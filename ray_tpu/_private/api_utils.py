@@ -1,0 +1,99 @@
+"""Shared helpers for building task specs from user calls.
+
+Options normalization mirrors the reference's
+``python/ray/_private/ray_option_utils.py``; argument promotion (large inline
+args become objects) mirrors ``put_threshold`` behavior in
+``python/ray/_raylet.pyx`` submit paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.task_spec import SchedulingStrategy, TaskArg
+
+_TASK_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns", "max_retries",
+    "retry_exceptions", "scheduling_strategy", "name", "runtime_env", "memory",
+    "label_selector", "_metadata",
+}
+_ACTOR_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
+    "max_concurrency", "name", "namespace", "lifetime", "get_if_exists",
+    "scheduling_strategy", "runtime_env", "memory", "label_selector", "max_pending_calls",
+    "_metadata",
+}
+
+
+def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
+    allowed = _ACTOR_OPTIONS if for_actor else _TASK_OPTIONS
+    for k in options:
+        if k not in allowed:
+            kind = "actor" if for_actor else "task"
+            raise ValueError(f"Invalid option {k!r} for {kind}; allowed: {sorted(allowed)}")
+    return options
+
+
+def build_resources(options: Dict[str, Any], default_num_cpus: float) -> Dict[str, float]:
+    resources: Dict[str, float] = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    resources["CPU"] = float(num_cpus if num_cpus is not None else default_num_cpus)
+    if options.get("num_gpus"):
+        resources["GPU"] = float(options["num_gpus"])
+    if options.get("num_tpus"):
+        resources["TPU"] = float(options["num_tpus"])
+    if options.get("memory"):
+        resources["memory"] = float(options["memory"])
+    return {k: v for k, v in resources.items() if v != 0}
+
+
+def normalize_strategy(strategy) -> SchedulingStrategy:
+    if strategy is None or strategy == "DEFAULT":
+        return SchedulingStrategy()
+    if strategy == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if isinstance(strategy, SchedulingStrategy):
+        return strategy
+    # duck-typed public strategies from ray_tpu.util.scheduling_strategies
+    kind = type(strategy).__name__
+    if kind == "NodeAffinitySchedulingStrategy":
+        return SchedulingStrategy(kind="NODE_AFFINITY", node_id=strategy.node_id,
+                                  soft=strategy.soft)
+    if kind == "PlacementGroupSchedulingStrategy":
+        pg = strategy.placement_group
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=pg.id,
+            bundle_index=strategy.placement_group_bundle_index,
+            capture_child_tasks=strategy.placement_group_capture_child_tasks,
+        )
+    if kind == "NodeLabelSchedulingStrategy":
+        return SchedulingStrategy(kind="NODE_LABEL", label_selector=dict(strategy.hard or {}))
+    raise ValueError(f"Unsupported scheduling strategy: {strategy!r}")
+
+
+def build_args(worker, args: Tuple, kwargs: Dict) -> Tuple[List[TaskArg], List[str]]:
+    """Serialize positional + keyword args; promote large values to objects."""
+    task_args: List[TaskArg] = []
+    kw_keys = list(kwargs.keys())
+    for value in list(args) + [kwargs[k] for k in kw_keys]:
+        if isinstance(value, ObjectRef):
+            task_args.append(TaskArg(is_ref=True, payload=value))
+            continue
+        payload, _refs = serialization.serialize(value)
+        if len(payload) > config.max_inline_object_size:
+            ref = worker.put(value)
+            task_args.append(TaskArg(is_ref=True, payload=ref))
+        else:
+            task_args.append(TaskArg(is_ref=False, payload=payload))
+    return task_args, kw_keys
+
+
+def next_task_id(worker) -> TaskID:
+    ctx = worker.current_ctx()
+    ctx.submit_index += 1
+    return TaskID.of(ctx.task_id, ctx.submit_index)

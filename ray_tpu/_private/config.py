@@ -1,0 +1,115 @@
+"""Central config-flag table, overridable via environment variables.
+
+TPU-native equivalent of the reference's ``RAY_CONFIG`` X-macro table
+(``src/ray/common/ray_config_def.h`` — 225 flags, overridable as ``RAY_{name}``
+env vars, materialized by the ``RayConfig`` singleton in
+``src/ray/common/ray_config.h``).  Here the table is a plain dict of typed
+defaults; every flag is overridable as ``RAY_TPU_{NAME}`` and the whole
+resolved map can be shipped cross-process (the reference passes
+``_system_config`` through ``ray.init``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_FLAG_DEFS: Dict[str, Any] = {
+    # --- transport / rpc ---
+    "rpc_connect_timeout_s": 30.0,
+    "rpc_retry_delay_ms": 100,
+    "rpc_max_retries": 5,
+    # chaos injection, same spirit as RAY_testing_rpc_failure
+    # (src/ray/rpc/rpc_chaos.h:23): "method=N:req_prob:resp_prob,..."
+    "testing_rpc_failure": "",
+    # --- object store ---
+    "object_store_memory_bytes": 2 * 1024**3,
+    # results smaller than this return in-band to the owner's memory store
+    # (reference: RayConfig::max_direct_call_object_size, 100KB)
+    "max_inline_object_size": 100 * 1024,
+    "object_spill_dir": "",
+    "object_store_fallback_dir": "",
+    # --- scheduling ---
+    # hybrid policy threshold (reference scheduler_spread_threshold,
+    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc)
+    "scheduler_spread_threshold": 0.5,
+    "worker_lease_timeout_s": 30.0,
+    # --- worker pool ---
+    "num_prestart_workers": 0,
+    "worker_startup_timeout_s": 60.0,
+    "idle_worker_kill_s": 300.0,
+    "maximum_startup_concurrency": 4,
+    # --- health / failure detection ---
+    # (reference gcs_health_check_manager.h:45 timings)
+    "health_check_period_s": 5.0,
+    "health_check_timeout_s": 30.0,
+    "num_heartbeats_timeout": 6,
+    # --- task/actor fault tolerance ---
+    "task_max_retries_default": 3,
+    "actor_max_restarts_default": 0,
+    # --- GCS ---
+    "gcs_storage": "memory",  # "memory" | "file" (persistence for FT)
+    "gcs_storage_path": "",
+    # --- logging / events ---
+    "event_log_enabled": True,
+    "log_rotation_bytes": 100 * 1024 * 1024,
+    # --- collective ---
+    "collective_op_timeout_s": 120.0,
+    # --- compiled graphs / channels ---
+    "channel_buffer_size_bytes": 4 * 1024**2,
+    "channel_acquire_timeout_s": 60.0,
+    # --- data ---
+    "data_target_block_size_bytes": 128 * 1024**2,
+    "data_max_inflight_tasks_per_op": 8,
+    # --- metrics ---
+    "metrics_report_interval_s": 5.0,
+}
+
+
+def _coerce(default: Any, raw: str) -> Any:
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+class _Config:
+    """Resolved flag map. Access flags as attributes: ``config.rpc_max_retries``."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self.reload()
+
+    def reload(self, overrides: Dict[str, Any] | None = None):
+        values = dict(_FLAG_DEFS)
+        for name, default in _FLAG_DEFS.items():
+            env = os.environ.get(f"RAY_TPU_{name.upper()}")
+            if env is None:
+                env = os.environ.get(f"RAY_TPU_{name}")
+            if env is not None:
+                values[name] = _coerce(default, env)
+        if overrides:
+            for k, v in overrides.items():
+                if k not in _FLAG_DEFS:
+                    raise ValueError(f"Unknown config flag: {k}")
+                values[k] = v
+        self._values = values
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def to_json(self) -> str:
+        return json.dumps(self._values)
+
+    def apply_json(self, payload: str):
+        self._values.update(json.loads(payload))
+
+
+config = _Config()

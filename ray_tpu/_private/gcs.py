@@ -1,0 +1,579 @@
+"""GCS: the cluster-global control plane server.
+
+TPU-native equivalent of the reference's GCS server
+(``src/ray/gcs/gcs_server/gcs_server.cc:186`` boot order: KV → node manager →
+resources → health checks → jobs → placement groups → actors → workers).
+Implements: node membership + health (``gcs_node_manager.h:49``,
+``gcs_health_check_manager.h:45``), the actor directory + actor scheduling
+(``gcs_actor_manager.h:328``, ``gcs_actor_scheduler.h:115`` — the GCS leases a
+worker from a raylet and pushes the creation task itself), placement groups
+(``gcs_placement_group_mgr.h:232`` with prepare/commit bundle reservation),
+internal KV (``gcs_kv_manager.h:34``), job table (``gcs_job_manager.h:52``),
+and a sequence-numbered pubsub feed (``src/ray/gcs/pubsub/``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import scheduling, serialization
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import ActorID, PlacementGroupID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.scheduling import NodeView, ResourceSet
+
+logger = logging.getLogger(__name__)
+
+
+class GcsServer:
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.server = RpcServer("gcs")
+        self.addr = ""
+
+        # tables
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.nodes: Dict[str, Dict[str, Any]] = {}
+        self.actors: Dict[bytes, Dict[str, Any]] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self.jobs: Dict[int, Dict[str, Any]] = {}
+        self.pgs: Dict[bytes, Dict[str, Any]] = {}
+        self.workers: Dict[bytes, Dict[str, Any]] = {}
+
+        self._job_counter = 0
+        self._raylet_clients: Dict[str, RpcClient] = {}
+        self._actor_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self._pg_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self._pending_actors: List[bytes] = []
+        self._pending_pgs: List[bytes] = []
+        self._events: List[Dict[str, Any]] = []  # pubsub feed with seq numbers
+        self._event_waiters: List[asyncio.Future] = []
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+        self.server.register_all(self)
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        bound_host, bound_port = await self.server.listen_tcp(host, port)
+        self.addr = f"tcp:{bound_host}:{bound_port}"
+        self._tasks.append(asyncio.ensure_future(self._health_check_loop()))
+        self._tasks.append(asyncio.ensure_future(self._retry_pending_loop()))
+        logger.info("gcs up at %s", self.addr)
+
+    def _raylet(self, node_id: str) -> Optional[RpcClient]:
+        node = self.nodes.get(node_id)
+        if node is None or not node.get("alive"):
+            return None
+        addr = node["addr"]
+        client = self._raylet_clients.get(addr)
+        if client is None:
+            client = RpcClient(addr, "gcs-raylet")
+            self._raylet_clients[addr] = client
+        return client
+
+    def _publish(self, channel: str, data: Dict[str, Any]):
+        self._events.append({"seq": len(self._events), "channel": channel,
+                             "time": time.time(), **data})
+        for w in self._event_waiters:
+            if not w.done():
+                w.set_result(None)
+        self._event_waiters.clear()
+
+    # ------------------------------------------------------------------ nodes
+
+    async def handle_register_node(self, node_id: str, addr: str,
+                                   resources: Dict[str, float],
+                                   labels: Dict[str, str],
+                                   node_name: str = "") -> Dict:
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "addr": addr,
+            "total": resources,
+            "available": dict(resources),
+            "labels": labels,
+            "node_name": node_name,
+            "alive": True,
+            "last_heartbeat": time.time(),
+            "start_time": time.time(),
+        }
+        self._publish("nodes", {"event": "node_added", "node_id": node_id})
+        self._kick_pending()
+        return {"ok": True}
+
+    async def handle_unregister_node(self, node_id: str) -> bool:
+        await self._mark_node_dead(node_id, reason="unregistered")
+        return True
+
+    async def handle_heartbeat(self, node_id: str, available: Dict[str, float]) -> Dict:
+        node = self.nodes.get(node_id)
+        if node is not None:
+            freed = node["available"] != available
+            node["available"] = available
+            node["last_heartbeat"] = time.time()
+            if freed:
+                self._kick_pending()
+        return {"nodes": self._cluster_view()}
+
+    def _cluster_view(self) -> List[Dict[str, Any]]:
+        return [
+            {"node_id": n["node_id"], "addr": n["addr"], "total": n["total"],
+             "available": n["available"], "labels": n["labels"], "alive": n["alive"]}
+            for n in self.nodes.values()
+        ]
+
+    async def handle_get_all_nodes(self) -> List[Dict[str, Any]]:
+        return [dict(n) for n in self.nodes.values()]
+
+    async def _health_check_loop(self):
+        # reference: gcs_health_check_manager.h:45 periodic node health checks
+        period = config.health_check_period_s / 5.0
+        timeout = period * config.num_heartbeats_timeout * 5
+        while not self._stopping:
+            now = time.time()
+            for node_id, node in list(self.nodes.items()):
+                if node["alive"] and now - node["last_heartbeat"] > timeout:
+                    logger.warning("node %s missed heartbeats; marking dead", node_id[:8])
+                    await self._mark_node_dead(node_id, reason="heartbeat timeout")
+            await asyncio.sleep(period)
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        node = self.nodes.get(node_id)
+        if node is None or not node["alive"]:
+            return
+        node["alive"] = False
+        node["death_reason"] = reason
+        self._publish("nodes", {"event": "node_dead", "node_id": node_id, "reason": reason})
+        # restart or fail actors that lived there
+        for actor_id, info in list(self.actors.items()):
+            if info.get("node_id") == node_id and info["state"] == "ALIVE":
+                await self._on_actor_interrupted(actor_id, f"node {node_id[:8]} died: {reason}")
+
+    # --------------------------------------------------------------------- kv
+
+    async def handle_kv_put(self, ns: str, key: str, value: bytes,
+                            overwrite: bool = True) -> bool:
+        k = (ns, key)
+        if not overwrite and k in self.kv:
+            return False
+        self.kv[k] = value
+        return True
+
+    async def handle_kv_get(self, ns: str, key: str) -> Optional[bytes]:
+        return self.kv.get((ns, key))
+
+    async def handle_kv_del(self, ns: str, key: str) -> bool:
+        return self.kv.pop((ns, key), None) is not None
+
+    async def handle_kv_keys(self, ns: str, prefix: str = "") -> List[str]:
+        return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    async def handle_kv_exists(self, ns: str, key: str) -> bool:
+        return (ns, key) in self.kv
+
+    # ------------------------------------------------------------------- jobs
+
+    async def handle_next_job_id(self) -> int:
+        self._job_counter += 1
+        return self._job_counter
+
+    async def handle_add_job(self, job_id: int, info: Dict[str, Any]) -> bool:
+        self.jobs[job_id] = {"job_id": job_id, "start_time": time.time(),
+                             "state": "RUNNING", **info}
+        return True
+
+    async def handle_mark_job_finished(self, job_id: int) -> bool:
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+            self.jobs[job_id]["end_time"] = time.time()
+        return True
+
+    async def handle_list_jobs(self) -> List[Dict[str, Any]]:
+        return list(self.jobs.values())
+
+    # ----------------------------------------------------------------- actors
+
+    async def handle_create_actor(self, spec_bytes: bytes) -> bool:
+        spec = serialization.loads(spec_bytes)
+        actor_id = spec.actor_id.binary()
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            if key in self.named_actors:
+                existing = self.named_actors[key]
+                if self.actors.get(existing, {}).get("state") != "DEAD":
+                    raise ValueError(
+                        f"Actor name {spec.actor_name!r} already taken in "
+                        f"namespace {spec.namespace!r}"
+                    )
+            self.named_actors[key] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "state": "PENDING_CREATION",
+            "spec": spec_bytes,
+            "name": spec.actor_name,
+            "namespace": spec.namespace,
+            "max_restarts": spec.max_restarts,
+            "num_restarts": 0,
+            "addr": None,
+            "node_id": None,
+            "worker_id": None,
+            "class_name": spec.function.qualname,
+            "start_time": time.time(),
+        }
+        self._publish("actors", {"event": "actor_registered", "actor_id": actor_id})
+        asyncio.ensure_future(self._schedule_actor(actor_id))
+        return True
+
+    async def _schedule_actor(self, actor_id: bytes):
+        info = self.actors.get(actor_id)
+        if info is None or info["state"] == "DEAD":
+            return
+        spec = serialization.loads(info["spec"])
+        demand = ResourceSet(spec.resources)
+        strategy = spec.scheduling_strategy
+        pick: Optional[str] = None
+        if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group_id is not None:
+            pg = self.pgs.get(strategy.placement_group_id.binary())
+            if pg and pg.get("placement"):
+                idx = strategy.bundle_index if strategy.bundle_index >= 0 else 0
+                pick = pg["placement"][idx]
+        else:
+            views = [NodeView(n["node_id"], n["total"], n["available"], n["labels"], n["alive"])
+                     for n in self.nodes.values()]
+            pick = scheduling.pick_node(
+                views, demand,
+                strategy_kind=strategy.kind if strategy.kind != "PLACEMENT_GROUP" else "DEFAULT",
+                affinity_node_id=strategy.node_id,
+                soft=strategy.soft,
+                label_selector=strategy.label_selector,
+                spread_threshold=config.scheduler_spread_threshold,
+            )
+        if pick is None:
+            if actor_id not in self._pending_actors:
+                self._pending_actors.append(actor_id)
+            return
+        raylet = self._raylet(pick)
+        if raylet is None:
+            if actor_id not in self._pending_actors:
+                self._pending_actors.append(actor_id)
+            return
+        try:
+            lease = await raylet.call(
+                "lease_worker",
+                resources=spec.resources,
+                strategy_kind="NODE_AFFINITY",
+                node_id=pick,
+                pg_id=(strategy.placement_group_id.binary()
+                       if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group_id
+                       else None),
+                bundle_index=strategy.bundle_index,
+                owner_addr="gcs",
+                dedicated=True,
+                timeout=config.worker_lease_timeout_s * 4,
+            )
+            if "spillback" in lease:
+                # stale view; retry via pending queue
+                if actor_id not in self._pending_actors:
+                    self._pending_actors.append(actor_id)
+                return
+            info["node_id"] = pick
+            info["worker_id"] = lease["worker_id"]
+            worker = RpcClient(lease["worker_addr"], "gcs-actor-push")
+            reply = await worker.call(
+                "push_task", spec_bytes=info["spec"], timeout=None
+            )
+            await worker.close()
+            # worker reports ready itself via report_actor_ready; creation
+            # errors arrive via report_actor_failed
+            if any(r.get("is_error") for r in reply.get("returns", [])):
+                return
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor %s scheduling failed: %s", actor_id.hex()[:8], e)
+            if actor_id not in self._pending_actors:
+                self._pending_actors.append(actor_id)
+
+    async def _retry_pending_loop(self):
+        while not self._stopping:
+            await asyncio.sleep(0.5)
+            self._kick_pending()
+
+    def _kick_pending(self):
+        pending_actors, self._pending_actors = self._pending_actors, []
+        for actor_id in pending_actors:
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        pending_pgs, self._pending_pgs = self._pending_pgs, []
+        for pg_id in pending_pgs:
+            asyncio.ensure_future(self._schedule_pg(pg_id))
+
+    async def handle_report_actor_ready(self, actor_id: bytes, addr: str, node_id: str,
+                                        worker_id: bytes) -> bool:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info.update(state="ALIVE", addr=addr, node_id=node_id, worker_id=worker_id)
+        self._publish("actors", {"event": "actor_alive", "actor_id": actor_id})
+        for fut in self._actor_waiters.pop(actor_id, []):
+            if not fut.done():
+                fut.set_result(None)
+        return True
+
+    async def handle_report_actor_failed(self, actor_id: bytes, error: bytes) -> bool:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        info["state"] = "DEAD"
+        info["death_cause"] = "creation task failed"
+        info["creation_error"] = error
+        self._publish("actors", {"event": "actor_dead", "actor_id": actor_id})
+        for fut in self._actor_waiters.pop(actor_id, []):
+            if not fut.done():
+                fut.set_result(None)
+        return True
+
+    async def handle_wait_actor_ready(self, actor_id: bytes, timeout: float = 60.0) -> Dict:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return {"state": "NOT_FOUND"}
+        if info["state"] in ("ALIVE", "DEAD"):
+            return {"state": info["state"], "addr": info.get("addr")}
+        fut = asyncio.get_event_loop().create_future()
+        self._actor_waiters.setdefault(actor_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+        info = self.actors.get(actor_id, {"state": "NOT_FOUND"})
+        return {"state": info.get("state"), "addr": info.get("addr")}
+
+    async def handle_get_actor_info(self, actor_id: bytes) -> Optional[Dict[str, Any]]:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        return {k: v for k, v in info.items() if k != "spec"}
+
+    async def handle_get_named_actor(self, name: str, namespace: str = "") -> Optional[bytes]:
+        actor_id = self.named_actors.get((namespace, name))
+        if actor_id is None:
+            return None
+        if self.actors.get(actor_id, {}).get("state") == "DEAD":
+            return None
+        return actor_id
+
+    async def handle_list_named_actors(self, namespace: Optional[str] = None) -> List[Dict]:
+        out = []
+        for (ns, name), aid in self.named_actors.items():
+            if namespace is not None and ns != namespace:
+                continue
+            if self.actors.get(aid, {}).get("state") != "DEAD":
+                out.append({"name": name, "namespace": ns})
+        return out
+
+    async def handle_list_actors(self) -> List[Dict[str, Any]]:
+        return [{k: v for k, v in a.items() if k != "spec"} for a in self.actors.values()]
+
+    async def handle_kill_actor(self, actor_id: bytes, no_restart: bool = True) -> bool:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        addr = info.get("addr")
+        info["state"] = "DEAD"
+        info["death_cause"] = "killed via kill_actor"
+        if info.get("name"):
+            self.named_actors.pop((info["namespace"], info["name"]), None)
+        self._publish("actors", {"event": "actor_dead", "actor_id": actor_id})
+        if addr:
+            try:
+                client = RpcClient(addr)
+                await asyncio.wait_for(client.call("kill_actor", no_restart=no_restart), 2.0)
+                await client.close()
+            except Exception:
+                pass
+        return True
+
+    async def handle_report_worker_death(self, node_id: str, worker_id: bytes,
+                                         had_lease: bool) -> bool:
+        for actor_id, info in list(self.actors.items()):
+            if info.get("worker_id") == worker_id and info["state"] == "ALIVE":
+                await self._on_actor_interrupted(actor_id, "worker process died")
+        return True
+
+    async def _on_actor_interrupted(self, actor_id: bytes, reason: str):
+        info = self.actors[actor_id]
+        max_restarts = info.get("max_restarts", 0)
+        if max_restarts == -1 or info["num_restarts"] < max_restarts:
+            info["num_restarts"] += 1
+            info["state"] = "RESTARTING"
+            info["addr"] = None
+            logger.info("restarting actor %s (%d/%s): %s", actor_id.hex()[:8],
+                        info["num_restarts"], max_restarts, reason)
+            self._publish("actors", {"event": "actor_restarting", "actor_id": actor_id})
+            asyncio.ensure_future(self._schedule_actor(actor_id))
+        else:
+            info["state"] = "DEAD"
+            info["death_cause"] = reason
+            if info.get("name"):
+                self.named_actors.pop((info["namespace"], info["name"]), None)
+            self._publish("actors", {"event": "actor_dead", "actor_id": actor_id})
+            for fut in self._actor_waiters.pop(actor_id, []):
+                if not fut.done():
+                    fut.set_result(None)
+
+    # ------------------------------------------------------- placement groups
+
+    async def handle_create_placement_group(self, bundles: List[Dict[str, float]],
+                                            strategy: str = "PACK",
+                                            name: str = "") -> bytes:
+        pg_id = PlacementGroupID.from_random().binary()
+        self.pgs[pg_id] = {
+            "pg_id": pg_id,
+            "bundles": bundles,
+            "strategy": strategy,
+            "name": name,
+            "state": "PENDING",
+            "placement": None,
+            "create_time": time.time(),
+        }
+        asyncio.ensure_future(self._schedule_pg(pg_id))
+        return pg_id
+
+    async def _schedule_pg(self, pg_id: bytes):
+        pg = self.pgs.get(pg_id)
+        if pg is None or pg["state"] in ("CREATED", "REMOVED"):
+            return
+        views = [NodeView(n["node_id"], n["total"], n["available"], n["labels"], n["alive"])
+                 for n in self.nodes.values() if n["alive"]]
+        placement = scheduling.pack_bundles(views, pg["bundles"], pg["strategy"])
+        if placement is None:
+            if pg_id not in self._pending_pgs:
+                self._pending_pgs.append(pg_id)
+            return
+        # two-phase: reserve every bundle, roll back on any failure
+        # (reference gcs_placement_group_scheduler.h:288 prepare/commit)
+        reserved: List[Tuple[str, int]] = []
+        ok = True
+        for idx, (node_id, bundle) in enumerate(zip(placement, pg["bundles"])):
+            raylet = self._raylet(node_id)
+            if raylet is None:
+                ok = False
+                break
+            try:
+                success = await raylet.call("reserve_bundle", pg_id=pg_id,
+                                            bundle_index=idx, resources=bundle)
+            except Exception:
+                success = False
+            if not success:
+                ok = False
+                break
+            reserved.append((node_id, idx))
+        if not ok:
+            for node_id, idx in reserved:
+                raylet = self._raylet(node_id)
+                if raylet is not None:
+                    try:
+                        await raylet.call("release_placement_group", pg_id=pg_id)
+                    except Exception:
+                        pass
+            if pg_id not in self._pending_pgs:
+                self._pending_pgs.append(pg_id)
+            return
+        pg["placement"] = placement
+        pg["state"] = "CREATED"
+        self._publish("pgs", {"event": "pg_created", "pg_id": pg_id})
+        for fut in self._pg_waiters.pop(pg_id, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def handle_wait_placement_group_ready(self, pg_id: bytes,
+                                                timeout: float = 60.0) -> Dict:
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            return {"state": "NOT_FOUND"}
+        if pg["state"] == "CREATED":
+            return {"state": "CREATED", "placement": pg["placement"]}
+        fut = asyncio.get_event_loop().create_future()
+        self._pg_waiters.setdefault(pg_id, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            pass
+        pg = self.pgs.get(pg_id, {"state": "NOT_FOUND"})
+        return {"state": pg.get("state"), "placement": pg.get("placement")}
+
+    async def handle_get_placement_group(self, pg_id: bytes) -> Optional[Dict[str, Any]]:
+        pg = self.pgs.get(pg_id)
+        return None if pg is None else dict(pg)
+
+    async def handle_list_placement_groups(self) -> List[Dict[str, Any]]:
+        return [dict(p) for p in self.pgs.values()]
+
+    async def handle_remove_placement_group(self, pg_id: bytes) -> bool:
+        pg = self.pgs.get(pg_id)
+        if pg is None:
+            return False
+        if pg.get("placement"):
+            for node_id in set(pg["placement"]):
+                raylet = self._raylet(node_id)
+                if raylet is not None:
+                    try:
+                        await raylet.call("release_placement_group", pg_id=pg_id)
+                    except Exception:
+                        pass
+        pg["state"] = "REMOVED"
+        self._publish("pgs", {"event": "pg_removed", "pg_id": pg_id})
+        return True
+
+    # ----------------------------------------------------------------- pubsub
+
+    async def handle_subscribe(self, cursor: int = 0, channel: Optional[str] = None,
+                               timeout: float = 30.0) -> Dict:
+        """Long-poll pubsub (reference src/ray/pubsub long-poll protocol)."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            events = [e for e in self._events[cursor:]
+                      if channel is None or e["channel"] == channel]
+            if events or asyncio.get_event_loop().time() >= deadline:
+                return {"events": events, "cursor": len(self._events)}
+            fut = asyncio.get_event_loop().create_future()
+            self._event_waiters.append(fut)
+            try:
+                await asyncio.wait_for(
+                    fut, max(0.01, deadline - asyncio.get_event_loop().time()))
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------------ aggregation
+
+    async def handle_cluster_resources(self) -> Dict[str, float]:
+        total = ResourceSet({})
+        for n in self.nodes.values():
+            if n["alive"]:
+                total.add(ResourceSet(n["total"]))
+        return total.to_dict()
+
+    async def handle_available_resources(self) -> Dict[str, float]:
+        avail = ResourceSet({})
+        for n in self.nodes.values():
+            if n["alive"]:
+                avail.add(ResourceSet(n["available"]))
+        return avail.to_dict()
+
+    async def handle_shutdown_cluster(self) -> bool:
+        asyncio.ensure_future(self.stop_cluster())
+        return True
+
+    async def stop_cluster(self):
+        self._stopping = True
+        for node_id in list(self.nodes):
+            raylet = self._raylet(node_id)
+            if raylet is not None:
+                try:
+                    await asyncio.wait_for(raylet.call("shutdown_node"), 3.0)
+                except Exception:
+                    pass
+        for t in self._tasks:
+            t.cancel()
+        await self.server.close()
+        loop = asyncio.get_event_loop()
+        loop.call_later(0.2, loop.stop)

@@ -1,0 +1,69 @@
+"""Head-node process: GCS + head raylet on one event loop.
+
+Process-bootstrap equivalent of the reference's
+``python/ray/_private/node.py:1467 start_ray_processes`` head path (GCS server
++ raylet + monitors).  One process hosting both servers keeps the single-host
+footprint small; additional raylets join as separate processes
+(``raylet_proc.py``), giving the reference's multi-node-on-one-host test
+topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", required=True, help="json resource map")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+
+    gcs = GcsServer(args.session_dir)
+    raylet = Raylet(
+        args.session_dir,
+        gcs_addr="",  # filled in after gcs start
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        node_name="head",
+    )
+
+    async def _start():
+        await gcs.start(port=args.port)
+        raylet.gcs_addr = gcs.addr
+        raylet.gcs.addr = gcs.addr
+        await raylet.start()
+        # head marker for the driver: address file
+        addr_file = os.path.join(args.session_dir, "gcs_address")
+        with open(addr_file + ".tmp", "w") as f:
+            f.write(gcs.addr)
+        os.rename(addr_file + ".tmp", addr_file)
+
+    loop.run_until_complete(_start())
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

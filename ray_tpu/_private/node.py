@@ -1,0 +1,179 @@
+"""Driver-side session bootstrap: start/connect/stop the cluster processes.
+
+Equivalent of the reference's ``python/ray/_private/node.py`` +
+``services.py`` (``start_ray_processes`` at ``node.py:1467``,
+``start_gcs_server`` at ``:1203``, ``start_raylet`` at ``:1237``): spawn the
+head process (GCS + head raylet), wait for readiness, connect the driver's
+CoreWorker, and tear everything down on shutdown.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_SESSION_ROOT = "/tmp/ray_tpu"
+
+
+def default_resources(num_cpus: Optional[float] = None,
+                      num_tpus: Optional[float] = None) -> Dict[str, float]:
+    """Auto-detected node resources (reference:
+    ``python/ray/_private/accelerators/tpu.py:109`` TPUAcceleratorManager
+    detects chips via /dev/accel* and /dev/vfio)."""
+    if num_cpus is None:
+        num_cpus = float(max(os.cpu_count() or 1, 4))
+    resources = {"CPU": float(num_cpus)}
+    if num_tpus is None:
+        num_tpus = float(_detect_tpu_chips())
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    resources["memory"] = float(_detect_memory_bytes())
+    return resources
+
+
+def _detect_tpu_chips() -> int:
+    # reference tpu.py:134-154 — count /dev/accel* or /dev/vfio/* entries
+    count = len([d for d in os.listdir("/dev") if d.startswith("accel")]) if os.path.isdir("/dev") else 0
+    if count == 0 and os.path.isdir("/dev/vfio"):
+        count = len([d for d in os.listdir("/dev/vfio") if d != "vfio"])
+    if count == 0:
+        # tunnel/axon environments expose chips only through jax
+        try:
+            import jax
+
+            count = len([d for d in jax.devices() if "cpu" not in d.platform.lower()])
+        except Exception:
+            count = 0
+    return count
+
+
+def _detect_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal"):
+                    return int(line.split()[1]) * 1024 // 2
+    except Exception:
+        pass
+    return 4 * 1024**3
+
+
+class NodeServices:
+    """Owns the head subprocess + session directory for one driver."""
+
+    def __init__(self):
+        self.session_dir: str = ""
+        self.gcs_addr: str = ""
+        self.head_proc: Optional[subprocess.Popen] = None
+        self._owns_cluster = False
+
+    def start_head(
+        self,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+        system_config: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        ts = time.strftime("%Y-%m-%d_%H-%M-%S")
+        self.session_dir = os.path.join(_SESSION_ROOT, f"session_{ts}_{os.getpid()}_{time.time_ns() % 10**6}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        os.makedirs(os.path.join(self.session_dir, "sockets"), exist_ok=True)
+        env = dict(os.environ)
+        if system_config:
+            for k, v in system_config.items():
+                env[f"RAY_TPU_{k.upper()}"] = str(v)
+        log = open(os.path.join(self.session_dir, "logs", "head.log"), "ab")
+        self.head_proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu._private.head_proc",
+                "--session-dir", self.session_dir,
+                "--resources", json.dumps(resources),
+                "--labels", json.dumps(labels or {}),
+            ],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        self._owns_cluster = True
+        addr_file = os.path.join(self.session_dir, "gcs_address")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if os.path.exists(addr_file):
+                with open(addr_file) as f:
+                    self.gcs_addr = f.read().strip()
+                atexit.register(self.stop)
+                return self.gcs_addr
+            if self.head_proc.poll() is not None:
+                log_path = os.path.join(self.session_dir, "logs", "head.log")
+                tail = ""
+                try:
+                    with open(log_path) as f:
+                        tail = f.read()[-4000:]
+                except Exception:
+                    pass
+                raise RuntimeError(
+                    f"head process exited rc={self.head_proc.returncode}\n{tail}")
+            time.sleep(0.05)
+        raise TimeoutError("timed out waiting for head to start")
+
+    def stop(self):
+        if not self._owns_cluster:
+            return
+        self._owns_cluster = False
+        # graceful cluster shutdown via GCS, then hard-kill
+        try:
+            import asyncio
+
+            from ray_tpu._private.rpc import RpcClient
+
+            async def _down():
+                c = RpcClient(self.gcs_addr)
+                try:
+                    await asyncio.wait_for(c.call("shutdown_cluster"), 3.0)
+                finally:
+                    await c.close()
+
+            loop = asyncio.new_event_loop()
+            try:
+                loop.run_until_complete(_down())
+            finally:
+                for t in asyncio.all_tasks(loop):
+                    t.cancel()
+                loop.run_until_complete(asyncio.sleep(0))
+                loop.close()
+        except Exception:
+            pass
+        if self.head_proc is not None:
+            try:
+                self.head_proc.wait(timeout=3)
+            except Exception:
+                try:
+                    self.head_proc.kill()
+                except Exception:
+                    pass
+            self.head_proc = None
+        self._cleanup_shm()
+
+    def _cleanup_shm(self):
+        # unlink any leftover rtpu_* shared-memory objects from this session's
+        # stores (plasma-equivalent teardown)
+        try:
+            for name in os.listdir("/dev/shm"):
+                if name.startswith("rtpu_"):
+                    try:
+                        os.unlink(os.path.join("/dev/shm", name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        if self.session_dir and os.path.isdir(self.session_dir):
+            shutil.rmtree(self.session_dir, ignore_errors=True)

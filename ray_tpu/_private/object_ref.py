@@ -1,0 +1,73 @@
+"""ObjectRef — a typed future naming an object in the cluster.
+
+Equivalent of the reference's ``ObjectRef`` (``python/ray/_raylet.pyx`` /
+``src/ray/common/id.h`` ObjectID + ownership metadata from
+``src/ray/core_worker/reference_count.h:72``).  Each ref carries its owner's
+address so any holder can resolve the value directly from the owner (the
+ownership model: the worker that created an object serves and refcounts it).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "_in_band")
+
+    def __init__(self, object_id: ObjectID, owner_addr: Optional[str] = None):
+        self.id = object_id
+        self.owner_addr = owner_addr
+        self._in_band = None  # local-mode fast path: value carried inline
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Track refs crossing serialization boundaries (borrower registration,
+        # reference: reference_count.h borrow protocol).
+        from ray_tpu._private import serialization
+
+        serialization.note_serialized_ref(self)
+        return (_rebuild_ref, (self.id, self.owner_addr))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+
+        import ray_tpu
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        try:
+            fut.set_result(ray_tpu.get(self))
+        except Exception as e:  # noqa: BLE001
+            fut.set_exception(e)
+        return fut
+
+    def __await__(self):
+        # Awaitable inside async actors/drivers.
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker.get_async(self).__await__()
+
+
+def _rebuild_ref(object_id, owner_addr):
+    from ray_tpu._private import serialization
+
+    ref = ObjectRef(object_id, owner_addr)
+    serialization.note_deserialized_ref(ref)
+    return ref

@@ -1,0 +1,208 @@
+"""Node-local shared-memory object store + per-worker in-band memory store.
+
+TPU-native equivalent of the reference's plasma store
+(``src/ray/object_manager/plasma/store.h:55``) and the per-worker
+``CoreWorkerMemoryStore`` (``src/ray/core_worker/store_provider/memory_store/``).
+
+Design differences from the reference, deliberate for the TPU era:
+
+* Objects live in named POSIX shared memory (``/dev/shm``), one segment per
+  object, attachable by any process on the host — which also makes the
+  multi-raylet-per-host test topology (reference ``cluster_utils.py:135``)
+  zero-copy across "nodes".  The reference instead runs a single dlmalloc
+  arena inside the raylet served over a unix socket; a C++ arena allocator is
+  the planned upgrade path behind this same interface.
+* Host-to-TPU staging: payload buffers are 64-byte aligned (see
+  ``serialization.py``) so ``jax.device_put`` can DMA straight from the
+  mapped segment into HBM without an intermediate copy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+# Segments whose buffers are still exported (zero-copy numpy/jax views) when
+# the store closes: keep them referenced so SharedMemory.__del__ never runs
+# (closing a mapped buffer raises BufferError; the OS reclaims at process
+# exit — this is exactly the plasma model where the store owns segment
+# lifetime, not Python GC).
+_leaked_segments: List = []
+
+
+def _untrack(seg: shared_memory.SharedMemory):
+    """Stop multiprocessing.resource_tracker from auto-unlinking this segment.
+
+    The framework's raylet/session owns shm cleanup (reference: plasma store
+    teardown), not Python's per-process resource tracker — which would unlink
+    objects still in use by other workers and spam warnings at exit.
+    """
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+
+def shm_name_for(object_id: ObjectID) -> str:
+    return f"rtpu_{object_id.hex()}"
+
+
+class SharedObjectStore:
+    """Create/attach sealed immutable objects in host shared memory."""
+
+    def __init__(self):
+        self._segments: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._created: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    # -- creation (producer side) --------------------------------------------
+
+    def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
+        """Write an already-serialized payload; returns the shm name."""
+        name = shm_name_for(object_id)
+        try:
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(1, len(payload)))
+            _untrack(seg)
+        except FileExistsError:
+            # Object already stored (e.g. deterministic re-execution); reuse.
+            with self._lock:
+                if object_id not in self._segments:
+                    seg = shared_memory.SharedMemory(name=name)
+                    _untrack(seg)
+                    self._segments[object_id] = seg
+            return name
+        seg.buf[: len(payload)] = payload
+        with self._lock:
+            self._created[object_id] = seg
+            self._segments[object_id] = seg
+        return name
+
+    def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int, List]:
+        payload, refs = serialization.serialize(value)
+        name = self.put_serialized(object_id, payload)
+        return name, len(payload), refs
+
+    # -- access (consumer side) ----------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            if object_id in self._segments:
+                return True
+        return os.path.exists(f"/dev/shm/{shm_name_for(object_id)}")
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        with self._lock:
+            seg = self._segments.get(object_id)
+        if seg is None:
+            try:
+                seg = shared_memory.SharedMemory(name=shm_name_for(object_id))
+                _untrack(seg)
+            except FileNotFoundError:
+                return None
+            with self._lock:
+                self._segments.setdefault(object_id, seg)
+                seg = self._segments[object_id]
+        return seg.buf
+
+    def get(self, object_id: ObjectID) -> Tuple[Any, List]:
+        buf = self.get_buffer(object_id)
+        if buf is None:
+            raise KeyError(object_id)
+        return serialization.deserialize(buf)
+
+    def get_bytes(self, object_id: ObjectID) -> Optional[bytes]:
+        buf = self.get_buffer(object_id)
+        return None if buf is None else bytes(buf)
+
+    # -- lifetime -------------------------------------------------------------
+
+    def release(self, object_id: ObjectID):
+        """Drop this process's mapping (does not delete the object)."""
+        with self._lock:
+            seg = self._segments.pop(object_id, None)
+            self._created.pop(object_id, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except Exception:
+                pass
+
+    def delete(self, object_id: ObjectID):
+        """Unlink the object from shared memory (cluster-wide delete)."""
+        with self._lock:
+            seg = self._segments.pop(object_id, None)
+            self._created.pop(object_id, None)
+        try:
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=shm_name_for(object_id))
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            logger.debug("delete %s failed", object_id, exc_info=True)
+
+    def close(self, unlink_created: bool = True):
+        with self._lock:
+            segments = dict(self._segments)
+            created = dict(self._created)
+            self._segments.clear()
+            self._created.clear()
+        for oid, seg in segments.items():
+            try:
+                seg.close()
+            except BufferError:
+                # buffers still exported by live numpy/jax views: neutralize
+                # __del__ (OS reclaims the mapping at process exit)
+                seg.close = lambda: None
+                _leaked_segments.append(seg)
+            except Exception:
+                pass
+        if unlink_created:
+            for oid in created:
+                try:
+                    shared_memory.SharedMemory(name=shm_name_for(oid)).unlink()
+                except Exception:
+                    pass
+
+
+class MemoryStore:
+    """Per-worker store for small in-band objects (owner serves peers).
+
+    Reference: ``CoreWorkerMemoryStore`` — small task returns are shipped in
+    the task reply and served from the owner's memory, avoiding shm traffic.
+    """
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, object_id: ObjectID, payload: bytes):
+        with self._lock:
+            self._objects[object_id] = payload
+
+    def get(self, object_id: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

@@ -1,0 +1,507 @@
+"""Raylet: the per-node scheduler daemon.
+
+TPU-native equivalent of the reference's raylet
+(``src/ray/raylet/node_manager.h:122``): worker-process pool
+(``worker_pool.h``), worker-lease protocol
+(``HandleRequestWorkerLease`` at ``node_manager.cc:1986``), cluster-view
+based placement with spillback (``cluster_task_manager.cc:47,200``), local
+dispatch (``local_task_manager.cc:122``, ``PopWorker :369``), and
+placement-group bundle reservations
+(``placement_group_resource_manager.h``).
+
+Multiple raylets can run on one host with distinct sockets/resources — the
+test topology of the reference's ``cluster_utils.Cluster``
+(``python/ray/cluster_utils.py:135``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import scheduling
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.scheduling import NodeView, ResourceSet
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    __slots__ = ("worker_id", "addr", "pid", "proc", "client", "lease", "dedicated", "started_at")
+
+    def __init__(self, worker_id: bytes, addr: str, pid: int, proc):
+        self.worker_id = worker_id
+        self.addr = addr
+        self.pid = pid
+        self.proc = proc
+        self.client: Optional[RpcClient] = None
+        self.lease: Optional[Dict[str, Any]] = None
+        self.dedicated = False
+        self.started_at = time.time()
+
+
+class Raylet:
+    def __init__(
+        self,
+        session_dir: str,
+        gcs_addr: str,
+        resources: Dict[str, float],
+        labels: Optional[Dict[str, str]] = None,
+        node_id: Optional[str] = None,
+        node_name: str = "",
+    ):
+        self.session_dir = session_dir
+        self.gcs_addr = gcs_addr
+        self.node_id = node_id or NodeID.from_random().hex()
+        self.node_name = node_name
+        self.total = ResourceSet(resources)
+        self.available = self.total.copy()
+        self.labels = labels or {}
+
+        self.server = RpcServer(f"raylet-{self.node_id[:8]}")
+        self.addr = ""
+        self.gcs = RpcClient(gcs_addr, "raylet-gcs")
+
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.idle: deque = deque()
+        self._spawned_procs: Dict[int, Any] = {}
+        self._starting = 0
+        self._register_waiters: deque = deque()  # futures for newly registered workers
+        self._lease_waiters: deque = deque()  # (demand, pg, bundle, future)
+        # pg_id -> {bundle_index -> available ResourceSet}
+        self.bundles: Dict[bytes, Dict[int, ResourceSet]] = {}
+        self._bundle_totals: Dict[bytes, Dict[int, ResourceSet]] = {}
+        self.cluster_view: List[Dict[str, Any]] = []
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+        self.server.register_all(self)
+
+    # ------------------------------------------------------------------ start
+
+    async def start(self):
+        sock = os.path.join(self.session_dir, "sockets", f"raylet_{self.node_id[:12]}.sock")
+        os.makedirs(os.path.dirname(sock), exist_ok=True)
+        await self.server.listen_unix(sock)
+        self.addr = f"unix:{sock}"
+        await self.gcs.call(
+            "register_node",
+            node_id=self.node_id,
+            addr=self.addr,
+            resources=self.total.to_dict(),
+            labels=self.labels,
+            node_name=self.node_name,
+        )
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reaper_loop()))
+        for _ in range(config.num_prestart_workers):
+            self._start_worker()
+        logger.info("raylet %s up at %s resources=%s", self.node_id[:8], self.addr,
+                    self.total.to_dict())
+
+    async def _heartbeat_loop(self):
+        # Resource broadcast: the role of the reference's RaySyncer
+        # (src/ray/common/ray_syncer/ray_syncer.h:83) — periodic usage sync,
+        # with the GCS returning the aggregated cluster view.
+        period = config.health_check_period_s / 5.0
+        while not self._stopping:
+            try:
+                reply = await self.gcs.call(
+                    "heartbeat",
+                    node_id=self.node_id,
+                    available=self.available.to_dict(),
+                )
+                self.cluster_view = reply.get("nodes", [])
+            except Exception as e:  # noqa: BLE001
+                logger.debug("heartbeat failed: %s", e)
+            await asyncio.sleep(period)
+
+    async def _reaper_loop(self):
+        while not self._stopping:
+            dead = []
+            for wid, h in list(self.workers.items()):
+                exited = False
+                if h.proc is not None:
+                    exited = h.proc.poll() is not None
+                elif h.pid:
+                    try:
+                        os.kill(h.pid, 0)
+                    except ProcessLookupError:
+                        exited = True
+                if exited:
+                    dead.append(h)
+            for h in dead:
+                await self._on_worker_death(h)
+            # reap zombies of spawned-but-never-registered workers
+            for pid, proc in list(self._spawned_procs.items()):
+                if proc.poll() is not None and not any(
+                    h.pid == pid for h in self.workers.values()
+                ):
+                    self._spawned_procs.pop(pid, None)
+                    self._starting = max(0, self._starting - 1)
+                    logger.warning("worker pid %s exited before registering (rc=%s)",
+                                   pid, proc.returncode)
+            await asyncio.sleep(0.2)
+
+    async def _on_worker_death(self, h: WorkerHandle):
+        logger.warning("worker %s (pid %s) died", h.worker_id.hex()[:8], h.pid)
+        self.workers.pop(h.worker_id, None)
+        self._spawned_procs.pop(h.pid, None)
+        if h in self.idle:
+            try:
+                self.idle.remove(h)
+            except ValueError:
+                pass
+        lease = h.lease
+        if lease is not None:
+            self._release_lease_resources(lease)
+            h.lease = None
+        try:
+            await self.gcs.call(
+                "report_worker_death", node_id=self.node_id,
+                worker_id=h.worker_id, had_lease=lease is not None,
+            )
+        except Exception:
+            pass
+        self._pump_leases()
+
+    # ------------------------------------------------------------ worker pool
+
+    def _start_worker(self):
+        self._starting += 1
+        env = dict(os.environ)
+        env.update(
+            RAY_TPU_SESSION_DIR=self.session_dir,
+            RAY_TPU_GCS_ADDR=self.gcs_addr,
+            RAY_TPU_RAYLET_ADDR=self.addr,
+            RAY_TPU_NODE_ID=self.node_id,
+        )
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{time.time_ns()}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        self._spawned_procs[proc.pid] = proc
+        return proc
+
+    async def handle_register_worker(self, worker_id: bytes, addr: str, pid: int) -> Dict:
+        h = WorkerHandle(worker_id, addr, pid, self._spawned_procs.get(pid))
+        self.workers[worker_id] = h
+        self._starting = max(0, self._starting - 1)
+        self.idle.append(h)
+        self._pump_leases()
+        return {"node_id": self.node_id, "session_dir": self.session_dir}
+
+    def _adopt_proc(self, pid: int, proc):
+        for h in self.workers.values():
+            if h.pid == pid:
+                h.proc = proc
+                return
+
+    # ---------------------------------------------------------------- leasing
+
+    def _node_views(self) -> List[NodeView]:
+        views = []
+        for n in self.cluster_view:
+            if n["node_id"] == self.node_id:
+                views.append(NodeView(self.node_id, self.total.to_dict(),
+                                      self.available.to_dict(), self.labels, True))
+            else:
+                views.append(NodeView(n["node_id"], n["total"], n["available"],
+                                      n.get("labels", {}), n.get("alive", True)))
+        if not any(v.node_id == self.node_id for v in views):
+            views.append(NodeView(self.node_id, self.total.to_dict(),
+                                  self.available.to_dict(), self.labels, True))
+        return views
+
+    def _addr_of(self, node_id: str) -> Optional[str]:
+        for n in self.cluster_view:
+            if n["node_id"] == node_id:
+                return n["addr"]
+        return None
+
+    async def handle_lease_worker(
+        self,
+        resources: Dict[str, float],
+        strategy_kind: str = "DEFAULT",
+        node_id: Optional[str] = None,
+        soft: bool = False,
+        pg_id: Optional[bytes] = None,
+        bundle_index: int = -1,
+        label_selector: Optional[Dict[str, str]] = None,
+        owner_addr: str = "",
+        dedicated: bool = False,
+    ) -> Dict:
+        demand = ResourceSet(resources)
+        if pg_id is not None:
+            # Placement-group lease: the bundle's node is authoritative.
+            target = await self._pg_bundle_node(pg_id, bundle_index, demand)
+            if target is None:
+                raise RuntimeError("placement group bundle not found/ready")
+            if target != self.node_id:
+                addr = self._addr_of(target) or (await self._gcs_node_addr(target))
+                return {"spillback": addr}
+            return await self._grant_local(demand, pg_id, bundle_index, dedicated, owner_addr)
+
+        pick = scheduling.pick_node(
+            self._node_views(),
+            demand,
+            strategy_kind=strategy_kind,
+            local_node_id=self.node_id,
+            affinity_node_id=node_id,
+            soft=soft,
+            label_selector=label_selector,
+            spread_threshold=config.scheduler_spread_threshold,
+        )
+        if pick is None:
+            # Infeasible right now. Queue or spill only to nodes that satisfy
+            # the HARD constraints (affinity/labels) — a saturated target is a
+            # wait, not a license to violate placement.
+            def _hard_ok(view: NodeView) -> bool:
+                if strategy_kind == "NODE_AFFINITY" and not soft:
+                    return view.node_id == node_id
+                return scheduling.feasible(view, demand, label_selector or {})
+
+            local_view = NodeView(self.node_id, self.total.to_dict(),
+                                  self.available.to_dict(), self.labels, True)
+            if _hard_ok(local_view):
+                return await self._grant_local(demand, None, -1, dedicated, owner_addr)
+            for v in self._node_views():
+                if v.node_id != self.node_id and _hard_ok(v):
+                    return {"spillback": self._addr_of(v.node_id)}
+            raise RuntimeError(
+                f"No node can ever satisfy resource request {resources} with "
+                f"strategy={strategy_kind} labels={label_selector}; cluster totals: "
+                f"{[(v.node_id[:8], v.total.to_dict()) for v in self._node_views()]}"
+            )
+        if pick != self.node_id:
+            return {"spillback": self._addr_of(pick)}
+        return await self._grant_local(demand, None, -1, dedicated, owner_addr)
+
+    async def _gcs_node_addr(self, node_id: str) -> Optional[str]:
+        nodes = await self.gcs.call("get_all_nodes")
+        for n in nodes:
+            if n["node_id"] == node_id:
+                return n["addr"]
+        return None
+
+    async def _pg_bundle_node(self, pg_id: bytes, bundle_index: int, demand: ResourceSet):
+        local_totals = self._bundle_totals.get(pg_id)
+        if local_totals is not None:
+            if bundle_index in local_totals:
+                return self.node_id
+            if bundle_index == -1 and any(
+                tot.is_superset_of(demand) for tot in local_totals.values()
+            ):
+                # some local bundle can (eventually) fit: wait here
+                return self.node_id
+        pg = await self.gcs.call("get_placement_group", pg_id=pg_id)
+        if pg is None or pg.get("placement") is None:
+            return None
+        placement = pg["placement"]
+        if bundle_index >= 0:
+            if bundle_index >= len(placement):
+                return None
+            return placement[bundle_index]
+        # bundle_index -1: route to the first node hosting any of the
+        # group's bundles (its raylet then waits for a bundle with room)
+        for node in placement:
+            if node != self.node_id:
+                return node
+        return placement[0] if placement else None
+
+    async def _grant_local(self, demand: ResourceSet, pg_id, bundle_index, dedicated,
+                           owner_addr) -> Dict:
+        fut = asyncio.get_event_loop().create_future()
+        self._lease_waiters.append((demand, pg_id, bundle_index, dedicated, owner_addr, fut))
+        self._pump_leases()
+        return await fut
+
+    def _resources_for_lease(self, pg_id, bundle_index,
+                             demand: Optional[ResourceSet] = None) -> Optional[ResourceSet]:
+        if pg_id is None:
+            return self.available
+        table = self.bundles.get(pg_id)
+        if table is None:
+            return None
+        if bundle_index >= 0:
+            return table.get(bundle_index)
+        # wildcard: first bundle with room for this demand
+        for rs in table.values():
+            if demand is None or rs.is_superset_of(demand):
+                return rs
+        return None
+
+    def _find_lease_pool(self, pg_id, bundle_index, demand: ResourceSet):
+        """Resolve the pool a lease draws from; returns (pool, resolved_index)."""
+        if pg_id is None:
+            return self.available, -1
+        table = self.bundles.get(pg_id)
+        if table is None:
+            return None, -1
+        if bundle_index >= 0:
+            return table.get(bundle_index), bundle_index
+        for idx, rs in table.items():
+            if rs.is_superset_of(demand):
+                return rs, idx
+        return None, -1
+
+    def _pump_leases(self):
+        made_progress = True
+        while made_progress and self._lease_waiters:
+            made_progress = False
+            n = len(self._lease_waiters)
+            for _ in range(n):
+                demand, pg_id, bundle_index, dedicated, owner_addr, fut = self._lease_waiters[0]
+                if fut.done():
+                    self._lease_waiters.popleft()
+                    made_progress = True
+                    continue
+                pool, resolved_index = self._find_lease_pool(pg_id, bundle_index, demand)
+                if pool is None or not pool.is_superset_of(demand):
+                    # head-of-line blocks (FIFO fairness like the reference's
+                    # dispatch queue); try next waiter anyway
+                    self._lease_waiters.rotate(-1)
+                    continue
+                if not self.idle:
+                    can_start = (len(self.workers) + self._starting) < self._max_workers()
+                    if self._starting < config.maximum_startup_concurrency and can_start:
+                        self._start_worker()
+                    self._lease_waiters.rotate(-1)
+                    continue
+                self._lease_waiters.popleft()
+                worker = self.idle.popleft()
+                pool.subtract(demand)
+                worker.lease = {
+                    "demand": demand, "pg_id": pg_id, "bundle_index": resolved_index,
+                    "owner": owner_addr,
+                }
+                worker.dedicated = dedicated
+                if not fut.done():
+                    fut.set_result({"worker_addr": worker.addr, "worker_id": worker.worker_id})
+                made_progress = True
+
+    def _max_workers(self) -> int:
+        cpus = self.total.get("CPU")
+        return max(int(cpus) * 4, 8)
+
+    def _release_lease_resources(self, lease: Dict[str, Any]):
+        pg_id = lease.get("pg_id")
+        idx = lease.get("bundle_index", -1)
+        if pg_id is None:
+            pool = self.available
+        else:
+            pool = (self.bundles.get(pg_id) or {}).get(idx)
+        if pool is not None:
+            pool.add(lease["demand"])
+
+    async def handle_return_lease(self, worker_id: bytes) -> bool:
+        h = self.workers.get(worker_id)
+        if h is None:
+            return False
+        if h.lease is not None:
+            self._release_lease_resources(h.lease)
+            h.lease = None
+        if h.dedicated:
+            # dedicated (actor) workers die with their lease
+            await self._kill_worker(h)
+        else:
+            self.idle.append(h)
+        self._pump_leases()
+        return True
+
+    async def _kill_worker(self, h: WorkerHandle):
+        self.workers.pop(h.worker_id, None)
+        self._spawned_procs.pop(h.pid, None)
+        if h in self.idle:
+            try:
+                self.idle.remove(h)
+            except ValueError:
+                pass
+        try:
+            client = RpcClient(h.addr)
+            await asyncio.wait_for(client.call("exit_worker"), timeout=1.0)
+            await client.close()
+        except Exception:
+            if h.pid:
+                try:
+                    os.kill(h.pid, 9)
+                except ProcessLookupError:
+                    pass
+
+    # ------------------------------------------------------- placement bundles
+
+    async def handle_reserve_bundle(self, pg_id: bytes, bundle_index: int,
+                                    resources: Dict[str, float]) -> bool:
+        demand = ResourceSet(resources)
+        if not self.available.is_superset_of(demand):
+            return False
+        self.available.subtract(demand)
+        self.bundles.setdefault(pg_id, {})[bundle_index] = demand.copy()
+        self._bundle_totals.setdefault(pg_id, {})[bundle_index] = demand.copy()
+        return True
+
+    async def handle_release_placement_group(self, pg_id: bytes) -> bool:
+        table = self._bundle_totals.pop(pg_id, None)
+        self.bundles.pop(pg_id, None)
+        if table:
+            for rs in table.values():
+                self.available.add(rs)
+        self._pump_leases()
+        return True
+
+    # ----------------------------------------------------------- misc handlers
+
+    async def handle_get_node_info(self) -> Dict:
+        return {
+            "node_id": self.node_id,
+            "addr": self.addr,
+            "session_dir": self.session_dir,
+            "gcs_addr": self.gcs_addr,
+            "resources_total": self.total.to_dict(),
+            "resources_available": self.available.to_dict(),
+            "labels": self.labels,
+        }
+
+    async def handle_pull_object(self, oid_hex: str) -> Optional[bytes]:
+        # Cross-node object pull endpoint (reference ObjectManager push/pull,
+        # src/ray/object_manager/object_manager.h:106). Single-host topologies
+        # resolve through shared memory directly; this is the DCN fallback.
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_store import SharedObjectStore
+
+        store = SharedObjectStore()
+        try:
+            return store.get_bytes(ObjectID.from_hex(oid_hex))
+        finally:
+            store.close(unlink_created=False)
+
+    async def handle_shutdown_node(self) -> bool:
+        asyncio.ensure_future(self.stop())
+        return True
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for h in list(self.workers.values()):
+            await self._kill_worker(h)
+        try:
+            await self.gcs.call("unregister_node", node_id=self.node_id)
+        except Exception:
+            pass
+        await self.server.close()
+        await self.gcs.close()

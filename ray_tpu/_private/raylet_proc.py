@@ -1,0 +1,56 @@
+"""Standalone raylet process — an additional "node" joining an existing GCS.
+
+Used by ``ray_tpu.cluster_utils.Cluster.add_node`` to build multi-node
+topologies on one host (reference: ``python/ray/cluster_utils.py:135,202``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--gcs-addr", required=True)
+    parser.add_argument("--resources", required=True)
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--node-name", default="")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from ray_tpu._private.raylet import Raylet
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    raylet = Raylet(
+        args.session_dir,
+        gcs_addr=args.gcs_addr,
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        node_name=args.node_name,
+    )
+    loop.run_until_complete(raylet.start())
+    # readiness marker for the parent
+    marker = os.path.join(args.session_dir, f"raylet_{raylet.node_id[:12]}.ready")
+    with open(marker, "w") as f:
+        f.write(raylet.addr)
+    print(json.dumps({"node_id": raylet.node_id, "addr": raylet.addr}), flush=True)
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

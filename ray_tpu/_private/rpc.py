@@ -1,0 +1,351 @@
+"""Async message transport with retries and built-in chaos injection.
+
+TPU-native equivalent of the reference's RPC layer (``src/ray/rpc/`` —
+``GrpcServer``/``GrpcClient`` wrappers, ``RetryableGrpcClient``, and the
+``rpc_chaos`` env-var fault injector at ``src/ray/rpc/rpc_chaos.h:23``).
+
+Instead of gRPC we use asyncio streams (unix sockets node-locally, TCP
+cross-host) with length-prefixed pickled frames.  The control plane is not the
+TPU hot path — device data rides XLA collectives over ICI — so a lean Python
+transport keeps the same architecture (typed async clients with retry +
+chaos) without the protobuf toolchain.  Chaos injection is wired in from day
+one, mirroring ``RAY_testing_rpc_failure="method=N:req%:resp%"``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import pickle
+import random
+import struct
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.config import config
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+
+MAX_FRAME = 16 * 1024**3
+
+
+def run_sync(coro):
+    """Run a coroutine on a fresh short-lived loop, cleaning up client tasks."""
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        for t in asyncio.all_tasks(loop):
+            t.cancel()
+        try:
+            loop.run_until_complete(asyncio.sleep(0))
+        except Exception:
+            pass
+        loop.close()
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    """Could not establish a connection (request was never sent)."""
+
+
+class RpcDisconnectedError(RpcConnectionError):
+    """Connection dropped mid-call — the request MAY have executed."""
+
+
+class RemoteError(RpcError):
+    """An exception raised inside a remote handler, re-raised at the caller."""
+
+
+# ---------------------------------------------------------------------------
+# chaos injection (reference: src/ray/rpc/rpc_chaos.h:23-40, rpc_chaos.cc:33)
+# ---------------------------------------------------------------------------
+
+
+class _ChaosRule:
+    def __init__(self, method: str, max_failures: int, req_prob: float, resp_prob: float):
+        self.method = method
+        self.remaining = max_failures
+        self.req_prob = req_prob
+        self.resp_prob = resp_prob
+
+
+def _parse_chaos(spec: str) -> Dict[str, _ChaosRule]:
+    rules: Dict[str, _ChaosRule] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        method, rest = part.split("=", 1)
+        n, req, resp = rest.split(":")
+        rules[method] = _ChaosRule(method, int(n), float(req), float(resp))
+    return rules
+
+
+class ChaosInjector:
+    def __init__(self):
+        spec = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", config.testing_rpc_failure)
+        self._rules = _parse_chaos(spec) if spec else {}
+
+    def should_drop(self, method: str, phase: str) -> bool:
+        rule = self._rules.get(method)
+        if rule is None or rule.remaining <= 0:
+            return False
+        prob = rule.req_prob if phase == "req" else rule.resp_prob
+        if random.random() < prob:
+            rule.remaining -= 1
+            logger.warning("chaos: dropping %s %s", phase, method)
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    payload = await reader.readexactly(length)
+    return pickle.loads(payload)
+
+
+def write_frame(writer: asyncio.StreamWriter, msg: Any):
+    payload = pickle.dumps(msg, protocol=5)
+    writer.write(_LEN.pack(len(payload)))
+    writer.write(payload)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+class RpcServer:
+    """Serves named async handlers over unix/TCP sockets.
+
+    Handlers receive the request kwargs; the return value is shipped back.
+    A handler may return a ``Deferred`` to reply later (long-poll pattern,
+    used by pubsub like the reference's ``src/ray/pubsub/``).
+    """
+
+    def __init__(self, name: str = "server"):
+        self.name = name
+        self._handlers: Dict[str, Handler] = {}
+        self._servers = []
+        self._chaos = ChaosInjector()
+        self._conn_tasks: set = set()
+
+    def register(self, method: str, handler: Handler):
+        self._handlers[method] = handler
+
+    def register_all(self, obj: Any, prefix: str = ""):
+        """Register every ``handle_*`` coroutine method of ``obj``."""
+        for attr in dir(obj):
+            if attr.startswith("handle_"):
+                self.register(prefix + attr[len("handle_"):], getattr(obj, attr))
+
+    async def listen_unix(self, path: str):
+        server = await asyncio.start_unix_server(self._on_conn, path=path)
+        self._servers.append(server)
+        return path
+
+    async def listen_tcp(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        server = await asyncio.start_server(self._on_conn, host=host, port=port)
+        self._servers.append(server)
+        sock = server.sockets[0]
+        return sock.getsockname()[:2]
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                asyncio.ensure_future(self._dispatch(msg, writer))
+        finally:
+            self._conn_tasks.discard(task)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg: Dict, writer: asyncio.StreamWriter):
+        method = msg.get("method", "")
+        req_id = msg.get("req_id")
+        if self._chaos.should_drop(method, "req"):
+            return
+        handler = self._handlers.get(method)
+        reply: Dict[str, Any]
+        if handler is None:
+            reply = {"req_id": req_id, "ok": False, "error": RpcError(f"no handler: {method}")}
+        else:
+            try:
+                result = await handler(**msg.get("kwargs", {}))
+                reply = {"req_id": req_id, "ok": True, "result": result}
+            except Exception as e:  # noqa: BLE001 - ship the error to the caller
+                logger.debug("handler %s raised", method, exc_info=True)
+                reply = {"req_id": req_id, "ok": False, "error": e}
+        if req_id is None:  # one-way message
+            return
+        if self._chaos.should_drop(method, "resp"):
+            return
+        try:
+            write_frame(writer, reply)
+            await writer.drain()
+        except (ConnectionResetError, RuntimeError, BrokenPipeError):
+            pass
+
+    async def close(self):
+        for s in self._servers:
+            s.close()
+            try:
+                await s.wait_closed()
+            except Exception:
+                pass
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class RpcClient:
+    """Retrying async client with request/response correlation.
+
+    Mirrors the role of ``RetryableGrpcClient``
+    (``src/ray/rpc/retryable_grpc_client.h``): transparent reconnect + bounded
+    retries; one-way sends for fire-and-forget paths.
+    """
+
+    _ids = itertools.count(1)
+
+    def __init__(self, addr: str, name: str = "client"):
+        # addr: "unix:/path" or "tcp:host:port"
+        self.addr = addr
+        self.name = name
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._recv_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+        self._closed = False
+
+    async def _connect(self):
+        alive = (
+            self._writer is not None
+            and not self._writer.is_closing()
+            and self._recv_task is not None
+            and not self._recv_task.done()
+        )
+        if alive:
+            return
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        deadline = asyncio.get_event_loop().time() + config.rpc_connect_timeout_s
+        last_err: Optional[Exception] = None
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                if self.addr.startswith("unix:"):
+                    path = self.addr[len("unix:"):]
+                    try:
+                        self._reader, self._writer = await asyncio.open_unix_connection(path)
+                    except (FileNotFoundError, ConnectionRefusedError) as e:
+                        # unix sockets exist iff the server process is alive and
+                        # listening — no point retrying for 30s (a dead actor /
+                        # worker would stall every caller)
+                        raise RpcConnectionError(
+                            f"cannot connect to {self.addr}: {e}") from None
+                elif self.addr.startswith("tcp:"):
+                    _, host, port = self.addr.split(":")
+                    self._reader, self._writer = await asyncio.open_connection(host, int(port))
+                else:
+                    raise RpcError(f"bad address: {self.addr}")
+                self._recv_task = asyncio.ensure_future(self._recv_loop())
+                return
+            except RpcConnectionError:
+                raise
+            except (ConnectionRefusedError, OSError) as e:
+                last_err = e
+                await asyncio.sleep(config.rpc_retry_delay_ms / 1000.0)
+        raise RpcConnectionError(f"cannot connect to {self.addr}: {last_err}")
+
+    async def _recv_loop(self):
+        assert self._reader is not None
+        try:
+            while True:
+                reply = await read_frame(self._reader)
+                fut = self._pending.pop(reply.get("req_id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(reply)
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(RpcDisconnectedError(f"connection to {self.addr} lost"))
+            self._pending.clear()
+
+    async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        retries = config.rpc_max_retries
+        while True:
+            try:
+                return await self._call_once(method, timeout, kwargs)
+            except RpcDisconnectedError:
+                # mid-call loss: the request may have executed — surface to the
+                # caller, which knows whether the call is idempotent
+                raise
+            except RpcConnectionError:
+                if self._closed or retries <= 0:
+                    raise
+                retries -= 1
+                self._writer = None
+                await asyncio.sleep(config.rpc_retry_delay_ms / 1000.0)
+
+    async def _call_once(self, method: str, timeout: Optional[float], kwargs: Dict) -> Any:
+        async with self._lock:
+            await self._connect()
+            req_id = next(self._ids)
+            fut: asyncio.Future = asyncio.get_event_loop().create_future()
+            self._pending[req_id] = fut
+            write_frame(self._writer, {"method": method, "req_id": req_id, "kwargs": kwargs})
+            await self._writer.drain()
+        reply = await asyncio.wait_for(fut, timeout)
+        if not reply["ok"]:
+            err = reply["error"]
+            raise err if isinstance(err, Exception) else RemoteError(str(err))
+        return reply["result"]
+
+    async def send(self, method: str, **kwargs):
+        """One-way message (no reply expected)."""
+        async with self._lock:
+            await self._connect()
+            write_frame(self._writer, {"method": method, "req_id": None, "kwargs": kwargs})
+            await self._writer.drain()
+
+    async def close(self):
+        self._closed = True
+        if self._recv_task:
+            self._recv_task.cancel()
+        if self._writer:
+            try:
+                self._writer.close()
+            except Exception:
+                pass

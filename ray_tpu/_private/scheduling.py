@@ -1,0 +1,243 @@
+"""Resource accounting + node-selection policies.
+
+Equivalent of the reference's scheduling primitives
+(``src/ray/common/scheduling/resource_set.h``, fixed-point fractional
+resources in ``fixed_point.h``) and the policy set in
+``src/ray/raylet/scheduling/policy/`` (hybrid pack-until-threshold-then-
+spread, spread, node-affinity, label matching — ``hybrid_scheduling_policy.cc``,
+``spread_scheduling_policy.cc``).
+
+Fractional resources use integer milli-units internally (the reference's
+FixedPoint uses 1/10000); TPU chips join CPU/GPU/memory as first-class
+resource names, and pod-slice topology is expressed through node labels
+(``tpu-slice-name``, ``tpu-worker-index``, ``tpu-pod-type``) that policies can
+match on — replacing the reference's string-resource hack
+(``python/ray/_private/accelerators/tpu.py:326-372`` ``TPU-{type}-head``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+GRANULARITY = 10000  # milli-resource fixed point, reference fixed_point.h
+
+
+def to_fixed(v: float) -> int:
+    return int(round(v * GRANULARITY))
+
+
+def from_fixed(v: int) -> float:
+    return v / GRANULARITY
+
+
+class ResourceSet:
+    """A named vector of fixed-point resource quantities."""
+
+    __slots__ = ("_res",)
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self._res: Dict[str, int] = {}
+        if resources:
+            for k, v in resources.items():
+                fv = to_fixed(v)
+                if fv != 0:
+                    self._res[k] = fv
+
+    @classmethod
+    def _from_fixed_map(cls, m: Dict[str, int]) -> "ResourceSet":
+        rs = cls()
+        rs._res = {k: v for k, v in m.items() if v != 0}
+        return rs
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._res.items()}
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._res.get(name, 0))
+
+    def is_superset_of(self, demand: "ResourceSet") -> bool:
+        return all(self._res.get(k, 0) >= v for k, v in demand._res.items())
+
+    def subtract(self, demand: "ResourceSet"):
+        for k, v in demand._res.items():
+            self._res[k] = self._res.get(k, 0) - v
+
+    def add(self, other: "ResourceSet"):
+        for k, v in other._res.items():
+            self._res[k] = self._res.get(k, 0) + v
+
+    def copy(self) -> "ResourceSet":
+        return ResourceSet._from_fixed_map(dict(self._res))
+
+    def is_empty(self) -> bool:
+        return not any(v > 0 for v in self._res.values())
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._res == other._res
+
+
+class NodeView:
+    """Scheduler-visible snapshot of one node."""
+
+    def __init__(self, node_id: str, total: Dict[str, float], available: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None, alive: bool = True):
+        self.node_id = node_id
+        self.total = ResourceSet(total)
+        self.available = ResourceSet(available)
+        self.labels = labels or {}
+        self.alive = alive
+
+    def utilization(self) -> float:
+        """Max utilization over resources with nonzero totals (critical-resource
+        utilization, reference ``scorer.cc`` NodeScorer)."""
+        best = 0.0
+        for k, tot in self.total._res.items():
+            if tot <= 0:
+                continue
+            avail = self.available._res.get(k, 0)
+            best = max(best, 1.0 - avail / tot)
+        return best
+
+
+_spread_rr = itertools.count()
+
+
+def feasible(node: NodeView, demand: ResourceSet, labels: Dict[str, str]) -> bool:
+    if not node.alive:
+        return False
+    if not node.total.is_superset_of(demand):
+        return False
+    for k, v in labels.items():
+        if node.labels.get(k) != v:
+            return False
+    return True
+
+
+def available_now(node: NodeView, demand: ResourceSet) -> bool:
+    return node.available.is_superset_of(demand)
+
+
+def pick_node(
+    nodes: List[NodeView],
+    demand: ResourceSet,
+    strategy_kind: str = "DEFAULT",
+    local_node_id: Optional[str] = None,
+    affinity_node_id: Optional[str] = None,
+    soft: bool = False,
+    label_selector: Optional[Dict[str, str]] = None,
+    spread_threshold: float = 0.5,
+) -> Optional[str]:
+    """Select a node for a resource demand; None means infeasible right now.
+
+    Hybrid policy (DEFAULT): prefer the local node while its critical-resource
+    utilization stays under ``spread_threshold``; then pack onto the
+    lowest-utilization feasible remote node; reference
+    ``hybrid_scheduling_policy.cc``.
+    """
+    labels = label_selector or {}
+    cands = [n for n in nodes if feasible(n, demand, labels) and available_now(n, demand)]
+
+    if strategy_kind == "NODE_AFFINITY":
+        for n in nodes:
+            if n.node_id == affinity_node_id:
+                if feasible(n, demand, labels) and available_now(n, demand):
+                    return n.node_id
+                break
+        if not soft:
+            return None
+        strategy_kind = "DEFAULT"
+
+    if not cands:
+        return None
+
+    if strategy_kind == "SPREAD":
+        # round-robin over feasible nodes, preferring least-utilized
+        cands.sort(key=lambda n: (n.utilization(), n.node_id))
+        return cands[next(_spread_rr) % len(cands)].node_id
+
+    # DEFAULT / hybrid
+    if local_node_id is not None:
+        local = next((n for n in cands if n.node_id == local_node_id), None)
+        if local is not None and local.utilization() < spread_threshold:
+            return local.node_id
+    under = [n for n in cands if n.utilization() < spread_threshold]
+    pool = under if under else cands
+    pool.sort(key=lambda n: (n.utilization(), n.node_id))
+    return pool[0].node_id
+
+
+def pack_bundles(
+    nodes: List[NodeView],
+    bundles: List[Dict[str, float]],
+    strategy: str,
+) -> Optional[List[str]]:
+    """Place placement-group bundles onto nodes.
+
+    Strategies (reference ``bundle_scheduling_policy.cc`` /
+    ``python/ray/util/placement_group.py``): PACK (minimize nodes, best
+    effort), STRICT_PACK (all on one node), SPREAD (best-effort one-per-node),
+    STRICT_SPREAD (hard one-per-node).  Returns node_id per bundle or None.
+    """
+    demands = [ResourceSet(b) for b in bundles]
+    avail = {n.node_id: n.available.copy() for n in nodes if n.alive}
+    order = sorted(avail, key=lambda nid: -next(n for n in nodes if n.node_id == nid).utilization())
+
+    def fits(nid, d):
+        return avail[nid].is_superset_of(d)
+
+    if strategy == "STRICT_PACK":
+        for nid in avail:
+            trial = avail[nid].copy()
+            ok = True
+            for d in demands:
+                if trial.is_superset_of(d):
+                    trial.subtract(d)
+                else:
+                    ok = False
+                    break
+            if ok:
+                return [nid] * len(demands)
+        return None
+
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        placement: List[str] = []
+        used: set = set()
+        for d in demands:
+            pick = None
+            for nid in sorted(avail, key=lambda x: (x in used, )):
+                if nid in used and strategy == "STRICT_SPREAD":
+                    continue
+                if fits(nid, d):
+                    pick = nid
+                    break
+            if pick is None:
+                if strategy == "STRICT_SPREAD":
+                    return None
+                for nid in avail:
+                    if fits(nid, d):
+                        pick = nid
+                        break
+                if pick is None:
+                    return None
+            avail[pick].subtract(d)
+            used.add(pick)
+            placement.append(pick)
+        return placement
+
+    # PACK (default): fill one node, overflow to next
+    placement = []
+    for d in demands:
+        pick = None
+        for nid in order:
+            if fits(nid, d):
+                pick = nid
+                break
+        if pick is None:
+            return None
+        avail[pick].subtract(d)
+        placement.append(pick)
+    return placement

@@ -1,0 +1,165 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers, zero-copy reads.
+
+Equivalent of the reference's ``python/ray/_private/serialization.py`` +
+``python/ray/cloudpickle/`` — pickle5 with out-of-band buffers so large numpy
+arrays are written into / read out of the shared-memory object store without
+copies, cloudpickle for functions/classes, and in-band ``ObjectRef`` tracking
+(the borrow half of the ownership protocol,
+``src/ray/core_worker/reference_count.h:72``).
+
+Wire layout (also the shared-memory object layout)::
+
+    u32 magic | u32 n_buffers | u64 core_len | n*u64 buffer_len
+    core pickle bytes | padding to 64 | buffer0 | padding to 64 | buffer1 ...
+
+Buffers are 64-byte aligned so jax/numpy can map them directly.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+
+_MAGIC = 0x52545055  # "RTPU"
+_HDR = struct.Struct("<II Q")
+_ALIGN = 64
+
+_local = threading.local()
+
+
+# --- ObjectRef tracking across (de)serialization -----------------------------
+
+
+def note_serialized_ref(ref):
+    refs = getattr(_local, "serialized_refs", None)
+    if refs is not None:
+        refs.append(ref)
+
+
+def note_deserialized_ref(ref):
+    refs = getattr(_local, "deserialized_refs", None)
+    if refs is not None:
+        refs.append(ref)
+
+
+class _TrackRefs:
+    """Context manager collecting ObjectRefs that cross the boundary."""
+
+    def __init__(self, direction: str):
+        self.direction = direction
+        self.refs: List = []
+
+    def __enter__(self):
+        setattr(_local, self.direction, self.refs)
+        return self
+
+    def __exit__(self, *exc):
+        setattr(_local, self.direction, None)
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _reduce_jax_array(arr):
+    import numpy as np
+
+    return (_rebuild_jax_array, (np.asarray(arr),))
+
+
+def _rebuild_jax_array(np_arr):
+    import jax
+
+    return jax.numpy.asarray(np_arr)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def __init__(self, file, protocol=5, buffer_callback=None):
+        super().__init__(file, protocol=protocol, buffer_callback=buffer_callback)
+
+    def reducer_override(self, obj):
+        # jax.Array must come back as a device array, not a numpy array.
+        tname = type(obj).__module__
+        if tname.startswith("jaxlib") or tname.startswith("jax"):
+            import jax
+
+            if isinstance(obj, jax.Array):
+                return _reduce_jax_array(obj)
+        return super().reducer_override(obj)
+
+
+def serialize(value: Any) -> Tuple[bytes, List[Any]]:
+    """Serialize ``value``; returns (payload_bytes, contained_object_refs)."""
+    import io
+
+    buffers: List[pickle.PickleBuffer] = []
+    with _TrackRefs("serialized_refs") as tracker:
+        f = io.BytesIO()
+        p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+        p.dump(value)
+        core = f.getvalue()
+    raw_bufs = [b.raw() for b in buffers]
+    total = _pad(_HDR.size + 8 * len(raw_bufs)) + _pad(len(core)) + sum(
+        _pad(b.nbytes) for b in raw_bufs
+    )
+    out = bytearray(total)
+    _HDR.pack_into(out, 0, _MAGIC, len(raw_bufs), len(core))
+    off = _HDR.size
+    for b in raw_bufs:
+        struct.pack_into("<Q", out, off, b.nbytes)
+        off += 8
+    off = _pad(off)
+    out[off : off + len(core)] = core
+    off = _pad(off + len(core))
+    for b in raw_bufs:
+        out[off : off + b.nbytes] = b
+        off = _pad(off + b.nbytes)
+    return bytes(out), tracker.refs
+
+
+def serialize_into(value: Any, allocate) -> Tuple[memoryview, List[Any]]:
+    """Serialize directly into a buffer from ``allocate(nbytes)`` (e.g. shm)."""
+    payload, refs = serialize(value)
+    buf = allocate(len(payload))
+    buf[: len(payload)] = payload
+    return buf, refs
+
+
+def deserialize(payload, zero_copy: bool = True) -> Tuple[Any, List[Any]]:
+    """Deserialize; returns (value, contained_object_refs).
+
+    ``payload`` may be bytes or a memoryview over shared memory; with
+    ``zero_copy`` the returned numpy arrays view that memory directly.
+    """
+    view = memoryview(payload)
+    magic, n_bufs, core_len = _HDR.unpack_from(view, 0)
+    if magic != _MAGIC:
+        raise ValueError("bad object payload magic")
+    off = _HDR.size
+    lens = [struct.unpack_from("<Q", view, off + 8 * i)[0] for i in range(n_bufs)]
+    off = _pad(off + 8 * n_bufs)
+    core = view[off : off + core_len]
+    off = _pad(off + core_len)
+    bufs = []
+    for blen in lens:
+        b = view[off : off + blen]
+        if not zero_copy:
+            b = bytes(b)
+        bufs.append(b)
+        off = _pad(off + blen)
+    with _TrackRefs("deserialized_refs") as tracker:
+        value = pickle.loads(core, buffers=bufs)
+    return value, tracker.refs
+
+
+def dumps(value: Any) -> bytes:
+    """Plain cloudpickle dump (for task specs / function descriptors)."""
+    return cloudpickle.dumps(value)
+
+
+def loads(payload: bytes) -> Any:
+    return pickle.loads(payload)

@@ -1,0 +1,104 @@
+"""Task and actor specifications shipped between processes.
+
+Equivalent of the reference's ``TaskSpecification``
+(``src/ray/common/task/task_spec.h``) — the single wire format describing a
+unit of work: function descriptor, arguments (inline values or ObjectRefs),
+resource demands, return count, retry policy, and (for actor tasks) actor
+identity and sequencing.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+@dataclass
+class FunctionDescriptor:
+    """Names a callable; payload is the cloudpickled function/class."""
+
+    module: str
+    qualname: str
+    payload: bytes  # cloudpickle of the function (or class for actors)
+    method_name: str = ""  # for actor tasks
+
+    def __repr__(self):
+        tail = f".{self.method_name}" if self.method_name else ""
+        return f"{self.module}.{self.qualname}{tail}"
+
+
+@dataclass
+class TaskArg:
+    """One argument: either an inline serialized value or an ObjectRef."""
+
+    is_ref: bool
+    payload: Any  # serialized bytes if inline; ObjectRef if is_ref
+
+
+@dataclass
+class SchedulingStrategy:
+    """Normalized scheduling strategy (reference:
+    ``python/ray/util/scheduling_strategies.py:15,41``)."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP | NODE_LABEL
+    node_id: Optional[str] = None
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+    label_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    function: FunctionDescriptor
+    args: List[TaskArg]
+    kwargs_keys: List[str]  # trailing len(kwargs_keys) args are kwargs
+    num_returns: int
+    resources: Dict[str, float]
+    owner_addr: str  # worker socket address of the owner
+    parent_task_id: Optional[TaskID] = None
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # actor fields
+    actor_id: Optional[ActorID] = None
+    actor_seq_no: int = 0
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    is_async_actor: bool = False
+    actor_name: str = ""
+    namespace: str = ""
+    runtime_env: Optional[Dict[str, Any]] = None
+    # execution metadata
+    attempt_number: int = 0
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.from_task_and_index(self.task_id, i) for i in range(self.num_returns)
+        ]
+
+    def scheduling_key(self) -> Tuple:
+        """Tasks with equal keys can reuse one worker lease (reference:
+        ``normal_task_submitter.h`` SchedulingKey)."""
+        return (
+            self.function.module,
+            self.function.qualname,
+            tuple(sorted(self.resources.items())),
+            self.scheduling_strategy.kind,
+            self.scheduling_strategy.node_id,
+            self.scheduling_strategy.placement_group_id,
+            self.scheduling_strategy.bundle_index,
+        )

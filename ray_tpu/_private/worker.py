@@ -1,0 +1,805 @@
+"""CoreWorker: the per-process runtime embedded in every driver and worker.
+
+TPU-native equivalent of the reference's ``CoreWorker``
+(``src/ray/core_worker/core_worker.h:166`` — "root class that contains all the
+core and language-independent functionalities of the worker") plus the task
+submission pipelines from ``src/ray/core_worker/transport/``:
+
+* normal tasks: lease a worker from the raylet keyed by SchedulingKey, then
+  push the task directly to the leased worker
+  (``normal_task_submitter.cc:28,548``);
+* actor tasks: direct push to the actor's worker, ordered by per-caller
+  sequence numbers (``actor_task_submitter.h:75``,
+  ``actor_scheduling_queue``/``out_of_order_actor_scheduling_queue``);
+* ownership: the submitting worker owns returned objects, stores small ones
+  in-band in its memory store and serves them to borrowers
+  (``reference_count.h:72``, memory store in ``store_provider/memory_store/``).
+
+Threading model: one asyncio loop on a dedicated IO thread (the reference's
+io_service), user code on executor threads (``BoundedExecutor``,
+``transport/thread_pool.h``), async-actor coroutines on a separate user event
+loop (reference: async actor event loop integration in ``_raylet.pyx``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import enum
+import heapq
+import logging
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import MemoryStore, SharedObjectStore
+from ray_tpu._private.rpc import RpcClient, RpcConnectionError, RpcServer
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerMode(enum.Enum):
+    DRIVER = 0
+    WORKER = 1
+    LOCAL = 2
+
+
+class ExecutionContext:
+    """Per-task execution context (current task/actor ids, counters)."""
+
+    def __init__(self, task_id: TaskID, job_id: JobID, actor_id: Optional[ActorID] = None):
+        self.task_id = task_id
+        self.job_id = job_id
+        self.actor_id = actor_id
+        self.put_index = 0
+        self.submit_index = 0
+
+
+_exec_ctx: contextvars.ContextVar[Optional[ExecutionContext]] = contextvars.ContextVar(
+    "rtpu_exec_ctx", default=None
+)
+
+
+class _Lease:
+    """One leased remote worker for a scheduling key."""
+
+    __slots__ = ("worker_addr", "worker_id", "client", "queue", "pumping")
+
+    def __init__(self):
+        self.worker_addr: Optional[str] = None
+        self.worker_id: Optional[bytes] = None
+        self.client: Optional[RpcClient] = None
+        self.queue: deque = deque()
+        self.pumping = False
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: WorkerMode,
+        session_dir: str,
+        gcs_addr: str,
+        raylet_addr: str,
+        node_id: str,
+        job_id: JobID,
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.mode = mode
+        self.session_dir = session_dir
+        self.node_id = node_id
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random()
+
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(target=self._run_loop, daemon=True, name="rtpu-io")
+        self._loop_ready = threading.Event()
+
+        self.server = RpcServer(f"worker-{self.worker_id.hex()[:8]}")
+        self.serve_addr: str = ""
+
+        self.memory_store = MemoryStore()
+        self.shared_store = SharedObjectStore()
+        # owner-side: pending return objects → asyncio futures resolved at task reply
+        self._result_futures: Dict[ObjectID, asyncio.Future] = {}
+        # locations for sealed objects this process knows about
+        self._locations: Dict[ObjectID, Dict[str, Any]] = {}
+        self._fetch_waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+
+        self.gcs = RpcClient(gcs_addr, "gcs-client")
+        self.raylet = RpcClient(raylet_addr, "raylet-client")
+        self._peer_clients: Dict[str, RpcClient] = {}
+
+        self._leases: Dict[Tuple, _Lease] = {}
+        self._task_errors: Dict[TaskID, int] = {}
+
+        # execution side
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._task_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
+        self._concurrency_sema: Optional[asyncio.Semaphore] = None
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._actor_spec: Optional[TaskSpec] = None
+        self._actor_seq: Dict[bytes, int] = {}
+        self._actor_pending: Dict[bytes, list] = {}
+        self._actor_consumers: Dict[bytes, asyncio.Task] = {}
+        self._actor_queue_waiters: Dict[bytes, asyncio.Future] = {}
+        self._user_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.namespace: str = ""
+
+        # driver-side root context
+        driver_task_id = TaskID.for_driver_task(job_id)
+        self._root_ctx = ExecutionContext(driver_task_id, job_id)
+        self._actor_addr_cache: Dict[ActorID, str] = {}
+        self._shutdown = False
+
+        self.server.register_all(self)
+
+    # ------------------------------------------------------------------ setup
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self._loop_ready.set()
+        self.loop.run_forever()
+
+    def start(self):
+        self._loop_thread.start()
+        self._loop_ready.wait()
+        sock = os.path.join(self.session_dir, "sockets", f"w_{self.worker_id.hex()[:16]}.sock")
+        os.makedirs(os.path.dirname(sock), exist_ok=True)
+
+        async def _listen():
+            await self.server.listen_unix(sock)
+
+        self.run_coro(_listen())
+        self.serve_addr = f"unix:{sock}"
+
+    def run_coro(self, coro, timeout: Optional[float] = None):
+        """Run a coroutine on the IO loop from any non-loop thread."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def current_ctx(self) -> ExecutionContext:
+        ctx = _exec_ctx.get()
+        return ctx if ctx is not None else self._root_ctx
+
+    # --------------------------------------------------------------- ownership
+
+    def _record_location(self, oid: ObjectID, loc: Dict[str, Any]):
+        self._locations[oid] = loc
+        waiters = self._fetch_waiters.pop(oid, [])
+        for w in waiters:
+            if not w.done():
+                w.set_result(loc)
+
+    def _peer(self, addr: str) -> RpcClient:
+        client = self._peer_clients.get(addr)
+        if client is None:
+            client = RpcClient(addr, "peer")
+            self._peer_clients[addr] = client
+        return client
+
+    # -------------------------------------------------------------------- put
+
+    def put(self, value: Any) -> ObjectRef:
+        ctx = self.current_ctx()
+        ctx.put_index += 1
+        oid = ObjectID.from_put(ctx.task_id, ctx.put_index)
+        payload, _refs = serialization.serialize(value)
+        is_error = isinstance(value, exc.TaskError)
+        if len(payload) <= config.max_inline_object_size:
+            self.memory_store.put(oid, payload)
+            self._record_location_threadsafe(oid, {"inline": True, "is_error": is_error})
+        else:
+            name = self.shared_store.put_serialized(oid, payload)
+            self._record_location_threadsafe(
+                oid, {"shm": name, "node": self.node_id, "size": len(payload), "is_error": is_error}
+            )
+        return ObjectRef(oid, self.serve_addr)
+
+    def _record_location_threadsafe(self, oid: ObjectID, loc: Dict[str, Any]):
+        if threading.current_thread() is self._loop_thread:
+            self._record_location(oid, loc)
+        else:
+            self.loop.call_soon_threadsafe(self._record_location, oid, loc)
+
+    # -------------------------------------------------------------------- get
+
+    def get(self, refs, timeout: Optional[float] = None):
+        import concurrent.futures
+
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        try:
+            values = self.run_coro(
+                self.get_async(ref_list, timeout),
+                None if timeout is None else timeout + 5.0,
+            )
+        except (asyncio.TimeoutError, concurrent.futures.TimeoutError):
+            raise exc.GetTimeoutError(f"get timed out after {timeout}s") from None
+        return values[0] if single else values
+
+    async def get_async(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        coros = [self._resolve_value(r) for r in ref_list]
+        try:
+            values = await asyncio.wait_for(asyncio.gather(*coros), timeout)
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(f"get timed out after {timeout}s")
+        for v in values:
+            if isinstance(v, exc.RayTpuError):
+                raise v
+        return values[0] if single else values
+
+    async def _resolve_value(self, ref: ObjectRef) -> Any:
+        payload, is_error = await self._resolve_payload(ref)
+        value, _refs = serialization.deserialize(payload)
+        return value
+
+    async def _resolve_payload(self, ref: ObjectRef) -> Tuple[Any, bool]:
+        oid = ref.id
+        # 1. local memory store
+        payload = self.memory_store.get(oid)
+        if payload is not None:
+            loc = self._locations.get(oid, {})
+            return payload, loc.get("is_error", False)
+        # 2. known location / pending local future
+        loc = self._locations.get(oid)
+        if loc is None and oid in self._result_futures:
+            loc = await self._result_futures[oid]
+        if loc is None:
+            # 3. fetch from owner
+            if not ref.owner_addr or ref.owner_addr == self.serve_addr:
+                loc = await self._wait_local_location(oid)
+            else:
+                reply = await self._peer(ref.owner_addr).call(
+                    "fetch_object", oid=oid.binary(), timeout=config.rpc_connect_timeout_s * 4
+                )
+                if reply.get("inline") is not None:
+                    self.memory_store.put(oid, reply["inline"])
+                    self._locations[oid] = {"inline": True, "is_error": reply.get("is_error", False)}
+                    return reply["inline"], reply.get("is_error", False)
+                loc = {k: reply[k] for k in ("shm", "node", "size", "is_error") if k in reply}
+                self._locations[oid] = loc
+        if loc.get("inline"):
+            payload = self.memory_store.get(oid)
+            if payload is None:
+                raise exc.ObjectLostError(oid)
+            return payload, loc.get("is_error", False)
+        buf = self.shared_store.get_buffer(oid)
+        if buf is None:
+            raise exc.ObjectLostError(oid)
+        return buf, loc.get("is_error", False)
+
+    async def _wait_local_location(self, oid: ObjectID, timeout: Optional[float] = None):
+        loc = self._locations.get(oid)
+        if loc is not None:
+            return loc
+        fut = self.loop.create_future()
+        self._fetch_waiters.setdefault(oid, []).append(fut)
+        return await asyncio.wait_for(fut, timeout)
+
+    # ------------------------------------------------------------------- wait
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None,
+             fetch_local: bool = True):
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+
+        async def _wait():
+            pending = {asyncio.ensure_future(self._resolve_payload(r)): r for r in refs}
+            ready: List[ObjectRef] = []
+            deadline = None if timeout is None else self.loop.time() + timeout
+            while pending and len(ready) < num_returns:
+                budget = None if deadline is None else max(0.0, deadline - self.loop.time())
+                done, _ = await asyncio.wait(
+                    pending.keys(), timeout=budget, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break
+                for d in done:
+                    ready.append(pending.pop(d))
+            for p in pending:
+                p.cancel()
+            not_ready = [r for r in refs if r not in ready]
+            return ready, not_ready
+
+        return self.run_coro(_wait())
+
+    # ------------------------------------------------------- normal task submit
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        return self.run_coro(self.submit_task_async(spec))
+
+    async def submit_task_async(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = []
+        for oid in spec.return_ids():
+            fut = self.loop.create_future()
+            self._result_futures[oid] = fut
+            refs.append(ObjectRef(oid, self.serve_addr))
+        key = spec.scheduling_key()
+        lease = self._leases.get(key)
+        if lease is None:
+            lease = self._leases[key] = _Lease()
+        lease.queue.append(spec)
+        if not lease.pumping:
+            lease.pumping = True
+            asyncio.ensure_future(self._pump_lease(key, lease))
+        return refs
+
+    async def _pump_lease(self, key: Tuple, lease: _Lease):
+        try:
+            while lease.queue:
+                spec = lease.queue.popleft()
+                try:
+                    await self._dispatch_one(lease, spec)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_task(spec, e)
+            if lease.client is not None:
+                try:
+                    await self.raylet.call("return_lease", worker_id=lease.worker_id)
+                except Exception:
+                    pass
+                lease.client = None
+                lease.worker_addr = None
+        finally:
+            lease.pumping = False
+            if lease.queue:
+                lease.pumping = True
+                asyncio.ensure_future(self._pump_lease(key, lease))
+
+    async def _acquire_lease(self, lease: _Lease, spec: TaskSpec):
+        raylet = self.raylet
+        for _hop in range(16):
+            strategy = spec.scheduling_strategy
+            reply = await raylet.call(
+                "lease_worker",
+                resources=spec.resources,
+                strategy_kind=strategy.kind,
+                node_id=strategy.node_id,
+                soft=strategy.soft,
+                pg_id=strategy.placement_group_id.binary() if strategy.placement_group_id else None,
+                bundle_index=strategy.bundle_index,
+                label_selector=strategy.label_selector,
+                owner_addr=self.serve_addr,
+                dedicated=spec.task_type == TaskType.ACTOR_CREATION_TASK,
+                timeout=config.worker_lease_timeout_s * 4,
+            )
+            if "spillback" in reply:
+                raylet = self._peer(reply["spillback"])
+                continue
+            lease.worker_addr = reply["worker_addr"]
+            lease.worker_id = reply["worker_id"]
+            lease.client = self._peer(lease.worker_addr)
+            return
+        raise exc.RayTpuError("lease spillback loop exceeded 16 hops")
+
+    async def _dispatch_one(self, lease: _Lease, spec: TaskSpec):
+        attempt = 0
+        while True:
+            if lease.client is None:
+                await self._acquire_lease(lease, spec)
+            try:
+                reply = await lease.client.call(
+                    "push_task", spec_bytes=serialization.dumps(spec), timeout=None
+                )
+                self._apply_task_reply(spec, reply)
+                return
+            except (RpcConnectionError, ConnectionResetError) as e:
+                # leased worker died
+                lease.client = None
+                lease.worker_addr = None
+                attempt += 1
+                if attempt > max(spec.max_retries, 0):
+                    self._fail_task(spec, exc.WorkerCrashedError(
+                        f"Worker executing task {spec.task_id.hex()} died: {e}"))
+                    return
+                logger.warning("retrying task %s after worker death (attempt %d)",
+                               spec.task_id.hex()[:8], attempt)
+
+    def _apply_task_reply(self, spec: TaskSpec, reply: Dict):
+        for ret in reply["returns"]:
+            oid = ObjectID(ret["oid"])
+            if ret.get("inline") is not None:
+                self.memory_store.put(oid, ret["inline"])
+                loc = {"inline": True, "is_error": ret.get("is_error", False)}
+            else:
+                loc = {"shm": ret["shm"], "node": ret.get("node"), "size": ret.get("size"),
+                       "is_error": ret.get("is_error", False)}
+            self._record_location(oid, loc)
+            fut = self._result_futures.pop(oid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(loc)
+
+    def _fail_task(self, spec: TaskSpec, error: Exception):
+        if not isinstance(error, exc.RayTpuError):
+            error = exc.TaskError.from_exception(error)
+        payload, _ = serialization.serialize(error)
+        for oid in spec.return_ids():
+            self.memory_store.put(oid, payload)
+            self._record_location(oid, {"inline": True, "is_error": True})
+            fut = self._result_futures.pop(oid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(self._locations[oid])
+
+    # ------------------------------------------------------------ actor submit
+
+    async def resolve_actor_addr(self, actor_id: ActorID, timeout: float = 300.0) -> str:
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr:
+            return addr
+        deadline = self.loop.time() + timeout
+        while True:
+            info = await self.gcs.call("wait_actor_ready", actor_id=actor_id.binary(),
+                                       timeout=30.0)
+            state = info.get("state")
+            if state == "ALIVE":
+                self._actor_addr_cache[actor_id] = info["addr"]
+                return info["addr"]
+            if state in ("DEAD", "NOT_FOUND"):
+                raise exc.ActorDiedError(actor_id, f"actor {actor_id.hex()} is {state}")
+            if self.loop.time() > deadline:
+                raise exc.ActorUnavailableError(
+                    actor_id, f"actor {actor_id.hex()} stuck in state {state}")
+
+    def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        return self.run_coro(self.submit_actor_task_async(spec))
+
+    async def submit_actor_task_async(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = []
+        for oid in spec.return_ids():
+            fut = self.loop.create_future()
+            self._result_futures[oid] = fut
+            refs.append(ObjectRef(oid, self.serve_addr))
+        asyncio.ensure_future(self._push_actor_task(spec))
+        return refs
+
+    async def _push_actor_task(self, spec: TaskSpec):
+        from ray_tpu._private.rpc import RpcDisconnectedError
+
+        tries = 0
+        while True:
+            try:
+                addr = await self.resolve_actor_addr(spec.actor_id)
+                client = self._peer(addr)
+                reply = await client.call(
+                    "push_task", spec_bytes=serialization.dumps(spec), timeout=None
+                )
+                self._apply_task_reply(spec, reply)
+                return
+            except RpcDisconnectedError:
+                # connection dropped mid-call: the method MAY have executed.
+                # At-most-once semantics (reference: actor tasks default
+                # max_task_retries=0) — fail the task, don't re-execute.
+                self._actor_addr_cache.pop(spec.actor_id, None)
+                self._fail_task(spec, exc.ActorDiedError(
+                    spec.actor_id,
+                    f"Actor {spec.actor_id.hex()[:8]} died while executing "
+                    f"method {spec.function.method_name!r}"))
+                return
+            except (RpcConnectionError, ConnectionResetError):
+                # never delivered: safe to retry after re-resolving the actor
+                # address (covers the RESTARTING window)
+                self._actor_addr_cache.pop(spec.actor_id, None)
+                tries += 1
+                try:
+                    info = await self.gcs.call("get_actor_info", actor_id=spec.actor_id.binary())
+                except Exception:
+                    info = {}
+                state = info.get("state")
+                if state == "DEAD" or tries > 120:
+                    self._fail_task(spec, exc.ActorDiedError(spec.actor_id))
+                    return
+                await asyncio.sleep(0.25)
+            except exc.ActorError as e:
+                self._fail_task(spec, e)
+                return
+            except Exception as e:  # noqa: BLE001
+                self._fail_task(spec, e)
+                return
+
+    # --------------------------------------------------------------- execution
+
+    def _load_function(self, spec: TaskSpec):
+        key = spec.function.payload
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            fn = serialization.loads(key)
+            self._fn_cache[key] = fn
+        return fn
+
+    async def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        args: List[Any] = []
+        for a in spec.args:
+            if a.is_ref:
+                args.append(await self._resolve_value_maybe_error(a.payload))
+            else:
+                value, _ = serialization.deserialize(a.payload)
+                args.append(value)
+        kwargs = {}
+        if spec.kwargs_keys:
+            n = len(spec.kwargs_keys)
+            kwargs = dict(zip(spec.kwargs_keys, args[-n:]))
+            args = args[:-n]
+        return args, kwargs
+
+    async def _resolve_value_maybe_error(self, ref: ObjectRef):
+        value = await self._resolve_value(ref)
+        if isinstance(value, exc.RayTpuError):
+            raise value
+        return value
+
+    async def handle_push_task(self, spec_bytes: bytes) -> Dict:
+        spec: TaskSpec = serialization.loads(spec_bytes)
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            return await self._exec_actor_creation(spec)
+        if spec.task_type == TaskType.ACTOR_TASK:
+            return await self._exec_actor_task(spec)
+        return await self._exec_in_thread(spec)
+
+    async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None) -> Dict:
+        fn = bound_method if bound_method is not None else self._load_function(spec)
+        args, kwargs = await self._resolve_args(spec)
+
+        def _run():
+            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            try:
+                return True, fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                return False, exc.TaskError.from_exception(e)
+            finally:
+                _exec_ctx.reset(token)
+
+        ok, result = await self.loop.run_in_executor(self._task_executor, _run)
+        return self._package_returns(spec, ok, result)
+
+    def _package_returns(self, spec: TaskSpec, ok: bool, result: Any) -> Dict:
+        if not ok:
+            results = [result] * spec.num_returns
+            is_error = True
+        else:
+            if spec.num_returns == 1:
+                results = [result]
+            else:
+                results = list(result)
+                if len(results) != spec.num_returns:
+                    e = exc.TaskError.from_exception(
+                        ValueError(
+                            f"Task declared num_returns={spec.num_returns} but returned "
+                            f"{len(results)} values"
+                        )
+                    )
+                    return self._package_returns(spec, False, e)
+            is_error = False
+        returns = []
+        for oid, value in zip(spec.return_ids(), results):
+            payload, _refs = serialization.serialize(value)
+            if len(payload) <= config.max_inline_object_size:
+                entry = {"oid": oid.binary(), "inline": payload, "is_error": is_error}
+            else:
+                name = self.shared_store.put_serialized(oid, payload)
+                entry = {"oid": oid.binary(), "shm": name, "node": self.node_id,
+                         "size": len(payload), "is_error": is_error}
+            returns.append(entry)
+        return {"returns": returns}
+
+    # actor execution ---------------------------------------------------------
+
+    async def _exec_actor_creation(self, spec: TaskSpec) -> Dict:
+        cls = self._load_function(spec)
+        args, kwargs = await self._resolve_args(spec)
+        self.actor_id = spec.actor_id
+        self._actor_spec = spec
+        if spec.max_concurrency > 1:
+            self._task_executor = ThreadPoolExecutor(
+                max_workers=spec.max_concurrency, thread_name_prefix="rtpu-actor"
+            )
+        if spec.is_async_actor:
+            self._user_loop = asyncio.new_event_loop()
+            threading.Thread(target=self._user_loop.run_forever, daemon=True,
+                             name="rtpu-actor-loop").start()
+
+        def _create():
+            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            try:
+                return True, cls(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                return False, exc.TaskError.from_exception(e)
+            finally:
+                _exec_ctx.reset(token)
+
+        ok, result = await self.loop.run_in_executor(self._task_executor, _create)
+        if not ok:
+            await self.gcs.call(
+                "report_actor_failed", actor_id=spec.actor_id.binary(),
+                error=serialization.dumps(result),
+            )
+            return self._package_returns(spec, False, result)
+        self.actor_instance = result
+        await self.gcs.call(
+            "report_actor_ready",
+            actor_id=spec.actor_id.binary(),
+            addr=self.serve_addr,
+            node_id=self.node_id,
+            worker_id=self.worker_id.binary(),
+        )
+        return self._package_returns(spec, True, None)
+
+    async def _exec_actor_task(self, spec: TaskSpec) -> Dict:
+        if self.actor_instance is None:
+            raise exc.ActorUnavailableError(spec.actor_id, "actor not initialized on this worker")
+        caller = spec.owner_addr.encode()
+        own = self._actor_spec
+        if own is not None and (own.is_async_actor or own.max_concurrency > 1):
+            return await self._exec_actor_method(spec)
+        # In-order scheduling queue per caller (reference ActorSchedulingQueue):
+        # tasks are enqueued by sequence number and a single consumer coroutine
+        # per caller runs each to COMPLETION (arg resolution included) before
+        # the next — strict submission-order execution, head-of-line blocking
+        # on unresolved dependencies, matching the reference.
+        # The first message from an unknown caller seeds the expected sequence
+        # number — callers may have submitted earlier tasks to a previous
+        # incarnation of this actor (restart loses cross-incarnation ordering).
+        fut = self.loop.create_future()
+        if caller not in self._actor_seq:
+            self._actor_seq[caller] = spec.actor_seq_no
+        heapq.heappush(
+            self._actor_pending.setdefault(caller, []), (spec.actor_seq_no, id(spec), spec, fut)
+        )
+        if caller not in self._actor_consumers:
+            self._actor_consumers[caller] = asyncio.ensure_future(
+                self._consume_actor_queue(caller)
+            )
+        else:
+            waiter = self._actor_queue_waiters.pop(caller, None)
+            if waiter is not None and not waiter.done():
+                waiter.set_result(None)
+        return await fut
+
+    async def _consume_actor_queue(self, caller: bytes):
+        while True:
+            q = self._actor_pending.get(caller)
+            expected = self._actor_seq.get(caller, 0)
+            if q and q[0][0] <= expected:
+                _seq, _tie, spec, fut = heapq.heappop(q)
+                self._actor_seq[caller] = max(expected, _seq + 1)
+                try:
+                    reply = await self._exec_actor_method(spec)
+                    if not fut.done():
+                        fut.set_result(reply)
+                except Exception as e:  # noqa: BLE001
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            waiter = self.loop.create_future()
+            self._actor_queue_waiters[caller] = waiter
+            await waiter
+
+    async def _exec_actor_method(self, spec: TaskSpec) -> Dict:
+        name = spec.function.method_name
+        if name == "__ray_terminate__":
+            asyncio.ensure_future(self._terminate_self())
+            return self._package_returns(spec, True, None)
+        method = getattr(self.actor_instance, name, None)
+        if method is None:
+            err = exc.TaskError.from_exception(
+                AttributeError(f"actor has no method {name!r}"))
+            return self._package_returns(spec, False, err)
+        if asyncio.iscoroutinefunction(method):
+            args, kwargs = await self._resolve_args(spec)
+
+            async def _run_coro():
+                # concurrency cap for async actors (reference: async actor
+                # max_concurrency, ConcurrencyGroupManager) — the semaphore
+                # lives on the user loop, created on first use
+                if self._concurrency_sema is None:
+                    limit = max(1, (self._actor_spec.max_concurrency
+                                    if self._actor_spec else 1000))
+                    self._concurrency_sema = asyncio.Semaphore(limit)
+                async with self._concurrency_sema:
+                    token = _exec_ctx.set(
+                        ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+                    try:
+                        return True, await method(*args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001
+                        return False, exc.TaskError.from_exception(e)
+                    finally:
+                        _exec_ctx.reset(token)
+
+            assert self._user_loop is not None, "async method on non-async actor"
+            cfut = asyncio.run_coroutine_threadsafe(_run_coro(), self._user_loop)
+            ok, result = await asyncio.wrap_future(cfut)
+            return self._package_returns(spec, ok, result)
+        return await self._exec_in_thread(spec, bound_method=method)
+
+    async def _terminate_self(self):
+        await asyncio.sleep(0.05)
+        os._exit(0)
+
+    # ------------------------------------------------------------ rpc handlers
+
+    async def handle_fetch_object(self, oid: bytes) -> Dict:
+        object_id = ObjectID(oid)
+        payload = self.memory_store.get(object_id)
+        loc = self._locations.get(object_id)
+        if payload is not None:
+            return {"inline": payload, "is_error": bool(loc and loc.get("is_error"))}
+        if loc is None:
+            fut = self._result_futures.get(object_id)
+            if fut is not None:
+                loc = await fut
+            else:
+                loc = await self._wait_local_location(object_id, timeout=config.rpc_connect_timeout_s * 2)
+        if loc.get("inline"):
+            return {"inline": self.memory_store.get(object_id), "is_error": loc.get("is_error", False)}
+        return dict(loc)
+
+    async def handle_ping(self) -> str:
+        return "pong"
+
+    async def handle_kill_actor(self, no_restart: bool = True) -> bool:
+        logger.info("actor %s killed", self.actor_id.hex() if self.actor_id else "?")
+        asyncio.ensure_future(self._terminate_self())
+        return True
+
+    async def handle_exit_worker(self) -> bool:
+        asyncio.ensure_future(self._terminate_self())
+        return True
+
+    async def handle_cancel_task(self, task_id: bytes) -> bool:
+        # Best-effort: running tasks are not interrupted (matching the
+        # reference's non-force cancel semantics for already-running work).
+        return False
+
+    # ---------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+
+        async def _close():
+            await self.server.close()
+            for c in self._peer_clients.values():
+                await c.close()
+            await self.gcs.close()
+            await self.raylet.close()
+            me = asyncio.current_task()
+            for t in asyncio.all_tasks():
+                if t is not me:
+                    t.cancel()
+
+        try:
+            self.run_coro(_close(), timeout=5)
+        except Exception:
+            pass
+        self.shared_store.close(unlink_created=False)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._loop_thread.join(timeout=2)
+
+
+# The process-wide worker singleton (reference: python/ray/_private/worker.py:426).
+global_worker: Optional[CoreWorker] = None
+
+
+def get_global_worker(required: bool = True) -> Optional[CoreWorker]:
+    if required and global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first."
+        )
+    return global_worker

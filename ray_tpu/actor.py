@@ -1,0 +1,242 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Equivalent of the reference's ``python/ray/actor.py``
+(``ActorClass._remote`` at ``actor.py:324``, ``ActorMethod._remote`` at
+``actor.py:909``).  Creation registers the actor with the GCS, which leases a
+dedicated worker and pushes the creation task (reference
+``gcs_actor_manager.cc:396`` / ``gcs_actor_scheduler.h:115``); method calls
+push directly to the actor's worker with per-caller sequence numbers.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import api_utils, serialization
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.task_spec import FunctionDescriptor, TaskSpec, TaskType
+from ray_tpu.exceptions import ActorDiedError
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 options: Optional[Dict[str, Any]] = None):
+        self._handle = handle
+        self._method_name = method_name
+        self._options = options or {}
+
+    def options(self, **opts) -> "ActorMethod":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorMethod(self._handle, self._method_name, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method_name, args, kwargs, self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; "
+            f"use .remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str, is_async: bool,
+                 max_concurrency: int, method_names: tuple,
+                 method_options: Optional[Dict[str, Dict[str, Any]]] = None):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._is_async = is_async
+        self._max_concurrency = max_concurrency
+        self._method_names = method_names
+        self._method_options = method_options or {}
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(
+                f"Actor class {self._class_name!r} has no method {name!r}")
+        return ActorMethod(self, name, dict(self._method_options.get(name, {})))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, self._is_async,
+             self._max_concurrency, self._method_names, self._method_options),
+        )
+
+    @property
+    def _ray_actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def _invoke(self, method_name: str, args, kwargs, options: Dict[str, Any]):
+        from ray_tpu._private.worker import get_global_worker
+
+        worker = get_global_worker()
+        task_args, kw_keys = api_utils.build_args(worker, args, kwargs)
+        seq = worker._actor_seq_out = getattr(worker, "_actor_seq_out", {})
+        seq_no = seq.get(self._actor_id, 0)
+        seq[self._actor_id] = seq_no + 1
+        spec = TaskSpec(
+            task_id=api_utils.next_task_id(worker),
+            job_id=worker.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            function=FunctionDescriptor(
+                module="", qualname=self._class_name, payload=b"",
+                method_name=method_name,
+            ),
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=options.get("num_returns", 1),
+            resources={},
+            owner_addr=worker.serve_addr,
+            parent_task_id=worker.current_ctx().task_id,
+            actor_id=self._actor_id,
+            actor_seq_no=seq_no,
+            max_concurrency=self._max_concurrency,
+            is_async_actor=self._is_async,
+        )
+        refs = worker.submit_actor_task(spec)
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __ray_terminate__(self):
+        return ActorMethod(self, "__ray_terminate__")
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = api_utils.validate_options(dict(options or {}), for_actor=True)
+        self._payload = serialization.dumps(cls)
+        self.__name__ = cls.__name__
+        self.__qualname__ = cls.__qualname__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(options)
+        ac = ActorClass.__new__(ActorClass)
+        ac._cls = self._cls
+        ac._options = api_utils.validate_options(merged, for_actor=True)
+        ac._payload = self._payload
+        ac.__name__ = self._cls.__name__
+        ac.__qualname__ = self._cls.__qualname__
+        return ac
+
+    def _is_async_class(self) -> bool:
+        return any(
+            asyncio_iscoroutinefunction(m)
+            for _n, m in inspect.getmembers(self._cls, predicate=inspect.isfunction)
+        )
+
+    def _method_names(self) -> tuple:
+        names = [
+            n for n, _m in inspect.getmembers(
+                self._cls, predicate=lambda m: inspect.isfunction(m) or inspect.ismethod(m))
+            if not n.startswith("__")
+        ]
+        return tuple(names)
+
+    def _method_options(self) -> Dict[str, Dict[str, Any]]:
+        """Collect per-method defaults set via @ray_tpu.method(...)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for n, m in inspect.getmembers(
+                self._cls, predicate=lambda m: inspect.isfunction(m) or inspect.ismethod(m)):
+            opts = getattr(m, "__ray_tpu_method_options__", None)
+            if opts:
+                out[n] = dict(opts)
+        return out
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.config import config
+        from ray_tpu._private.worker import get_global_worker
+
+        worker = get_global_worker()
+        opts = self._options
+        name = opts.get("name") or ""
+        namespace = opts.get("namespace") or worker.namespace
+
+        if opts.get("get_if_exists") and name:
+            existing = get_actor_or_none(name, namespace)
+            if existing is not None:
+                return existing
+
+        ctx = worker.current_ctx()
+        ctx.submit_index += 1
+        actor_id = ActorID.of(worker.job_id, ctx.task_id, ctx.submit_index)
+        task_args, kw_keys = api_utils.build_args(worker, args, kwargs)
+        is_async = self._is_async_class()
+        max_concurrency = opts.get("max_concurrency") or (1000 if is_async else 1)
+        spec = TaskSpec(
+            task_id=api_utils.next_task_id(worker),
+            job_id=worker.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function=FunctionDescriptor(
+                module=getattr(self._cls, "__module__", "") or "",
+                qualname=self._cls.__qualname__,
+                payload=self._payload,
+            ),
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=1,
+            resources=api_utils.build_resources(opts, default_num_cpus=0),
+            owner_addr=worker.serve_addr,
+            parent_task_id=ctx.task_id,
+            scheduling_strategy=api_utils.normalize_strategy(opts.get("scheduling_strategy")),
+            actor_id=actor_id,
+            max_restarts=opts.get("max_restarts", config.actor_max_restarts_default),
+            max_concurrency=max_concurrency,
+            is_async_actor=is_async,
+            actor_name=name,
+            namespace=namespace,
+        )
+        worker.run_coro(
+            worker.gcs.call("create_actor", spec_bytes=serialization.dumps(spec))
+        )
+        return ActorHandle(actor_id, self._cls.__qualname__, is_async, max_concurrency,
+                           self._method_names(), self._method_options())
+
+
+def asyncio_iscoroutinefunction(fn) -> bool:
+    import asyncio
+
+    return asyncio.iscoroutinefunction(fn)
+
+
+def get_actor_or_none(name: str, namespace: Optional[str] = None) -> Optional[ActorHandle]:
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    if namespace is None:
+        namespace = worker.namespace
+    actor_id_bytes = worker.run_coro(
+        worker.gcs.call("get_named_actor", name=name, namespace=namespace)
+    )
+    if actor_id_bytes is None:
+        return None
+    info = worker.run_coro(
+        worker.gcs.call("get_actor_info", actor_id=actor_id_bytes)
+    )
+    # async/max_concurrency flags affect only server-side queueing; the actor
+    # worker knows its own mode, so defaults here are safe for dispatch.
+    return ActorHandle(ActorID(actor_id_bytes), info.get("class_name", "Actor"),
+                       False, 1, ())
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    handle = get_actor_or_none(name, namespace)
+    if handle is None:
+        raise ValueError(f"Failed to look up actor with name {name!r}")
+    return handle

@@ -1,0 +1,104 @@
+"""Public exception types.
+
+Equivalent of the reference's ``python/ray/exceptions.py`` — errors crossing
+process boundaries carry the remote traceback and re-raise at the caller.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RpcChaosError(RayTpuError):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ``get`` with the remote trace.
+
+    Reference: ``RayTaskError`` (python/ray/exceptions.py).
+    """
+
+    def __init__(self, cause_repr: str, remote_traceback: str, cause: Optional[BaseException] = None):
+        self.cause_repr = cause_repr
+        self.remote_traceback = remote_traceback
+        self.cause = cause
+        super().__init__(f"{cause_repr}\n\nRemote traceback:\n{remote_traceback}")
+
+    @classmethod
+    def from_exception(cls, e: BaseException) -> "TaskError":
+        return cls(repr(e), "".join(traceback.format_exception(type(e), e, e.__traceback__)), e)
+
+    def __reduce__(self):
+        # The cause may not be picklable; try to keep it, fall back to repr only.
+        import pickle
+
+        cause = self.cause
+        try:
+            pickle.dumps(cause)
+        except Exception:
+            cause = None
+        return (TaskError, (self.cause_repr, self.remote_traceback, cause))
+
+
+class ActorError(RayTpuError):
+    """The actor is dead or died while executing this method.
+
+    Reference: ``RayActorError``.
+    """
+
+    def __init__(self, actor_id=None, msg: str = ""):
+        self.actor_id = actor_id
+        self.msg = msg
+        super().__init__(msg or f"Actor {actor_id} is dead")
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.msg))
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died (reference: WorkerCrashedError)."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id=None, msg: str = ""):
+        self.object_id = object_id
+        self.msg = msg
+        super().__init__(msg or f"Object {object_id} was lost and could not be reconstructed")
+
+    def __reduce__(self):
+        return (type(self), (self.object_id, self.msg))
+
+
+class ObjectFetchTimedOutError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` exceeded its timeout (reference: GetTimeoutError)."""
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass

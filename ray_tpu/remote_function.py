@@ -1,0 +1,87 @@
+"""RemoteFunction: the object behind ``@ray_tpu.remote`` on a function.
+
+Equivalent of the reference's ``python/ray/remote_function.py``
+(``RemoteFunction._remote`` at ``remote_function.py:308``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import api_utils, serialization
+from ray_tpu._private.task_spec import FunctionDescriptor, TaskSpec, TaskType
+
+
+class RemoteFunction:
+    def __init__(self, function, options: Optional[Dict[str, Any]] = None):
+        self._function = function
+        self._options = api_utils.validate_options(dict(options or {}), for_actor=False)
+        self._payload = serialization.dumps(function)
+        functools.update_wrapper(self, function)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._function.__name__!r} cannot be called directly; "
+            f"use {self._function.__name__}.remote()."
+        )
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(options)
+        rf = RemoteFunction.__new__(RemoteFunction)
+        rf._function = self._function
+        rf._options = api_utils.validate_options(merged, for_actor=False)
+        rf._payload = self._payload
+        functools.update_wrapper(rf, self._function)
+        return rf
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu._private.config import config
+        from ray_tpu._private.worker import get_global_worker
+
+        worker = get_global_worker()
+        opts = self._options
+        task_args, kw_keys = api_utils.build_args(worker, args, kwargs)
+        spec = TaskSpec(
+            task_id=api_utils.next_task_id(worker),
+            job_id=worker.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function=FunctionDescriptor(
+                module=getattr(self._function, "__module__", "") or "",
+                qualname=getattr(self._function, "__qualname__", "fn"),
+                payload=self._payload,
+            ),
+            args=task_args,
+            kwargs_keys=kw_keys,
+            num_returns=opts.get("num_returns", 1),
+            resources=api_utils.build_resources(opts, default_num_cpus=1),
+            owner_addr=worker.serve_addr,
+            parent_task_id=worker.current_ctx().task_id,
+            scheduling_strategy=api_utils.normalize_strategy(opts.get("scheduling_strategy")),
+            max_retries=opts.get("max_retries", config.task_max_retries_default),
+            retry_exceptions=opts.get("retry_exceptions", False),
+        )
+        refs = worker.submit_task(spec)
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs
+
+
+def remote_decorator(*args, **options):
+    """Implements ``@ray_tpu.remote`` / ``@ray_tpu.remote(**options)`` for both
+    functions and classes (reference ``worker.py:3405``)."""
+    from ray_tpu.actor import ActorClass
+
+    def _wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        if callable(target):
+            return RemoteFunction(target, options)
+        raise TypeError("@ray_tpu.remote requires a function or class")
+
+    if len(args) == 1 and not options and (callable(args[0]) or isinstance(args[0], type)):
+        return _wrap(args[0])
+    if args:
+        raise TypeError("@ray_tpu.remote() accepts only keyword options")
+    return _wrap

@@ -1,0 +1,63 @@
+"""Runtime context: introspection of the current driver/worker/task/actor.
+
+Equivalent of the reference's ``python/ray/runtime_context.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._worker = worker
+
+    @property
+    def job_id(self):
+        return self._worker.job_id
+
+    @property
+    def node_id(self) -> str:
+        return self._worker.node_id
+
+    def get_node_id(self) -> str:
+        return self._worker.node_id
+
+    def get_job_id(self) -> str:
+        return self._worker.job_id.hex()
+
+    @property
+    def worker_id(self):
+        return self._worker.worker_id
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+    @property
+    def task_id(self):
+        return self._worker.current_ctx().task_id
+
+    def get_task_id(self) -> Optional[str]:
+        ctx = self._worker.current_ctx()
+        return ctx.task_id.hex() if ctx is not None else None
+
+    @property
+    def actor_id(self):
+        return self._worker.current_ctx().actor_id
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = self._worker.current_ctx().actor_id
+        return aid.hex() if aid is not None else None
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_assigned_resources(self):
+        return {}
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu._private.worker import get_global_worker
+
+    return RuntimeContext(get_global_worker())

@@ -1,0 +1,138 @@
+"""`raytpu` command-line interface.
+
+Equivalent of the reference's ``ray`` CLI
+(``python/ray/scripts/scripts.py``; ``start`` at ``scripts.py:706``):
+start/stop a head node, inspect cluster status, list entities.
+Uses argparse instead of click (no extra deps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def cmd_start(args):
+    from ray_tpu._private.node import NodeServices, default_resources
+
+    resources = default_resources(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    services = NodeServices()
+    addr = services.start_head(resources, json.loads(args.labels or "{}"))
+    # Detach: the head runs as its own process group; record for `stop`.
+    state = {"gcs_addr": addr, "head_pid": services.head_proc.pid,
+             "session_dir": services.session_dir}
+    os.makedirs(os.path.expanduser("~/.ray_tpu"), exist_ok=True)
+    with open(os.path.expanduser("~/.ray_tpu/head.json"), "w") as f:
+        json.dump(state, f)
+    import atexit
+
+    atexit.unregister(services.stop)
+    services._owns_cluster = False  # keep running after this CLI exits
+    print(f"Head started. Address: {addr}")
+    print(f"Connect with: ray_tpu.init(address='{addr}')")
+
+
+def cmd_stop(args):
+    path = os.path.expanduser("~/.ray_tpu/head.json")
+    if not os.path.exists(path):
+        print("No running head found.")
+        return
+    with open(path) as f:
+        state = json.load(f)
+    from ray_tpu._private.rpc import RpcClient, run_sync
+
+    async def _down():
+        c = RpcClient(state["gcs_addr"])
+        try:
+            await c.call("shutdown_cluster")
+        finally:
+            await c.close()
+
+    try:
+        run_sync(_down())
+        print("Cluster shut down.")
+    except Exception as e:  # noqa: BLE001
+        print(f"Graceful shutdown failed ({e}); killing pid {state['head_pid']}")
+        try:
+            os.kill(state["head_pid"], 9)
+        except ProcessLookupError:
+            pass
+    os.unlink(path)
+
+
+def cmd_status(args):
+    ray_tpu = _connect(args.address or _default_address())
+    print("Nodes:")
+    for n in ray_tpu.nodes():
+        mark = "alive" if n["alive"] else "DEAD"
+        print(f"  {n['node_id'][:12]} [{mark}] {n['addr']} total={n['total']}")
+    print("Cluster resources:", ray_tpu.cluster_resources())
+    print("Available:", ray_tpu.available_resources())
+    ray_tpu.shutdown()
+
+
+def cmd_list(args):
+    ray_tpu = _connect(args.address or _default_address())
+    from ray_tpu.util import state as state_api
+
+    fn = {
+        "actors": state_api.list_actors,
+        "nodes": state_api.list_nodes,
+        "jobs": state_api.list_jobs,
+        "placement-groups": state_api.list_placement_groups,
+    }[args.entity]
+    for row in fn():
+        print(json.dumps(row, default=str))
+    ray_tpu.shutdown()
+
+
+def _default_address() -> str:
+    path = os.path.expanduser("~/.ray_tpu/head.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)["gcs_addr"]
+    raise SystemExit("No address given and no running head found (raytpu start first).")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="raytpu",
+                                     description="TPU-native distributed runtime CLI")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head node on this machine")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default="")
+    p.add_argument("--labels", default="")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the head started on this machine")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="show cluster nodes and resources")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("entity", choices=["actors", "nodes", "jobs", "placement-groups"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

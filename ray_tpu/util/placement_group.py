@@ -1,0 +1,104 @@
+"""Placement groups: gang resource reservation across nodes.
+
+Equivalent of the reference's ``python/ray/util/placement_group.py`` backed by
+the GCS placement-group manager (``gcs_placement_group_mgr.h:232``) and raylet
+bundle reservations (``placement_group_resource_manager.h``).  Strategies:
+PACK / SPREAD / STRICT_PACK / STRICT_SPREAD.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self._bundles = bundles
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self._bundles)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._bundles)
+
+    def ready(self):
+        """Returns an ObjectRef resolving when the PG is placed (reference
+        ``PlacementGroup.ready``)."""
+        import ray_tpu
+
+        pg = self
+
+        @ray_tpu.remote
+        def _pg_ready_probe():
+            return True
+
+        from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        return _pg_ready_probe.options(
+            num_cpus=0,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg),
+        ).remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        from ray_tpu._private.worker import get_global_worker
+
+        worker = get_global_worker()
+        reply = worker.run_coro(
+            worker.gcs.call("wait_placement_group_ready", pg_id=self.id.binary(),
+                            timeout=timeout_seconds),
+            timeout=timeout_seconds + 10,
+        )
+        return reply.get("state") == "CREATED"
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self._bundles))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"Invalid strategy {strategy!r}; valid: {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not b or all(v == 0 for v in b.values()):
+            raise ValueError("bundles must request positive resources")
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    pg_id_bytes = worker.run_coro(
+        worker.gcs.call("create_placement_group", bundles=bundles, strategy=strategy,
+                        name=name)
+    )
+    return PlacementGroup(PlacementGroupID(pg_id_bytes), bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    worker.run_coro(worker.gcs.call("remove_placement_group", pg_id=pg.id.binary()))
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None):
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    if pg is not None:
+        return worker.run_coro(worker.gcs.call("get_placement_group", pg_id=pg.id.binary()))
+    return worker.run_coro(worker.gcs.call("list_placement_groups"))
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    return None

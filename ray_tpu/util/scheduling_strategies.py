@@ -1,0 +1,46 @@
+"""Public scheduling strategies.
+
+Equivalent of the reference's ``python/ray/util/scheduling_strategies.py``
+(``PlacementGroupSchedulingStrategy`` at ``:15``,
+``NodeAffinitySchedulingStrategy`` at ``:41``,
+``NodeLabelSchedulingStrategy``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(
+        self,
+        placement_group,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: Optional[bool] = None,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = bool(placement_group_capture_child_tasks)
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False,
+                 _spill_on_unavailable: bool = False, _fail_on_unavailable: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class NodeLabelSchedulingStrategy:
+    def __init__(self, hard: Optional[Dict[str, str]] = None,
+                 soft: Optional[Dict[str, str]] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}
+
+
+# TPU-era addition: place a gang of workers onto one pod slice by slice label,
+# generalizing the reference's `TPU-{type}-head` resource hack
+# (python/ray/_private/accelerators/tpu.py:326-372) into a label selector.
+class TpuSliceSchedulingStrategy(NodeLabelSchedulingStrategy):
+    def __init__(self, slice_name: str):
+        super().__init__(hard={"tpu-slice-name": slice_name})
+        self.slice_name = slice_name

@@ -1,0 +1,69 @@
+"""State API: list cluster entities (reference ``python/ray/util/state/api.py``)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _worker():
+    from ray_tpu._private.worker import get_global_worker
+
+    return get_global_worker()
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    w = _worker()
+    return w.run_coro(w.gcs.call("get_all_nodes"))
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    w = _worker()
+    out = w.run_coro(w.gcs.call("list_actors"))
+    for a in out:
+        a["actor_id"] = a["actor_id"].hex()
+        if a.get("worker_id"):
+            a["worker_id"] = a["worker_id"].hex()
+    return out
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    w = _worker()
+    return w.run_coro(w.gcs.call("list_jobs"))
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    w = _worker()
+    out = w.run_coro(w.gcs.call("list_placement_groups"))
+    for p in out:
+        p["placement_group_id"] = p["pg_id"].hex()
+        del p["pg_id"]
+    return out
+
+
+def list_named_actors(namespace: Optional[str] = None) -> List[Dict[str, str]]:
+    w = _worker()
+    return w.run_coro(w.gcs.call("list_named_actors", namespace=namespace))
+
+
+def timeline(filename: Optional[str] = None):
+    """Export a chrome://tracing timeline of cluster events (reference
+    ``python/ray/_private/state.py:444 profile_events``)."""
+    w = _worker()
+    reply = w.run_coro(w.gcs.call("subscribe", cursor=0, timeout=0.01))
+    events = []
+    for e in reply.get("events", []):
+        events.append({
+            "name": e.get("event", "event"),
+            "cat": e.get("channel", ""),
+            "ph": "i",
+            "ts": e.get("time", time.time()) * 1e6,
+            "pid": 0,
+            "tid": 0,
+            "s": "g",
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

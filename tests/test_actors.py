@@ -1,0 +1,219 @@
+"""Actor tests: creation, ordering, async actors, named actors, kill/restart.
+
+Models the reference's ``python/ray/tests/test_actor.py`` /
+``test_actor_failures.py`` coverage.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+
+def test_actor_basic(ray_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.get.remote()) == 6
+
+
+def test_actor_constructor_args(ray_start):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.get.remote()) == 100
+
+
+def test_actor_ordering(ray_start):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(50)]
+    values = ray_tpu.get(refs)
+    assert values == list(range(1, 51))
+
+
+def test_actor_method_error(ray_start):
+    c = Counter.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="actor method failed"):
+        ray_tpu.get(c.fail.remote())
+    # actor still alive after method error
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+
+def test_two_actors_isolated(ray_start):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get([a.incr.remote(), a.incr.remote(), b.incr.remote()])
+    assert ray_tpu.get(a.get.remote()) == 2
+    assert ray_tpu.get(b.get.remote()) == 1
+    # distinct processes
+    assert ray_tpu.get(a.pid.remote()) != ray_tpu.get(b.pid.remote())
+
+
+def test_actor_handle_passing(ray_start):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.get.remote()) == 1
+
+
+def test_named_actor(ray_start):
+    c = Counter.options(name="global_counter_1").remote(7)
+    ray_tpu.get(c.get.remote())  # ensure alive
+    h = ray_tpu.get_actor("global_counter_1")
+    assert ray_tpu.get(h.get.remote()) == 7
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("no_such_actor_xyz")
+
+
+def test_get_if_exists(ray_start):
+    a = Counter.options(name="gie_counter", get_if_exists=True).remote(1)
+    ray_tpu.get(a.get.remote())
+    b = Counter.options(name="gie_counter", get_if_exists=True).remote(1)
+    ray_tpu.get(b.incr.remote())
+    assert ray_tpu.get(a.get.remote()) == 2
+
+
+def test_async_actor(ray_start):
+    @ray_tpu.remote
+    class AsyncWorker:
+        def __init__(self):
+            self.n = 0
+
+        async def work(self, delay):
+            await asyncio.sleep(delay)
+            self.n += 1
+            return self.n
+
+        async def count(self):
+            return self.n
+
+    w = AsyncWorker.remote()
+    t0 = time.time()
+    refs = [w.work.remote(0.5) for _ in range(10)]
+    results = ray_tpu.get(refs)
+    elapsed = time.time() - t0
+    assert sorted(results) == list(range(1, 11))
+    # concurrent: 10 x 0.5s sleeps must overlap
+    assert elapsed < 4.0
+
+
+def test_actor_constructor_failure(ray_start):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("cannot construct")
+
+        def f(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((ray_tpu.exceptions.TaskError, ray_tpu.exceptions.ActorError)):
+        ray_tpu.get(b.f.remote())
+
+
+def test_kill_actor(ray_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    ray_tpu.kill(c)
+    time.sleep(0.5)
+    with pytest.raises(ray_tpu.exceptions.ActorError):
+        ray_tpu.get(c.incr.remote(), timeout=30)
+
+
+def test_actor_restart(ray_isolated):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote()) == 1
+    p.die.remote()
+    time.sleep(1.0)
+    # restarted with fresh state
+    deadline = time.time() + 60
+    while True:
+        try:
+            v = ray_tpu.get(p.incr.remote(), timeout=30)
+            break
+        except ray_tpu.exceptions.RayTpuError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert v == 1
+
+
+def test_max_concurrency_threaded(ray_start):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    s = Sleeper.remote()
+    t0 = time.time()
+    refs = [s.nap.remote(1.0) for _ in range(4)]
+    ray_tpu.get(refs)
+    assert time.time() - t0 < 3.5
+
+
+def test_actor_ordering_with_ref_args(ray_start):
+    """Regression: a method whose arg is a slow ObjectRef must still execute
+    before a later submitted inline-arg method (strict submission order)."""
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(1.0)
+        return 100
+
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.events = []
+
+        def record(self, v):
+            self.events.append(v)
+            return v
+
+        def all(self):
+            return self.events
+
+    log = Log.remote()
+    ray_tpu.get(log.all.remote())  # warm
+    r1 = log.record.remote(slow_value.remote())  # dep resolves in ~1s
+    r2 = log.record.remote(2)  # submitted later, must run later
+    ray_tpu.get([r1, r2])
+    assert ray_tpu.get(log.all.remote()) == [100, 2]

@@ -1,0 +1,150 @@
+"""End-to-end core API tests: tasks, objects, get/put/wait.
+
+Models the reference's ``python/ray/tests/test_basic.py`` coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start):
+    ref = ray_tpu.put(123)
+    assert ray_tpu.get(ref) == 123
+    big = np.arange(1_000_000, dtype=np.int64)
+    ref2 = ray_tpu.put(big)
+    np.testing.assert_array_equal(ray_tpu.get(ref2), big)
+
+
+def test_simple_task(ray_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+    refs = [add.remote(i, i) for i in range(10)]
+    assert ray_tpu.get(refs) == [2 * i for i in range(10)]
+
+
+def test_task_with_kwargs_and_refs(ray_start):
+    @ray_tpu.remote
+    def combine(a, b=0, c=0):
+        return a + b + c
+
+    x = ray_tpu.put(10)
+    assert ray_tpu.get(combine.remote(x, b=5, c=1)) == 16
+
+    @ray_tpu.remote
+    def double(v):
+        return v * 2
+
+    chained = double.remote(double.remote(double.remote(1)))
+    assert ray_tpu.get(chained) == 8
+
+
+def test_large_args_and_returns(ray_start):
+    @ray_tpu.remote
+    def echo_sum(arr):
+        return arr, float(arr.sum())
+
+    big = np.ones((512, 1024), dtype=np.float32)  # 2MB > inline threshold
+
+    @ray_tpu.remote(num_returns=2)
+    def two(arr):
+        return arr, float(arr.sum())
+
+    r_arr, r_sum = two.remote(big)
+    out = ray_tpu.get(r_arr)
+    np.testing.assert_array_equal(out, big)
+    assert ray_tpu.get(r_sum) == big.size
+
+
+def test_num_returns(ray_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_exception(ray_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="boom!"):
+        ray_tpu.get(boom.remote())
+
+
+def test_exception_propagates_through_deps(ray_start):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("first failure")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="first failure"):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_start):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return "slow"
+
+    # warm both leases so worker startup doesn't eat the timeout
+    ray_tpu.get([fast.remote(), slow.remote(0)])
+    f, s = fast.remote(), slow.remote(15)
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=5)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(sleepy.remote(), timeout=1)
+
+
+def test_nested_tasks(ray_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(4)) == 41
+
+
+def test_cluster_resources(ray_start):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 8.0
+
+
+def test_runtime_context(ray_start):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_node_id()
+
+    @ray_tpu.remote
+    def whoami():
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id(), c.get_worker_id()
+
+    tid, wid = ray_tpu.get(whoami.remote())
+    assert tid and wid

@@ -1,0 +1,74 @@
+"""Unit tests for IDs and serialization (no cluster needed)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID, UniqueID
+
+
+def test_id_roundtrip():
+    uid = UniqueID.from_random()
+    assert UniqueID.from_hex(uid.hex()) == uid
+    assert len(uid.binary()) == UniqueID.SIZE
+    assert not uid.is_nil()
+    assert UniqueID.nil().is_nil()
+
+
+def test_id_derivation_deterministic():
+    job = JobID.from_int(7)
+    t = TaskID.for_driver_task(job)
+    t2 = TaskID.for_driver_task(job)
+    assert t == t2
+    o1 = ObjectID.from_task_and_index(t, 0)
+    o2 = ObjectID.from_task_and_index(t, 0)
+    o3 = ObjectID.from_task_and_index(t, 1)
+    assert o1 == o2 and o1 != o3
+    a = ActorID.of(job, t, 1)
+    assert a == ActorID.of(job, t, 1)
+    assert a != ActorID.of(job, t, 2)
+
+
+def test_id_type_distinction():
+    raw = b"x" * 16
+    assert UniqueID(raw) != ObjectID(raw)
+    with pytest.raises(ValueError):
+        TaskID(raw)  # wrong width
+
+
+def test_serialize_roundtrip_basic():
+    for value in [1, "abc", [1, 2, {"k": (3, 4)}], None, b"bytes", {"nested": [1.5]}]:
+        payload, refs = serialization.serialize(value)
+        out, refs2 = serialization.deserialize(payload)
+        assert out == value
+        assert refs == [] and refs2 == []
+
+
+def test_serialize_numpy_zero_copy():
+    arr = np.arange(100000, dtype=np.float32).reshape(100, 1000)
+    payload, _ = serialization.serialize({"x": arr, "tag": 5})
+    out, _ = serialization.deserialize(payload)
+    np.testing.assert_array_equal(out["x"], arr)
+    # zero-copy: deserialized array should view the payload buffer
+    assert not out["x"].flags["OWNDATA"]
+
+
+def test_serialize_closure():
+    y = 42
+
+    def fn(x):
+        return x + y
+
+    payload = serialization.dumps(fn)
+    fn2 = serialization.loads(payload)
+    assert fn2(1) == 43
+
+
+def test_serialize_jax_array():
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    payload, _ = serialization.serialize(x)
+    out, _ = serialization.deserialize(payload)
+    assert isinstance(out, type(x))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
