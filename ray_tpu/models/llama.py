@@ -1,0 +1,265 @@
+"""Llama-family decoder-only transformer, pure-functional JAX.
+
+TPU-first choices:
+- params are a plain pytree + a parallel *spec tree* of logical axis names
+  (mapped to mesh axes by ``ray_tpu.parallel.sharding``) — DP/FSDP/TP/SP are
+  rule-table changes, not model changes;
+- layers are stacked and iterated with ``lax.scan`` (one trace, O(1) compile
+  time in depth) with per-layer ``jax.checkpoint`` rematerialisation;
+- bf16 activations / fp32 master params; all matmuls hit the MXU with fp32
+  accumulation (``preferred_element_type``);
+- attention dispatches through ``ray_tpu.ops`` (Pallas flash on-chip, ring
+  attention when the mesh shards sequence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    mlp_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    scan_layers: bool = True
+    attention_impl: str = "auto"
+    tie_embeddings: bool = False
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    # --- presets -----------------------------------------------------------
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama2_13b() -> "LlamaConfig":
+        return LlamaConfig(
+            hidden_size=5120, num_layers=40, num_heads=40, num_kv_heads=40,
+            mlp_dim=13824,
+        )
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+            num_kv_heads=8, mlp_dim=14336, max_seq_len=8192,
+            rope_theta=500000.0,
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-scale model (runs on CPU mesh in <1s)."""
+        defaults = dict(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, mlp_dim=128, max_seq_len=128,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    def num_params(self) -> int:
+        hd = self.resolved_head_dim
+        per_layer = (
+            self.hidden_size * (self.num_heads * hd)          # wq
+            + 2 * self.hidden_size * (self.num_kv_heads * hd)  # wk, wv
+            + (self.num_heads * hd) * self.hidden_size         # wo
+            + 3 * self.hidden_size * self.mlp_dim              # gate/up/down
+            + 2 * self.hidden_size                             # norms
+        )
+        embed = self.vocab_size * self.hidden_size
+        head = 0 if self.tie_embeddings else embed
+        return embed + head + self.num_layers * per_layer + self.hidden_size
+
+
+def _layer_init(key, cfg: LlamaConfig) -> Dict[str, jnp.ndarray]:
+    hd = cfg.resolved_head_dim
+    h, q_out, kv_out = cfg.hidden_size, cfg.num_heads * hd, cfg.num_kv_heads * hd
+    ks = jax.random.split(key, 7)
+    std = 0.02
+    init = lambda k, shape: (
+        jax.random.normal(k, shape, cfg.param_dtype) * std
+    )
+    return {
+        "attn_norm": jnp.ones((h,), cfg.param_dtype),
+        "wq": init(ks[0], (h, q_out)),
+        "wk": init(ks[1], (h, kv_out)),
+        "wv": init(ks[2], (h, kv_out)),
+        "wo": init(ks[3], (q_out, h)),
+        "mlp_norm": jnp.ones((h,), cfg.param_dtype),
+        "w_gate": init(ks[4], (h, cfg.mlp_dim)),
+        "w_up": init(ks[5], (h, cfg.mlp_dim)),
+        "w_down": init(ks[6], (cfg.mlp_dim, h)),
+    }
+
+
+def llama_init(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Initialize the parameter pytree (host or per-device; pure)."""
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+    layer_keys = jax.random.split(k_layers, cfg.num_layers)
+    if cfg.scan_layers:
+        layers = jax.vmap(lambda k: _layer_init(k, cfg))(layer_keys)
+    else:
+        layers = [_layer_init(k, cfg) for k in layer_keys]
+    params = {
+        "embed": jax.random.normal(
+            k_embed, (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype
+        ) * 0.02,
+        "layers": layers,
+        "final_norm": jnp.ones((cfg.hidden_size,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jax.random.normal(
+            k_head, (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype
+        ) * 0.02
+    return params
+
+
+def llama_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Logical-axis spec tree matching ``llama_init``'s structure."""
+    layer = {
+        "attn_norm": ("norm",),
+        "wq": ("embed", "heads"),
+        "wk": ("embed", "kv_heads"),
+        "wv": ("embed", "kv_heads"),
+        "wo": ("heads", "embed"),
+        "mlp_norm": ("norm",),
+        "w_gate": ("embed", "mlp"),
+        "w_up": ("embed", "mlp"),
+        "w_down": ("mlp", "embed"),
+    }
+    if cfg.scan_layers:
+        layers = {k: ("layers",) + v for k, v in layer.items()}
+    else:
+        layers = [dict(layer) for _ in range(cfg.num_layers)]
+    specs = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    return specs
+
+
+def _constrain(x, mesh, *axes):
+    if mesh is None:
+        return x
+    from ray_tpu.parallel.sharding import with_named_sharding
+
+    return with_named_sharding(x, mesh, *axes)
+
+
+def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
+    b, s, h = x.shape
+    hd = cfg.resolved_head_dim
+    dt = cfg.dtype
+    # Attention block.
+    y = rms_norm(x, lp["attn_norm"])
+    q = jnp.einsum("bsh,hq->bsq", y, lp["wq"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    k = jnp.einsum("bsh,hq->bsq", y, lp["wk"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    v = jnp.einsum("bsh,hq->bsq", y, lp["wv"].astype(dt),
+                   preferred_element_type=jnp.float32).astype(dt)
+    q = q.reshape(b, s, cfg.num_heads, hd)
+    k = k.reshape(b, s, cfg.num_kv_heads, hd)
+    v = v.reshape(b, s, cfg.num_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = _constrain(q, mesh, "batch", "seq", "heads", None)
+    attn = dot_product_attention(
+        q, k, v, causal=True, impl=cfg.attention_impl, mesh=mesh
+    )
+    attn = attn.reshape(b, s, cfg.num_heads * hd)
+    x = x + jnp.einsum("bsq,qh->bsh", attn, lp["wo"].astype(dt),
+                       preferred_element_type=jnp.float32).astype(dt)
+    x = _constrain(x, mesh, "batch", "seq", None)
+    # MLP block.
+    y = rms_norm(x, lp["mlp_norm"])
+    gate = jnp.einsum("bsh,hm->bsm", y, lp["w_gate"].astype(dt),
+                      preferred_element_type=jnp.float32).astype(dt)
+    up = jnp.einsum("bsh,hm->bsm", y, lp["w_up"].astype(dt),
+                    preferred_element_type=jnp.float32).astype(dt)
+    act = swiglu(gate, up)
+    x = x + jnp.einsum("bsm,mh->bsh", act, lp["w_down"].astype(dt),
+                       preferred_element_type=jnp.float32).astype(dt)
+    return _constrain(x, mesh, "batch", "seq", None)
+
+
+def llama_apply(
+    params: Dict[str, Any],
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    *,
+    mesh=None,
+) -> jnp.ndarray:
+    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] (fp32)."""
+    s = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.resolved_head_dim, s, cfg.rope_theta)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _constrain(x, mesh, "batch", "seq", None)
+
+    layer_fn = functools.partial(_decoder_layer, cfg=cfg, cos=cos, sin=sin,
+                                 mesh=mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(
+            lambda carry, lp: (layer_fn(carry, lp), None),
+            x,
+            params["layers"],
+        )
+    else:
+        for lp in params["layers"]:
+            x = layer_fn(x, lp)
+    x = rms_norm(x, params["final_norm"])
+    head = (
+        params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    ).astype(cfg.dtype)
+    logits = jnp.einsum("bsh,hv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return _constrain(logits, mesh, "batch", "seq", None)
+
+
+def llama_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jnp.ndarray],
+    cfg: LlamaConfig,
+    *,
+    mesh=None,
+) -> jnp.ndarray:
+    """Next-token cross-entropy; batch has 'tokens' [b,s] and optional
+    'mask' [b,s] (1 = contribute to loss)."""
+    tokens = batch["tokens"]
+    logits = llama_apply(params, tokens[:, :-1], cfg, mesh=mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

@@ -1,0 +1,10 @@
+"""TPU compute ops: attention (reference / Pallas flash / ring), norms, rope.
+
+The reference has no kernel layer — its model math lives in torch/vLLM
+behind ``ray.llm`` (SURVEY.md §2.4).  Here the hot ops are first-class:
+Pallas kernels target the MXU/VMEM directly, with pure-jnp reference
+implementations for CPU test meshes and autodiff checks.
+"""
+
+from ray_tpu.ops.attention import dot_product_attention  # noqa: F401
+from ray_tpu.ops.layers import rms_norm, apply_rope, rope_frequencies  # noqa: F401

@@ -1,0 +1,248 @@
+"""Attention: reference, Pallas flash (TPU), and ring attention (sp axis).
+
+Ring attention (context parallelism) is absent from the reference
+(SURVEY.md §2.4 — "EP/SP/CP/ring attention: Absent") and is a headline
+TPU-native feature here: K/V blocks rotate around the ``sp`` mesh axis via
+``lax.ppermute`` (ICI neighbor exchanges) while each device computes
+blockwise online-softmax attention for its local Q shard — memory per device
+is O(seq/sp), enabling contexts sp× longer than a single chip's HBM allows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[b, s, kv_heads, d] -> [b, s, kv_heads * n_rep, d] (GQA expansion)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def reference_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    positions_q: Optional[jnp.ndarray] = None,
+    positions_k: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Plain softmax attention, fp32 accumulation.
+
+    q: [b, sq, h, d]; k, v: [b, sk, kv_h, d] with h % kv_h == 0.
+    """
+    b, sq, h, d = q.shape
+    kv_h = k.shape[2]
+    k = _repeat_kv(k, h // kv_h)
+    v = _repeat_kv(v, h // kv_h)
+    scale = d ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        if positions_q is None:
+            positions_q = jnp.arange(sq)
+        if positions_k is None:
+            positions_k = jnp.arange(k.shape[1])
+        mask = positions_q[:, None] >= positions_k[None, :]
+        logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def _blockwise_step(q, k, v, m, l, o, *, qpos, kpos, scale):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: [b, sq, h, d]; k, v: [b, sk, h, d] (kv already GQA-expanded);
+    m, l: [b, h, sq] running max / normalizer; o: [b, sq, h, d] fp32 accum.
+    """
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = qpos[:, None] >= kpos[None, :]
+    logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+    m_blk = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # exp of fully-masked rows underflows to 0 — no NaNs since m_new finite.
+    p = jnp.exp(logits - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, o_new
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions (jax.shard_map vs experimental).
+
+    check_vma=False is needed when the body contains ops opaque to the
+    varying-axis type system (e.g. pallas_call).
+    """
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma,
+            )
+        except TypeError:
+            return jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+            )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    causal: bool = True,
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+) -> jnp.ndarray:
+    """Ring attention over the ``sp`` mesh axis (global-view inputs).
+
+    Inputs are global arrays [b, S, h, d] (sharded or not); shard_map splits
+    S over ``sp``, and K/V shards rotate around the ring with ppermute while
+    each device accumulates blockwise output for its local Q shard.
+    """
+    sp = mesh.shape[sp_axis]
+    if sp == 1:
+        return reference_attention(q, k, v, causal=causal)
+    h, kv_h = q.shape[2], k.shape[2]
+    batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    if head_axis is not None and head_axis not in mesh.axis_names:
+        head_axis = None
+    qspec = P(batch_axes if batch_axes else None, sp_axis, head_axis, None)
+
+    def local_fn(q_loc, k_loc, v_loc):
+        b, sq, h_loc, d = q_loc.shape
+        idx = jax.lax.axis_index(sp_axis)
+        scale = d ** -0.5
+        qpos = idx * sq + jnp.arange(sq)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def compute(t, k_cur, v_cur, m, l, o):
+            src_block = (idx - t) % sp
+            if causal:
+                kpos = src_block * sq + jnp.arange(k_cur.shape[1])
+                qp = qpos
+            else:
+                kpos = jnp.zeros((k_cur.shape[1],), jnp.int32)
+                qp = jnp.zeros((sq,), jnp.int32)
+            return _blockwise_step(
+                q_loc, k_cur, v_cur, m, l, o, qpos=qp, kpos=kpos, scale=scale
+            )
+
+        def body(t, carry):
+            k_cur, v_cur, m, l, o = carry
+            m, l, o = compute(t, k_cur, v_cur, m, l, o)
+            k_nxt = jax.lax.ppermute(k_cur, sp_axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, sp_axis, perm)
+            return k_nxt, v_nxt, m, l, o
+
+        m0 = jnp.full((b, h_loc, sq), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h_loc, sq), jnp.float32)
+        o0 = jnp.zeros((b, sq, h_loc, d), jnp.float32)
+        # Mark the accumulators device-varying so the loop carry typechecks
+        # under shard_map's varying-axis tracking (jax>=0.9).
+        if hasattr(jax.lax, "pcast"):
+            m0, l0, o0 = jax.lax.pcast(
+                (m0, l0, o0), tuple(mesh.axis_names), to="varying"
+            )
+        elif hasattr(jax.lax, "pvary"):
+            m0, l0, o0 = jax.lax.pvary((m0, l0, o0), tuple(mesh.axis_names))
+        # Last block: compute only — its rotated K/V would be discarded, so
+        # running the final ppermute pair would waste two ICI collectives.
+        k_l, v_l, m, l, o = jax.lax.fori_loop(
+            0, sp - 1, body, (k_loc, v_loc, m0, l0, o0)
+        )
+        m, l, o = compute(sp - 1, k_l, v_l, m, l, o)
+        l = jnp.maximum(l, 1e-30)
+        out = o / l.transpose(0, 2, 1)[..., None]
+        return out.astype(q_loc.dtype)
+
+    # GQA-expand before shard_map so head counts line up under tp sharding.
+    k = _repeat_kv(k, h // kv_h)
+    v = _repeat_kv(v, h // kv_h)
+    return _shard_map(
+        local_fn, mesh=mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec
+    )(q, k, v)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+    mesh: Optional[Mesh] = None,
+    sp_axis: str = "sp",
+) -> jnp.ndarray:
+    """Dispatching attention entry point used by the model layer.
+
+    impl: 'auto' | 'ref' | 'flash' | 'ring'.  'auto' picks ring when the
+    mesh shards sequence (sp>1), Pallas flash on TPU otherwise, and the
+    reference path on CPU test meshes.
+    """
+    if impl == "auto":
+        if (
+            mesh is not None
+            and sp_axis in mesh.axis_names
+            and mesh.shape[sp_axis] > 1
+        ):
+            impl = "ring"
+        elif jax.default_backend() == "tpu" and q.shape[1] >= 256:
+            impl = "flash"
+        else:
+            impl = "ref"
+    if impl == "ring":
+        assert mesh is not None, "ring attention needs a mesh"
+        return ring_attention(
+            q, k, v, mesh=mesh, sp_axis=sp_axis, causal=causal
+        )
+    if impl == "flash":
+        from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+        if mesh is None:
+            return flash_attention(q, k, v, causal=causal)
+        # The pallas_call is opaque to GSPMD: run it per-shard under
+        # shard_map, with batch sharded over dp/fsdp and heads over tp
+        # (sequence is whole per device since sp==1 on this path).
+        batch_axes = tuple(
+            a for a in ("dp", "fsdp") if a in mesh.axis_names
+        )
+        head_axis = "tp" if "tp" in mesh.axis_names else None
+        qspec = P(batch_axes if batch_axes else None, None, head_axis, None)
+        kvspec = qspec
+        return _shard_map(
+            lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=causal),
+            mesh=mesh,
+            in_specs=(qspec, kvspec, kvspec),
+            out_specs=qspec,
+            check_vma=False,
+        )(q, k, v)
+    return reference_attention(q, k, v, causal=causal)

@@ -1,0 +1,64 @@
+"""Elementwise/norm/rotary building blocks (XLA-fused; no kernels needed).
+
+These stay as plain jnp: XLA fuses them into adjacent matmuls, so a Pallas
+kernel would only add boundary overhead.  Computation is done in fp32 and
+cast back, the standard TPU-stability recipe for bf16 activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm in fp32, output in x.dtype. scale has shape [dim]."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (x * scale.astype(jnp.float32)).astype(dtype)
+
+
+def rope_frequencies(
+    head_dim: int, max_seq_len: int, theta: float = 10000.0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute rotary cos/sin tables [max_seq_len, head_dim // 2] (fp32)."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Rotary position embedding.
+
+    x: [batch, seq, heads, head_dim]; cos/sin: [max_seq, head_dim//2];
+    positions: optional [batch, seq] int32 (defaults to arange).
+    """
+    b, s, h, d = x.shape
+    if positions is None:
+        cos_g = cos[:s][None, :, None, :]
+        sin_g = sin[:s][None, :, None, :]
+    else:
+        cos_g = cos[positions][:, :, None, :]
+        sin_g = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos_g - x2 * sin_g, x2 * cos_g + x1 * sin_g], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU activation: silu(gate) * up."""
+    g = gate.astype(jnp.float32)
+    return (g * jnp.reciprocal(1.0 + jnp.exp(-g))).astype(gate.dtype) * up

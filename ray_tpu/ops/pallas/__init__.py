@@ -1,0 +1,1 @@
+"""Pallas TPU kernels (MXU/VMEM-targeted) with CPU interpret-mode fallback."""

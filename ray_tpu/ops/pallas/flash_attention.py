@@ -1,0 +1,203 @@
+"""Flash attention forward kernel in Pallas for TPU.
+
+Blockwise online-softmax attention: for each (batch*head, q-block) grid cell
+the kernel streams K/V blocks through VMEM, keeping running max/normalizer in
+VMEM scratch that persists across the innermost (k-block) grid dimension —
+the TPU grid is executed sequentially on each core, so scratch acts as the
+accumulator carry.  QK^T and PV ride the MXU with fp32 accumulation; causal
+q-blocks fully above the diagonal are skipped via ``pl.when``.  Sequences are
+padded up to the block size and the pad K positions masked, so any length is
+supported.
+
+Backward currently recomputes attention with the jnp reference path (exact
+same math, O(block) memory under remat); a Pallas backward kernel is the
+planned upgrade.  GQA is handled by index-mapping each q-head onto its kv
+head — no materialized KV expansion.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU extensions are unavailable on some CPU-only jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal,
+    block_q, block_k, num_kblocks, seq_k
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = kpos < seq_k  # pad K positions contribute nothing
+        if causal:
+            mask = jnp.logical_and(mask, qpos >= kpos)
+        logits = jnp.where(mask, logits, _NEG_INF)
+        m_prev = m_scr[:]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    if causal:
+        # Skip k-blocks strictly above the causal diagonal.
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_kblocks - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _pad_seq(x, block):
+    s = x.shape[1]
+    pad = (-s) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk, kv_h = k.shape[1], k.shape[2]
+    n_rep = h // kv_h
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    q = _pad_seq(q, block_q)
+    k = _pad_seq(k, block_k)
+    v = _pad_seq(v, block_k)
+    sq_p, sk_p = q.shape[1], k.shape[1]
+    # Kernel layout: [b*h, s, d] with heads folded into the grid.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kv_h, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kv_h, sk_p, d)
+    nq, nk = sq_p // block_q, sk_p // block_k
+    grid = (b * h, nq, nk)
+
+    def q_map(bh, qi, ki):
+        return (bh, qi, 0)
+
+    def kv_map(bh, qi, ki):
+        # GQA: q-head bh -> kv row (batch * kv_h + head // n_rep).
+        return ((bh // h) * kv_h + (bh % h) // n_rep, ki, 0)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=d ** -0.5,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        num_kblocks=nk,
+        seq_k=sk,
+    )
+    scratch = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, d), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), q_map),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq_p, d).transpose(0, 2, 1, 3)[:, :sq]
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+)
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    from ray_tpu.ops.attention import reference_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash attention. q: [b, s, h, d]; k, v: [b, s, kv_h, d].
+
+    Off-TPU this runs the Pallas interpreter (slow; tests use small shapes);
+    if the Pallas TPU extensions are missing entirely it falls back to the
+    jnp reference implementation.
+    """
+    if pltpu is None:  # pragma: no cover
+        from ray_tpu.ops.attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal)
+    if jax.default_backend() != "tpu":
+        interpret = True
+    return _flash(q, k, v, causal, block_q, block_k, interpret)

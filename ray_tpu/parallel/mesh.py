@@ -1,0 +1,134 @@
+"""Device-mesh construction for TPU pod slices.
+
+The canonical mesh has four named axes, outermost to innermost:
+
+    ("dp", "fsdp", "tp", "sp")
+
+- ``dp``:   pure data parallelism (gradients psum'd; params replicated)
+- ``fsdp``: ZeRO-style sharded data parallelism (params/opt-state sharded,
+            all-gathered for compute) — the reference reaches this via torch
+            FSDP (``train_loop_utils.py:176-178``); here it is an axis.
+- ``tp``:   tensor parallelism (Megatron-style column/row sharding)
+- ``sp``:   sequence/context parallelism (ring attention) — absent from the
+            reference entirely (SURVEY.md §2.4); first-class here.
+
+Axis ordering matters on hardware: innermost axes get ICI-adjacent devices
+(jax device order follows the torus), so tp/sp ride ICI while dp can span
+slices over DCN.  ``create_hybrid_mesh`` makes that split explicit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each mesh axis; -1 on at most one axis means "infer".
+
+    ``MeshConfig(dp=-1, tp=4)`` on 16 devices → (4, 1, 4, 1).
+    """
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        sizes = [self.dp, self.fsdp, self.tp, self.sp]
+        n_infer = sum(1 for s in sizes if s == -1)
+        if n_infer > 1:
+            raise ValueError(f"At most one axis may be -1, got {sizes}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if n_infer == 1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes = [n_devices // fixed if s == -1 else s for s in sizes]
+        elif fixed != n_devices:
+            raise ValueError(
+                f"Mesh {sizes} needs {fixed} devices, have {n_devices}"
+            )
+        return tuple(sizes)  # type: ignore[return-value]
+
+
+def mesh_shape_for(n_devices: int, config: Optional[MeshConfig] = None):
+    return (config or MeshConfig()).resolve(n_devices)
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Tuple[str, ...] = MESH_AXES,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all visible devices).
+
+    Uses ``jax.experimental.mesh_utils`` when available so the logical mesh
+    layout matches the physical ICI torus (contiguous inner axes).
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = mesh_shape_for(len(devices), config)
+    try:
+        from jax.experimental import mesh_utils
+
+        if devices is jax.devices() or list(devices) == list(jax.devices()):
+            dev_array = mesh_utils.create_device_mesh(shape)
+        else:
+            dev_array = np.asarray(devices).reshape(shape)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def create_hybrid_mesh(
+    *,
+    ici_config: Optional[MeshConfig] = None,
+    num_slices: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh spanning multiple pod slices: ``dp`` over DCN, rest over ICI.
+
+    For a multi-slice (multi-host DCN-connected) topology the outermost axis
+    must map to the slice boundary so only DP gradient reductions cross DCN.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % num_slices != 0:
+        raise ValueError(f"{n} devices not divisible into {num_slices} slices")
+    per_slice = n // num_slices
+    cfg = ici_config or MeshConfig(dp=1, fsdp=-1)
+    ici_shape = cfg.resolve(per_slice)
+    if cfg.dp != 1 and num_slices > 1:
+        raise ValueError("dp must be 1 in ici_config for hybrid meshes")
+    # create_hybrid_device_mesh takes same-rank ICI and DCN shapes; the
+    # result shape is their elementwise product, so dp == num_slices lands
+    # on the DCN boundary and fsdp/tp/sp stay within a slice's ICI torus.
+    dcn_shape = (num_slices, 1, 1, 1)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
+        )
+    except Exception:
+        dev_array = np.asarray(devices).reshape(
+            (num_slices,) + ici_shape[1:]
+        )
+    return Mesh(dev_array, MESH_AXES)
+
+
+def local_mesh(n: int = 1) -> Mesh:
+    """A trivial mesh over the first n local devices (single-host dev/test)."""
+    return create_mesh(MeshConfig(dp=-1), devices=jax.devices()[:n])

@@ -1,0 +1,176 @@
+"""Tests for ray_tpu.ops: attention kernels, norms, rope."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import (
+    dot_product_attention,
+    reference_attention,
+    ring_attention,
+)
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+from ray_tpu.ops.pallas.flash_attention import flash_attention
+from ray_tpu.parallel import MeshConfig, create_mesh
+
+
+def _qkv(b=2, s=128, h=4, kvh=2, d=64, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, kvh, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, kvh, d), dtype)
+    return q, k, v
+
+
+class TestReferenceAttention:
+    def test_causal_masks_future(self):
+        q, k, v = _qkv(s=16)
+        out = reference_attention(q, k, v, causal=True)
+        # Row 0 attends only to position 0 → equals v[:, 0] (GQA-expanded).
+        expected = jnp.repeat(v[:, 0], 2, axis=1)
+        np.testing.assert_allclose(out[:, 0], expected, rtol=1e-5)
+
+    def test_matches_jax_builtin(self):
+        q, k, v = _qkv(h=4, kvh=4)
+        ours = reference_attention(q, k, v, causal=True)
+        jaxs = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        np.testing.assert_allclose(ours, jaxs, atol=1e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        ref = reference_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        # Interpret mode emulates MXU bf16 matmul precision.
+        np.testing.assert_allclose(out, ref, atol=2e-2)
+
+    def test_grad_matches_reference(self):
+        q, k, v = _qkv(s=64)
+        g = jax.grad(
+            lambda *a: flash_attention(*a, block_q=32, block_k=32).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda *a: reference_attention(*a).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, atol=2e-2)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference_sp4(self, causal):
+        mesh = create_mesh(MeshConfig(dp=2, fsdp=1, tp=1, sp=4))
+        q, k, v = _qkv(b=2, s=64, h=4, kvh=2, d=32)
+        ref = reference_attention(q, k, v, causal=causal)
+        out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_under_jit_with_tp(self):
+        mesh = create_mesh(MeshConfig(dp=1, fsdp=1, tp=2, sp=4))
+        q, k, v = _qkv(b=2, s=64, h=4, kvh=4, d=32)
+        ref = reference_attention(q, k, v, causal=True)
+        out = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh=mesh, causal=True)
+        )(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_grad_flows(self):
+        mesh = create_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=8))
+        q, k, v = _qkv(b=1, s=64, h=2, kvh=2, d=16)
+        def f(q, k, v):
+            return ring_attention(q, k, v, mesh=mesh, causal=True).sum()
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(
+            lambda *a: reference_attention(*a, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+class TestDispatch:
+    def test_auto_picks_ring_on_sp_mesh(self):
+        mesh = create_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=8))
+        q, k, v = _qkv(b=1, s=64, h=2, kvh=2, d=16)
+        out = dot_product_attention(q, k, v, mesh=mesh)
+        ref = reference_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestLayers:
+    def test_rms_norm(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+        out = rms_norm(x, jnp.ones(8))
+        rms = jnp.sqrt(jnp.mean(out**2, axis=-1))
+        np.testing.assert_allclose(rms, jnp.ones(4), rtol=1e-3)
+
+    def test_rope_preserves_norm_and_relative(self):
+        cos, sin = rope_frequencies(16, 32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 32, 2, 16))
+        out = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(out, axis=-1), jnp.linalg.norm(x, axis=-1),
+            rtol=1e-5,
+        )
+        # Position 0 is the identity rotation.
+        np.testing.assert_allclose(out[:, 0], x[:, 0], atol=1e-6)
+
+    def test_rope_positions_arg(self):
+        cos, sin = rope_frequencies(8, 64)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 1, 8))
+        pos = jnp.array([[5, 6, 7, 8]])
+        shifted = apply_rope(x, cos, sin, positions=pos)
+        full = apply_rope(
+            jnp.pad(x, ((0, 0), (5, 0), (0, 0), (0, 0))), cos, sin
+        )[:, 5:]
+        np.testing.assert_allclose(shifted, full, atol=1e-5)
+
+    def test_swiglu(self):
+        g = jnp.array([0.0, 1.0, -1.0])
+        u = jnp.array([2.0, 2.0, 2.0])
+        out = swiglu(g, u)
+        np.testing.assert_allclose(out[0], 0.0, atol=1e-6)
+        assert out[1] > 0 and out[2] < 0
+
+
+class TestFlashPadding:
+    def test_non_divisible_seq(self):
+        """Seq lengths not divisible by block size are padded and masked."""
+        q, k, v = _qkv(s=95)
+        ref = reference_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        np.testing.assert_allclose(out, ref, atol=2e-2)
+
+    def test_odd_seq_4095_style(self):
+        q, k, v = _qkv(b=1, s=63, h=2, kvh=1, d=32)
+        ref = reference_attention(q, k, v, causal=False)
+        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+        np.testing.assert_allclose(out, ref, atol=2e-2)
+
+
+class TestShardedFlash:
+    def test_flash_under_mesh_shard_map(self):
+        """impl='flash' with a mesh runs per-shard under shard_map."""
+        mesh = create_mesh(MeshConfig(dp=4, fsdp=1, tp=2, sp=1))
+        q, k, v = _qkv(b=4, s=64, h=4, kvh=2, d=32)
+        ref = reference_attention(q, k, v, causal=True)
+        out = jax.jit(
+            lambda q, k, v: dot_product_attention(
+                q, k, v, causal=True, impl="flash", mesh=mesh
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-2)
+
+
+class TestHybridMesh:
+    def test_shape_and_axis_layout(self):
+        from ray_tpu.parallel import create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(
+            ici_config=MeshConfig(dp=1, fsdp=2, tp=2, sp=1), num_slices=2
+        )
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
