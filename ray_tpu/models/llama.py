@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import dot_product_attention
 from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
@@ -39,6 +40,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # "full": recompute everything in bwd (min memory);
+    # "save_attn": keep attention outputs (skips flash-kernel recompute —
+    # ~64 MB/layer at b8/s2048/h1024, usually the right trade on TPU).
+    remat_policy: str = "save_attn"
     scan_layers: bool = True
     attention_impl: str = "auto"
     tie_embeddings: bool = False
@@ -191,6 +196,7 @@ def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
     attn = dot_product_attention(
         q, k, v, causal=True, impl=cfg.attention_impl, mesh=mesh
     )
+    attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, cfg.num_heads * hd)
     x = x + jnp.einsum("bsq,qh->bsh", attn, lp["wo"].astype(dt),
                        preferred_element_type=jnp.float32).astype(dt)
@@ -223,9 +229,20 @@ def llama_apply(
     layer_fn = functools.partial(_decoder_layer, cfg=cfg, cos=cos, sin=sin,
                                  mesh=mesh)
     if cfg.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
-        )
+        if cfg.remat_policy == "save_attn":
+            # Also save the flash kernel's residuals (output + lse) so the
+            # backward does not replay the forward kernel to regenerate them.
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse"
+            )
+        elif cfg.remat_policy == "full":
+            policy = jax.checkpoint_policies.nothing_saveable
+        else:
+            raise ValueError(
+                f"remat_policy must be 'full' or 'save_attn', got "
+                f"{cfg.remat_policy!r}"
+            )
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
     if cfg.scan_layers:
         x, _ = jax.lax.scan(
             lambda carry, lp: (layer_fn(carry, lp), None),

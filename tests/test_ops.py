@@ -47,8 +47,10 @@ class TestFlashAttention:
         # Interpret mode emulates MXU bf16 matmul precision.
         np.testing.assert_allclose(out, ref, atol=2e-2)
 
-    def test_grad_matches_reference(self):
-        q, k, v = _qkv(s=64)
+    # s=48 exercises the backward padding path (not a block multiple).
+    @pytest.mark.parametrize("s", [64, 48])
+    def test_grad_matches_reference(self, s):
+        q, k, v = _qkv(s=s)
         g = jax.grad(
             lambda *a: flash_attention(*a, block_q=32, block_k=32).sum(),
             argnums=(0, 1, 2),
