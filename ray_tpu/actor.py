@@ -109,6 +109,13 @@ class ActorHandle:
     def __ray_terminate__(self):
         return ActorMethod(self, "__ray_terminate__")
 
+    @property
+    def _remote_call(self) -> ActorMethod:
+        """Generic in-actor execution: ``h._remote_call.remote(fn, *args)``
+        runs ``fn(actor_instance, *args)`` in the actor's process (parity:
+        ray's ``__ray_call__``)."""
+        return ActorMethod(self, "__rtpu_call__", {})
+
 
 class ActorClass:
     def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):

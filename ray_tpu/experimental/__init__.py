@@ -1,0 +1,1 @@
+"""Experimental APIs (internal KV, compiled-graph channels)."""

@@ -1,0 +1,73 @@
+"""Cluster-wide internal key-value store (GCS-backed).
+
+Parity: ``ray.experimental.internal_kv`` (``python/ray/experimental/
+internal_kv.py``) — the store the reference's collective groups use for
+rendezvous (``NCCLUniqueIDStore``, and GLOO's ``ray_internal_kv`` store at
+``python/ray/util/collective/collective_group/gloo_util.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _worker():
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called first")
+    return w
+
+
+def _internal_kv_put(
+    key: bytes, value: bytes, overwrite: bool = True, namespace: str = "kv"
+) -> bool:
+    w = _worker()
+    return w.run_coro(
+        w.gcs.call(
+            "kv_put",
+            ns=namespace,
+            key=key.decode() if isinstance(key, bytes) else key,
+            value=value,
+            overwrite=overwrite,
+        )
+    )
+
+
+def _internal_kv_get(key: bytes, namespace: str = "kv") -> Optional[bytes]:
+    w = _worker()
+    return w.run_coro(
+        w.gcs.call(
+            "kv_get",
+            ns=namespace,
+            key=key.decode() if isinstance(key, bytes) else key,
+        )
+    )
+
+
+def _internal_kv_del(key: bytes, namespace: str = "kv") -> bool:
+    w = _worker()
+    return w.run_coro(
+        w.gcs.call(
+            "kv_del",
+            ns=namespace,
+            key=key.decode() if isinstance(key, bytes) else key,
+        )
+    )
+
+
+def _internal_kv_list(prefix: str = "", namespace: str = "kv") -> List[str]:
+    w = _worker()
+    return w.run_coro(w.gcs.call("kv_keys", ns=namespace, prefix=prefix))
+
+
+def _internal_kv_exists(key: bytes, namespace: str = "kv") -> bool:
+    w = _worker()
+    return w.run_coro(
+        w.gcs.call(
+            "kv_exists",
+            ns=namespace,
+            key=key.decode() if isinstance(key, bytes) else key,
+        )
+    )

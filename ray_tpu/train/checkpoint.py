@@ -1,0 +1,80 @@
+"""Directory-based checkpoints (parity: ``ray.train.Checkpoint``,
+``python/ray/train/_checkpoint.py``), plus jax-pytree save/load helpers
+built on orbax when available (msgpack/np fallback otherwise)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Iterator, Optional
+
+
+class Checkpoint:
+    """A checkpoint is a directory; this class is a handle to it."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None or os.path.abspath(path) == self.path:
+            return self.path
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    # --- pytree convenience -----------------------------------------------
+    @classmethod
+    def from_pytree(
+        cls, tree: Any, path: Optional[str] = None
+    ) -> "Checkpoint":
+        """Save a jax pytree (device arrays are fetched to host)."""
+        import jax
+
+        if path is None:
+            path = os.path.join(
+                tempfile.gettempdir(), f"rtpu-ckpt-{uuid.uuid4().hex[:12]}"
+            )
+        os.makedirs(path, exist_ok=True)
+        host_tree = jax.device_get(tree)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            ckptr.save(os.path.join(path, "pytree"), host_tree)
+        except Exception:
+            with open(os.path.join(path, "pytree.pkl"), "wb") as f:
+                pickle.dump(host_tree, f, protocol=5)
+        return cls(path)
+
+    def to_pytree(self, target: Any = None) -> Any:
+        """Load the pytree (optionally restoring onto ``target``'s
+        structure/shardings)."""
+        orbax_path = os.path.join(self.path, "pytree")
+        pkl_path = os.path.join(self.path, "pytree.pkl")
+        if os.path.exists(orbax_path):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            if target is not None:
+                try:
+                    return ckptr.restore(orbax_path, item=target)
+                except TypeError:
+                    return ckptr.restore(orbax_path)
+            return ckptr.restore(orbax_path)
+        with open(pkl_path, "rb") as f:
+            return pickle.load(f)

@@ -1,0 +1,73 @@
+"""Train configuration dataclasses.
+
+Parity: ``ray.train`` configs (``python/ray/air/config.py`` —
+ScalingConfig/RunConfig/CheckpointConfig/FailureConfig), TPU-first: the
+scaling unit is a TPU topology (chips / pod-slice), not GPU counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    num_workers: training worker processes (one per TPU host in multi-host
+    pods; 1 for single-controller meshes).
+    use_tpu: reserve TPU resources for each worker.
+    chips_per_worker: TPU chips per worker (a v5e host has 4 or 8).
+    topology: optional slice topology string (e.g. "v5e-64") — workers are
+    gang-scheduled onto one slice via a placement group when set.
+    resources_per_worker: extra custom resources.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: float = 0.0
+    topology: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = self.chips_per_worker
+        return res
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: group restarts allowed (-1 = unlimited)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig
+    )
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Any]
+    path: Optional[str]
+    error: Optional[BaseException] = None
+    metrics_history: Optional[list] = None

@@ -1,0 +1,103 @@
+"""Worker-side training session: ``report``, ``get_context``.
+
+Parity: ``ray.train.report`` / ``ray.train.get_context``
+(``python/ray/train/_internal/session.py``).  The session lives in the
+worker actor; ``report()`` enqueues (metrics, checkpoint) rows the
+controller polls (Train-v2 poll-based worker group,
+``python/ray/train/v2/_internal/execution/worker_group/worker_group.py``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+class _TrainSession:
+    def __init__(
+        self,
+        rank: int,
+        world_size: int,
+        group_name: str,
+        config: Dict[str, Any],
+        checkpoint: Optional[Checkpoint],
+    ):
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        self.config = config
+        self.latest_checkpoint = checkpoint
+        self.results: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+def _start_session(**kw) -> _TrainSession:
+    global _session
+    with _session_lock:
+        _session = _TrainSession(**kw)
+        return _session
+
+
+def _get_session() -> _TrainSession:
+    s = _session
+    if s is None:
+        raise RuntimeError(
+            "No training session active — this API must be called inside "
+            "a train_loop_per_worker"
+        )
+    return s
+
+
+def report(
+    metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None
+) -> None:
+    """Report metrics (and optionally a checkpoint) to the controller."""
+    s = _get_session()
+    s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+class TrainContext:
+    def get_world_size(self) -> int:
+        return _get_session().world_size
+
+    def get_world_rank(self) -> int:
+        return _get_session().rank
+
+    def get_local_rank(self) -> int:
+        return _get_session().rank  # single-node local == world for now
+
+    def get_trial_name(self) -> str:
+        return _get_session().group_name
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return _get_session().latest_checkpoint
+
+    def get_config(self) -> Dict[str, Any]:
+        return _get_session().config
+
+    def collective_group(self, backend: str = "tcp") -> str:
+        """Join (once) the all-workers collective group; returns its name.
+
+        The DP pattern over DCN-separated hosts: compute grads locally,
+        ``col.allreduce(grads, ctx.collective_group())``, apply locally.
+        """
+        from ray_tpu.util import collective as col
+
+        s = _get_session()
+        name = f"train::{s.group_name}"
+        if not col.is_group_initialized(name):
+            col.init_collective_group(
+                s.world_size, s.rank, backend, name
+            )
+        return name
+
+
+def get_context() -> TrainContext:
+    return TrainContext()

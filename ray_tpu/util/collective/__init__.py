@@ -1,0 +1,26 @@
+"""Out-of-band collectives between actors/tasks.
+
+Parity: ``ray.util.collective`` (``python/ray/util/collective/collective.py``
+— init_collective_group :123, create_collective_group :160, allreduce :268,
+barrier :308, reduce :321, broadcast :383, allgather :433, reducescatter
+:482, send :541, recv :604).  Backends are TCP (GLOO role) and XLA (NCCL
+role, over ICI) — no CUDA anywhere.
+"""
+
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp  # noqa: F401

@@ -1,0 +1,161 @@
+"""Public collective API (process-local group registry + module functions).
+
+Parity: ``python/ray/util/collective/collective.py`` (GroupManager :40).
+Each participating process calls ``init_collective_group`` (typically from
+inside its actor/task), then the module-level ops.  ``create_collective_
+group`` does the same from the driver for a set of actors, using the
+generic ``_remote_call`` mechanism so user classes need no extra methods.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+logger = logging.getLogger(__name__)
+
+
+class GroupManager:
+    def __init__(self):
+        self._groups: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def create(self, backend, world_size: int, rank: int, group_name: str):
+        backend = Backend.parse(backend)
+        with self._lock:
+            if group_name in self._groups:
+                raise RuntimeError(
+                    f"collective group {group_name!r} already initialized"
+                )
+        if backend == Backend.TCP:
+            from ray_tpu.util.collective.collective_group.tcp_group import (
+                TcpGroup,
+            )
+
+            g = TcpGroup(world_size, rank, group_name)
+        else:
+            from ray_tpu.util.collective.collective_group.xla_group import (
+                XlaDistributedGroup,
+            )
+
+            g = XlaDistributedGroup(world_size, rank, group_name)
+        with self._lock:
+            self._groups[group_name] = g
+        return g
+
+    def get(self, group_name: str):
+        g = self._groups.get(group_name)
+        if g is None:
+            raise RuntimeError(
+                f"collective group {group_name!r} is not initialized in "
+                f"this process; call init_collective_group first"
+            )
+        return g
+
+    def exists(self, group_name: str) -> bool:
+        return group_name in self._groups
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            g = self._groups.pop(group_name, None)
+        if g is not None:
+            g.destroy_group()
+
+
+_group_mgr = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "tcp",
+    group_name: str = "default",
+) -> None:
+    """Initialize this process's membership in a collective group."""
+    _group_mgr.create(backend, world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: List[Any],
+    world_size: int,
+    ranks: Optional[List[int]] = None,
+    backend: str = "tcp",
+    group_name: str = "default",
+) -> None:
+    """Driver-side setup: make ``actors`` a collective group.
+
+    Dispatches ``init_collective_group`` into every actor (via the generic
+    in-actor call, so user classes need no special methods) and blocks until
+    all ranks have joined.
+    """
+    import ray_tpu
+
+    if ranks is None:
+        ranks = list(range(len(actors)))
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError(
+            f"{len(actors)} actors, {len(ranks)} ranks, world={world_size}"
+        )
+
+    def _join(_self, world_size, rank, backend, group_name):
+        init_collective_group(world_size, rank, backend, group_name)
+        return rank
+
+    refs = [
+        a._remote_call.remote(_join, world_size, r, backend, group_name)
+        for a, r in zip(actors, ranks)
+    ]
+    ray_tpu.get(refs)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _group_mgr.exists(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _group_mgr.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group_mgr.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group_mgr.get(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):
+    return _group_mgr.get(group_name).allreduce(tensor, op)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group_mgr.get(group_name).barrier()
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op=ReduceOp.SUM):
+    return _group_mgr.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group_mgr.get(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group_mgr.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op=ReduceOp.SUM):
+    return _group_mgr.get(group_name).reducescatter(tensor, op)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    return _group_mgr.get(group_name).send(tensor, dst_rank)
+
+
+def recv(shape=None, dtype=None, src_rank: int = 0,
+         group_name: str = "default", tag: int = 0):
+    return _group_mgr.get(group_name).recv(shape, dtype, src_rank)

@@ -1,0 +1,8 @@
+from ray_tpu.util.collective.collective_group.base_collective_group import (  # noqa: F401
+    BaseGroup,
+)
+from ray_tpu.util.collective.collective_group.tcp_group import TcpGroup  # noqa: F401
+from ray_tpu.util.collective.collective_group.xla_group import (  # noqa: F401
+    XlaDistributedGroup,
+    XlaMeshGroup,
+)

@@ -1,0 +1,55 @@
+"""Abstract collective group. Parity: ``BaseGroup``
+(``python/ray/util/collective/collective_group/base_collective_group.py:15``)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List
+
+from ray_tpu.util.collective.types import ReduceOp
+
+
+class BaseGroup(abc.ABC):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self._world_size = world_size
+        self._rank = rank
+        self._group_name = group_name
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def group_name(self) -> str:
+        return self._group_name
+
+    @abc.abstractmethod
+    def destroy_group(self) -> None: ...
+
+    @abc.abstractmethod
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM): ...
+
+    @abc.abstractmethod
+    def barrier(self) -> None: ...
+
+    @abc.abstractmethod
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM): ...
+
+    @abc.abstractmethod
+    def broadcast(self, tensor, src_rank: int = 0): ...
+
+    @abc.abstractmethod
+    def allgather(self, tensor) -> List[Any]: ...
+
+    @abc.abstractmethod
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM): ...
+
+    @abc.abstractmethod
+    def send(self, tensor, dst_rank: int) -> None: ...
+
+    @abc.abstractmethod
+    def recv(self, shape, dtype, src_rank: int): ...

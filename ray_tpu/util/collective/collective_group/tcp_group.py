@@ -1,0 +1,293 @@
+"""Host-memory collective group over TCP with GCS-KV rendezvous.
+
+The GLOO-role backend (reference: ``GLOOGroup``,
+``python/ray/util/collective/collective_group/gloo_collective_group.py``,
+rendezvous via the internal KV store).  Topology: a leader (rank 0) binds a
+TCP server and publishes its address in the internal KV under the group
+name; every rank (including 0) connects as a client.  Collectives are
+gather-compute-scatter at the leader; point-to-point send/recv is routed
+through the leader's mailbox keyed (src, dst, tag).
+
+This is the correctness/portability backend (control-plane reductions, CPU
+smoke tests — the north-star "allreduce over 4 CPU workers" config); the
+bandwidth path on TPU is the XLA backend.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.util.collective.collective_group.base_collective_group import (
+    BaseGroup,
+)
+from ray_tpu.util.collective.types import ReduceOp
+
+_REDUCE = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+}
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=5)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("collective peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _as_numpy(tensor) -> np.ndarray:
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    return np.asarray(tensor)
+
+
+class _LeaderServer:
+    """Rank-0 server: collects per-seq submissions, computes, replies."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(world_size + 4)
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: Dict[int, Dict[int, Dict]] = {}
+        self._results: Dict[int, Dict[int, Any]] = {}
+        self._mailbox: Dict[Tuple[int, int, int], Any] = {}  # (src,dst,tag)
+        self._conns: Dict[int, socket.socket] = {}
+        self._stop = False
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="coll-leader"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        accepted = 0
+        while not self._stop and accepted < self.world_size:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+            accepted += 1
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            hello = _recv_msg(conn)
+            rank = hello["rank"]
+            with self._lock:
+                self._conns[rank] = conn
+            while not self._stop:
+                msg = _recv_msg(conn)
+                kind = msg["kind"]
+                if kind == "collective":
+                    self._handle_collective(conn, rank, msg)
+                elif kind == "send":
+                    with self._cv:
+                        key = (rank, msg["dst"], msg.get("tag", 0))
+                        self._mailbox.setdefault(key, []).append(msg["data"])
+                        self._cv.notify_all()
+                elif kind == "recv":
+                    key = (msg["src"], rank, msg.get("tag", 0))
+                    with self._cv:
+                        while not self._mailbox.get(key) and not self._stop:
+                            self._cv.wait(timeout=1.0)
+                        q = self._mailbox.get(key)
+                        data = q.pop(0) if q else None
+                    _send_msg(conn, {"data": data})
+                elif kind == "shutdown":
+                    return
+        except (ConnectionError, OSError, EOFError):
+            return
+
+    def _handle_collective(self, conn, rank, msg):
+        seq = msg["seq"]
+        with self._cv:
+            self._pending.setdefault(seq, {})[rank] = msg
+            if len(self._pending[seq]) == self.world_size:
+                self._results[seq] = self._compute(self._pending.pop(seq))
+                self._cv.notify_all()
+            else:
+                while seq not in self._results and not self._stop:
+                    self._cv.wait(timeout=1.0)
+            reply = self._results[seq][rank]
+            # Last reader cleans up.
+            self._results[seq]["_reads"] = (
+                self._results[seq].get("_reads", 0) + 1
+            )
+            if self._results[seq]["_reads"] == self.world_size:
+                del self._results[seq]
+        _send_msg(conn, {"data": reply})
+
+    def _compute(self, msgs: Dict[int, Dict]) -> Dict[int, Any]:
+        op = msgs[0]["op"]
+        world = self.world_size
+        if op == "barrier":
+            return {r: None for r in range(world)}
+        tensors = [msgs[r]["data"] for r in range(world)]
+        if op == "allreduce":
+            out = _REDUCE[ReduceOp(msgs[0]["rop"])](tensors)
+            return {r: out for r in range(world)}
+        if op == "reduce":
+            out = _REDUCE[ReduceOp(msgs[0]["rop"])](tensors)
+            dst = msgs[0]["dst"]
+            return {r: (out if r == dst else None) for r in range(world)}
+        if op == "broadcast":
+            src = msgs[0]["src"]
+            return {r: tensors[src] for r in range(world)}
+        if op == "allgather":
+            return {r: tensors for r in range(world)}
+        if op == "reducescatter":
+            out = _REDUCE[ReduceOp(msgs[0]["rop"])](tensors)
+            chunks = np.split(out, world, axis=0)
+            return {r: chunks[r] for r in range(world)}
+        raise ValueError(f"unknown collective op {op}")
+
+    def shutdown(self):
+        self._stop = True
+        with self._cv:
+            self._cv.notify_all()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TcpGroup(BaseGroup):
+    def __init__(
+        self,
+        world_size: int,
+        rank: int,
+        group_name: str,
+        *,
+        timeout_s: float = 60.0,
+    ):
+        super().__init__(world_size, rank, group_name)
+        from ray_tpu.experimental import internal_kv
+
+        self._timeout = timeout_s
+        self._seq = 0
+        self._server: Optional[_LeaderServer] = None
+        key = f"collective/{group_name}/leader"
+        if rank == 0:
+            self._server = _LeaderServer(world_size)
+            internal_kv._internal_kv_put(
+                key.encode(), self._server.addr.encode(),
+                namespace="collective",
+            )
+            addr = self._server.addr
+        else:
+            deadline = time.monotonic() + timeout_s
+            addr = None
+            while time.monotonic() < deadline:
+                raw = internal_kv._internal_kv_get(
+                    key.encode(), namespace="collective"
+                )
+                if raw:
+                    addr = raw.decode()
+                    break
+                time.sleep(0.05)
+            if addr is None:
+                raise TimeoutError(
+                    f"collective group {group_name!r}: leader never "
+                    f"published its address"
+                )
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=timeout_s
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(self._sock, {"rank": rank})
+
+    # ----------------------------------------------------------------- ops
+    def _collective(self, op: str, data=None, **kw):
+        self._seq += 1
+        _send_msg(
+            self._sock,
+            {"kind": "collective", "op": op, "seq": self._seq, "data": data,
+             **kw},
+        )
+        self._sock.settimeout(self._timeout)
+        return _recv_msg(self._sock)["data"]
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._collective(
+            "allreduce", _as_numpy(tensor), rop=ReduceOp(op).value
+        )
+
+    def barrier(self) -> None:
+        self._collective("barrier")
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self._collective(
+            "reduce", _as_numpy(tensor), dst=dst_rank, rop=ReduceOp(op).value
+        )
+        return out if self.rank == dst_rank else tensor
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        return self._collective("broadcast", _as_numpy(tensor), src=src_rank)
+
+    def allgather(self, tensor) -> List[Any]:
+        return self._collective("allgather", _as_numpy(tensor))
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        t = _as_numpy(tensor)
+        if t.shape[0] % self.world_size:
+            raise ValueError(
+                f"reducescatter needs dim0 divisible by world_size "
+                f"({t.shape[0]} % {self.world_size})"
+            )
+        return self._collective(
+            "reducescatter", t, rop=ReduceOp(op).value
+        )
+
+    def send(self, tensor, dst_rank: int, tag: int = 0) -> None:
+        _send_msg(
+            self._sock,
+            {"kind": "send", "dst": dst_rank, "tag": tag,
+             "data": _as_numpy(tensor)},
+        )
+
+    def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
+        _send_msg(self._sock, {"kind": "recv", "src": src_rank, "tag": tag})
+        self._sock.settimeout(self._timeout)
+        return _recv_msg(self._sock)["data"]
+
+    def destroy_group(self) -> None:
+        try:
+            _send_msg(self._sock, {"kind": "shutdown"})
+            self._sock.close()
+        except OSError:
+            pass
+        if self._server is not None:
+            self._server.shutdown()

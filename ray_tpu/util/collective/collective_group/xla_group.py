@@ -1,0 +1,261 @@
+"""XLA collective groups — the accelerator plane (reference: NCCLGroup,
+``python/ray/util/collective/collective_group/nccl_collective_group.py``).
+
+Two shapes, mirroring how TPUs are actually driven:
+
+- ``XlaMeshGroup``: one process owns a device mesh (a pod-slice host or the
+  whole single-controller mesh).  "Ranks" are devices; ops are jitted
+  shard_map collectives over ICI (psum / all_gather / reduce_scatter /
+  ppermute).  This is the *_multigpu analogue and the fast path.
+
+- ``XlaDistributedGroup``: rank-per-process over jax.distributed.  Rank 0
+  publishes the coordinator address in the internal KV (parity with
+  ``NCCLUniqueIDStore``'s named-actor rendezvous); every rank calls
+  ``jax.distributed.initialize`` and ops run over the global mesh.
+  Requires a jaxlib with cross-process collectives for the platform.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.util.collective.collective_group.base_collective_group import (
+    BaseGroup,
+)
+from ray_tpu.util.collective.types import ReduceOp
+
+_JAX_REDUCE = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    from ray_tpu.ops.attention import _shard_map as sm
+
+    # check_vma=False: ops like all_gather produce replicated outputs the
+    # varying-axis checker cannot statically infer.
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_vma=False)
+
+
+class XlaMeshGroup(BaseGroup):
+    """Device-collectives over a single-process mesh (axis "x").
+
+    Tensors are jax arrays sharded (or shardable) over the mesh's first
+    axis.  Each op compiles once per shape and runs entirely on ICI.
+    """
+
+    def __init__(
+        self,
+        world_size: int,
+        rank: int = 0,
+        group_name: str = "default",
+        *,
+        devices: Optional[List[jax.Device]] = None,
+    ):
+        super().__init__(world_size, rank, group_name)
+        devices = devices or jax.devices()[:world_size]
+        if len(devices) < world_size:
+            raise ValueError(
+                f"need {world_size} devices, have {len(devices)}"
+            )
+        self.mesh = Mesh(np.asarray(devices), ("x",))
+        self._sharded = NamedSharding(self.mesh, P("x"))
+        self._replicated = NamedSharding(self.mesh, P())
+
+    def _device_put_sharded(self, tensor):
+        return jax.device_put(tensor, self._sharded)
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        """tensor: per-device values stacked on dim0 [world, ...] (or any
+        array sharded over dim0); returns the reduction, replicated."""
+        op = ReduceOp(op)
+        x = self._device_put_sharded(tensor)
+        if op == ReduceOp.PRODUCT:
+            body = lambda t: jnp.exp(jax.lax.psum(jnp.log(t), "x"))
+        else:
+            red = _JAX_REDUCE[op]
+            body = lambda t: red(t, "x")
+
+        def local(t):
+            return body(jnp.squeeze(t, 0))
+
+        return _shard_map(
+            local, self.mesh, (P("x"),), P()
+        )(x)
+
+    def barrier(self) -> None:
+        jax.block_until_ready(self.allreduce(np.zeros((self.world_size, 1))))
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        return self.allreduce(tensor, op)  # replicated result includes dst
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        x = self._device_put_sharded(tensor)
+
+        def local(t):
+            # ppermute needs unique (src, dst) pairs, so broadcast as a
+            # masked psum: only the source contributes.
+            t = jnp.squeeze(t, 0)
+            mask = jax.lax.axis_index("x") == src_rank
+            return jax.lax.psum(jnp.where(mask, t, jnp.zeros_like(t)), "x")[
+                None
+            ]
+
+        return _shard_map(local, self.mesh, (P("x"),), P("x"))(x)
+
+    def allgather(self, tensor) -> Any:
+        x = self._device_put_sharded(tensor)
+
+        def local(t):
+            return jax.lax.all_gather(jnp.squeeze(t, 0), "x")
+
+        return _shard_map(local, self.mesh, (P("x"),), P())(x)
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        op = ReduceOp(op)
+        if op != ReduceOp.SUM:
+            raise NotImplementedError("reducescatter supports SUM on XLA")
+        x = self._device_put_sharded(tensor)
+
+        def local(t):
+            # t: [1, world, ...] local stack element; scatter dim 1.
+            return jax.lax.psum_scatter(
+                jnp.squeeze(t, 0), "x", scatter_dimension=0, tiled=False
+            )[None]
+
+        return _shard_map(local, self.mesh, (P("x"),), P("x"))(x)
+
+    def send(self, tensor, dst_rank: int) -> None:
+        raise NotImplementedError(
+            "point-to-point on the mesh group: use ppermute via permute()"
+        )
+
+    def recv(self, shape=None, dtype=None, src_rank: int = 0):
+        raise NotImplementedError(
+            "point-to-point on the mesh group: use ppermute via permute()"
+        )
+
+    def permute(self, tensor, perm: List[tuple]):
+        """ppermute: perm is [(src_device, dst_device), ...]."""
+        x = self._device_put_sharded(tensor)
+
+        def local(t):
+            return jax.lax.ppermute(jnp.squeeze(t, 0), "x", perm)[None]
+
+        return _shard_map(local, self.mesh, (P("x"),), P("x"))(x)
+
+    def destroy_group(self) -> None:
+        pass
+
+
+class XlaDistributedGroup(BaseGroup):
+    """Rank-per-process group over jax.distributed (multi-host TPU pods).
+
+    Rendezvous: rank 0 reserves a TCP port and publishes
+    ``collective/{group}/coordinator`` in the internal KV.
+    """
+
+    def __init__(
+        self, world_size: int, rank: int, group_name: str,
+        *, timeout_s: float = 120.0,
+    ):
+        super().__init__(world_size, rank, group_name)
+        from ray_tpu.experimental import internal_kv
+
+        key = f"collective/{group_name}/coordinator"
+        if rank == 0:
+            import socket
+
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            addr = f"127.0.0.1:{port}"
+            internal_kv._internal_kv_put(
+                key.encode(), addr.encode(), namespace="collective"
+            )
+        else:
+            deadline = time.monotonic() + timeout_s
+            addr = None
+            while time.monotonic() < deadline:
+                raw = internal_kv._internal_kv_get(
+                    key.encode(), namespace="collective"
+                )
+                if raw:
+                    addr = raw.decode()
+                    break
+                time.sleep(0.05)
+            if addr is None:
+                raise TimeoutError("coordinator address never published")
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=world_size,
+            process_id=rank,
+        )
+        self.mesh = Mesh(np.asarray(jax.devices()), ("x",))
+
+    def _global(self, tensor):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.host_local_array_to_global_array(
+            np.asarray(tensor)[None], self.mesh, P("x")
+        )
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        op = ReduceOp(op)
+        x = self._global(tensor)
+        red = _JAX_REDUCE[op]
+
+        def local(t):
+            return red(jnp.squeeze(t, 0), "x")
+
+        out = jax.jit(
+            _shard_map(local, self.mesh, (P("x"),), P())
+        )(x)
+        return np.asarray(jax.device_get(out.addressable_data(0)))
+
+    def barrier(self) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(self.group_name)
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        return self.allreduce(tensor, op)
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(
+            np.asarray(tensor), is_source=self.rank == src_rank
+        )
+
+    def allgather(self, tensor) -> List[Any]:
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.process_allgather(np.asarray(tensor))
+        return list(out)
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        out = self.allreduce(tensor, op)
+        chunk = out.shape[0] // self.world_size
+        return out[self.rank * chunk:(self.rank + 1) * chunk]
+
+    def send(self, tensor, dst_rank: int) -> None:
+        raise NotImplementedError("p2p over jax.distributed not supported")
+
+    def recv(self, shape=None, dtype=None, src_rank: int = 0):
+        raise NotImplementedError("p2p over jax.distributed not supported")
+
+    def destroy_group(self) -> None:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass

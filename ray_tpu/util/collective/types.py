@@ -1,0 +1,42 @@
+"""Collective types. Parity: ``python/ray/util/collective/types.py:29-46``
+(Backend enum NCCL/GLOO there; here the accelerator plane is XLA)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    """Collective backends.
+
+    - TCP: host-memory collectives between actor processes over TCP, with
+      GCS-KV rendezvous — the GLOO-role backend (works anywhere, used for
+      CPU smoke tests and control-plane reductions).
+    - XLA: in-mesh collectives — arrays live on TPU devices of one process
+      mesh; ops lower to psum/all_gather/ppermute over ICI inside jit.
+      (The multi-host variant forms the mesh via jax.distributed.)
+    """
+
+    TCP = "tcp"
+    XLA = "xla"
+
+    @staticmethod
+    def parse(v) -> "Backend":
+        if isinstance(v, Backend):
+            return v
+        v = str(v).lower()
+        if v in ("tcp", "gloo", "cpu"):
+            return Backend.TCP
+        if v in ("xla", "ici", "tpu", "nccl"):
+            return Backend.XLA
+        raise ValueError(f"unknown collective backend {v!r}")
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+unset_timeout_ms = 30_000

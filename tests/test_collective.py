@@ -1,0 +1,126 @@
+"""Collective layer tests.
+
+The 4-CPU-worker allreduce is the north-star smoke config (BASELINE.md:
+"collective allreduce — 4 CPU workers").
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+from ray_tpu.util.collective.types import ReduceOp
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, rank: int, world: int):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group_name):
+        col.init_collective_group(self.world, self.rank, "tcp", group_name)
+        return self.rank
+
+    def do_allreduce(self, group_name):
+        x = np.full((4,), float(self.rank + 1))
+        return col.allreduce(x, group_name)
+
+    def do_ops(self, group_name):
+        out = {}
+        out["bcast"] = col.broadcast(
+            np.full((2,), float(self.rank)), src_rank=2,
+            group_name=group_name,
+        )
+        out["gather"] = col.allgather(
+            np.array([self.rank]), group_name=group_name
+        )
+        out["rs"] = col.reducescatter(
+            np.arange(8, dtype=np.float64), group_name=group_name
+        )
+        out["max"] = col.allreduce(
+            np.array([float(self.rank)]), group_name, op=ReduceOp.MAX
+        )
+        col.barrier(group_name)
+        out["rank"] = col.get_rank(group_name)
+        return out
+
+    def do_sendrecv(self, group_name):
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=3, group_name=group_name)
+            return None
+        if self.rank == 3:
+            return col.recv(src_rank=0, group_name=group_name)
+        return None
+
+
+@pytest.fixture
+def group4(ray_start):
+    import uuid
+
+    name = f"g-{uuid.uuid4().hex[:8]}"
+    workers = [Worker.remote(i, 4) for i in range(4)]
+    ray_tpu.get([w.setup.remote(name) for w in workers])
+    yield workers, name
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+class TestTcpCollective:
+    def test_allreduce_4_cpu_workers(self, group4):
+        workers, name = group4
+        outs = ray_tpu.get([w.do_allreduce.remote(name) for w in workers])
+        for o in outs:
+            np.testing.assert_allclose(o, np.full((4,), 10.0))
+
+    def test_all_ops(self, group4):
+        workers, name = group4
+        outs = ray_tpu.get([w.do_ops.remote(name) for w in workers])
+        for r, o in enumerate(outs):
+            np.testing.assert_allclose(o["bcast"], np.full((2,), 2.0))
+            np.testing.assert_allclose(
+                np.concatenate(o["gather"]), np.arange(4)
+            )
+            # reducescatter of 4x arange(8): each rank gets its 2-chunk x4.
+            np.testing.assert_allclose(
+                o["rs"], 4 * np.arange(8)[r * 2:(r + 1) * 2]
+            )
+            assert o["max"][0] == 3.0
+            assert o["rank"] == r
+
+    def test_send_recv(self, group4):
+        workers, name = group4
+        outs = ray_tpu.get([w.do_sendrecv.remote(name) for w in workers])
+        np.testing.assert_allclose(outs[3], np.array([42.0]))
+
+    def test_create_collective_group_from_driver(self, ray_start):
+        import uuid
+
+        name = f"g-{uuid.uuid4().hex[:8]}"
+        workers = [Worker.remote(i, 2) for i in range(2)]
+        col.create_collective_group(workers, 2, group_name=name)
+        outs = ray_tpu.get([w.do_allreduce.remote(name) for w in workers])
+        np.testing.assert_allclose(outs[0], np.full((4,), 3.0))
+        for w in workers:
+            ray_tpu.kill(w)
+
+    def test_uninitialized_group_raises(self, ray_start):
+        with pytest.raises(RuntimeError, match="not initialized"):
+            col.allreduce(np.zeros(2), "nope")
+
+
+class TestXlaMeshGroup:
+    def test_mesh_collectives(self):
+        from ray_tpu.util.collective.collective_group.xla_group import (
+            XlaMeshGroup,
+        )
+
+        g = XlaMeshGroup(8)
+        x = np.arange(8, dtype=np.float32)[:, None]  # one scalar per device
+        out = np.asarray(g.allreduce(x))
+        np.testing.assert_allclose(out, [28.0])
+        out = np.asarray(g.allgather(np.arange(8, dtype=np.float32)[:, None]))
+        np.testing.assert_allclose(out[:, 0], np.arange(8))
+        out = np.asarray(g.broadcast(x, src_rank=3))
+        np.testing.assert_allclose(out[:, 0], np.full((8,), 3.0))
+        g.barrier()
