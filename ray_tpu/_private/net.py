@@ -1,0 +1,28 @@
+"""Small networking helpers shared by rendezvous paths."""
+
+from __future__ import annotations
+
+import socket
+
+
+def local_ip() -> str:
+    """This host's routable IP (falls back to loopback off-network)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def free_port() -> int:
+    """A currently-free TCP port (best-effort: released before use)."""
+    s = socket.socket()
+    s.bind(("", 0))
+    try:
+        return s.getsockname()[1]
+    finally:
+        s.close()

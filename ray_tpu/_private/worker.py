@@ -552,21 +552,6 @@ class CoreWorker:
             return await self._exec_actor_task(spec)
         return await self._exec_in_thread(spec)
 
-    async def _exec_in_thread_prepared(self, spec: TaskSpec, fn) -> Dict:
-        """Run an already-bound zero-prep callable in the executor thread."""
-
-        def _run():
-            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
-            try:
-                return True, fn()
-            except BaseException as e:  # noqa: BLE001
-                return False, exc.TaskError.from_exception(e)
-            finally:
-                _exec_ctx.reset(token)
-
-        ok, result = await self.loop.run_in_executor(self._task_executor, _run)
-        return self._package_returns(spec, ok, result)
-
     async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None) -> Dict:
         fn = bound_method if bound_method is not None else self._load_function(spec)
         args, kwargs = await self._resolve_args(spec)
@@ -715,14 +700,10 @@ class CoreWorker:
             # (parity: ray's ``__ray_call__``).  Used by libraries (train,
             # collective setup) to execute code in an actor's process
             # without the user class declaring a method for it.
-            args, kwargs = await self._resolve_args(spec)
-            fn = args[0]
+            def _bound(fn, *a, **kw):
+                return fn(self.actor_instance, *a, **kw)
 
-            def _bound(*a, **kw):
-                return fn(self.actor_instance, *args[1:], **kwargs)
-
-            spec2 = spec
-            return await self._exec_in_thread_prepared(spec2, _bound)
+            return await self._exec_in_thread(spec, bound_method=_bound)
         method = getattr(self.actor_instance, name, None)
         if method is None:
             err = exc.TaskError.from_exception(

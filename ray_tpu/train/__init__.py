@@ -1,0 +1,44 @@
+"""ray_tpu.train: SPMD training over gang-scheduled TPU workers.
+
+Parity target: ``ray.train`` (v2 control-loop design,
+``python/ray/train/v2/``) with JAX/GSPMD instead of torch DDP — see
+``trainer.JaxTrainer``.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.policies import (
+    DefaultFailurePolicy,
+    ElasticScalingPolicy,
+    FailureDecision,
+    FailurePolicy,
+    FixedScalingPolicy,
+    ResizeDecision,
+    ScalingPolicy,
+)
+from ray_tpu.train.session import (
+    TrainContext,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import (
+    DataParallelTrainer,
+    JaxTrainer,
+    initialize_jax_distributed,
+)
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "Result", "RunConfig",
+    "ScalingConfig", "DefaultFailurePolicy", "ElasticScalingPolicy",
+    "FailureDecision", "FailurePolicy", "FixedScalingPolicy", "ResizeDecision",
+    "ScalingPolicy", "TrainContext", "get_context", "get_dataset_shard",
+    "report", "DataParallelTrainer", "JaxTrainer",
+    "initialize_jax_distributed",
+]

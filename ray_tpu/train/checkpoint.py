@@ -51,12 +51,16 @@ class Checkpoint:
             )
         os.makedirs(path, exist_ok=True)
         host_tree = jax.device_get(tree)
+        orbax_dir = os.path.join(path, "pytree")
         try:
             import orbax.checkpoint as ocp
 
             ckptr = ocp.PyTreeCheckpointer()
-            ckptr.save(os.path.join(path, "pytree"), host_tree)
+            ckptr.save(orbax_dir, host_tree)
         except Exception:
+            # a partially-written orbax dir would shadow the pickle in
+            # to_pytree — remove it before falling back
+            shutil.rmtree(orbax_dir, ignore_errors=True)
             with open(os.path.join(path, "pytree.pkl"), "wb") as f:
                 pickle.dump(host_tree, f, protocol=5)
         return cls(path)

@@ -1,0 +1,88 @@
+"""Top-K checkpoint bookkeeping for a train run.
+
+Parity: ``python/ray/train/_internal/checkpoint_manager.py`` (keep top-K by
+score) and ``storage.py`` (persist to run storage dir).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class _Tracked:
+    checkpoint: Checkpoint
+    metrics: Dict[str, Any]
+    index: int
+
+
+class CheckpointManager:
+    def __init__(self, storage_dir: Optional[str], num_to_keep: Optional[int],
+                 score_attribute: Optional[str], score_order: str = "max"):
+        self.storage_dir = storage_dir
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._tracked: List[_Tracked] = []
+        self._index = 0
+        if storage_dir:
+            os.makedirs(storage_dir, exist_ok=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._tracked:
+            return None
+        return max(self._tracked, key=lambda t: t.index).checkpoint
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        t = self._best_tracked()
+        return t.checkpoint if t else None
+
+    def _best_tracked(self) -> Optional[_Tracked]:
+        if not self._tracked:
+            return None
+        if not self.score_attribute:
+            return max(self._tracked, key=lambda t: t.index)
+        scored = [t for t in self._tracked if self.score_attribute in t.metrics]
+        if not scored:
+            return max(self._tracked, key=lambda t: t.index)
+        key = lambda t: t.metrics[self.score_attribute]  # noqa: E731
+        return (max if self.score_order == "max" else min)(scored, key=key)
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
+        """Persist (if storage configured) and track; evicts beyond top-K."""
+        self._index += 1
+        if self.storage_dir:
+            dest = os.path.join(self.storage_dir, f"checkpoint_{self._index:06d}")
+            if os.path.abspath(checkpoint.path) != dest:
+                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+            checkpoint = Checkpoint(dest)
+        self._tracked.append(_Tracked(checkpoint, dict(metrics), self._index))
+        self._evict()
+        return checkpoint
+
+    def _evict(self) -> None:
+        if not self.num_to_keep or len(self._tracked) <= self.num_to_keep:
+            return
+        # never evict the best or the latest
+        keep_ids = set()
+        best = self._best_tracked()
+        if best:
+            keep_ids.add(id(best))
+        latest = max(self._tracked, key=lambda t: t.index)
+        keep_ids.add(id(latest))
+        candidates = sorted(
+            (t for t in self._tracked if id(t) not in keep_ids),
+            key=lambda t: t.index)
+        while len(self._tracked) > self.num_to_keep and candidates:
+            victim = candidates.pop(0)
+            self._tracked.remove(victim)
+            if self.storage_dir and victim.checkpoint.path.startswith(
+                    os.path.abspath(self.storage_dir)):
+                shutil.rmtree(victim.checkpoint.path, ignore_errors=True)

@@ -1,0 +1,207 @@
+"""Train controller: the explicit control loop driving a worker group.
+
+Parity: Train-v2 ``TrainController``
+(``python/ray/train/v2/_internal/execution/controller/controller.py:91`` —
+loop ``_run_control_loop_iteration :423``, step ``:332``): poll the group,
+collect reported (metrics, checkpoint) rows, consult the FailurePolicy on
+errors and the ScalingPolicy when (re)starting the group.  Recovery is
+checkpoint-restore with a fresh group — on TPU that is also how elastic
+resize works (the GSPMD mesh is re-formed by the new group).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import Result, RunConfig, ScalingConfig
+from ray_tpu.train.policies import (
+    DefaultFailurePolicy,
+    FailureDecision,
+    FailurePolicy,
+    FixedScalingPolicy,
+    ResizeDecision,
+    ScalingPolicy,
+    TrainRunContext,
+)
+from ray_tpu.train.worker_group import WorkerGroup, WorkerStatus
+
+logger = logging.getLogger(__name__)
+
+
+class TrainController:
+    def __init__(
+        self,
+        fn_payload: bytes,
+        train_loop_config: Dict[str, Any],
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        failure_policy: Optional[FailurePolicy] = None,
+        scaling_policy: Optional[ScalingPolicy] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        dist_env_fn: Optional[Callable[[WorkerGroup], Optional[List[Dict[str, str]]]]] = None,
+        poll_interval_s: float = 0.05,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.fn_payload = fn_payload
+        self.train_loop_config = dict(train_loop_config or {})
+        self.scaling_config = scaling_config
+        self.run_config = run_config
+        self.failure_policy = failure_policy or DefaultFailurePolicy(
+            run_config.failure_config.max_failures)
+        self.scaling_policy = scaling_policy or FixedScalingPolicy()
+        self.datasets = datasets or {}
+        self.dist_env_fn = dist_env_fn
+        self.poll_interval_s = poll_interval_s
+        self.name = run_config.name or f"train-{uuid.uuid4().hex[:8]}"
+
+        ckpt_cfg = run_config.checkpoint_config
+        storage = None
+        if run_config.storage_path:
+            import os
+
+            storage = os.path.join(run_config.storage_path, self.name)
+        self.checkpoint_manager = CheckpointManager(
+            storage_dir=storage,
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+        if resume_from_checkpoint is not None:
+            self.checkpoint_manager.register(resume_from_checkpoint, {})
+        self.metrics_history: List[Dict[str, Any]] = []
+        self._ctx = TrainRunContext()
+        # report-row bookkeeping: rows are aligned by per-rank *absolute*
+        # index within a group generation, not by poll-window position (a
+        # rank's row can straddle poll boundaries)
+        self._generation = 0
+        self._rank_row_counts: Dict[int, int] = {}
+        self._step_buffer: Dict[tuple, Dict[int, Dict[str, Any]]] = {}
+        self._emitted: Dict[tuple, Dict[str, Any]] = {}
+        self._ckpt_registered: set = set()
+
+    # -- group lifecycle ---------------------------------------------------
+    def _start_group(self) -> WorkerGroup:
+        decision = self.scaling_policy.make_decision_for_non_running_worker_group(
+            self.scaling_config)
+        sc = self.scaling_config
+        if isinstance(decision, ResizeDecision) and \
+                decision.num_workers != sc.num_workers:
+            import dataclasses
+
+            sc = dataclasses.replace(sc, num_workers=decision.num_workers)
+            logger.info("train %s: elastic resize to %d workers",
+                        self.name, sc.num_workers)
+        # Generation-scoped name: collective groups and report indices from
+        # a previous (possibly abruptly killed) group can never alias the
+        # new one's.
+        self._generation += 1
+        self._rank_row_counts = {}
+        group = WorkerGroup(sc, f"{self.name}/g{self._generation}")
+        group.start()
+
+        shards = self._split_datasets(sc.num_workers)
+        dist_env = (self.dist_env_fn(group) if self.dist_env_fn else None)
+        group.run_train_fn(
+            self.fn_payload, self.train_loop_config,
+            self.checkpoint_manager.latest, shards, dist_env)
+        return group
+
+    def _split_datasets(self, n: int) -> Optional[List[Any]]:
+        if not self.datasets:
+            return None
+        # one shard dict per rank; Dataset objects are streaming_split,
+        # plain iterables replicated
+        per_rank: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            splitter = getattr(ds, "streaming_split", None)
+            if callable(splitter):
+                parts = splitter(n, equal=True)
+                for r in range(n):
+                    per_rank[r][name] = parts[r]
+            else:
+                for r in range(n):
+                    per_rank[r][name] = ds
+        return per_rank
+
+    # -- control loop ------------------------------------------------------
+    def run(self) -> Result:
+        group = self._start_group()
+        error: Optional[BaseException] = None
+        try:
+            while True:
+                statuses = group.poll()
+                self._collect_results(statuses)
+
+                errs = [s for s in statuses if s.error]
+                if errs:
+                    self._ctx.errors_seen += 1
+                    first = errs[0].error
+                    decision = self.failure_policy.make_decision(self._ctx, first)
+                    if decision == FailureDecision.RETRY:
+                        logger.warning(
+                            "train %s: worker failure (%d so far), restarting "
+                            "from latest checkpoint:\n%s",
+                            self.name, self._ctx.errors_seen, first)
+                        group.shutdown()
+                        group = self._start_group()
+                        continue
+                    error = RuntimeError(
+                        f"training failed after {self._ctx.errors_seen} "
+                        f"failure(s):\n{first}")
+                    break
+
+                if all(s.finished for s in statuses):
+                    break
+                time.sleep(self.poll_interval_s)
+        finally:
+            group.shutdown()
+
+        return Result(
+            metrics=self.metrics_history[-1] if self.metrics_history else None,
+            checkpoint=self.checkpoint_manager.best,
+            path=self.checkpoint_manager.storage_dir,
+            error=error,
+            metrics_history=list(self.metrics_history),
+        )
+
+    def _collect_results(self, statuses: List[WorkerStatus]) -> None:
+        """Merge per-rank reports.
+
+        Rows are keyed (generation, per-rank absolute row index): rank r's
+        i-th ``report()`` call pairs with every other rank's i-th call no
+        matter how the rows split across poll windows.  Rank-0 metrics are
+        canonical; the first checkpoint seen for a step is registered
+        (rank 0 wins when it arrives in the same poll).
+        """
+        for s in statuses:
+            base = self._rank_row_counts.get(s.rank, 0)
+            for off, row in enumerate(s.results):
+                key = (self._generation, base + off)
+                self._step_buffer.setdefault(key, {})[s.rank] = row
+            self._rank_row_counts[s.rank] = base + len(s.results)
+
+        for key in sorted(self._step_buffer):
+            rows = self._step_buffer[key]
+            if key not in self._emitted:
+                if 0 not in rows:
+                    continue  # wait for the canonical rank
+                metrics = dict(rows[0]["metrics"])
+                metrics.setdefault("training_iteration",
+                                   len(self.metrics_history) + 1)
+                self.metrics_history.append(metrics)
+                self._emitted[key] = metrics
+            if key not in self._ckpt_registered:
+                for rank in sorted(rows):
+                    path = rows[rank].get("checkpoint_path")
+                    if path:
+                        self.checkpoint_manager.register(
+                            Checkpoint(path), self._emitted[key])
+                        self._ckpt_registered.add(key)
+                        break
+            if len(rows) == len(statuses) and key in self._emitted:
+                del self._step_buffer[key]

@@ -36,6 +36,8 @@ class _TrainSession:
         self.results: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        self.error_tb: Optional[str] = None
+        self.dataset_shard: Any = None
 
 
 def _start_session(**kw) -> _TrainSession:
@@ -101,3 +103,23 @@ class TrainContext:
 
 def get_context() -> TrainContext:
     return TrainContext()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's dataset shard (parity: ``ray.train.get_dataset_shard``).
+
+    Returns the shard the controller assigned via
+    ``DataParallelTrainer(datasets={name: ds})`` — a ``DataIterator`` for
+    ``ray_tpu.data`` datasets (``streaming_split`` per rank), or the value
+    itself for plain iterables (replicated).
+    """
+    s = _get_session()
+    shards = s.dataset_shard
+    if shards is None:
+        raise KeyError(
+            f"no datasets were passed to the trainer (requested {name!r})")
+    if isinstance(shards, dict):
+        if name not in shards:
+            raise KeyError(f"no dataset shard named {name!r}; have {list(shards)}")
+        return shards[name]
+    return shards

@@ -1,0 +1,262 @@
+"""Train worker group: N actors gang-scheduled on a placement group.
+
+Parity: Train-v2 worker group
+(``python/ray/train/v2/_internal/execution/worker_group/worker_group.py``)
+and v1 ``WorkerGroup`` (``python/ray/train/_internal/worker_group.py:102``).
+The controller polls workers for status instead of blocking on futures —
+that is what makes failure handling and elastic resize possible between
+control-loop steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class WorkerStatus:
+    """One worker's poll snapshot."""
+
+    rank: int
+    running: bool
+    finished: bool
+    error: Optional[str]
+    results: List[Dict[str, Any]]  # drained (metrics, checkpoint) rows
+    dead: bool = False  # actor unreachable
+
+
+class TrainWorker:
+    """Actor hosting one training process; runs the user loop in a thread.
+
+    TPU-first: each worker owns the chips its raylet isolated for it; the
+    jax process inside forms (or joins) the mesh.  On multi-host slices the
+    controller passes coordinator address/process ids so workers can call
+    ``jax.distributed.initialize`` (GSPMD mesh over the pod slice).
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._session = None
+
+    def get_metadata(self) -> Dict[str, Any]:
+        import os
+        import socket
+
+        from ray_tpu._private.net import local_ip
+
+        ctx = ray_tpu.get_runtime_context()
+        return {
+            "node_id": ctx.get_node_id(),
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "ip": local_ip(),
+        }
+
+    def find_free_port(self) -> int:
+        """A free port on THIS worker's host (for the rank-0 jax
+        coordinator — the bind happens in this process later, so this is
+        best-effort but races only with unrelated local processes)."""
+        from ray_tpu._private.net import free_port
+
+        return free_port()
+
+    def setup_distributed(self, env: Dict[str, str]) -> None:
+        """Install coordination env vars (before any jax import in the loop)."""
+        import os
+
+        os.environ.update(env)
+
+    def start_loop(
+        self,
+        fn_payload: bytes,
+        config: Dict[str, Any],
+        rank: int,
+        world_size: int,
+        group_name: str,
+        checkpoint_path: Optional[str],
+        dataset_shard: Any = None,
+    ) -> None:
+        from ray_tpu._private import serialization
+        from ray_tpu.train import session as session_mod
+
+        fn = serialization.loads(fn_payload)
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        sess = session_mod._start_session(
+            rank=rank,
+            world_size=world_size,
+            group_name=group_name,
+            config=config,
+            checkpoint=ckpt,
+        )
+        sess.dataset_shard = dataset_shard
+        self._session = sess
+
+        def _run():
+            try:
+                if _takes_config(fn):
+                    fn(config)
+                else:
+                    fn()
+            except BaseException as e:  # noqa: BLE001 — reported to controller
+                sess.error = e
+                sess.error_tb = traceback.format_exc()
+            finally:
+                sess.finished.set()
+
+        self._thread = threading.Thread(target=_run, daemon=True, name="train-loop")
+        self._thread.start()
+
+    def poll(self) -> Dict[str, Any]:
+        sess = self._session
+        if sess is None:
+            return {"running": False, "finished": False, "error": None, "results": []}
+        rows = []
+        while True:
+            try:
+                rows.append(sess.results.get_nowait())
+            except Exception:
+                break
+        # Checkpoints travel as paths (directories are node-local; the
+        # controller re-wraps them).
+        out_rows = []
+        for r in rows:
+            ck = r.get("checkpoint")
+            out_rows.append({
+                "metrics": r["metrics"],
+                "checkpoint_path": ck.path if ck is not None else None,
+            })
+        err = None
+        if sess.error is not None:
+            err = getattr(sess, "error_tb", None) or repr(sess.error)
+        return {
+            "running": self._thread is not None and self._thread.is_alive(),
+            "finished": sess.finished.is_set(),
+            "error": err,
+            "results": out_rows,
+        }
+
+    def shutdown(self) -> bool:
+        return True
+
+
+def _takes_config(fn: Callable) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    params = [p for p in sig.parameters.values()
+              if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    return len(params) >= 1
+
+
+class WorkerGroup:
+    """Lifecycle of the N train-worker actors + their placement group."""
+
+    def __init__(self, scaling_config, group_name: str):
+        self.scaling_config = scaling_config
+        self.group_name = group_name
+        self.workers: List[Any] = []
+        self.worker_metadata: List[Dict[str, Any]] = []
+        self.pg = None
+        self._started = False
+
+    def start(self) -> None:
+        from ray_tpu.util.placement_group import placement_group
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        sc = self.scaling_config
+        res = sc.worker_resources()
+        bundles = [dict(res) for _ in range(sc.num_workers)]
+        # Gang-reserve: one bundle per worker.  STRICT_PACK keeps a slice's
+        # workers on one ICI domain when a topology is requested; PACK
+        # otherwise (reference: BackendExecutor._create_placement_group,
+        # python/ray/train/_internal/backend_executor.py:230).
+        strategy = "STRICT_PACK" if sc.topology else "PACK"
+        self.pg = placement_group(bundles, strategy=strategy,
+                                  name=f"train-{self.group_name}")
+        if not self.pg.wait(timeout_seconds=60):
+            raise RuntimeError(
+                f"placement group for {self.group_name} not placed in 60s "
+                f"(bundles={bundles})")
+
+        worker_cls = ray_tpu.remote(TrainWorker)
+        self.workers = [
+            worker_cls.options(
+                num_cpus=0,
+                resources={k: v for k, v in res.items()},
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i),
+            ).remote()
+            for i in range(sc.num_workers)
+        ]
+        # barrier: all actors alive
+        self.worker_metadata = ray_tpu.get(
+            [w.get_metadata.remote() for w in self.workers], timeout=60)
+        self._started = True
+
+    def run_train_fn(
+        self,
+        fn_payload: bytes,
+        config: Dict[str, Any],
+        checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[List[Any]] = None,
+        dist_env: Optional[List[Dict[str, str]]] = None,
+    ) -> None:
+        n = len(self.workers)
+        if dist_env is not None:
+            ray_tpu.get([
+                w.setup_distributed.remote(dist_env[i])
+                for i, w in enumerate(self.workers)
+            ], timeout=30)
+        refs = []
+        for rank, w in enumerate(self.workers):
+            shard = dataset_shards[rank] if dataset_shards else None
+            refs.append(w.start_loop.remote(
+                fn_payload, config, rank, n, self.group_name,
+                checkpoint.path if checkpoint else None, shard,
+            ))
+        ray_tpu.get(refs, timeout=60)
+
+    def poll(self, timeout: float = 30.0) -> List[WorkerStatus]:
+        """Poll every worker; a dead actor yields ``dead=True`` status."""
+        statuses: List[WorkerStatus] = []
+        refs = [w.poll.remote() for w in self.workers]
+        for rank, ref in enumerate(refs):
+            try:
+                st = ray_tpu.get(ref, timeout=timeout)
+                statuses.append(WorkerStatus(
+                    rank=rank, running=st["running"], finished=st["finished"],
+                    error=st["error"], results=st["results"]))
+            except Exception as e:  # actor died / unreachable
+                statuses.append(WorkerStatus(
+                    rank=rank, running=False, finished=False,
+                    error=f"worker {rank} unreachable: {e!r}", results=[],
+                    dead=True))
+        return statuses
+
+    def shutdown(self) -> None:
+        from ray_tpu.util.placement_group import remove_placement_group
+
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None
+        self._started = False

@@ -153,9 +153,9 @@ def reducescatter(tensor, group_name: str = "default", op=ReduceOp.SUM):
 
 
 def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
-    return _group_mgr.get(group_name).send(tensor, dst_rank)
+    return _group_mgr.get(group_name).send(tensor, dst_rank, tag)
 
 
 def recv(shape=None, dtype=None, src_rank: int = 0,
          group_name: str = "default", tag: int = 0):
-    return _group_mgr.get(group_name).recv(shape, dtype, src_rank)
+    return _group_mgr.get(group_name).recv(shape, dtype, src_rank, tag)

@@ -49,7 +49,7 @@ class BaseGroup(abc.ABC):
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM): ...
 
     @abc.abstractmethod
-    def send(self, tensor, dst_rank: int) -> None: ...
+    def send(self, tensor, dst_rank: int, tag: int = 0) -> None: ...
 
     @abc.abstractmethod
-    def recv(self, shape, dtype, src_rank: int): ...
+    def recv(self, shape, dtype, src_rank: int, tag: int = 0): ...

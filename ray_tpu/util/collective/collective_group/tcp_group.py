@@ -70,9 +70,13 @@ class _LeaderServer:
         self.world_size = world_size
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("127.0.0.1", 0))
+        # Bind all interfaces and publish a routable IP so ranks on other
+        # hosts (DCN) can reach the leader.
+        self.sock.bind(("0.0.0.0", 0))
         self.sock.listen(world_size + 4)
-        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        from ray_tpu._private.net import local_ip
+
+        self.addr = f"{local_ip()}:{self.sock.getsockname()[1]}"
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[int, Dict[int, Dict]] = {}
@@ -291,3 +295,13 @@ class TcpGroup(BaseGroup):
             pass
         if self._server is not None:
             self._server.shutdown()
+            # drop the rendezvous key so a later group with the same name
+            # can't read this (now dead) leader's address
+            try:
+                from ray_tpu.experimental import internal_kv
+
+                internal_kv._internal_kv_del(
+                    f"collective/{self.group_name}/leader".encode(),
+                    namespace="collective")
+            except Exception:
+                pass

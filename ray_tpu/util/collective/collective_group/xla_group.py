@@ -80,7 +80,10 @@ class XlaMeshGroup(BaseGroup):
         op = ReduceOp(op)
         x = self._device_put_sharded(tensor)
         if op == ReduceOp.PRODUCT:
-            body = lambda t: jnp.exp(jax.lax.psum(jnp.log(t), "x"))
+            # no pprod primitive: all_gather then reduce locally (correct
+            # for zeros/negatives, unlike an exp-sum-log formulation)
+            body = lambda t: jnp.prod(
+                jax.lax.all_gather(t, "x", axis=0), axis=0)
         else:
             red = _JAX_REDUCE[op]
             body = lambda t: red(t, "x")
@@ -134,12 +137,12 @@ class XlaMeshGroup(BaseGroup):
 
         return _shard_map(local, self.mesh, (P("x"),), P("x"))(x)
 
-    def send(self, tensor, dst_rank: int) -> None:
+    def send(self, tensor, dst_rank: int, tag: int = 0) -> None:
         raise NotImplementedError(
             "point-to-point on the mesh group: use ppermute via permute()"
         )
 
-    def recv(self, shape=None, dtype=None, src_rank: int = 0):
+    def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
         raise NotImplementedError(
             "point-to-point on the mesh group: use ppermute via permute()"
         )
@@ -248,10 +251,10 @@ class XlaDistributedGroup(BaseGroup):
         chunk = out.shape[0] // self.world_size
         return out[self.rank * chunk:(self.rank + 1) * chunk]
 
-    def send(self, tensor, dst_rank: int) -> None:
+    def send(self, tensor, dst_rank: int, tag: int = 0) -> None:
         raise NotImplementedError("p2p over jax.distributed not supported")
 
-    def recv(self, shape=None, dtype=None, src_rank: int = 0):
+    def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
         raise NotImplementedError("p2p over jax.distributed not supported")
 
     def destroy_group(self) -> None:
