@@ -34,6 +34,14 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._invoke(self._method_name, args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node for this method call (reference
+        ``python/ray/dag/``; compiled via ``experimental_compile``)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs,
+                               options=self._options)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method {self._method_name!r} cannot be called directly; "

@@ -1,0 +1,20 @@
+"""ray_tpu.dag: lazy DAGs over actors/tasks + compiled execution.
+
+Parity: ``python/ray/dag/`` — ``DAGNode.experimental_compile``
+(``dag_node.py:265``) → ``CompiledDAG`` (``compiled_dag_node.py:805``).
+"""
+
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode", "InputNode", "InputAttributeNode", "ClassMethodNode",
+    "FunctionNode", "MultiOutputNode", "CompiledDAG", "CompiledDAGRef",
+]

@@ -1,0 +1,18 @@
+"""Compiled-graph channels (parity: ``python/ray/experimental/channel/``)."""
+
+from ray_tpu.experimental.channel.communicator import (
+    Communicator,
+    CpuCommunicator,
+    TpuCommunicator,
+)
+from ray_tpu.experimental.channel.shared_memory_channel import (
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+    CompositeChannel,
+)
+
+__all__ = [
+    "Channel", "ChannelClosedError", "ChannelTimeoutError",
+    "CompositeChannel", "Communicator", "CpuCommunicator", "TpuCommunicator",
+]

@@ -1,0 +1,193 @@
+"""Mutable shared-memory channels: the compiled-graph data plane.
+
+TPU-era equivalent of the reference's mutable plasma objects
+(``src/ray/core_worker/experimental_mutable_object_manager.h:44`` —
+WriteAcquire/WriteRelease/ReadAcquire/ReadRelease) and the Python
+``Channel``/``CompositeChannel`` wrappers
+(``python/ray/experimental/channel/shared_memory_channel.py:151,648``).
+
+One writer, N readers, single versioned buffer in POSIX shm:
+
+    [u64 version][u64 payload_len][u64 n_readers][u64 ack[r] ...][payload]
+
+Protocol (seqlock-flavored, no cross-process locks needed because there is
+exactly one writer and each reader owns its ack slot):
+
+- write(v): wait until every ack[r] == version (all readers consumed the
+  previous value), write payload, set version += 2 (even = stable).
+- read(r): wait until version > ack[r], copy payload out, set
+  ack[r] = version.
+
+Waits are bounded spin+sleep — channel latency is tens of microseconds,
+~1000x below the RPC task path, which is the whole point of compiled graphs.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+import uuid
+from multiprocessing import shared_memory
+from typing import Any, List, Optional
+
+_U64 = struct.Struct("<Q")
+_HDR = 24  # version, payload_len, n_readers
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class ChannelClosedError(RuntimeError):
+    pass
+
+
+_CLOSED = (1 << 64) - 1  # version sentinel: channel torn down
+
+# resource_tracker would unlink segments when *any* process exits; channel
+# lifetime is owned by the compiled DAG (same reasoning as the object store)
+from ray_tpu._private.object_store import _untrack  # noqa: E402
+
+
+class Channel:
+    """Handle to one shm channel; picklable (reconstructs by name)."""
+
+    def __init__(self, name: Optional[str] = None, *, buffer_size: int = 1 << 20,
+                 num_readers: int = 1, _create: bool = True):
+        self.name = name or f"rtpu_ch_{uuid.uuid4().hex[:16]}"
+        self.buffer_size = buffer_size
+        self.num_readers = num_readers
+        self._reader_slot: Optional[int] = None
+        total = _HDR + 8 * num_readers + buffer_size
+        if _create:
+            self._seg = shared_memory.SharedMemory(
+                name=self.name, create=True, size=total)
+            _untrack(self._seg)
+            self._seg.buf[:_HDR + 8 * num_readers] = b"\x00" * (
+                _HDR + 8 * num_readers)
+            _U64.pack_into(self._seg.buf, 16, num_readers)
+        else:
+            self._seg = shared_memory.SharedMemory(name=self.name)
+            _untrack(self._seg)
+
+    # -- pickling ----------------------------------------------------------
+    def __reduce__(self):
+        return (_attach_channel, (self.name, self.buffer_size,
+                                  self.num_readers, self._reader_slot))
+
+    # -- low-level header access ------------------------------------------
+    def _version(self) -> int:
+        return _U64.unpack_from(self._seg.buf, 0)[0]
+
+    def _ack(self, slot: int) -> int:
+        return _U64.unpack_from(self._seg.buf, _HDR + 8 * slot)[0]
+
+    def _set_ack(self, slot: int, v: int) -> None:
+        _U64.pack_into(self._seg.buf, _HDR + 8 * slot, v)
+
+    def _wait(self, pred, timeout: Optional[float], what: str):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while not pred():
+            if self._version() == _CLOSED:
+                raise ChannelClosedError(f"channel {self.name} closed")
+            spins += 1
+            if spins < 200:
+                continue  # hot spin ~ tens of µs
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(
+                    f"channel {self.name}: timeout waiting for {what}")
+            time.sleep(0.0001)
+
+    # -- data plane --------------------------------------------------------
+    def write_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        if len(payload) > self.buffer_size:
+            raise ValueError(
+                f"payload of {len(payload)}B exceeds channel buffer "
+                f"{self.buffer_size}B (set buffer_size at compile time)")
+        v = self._version()
+        if v == _CLOSED:
+            raise ChannelClosedError(f"channel {self.name} closed")
+        self._wait(
+            lambda: all(self._ack(r) >= v for r in range(self.num_readers)),
+            timeout, "readers to consume previous value")
+        base = _HDR + 8 * self.num_readers
+        self._seg.buf[base:base + len(payload)] = payload
+        _U64.pack_into(self._seg.buf, 8, len(payload))
+        _U64.pack_into(self._seg.buf, 0, v + 2)
+
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        slot = self._reader_slot or 0
+        last = self._ack(slot)
+        self._wait(lambda: self._version() > last, timeout, "a new value")
+        v = self._version()
+        if v == _CLOSED:
+            raise ChannelClosedError(f"channel {self.name} closed")
+        n = _U64.unpack_from(self._seg.buf, 8)[0]
+        base = _HDR + 8 * self.num_readers
+        out = bytes(self._seg.buf[base:base + n])
+        self._set_ack(slot, v)
+        return out
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        from ray_tpu._private import serialization
+
+        self.write_bytes(serialization.dumps(value), timeout)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu._private import serialization
+
+        return serialization.loads(self.read_bytes(timeout))
+
+    # -- lifecycle ---------------------------------------------------------
+    def set_reader_slot(self, slot: int) -> "Channel":
+        if not (0 <= slot < self.num_readers):
+            raise ValueError(f"reader slot {slot} out of range")
+        self._reader_slot = slot
+        return self
+
+    def close(self) -> None:
+        try:
+            _U64.pack_into(self._seg.buf, 0, _CLOSED)
+        except Exception:
+            pass
+
+    def destroy(self) -> None:
+        self.close()
+        try:
+            self._seg.close()
+            self._seg.unlink()
+        except Exception:
+            pass
+
+    def detach(self) -> None:
+        try:
+            self._seg.close()
+        except Exception:
+            pass
+
+
+def _attach_channel(name: str, buffer_size: int, num_readers: int,
+                    reader_slot: Optional[int]) -> Channel:
+    ch = Channel(name, buffer_size=buffer_size, num_readers=num_readers,
+                 _create=False)
+    ch._reader_slot = reader_slot
+    return ch
+
+
+class CompositeChannel:
+    """Fan-in of several channels read as a tuple (one per upstream edge).
+
+    Parity: ``CompositeChannel``
+    (``python/ray/experimental/channel/shared_memory_channel.py:648``).
+    """
+
+    def __init__(self, channels: List[Channel]):
+        self.channels = channels
+
+    def read(self, timeout: Optional[float] = None) -> tuple:
+        return tuple(c.read(timeout) for c in self.channels)
+
+    def close(self) -> None:
+        for c in self.channels:
+            c.close()

@@ -36,6 +36,12 @@ class RemoteFunction:
         functools.update_wrapper(rf, self._function)
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Build a (classic, interpreted) DAG node for this task."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from ray_tpu._private.config import config
         from ray_tpu._private.worker import get_global_worker

@@ -1,0 +1,236 @@
+"""Compiled-graph tests (parity model: python/ray/dag tests with the
+CPU-communicator trick — channels + exec loops validated without TPUs)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import (
+    Channel,
+    ChannelClosedError,
+)
+
+pytestmark = pytest.mark.usefixtures("ray_start")
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def add2(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise ValueError("kapow")
+
+    def get_calls(self):
+        return self.calls
+
+
+class TestChannel:
+    def test_roundtrip_and_versioning(self):
+        ch = Channel(buffer_size=1 << 16, num_readers=1)
+        reader = Channel(ch.name, buffer_size=1 << 16, num_readers=1,
+                         _create=False).set_reader_slot(0)
+        ch.write({"a": np.arange(4)})
+        out = reader.read()
+        assert list(out["a"]) == [0, 1, 2, 3]
+        ch.write(2)
+        assert reader.read() == 2
+        ch.destroy()
+
+    def test_write_blocks_until_consumed(self):
+        ch = Channel(buffer_size=1 << 12, num_readers=1)
+        ch.write(1)
+        with pytest.raises(TimeoutError):
+            ch.write(2, timeout=0.2)
+        ch.destroy()
+
+    def test_closed_channel_raises(self):
+        ch = Channel(buffer_size=1 << 12, num_readers=1)
+        ch.close()
+        with pytest.raises(ChannelClosedError):
+            ch.read(timeout=1)
+        ch.destroy()
+
+    def test_oversize_payload_rejected(self):
+        ch = Channel(buffer_size=64, num_readers=1)
+        with pytest.raises(ValueError):
+            ch.write_bytes(b"x" * 100)
+        ch.destroy()
+
+
+class TestInterpretedDag:
+    def test_function_and_method_nodes(self):
+        @ray_tpu.remote
+        def double(x):
+            return 2 * x
+
+        a = Adder.remote(10)
+        with InputNode() as inp:
+            dag = double.bind(a.add.bind(inp))
+        ref = dag.execute(5)
+        assert ray_tpu.get(ref) == 30
+
+    def test_multi_output(self):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+        refs = dag.execute(10)
+        assert ray_tpu.get(refs) == [11, 12]
+
+
+class TestCompiledDag:
+    def test_linear_pipeline(self):
+        a = Adder.remote(1)
+        b = Adder.remote(10)
+        with InputNode() as inp:
+            dag = b.add.bind(a.add.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            for i in range(5):
+                ref = compiled.execute(i)
+                assert ref.get(timeout=10) == i + 11
+        finally:
+            compiled.teardown()
+
+    def test_fan_out_fan_in(self):
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        c = Adder.remote(0)
+        with InputNode() as inp:
+            dag = c.add2.bind(a.add.bind(inp), b.add.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(10).get(timeout=10) == 23
+            assert compiled.execute(0).get(timeout=10) == 3
+        finally:
+            compiled.teardown()
+
+    def test_multi_output_compiled(self):
+        a = Adder.remote(5)
+        b = Adder.remote(7)
+        with InputNode() as inp:
+            dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+        compiled = dag.experimental_compile()
+        try:
+            out = compiled.execute(1).get(timeout=10)
+            assert out == [6, 8]
+        finally:
+            compiled.teardown()
+
+    def test_input_attributes(self):
+        a = Adder.remote(0)
+        with InputNode() as inp:
+            dag = a.add2.bind(inp[0], inp.y)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(3, y=4).get(timeout=10) == 7
+        finally:
+            compiled.teardown()
+
+    def test_same_actor_chain_short_circuits(self):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(a.add.bind(a.add.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(0).get(timeout=10) == 3
+        finally:
+            compiled.teardown()
+        assert ray_tpu.get(a.get_calls.remote()) == 3
+
+    def test_error_propagation(self):
+        a = Adder.remote(1)
+        b = Adder.remote(1)
+        with InputNode() as inp:
+            dag = b.add.bind(a.boom.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            ref = compiled.execute(1)
+            with pytest.raises(Exception, match="kapow"):
+                ref.get(timeout=10)
+            # DAG still usable after an application error
+            ref2 = compiled.execute(2)
+            with pytest.raises(Exception, match="kapow"):
+                ref2.get(timeout=10)
+        finally:
+            compiled.teardown()
+
+    def test_numpy_payload_throughput(self):
+        a = Adder.remote(0.0)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile(buffer_size_bytes=1 << 22)
+        try:
+            x = np.ones((256, 256), np.float32)
+            out = compiled.execute(x).get(timeout=10)
+            np.testing.assert_allclose(out, x)
+        finally:
+            compiled.teardown()
+
+    def test_get_out_of_order_rejected(self):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            r1 = compiled.execute(1)
+            r2 = compiled.execute(2)
+            with pytest.raises(ValueError, match="submission order"):
+                r2.get(timeout=5)
+            assert r1.get(timeout=10) == 2
+            assert r2.get(timeout=10) == 3
+        finally:
+            compiled.teardown()
+
+    def test_actor_reusable_after_teardown(self):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        assert compiled.execute(1).get(timeout=10) == 2
+        compiled.teardown()
+        assert ray_tpu.get(a.add.remote(5)) == 6
+
+    def test_actor_revisit_a_b_a(self):
+        """A -> B -> A: lazy channel reads must not deadlock."""
+        a = Adder.remote(1)
+        b = Adder.remote(10)
+        with InputNode() as inp:
+            dag = a.add.bind(b.add.bind(a.add.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(0).get(timeout=15) == 12
+            assert compiled.execute(5).get(timeout=15) == 17
+        finally:
+            compiled.teardown()
+
+    def test_teardown_with_ungotten_result_is_fast(self):
+        import time
+
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        compiled.execute(1)  # never gotten
+        t0 = time.monotonic()
+        compiled.teardown(timeout=10)
+        assert time.monotonic() - t0 < 5
+
+    def test_compile_rejects_input_independent_task(self):
+        a = Adder.remote(1)
+        b = Adder.remote(1)
+        with InputNode() as inp:
+            free = a.get_calls.bind()
+            dag = b.add2.bind(inp, free)
+        with pytest.raises(ValueError, match="depend"):
+            dag.experimental_compile()
