@@ -261,7 +261,9 @@ class CoreWorker:
         # 2. known location / pending local future
         loc = self._locations.get(oid)
         if loc is None and oid in self._result_futures:
-            loc = await self._result_futures[oid]
+            # shield: a cancelled waiter (e.g. wait() timeout) must not cancel
+            # the shared per-object future other getters await
+            loc = await asyncio.shield(self._result_futures[oid])
         if loc is None:
             # 3. fetch from owner
             if not ref.owner_addr or ref.owner_addr == self.serve_addr:

@@ -1,0 +1,92 @@
+"""ray_tpu.data: streaming distributed datasets (reference: ``python/ray/data/``).
+
+Read API parity target: ``python/ray/data/read_api.py`` (``range``,
+``from_items``, ``read_parquet`` etc.); Dataset API: ``dataset.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data import logical as L
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import BlockMetadata, batch_to_block
+from ray_tpu.data.context import DataContext, ExecutionOptions, ExecutionResources
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.operators import ActorPoolStrategy
+from ray_tpu.data import datasource as DS
+
+__all__ = [
+    "ActorPoolStrategy", "AggregateFn", "Count", "DataContext", "DataIterator",
+    "Dataset", "ExecutionOptions", "ExecutionResources", "GroupedData",
+    "MaterializedDataset", "Max", "Mean", "Min", "Std", "Sum",
+    "from_arrow", "from_blocks", "from_items", "from_numpy", "from_pandas",
+    "range", "read_binary_files", "read_csv", "read_datasource", "read_json",
+    "read_numpy", "read_parquet", "read_text",
+]
+
+
+def read_datasource(ds: DS.Datasource, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.LogicalPlan(L.Read(ds, parallelism)))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(DS.RangeDatasource(n), parallelism=parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    return from_blocks([batch_to_block({column: np.asarray(arr)})])
+
+
+def from_arrow(tables) -> Dataset:
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return from_blocks(list(tables))
+
+
+def from_pandas(dfs) -> Dataset:
+    import pandas as pd
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    return from_blocks([pa.Table.from_pandas(df, preserve_index=False)
+                        for df in dfs])
+
+
+def from_blocks(blocks: List[pa.Table]) -> Dataset:
+    return read_datasource(DS.BlocksDatasource(blocks),
+                           parallelism=len(blocks) or 1)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.ParquetDatasource(paths, columns=columns),
+                           parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.TextDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.NumpyDatasource(paths), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(DS.BinaryDatasource(paths), parallelism=parallelism)

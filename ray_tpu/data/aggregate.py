@@ -1,0 +1,54 @@
+"""Aggregation functions for Dataset.aggregate / GroupedData.
+
+Reference: ``python/ray/data/aggregate.py`` (AggregateFn, Count, Sum, Min,
+Max, Mean, Std, AbsMax).  Implemented as (column, arrow_compute_fn,
+output_name) specs executed by ``transforms.aggregate_partition`` with
+``pyarrow.Table.group_by``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+class AggregateFn:
+    arrow_fn: str = ""
+
+    def __init__(self, on: Optional[str] = None, alias_name: Optional[str] = None):
+        self.on = on
+        self.name = alias_name or (
+            f"{self.display}({on})" if on else f"{self.display}()")
+
+    @property
+    def display(self) -> str:
+        return type(self).__name__.lower()
+
+    def to_spec(self) -> Tuple[str, str, str]:
+        return (self.on or "", self.arrow_fn, self.name)
+
+
+class Count(AggregateFn):
+    arrow_fn = "count"
+
+    def to_spec(self):
+        return (self.on or "", "count", self.name)
+
+
+class Sum(AggregateFn):
+    arrow_fn = "sum"
+
+
+class Min(AggregateFn):
+    arrow_fn = "min"
+
+
+class Max(AggregateFn):
+    arrow_fn = "max"
+
+
+class Mean(AggregateFn):
+    arrow_fn = "mean"
+
+
+class Std(AggregateFn):
+    arrow_fn = "stddev"

@@ -1,0 +1,231 @@
+"""Block representation for ray_tpu.data.
+
+A *block* is the unit of data movement and parallelism: a horizontal slice of
+a dataset, stored as one object in the shared-memory object store and
+processed by one task.  Reference: ``python/ray/data/block.py`` (Block =
+``pyarrow.Table``; ``BlockAccessor`` ABC) — here blocks are always Arrow
+tables, which serialize zero-copy through the shm store and convert to
+numpy/jax without copies for primitive types.
+
+``BlockMetadata`` travels out-of-band (in the task reply, not the store), so
+the streaming executor can make scheduling decisions without fetching data.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+# A batch handed to user fns in map_batches: dict of column -> numpy array
+# ("numpy", the default), pandas DataFrame, or pyarrow Table.
+Batch = Union[Dict[str, np.ndarray], "pa.Table", Any]
+
+TENSOR_COL_MARKER = b"__ray_tpu_tensor_shape__"
+
+
+@dataclass
+class BlockMetadata:
+    """Out-of-band stats for one block (reference ``block.py:BlockMetadata``)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: List[str] = field(default_factory=list)
+    exec_stats: Optional[Dict[str, float]] = None
+
+    @staticmethod
+    def for_block(block: pa.Table, input_files: Optional[List[str]] = None,
+                  start_time: Optional[float] = None) -> "BlockMetadata":
+        stats = None
+        if start_time is not None:
+            stats = {"wall_s": time.perf_counter() - start_time}
+        return BlockMetadata(
+            num_rows=block.num_rows,
+            size_bytes=block.nbytes,
+            schema=block.schema,
+            input_files=list(input_files or []),
+            exec_stats=stats,
+        )
+
+
+def _tensor_to_arrow(col: np.ndarray) -> pa.Array:
+    """Store an ndim>1 numpy column as a FixedSizeListArray with shape metadata."""
+    flat = np.ascontiguousarray(col).reshape(len(col), -1)
+    values = pa.array(flat.reshape(-1))
+    return pa.FixedSizeListArray.from_arrays(values, flat.shape[1])
+
+
+def batch_to_block(batch: Batch) -> pa.Table:
+    """Convert a user-returned batch into an Arrow table block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, pa.RecordBatch):
+        return pa.Table.from_batches([batch])
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(batch, dict):
+        cols, names, shapes = [], [], {}
+        for name, col in batch.items():
+            col = np.asarray(col) if not isinstance(col, np.ndarray) else col
+            if col.ndim > 1:
+                cols.append(_tensor_to_arrow(col))
+                shapes[name] = col.shape[1:]
+            else:
+                # object-dtype columns (strings etc.) go through pa.array
+                cols.append(pa.array(col.tolist() if col.dtype == object else col))
+            names.append(name)
+        tbl = pa.table(cols, names=names)
+        if shapes:
+            meta = dict(tbl.schema.metadata or {})
+            meta[TENSOR_COL_MARKER] = repr(
+                {k: tuple(v) for k, v in shapes.items()}
+            ).encode()
+            tbl = tbl.replace_schema_metadata(meta)
+        return tbl
+    raise TypeError(
+        f"Batch must be dict[str, np.ndarray], pandas.DataFrame, or "
+        f"pyarrow.Table; got {type(batch)}"
+    )
+
+
+def _tensor_shapes(block: pa.Table) -> Dict[str, tuple]:
+    meta = block.schema.metadata or {}
+    raw = meta.get(TENSOR_COL_MARKER)
+    return eval(raw.decode()) if raw else {}  # noqa: S307 - our own repr
+
+
+def rows_to_block(rows: List[Dict[str, Any]]) -> pa.Table:
+    """Build a block from a list of row dicts (wrapping plain items as {'item'})."""
+    norm = [r if isinstance(r, dict) else {"item": r} for r in rows]
+    if not norm:
+        return pa.table({})
+    return pa.Table.from_pylist(norm)
+
+
+def concat_blocks(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b is not None and b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    if len(blocks) == 1:
+        return blocks[0]
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+class BlockAccessor:
+    """Uniform view over a block (reference ``BlockAccessor`` ABC; here Arrow-only)."""
+
+    def __init__(self, block: pa.Table):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: pa.Table) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self._block.num_rows
+
+    def size_bytes(self) -> int:
+        return self._block.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._block.schema
+
+    def to_arrow(self) -> pa.Table:
+        return self._block
+
+    def to_pandas(self):
+        return self._block.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        cols = columns or self._block.column_names
+        shapes = _tensor_shapes(self._block)
+        out: Dict[str, np.ndarray] = {}
+        for name in cols:
+            arr = self._block.column(name)
+            if name in shapes:
+                flat = arr.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape((self._block.num_rows,) + shapes[name])
+            else:
+                out[name] = arr.to_numpy(zero_copy_only=False)
+        return out
+
+    def to_batch(self, batch_format: str = "numpy") -> Batch:
+        if batch_format in ("numpy", "default"):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._block
+        raise ValueError(f"Unknown batch_format: {batch_format!r}")
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        shapes = _tensor_shapes(self._block)
+        if shapes:
+            cols = self.to_numpy()
+            for i in range(self._block.num_rows):
+                yield {k: v[i] for k, v in cols.items()}
+        else:
+            for row in self._block.to_pylist():
+                yield row
+
+    def slice(self, start: int, end: int) -> pa.Table:
+        return self._block.slice(start, end - start)
+
+    def take_rows(self, indices: np.ndarray) -> pa.Table:
+        return self._block.take(pa.array(indices))
+
+    def select(self, columns: List[str]) -> pa.Table:
+        return self._block.select(columns)
+
+    def sample(self, n: int, seed: Optional[int] = None) -> pa.Table:
+        rng = np.random.default_rng(seed)
+        n = min(n, self._block.num_rows)
+        idx = rng.choice(self._block.num_rows, size=n, replace=False)
+        return self.take_rows(idx)
+
+
+class BlockBuilder:
+    """Accumulate rows/batches/blocks up to a target size, then yield blocks."""
+
+    def __init__(self, target_max_block_size: Optional[int] = None):
+        self._rows: List[Dict[str, Any]] = []
+        self._tables: List[pa.Table] = []
+        self._approx_bytes = 0
+        self._target = target_max_block_size
+
+    def add_row(self, row: Dict[str, Any]):
+        self._rows.append(row if isinstance(row, dict) else {"item": row})
+        self._approx_bytes += 64  # cheap estimate; refined on build
+
+    def add_batch(self, batch: Batch):
+        self.add_block(batch_to_block(batch))
+
+    def add_block(self, block: pa.Table):
+        if block.num_rows:
+            self._tables.append(block)
+            self._approx_bytes += block.nbytes
+
+    def num_rows(self) -> int:
+        return len(self._rows) + sum(t.num_rows for t in self._tables)
+
+    def current_size_bytes(self) -> int:
+        return self._approx_bytes
+
+    def should_flush(self) -> bool:
+        return self._target is not None and self._approx_bytes >= self._target
+
+    def build(self) -> pa.Table:
+        tables = list(self._tables)
+        if self._rows:
+            tables.append(rows_to_block(self._rows))
+        self._rows, self._tables, self._approx_bytes = [], [], 0
+        return concat_blocks(tables)

@@ -1,0 +1,61 @@
+"""DataContext: per-driver execution configuration for ray_tpu.data.
+
+Reference: ``python/ray/data/context.py`` (``DataContext.get_current``) and
+``ExecutionOptions``/``ExecutionResources`` in
+``python/ray/data/_internal/execution/interfaces/execution_options.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ExecutionResources:
+    """Resource budget for a streaming execution (None = unlimited)."""
+
+    cpu: Optional[float] = None
+    tpu: Optional[float] = None
+    object_store_memory: Optional[float] = None
+
+
+@dataclass
+class ExecutionOptions:
+    resource_limits: ExecutionResources = field(default_factory=ExecutionResources)
+    # Unlike the reference (default False), block order is preserved by
+    # default so take()/iteration are deterministic; disable for max overlap.
+    preserve_order: bool = True
+    verbose_progress: bool = False
+
+
+@dataclass
+class DataContext:
+    """Global knobs, mirroring the reference's DataContext defaults."""
+
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    read_op_min_num_blocks: int = 8
+    # Streaming executor backpressure: max in-flight task outputs queued per
+    # operator before we stop dispatching new tasks for it.
+    max_tasks_in_flight_per_op: int = 16
+    # Per-op max queued output bytes before upstream dispatch pauses
+    # (StreamingOutputBackpressurePolicy equivalent).
+    max_op_output_queue_bytes: int = 512 * 1024 * 1024
+    # Fuse compatible map operators into one task (operator fusion rule).
+    enable_operator_fusion: bool = True
+    execution_options: ExecutionOptions = field(default_factory=ExecutionOptions)
+    # iter_batches defaults
+    default_batch_format: str = "numpy"
+    prefetch_batches: int = 2
+
+    _current: "DataContext" = None  # class-level singleton
+    _lock = threading.Lock()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        with DataContext._lock:
+            if DataContext._current is None:
+                DataContext._current = DataContext()
+            return DataContext._current

@@ -1,0 +1,427 @@
+"""Dataset: the lazy, streaming distributed dataset API.
+
+Reference: ``python/ray/data/dataset.py`` (6.2k LoC facade) — transforms
+build a ``LogicalPlan``; actions/iteration plan it (with operator fusion),
+execute on the streaming executor, and stream ``RefBundle``s back.
+
+TPU-first notes: blocks are Arrow tables in the shared-memory object store;
+``iter_jax_batches``/``to_jax`` stage into HBM via ``jax.device_put`` (see
+``iterator.py``); ``streaming_split`` feeds JaxTrainer workers.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue as queuelib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import BlockAccessor, BlockMetadata, concat_blocks
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.operators import ActorPoolStrategy, RefBundle
+from ray_tpu.data.planner import plan as plan_physical
+from ray_tpu.data.streaming_executor import (
+    StreamingExecutor,
+    execute_streaming_split,
+)
+from ray_tpu.data import transforms as T
+
+
+@ray_tpu.remote
+def _write_block(block: pa.Table, path: str, file_format: str) -> str:
+    from ray_tpu.data.datasource import write_block_file
+
+    write_block_file(block, path, file_format)
+    return path
+
+
+class Dataset:
+    def __init__(self, plan: L.LogicalPlan):
+        self._plan = plan
+
+    # -- plan-building transforms (lazy) --------------------------------------
+
+    def _with(self, op_cls, *args, **kwargs) -> "Dataset":
+        return Dataset(L.LogicalPlan(op_cls(self._plan.dag, *args, **kwargs)))
+
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy", compute: Optional[ActorPoolStrategy] = None,
+                    fn_args: tuple = (), fn_kwargs: Optional[dict] = None,
+                    num_cpus: Optional[float] = None, num_tpus: float = 0,
+                    concurrency: Optional[int] = None) -> "Dataset":
+        if concurrency is not None and compute is None and isinstance(fn, type):
+            compute = ActorPoolStrategy(size=concurrency)
+        return self._with(L.MapBatches, fn, batch_size=batch_size,
+                          batch_format=batch_format, compute=compute,
+                          fn_args=fn_args, fn_kwargs=fn_kwargs,
+                          num_cpus=num_cpus, num_tpus=num_tpus)
+
+    def map(self, fn, **kw) -> "Dataset":
+        return self._with(L.MapRows, fn, **kw)
+
+    def flat_map(self, fn, **kw) -> "Dataset":
+        return self._with(L.FlatMap, fn, **kw)
+
+    def filter(self, fn, **kw) -> "Dataset":
+        return self._with(L.Filter, fn, **kw)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(lambda b: {c: b[c] for c in cols})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {c: v for c, v in b.items() if c not in drop})
+
+    def add_column(self, name: str, fn: Callable[[Dict[str, np.ndarray]], np.ndarray]
+                   ) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(add)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(c, c): v for c, v in b.items()})
+
+    def repartition(self, num_blocks: int, *, shuffle: bool = False) -> "Dataset":
+        return self._with(L.Repartition, num_blocks, shuffle)
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        return self._with(L.RandomShuffle, seed, num_blocks)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(L.RandomizeBlocks, seed)
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        return self._with(L.Sort, key, descending)
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit, n)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(L.LogicalPlan(
+            L.Union(self._plan.dag, *[o._plan.dag for o in others])))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return Dataset(L.LogicalPlan(L.Zip(self._plan.dag, other._plan.dag)))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        ds = self._with(L.Aggregate, None, list(aggs))
+        rows = ds.take_all()
+        return rows[0] if rows else {}
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        mat = ds.materialize()
+        n = mat.count()
+        n_test = int(n * test_size)
+        return mat.split_at_indices([n - n_test])
+
+    # -- execution ------------------------------------------------------------
+
+    def _execute(self) -> Iterator[RefBundle]:
+        optimized = L.optimize(self._plan)
+        sink = plan_physical(optimized.dag)
+        return StreamingExecutor(sink).run()
+
+    def explain(self) -> str:
+        optimized = L.optimize(self._plan)
+        return optimized.explain()
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = list(self._execute())
+        return MaterializedDataset(bundles)
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self._execute, owner=self)
+
+    # -- consumption ----------------------------------------------------------
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_rows()
+
+    def iter_jax_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_jax_batches(**kw)
+
+    def iter_torch_batches(self, **kw) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_torch_batches(**kw)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def take_batch(self, batch_size: int = 20, *, batch_format: str = "numpy"):
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=batch_format):
+            return batch
+        return {}
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        total = 0
+        for bundle in self._execute():
+            total += bundle.num_rows()
+        return total
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def schema(self) -> Optional[pa.Schema]:
+        for bundle in self.limit(1)._execute():
+            for ref, meta in bundle.blocks:
+                if meta.schema is not None and len(meta.schema.names):
+                    return meta.schema
+                block = ray_tpu.get(ref)
+                return block.schema
+        return None
+
+    def num_blocks(self) -> int:
+        return sum(len(b.blocks) for b in self._execute())
+
+    def size_bytes(self) -> int:
+        return sum(b.size_bytes() for b in self._execute())
+
+    def unique(self, column: str) -> List[Any]:
+        seen = set()
+        for batch in self.select_columns([column]).iter_batches(
+                batch_format="pyarrow", batch_size=None):
+            seen.update(batch.column(column).to_pylist())
+        return sorted(seen, key=repr)
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        return self.aggregate(Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        return self.aggregate(Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on))[f"mean({on})"]
+
+    def std(self, on: str):
+        return self.aggregate(Std(on))[f"std({on})"]
+
+    # -- conversion -----------------------------------------------------------
+
+    def to_pandas(self):
+        return concat_blocks(self._all_blocks()).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return concat_blocks(self._all_blocks())
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        return BlockAccessor(self.to_arrow()).to_numpy()
+
+    def to_jax(self, *, sharding=None) -> Dict[str, Any]:
+        """Whole dataset as jax arrays in HBM (small datasets only)."""
+        import jax
+
+        cols = self.to_numpy()
+        return {k: (jax.device_put(v, sharding) if sharding is not None
+                    else jax.device_put(v)) for k, v in cols.items()}
+
+    def _all_blocks(self) -> List[pa.Table]:
+        return [ray_tpu.get(ref) for bundle in self._execute()
+                for ref, _ in bundle.blocks]
+
+    # -- splits ---------------------------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        blocks = [b for bundle in mat._bundles for b in bundle.blocks]
+        if equal:
+            total = sum(m.num_rows for _, m in blocks)
+            per = total // n
+            return self.split_at_indices([per * i for i in range(1, n)])
+        groups: List[List] = [[] for _ in range(n)]
+        rows = [0] * n
+        for ref, meta in blocks:
+            i = int(np.argmin(rows))
+            groups[i].append((ref, meta))
+            rows[i] += meta.num_rows
+        return [MaterializedDataset([RefBundle(g)] if g else [])
+                for g in groups]
+
+    def split_at_indices(self, indices: List[int]) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        blocks = [b for bundle in mat._bundles for b in bundle.blocks]
+        bounds = list(indices) + [sum(m.num_rows for _, m in blocks)]
+        out: List[MaterializedDataset] = []
+        pos = 0
+        bi = 0
+        cur: List = []
+        for ref, meta in blocks:
+            off = 0
+            while off < meta.num_rows:
+                end = bounds[bi] if bi < len(bounds) else pos + (meta.num_rows - off)
+                take = min(meta.num_rows - off, max(0, end - pos))
+                if take == 0:
+                    out.append(MaterializedDataset([RefBundle(cur)] if cur else []))
+                    cur = []
+                    bi += 1
+                    continue
+                if take == meta.num_rows and off == 0:
+                    cur.append((ref, meta))
+                else:
+                    refs, metas = ray_tpu.get(
+                        T.slice_block.remote(ref, off, off + take))
+                    cur.append((refs[0], metas[0]))
+                off += take
+                pos += take
+        out.append(MaterializedDataset([RefBundle(cur)] if cur else []))
+        while len(out) < len(bounds):
+            out.append(MaterializedDataset([]))
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[DataIterator]:
+        """n single-pass iterators consuming a shared streaming execution
+        (reference: ``Dataset.streaming_split`` feeding Train workers)."""
+        optimized = L.optimize(self._plan)
+        sink = plan_physical(optimized.dag)
+        queues = execute_streaming_split(sink, n, equal)
+
+        def make_source(q: "queuelib.Queue"):
+            def source():
+                while True:
+                    item = q.get()
+                    if item.__class__ is not RefBundle:
+                        break
+                    yield item
+
+            return source
+
+        return [DataIterator(make_source(q), owner=self) for q in queues]
+
+    # -- writes ---------------------------------------------------------------
+
+    def _write(self, path: str, file_format: str) -> List[str]:
+        os.makedirs(path, exist_ok=True)
+        refs = []
+        i = 0
+        for bundle in self._execute():
+            for ref, _meta in bundle.blocks:
+                fname = os.path.join(path, f"part-{i:05d}.{file_format}")
+                refs.append(_write_block.remote(ref, fname, file_format))
+                i += 1
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(path, "json")
+
+    def stats(self) -> str:
+        return self.explain()
+
+    def __repr__(self):
+        return f"Dataset({self._plan.dag.name})"
+
+
+class MaterializedDataset(Dataset):
+    """A Dataset whose blocks are already in the object store."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__(L.LogicalPlan(L.InputData(bundles)))
+        self._bundles = bundles
+
+    def _execute(self) -> Iterator[RefBundle]:
+        if isinstance(self._plan.dag, L.InputData):
+            return iter(self._bundles)
+        return super()._execute()
+
+    def materialize(self) -> "MaterializedDataset":
+        return self
+
+    def count(self) -> int:
+        return sum(b.num_rows() for b in self._bundles)
+
+    def num_blocks(self) -> int:
+        return sum(len(b.blocks) for b in self._bundles)
+
+
+class GroupedData:
+    """Reference: ``python/ray/data/grouped_data.py``."""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return self._ds._with(L.Aggregate, self._key, list(aggs))
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count(self._key, alias_name="count()"))
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn, *, batch_format: str = "numpy") -> Dataset:
+        """Apply fn to each group (implemented as sort + per-block scan)."""
+        key = self._key
+        sorted_ds = self._ds.sort(key).repartition(1)
+
+        def apply_groups(batch: pa.Table):
+            tables = []
+            col = batch.column(key).to_numpy(zero_copy_only=False)
+            if len(col) == 0:
+                return batch
+            splits = np.nonzero(col[1:] != col[:-1])[0] + 1
+            start = 0
+            from ray_tpu.data.block import batch_to_block
+
+            for end in list(splits) + [len(col)]:
+                sub = batch.slice(start, end - start)
+                res = fn(BlockAccessor(sub).to_batch(batch_format))
+                tables.append(batch_to_block(res))
+                start = end
+            return concat_blocks(tables)
+
+        return sorted_ds.map_batches(apply_groups, batch_format="pyarrow",
+                                     batch_size=None)

@@ -1,0 +1,270 @@
+"""Datasources: pluggable readers producing ReadTasks, and file datasinks.
+
+Reference: ``python/ray/data/datasource/datasource.py`` (``Datasource``,
+``ReadTask``) and the per-format datasources under
+``python/ray/data/_internal/datasource/``.  A ``ReadTask`` is a serializable
+zero-arg callable returning an iterator of output blocks, plus metadata
+estimated *before* execution so the optimizer can plan parallelism.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import BlockMetadata, batch_to_block, rows_to_block
+
+
+class ReadTask:
+    def __init__(self, read_fn: Callable[[], Iterator[pa.Table]],
+                 metadata: BlockMetadata):
+        self._read_fn = read_fn
+        self.metadata = metadata
+
+    def __call__(self) -> Iterator[pa.Table]:
+        return self._read_fn()
+
+
+class Datasource:
+    """ABC: estimate size, then produce up to ``parallelism`` ReadTasks."""
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, block_format: str = "int"):
+        self._n = n
+        self._format = block_format
+
+    def estimate_inmemory_data_size(self) -> int:
+        return self._n * 8
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks = []
+        per = -(-self._n // parallelism) if self._n else 0
+        for i in range(parallelism):
+            start, end = i * per, min((i + 1) * per, self._n)
+            if start >= end and self._n > 0:
+                break
+
+            def make(start=start, end=end):
+                def read() -> Iterator[pa.Table]:
+                    yield pa.table({"id": np.arange(start, end, dtype=np.int64)})
+
+                return read
+
+            tasks.append(ReadTask(make(), BlockMetadata(
+                num_rows=end - start, size_bytes=(end - start) * 8,
+                schema=pa.schema([("id", pa.int64())]))))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def estimate_inmemory_data_size(self) -> int:
+        return len(self._items) * 64
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n or 1))
+        per = -(-n // parallelism) if n else 0
+        tasks = []
+        for i in range(parallelism):
+            chunk = self._items[i * per:(i + 1) * per]
+            if not chunk and n > 0:
+                break
+
+            def make(chunk=chunk):
+                def read() -> Iterator[pa.Table]:
+                    yield rows_to_block(chunk)
+
+                return read
+
+            tasks.append(ReadTask(make(), BlockMetadata(
+                num_rows=len(chunk), size_bytes=len(chunk) * 64)))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """In-memory tables (from_pandas / from_arrow / from_numpy)."""
+
+    def __init__(self, blocks: List[pa.Table]):
+        self._blocks = blocks
+
+    def estimate_inmemory_data_size(self) -> int:
+        return sum(b.nbytes for b in self._blocks)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for b in self._blocks:
+            def make(b=b):
+                def read() -> Iterator[pa.Table]:
+                    yield b
+
+                return read
+
+            tasks.append(ReadTask(make(), BlockMetadata.for_block(b)))
+        return tasks
+
+
+def _expand_paths(paths, suffix: Optional[str]) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pat = os.path.join(p, "**", f"*{suffix}" if suffix else "*")
+            out.extend(sorted(f for f in globlib.glob(pat, recursive=True)
+                              if os.path.isfile(f)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"No input files found for {paths!r}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """One ReadTask per group of files, grouped to meet the parallelism."""
+
+    _suffix: Optional[str] = None
+
+    def __init__(self, paths):
+        self._paths = _expand_paths(paths, self._suffix)
+
+    def estimate_inmemory_data_size(self) -> int:
+        return sum(os.path.getsize(p) for p in self._paths)
+
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        groups: List[List[str]] = [[] for _ in range(min(parallelism, len(self._paths)))]
+        for i, p in enumerate(self._paths):
+            groups[i % len(groups)].append(p)
+        tasks = []
+        for group in groups:
+            def make(group=group, self=self):
+                def read() -> Iterator[pa.Table]:
+                    for path in group:
+                        yield from self._read_file(path)
+
+                return read
+
+            tasks.append(ReadTask(make(), BlockMetadata(
+                num_rows=0, size_bytes=sum(os.path.getsize(p) for p in group),
+                input_files=group)))
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    _suffix = ".parquet"
+
+    def __init__(self, paths, columns: Optional[List[str]] = None):
+        super().__init__(paths)
+        self._columns = columns
+
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path, columns=self._columns)
+
+
+class CSVDatasource(FileBasedDatasource):
+    _suffix = ".csv"
+
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path)
+
+
+class JSONDatasource(FileBasedDatasource):
+    """JSONL (one object per line) or a single top-level JSON array."""
+
+    _suffix = ".json"
+
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        with open(path, "r") as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "[":
+                rows = json.load(f)
+            else:
+                rows = [json.loads(line) for line in f if line.strip()]
+        yield rows_to_block(rows)
+
+
+class TextDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        with open(path, "r") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        yield pa.table({"text": lines})
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": pa.array([data], type=pa.binary()),
+                        "path": [path]})
+
+
+class NumpyDatasource(FileBasedDatasource):
+    _suffix = ".npy"
+
+    def _read_file(self, path: str) -> Iterator[pa.Table]:
+        arr = np.load(path)
+        yield batch_to_block({"data": arr})
+
+
+# ---------------------------------------------------------------------------
+# Datasinks (write path): one file per block, task-parallel.
+# Reference: ``python/ray/data/datasource/datasink.py`` + write_* in dataset.py
+# ---------------------------------------------------------------------------
+
+def write_block_file(block: pa.Table, path: str, file_format: str):
+    if file_format == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(block, path)
+    elif file_format == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(block, path)
+    elif file_format == "json":
+        with open(path, "w") as f:
+            for row in block.to_pylist():
+                f.write(json.dumps(_json_safe(row)) + "\n")
+    else:
+        raise ValueError(f"Unknown file format {file_format!r}")
+
+
+def _json_safe(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        elif isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        out[k] = v
+    return out

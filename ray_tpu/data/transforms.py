@@ -1,0 +1,275 @@
+"""Block transform functions executed inside tasks/actors.
+
+The unit shipped to a worker is a ``MapChain``: the (possibly fused) sequence
+of row/batch transforms one task applies to one input block.  Output blocks
+are ``put()`` into the object store from the worker and only their refs +
+metadata travel back, so the driver never touches block data.
+
+Reference: ``python/ray/data/_internal/execution/operators/map_transformer.py``
+(MapTransformer and its Row/Batch transform fns).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import (
+    BlockAccessor,
+    BlockBuilder,
+    BlockMetadata,
+    batch_to_block,
+    concat_blocks,
+)
+
+
+@dataclass
+class MapStep:
+    kind: str  # "batches" | "rows" | "flat" | "filter"
+    fn: Any  # function, or a class to instantiate (stateful callable)
+    fn_args: tuple = ()
+    fn_kwargs: dict = field(default_factory=dict)
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+
+
+@dataclass
+class MapChain:
+    steps: List[MapStep]
+    target_max_block_size: int = 128 * 1024 * 1024
+
+
+def _resolve_fn(step: MapStep, cache: Optional[Dict[int, Any]] = None) -> Callable:
+    """Instantiate callable classes (once per actor when a cache is given)."""
+    fn = step.fn
+    if isinstance(fn, type):
+        key = id(fn)
+        if cache is not None and key in cache:
+            return cache[key]
+        inst = fn(*step.fn_args, **step.fn_kwargs)
+        if cache is not None:
+            cache[key] = inst
+        return inst
+    return fn
+
+
+def _iter_batches(block: pa.Table, batch_size: Optional[int],
+                  batch_format: str) -> Iterator[Any]:
+    acc = BlockAccessor(block)
+    if batch_size is None or batch_size >= block.num_rows:
+        if block.num_rows:
+            yield acc.to_batch(batch_format)
+        return
+    for start in range(0, block.num_rows, batch_size):
+        yield BlockAccessor(acc.slice(start, min(start + batch_size,
+                                                 block.num_rows))).to_batch(batch_format)
+
+
+def apply_chain(blocks: List[pa.Table], chain: MapChain,
+                fn_cache: Optional[Dict[int, Any]] = None) -> Iterator[pa.Table]:
+    """Apply every step to the input blocks, yielding output blocks split at
+    the target block size."""
+    tables = blocks
+    for step in chain.steps:
+        fn = _resolve_fn(step, fn_cache)
+        out = BlockBuilder(chain.target_max_block_size)
+        produced: List[pa.Table] = []
+        for block in tables:
+            if step.kind == "batches":
+                for batch in _iter_batches(block, step.batch_size, step.batch_format):
+                    args, kwargs = ((), {}) if isinstance(step.fn, type) else (
+                        step.fn_args, step.fn_kwargs)
+                    res = fn(batch, *args, **kwargs)
+                    if res is None:
+                        continue
+                    out.add_batch(res)
+                    if out.should_flush():
+                        produced.append(out.build())
+            elif step.kind == "rows":
+                for row in BlockAccessor(block).iter_rows():
+                    out.add_row(fn(row))
+            elif step.kind == "flat":
+                for row in BlockAccessor(block).iter_rows():
+                    for r in fn(row):
+                        out.add_row(r)
+            elif step.kind == "filter":
+                for row in BlockAccessor(block).iter_rows():
+                    if fn(row):
+                        out.add_row(row)
+            else:
+                raise ValueError(f"unknown map kind {step.kind!r}")
+        if out.num_rows() or not produced:
+            produced.append(out.build())
+        tables = produced
+    yield from tables
+
+
+def _finalize(blocks: Iterator[pa.Table], t0: float,
+              input_files: Optional[List[str]] = None):
+    """Put output blocks, return ([ref...], [meta...]) — the small task reply."""
+    refs, metas = [], []
+    for b in blocks:
+        refs.append(ray_tpu.put(b))
+        metas.append(BlockMetadata.for_block(b, input_files=input_files,
+                                             start_time=t0))
+    return refs, metas
+
+
+@ray_tpu.remote
+def run_map_task(chain: MapChain, *blocks: pa.Table):
+    """Task-pool map: apply the chain to the input blocks."""
+    t0 = time.perf_counter()
+    return _finalize(apply_chain(list(blocks), chain), t0)
+
+
+@ray_tpu.remote
+def run_read_task(read_task, chain: Optional[MapChain]):
+    """Execute a datasource ReadTask (+ optionally a fused downstream chain)."""
+    t0 = time.perf_counter()
+    blocks = list(read_task())
+    if chain is not None and chain.steps:
+        blocks = apply_chain(blocks, chain)
+    return _finalize(blocks, t0, input_files=read_task.metadata.input_files)
+
+
+@ray_tpu.remote
+class MapWorker:
+    """Actor-pool map worker: caches stateful callables across calls.
+
+    Reference: ``_MapWorker`` in
+    ``python/ray/data/_internal/execution/operators/actor_pool_map_operator.py``.
+    """
+
+    def __init__(self):
+        self._fn_cache: Dict[int, Any] = {}
+
+    def ready(self) -> bool:
+        return True
+
+    def run(self, chain: MapChain, *blocks: pa.Table):
+        t0 = time.perf_counter()
+        return _finalize(apply_chain(list(blocks), chain, self._fn_cache), t0)
+
+
+# -- shuffle-family task fns -------------------------------------------------
+
+
+@ray_tpu.remote
+def split_block(block: pa.Table, num_splits: int, seed_or_none):
+    """Map side of random_shuffle/repartition(shuffle=True): permute rows and
+    deal them into ``num_splits`` parts."""
+    t0 = time.perf_counter()
+    acc = BlockAccessor(block)
+    n = block.num_rows
+    rng = np.random.default_rng(seed_or_none)
+    parts = np.array_split(rng.permutation(n), num_splits)
+    return _finalize((acc.take_rows(p) for p in parts), t0)
+
+
+@ray_tpu.remote
+def merge_blocks(*blocks: pa.Table):
+    """Reduce side: concatenate parts into one output block."""
+    t0 = time.perf_counter()
+    return _finalize(iter([concat_blocks(list(blocks))]), t0)
+
+
+@ray_tpu.remote
+def slice_block(block: pa.Table, start: int, end: int):
+    t0 = time.perf_counter()
+    return _finalize(iter([BlockAccessor(block).slice(start, end)]), t0)
+
+
+@ray_tpu.remote
+def sample_boundaries(block: pa.Table, key: str, n_samples: int):
+    acc = BlockAccessor(block)
+    sampled = acc.sample(min(n_samples, block.num_rows))
+    return sampled.column(key).to_pylist() if sampled.num_rows else []
+
+
+@ray_tpu.remote
+def range_partition_block(block: pa.Table, key: str, boundaries: List[Any],
+                          descending: bool):
+    """Sort a block locally then split at the given key boundaries."""
+    t0 = time.perf_counter()
+    order = "descending" if descending else "ascending"
+    block = block.sort_by([(key, order)])
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    if descending:
+        idx = len(col) - np.searchsorted(col[::-1], boundaries, side="left")
+    else:
+        idx = np.searchsorted(col, boundaries, side="left")
+    parts = []
+    prev = 0
+    for i in list(idx) + [block.num_rows]:
+        i = int(max(prev, i))
+        parts.append(block.slice(prev, i - prev))
+        prev = i
+    return _finalize(iter(parts), t0)
+
+
+@ray_tpu.remote
+def merge_sorted_blocks(key: str, descending: bool, *blocks: pa.Table):
+    t0 = time.perf_counter()
+    merged = concat_blocks(list(blocks))
+    if merged.num_rows:
+        merged = merged.sort_by([(key, "descending" if descending else "ascending")])
+    return _finalize(iter([merged]), t0)
+
+
+@ray_tpu.remote
+def hash_partition_block(block: pa.Table, key: str, num_partitions: int):
+    """Map side of groupby: deal rows into partitions by key hash."""
+    t0 = time.perf_counter()
+    if block.num_rows == 0:
+        return _finalize(iter([block] * num_partitions), t0)
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    hashes = np.array([hash(v) % num_partitions for v in col.tolist()])
+    acc = BlockAccessor(block)
+    parts = [acc.take_rows(np.nonzero(hashes == p)[0])
+             for p in range(num_partitions)]
+    return _finalize(iter(parts), t0)
+
+
+@ray_tpu.remote
+def aggregate_partition(key: Optional[str], agg_specs: List[Tuple[str, str, str]],
+                        *blocks: pa.Table):
+    """Reduce side of groupby: arrow group_by aggregate on one partition.
+
+    agg_specs: (column, arrow_fn, output_name).
+    """
+    t0 = time.perf_counter()
+    merged = concat_blocks(list(blocks))
+    if merged.num_rows == 0:
+        return _finalize(iter([merged]), t0)
+    if key is None:
+        import pyarrow.compute as pc
+
+        out: Dict[str, Any] = {}
+        for col, fn, name in agg_specs:
+            if fn == "count":
+                out[name] = [merged.num_rows]
+            else:
+                out[name] = [getattr(pc, fn)(merged.column(col)).as_py()]
+        return _finalize(iter([pa.table(out)]), t0)
+    aggs = [(col if col else key, fn) for col, fn, _ in agg_specs]
+    res = merged.group_by(key).aggregate(aggs)
+    # arrow names outputs "<col>_<fn>"; rename to requested names
+    rename = {f"{col if col else key}_{fn}": name for col, fn, name in agg_specs}
+    res = res.rename_columns([rename.get(c, c) for c in res.column_names])
+    return _finalize(iter([res]), t0)
+
+
+@ray_tpu.remote
+def zip_blocks(left: pa.Table, right: pa.Table):
+    t0 = time.perf_counter()
+    assert left.num_rows == right.num_rows, (left.num_rows, right.num_rows)
+    cols = {name: left.column(name) for name in left.column_names}
+    for name in right.column_names:
+        out_name = name if name not in cols else f"{name}_1"
+        cols[out_name] = right.column(name)
+    return _finalize(iter([pa.table(cols)]), t0)

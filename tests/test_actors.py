@@ -184,10 +184,11 @@ def test_max_concurrency_threaded(ray_start):
             return t
 
     s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(0.0))  # wait for the actor process to be up
     t0 = time.time()
     refs = [s.nap.remote(1.0) for _ in range(4)]
     ray_tpu.get(refs)
-    assert time.time() - t0 < 3.5
+    assert time.time() - t0 < 3.0
 
 
 def test_actor_ordering_with_ref_args(ray_start):

@@ -1,0 +1,277 @@
+"""Tests for ray_tpu.data (reference test model: python/ray/data/tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data import ActorPoolStrategy
+
+
+def test_range_count_take(ray_start):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert ds.num_blocks() == 4
+
+
+def test_from_items_and_schema(ray_start):
+    ds = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    assert ds.count() == 2
+    assert set(ds.columns()) == {"a", "b"}
+    assert ds.take_all()[1]["b"] == "y"
+
+
+def test_map_batches_fusion(ray_start):
+    ds = rd.range(50).map_batches(lambda b: {"id": b["id"] + 1}) \
+        .map_batches(lambda b: {"id": b["id"] * 2})
+    # both maps and the read fuse into one operator
+    assert "->" in ds.explain().splitlines()[0] or "Read" in ds.explain()
+    rows = ds.take_all()
+    assert [r["id"] for r in rows[:3]] == [2, 4, 6]
+
+
+def test_map_filter_flat_map(ray_start):
+    ds = rd.range(10)
+    assert ds.map(lambda r: {"x": r["id"] ** 2}).take(3) == [
+        {"x": 0}, {"x": 1}, {"x": 4}]
+    assert ds.filter(lambda r: r["id"] >= 8).count() == 2
+    out = ds.limit(2).flat_map(lambda r: [r, r]).count()
+    assert out == 4
+
+
+def test_column_ops(ray_start):
+    ds = rd.from_items([{"a": i, "b": i * 2} for i in range(5)])
+    assert set(ds.select_columns(["a"]).columns()) == {"a"}
+    assert set(ds.drop_columns(["a"]).columns()) == {"b"}
+    ds2 = ds.add_column("c", lambda b: b["a"] + b["b"])
+    assert ds2.take(1)[0]["c"] == 0
+    assert "a2" in ds.rename_columns({"a": "a2"}).columns()
+
+
+def test_batch_formats(ray_start):
+    ds = rd.range(10)
+    b = next(iter(ds.iter_batches(batch_size=5, batch_format="numpy")))
+    assert isinstance(b["id"], np.ndarray)
+    b = next(iter(ds.iter_batches(batch_size=5, batch_format="pandas")))
+    assert b["id"].tolist() == [0, 1, 2, 3, 4]
+    b = next(iter(ds.iter_batches(batch_size=5, batch_format="pyarrow")))
+    assert b.num_rows == 5
+
+
+def test_iter_batches_sizes_and_drop_last(ray_start):
+    ds = rd.range(23, parallelism=3)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=5)]
+    assert sum(sizes) == 23
+    assert sizes[:-1] == [5] * (len(sizes) - 1)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=5, drop_last=True)]
+    assert sizes == [5, 5, 5, 5]
+
+
+def test_local_shuffle_buffer(ray_start):
+    ds = rd.range(100, parallelism=2)
+    ids = []
+    for b in ds.iter_batches(batch_size=10, local_shuffle_buffer_size=50,
+                             local_shuffle_seed=7):
+        ids.extend(b["id"].tolist())
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+def test_tensor_columns_roundtrip(ray_start):
+    data = np.arange(24, dtype=np.float32).reshape(6, 2, 2)
+    ds = rd.from_numpy(data, column="img")
+    batch = next(iter(ds.iter_batches(batch_size=6)))
+    np.testing.assert_array_equal(batch["img"], data)
+    # through a map too
+    ds2 = ds.map_batches(lambda b: {"img": b["img"] * 2})
+    batch = next(iter(ds2.iter_batches(batch_size=6)))
+    np.testing.assert_array_equal(batch["img"], data * 2)
+
+
+def test_actor_pool_map(ray_start):
+    class AddState:
+        def __init__(self):
+            self.offset = 100
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.offset}
+
+    ds = rd.range(40, parallelism=4).map_batches(
+        AddState, compute=ActorPoolStrategy(size=2))
+    rows = sorted(r["id"] for r in ds.take_all())
+    assert rows == list(range(100, 140))
+
+
+def test_repartition(ray_start):
+    ds = rd.range(100, parallelism=10).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 100
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(100))
+
+
+def test_random_shuffle_deterministic(ray_start):
+    a = [r["id"] for r in rd.range(50, parallelism=5).random_shuffle(seed=3).take_all()]
+    b = [r["id"] for r in rd.range(50, parallelism=5).random_shuffle(seed=3).take_all()]
+    assert a == b
+    assert sorted(a) == list(range(50))
+    assert a != list(range(50))
+
+
+def test_sort(ray_start):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200).tolist()
+    ds = rd.from_items([{"v": v} for v in vals], parallelism=4).sort("v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(vals)
+    out = [r["v"] for r in rd.from_items([{"v": v} for v in vals], parallelism=4)
+           .sort("v", descending=True).take_all()]
+    assert out == sorted(vals, reverse=True)
+
+
+def test_groupby_aggregate(ray_start):
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(30)],
+                       parallelism=3)
+    rows = {r["k"]: r for r in ds.groupby("k").sum("v").take_all()}
+    assert rows[0]["sum(v)"] == sum(float(i) for i in range(30) if i % 3 == 0)
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    means = {r["k"]: r["mean(v)"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[1] == pytest.approx(np.mean([i for i in range(30) if i % 3 == 1]))
+
+
+def test_global_aggregate(ray_start):
+    ds = rd.range(101)
+    assert ds.sum("id") == 5050
+    assert ds.min("id") == 0
+    assert ds.max("id") == 100
+    assert ds.mean("id") == pytest.approx(50.0)
+
+
+def test_map_groups(ray_start):
+    ds = rd.from_items([{"k": i % 2, "v": i} for i in range(10)])
+
+    def norm(batch):
+        return {"k": batch["k"], "v": batch["v"] - batch["v"].min()}
+
+    rows = ds.groupby("k").map_groups(norm).take_all()
+    by_k = {}
+    for r in rows:
+        by_k.setdefault(r["k"], []).append(r["v"])
+    assert min(by_k[0]) == 0 and min(by_k[1]) == 0
+
+
+def test_union_zip(ray_start):
+    a = rd.range(5)
+    b = rd.range(5).map(lambda r: {"id": r["id"] + 5})
+    assert sorted(r["id"] for r in a.union(b).take_all()) == list(range(10))
+    z = rd.range(6, parallelism=2).zip(
+        rd.range(6, parallelism=3).map(lambda r: {"y": r["id"] * 10}))
+    rows = sorted(z.take_all(), key=lambda r: r["id"])
+    assert rows[3] == {"id": 3, "y": 30}
+
+
+def test_limit_early_exit(ray_start):
+    # limit stops the pipeline early (streaming early-exit)
+    ds = rd.range(10_000, parallelism=100).limit(25)
+    assert ds.count() == 25
+    assert [r["id"] for r in ds.take_all()] == list(range(25))
+
+
+def test_split(ray_start):
+    parts = rd.range(100, parallelism=10).split(3)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 100
+    assert all(c > 0 for c in counts)
+
+
+def test_split_at_indices(ray_start):
+    parts = rd.range(10).split_at_indices([3, 7])
+    assert [p.count() for p in parts] == [3, 4, 3]
+    assert [r["id"] for r in parts[1].take_all()] == [3, 4, 5, 6]
+
+
+def test_streaming_split(ray_start):
+    its = rd.range(60, parallelism=6).streaming_split(2)
+    import threading
+
+    results = [[], []]
+
+    def consume(i):
+        for batch in its[i].iter_batches(batch_size=10, prefetch_batches=0):
+            results[i].extend(batch["id"].tolist())
+
+    threads = [threading.Thread(target=consume, args=(i,)) for i in (0, 1)]
+    [t.start() for t in threads]
+    [t.join(timeout=60) for t in threads]
+    assert sorted(results[0] + results[1]) == list(range(60))
+    assert results[0] and results[1]
+
+
+def test_write_read_parquet(ray_start, tmp_path):
+    path = str(tmp_path / "out")
+    rd.range(30, parallelism=3).write_parquet(path)
+    ds = rd.read_parquet(path)
+    assert ds.count() == 30
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(30))
+
+
+def test_write_read_csv_json(ray_start, tmp_path):
+    rd.from_items([{"a": i, "b": f"s{i}"} for i in range(10)]).write_csv(
+        str(tmp_path / "csv"))
+    ds = rd.read_csv(str(tmp_path / "csv"))
+    assert ds.count() == 10
+    rd.from_items([{"a": i} for i in range(7)]).write_json(str(tmp_path / "js"))
+    ds = rd.read_json(str(tmp_path / "js"))
+    assert sorted(r["a"] for r in ds.take_all()) == list(range(7))
+
+
+def test_read_text(ray_start, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+
+def test_from_pandas_to_pandas(ray_start):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    ds = rd.from_pandas(df)
+    out = ds.to_pandas()
+    assert out["x"].tolist() == [1, 2, 3]
+
+
+def test_unique_and_stats(ray_start):
+    ds = rd.from_items([{"v": i % 4} for i in range(20)])
+    assert ds.unique("v") == [0, 1, 2, 3]
+    assert "Read" in ds.stats()
+
+
+def test_iter_jax_batches(ray_start):
+    import jax.numpy as jnp
+
+    ds = rd.range(32).map_batches(
+        lambda b: {"x": b["id"].astype(np.float32)})
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 2
+    assert batches[0]["x"].dtype == jnp.float32
+    total = sum(float(b["x"].sum()) for b in batches)
+    assert total == float(np.arange(32).sum())
+
+
+def test_materialize_reuse(ray_start):
+    calls = []
+
+    def tag(b):
+        return {"id": b["id"]}
+
+    mat = rd.range(20, parallelism=2).map_batches(tag).materialize()
+    assert mat.count() == 20
+    assert mat.count() == 20  # second action doesn't re-execute
+    assert mat.map(lambda r: {"x": r["id"]}).count() == 20
+
+
+def test_random_block_order_and_train_test_split(ray_start):
+    tr, te = rd.range(100).train_test_split(0.2)
+    assert tr.count() == 80 and te.count() == 20
