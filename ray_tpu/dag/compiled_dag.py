@@ -42,6 +42,12 @@ class _Stop:
 _STOP = _Stop()
 
 
+class _StopSignal(BaseException):
+    """Raised inside the exec loop when a channel delivers the _STOP
+    sentinel (BaseException so user-level ``except Exception`` in resolve
+    can't swallow it)."""
+
+
 # --------------------------------------------------------------------------
 # Actor-side exec loop (runs inside the actor process, in its own thread)
 # --------------------------------------------------------------------------
@@ -53,6 +59,9 @@ def _start_exec_loop(instance, dag_id: str, spec_bytes: bytes) -> bool:
     from ray_tpu._private import serialization
 
     spec = serialization.loads(spec_bytes)
+    # prune finished loops so long-lived actors don't accumulate state
+    for done_id in [k for k, st in _EXEC_LOOPS.items() if st.get("done")]:
+        _EXEC_LOOPS.pop(done_id, None)
     state: Dict[str, Any] = {"error": None, "done": False}
     _EXEC_LOOPS[dag_id] = state
 
@@ -101,6 +110,10 @@ def _run_exec_loop(instance, spec: Dict[str, Any]) -> None:
         def get_chan(name: str):
             if name not in cache:
                 cache[name] = read_channels[name].read()
+            if isinstance(cache[name], _Stop):
+                # raise BEFORE any unpacking of the value (the input argspec
+                # does `args, kwargs = get_chan(...)`)
+                raise _StopSignal()
             return cache[name]
 
         local: Dict[int, Any] = {}
@@ -132,16 +145,15 @@ def _run_exec_loop(instance, spec: Dict[str, Any]) -> None:
                 args = [resolve(a) for a in t["args"]]
                 kwargs = {k: resolve(v) for k, v in t["kwargs"].items()}
                 vals = list(args) + list(kwargs.values())
-                if any(isinstance(v, _Stop) for v in vals) or any(
-                        isinstance(v, _Stop) for v in cache.values()):
-                    stopping = True
-                    break
                 upstream_err = next(
                     (v for v in vals if isinstance(v, TaskError)), None)
                 if upstream_err is not None:
                     result = upstream_err
                 else:
                     result = getattr(instance, t["method"])(*args, **kwargs)
+            except _StopSignal:
+                stopping = True
+                break
             except BaseException as e:  # noqa: BLE001 — propagated downstream
                 result = TaskError.from_exception(e)
             local[t["local_idx"]] = result
@@ -188,6 +200,10 @@ class CompiledDAG:
         self._actors: List[Any] = []
         self._next_exec_idx = 0
         self._next_get_idx = 0
+        # values already drained from output channels for the execution
+        # currently being gotten (lets a timed-out get() resume without
+        # re-reading channels it already consumed)
+        self._partial_values: List[Any] = []
         self._torn_down = False
         # separate locks: a producer blocked in a backpressured execute()
         # must not prevent a consumer's get() from draining the pipeline
@@ -377,6 +393,8 @@ class CompiledDAG:
             return ref
 
     def _get_result(self, ref: CompiledDAGRef, timeout: Optional[float]):
+        import time
+
         with self._get_lock:
             if ref._has_result:
                 raise ValueError("a CompiledDAGRef can only be gotten once")
@@ -385,7 +403,17 @@ class CompiledDAG:
                     f"results must be gotten in submission order (next is "
                     f"execution #{self._next_get_idx}, this ref is "
                     f"#{ref._idx})")
-            values = [ch.read(timeout) for ch in self._output_channels]
+            # one deadline across ALL output channels; resume after a timeout
+            # from the first unread channel (each read consumes its ack slot,
+            # so re-reading a drained channel would desync the pipeline)
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while len(self._partial_values) < len(self._output_channels):
+                ch = self._output_channels[len(self._partial_values)]
+                budget = (None if deadline is None
+                          else max(0.0, deadline - time.monotonic()))
+                self._partial_values.append(ch.read(budget))
+            values = self._partial_values
+            self._partial_values = []
             self._next_get_idx += 1
             ref._has_result = True
         err = next((v for v in values if isinstance(v, TaskError)), None)

@@ -62,6 +62,10 @@ class DAGNode:
     ):
         from ray_tpu.dag.compiled_dag import CompiledDAG
 
+        if enable_asyncio:
+            raise NotImplementedError(
+                "enable_asyncio is not supported yet; use execute() + "
+                "ref.get() from a thread")
         dag = CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
                           submit_timeout=submit_timeout)
         dag._compile()

@@ -110,9 +110,9 @@ class TpuCommunicator(CpuCommunicator):
     public ICI API (SURVEY.md §7 hard-part 1), so device arrays are staged
     through host shm (device_get → channel → device_put) — correct on any
     topology, DCN-bandwidth-bound.  The fast path is *in-mesh fusion*: when
-    every node of a DAG edge lives in one process holding a mesh, the
-    compiled DAG keeps values as jax.Arrays and XLA moves them over ICI
-    inside the jitted program (see ``compiled_dag.InMeshChannel``).
+    every node of a DAG edge lives in one process holding a mesh, keep the
+    whole step under one jit so values stay as jax.Arrays and XLA moves
+    them over ICI inside the compiled program (no channel hop at all).
     """
 
     def send(self, tensor, peer_rank: int) -> None:

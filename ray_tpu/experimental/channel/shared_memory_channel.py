@@ -42,7 +42,9 @@ class ChannelClosedError(RuntimeError):
     pass
 
 
-_CLOSED = (1 << 64) - 1  # version sentinel: channel torn down
+_CLOSED_BIT = 1 << 63  # high bit of the n_readers word: channel torn down.
+# The flag lives in a word the writer never stores to, so close() is sticky
+# even if a writer is mid-write when the channel is closed.
 
 # resource_tracker would unlink segments when *any* process exits; channel
 # lifetime is owned by the compiled DAG (same reasoning as the object store)
@@ -85,11 +87,14 @@ class Channel:
     def _set_ack(self, slot: int, v: int) -> None:
         _U64.pack_into(self._seg.buf, _HDR + 8 * slot, v)
 
+    def _is_closed(self) -> bool:
+        return bool(_U64.unpack_from(self._seg.buf, 16)[0] & _CLOSED_BIT)
+
     def _wait(self, pred, timeout: Optional[float], what: str):
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while not pred():
-            if self._version() == _CLOSED:
+            if self._is_closed():
                 raise ChannelClosedError(f"channel {self.name} closed")
             spins += 1
             if spins < 200:
@@ -105,9 +110,9 @@ class Channel:
             raise ValueError(
                 f"payload of {len(payload)}B exceeds channel buffer "
                 f"{self.buffer_size}B (set buffer_size at compile time)")
-        v = self._version()
-        if v == _CLOSED:
+        if self._is_closed():
             raise ChannelClosedError(f"channel {self.name} closed")
+        v = self._version()
         self._wait(
             lambda: all(self._ack(r) >= v for r in range(self.num_readers)),
             timeout, "readers to consume previous value")
@@ -121,7 +126,7 @@ class Channel:
         last = self._ack(slot)
         self._wait(lambda: self._version() > last, timeout, "a new value")
         v = self._version()
-        if v == _CLOSED:
+        if self._is_closed():
             raise ChannelClosedError(f"channel {self.name} closed")
         n = _U64.unpack_from(self._seg.buf, 8)[0]
         base = _HDR + 8 * self.num_readers
@@ -148,7 +153,8 @@ class Channel:
 
     def close(self) -> None:
         try:
-            _U64.pack_into(self._seg.buf, 0, _CLOSED)
+            cur = _U64.unpack_from(self._seg.buf, 16)[0]
+            _U64.pack_into(self._seg.buf, 16, cur | _CLOSED_BIT)
         except Exception:
             pass
 

@@ -234,3 +234,86 @@ class TestCompiledDag:
             dag = b.add2.bind(inp, free)
         with pytest.raises(ValueError, match="depend"):
             dag.experimental_compile()
+
+
+class TestCommunicator:
+    def test_composite_channel(self):
+        from ray_tpu.experimental.channel import CompositeChannel
+
+        a = Channel(buffer_size=1 << 12, num_readers=1)
+        b = Channel(buffer_size=1 << 12, num_readers=1)
+        ra = Channel(a.name, buffer_size=1 << 12, num_readers=1, _create=False)
+        rb = Channel(b.name, buffer_size=1 << 12, num_readers=1, _create=False)
+        a.write(1)
+        b.write("two")
+        comp = CompositeChannel([ra, rb])
+        assert comp.read(timeout=5) == (1, "two")
+        comp.close()
+        with pytest.raises(ChannelClosedError):
+            a.write(3, timeout=1)
+        a.destroy()
+        b.destroy()
+
+    def test_close_is_sticky_under_concurrent_write(self):
+        # a writer completing its version bump must not "reopen" a channel
+        # that was closed mid-write
+        ch = Channel(buffer_size=1 << 12, num_readers=1)
+        ch.write(1)  # unconsumed: next write will block on the ack
+        import threading
+
+        state = {}
+
+        def write2():
+            try:
+                ch.write(2, timeout=5)
+                state["wrote"] = True
+            except ChannelClosedError:
+                state["closed"] = True
+
+        t = threading.Thread(target=write2)
+        t.start()
+        import time
+
+        time.sleep(0.2)  # writer is now blocked waiting for the ack
+        ch.close()
+        t.join(timeout=10)
+        assert state.get("closed") and not state.get("wrote")
+        reader = Channel(ch.name, buffer_size=1 << 12, num_readers=1,
+                         _create=False)
+        with pytest.raises(ChannelClosedError):
+            reader.read(timeout=1)
+        ch.destroy()
+
+    def test_cpu_communicator_send_recv_allreduce(self):
+        import uuid
+
+        from ray_tpu.experimental.channel import CpuCommunicator
+
+        @ray_tpu.remote
+        class CommActor:
+            def __init__(self, rank, world, name):
+                self.comm = CpuCommunicator(world, name)
+                self.comm.initialize(rank)
+                self.rank = rank
+
+            def allreduce(self):
+                return self.comm.allreduce(np.full((3,), float(self.rank + 1)))
+
+            def exchange(self):
+                if self.rank == 0:
+                    self.comm.send(np.array([7.0]), 1)
+                    return None
+                return self.comm.recv((1,), np.float64, 0)
+
+            def world(self):
+                return self.comm.get_world_size()
+
+        name = f"comm-{uuid.uuid4().hex[:8]}"
+        actors = [CommActor.remote(i, 2, name) for i in range(2)]
+        res = ray_tpu.get([a.allreduce.remote() for a in actors])
+        np.testing.assert_allclose(res[0], np.full((3,), 3.0))
+        out = ray_tpu.get([a.exchange.remote() for a in actors])
+        np.testing.assert_allclose(out[1], [7.0])
+        assert ray_tpu.get(actors[0].world.remote()) == 2
+        for a in actors:
+            ray_tpu.kill(a)
