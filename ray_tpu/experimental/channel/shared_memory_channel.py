@@ -190,9 +190,20 @@ class CompositeChannel:
 
     def __init__(self, channels: List[Channel]):
         self.channels = channels
+        # values already drained for the in-progress read (a mid-tuple
+        # timeout has consumed those channels' ack slots; a retry must
+        # resume, not re-read — same protocol as CompiledDAG._get_result)
+        self._partial: List[Any] = []
 
     def read(self, timeout: Optional[float] = None) -> tuple:
-        return tuple(c.read(timeout) for c in self.channels)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while len(self._partial) < len(self.channels):
+            budget = (None if deadline is None
+                      else max(0.0, deadline - time.monotonic()))
+            self._partial.append(self.channels[len(self._partial)].read(budget))
+        out = tuple(self._partial)
+        self._partial = []
+        return out
 
     def close(self) -> None:
         for c in self.channels:

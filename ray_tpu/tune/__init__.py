@@ -1,0 +1,42 @@
+"""ray_tpu.tune: hyperparameter optimization (reference: ``python/ray/tune/``)."""
+
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    HyperbandImprovementSearcher,
+    Searcher,
+    choice,
+    generate_variants,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import FunctionTrainable, Trainable, get_checkpoint, report
+from ray_tpu.tune.tuner import (
+    Result,
+    ResultGrid,
+    TuneConfig,
+    TuneController,
+    Tuner,
+    run,
+)
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
+    "FIFOScheduler", "FunctionTrainable", "HyperbandImprovementSearcher",
+    "MedianStoppingRule", "PopulationBasedTraining", "Result", "ResultGrid",
+    "Searcher", "Trainable", "TrialScheduler", "TuneConfig", "TuneController",
+    "Tuner", "choice", "generate_variants", "get_checkpoint", "grid_search",
+    "loguniform", "quniform", "randint", "report", "run", "sample_from",
+    "uniform",
+]

@@ -1,0 +1,177 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Reference: ``python/ray/tune/schedulers/`` — ``TrialScheduler`` ABC
+(``trial_scheduler.py``), ``AsyncHyperBandScheduler``/ASHA
+(``async_hyperband.py``), ``MedianStoppingRule`` (``median_stopping_rule.py``),
+``PopulationBasedTraining`` (``pbt.py``).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+
+    def set_properties(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = result[self.metric]
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]):
+        pass
+
+    def choose_trial_to_run(self, pending: List) -> Optional[Any]:
+        return pending[0] if pending else None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: asynchronous successive halving.
+
+    Rungs at grace_period * reduction_factor^k; a trial reaching a rung is
+    stopped unless its metric is in the top 1/reduction_factor of results
+    recorded at that rung (reference ``async_hyperband.py`` ``_Bracket``).
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(int(t))
+            t *= reduction_factor
+        self._milestones = sorted(set(milestones), reverse=True)
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return self.STOP
+        score = self._score(result)
+        action = self.CONTINUE
+        for m in self._milestones:
+            if t >= m:
+                rung = self._rungs.setdefault(m, [])
+                cutoff = None
+                if rung:
+                    k = max(1, int(len(rung) / self.rf))
+                    cutoff = sorted(rung, reverse=True)[k - 1]
+                rung.append(score)
+                if cutoff is not None and score < cutoff:
+                    action = self.STOP
+                break
+        return action
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is below the median of running
+    averages of completed/running trials at the same step."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._histories: Dict[str, List[float]] = {}
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        hist = self._histories.setdefault(trial.trial_id, [])
+        hist.append(score)
+        if t < self.grace or len(self._histories) < self.min_samples:
+            return self.CONTINUE
+        avgs = [sum(h) / len(h) for tid, h in self._histories.items()
+                if h and tid != trial.trial_id]
+        if len(avgs) + 1 < self.min_samples:
+            return self.CONTINUE
+        median = sorted(avgs)[len(avgs) // 2]
+        best = max(hist)
+        return self.STOP if best < median else self.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: at every ``perturbation_interval``, bottom-quantile trials
+    exploit (clone checkpoint+config of) a top-quantile trial and explore
+    (perturb hyperparams).  Requires checkpointable trainables; the
+    controller performs the actual clone via trial.exploit_from.
+
+    Reference: ``python/ray/tune/schedulers/pbt.py`` (``_exploit``,
+    ``_explore``).
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None,
+                 resample_probability: float = 0.25):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, float] = {}
+        self._trials: Dict[str, Any] = {}
+
+    def on_trial_result(self, trial, result):
+        tid = trial.trial_id
+        self._trials[tid] = trial
+        self._latest[tid] = self._score(result)
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb.get(tid, 0) < self.interval:
+            return self.CONTINUE
+        self._last_perturb[tid] = t
+        ordered = sorted(self._latest, key=self._latest.get)
+        k = max(1, int(len(ordered) * self.quantile))
+        if len(ordered) < 2 * k:
+            return self.CONTINUE
+        bottom, top = ordered[:k], ordered[-k:]
+        if tid in bottom:
+            donor = self._trials[self._rng.choice(top)]
+            new_cfg = self._explore(dict(donor.config))
+            trial.request_exploit(donor, new_cfg)
+        return self.CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        for key, mut in self.mutations.items():
+            if self._rng.random() < self.resample_p or key not in config:
+                if isinstance(mut, Domain):
+                    config[key] = mut.sample(self._rng)
+                elif isinstance(mut, list):
+                    config[key] = self._rng.choice(mut)
+                elif callable(mut):
+                    config[key] = mut()
+            else:
+                cur = config[key]
+                if isinstance(cur, (int, float)):
+                    factor = self._rng.choice([0.8, 1.2])
+                    config[key] = cur * factor
+                    if isinstance(mut, list):  # snap to allowed values
+                        config[key] = min(mut, key=lambda v: abs(v - config[key]))
+        return config

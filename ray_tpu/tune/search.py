@@ -1,0 +1,247 @@
+"""Search spaces and search algorithms.
+
+Reference: ``python/ray/tune/search/`` — domains in ``sample.py``
+(``uniform``, ``loguniform``, ``choice``, ``randint``, ``grid_search``),
+variant expansion in ``basic_variant.py`` (``BasicVariantGenerator``), and
+the ``Searcher`` ABC in ``search/searcher.py``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[Dict], Any]):
+        self.fn = fn
+
+    def sample(self, rng):  # resolved against the spec later
+        return self.fn
+
+
+class GridSearch:
+    def __init__(self, values: List[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories: List[Any]) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn: Callable[[Dict], Any]) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, Any]:
+    return {"grid_search": list(values)}
+
+
+def _walk(space: Dict[str, Any], path: Tuple[str, ...] = ()):
+    """Yield (path, value) leaves of a nested param space."""
+    for k, v in space.items():
+        p = path + (k,)
+        if isinstance(v, dict) and "grid_search" in v and len(v) == 1:
+            yield p, GridSearch(v["grid_search"])
+        elif isinstance(v, dict):
+            yield from _walk(v, p)
+        else:
+            yield p, v
+
+
+def _set_path(d: Dict, path: Tuple[str, ...], value: Any):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(space: Dict[str, Any], num_samples: int,
+                      seed: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Expand grid axes (cartesian product), sample stochastic domains
+    ``num_samples`` times each (reference: grid x num_samples semantics)."""
+    rng = random.Random(seed)
+    leaves = list(_walk(space))
+    grid_axes = [(p, v.values) for p, v in leaves if isinstance(v, GridSearch)]
+    out: List[Dict[str, Any]] = []
+    grids = itertools.product(*[vals for _, vals in grid_axes]) if grid_axes \
+        else [()]
+    for combo in grids:
+        for _ in range(num_samples):
+            cfg: Dict[str, Any] = {}
+            for (p, _), val in zip(grid_axes, combo):
+                _set_path(cfg, p, val)
+            deferred = []
+            for p, v in leaves:
+                if isinstance(v, GridSearch):
+                    continue
+                if isinstance(v, SampleFrom):
+                    deferred.append((p, v))
+                elif isinstance(v, Domain):
+                    _set_path(cfg, p, v.sample(rng))
+                else:
+                    _set_path(cfg, p, v)
+            for p, v in deferred:  # sample_from sees the resolved spec
+                _set_path(cfg, p, v.fn(cfg))
+            out.append(cfg)
+    return out
+
+
+class Searcher:
+    """ABC for sequential-suggestion search algorithms
+    (reference ``search/searcher.py``)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str],
+                              space: Dict[str, Any]) -> None:
+        self.metric = metric or self.metric
+        self.mode = mode or self.mode
+        self._space = space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid + random sampling — the default (reference ``basic_variant.py``)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int,
+                 seed: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self._variants = generate_variants(space, num_samples, seed)
+        self._i = 0
+
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._i >= len(self._variants):
+            return None
+        cfg = self._variants[self._i]
+        self._i += 1
+        return cfg
+
+
+class HyperbandImprovementSearcher(Searcher):
+    """Exploitation-biased random search: after enough observations, new
+    suggestions are perturbed copies of top performers (a light TPE stand-in
+    implemented without external deps)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int,
+                 seed: Optional[int] = None, exploit_after: int = 4,
+                 top_fraction: float = 0.25, **kw):
+        super().__init__(**kw)
+        self._space = space
+        self._num = num_samples
+        self._rng = random.Random(seed)
+        self._exploit_after = exploit_after
+        self._top_fraction = top_fraction
+        self._suggested = 0
+        self._observed: List[Tuple[float, Dict[str, Any]]] = []
+        self._trial_cfg: Dict[str, Dict[str, Any]] = {}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self._num:
+            return None
+        self._suggested += 1
+        if len(self._observed) >= self._exploit_after and self._rng.random() < 0.5:
+            cfg = self._exploit()
+        else:
+            cfg = generate_variants(self._space, 1,
+                                    self._rng.randrange(1 << 30))[0]
+        self._trial_cfg[trial_id] = cfg
+        return cfg
+
+    def _exploit(self) -> Dict[str, Any]:
+        ordered = sorted(self._observed, key=lambda t: t[0],
+                         reverse=(self.mode == "max"))
+        k = max(1, int(len(ordered) * self._top_fraction))
+        base = dict(self._rng.choice(ordered[:k])[1])
+        # re-sample one stochastic axis as the perturbation
+        leaves = [(p, v) for p, v in _walk(self._space)
+                  if isinstance(v, Domain) and not isinstance(v, SampleFrom)]
+        if leaves:
+            p, dom = self._rng.choice(leaves)
+            _set_path(base, p, dom.sample(self._rng))
+        return base
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if result and self.metric in result and not error:
+            self._observed.append(
+                (result[self.metric], self._trial_cfg.get(trial_id, {})))

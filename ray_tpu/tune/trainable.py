@@ -1,0 +1,124 @@
+"""Trainable API + the function-trainable wrapper and report session.
+
+Reference: ``python/ray/tune/trainable/trainable.py`` (class API: setup /
+step / save_checkpoint / load_checkpoint) and
+``trainable/function_trainable.py`` (function API bridged through a report
+queue; ``ray.tune.report`` a.k.a. ``session.report``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_session = threading.local()
+
+
+def report(metrics: Optional[Dict[str, Any]] = None, *,
+           checkpoint: Optional[Dict[str, Any]] = None, **kw) -> None:
+    """Report metrics (and optionally a checkpoint dict) from a function
+    trainable.  Inside ray_tpu.train workers this delegates to the train
+    session."""
+    q = getattr(_session, "queue", None)
+    if q is None:
+        from ray_tpu.train import session as train_session
+
+        if train_session._session is not None:
+            train_session.report(dict(metrics or {}, **kw),
+                                 checkpoint=checkpoint)
+            return
+        raise RuntimeError("tune.report() called outside a trial")
+    metrics = dict(metrics or {}, **kw)
+    q.put(("report", metrics, checkpoint))
+
+
+def get_checkpoint() -> Optional[Dict[str, Any]]:
+    return getattr(_session, "checkpoint", None)
+
+
+class Trainable:
+    """Class API: subclass and implement setup/step (+ save/load for PBT)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        self.iteration = 0
+        self.setup(config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement save_checkpoint for "
+            f"pause/exploit support")
+
+    def load_checkpoint(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+    # controller-facing
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        out = self.step()
+        out.setdefault("training_iteration", self.iteration)
+        return out
+
+
+class FunctionTrainable(Trainable):
+    """Runs ``fn(config)`` on a thread; each ``tune.report`` becomes one
+    step() result."""
+
+    _DONE = object()
+
+    def __init__(self, config: Dict[str, Any], fn: Callable[[Dict], Any],
+                 checkpoint: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._restored = checkpoint
+        self.latest_checkpoint: Optional[Dict[str, Any]] = None
+        super().__init__(config)
+
+    def setup(self, config):
+        def run():
+            _session.queue = self._q
+            _session.checkpoint = self._restored
+            try:
+                self._fn(config)
+            except BaseException as e:  # noqa: BLE001
+                self._error = e
+            finally:
+                self._q.put(FunctionTrainable._DONE)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="tune-fn-trainable")
+        self._thread.start()
+
+    def step(self) -> Dict[str, Any]:
+        item = self._q.get()
+        if item is FunctionTrainable._DONE:
+            if self._error is not None:
+                raise self._error
+            return {"done": True}
+        _kind, metrics, ckpt = item
+        if ckpt is not None:
+            self.latest_checkpoint = ckpt
+        metrics.setdefault("done", False)
+        return metrics
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        if self.latest_checkpoint is None:
+            raise RuntimeError(
+                "function trainable never reported a checkpoint; pass "
+                "checkpoint= to tune.report() to enable pause/exploit")
+        return self.latest_checkpoint
+
+    def cleanup(self):
+        pass

@@ -1,0 +1,387 @@
+"""Tuner + TuneController: the HPO execution engine.
+
+Reference: ``python/ray/tune/tune.py`` (``tune.run``), ``tuner.py`` (Tuner
+facade), and the event loop in ``tune/execution/tune_controller.py:68`` —
+trials run as actors, the controller steps them, consults the scheduler on
+every result, and the searcher on every completion.
+
+TPU note: a trial's ``resources={"num_tpus": n}`` gates scheduling on chip
+resources, so concurrent trials time-share a host's chips safely; a trial
+that is itself a distributed JaxTrainer run nests via
+``tune_trainer_adapter``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trainable import FunctionTrainable, Trainable
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    config: Dict[str, Any]
+    path: Optional[str] = None
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    checkpoint: Optional[Dict[str, Any]] = None
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        ok = [r for r in self._results
+              if r.metrics is not None and metric in r.metrics]
+        if not ok:
+            raise RuntimeError("no trial reported the target metric "
+                               f"{metric!r}; errors: {self.errors}")
+        return (max if mode == "max" else min)(
+            ok, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row.update({f"config/{k}": v for k, v in _flatten(r.config).items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out
+
+
+@ray_tpu.remote
+class _TrialActor:
+    """Hosts one Trainable instance; stepped by the controller."""
+
+    def __init__(self, trainable_spec: Dict[str, Any], config: Dict[str, Any],
+                 checkpoint: Optional[Dict[str, Any]] = None):
+        kind = trainable_spec["kind"]
+        target = trainable_spec["target"]
+        if kind == "function":
+            self._t: Trainable = FunctionTrainable(config, target,
+                                                   checkpoint=checkpoint)
+        else:
+            self._t = target(config)
+            if checkpoint is not None:
+                self._t.load_checkpoint(checkpoint)
+
+    def train(self) -> Dict[str, Any]:
+        return self._t.train()
+
+    def save(self) -> Dict[str, Any]:
+        return self._t.save_checkpoint()
+
+    def restore(self, state: Dict[str, Any]) -> bool:
+        self._t.load_checkpoint(state)
+        return True
+
+    def set_config(self, config: Dict[str, Any]) -> bool:
+        self._t.config = config
+        if hasattr(self._t, "reset_config"):
+            self._t.reset_config(config)
+        return True
+
+    def stop(self) -> bool:
+        self._t.cleanup()
+        return True
+
+
+class Trial:
+    PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
+
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 resources: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.resources = resources
+        self.status = Trial.PENDING
+        self.actor = None
+        self.step_ref = None
+        self.history: List[Dict[str, Any]] = []
+        self.error: Optional[str] = None
+        self.last_checkpoint: Optional[Dict[str, Any]] = None
+        self.num_failures = 0
+        self._exploit_req = None
+
+    @property
+    def last_result(self) -> Optional[Dict[str, Any]]:
+        return self.history[-1] if self.history else None
+
+    def request_exploit(self, donor: "Trial", new_config: Dict[str, Any]):
+        """Called by PBT: clone donor's checkpoint, adopt perturbed config."""
+        self._exploit_req = (donor, new_config)
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status})"
+
+
+class TuneController:
+    """The trial event loop (reference ``tune_controller.py:68``)."""
+
+    def __init__(self, trainable_spec, searcher: Searcher,
+                 scheduler: TrialScheduler, cfg: TuneConfig,
+                 resources: Dict[str, Any], stop: Optional[Dict[str, Any]],
+                 storage_path: Optional[str], name: str):
+        self._spec = trainable_spec
+        self._searcher = searcher
+        self._scheduler = scheduler
+        self._cfg = cfg
+        self._resources = resources
+        self._stop_criteria = stop or {}
+        self._dir = None
+        if storage_path:
+            self._dir = os.path.join(storage_path, name)
+            os.makedirs(self._dir, exist_ok=True)
+        self._trials: List[Trial] = []
+        self._next_id = 0
+
+    def _new_trial(self) -> Optional[Trial]:
+        tid = f"t{self._next_id:05d}"
+        cfg = self._searcher.suggest(tid)
+        if cfg is None:
+            return None
+        self._next_id += 1
+        t = Trial(tid, cfg, self._resources)
+        self._trials.append(t)
+        return t
+
+    def _launch(self, trial: Trial, checkpoint: Optional[Dict] = None):
+        opts = dict(trial.resources)
+        trial.actor = _TrialActor.options(**opts).remote(
+            self._spec, trial.config, checkpoint)
+        trial.status = Trial.RUNNING
+        trial.step_ref = trial.actor.train.remote()
+
+    def _finish(self, trial: Trial, status: str, error: Optional[str] = None):
+        trial.status = status
+        trial.error = error
+        if trial.actor is not None:
+            try:
+                trial.actor.stop.remote()
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.step_ref = None
+        self._searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=status == Trial.ERROR)
+        self._scheduler.on_trial_complete(trial, trial.last_result)
+        self._write_trial_log(trial)
+
+    def _write_trial_log(self, trial: Trial):
+        if not self._dir:
+            return
+        path = os.path.join(self._dir, f"{trial.trial_id}.json")
+        with open(path, "w") as f:
+            json.dump({"trial_id": trial.trial_id, "config": trial.config,
+                       "status": trial.status, "error": trial.error,
+                       "history": [
+                           {k: v for k, v in r.items()
+                            if isinstance(v, (int, float, str, bool, type(None)))}
+                           for r in trial.history]}, f, default=str)
+
+    def _should_stop_trial(self, result: Dict[str, Any]) -> bool:
+        if result.get("done"):
+            return True
+        for k, v in self._stop_criteria.items():
+            if k in result:
+                if k == "training_iteration" and result[k] >= v:
+                    return True
+                if k != "training_iteration":
+                    cmp = result[k] >= v if self._cfg.mode == "max" else result[k] <= v
+                    if cmp:
+                        return True
+        return False
+
+    def run(self) -> List[Trial]:
+        max_conc = self._cfg.max_concurrent_trials or 4
+        while True:
+            running = [t for t in self._trials if t.status == Trial.RUNNING]
+            # top up
+            while len(running) < max_conc:
+                t = self._new_trial()
+                if t is None:
+                    break
+                self._launch(t)
+                running.append(t)
+            if not running:
+                break
+            # wait for any step, then drain everything already done so no
+            # fast trial starves the others (fairness across trials)
+            refs = [t.step_ref for t in running]
+            ready, rest = ray_tpu.wait(refs, num_returns=1, timeout=5.0)
+            if rest:
+                more, _ = ray_tpu.wait(rest, num_returns=len(rest), timeout=0)
+                ready.extend(more)
+            for ref in ready:
+                trial = next(t for t in running if t.step_ref == ref)
+                self._process_step(trial)
+        return self._trials
+
+    def _process_step(self, trial: Trial):
+        try:
+            result = ray_tpu.get(trial.step_ref)
+        except Exception as e:
+            trial.num_failures += 1
+            if trial.num_failures <= self._cfg.max_failures:
+                # retry from last checkpoint (failure tolerance)
+                self._launch(trial, trial.last_checkpoint)
+                return
+            self._finish(trial, Trial.ERROR, error=repr(e))
+            return
+        trial.history.append(result)
+        self._searcher.on_trial_result(trial.trial_id, result)
+        decision = self._scheduler.on_trial_result(trial, result)
+        if self._should_stop_trial(result) or decision == TrialScheduler.STOP:
+            self._finish(trial, Trial.TERMINATED)
+            return
+        # PBT exploit: clone donor checkpoint + new config, then continue
+        if trial._exploit_req is not None:
+            donor, new_cfg = trial._exploit_req
+            trial._exploit_req = None
+            try:
+                state = ray_tpu.get(donor.actor.save.remote(), timeout=60) \
+                    if donor.actor is not None else donor.last_checkpoint
+                if state is not None:
+                    ray_tpu.get(trial.actor.restore.remote(state), timeout=60)
+                    ray_tpu.get(trial.actor.set_config.remote(new_cfg),
+                                timeout=60)
+                    trial.config = new_cfg
+                    trial.last_checkpoint = state
+            except Exception:
+                pass  # exploit is best-effort; trial continues as-is
+        trial.step_ref = trial.actor.train.remote()
+
+
+class Tuner:
+    """Facade (reference ``python/ray/tune/tuner.py``)."""
+
+    def __init__(self, trainable: Callable | type, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[Any] = None,
+                 resources_per_trial: Optional[Dict[str, Any]] = None):
+        self._trainable = trainable
+        self._space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+        self._run_config = run_config
+        self._resources = resources_per_trial or {"num_cpus": 1}
+
+    def fit(self) -> ResultGrid:
+        cfg = self._cfg
+        searcher = cfg.search_alg
+        if searcher is None:
+            searcher = BasicVariantGenerator(self._space, cfg.num_samples,
+                                             seed=cfg.seed)
+        else:
+            searcher.set_search_properties(cfg.metric, cfg.mode, self._space)
+        scheduler = cfg.scheduler or FIFOScheduler()
+        scheduler.set_properties(cfg.metric, cfg.mode)
+
+        if isinstance(self._trainable, type) and issubclass(self._trainable,
+                                                            Trainable):
+            spec = {"kind": "class", "target": self._trainable}
+        elif callable(self._trainable):
+            spec = {"kind": "function", "target": self._trainable}
+        else:
+            raise TypeError("trainable must be a function or Trainable class")
+
+        stop = getattr(self._run_config, "stop", None) if self._run_config else None
+        storage = getattr(self._run_config, "storage_path", None) \
+            if self._run_config else None
+        name = (getattr(self._run_config, "name", None)
+                if self._run_config else None) or f"tune-{uuid.uuid4().hex[:8]}"
+
+        controller = TuneController(spec, searcher, scheduler, cfg,
+                                    self._resources, stop, storage, name)
+        trials = controller.run()
+        results = []
+        for t in trials:
+            best = None
+            if t.history:
+                reported = [r for r in t.history if cfg.metric in r]
+                if reported:
+                    best = (max if cfg.mode == "max" else min)(
+                        reported, key=lambda r: r[cfg.metric])
+                else:
+                    best = t.history[-1]
+            results.append(Result(metrics=best, config=t.config,
+                                  error=t.error, metrics_history=t.history,
+                                  checkpoint=t.last_checkpoint))
+        return ResultGrid(results, cfg.metric, cfg.mode)
+
+
+def run(trainable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: str = "loss", mode: str = "min",
+        scheduler: Optional[TrialScheduler] = None,
+        search_alg: Optional[Searcher] = None,
+        stop: Optional[Dict[str, Any]] = None,
+        resources_per_trial: Optional[Dict[str, Any]] = None,
+        max_concurrent_trials: Optional[int] = None,
+        seed: Optional[int] = None) -> ResultGrid:
+    """Functional entry point (reference ``tune.run``)."""
+
+    class _RC:
+        pass
+
+    rc = _RC()
+    rc.stop = stop
+    rc.storage_path = None
+    rc.name = None
+    tuner = Tuner(
+        trainable, param_space=config or {},
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler,
+                               search_alg=search_alg, seed=seed,
+                               max_concurrent_trials=max_concurrent_trials),
+        run_config=rc, resources_per_trial=resources_per_trial)
+    return tuner.fit()
