@@ -1,0 +1,143 @@
+"""ray_tpu.serve: online model serving (reference: ``python/ray/serve/``).
+
+``serve.run(app)`` deploys a bound deployment graph behind the singleton
+controller; ``DeploymentHandle.remote()`` routes via pow-2 choices; an
+optional HTTP proxy exposes route prefixes (``serve.start(http_options=...)``).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from ray_tpu.serve.replica import batch
+from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
+    "get_app_handle", "get_deployment_handle", "run", "shutdown", "start",
+    "status",
+]
+
+_proxy = None
+
+
+def start(http_options: Optional[Dict[str, Any]] = None):
+    """Start serve (controller + optional HTTP proxy)."""
+    from ray_tpu.serve.controller import get_controller
+
+    get_controller()
+    global _proxy
+    if http_options and _proxy is None:
+        from ray_tpu.serve.proxy import ProxyActor
+
+        host = http_options.get("host", "127.0.0.1")
+        port = http_options.get("port", 8000)
+        _proxy = ProxyActor.remote(host, port)
+        ray_tpu.get(_proxy.ready.remote(), timeout=60)
+    return _proxy
+
+
+def run(target: Application | Deployment, *, name: str = "default",
+        route_prefix: Optional[str] = "/", _blocking: bool = False
+        ) -> DeploymentHandle:
+    """Deploy an application graph; returns the ingress handle
+    (reference ``serve.run`` at ``python/ray/serve/api.py:660``)."""
+    from ray_tpu._private import serialization
+    from ray_tpu.serve.controller import get_controller
+
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects a Deployment or bound Application")
+
+    controller = get_controller()
+    apps = target._collect()  # dependencies first
+    handles: Dict[int, DeploymentHandle] = {}
+    for app in apps:
+        dep = app.deployment
+        # replace Application args with handles to the deployed dependency
+        init_args = tuple(handles[id(a)] if isinstance(a, Application) else a
+                          for a in app.args)
+        init_kwargs = {k: handles[id(v)] if isinstance(v, Application) else v
+                       for k, v in app.kwargs.items()}
+        is_ingress = app is apps[-1]
+        cfg = dep.config
+        config_dict = {
+            "num_replicas": cfg.num_replicas,
+            "max_ongoing_requests": cfg.max_ongoing_requests,
+            "autoscaling_config": (
+                None if cfg.autoscaling_config is None else {
+                    "min_replicas": cfg.autoscaling_config.min_replicas,
+                    "max_replicas": cfg.autoscaling_config.max_replicas,
+                    "target_ongoing_requests":
+                        cfg.autoscaling_config.target_ongoing_requests,
+                    "upscale_delay_s": cfg.autoscaling_config.upscale_delay_s,
+                    "downscale_delay_s":
+                        cfg.autoscaling_config.downscale_delay_s,
+                }),
+            "user_config": cfg.user_config,
+            "ray_actor_options": cfg.ray_actor_options,
+        }
+        prefix = (dep.route_prefix or route_prefix) if is_ingress else None
+        ray_tpu.get(controller.deploy.remote(
+            dep.name, serialization.dumps(dep._target), init_args,
+            init_kwargs, config_dict, prefix,
+            name if is_ingress else None), timeout=120)
+        handles[id(app)] = DeploymentHandle(dep.name)
+    return handles[id(apps[-1])]
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    from ray_tpu.serve.controller import get_controller
+
+    ingress = ray_tpu.get(get_controller().get_app_ingress.remote(name))
+    if ingress is None:
+        raise RuntimeError(f"no application named {name!r}")
+    return DeploymentHandle(ingress)
+
+
+def status() -> Dict[str, Any]:
+    from ray_tpu.serve.controller import get_controller
+
+    return ray_tpu.get(get_controller().list_deployments.remote())
+
+
+def delete(deployment_name: str):
+    from ray_tpu.serve.controller import get_controller
+
+    ray_tpu.get(get_controller().delete_deployment.remote(deployment_name))
+
+
+def shutdown():
+    global _proxy
+    from ray_tpu.actor import get_actor_or_none
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+
+    controller = get_actor_or_none(CONTROLLER_NAME)
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=60)
+            ray_tpu.kill(controller)
+        except Exception:
+            pass
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+        _proxy = None

@@ -1,0 +1,240 @@
+"""ServeController: the reconciliation loop.
+
+Reference: ``python/ray/serve/_private/controller.py:86`` (singleton
+controller actor), ``deployment_state.py`` (goal-state reconciliation),
+``autoscaling_state.py`` + ``autoscaling_policy.py`` (queue-depth-driven
+replica autoscaling).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@ray_tpu.remote(name=CONTROLLER_NAME, max_restarts=1)
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._apps: Dict[str, str] = {}  # app name -> ingress deployment
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._loop = threading.Thread(target=self._reconcile_loop, daemon=True)
+        self._loop.start()
+
+    # -- deploy / delete -----------------------------------------------------
+
+    def deploy(self, name: str, target_payload: bytes, init_args: tuple,
+               init_kwargs: dict, config: Dict[str, Any],
+               route_prefix: Optional[str],
+               app_name: Optional[str] = None) -> bool:
+        old_replicas: List[Any] = []
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                st = {"replicas": [], "version": 0, "last_scale": 0.0,
+                      "scale_marks": []}
+                self._deployments[name] = st
+            elif st.get("target") != target_payload or st.get("config") != config:
+                # code or config changed: running replicas embed the OLD
+                # payload — restart them all (full restart, not rolling)
+                old_replicas = list(st["replicas"])
+                st["replicas"] = []
+            st.update(
+                target=target_payload, init_args=init_args,
+                init_kwargs=init_kwargs, config=config,
+                goal_replicas=config["num_replicas"])
+            if app_name:
+                self._apps[app_name] = name
+            asc = config.get("autoscaling_config")
+            if asc:
+                st["goal_replicas"] = max(asc["min_replicas"],
+                                          min(st["goal_replicas"],
+                                              asc["max_replicas"]))
+            st["version"] += 1
+            if route_prefix:
+                self._routes[route_prefix] = name
+        for r in old_replicas:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            st = self._deployments.pop(name, None)
+            self._routes = {r: d for r, d in self._routes.items() if d != name}
+            self._apps = {a: d for a, d in self._apps.items() if d != name}
+        if st:
+            for r in st["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    def shutdown(self) -> bool:
+        with self._lock:
+            names = list(self._deployments)
+        for n in names:
+            self.delete_deployment(n)
+        self._stop.set()
+        return True
+
+    # -- queries -------------------------------------------------------------
+
+    def get_deployment_info(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return None
+            return {"replicas": list(st["replicas"]),
+                    "max_ongoing_requests":
+                        st["config"]["max_ongoing_requests"],
+                    "version": st["version"]}
+
+    def get_version(self, name: str) -> int:
+        with self._lock:
+            st = self._deployments.get(name)
+            return -1 if st is None else st["version"]
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {name: {"num_replicas": len(st["replicas"]),
+                           "goal": st.get("goal_replicas", 0),
+                           "version": st["version"]}
+                    for name, st in self._deployments.items()}
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def get_app_ingress(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            return self._apps.get(app_name)
+
+    def reconfigure(self, name: str, user_config: dict) -> bool:
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return False
+            st["config"]["user_config"] = user_config
+            replicas = list(st["replicas"])
+        ray_tpu.get([r.reconfigure.remote(user_config) for r in replicas])
+        return True
+
+    # -- reconciliation ------------------------------------------------------
+
+    def _start_replica(self, name: str, st: Dict[str, Any]):
+        rid = f"{name}#{uuid.uuid4().hex[:6]}"
+        from ray_tpu.serve.replica import ReplicaActor
+
+        opts = dict(st["config"].get("ray_actor_options") or {})
+        # a replica must admit max_ongoing_requests concurrent calls (the
+        # router's load metric — and @serve.batch needs in-replica concurrency)
+        opts.setdefault("max_concurrency",
+                        max(2, st["config"]["max_ongoing_requests"]))
+        handle = ReplicaActor.options(**opts).remote(
+            st["target"], st["init_args"], st["init_kwargs"],
+            st["config"].get("user_config"), name, rid)
+        st["replicas"].append(handle)
+        st["version"] += 1
+
+    def _reconcile_once(self):
+        with self._lock:
+            items = list(self._deployments.items())
+            for name, st in items:
+                goal = st.get("goal_replicas", 0)
+                while len(st["replicas"]) < goal:
+                    self._start_replica(name, st)
+                while len(st["replicas"]) > goal:
+                    victim = st["replicas"].pop()
+                    st["version"] += 1
+                    try:
+                        ray_tpu.kill(victim)
+                    except Exception:
+                        pass
+
+    def _autoscale_once(self):
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, st in items:
+            asc = st["config"].get("autoscaling_config")
+            if not asc:
+                continue
+            replicas = list(st["replicas"])
+            if not replicas:
+                continue
+            total = 0
+            for r in replicas:
+                try:
+                    total += ray_tpu.get(r.get_queue_len.remote(), timeout=5)
+                except Exception:
+                    pass
+            avg = total / len(replicas)
+            now = time.monotonic()
+            with self._lock:
+                target = asc["target_ongoing_requests"]
+                goal = st.get("goal_replicas", 1)
+                if avg > target and goal < asc["max_replicas"]:
+                    if now - st["last_scale"] >= asc["upscale_delay_s"]:
+                        st["goal_replicas"] = min(goal + 1, asc["max_replicas"])
+                        st["last_scale"] = now
+                elif avg < target * 0.5 and goal > asc["min_replicas"]:
+                    if now - st["last_scale"] >= asc["downscale_delay_s"]:
+                        st["goal_replicas"] = max(goal - 1, asc["min_replicas"])
+                        st["last_scale"] = now
+
+    def _health_check_once(self):
+        with self._lock:
+            items = [(n, list(st["replicas"])) for n, st in
+                     self._deployments.items()]
+        for name, replicas in items:
+            for r in replicas:
+                try:
+                    ray_tpu.get(r.check_health.remote(), timeout=10)
+                except Exception:
+                    with self._lock:
+                        st = self._deployments.get(name)
+                        if st and r in st["replicas"]:
+                            st["replicas"].remove(r)
+                            st["version"] += 1
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+
+    def _reconcile_loop(self):
+        n = 0
+        while not self._stop.is_set():
+            try:
+                self._autoscale_once()
+                self._reconcile_once()
+                if n % 10 == 9:
+                    self._health_check_once()
+            except Exception:
+                pass
+            n += 1
+            self._stop.wait(1.0)
+
+    def ping(self) -> bool:
+        return True
+
+
+def get_controller():
+    from ray_tpu.actor import get_actor_or_none
+
+    handle = get_actor_or_none(CONTROLLER_NAME)
+    if handle is None:
+        handle = ServeController.options(get_if_exists=True).remote()
+        ray_tpu.get(handle.ping.remote(), timeout=60)
+    return handle

@@ -1,0 +1,129 @@
+"""Deployment definition + @serve.deployment decorator.
+
+Reference: ``python/ray/serve/deployment.py`` (``Deployment`` dataclass,
+``bind``) and ``python/ray/serve/api.py`` (``@serve.deployment``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Reference: ``serve/config.py`` AutoscalingConfig (queue-depth driven)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 10.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    user_config: Optional[Dict[str, Any]] = None
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    health_check_period_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 10.0
+
+
+class Deployment:
+    def __init__(self, cls_or_fn: Any, name: str, config: DeploymentConfig,
+                 init_args: Tuple = (), init_kwargs: Optional[Dict] = None,
+                 route_prefix: Optional[str] = None):
+        self._target = cls_or_fn
+        self.name = name
+        self.config = config
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs or {}
+        self.route_prefix = route_prefix
+
+    def options(self, *, num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[AutoscalingConfig | dict] = None,
+                user_config: Optional[Dict[str, Any]] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                name: Optional[str] = None,
+                route_prefix: Optional[str] = None) -> "Deployment":
+        cfg = dataclasses.replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+        if user_config is not None:
+            cfg.user_config = user_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = ray_actor_options
+        return Deployment(self._target, name or self.name, cfg,
+                          self.init_args, self.init_kwargs,
+                          route_prefix if route_prefix is not None
+                          else self.route_prefix)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        """Bind constructor args (possibly other Applications → composition)."""
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment({self.name})"
+
+
+class Application:
+    """A bound deployment graph node (reference ``serve/_private/build_app``)."""
+
+    def __init__(self, deployment: Deployment, args: Tuple, kwargs: Dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def _collect(self) -> List["Application"]:
+        """All applications in this graph, dependencies first."""
+        seen: Dict[int, Application] = {}
+        order: List[Application] = []
+
+        def visit(app: Application):
+            if id(app) in seen:
+                return
+            seen[id(app)] = app
+            for a in list(app.args) + list(app.kwargs.values()):
+                if isinstance(a, Application):
+                    visit(a)
+            order.append(app)
+
+        visit(self)
+        return order
+
+
+def deployment(cls_or_fn: Any = None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_ongoing_requests: int = 16,
+               autoscaling_config: Optional[AutoscalingConfig | dict] = None,
+               user_config: Optional[Dict[str, Any]] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               route_prefix: Optional[str] = None):
+    """``@serve.deployment`` — wraps a class (or function) as a Deployment."""
+
+    def wrap(target):
+        if autoscaling_config is not None and isinstance(autoscaling_config, dict):
+            asc = AutoscalingConfig(**autoscaling_config)
+        else:
+            asc = autoscaling_config
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=asc,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options or {})
+        return Deployment(target, name or getattr(target, "__name__", "app"),
+                          cfg, route_prefix=route_prefix)
+
+    if cls_or_fn is not None:
+        return wrap(cls_or_fn)
+    return wrap

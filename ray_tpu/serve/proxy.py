@@ -1,0 +1,128 @@
+"""HTTP proxy actor: routes requests to deployments.
+
+Reference: ``python/ray/serve/_private/proxy.py`` (``ProxyActor :1137``,
+HTTP handler :750) — an aiohttp server per node; the route table comes from
+the controller (long-poll analog: refreshed on miss and periodically).
+
+Request contract: ``GET/POST {route_prefix}[/suffix]`` → deployment's
+``__call__`` receives the JSON body (POST) or query-param dict (GET);
+the JSON-serialized return value is the response body.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ProxyActor:
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, str] = {}
+        self._routes_at = 0.0
+        self._handles: Dict[str, Any] = {}
+        self._ready = threading.Event()
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="serve-proxy")
+        self._thread.start()
+        if not self._ready.wait(timeout=30):
+            raise RuntimeError(f"proxy failed to bind: {self._error}")
+
+    def ready(self) -> int:
+        return self._port
+
+    def _refresh_routes(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._routes_at < 2.0:
+            return
+        from ray_tpu.serve.controller import get_controller
+
+        self._routes = ray_tpu.get(get_controller().get_routes.remote())
+        self._routes_at = now
+
+    def _resolve(self, path: str) -> Optional[str]:
+        self._refresh_routes()
+        # longest matching prefix wins
+        best = None
+        for prefix, dep in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
+                    or (prefix == "/" and path.startswith("/")):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, dep)
+        if best is None:
+            self._refresh_routes(force=True)
+            for prefix, dep in self._routes.items():
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                    if best is None or len(prefix) > len(best[0]):
+                        best = (prefix, dep)
+        return best[1] if best else None
+
+    def _handle_for(self, deployment: str):
+        h = self._handles.get(deployment)
+        if h is None:
+            from ray_tpu.serve.router import DeploymentHandle
+
+            h = DeploymentHandle(deployment)
+            self._handles[deployment] = h
+        return h
+
+    def _serve(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        from aiohttp import web
+
+        async def handler(request: "web.Request") -> "web.Response":
+            # route resolution can hit the controller (blocking get): keep it
+            # off the event loop thread along with the routed call itself
+            dep = await loop.run_in_executor(None, self._resolve, request.path)
+            if dep is None:
+                return web.json_response(
+                    {"error": f"no deployment for {request.path}"}, status=404)
+            if request.method == "POST":
+                try:
+                    body = await request.json()
+                except Exception:
+                    body = (await request.read()).decode("utf-8", "replace")
+            else:
+                body = dict(request.query)
+            handle = self._handle_for(dep)
+            try:
+                resp = await loop.run_in_executor(
+                    None, lambda: handle.remote(body).result(timeout=60))
+            except Exception as e:
+                return web.json_response({"error": repr(e)}, status=500)
+            try:
+                return web.json_response(resp)
+            except TypeError:
+                return web.Response(text=str(resp))
+
+        async def health(_request):
+            return web.json_response({"status": "ok"})
+
+        app = web.Application()
+        app.router.add_route("GET", "/-/healthz", health)
+        app.router.add_route("*", "/{tail:.*}", handler)
+        runner = web.AppRunner(app)
+
+        async def start():
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            await site.start()
+
+        try:
+            loop.run_until_complete(start())
+        except Exception as e:
+            self._error = repr(e)
+            self._ready.set()
+            return
+        self._ready.set()
+        loop.run_forever()

@@ -1,0 +1,161 @@
+"""Replica actor: hosts one copy of the user callable.
+
+Reference: ``python/ray/serve/_private/replica.py`` (``ReplicaActor :914``,
+``handle_request``) and request batching (``python/ray/serve/batching.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class _BatchQueue:
+    """Accumulate calls, flush at max_batch_size or batch_wait_timeout_s."""
+
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        self._items: List = []
+        self._flush_at: Optional[float] = None
+        self._cond = threading.Condition(self._lock)
+        self._worker: Optional[threading.Thread] = None
+
+    def submit(self, item: Any) -> "threading.Event":
+        slot = {"done": threading.Event(), "item": item, "result": None,
+                "error": None}
+        with self._cond:
+            # lazy worker start: a queue that loses the setdefault race in
+            # @serve.batch is never submitted to and so leaks no thread
+            if self._worker is None:
+                self._worker = threading.Thread(target=self._run, daemon=True)
+                self._worker.start()
+            self._items.append(slot)
+            if self._flush_at is None:
+                self._flush_at = time.monotonic() + self._timeout
+            self._cond.notify()
+        return slot
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._items or (
+                        len(self._items) < self._max
+                        and time.monotonic() < (self._flush_at or 0)):
+                    wait = (None if not self._items
+                            else max(0.0, self._flush_at - time.monotonic()))
+                    self._cond.wait(timeout=wait)
+                batch = self._items[:self._max]
+                self._items = self._items[self._max:]
+                self._flush_at = (time.monotonic() + self._timeout
+                                  if self._items else None)
+            try:
+                results = self._fn([s["item"] for s in batch])
+                if len(results) != len(batch):
+                    raise ValueError(
+                        f"@serve.batch fn returned {len(results)} results "
+                        f"for a batch of {len(batch)}")
+                for s, r in zip(batch, results):
+                    s["result"] = r
+                    s["done"].set()
+            except BaseException as e:  # noqa: BLE001
+                for s in batch:
+                    s["error"] = e
+                    s["done"].set()
+
+
+def batch(fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch``: calls to the wrapped method are grouped into lists.
+
+    No locks in the closure (cloudpickle serializes decorated classes by
+    value, and Lock objects don't pickle); first-call queue creation races
+    are settled by the atomic dict.setdefault.
+    """
+
+    def wrap(f):
+        attr = f"__serve_batch_queue_{f.__name__}"
+
+        def call(self, item):
+            q = self.__dict__.get(attr)
+            if q is None:
+                q = self.__dict__.setdefault(
+                    attr, _BatchQueue(lambda items: f(self, items),
+                                      max_batch_size, batch_wait_timeout_s))
+            slot = q.submit(item)
+            slot["done"].wait()
+            if slot["error"] is not None:
+                raise slot["error"]
+            return slot["result"]
+
+        call.__name__ = f.__name__
+        call._is_serve_batch = True
+        return call
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+@ray_tpu.remote
+class ReplicaActor:
+    """Wraps the user callable; tracks ongoing-request count for the
+    pow-2 router and the autoscaler."""
+
+    def __init__(self, target_payload: bytes, init_args: tuple,
+                 init_kwargs: dict, user_config: Optional[dict],
+                 deployment_name: str, replica_id: str):
+        from ray_tpu._private import serialization
+
+        target = serialization.loads(target_payload)
+        self._deployment = deployment_name
+        self._replica_id = replica_id
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            # plain function deployment: calls go straight to it
+            self._callable = target
+        if user_config is not None and hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def reconfigure(self, user_config: dict) -> bool:
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        return True
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            fn = getattr(self._callable, method, None)
+            if fn is None:
+                raise AttributeError(
+                    f"deployment {self._deployment} has no method {method!r}")
+            result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = asyncio.run(result)  # creates AND closes the loop
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"replica_id": self._replica_id, "ongoing": self._ongoing,
+                "total": self._total}
+
+    def check_health(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            self._callable.check_health()
+        return True

@@ -1,0 +1,159 @@
+"""DeploymentHandle + power-of-two-choices replica routing.
+
+Reference: ``python/ray/serve/handle.py`` (``DeploymentHandle.remote :709``)
+and ``serve/_private/replica_scheduler/pow_2_scheduler.py``
+(``PowerOfTwoChoicesReplicaScheduler :52``, ``choose_replica_for_request
+:816``): sample two replicas, probe queue lengths (with a short-lived
+cache), send to the shorter queue.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class Router:
+    """Pow-2 replica chooser with a queue-length cache."""
+
+    QUEUE_LEN_CACHE_S = 2.0
+
+    def __init__(self, deployment_name: str, controller):
+        self._deployment = deployment_name
+        self._controller = controller
+        self._replicas: List[Any] = []
+        self._max_ongoing = 16
+        self._version = -1
+        self._qlen_cache: Dict[str, tuple] = {}  # actor id -> (len, expiry)
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+        self.refresh()
+
+    def refresh(self):
+        info = ray_tpu.get(
+            self._controller.get_deployment_info.remote(self._deployment))
+        if info is None:
+            raise KeyError(f"no deployment {self._deployment!r}")
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._max_ongoing = info["max_ongoing_requests"]
+            self._version = info["version"]
+            self._qlen_cache.clear()  # cache keys are replica ids; drop stale
+
+    def _maybe_refresh(self):
+        # long-poll analog: cheap version check piggybacked on the probe path
+        try:
+            v = ray_tpu.get(
+                self._controller.get_version.remote(self._deployment))
+        except Exception:
+            return
+        if v != self._version:
+            self.refresh()
+
+    def _cache_key(self, replica) -> str:
+        return replica._actor_id.hex()
+
+    def _probe(self, replica) -> int:
+        key = self._cache_key(replica)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._qlen_cache.get(key)
+            if hit and hit[1] > now:
+                return hit[0]
+        try:
+            qlen = ray_tpu.get(replica.get_queue_len.remote(), timeout=5)
+        except Exception:
+            qlen = 1 << 30  # unreachable replica: never prefer it
+        with self._lock:
+            self._qlen_cache[key] = (qlen, now + self.QUEUE_LEN_CACHE_S)
+        return qlen
+
+    def choose_replica(self):
+        # operate on a snapshot: a concurrent refresh() must not shift
+        # indices under us
+        with self._lock:
+            reps = list(self._replicas)
+        if not reps:
+            self._maybe_refresh()
+            with self._lock:
+                reps = list(self._replicas)
+            if not reps:
+                raise RuntimeError(
+                    f"deployment {self._deployment!r} has no replicas")
+        if len(reps) == 1:
+            return reps[0]
+        i, j = self._rng.sample(range(len(reps)), 2)
+        return reps[i] if self._probe(reps[i]) <= self._probe(reps[j]) \
+            else reps[j]
+
+    def note_dispatch(self, replica):
+        """Bump the cached queue length so back-to-back requests spread."""
+        key = self._cache_key(replica)
+        with self._lock:
+            hit = self._qlen_cache.get(key)
+            if hit:
+                self._qlen_cache[key] = (hit[0] + 1, hit[1])
+
+    def assign(self, method: str, args: tuple, kwargs: dict):
+        for attempt in range(3):
+            self._maybe_refresh()
+            replica = self.choose_replica()
+            try:
+                ref = replica.handle_request.remote(method, args, kwargs)
+                self.note_dispatch(replica)
+                return ref
+            except Exception:
+                if attempt == 2:
+                    raise
+                self.refresh()
+
+
+class DeploymentHandle:
+    """Client-side handle; composition-safe (picklable into replicas)."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._deployment = deployment_name
+        self._method = method_name
+        self._router: Optional[Router] = None
+        self._router_lock = threading.Lock()
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._deployment, self._method))
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._deployment, method_name)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self._deployment, name)
+
+    def _get_router(self) -> Router:
+        with self._router_lock:
+            if self._router is None:
+                from ray_tpu.serve.controller import get_controller
+
+                self._router = Router(self._deployment, get_controller())
+            return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        ref = self._get_router().assign(self._method, args, kwargs)
+        return DeploymentResponse(ref)

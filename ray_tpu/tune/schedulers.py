@@ -21,7 +21,12 @@ class TrialScheduler:
         self.metric = metric
         self.mode = mode
 
-    def _score(self, result: Dict[str, Any]) -> float:
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        """Normalized higher-is-better score, or None when the result does
+        not carry the metric (e.g. a function trainable's final done
+        sentinel) — callers must treat None as not-comparable."""
+        if self.metric not in result:
+            return None
         v = result[self.metric]
         return v if self.mode == "max" else -v
 
@@ -55,6 +60,7 @@ class AsyncHyperBandScheduler(TrialScheduler):
         self.rf = reduction_factor
         self.max_t = max_t
         self._rungs: Dict[int, List[float]] = {}
+        self._recorded: Dict[int, set] = {}  # rung -> trial_ids already in it
         milestones = []
         t = grace_period
         while t < max_t:
@@ -67,9 +73,15 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if t >= self.max_t:
             return self.STOP
         score = self._score(result)
+        if score is None:
+            return self.CONTINUE
         action = self.CONTINUE
         for m in self._milestones:
             if t >= m:
+                seen = self._recorded.setdefault(m, set())
+                if trial.trial_id in seen:
+                    break  # each trial enters each rung exactly once
+                seen.add(trial.trial_id)
                 rung = self._rungs.setdefault(m, [])
                 cutoff = None
                 if rung:
@@ -99,12 +111,19 @@ class MedianStoppingRule(TrialScheduler):
     def on_trial_result(self, trial, result):
         t = result.get(self.time_attr, 0)
         score = self._score(result)
+        if score is None:
+            return self.CONTINUE
         hist = self._histories.setdefault(trial.trial_id, [])
         hist.append(score)
         if t < self.grace or len(self._histories) < self.min_samples:
             return self.CONTINUE
-        avgs = [sum(h) / len(h) for tid, h in self._histories.items()
-                if h and tid != trial.trial_id]
+        # step-aligned comparison: other trials' running averages truncated
+        # to this trial's step count, so late starters aren't judged against
+        # veterans' full histories
+        n = len(hist)
+        avgs = [sum(h[:n]) / min(len(h), n)
+                for tid, h in self._histories.items()
+                if tid != trial.trial_id and len(h) >= n]
         if len(avgs) + 1 < self.min_samples:
             return self.CONTINUE
         median = sorted(avgs)[len(avgs) // 2]
@@ -138,9 +157,12 @@ class PopulationBasedTraining(TrialScheduler):
         self._trials: Dict[str, Any] = {}
 
     def on_trial_result(self, trial, result):
+        score = self._score(result)
+        if score is None:
+            return self.CONTINUE
         tid = trial.trial_id
         self._trials[tid] = trial
-        self._latest[tid] = self._score(result)
+        self._latest[tid] = score
         t = result.get(self.time_attr, 0)
         if t - self._last_perturb.get(tid, 0) < self.interval:
             return self.CONTINUE

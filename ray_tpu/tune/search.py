@@ -229,10 +229,14 @@ class HyperbandImprovementSearcher(Searcher):
         return cfg
 
     def _exploit(self) -> Dict[str, Any]:
+        import copy
+
         ordered = sorted(self._observed, key=lambda t: t[0],
                          reverse=(self.mode == "max"))
         k = max(1, int(len(ordered) * self._top_fraction))
-        base = dict(self._rng.choice(ordered[:k])[1])
+        # deep copy: _set_path on a nested space must not mutate the
+        # recorded observation (or the donor trial's live config)
+        base = copy.deepcopy(self._rng.choice(ordered[:k])[1])
         # re-sample one stochastic axis as the perturbation
         leaves = [(p, v) for p, v in _walk(self._space)
                   if isinstance(v, Domain) and not isinstance(v, SampleFrom)]

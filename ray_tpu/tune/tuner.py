@@ -36,6 +36,9 @@ class TuneConfig:
     scheduler: Optional[TrialScheduler] = None
     seed: Optional[int] = None
     max_failures: int = 0
+    # save a checkpoint every N steps (0 = only on PBT exploit); needed for
+    # retry-from-checkpoint to actually resume progress
+    checkpoint_freq: int = 0
 
 
 @dataclasses.dataclass
@@ -98,6 +101,19 @@ def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
     return out
 
 
+def _resolve_checkpoint(trial: "Trial"):
+    """Best checkpoint available: the newest pending save if its reply made
+    it back, else the last successfully resolved one (a save whose reply
+    raced an abrupt actor death is lost — fall back, don't restart at 0)."""
+    if trial.pending_save is not None:
+        try:
+            trial.last_checkpoint = ray_tpu.get(trial.pending_save, timeout=15)
+        except Exception:
+            pass
+        trial.pending_save = None
+    return trial.last_checkpoint
+
+
 @ray_tpu.remote
 class _TrialActor:
     """Hosts one Trainable instance; stepped by the controller."""
@@ -148,7 +164,11 @@ class Trial:
         self.step_ref = None
         self.history: List[Dict[str, Any]] = []
         self.error: Optional[str] = None
+        # last RESOLVED checkpoint dict (safe to restore from) + the ref of
+        # the newest in-flight async save (its reply can be lost if the
+        # actor dies abruptly right after saving — at-most-once semantics)
         self.last_checkpoint: Optional[Dict[str, Any]] = None
+        self.pending_save = None
         self.num_failures = 0
         self._exploit_req = None
 
@@ -272,25 +292,53 @@ class TuneController:
             result = ray_tpu.get(trial.step_ref)
         except Exception as e:
             trial.num_failures += 1
+            # the old actor may still be alive (application-level error):
+            # kill it so the retry doesn't leak its process/resources
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:
+                    pass
+                trial.actor = None
             if trial.num_failures <= self._cfg.max_failures:
                 # retry from last checkpoint (failure tolerance)
-                self._launch(trial, trial.last_checkpoint)
+                self._launch(trial, _resolve_checkpoint(trial))
                 return
             self._finish(trial, Trial.ERROR, error=repr(e))
             return
         trial.history.append(result)
-        self._searcher.on_trial_result(trial.trial_id, result)
-        decision = self._scheduler.on_trial_result(trial, result)
-        if self._should_stop_trial(result) or decision == TrialScheduler.STOP:
+        # done sentinel / stop criteria are decided BEFORE consulting the
+        # scheduler: the final result of a function trainable carries no
+        # metric and must not reach rung bookkeeping
+        if self._should_stop_trial(result):
             self._finish(trial, Trial.TERMINATED)
             return
+        self._searcher.on_trial_result(trial.trial_id, result)
+        decision = self._scheduler.on_trial_result(trial, result)
+        if decision == TrialScheduler.STOP:
+            self._finish(trial, Trial.TERMINATED)
+            return
+        # harvest the previous async save if its reply has arrived (zero-wait)
+        if trial.pending_save is not None:
+            done, _ = ray_tpu.wait([trial.pending_save], num_returns=1,
+                                   timeout=0.02)
+            if done:
+                try:
+                    trial.last_checkpoint = ray_tpu.get(trial.pending_save)
+                    trial.pending_save = None
+                except Exception:
+                    trial.pending_save = None
+        freq = self._cfg.checkpoint_freq
+        if freq and len(trial.history) % freq == 0 and trial.actor is not None:
+            # async save: a blocking get here would stall every other trial
+            trial.pending_save = trial.actor.save.remote()
         # PBT exploit: clone donor checkpoint + new config, then continue
         if trial._exploit_req is not None:
             donor, new_cfg = trial._exploit_req
             trial._exploit_req = None
             try:
                 state = ray_tpu.get(donor.actor.save.remote(), timeout=60) \
-                    if donor.actor is not None else donor.last_checkpoint
+                    if donor.actor is not None else _resolve_checkpoint(donor)
                 if state is not None:
                     ray_tpu.get(trial.actor.restore.remote(state), timeout=60)
                     ray_tpu.get(trial.actor.set_config.remote(new_cfg),
@@ -356,7 +404,7 @@ class Tuner:
                     best = t.history[-1]
             results.append(Result(metrics=best, config=t.config,
                                   error=t.error, metrics_history=t.history,
-                                  checkpoint=t.last_checkpoint))
+                                  checkpoint=_resolve_checkpoint(t)))
         return ResultGrid(results, cfg.metric, cfg.mode)
 
 
@@ -367,6 +415,7 @@ def run(trainable, *, config: Optional[Dict[str, Any]] = None,
         stop: Optional[Dict[str, Any]] = None,
         resources_per_trial: Optional[Dict[str, Any]] = None,
         max_concurrent_trials: Optional[int] = None,
+        max_failures: int = 0, checkpoint_freq: int = 0,
         seed: Optional[int] = None) -> ResultGrid:
     """Functional entry point (reference ``tune.run``)."""
 
@@ -382,6 +431,8 @@ def run(trainable, *, config: Optional[Dict[str, Any]] = None,
         tune_config=TuneConfig(metric=metric, mode=mode,
                                num_samples=num_samples, scheduler=scheduler,
                                search_alg=search_alg, seed=seed,
+                               max_failures=max_failures,
+                               checkpoint_freq=checkpoint_freq,
                                max_concurrent_trials=max_concurrent_trials),
         run_config=rc, resources_per_trial=resources_per_trial)
     return tuner.fit()

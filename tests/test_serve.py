@@ -1,0 +1,209 @@
+"""Serve tier tests (reference model: python/ray/serve/tests/)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_shutdown(ray_start):
+    yield
+    serve.shutdown()
+
+
+def test_basic_deployment_and_handle(serve_shutdown):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+        def shout(self, x):
+            return str(x).upper()
+
+    handle = serve.run(Echo.bind(), route_prefix="/echo")
+    assert handle.remote({"a": 1}).result(timeout=30) == {"echo": {"a": 1}}
+    assert handle.options(method_name="shout").remote("hi").result(
+        timeout=30) == "HI"
+    assert handle.shout.remote("yo").result(timeout=30) == "YO"
+
+
+def test_multiple_replicas_spread_load(serve_shutdown):
+    import os
+
+    @serve.deployment(num_replicas=3)
+    class Who:
+        def __call__(self, _x):
+            return os.getpid()
+
+    handle = serve.run(Who.bind())
+    pids = {handle.remote(None).result(timeout=30) for _ in range(20)}
+    assert len(pids) >= 2  # pow-2 routing reaches multiple replicas
+    st = serve.status()
+    assert st["Who"]["num_replicas"] == 3
+
+
+def test_composition(serve_shutdown):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, x):
+            return self.doubler.remote(x).result(timeout=30) + 1
+
+    handle = serve.run(Ingress.bind(Doubler.bind()))
+    assert handle.remote(10).result(timeout=30) == 21
+
+
+def test_user_config_reconfigure(serve_shutdown):
+    @serve.deployment(user_config={"k": 1})
+    class Cfg:
+        def __init__(self):
+            self.k = 0
+
+        def reconfigure(self, config):
+            self.k = config["k"]
+
+        def __call__(self, _x):
+            return self.k
+
+    handle = serve.run(Cfg.bind())
+    assert handle.remote(None).result(timeout=30) == 1
+    from ray_tpu.serve.controller import get_controller
+
+    ray_tpu.get(get_controller().reconfigure.remote("Cfg", {"k": 7}))
+    assert handle.remote(None).result(timeout=30) == 7
+
+
+def test_batching(serve_shutdown):
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    import threading
+
+    results = [None] * 8
+
+    def call(i):
+        results[i] = handle.remote(i).result(timeout=30)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join(30) for t in threads]
+    assert results == [i * 10 for i in range(8)]
+    sizes = handle.sizes.remote().result(timeout=30)
+    assert max(sizes) > 1  # some requests actually batched together
+
+
+def test_function_deployment(serve_shutdown):
+    @serve.deployment
+    def square(x):
+        return x * x
+
+    handle = serve.run(square.bind())
+    assert handle.remote(7).result(timeout=30) == 49
+
+
+def test_error_propagates(serve_shutdown):
+    @serve.deployment
+    class Boom:
+        def __call__(self, _x):
+            raise ValueError("kapow")
+
+    handle = serve.run(Boom.bind())
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        handle.remote(None).result(timeout=30)
+
+
+def test_autoscaling_up(serve_shutdown):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.5})
+    class Slow:
+        def __call__(self, _x):
+            time.sleep(1.0)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    import threading
+
+    threads = [threading.Thread(
+        target=lambda: handle.remote(None).result(timeout=120))
+        for _ in range(12)]
+    [t.start() for t in threads]
+    deadline = time.time() + 45
+    scaled = False
+    while time.time() < deadline:
+        if serve.status()["Slow"]["num_replicas"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.5)
+    [t.join(120) for t in threads]
+    assert scaled, f"never scaled up: {serve.status()}"
+
+
+def test_http_proxy(serve_shutdown):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    class Api:
+        def __call__(self, body):
+            return {"got": body, "n": body.get("n", 0) * 2}
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 18431})
+    serve.run(Api.bind(), route_prefix="/api")
+    req = urllib.request.Request(
+        "http://127.0.0.1:18431/api", data=json.dumps({"n": 21}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    deadline = time.time() + 30
+    while True:
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert out == {"got": {"n": 21}, "n": 42}
+    # health endpoint
+    with urllib.request.urlopen(
+            "http://127.0.0.1:18431/-/healthz", timeout=10) as resp:
+        assert json.loads(resp.read())["status"] == "ok"
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen("http://127.0.0.1:18431/nope", timeout=10)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_delete_deployment(serve_shutdown):
+    @serve.deployment
+    class Temp:
+        def __call__(self, _):
+            return 1
+
+    serve.run(Temp.bind())
+    assert "Temp" in serve.status()
+    serve.delete("Temp")
+    assert "Temp" not in serve.status()

@@ -137,27 +137,48 @@ def test_pbt_exploit(ray_start):
     assert len(grid) == 4
 
 
-def test_max_failures_retry(ray_start):
+def test_max_failures_retry_resumes_from_checkpoint(ray_start):
     class Flaky(Trainable):
         def setup(self, config):
             self.n = 0
+            self.died = False
 
         def step(self):
             self.n += 1
-            if self.n == 2 and not getattr(Flaky, "_failed", False):
-                Flaky._failed = True
+            # die exactly once, at n==3 of the first incarnation (a restored
+            # actor comes back with n>=2 from the checkpoint, so n==3 is only
+            # revisited after restore if the checkpoint was applied)
+            if self.n == 3 and not self.died:
                 import os
 
                 os._exit(1)  # hard-kill the actor process
-            return {"loss": 1.0 / self.n, "done": self.n >= 3}
+            return {"loss": 1.0 / self.n, "n": self.n,
+                    "done": self.n >= 5}
 
         def save_checkpoint(self):
-            return {"n": self.n}
+            return {"n": self.n, "died": True}
 
         def load_checkpoint(self, state):
             self.n = state["n"]
+            self.died = state["died"]
 
     grid = tune.run(Flaky, config={}, metric="loss", mode="min",
-                    search_alg=None, num_samples=1)
-    # trial recovered or errored after retry budget: one result either way
+                    max_failures=1, checkpoint_freq=1, num_samples=1)
     assert len(grid) == 1
+    r = grid[0]
+    assert r.error is None, r.error
+    # resumed from a checkpoint rather than restarting at 0: n==1 is never
+    # revisited (the crash-racing n==2 save may be lost, in which case the
+    # n==1 checkpoint is the fallback and n==2 repeats — that's allowed)
+    ns = [m["n"] for m in r.metrics_history if "n" in m]
+    assert ns[-1] == 5
+    assert ns.count(1) == 1
+
+
+def test_error_without_retry_budget(ray_start):
+    class Dies(Trainable):
+        def step(self):
+            raise RuntimeError("no")
+
+    grid = tune.run(Dies, config={}, metric="loss", mode="min", num_samples=1)
+    assert len(grid) == 1 and grid[0].error is not None
