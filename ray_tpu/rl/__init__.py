@@ -1,0 +1,25 @@
+"""ray_tpu.rl: reinforcement learning (reference: ``rllib/``).
+
+PPO with jitted in-graph rollouts for jax envs (TPU fast path) or
+EnvRunner actors for python/gym envs (the reference's architecture).
+"""
+
+from ray_tpu.rl.algorithm import PPO, Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import (
+    CartPoleEnv,
+    EnvSpec,
+    GymVectorEnv,
+    JaxVectorEnv,
+    make_env,
+    register_env,
+)
+from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.models import ActorCriticModule
+from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
+
+__all__ = [
+    "PPO", "Algorithm", "AlgorithmConfig", "ActorCriticModule",
+    "CartPoleEnv", "EnvRunner", "EnvRunnerGroup", "EnvSpec", "GymVectorEnv",
+    "JaxVectorEnv", "PPOConfig", "PPOLearner", "compute_gae", "make_env",
+    "register_env",
+]

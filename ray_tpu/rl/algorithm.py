@@ -1,0 +1,197 @@
+"""Algorithm facade: config -> build() -> train() iterations.
+
+Reference: ``rllib/algorithms/algorithm.py:207`` (Algorithm orchestrating
+EnvRunnerGroup + LearnerGroup) and ``algorithm_config.py`` (builder-style
+AlgorithmConfig).  Two execution modes:
+
+- env_runners(num_env_runners=0) + a jax env: everything — rollout, GAE,
+  minibatch epochs — runs in jitted device code in this process (TPU-first
+  fast path; the mesh shards the batch over ``dp``).
+- num_env_runners>0 (or a gym env): EnvRunner actors collect on CPU hosts,
+  the learner updates on device — the reference's architecture.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.env import JaxVectorEnv, make_env
+from ray_tpu.rl.models import ActorCriticModule
+from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae, make_rollout_fn
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class=None):
+        self.algo_class = algo_class or PPO
+        self.env_name: Optional[str] = None
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 8
+        self.rollout_fragment_length = 128
+        self.hidden_sizes = (64, 64)
+        self.ppo = PPOConfig()
+        self.seed = 0
+
+    def environment(self, env: str) -> "AlgorithmConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_env_runners: int = 0,
+                    num_envs_per_env_runner: int = 8,
+                    rollout_fragment_length: int = 128) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 clip_eps: Optional[float] = None,
+                 entropy_coef: Optional[float] = None,
+                 num_epochs: Optional[int] = None,
+                 num_minibatches: Optional[int] = None,
+                 hidden_sizes=None) -> "AlgorithmConfig":
+        import dataclasses
+
+        kw = {k: v for k, v in dict(
+            lr=lr, gamma=gamma, clip_eps=clip_eps, entropy_coef=entropy_coef,
+            num_epochs=num_epochs, num_minibatches=num_minibatches,
+        ).items() if v is not None}
+        self.ppo = dataclasses.replace(self.ppo, **kw)
+        if hidden_sizes is not None:
+            self.hidden_sizes = tuple(hidden_sizes)
+        return self
+
+    def seed_(self, seed: int) -> "AlgorithmConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+
+    def train(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def stop(self):
+        pass
+
+
+class PPO(Algorithm):
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        import jax
+
+        env = make_env(config.env_name)
+        self.is_jax_env = isinstance(env, JaxVectorEnv)
+        self.env = env
+        spec = env.spec
+        self.module = ActorCriticModule(spec.obs_dim, spec.num_actions,
+                                        config.hidden_sizes)
+        self.learner = PPOLearner(self.module, config.ppo, seed=config.seed)
+        self.key = jax.random.PRNGKey(config.seed + 1)
+        self.iteration = 0
+        self._ep_returns: List[float] = []
+        self._last_ep_reward = float("nan")
+        if self.is_jax_env and config.num_env_runners == 0:
+            self.key, k = jax.random.split(self.key)
+            self.env_state, self.obs = env.reset(
+                k, config.num_envs_per_runner)
+            self._rollout = make_rollout_fn(
+                self.module, env, config.rollout_fragment_length, config.ppo)
+            self.runner_group = None
+        else:
+            from ray_tpu.rl.env_runner import EnvRunnerGroup
+
+            self.runner_group = EnvRunnerGroup(
+                config.env_name, max(1, config.num_env_runners),
+                config.num_envs_per_runner,
+                {"obs_dim": spec.obs_dim, "num_actions": spec.num_actions,
+                 "hidden": config.hidden_sizes, "gamma": config.ppo.gamma},
+                seed=config.seed)
+            self.runner_group.sync_weights(self.learner.get_weights())
+
+    # -- one training iteration -------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.perf_counter()
+        cfg = self.config
+        if self.runner_group is None:
+            self.key, kr, ku = jax.random.split(self.key, 3)
+            self.env_state, self.obs, batch, stats = self._rollout(
+                self.learner.params, self.env_state, self.obs, kr)
+            metrics = self.learner.update(batch, ku)
+            n_steps = int(batch["obs"].shape[0])
+            eps = float(stats["episodes_done"])
+            rps = float(stats["reward_per_step"])
+            if eps > 0:
+                ep_reward = rps * n_steps / eps
+                self._last_ep_reward = ep_reward
+            else:
+                # no episode finished this fragment: carry the previous
+                # estimate rather than reporting the whole batch's reward
+                ep_reward = self._last_ep_reward
+        else:
+            trajs = self.runner_group.sample(cfg.rollout_fragment_length)
+            batch = self._assemble(trajs)
+            self.key, ku = jax.random.split(self.key)
+            metrics = self.learner.update(batch, ku)
+            self.runner_group.sync_weights(self.learner.get_weights())
+            n_steps = int(batch["obs"].shape[0])
+            done_eps = self.runner_group.episode_stats()
+            self._ep_returns.extend(done_eps)
+            recent = self._ep_returns[-50:]
+            ep_reward = float(np.mean(recent)) if recent else float("nan")
+        self.iteration += 1
+        metrics.update({
+            "training_iteration": self.iteration,
+            "env_steps_this_iter": n_steps,
+            "env_steps_per_sec": n_steps / (time.perf_counter() - t0),
+            "episode_reward_mean": ep_reward,
+        })
+        return metrics
+
+    def _assemble(self, trajs: List[Dict[str, np.ndarray]]):
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.ppo import compute_gae
+
+        parts = []
+        for t in trajs:
+            advs, rets = compute_gae(
+                jnp.asarray(t["rewards"]), jnp.asarray(t["values"]),
+                jnp.asarray(t["dones"]), jnp.asarray(t["last_value"]),
+                self.config.ppo.gamma, self.config.ppo.gae_lambda)
+            parts.append({
+                "obs": t["obs"].reshape(-1, t["obs"].shape[-1]),
+                "actions": t["actions"].reshape(-1),
+                "logp_old": t["logp_old"].reshape(-1),
+                "advantages": np.asarray(advs).reshape(-1),
+                "returns": np.asarray(rets).reshape(-1),
+            })
+        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return {"learner": self.learner.get_state(),
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        if "learner" in state:
+            self.learner.set_state(state["learner"])
+        else:  # params-only checkpoint (older format)
+            self.learner.set_weights(state["params"])
+        self.iteration = state["iteration"]
+        if self.runner_group is not None:
+            self.runner_group.sync_weights(self.learner.get_weights())
+
+    def stop(self):
+        if self.runner_group is not None:
+            self.runner_group.stop()

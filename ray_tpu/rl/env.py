@@ -1,0 +1,160 @@
+"""RL environments: pure-JAX vectorized envs + gymnasium adapter.
+
+Reference: RLlib's env layer (``rllib/env/``).  TPU-first difference: a
+``JaxVectorEnv`` is a pure function ``(state, action, key) -> (state, obs,
+reward, done)``, so whole rollouts run INSIDE one jitted ``lax.scan`` on
+device — the env never leaves the accelerator, where the reference steps
+python envs on CPU workers (``single_agent_env_runner.py``).  Python/gym
+envs are still supported through ``GymVectorEnv`` for the actor-based
+runner path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    obs_dim: int
+    num_actions: int
+    max_episode_steps: int
+
+
+class JaxVectorEnv:
+    """ABC for device-resident vector envs (see CartPoleEnv)."""
+
+    spec: EnvSpec
+
+    def reset(self, key, batch: int):
+        raise NotImplementedError
+
+    def step(self, state, action, key):
+        """-> (next_state, obs, reward, terminated, truncated, final_obs).
+
+        ``terminated`` = true episode end (bootstrap value 0);
+        ``truncated`` = time-limit cut (bootstrap from ``final_obs``, the
+        pre-auto-reset observation).  ``obs`` is post-auto-reset.
+        """
+        raise NotImplementedError
+
+
+class CartPoleEnv(JaxVectorEnv):
+    """CartPole-v1 dynamics, batched, in jax (matches gymnasium's physics)."""
+
+    spec = EnvSpec(obs_dim=4, num_actions=2, max_episode_steps=500)
+
+    def __init__(self):
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.total_mass = self.masspole + self.masscart
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+
+    def reset(self, key, batch: int):
+        import jax
+
+        state = jax.random.uniform(key, (batch, 4), minval=-0.05, maxval=0.05)
+        steps = jax.numpy.zeros((batch,), dtype=jax.numpy.int32)
+        return (state, steps), state
+
+    def step(self, env_state, action, key):
+        import jax.numpy as jnp
+
+        state, steps = env_state
+        x, x_dot, theta, theta_dot = (state[:, 0], state[:, 1], state[:, 2],
+                                      state[:, 3])
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta
+                ) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta ** 2
+                           / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        steps = steps + 1
+        terminated = ((jnp.abs(x) > self.x_threshold)
+                      | (jnp.abs(theta) > self.theta_threshold))
+        truncated = (steps >= self.spec.max_episode_steps) & ~terminated
+        done = terminated | truncated
+        reward = jnp.ones_like(x)
+        final_obs = jnp.stack([x, x_dot, theta, theta_dot], axis=1)
+        # auto-reset finished envs (standard vector-env semantics)
+        import jax
+
+        fresh = jax.random.uniform(key, final_obs.shape, minval=-0.05,
+                                   maxval=0.05)
+        next_state = jnp.where(done[:, None], fresh, final_obs)
+        steps = jnp.where(done, 0, steps)
+        return ((next_state, steps), next_state, reward, terminated,
+                truncated, final_obs)
+
+
+_ENVS: Dict[str, Callable[[], JaxVectorEnv]] = {
+    "CartPole-v1": CartPoleEnv,
+}
+
+
+def register_env(name: str, factory: Callable[[], Any]) -> None:
+    _ENVS[name] = factory
+
+
+def make_env(name: str):
+    if name in _ENVS:
+        return _ENVS[name]()
+    return GymVectorEnv(name)  # fall back to gymnasium
+
+
+class GymVectorEnv:
+    """Host-side gymnasium vector env for the actor-runner path."""
+
+    def __init__(self, name: str):
+        import gymnasium as gym
+
+        self._gym = gym
+        self.name = name
+        self.envs = None
+        probe = gym.make(name)
+        self.spec = EnvSpec(
+            obs_dim=int(np.prod(probe.observation_space.shape)),
+            num_actions=int(probe.action_space.n),
+            max_episode_steps=probe.spec.max_episode_steps or 1000)
+        probe.close()
+
+    def make_batch(self, num_envs: int, seed: int = 0):
+        # SAME_STEP autoreset: the step that ends an episode returns the
+        # reset obs but surfaces the true final obs in info["final_obs"] —
+        # gymnasium>=1.0's NEXT_STEP default would inject a phantom
+        # transition (ignored action, zero reward) into the training data.
+        kw = {}
+        if hasattr(self._gym.vector, "AutoresetMode"):
+            kw["autoreset_mode"] = self._gym.vector.AutoresetMode.SAME_STEP
+        self.envs = self._gym.vector.SyncVectorEnv(
+            [lambda: self._gym.make(self.name) for _ in range(num_envs)], **kw)
+        obs, _ = self.envs.reset(seed=seed)
+        return obs
+
+    def step(self, actions: np.ndarray):
+        """-> (obs, reward, terminated, truncated, final_obs)."""
+        obs, rew, term, trunc, info = self.envs.step(actions)
+        final_obs = obs
+        done = term | trunc
+        if done.any() and "final_obs" in info:
+            final_obs = obs.copy()
+            for i in np.nonzero(done)[0]:
+                fo = info["final_obs"][i]
+                if fo is not None:
+                    final_obs[i] = np.asarray(fo).reshape(obs.shape[1:])
+        return obs, rew, term, trunc, final_obs

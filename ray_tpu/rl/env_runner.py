@@ -1,0 +1,123 @@
+"""EnvRunner actors: distributed rollout collection for host (gym) envs.
+
+Reference: ``rllib/env/single_agent_env_runner.py`` + ``env_runner_group.py``.
+The jax-env fast path doesn't need these (rollouts run in-graph on device);
+they exist for python envs and for scaling rollout collection across hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """Steps a gymnasium vector env with the current policy on CPU."""
+
+    def __init__(self, env_name: str, num_envs: int, module_spec: dict,
+                 seed: int = 0):
+        import jax
+
+        from ray_tpu.rl.env import GymVectorEnv, make_env
+        from ray_tpu.rl.models import ActorCriticModule
+
+        # host stepping needs the gym incarnation even for names that also
+        # have a jax fast-path registration (e.g. CartPole-v1); custom
+        # register_env names fall through to the registry
+        try:
+            self.env = GymVectorEnv(env_name)
+        except Exception:
+            self.env = make_env(env_name)
+            if not isinstance(self.env, GymVectorEnv):
+                raise TypeError(
+                    f"EnvRunner actors step host (gym) envs; {env_name!r} "
+                    f"is a JaxVectorEnv — use num_env_runners=0 so rollouts "
+                    f"run in-graph on device")
+        self.obs = self.env.make_batch(num_envs, seed=seed)
+        self.gamma = float(module_spec.pop("gamma", 0.99))
+        self.module = ActorCriticModule(**module_spec)
+        self.params = None
+        self.key = jax.random.PRNGKey(seed)
+        self.episode_returns = np.zeros(num_envs)
+        self.completed: List[float] = []
+        self._sample = jax.jit(self.module.sample_action)
+        self._value = jax.jit(self.module.value)
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, Any]:
+        import jax
+
+        traj = {k: [] for k in ("obs", "actions", "logp_old", "rewards",
+                                "dones", "values")}
+        for _ in range(num_steps):
+            self.key, k = jax.random.split(self.key)
+            action, logp = self._sample(self.params, self.obs, k)
+            value = self._value(self.params, self.obs)
+            action = np.asarray(action)
+            next_obs, reward, term, trunc, final_obs = self.env.step(action)
+            done = term | trunc
+            self.episode_returns += reward
+            # time-limit bootstrap: fold V(final_obs) into the reward at
+            # truncations (same trick as the in-graph rollout)
+            if trunc.any():
+                v_final = np.asarray(self._value(self.params, final_obs))
+                reward = reward + self.gamma * v_final * trunc
+            traj["obs"].append(self.obs)
+            traj["actions"].append(action)
+            traj["logp_old"].append(np.asarray(logp))
+            traj["rewards"].append(reward)
+            traj["dones"].append(done)
+            traj["values"].append(np.asarray(value))
+            for i in np.nonzero(done)[0]:
+                self.completed.append(float(self.episode_returns[i]))
+                self.episode_returns[i] = 0.0
+            self.obs = next_obs
+        last_value = np.asarray(self._value(self.params, self.obs))
+        out = {k: np.stack(v) for k, v in traj.items()}
+        out["last_value"] = last_value
+        return out
+
+    def episode_stats(self, clear: bool = True) -> List[float]:
+        out = list(self.completed)
+        if clear:
+            self.completed = []
+        return out
+
+
+class EnvRunnerGroup:
+    """N EnvRunner actors + weight broadcast via a shared object ref."""
+
+    def __init__(self, env_name: str, num_runners: int, num_envs_per: int,
+                 module_spec: dict, seed: int = 0):
+        self.runners = [
+            EnvRunner.remote(env_name, num_envs_per, module_spec, seed + i)
+            for i in range(num_runners)]
+
+    def sync_weights(self, params) -> None:
+        ref = ray_tpu.put(params)  # one shm copy, all runners attach
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+
+    def sample(self, num_steps: int) -> List[Dict[str, Any]]:
+        return ray_tpu.get(
+            [r.sample.remote(num_steps) for r in self.runners])
+
+    def episode_stats(self) -> List[float]:
+        out: List[float] = []
+        for stats in ray_tpu.get(
+                [r.episode_stats.remote() for r in self.runners]):
+            out.extend(stats)
+        return out
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

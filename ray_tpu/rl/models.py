@@ -1,0 +1,64 @@
+"""RL policy/value networks, pure-functional JAX.
+
+Reference: RLlib's ``RLModule`` abstraction (``core/rl_module/rl_module.py:260``)
+— here a module is (init_fn, apply_fn) over a plain param pytree, jit- and
+shard-friendly like the rest of the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(key, sizes: Sequence[int]) -> Dict[str, Any]:
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jax.random.normal(keys[i], (din, dout)) * jnp.sqrt(
+            2.0 / din)
+        params[f"b{i}"] = jnp.zeros((dout,))
+    return params
+
+
+def mlp_apply(params: Dict[str, Any], x: jnp.ndarray, n_layers: int
+              ) -> jnp.ndarray:
+    for i in range(n_layers):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class ActorCriticModule:
+    """Separate policy and value MLP towers (RLlib's default PPO module)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.pi_sizes = [obs_dim, *hidden, num_actions]
+        self.vf_sizes = [obs_dim, *hidden, 1]
+
+    def init(self, key) -> Dict[str, Any]:
+        kp, kv = jax.random.split(key)
+        return {"pi": mlp_init(kp, self.pi_sizes),
+                "vf": mlp_init(kv, self.vf_sizes)}
+
+    def logits(self, params, obs) -> jnp.ndarray:
+        return mlp_apply(params["pi"], obs, len(self.pi_sizes) - 1)
+
+    def value(self, params, obs) -> jnp.ndarray:
+        return mlp_apply(params["vf"], obs, len(self.vf_sizes) - 1)[..., 0]
+
+    def forward(self, params, obs) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return self.logits(params, obs), self.value(params, obs)
+
+    def sample_action(self, params, obs, key):
+        logits = self.logits(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)
+        return action, jnp.take_along_axis(
+            logp, action[..., None], axis=-1)[..., 0]

@@ -1,0 +1,193 @@
+"""PPO learner: GAE + clipped surrogate, fully jitted.
+
+Reference: ``rllib/algorithms/ppo/`` (torch loss in
+``ppo_torch_learner.py``) and ``core/learner/learner.py:107``.  TPU-first:
+rollout (for jax envs) AND update are single jitted programs; the update
+scans over minibatch epochs on device.  This learner runs on one device
+(or one mesh-replica); multi-learner data parallelism composes at the
+library layer (shard the batch, psum grads) the way
+``ray_tpu/models/training.py`` does for the LLM trainer — no NCCL/DDP
+analog is needed (reference wraps modules in torch DDP at
+``torch_learner.py:432``).
+
+Truncation handling: a time-limit cut bootstraps the return from the value
+of the pre-reset final observation (folded into the reward:
+``r += gamma * V(final_obs)``), while true termination bootstraps 0 — the
+standard partial-episode bootstrapping fix the reference also applies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl.models import ActorCriticModule
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    num_epochs: int = 4
+    num_minibatches: int = 4
+    max_grad_norm: float = 0.5
+
+
+def compute_gae(rewards, values, dones, last_value, gamma, lam):
+    """Generalized advantage estimation via reverse lax.scan.
+
+    rewards/values/dones: [T, B]; last_value: [B].
+    """
+
+    def step(carry, inp):
+        gae, next_value = carry
+        reward, value, done = inp
+        nonterminal = 1.0 - done
+        delta = reward + gamma * next_value * nonterminal - value
+        gae = delta + gamma * lam * nonterminal * gae
+        return (gae, value), gae
+
+    (_, _), advs = jax.lax.scan(
+        step, (jnp.zeros_like(last_value), last_value),
+        (rewards, values, dones.astype(jnp.float32)), reverse=True)
+    return advs, advs + values
+
+
+class PPOLearner:
+    """Holds params + optax (clip + adam) state; update() is one jitted call."""
+
+    def __init__(self, module: ActorCriticModule, config: PPOConfig,
+                 seed: int = 0):
+        self.module = module
+        self.config = config
+        key = jax.random.PRNGKey(seed)
+        self.params = module.init(key)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self.opt_state = self.tx.init(self.params)
+        self.step_count = 0
+        self._update = jax.jit(self._update_impl)
+
+    def _loss(self, params, batch):
+        c = self.config
+        logits, values = self.module.forward(params, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        ratio = jnp.exp(logp - batch["logp_old"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - c.clip_eps, 1 + c.clip_eps) * adv
+        pi_loss = -jnp.minimum(unclipped, clipped).mean()
+        vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = pi_loss + c.vf_coef * vf_loss - c.entropy_coef * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "approx_kl": (batch["logp_old"] - logp).mean()}
+
+    def _update_impl(self, params, opt_state, step0, batch, key):
+        c = self.config
+        n = batch["obs"].shape[0]
+        mb = n // c.num_minibatches
+
+        def epoch(carry, ekey):
+            params, opt_state, step = carry
+            perm = jax.random.permutation(ekey, n)
+
+            def minibatch(carry, i):
+                params, opt_state, step = carry
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * mb, mb)
+                mb_batch = {k: v[idx] for k, v in batch.items()}
+                (_, aux), grads = jax.value_and_grad(
+                    self._loss, has_aux=True)(params, mb_batch)
+                updates, opt_state = self.tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, step + 1), aux
+
+            (params, opt_state, step), auxs = jax.lax.scan(
+                minibatch, (params, opt_state, step),
+                jnp.arange(c.num_minibatches))
+            return (params, opt_state, step), auxs
+
+        (params, opt_state, step), auxs = jax.lax.scan(
+            epoch, (params, opt_state, step0),
+            jax.random.split(key, c.num_epochs))
+        metrics = jax.tree.map(lambda x: x.mean(), auxs)
+        return params, opt_state, step, metrics
+
+    def update(self, batch: Dict[str, jnp.ndarray], key) -> Dict[str, float]:
+        self.params, self.opt_state, step, metrics = self._update(
+            self.params, self.opt_state,
+            jnp.asarray(self.step_count, jnp.int32), batch, key)
+        self.step_count = int(step)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params):
+        self.params = jax.device_put(params)
+
+    def get_state(self) -> Dict[str, Any]:
+        """Full training state (params + optimizer moments + step)."""
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "step_count": self.step_count}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.step_count = state["step_count"]
+
+
+def make_rollout_fn(module: ActorCriticModule, env, num_steps: int,
+                    config: PPOConfig):
+    """In-graph rollout for JaxVectorEnv: one jitted scan collects the whole
+    trajectory batch AND its GAE targets on device."""
+
+    def rollout(params, env_state, obs, key):
+        def step(carry, k):
+            env_state, obs = carry
+            ka, ke = jax.random.split(k)
+            action, logp = module.sample_action(params, obs, ka)
+            value = module.value(params, obs)
+            (env_state, next_obs, reward, terminated, truncated,
+             final_obs) = env.step(env_state, action, ke)
+            # time-limit bootstrap: fold V(final_obs) into the reward at
+            # truncations, then treat them as terminal for GAE
+            v_final = module.value(params, final_obs)
+            reward = reward + config.gamma * v_final * truncated
+            done = terminated | truncated
+            out = {"obs": obs, "actions": action, "logp_old": logp,
+                   "rewards": reward, "dones": done, "values": value}
+            return (env_state, next_obs), out
+
+        (env_state, obs), traj = jax.lax.scan(
+            step, (env_state, obs), jax.random.split(key, num_steps))
+        last_value = module.value(params, obs)
+        advs, returns = compute_gae(
+            traj["rewards"], traj["values"], traj["dones"], last_value,
+            config.gamma, config.gae_lambda)
+        flat = {
+            "obs": traj["obs"].reshape(-1, traj["obs"].shape[-1]),
+            "actions": traj["actions"].reshape(-1),
+            "logp_old": traj["logp_old"].reshape(-1),
+            "advantages": advs.reshape(-1),
+            "returns": returns.reshape(-1),
+        }
+        stats = {"reward_per_step": traj["rewards"].mean(),
+                 "episodes_done": traj["dones"].sum()}
+        return env_state, obs, flat, stats
+
+    return jax.jit(rollout)
