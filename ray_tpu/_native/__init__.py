@@ -1,0 +1,1 @@
+"""Native (C++) runtime components, built on first use (see build.py)."""

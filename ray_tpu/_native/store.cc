@@ -1,0 +1,536 @@
+// rtpu_store: node-local shared-memory object store arena.
+//
+// TPU-era equivalent of the reference's plasma store
+// (src/ray/object_manager/plasma/: PlasmaStore store.h:55, dlmalloc arena,
+// eviction_policy.h) re-designed as a LIBRARY instead of a daemon: every
+// worker process maps ONE shm arena and operates on it directly under a
+// process-shared robust mutex — no unix-socket round trips on the hot
+// path (the reference pays one per create/seal/get; here a put is
+// lock+alloc+memcpy+seal).
+//
+// Layout of the shm segment:
+//   [Header | table: Entry[capacity] | arena: boundary-tag blocks]
+//
+// - Allocator: first-fit free list over boundary-tag blocks with
+//   split-on-alloc and coalesce-with-neighbors-on-free (footer-less:
+//   prev_size links). 64-byte-aligned payloads so jax.device_put can DMA
+//   straight from the mapped buffer into HBM.
+// - Object table: open-addressing hash map keyed by 16-byte object ids;
+//   sealed objects are immutable, so reads need no lock after lookup.
+// - Eviction: sealed, refcount==0 objects are evicted in LRU order when
+//   an allocation doesn't fit (reference: plasma LRU eviction_policy.h).
+// - Crash-safety: robust mutex; a worker dying mid-operation leaves the
+//   lock recoverable (EOWNERDEAD -> consistent), matching the daemon-less
+//   design's main risk.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <deque>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5254505553544f52ULL;  // "RTPUSTOR"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kAlign = 64;
+// Block header is a full alignment unit so payloads (block base + header)
+// stay 64-byte aligned — the invariant jax.device_put zero-copy DMA needs.
+constexpr uint64_t kBlockHdr = 64;  // {size_flags, prev_size, 48B pad}
+
+enum EntryState : uint32_t {
+  kEmpty = 0,
+  kAllocated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+  // deleted while readers still hold pins: block freed when refcount==0
+  kPendingDelete = 4,
+};
+
+struct Entry {
+  uint8_t id[16];
+  uint64_t offset;  // payload offset from segment base
+  uint64_t size;
+  uint32_t state;
+  uint32_t refcount;
+  uint64_t lru_tick;
+};
+
+struct Header {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t _pad;
+  pthread_mutex_t mutex;
+  uint64_t segment_size;
+  uint64_t table_capacity;
+  uint64_t table_offset;
+  uint64_t arena_offset;
+  uint64_t arena_size;
+  uint64_t free_head;   // offset of first free block hdr (0 = none)
+  uint64_t lru_clock;
+  uint64_t used_bytes;  // payload bytes in live blocks
+  uint64_t num_objects;
+  uint64_t num_evictions;
+};
+
+// in-arena block header (lives at block_off):
+//   size_flags: block size (incl. header) << 1 | used
+//   prev_size:  size of the previous block (0 for first)
+// free blocks additionally store next_free at payload[0].
+struct Block {
+  uint64_t size_flags;
+  uint64_t prev_size;
+  uint64_t size() const { return size_flags >> 1; }
+  bool used() const { return size_flags & 1; }
+  void set(uint64_t size, bool used) { size_flags = (size << 1) | (used ? 1 : 0); }
+};
+
+struct Handle {
+  uint8_t* base = nullptr;
+  uint64_t size = 0;
+  std::string name;
+  bool valid = false;
+};
+
+// deque: push_back never invalidates references, so a Handle* taken under
+// the mutex stays valid across concurrent create/attach.  Slots are never
+// erased (detach marks invalid); callers must not race detach with ops on
+// the same handle — detach only at process shutdown.
+std::deque<Handle> g_handles;
+std::mutex g_handles_mu;
+
+Header* hdr(Handle& h) { return reinterpret_cast<Header*>(h.base); }
+Entry* table(Handle& h) {
+  return reinterpret_cast<Entry*>(h.base + hdr(h)->table_offset);
+}
+Block* block_at(Handle& h, uint64_t off) {
+  return reinterpret_cast<Block*>(h.base + off);
+}
+uint64_t& next_free_of(Handle& h, uint64_t block_off) {
+  return *reinterpret_cast<uint64_t*>(h.base + block_off + kBlockHdr);
+}
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 16 id bytes
+  uint64_t x = 1469598103934665603ULL;
+  for (int i = 0; i < 16; i++) { x ^= id[i]; x *= 1099511628211ULL; }
+  return x;
+}
+
+int lock(Handle& h) {
+  int rc = pthread_mutex_lock(&hdr(h)->mutex);
+  if (rc == EOWNERDEAD) {
+    // previous owner died while holding the lock; table/arena metadata is
+    // updated under the lock in small steps — declare it consistent (worst
+    // case: a leaked allocated-unsealed block, reclaimed by eviction)
+    pthread_mutex_consistent(&hdr(h)->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+void unlock(Handle& h) { pthread_mutex_unlock(&hdr(h)->mutex); }
+
+// ---- allocator ------------------------------------------------------------
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+void free_list_remove(Handle& h, uint64_t off) {
+  Header* H = hdr(h);
+  uint64_t* cur = &H->free_head;
+  while (*cur) {
+    if (*cur == off) { *cur = next_free_of(h, off); return; }
+    cur = &next_free_of(h, *cur);
+  }
+}
+
+void free_list_push(Handle& h, uint64_t off) {
+  next_free_of(h, off) = hdr(h)->free_head;
+  hdr(h)->free_head = off;
+}
+
+// merge the free block at `off` with free neighbors; returns merged offset
+uint64_t coalesce(Handle& h, uint64_t off) {
+  Header* H = hdr(h);
+  Block* b = block_at(h, off);
+  // next neighbor
+  uint64_t next_off = off + b->size();
+  if (next_off < H->arena_offset + H->arena_size) {
+    Block* n = block_at(h, next_off);
+    if (!n->used()) {
+      free_list_remove(h, next_off);
+      b->set(b->size() + n->size(), false);
+    }
+  }
+  // prev neighbor
+  if (b->prev_size) {
+    uint64_t prev_off = off - b->prev_size;
+    Block* p = block_at(h, prev_off);
+    if (!p->used()) {
+      free_list_remove(h, prev_off);
+      p->set(p->size() + b->size(), false);
+      off = prev_off;
+      b = p;
+    }
+  }
+  // fix next block's prev_size
+  uint64_t after = off + b->size();
+  if (after < H->arena_offset + H->arena_size)
+    block_at(h, after)->prev_size = b->size();
+  return off;
+}
+
+// allocate a block with payload >= want; returns payload offset or 0
+uint64_t arena_alloc(Handle& h, uint64_t want) {
+  Header* H = hdr(h);
+  uint64_t need = align_up(kBlockHdr + want, kAlign);
+  uint64_t* cur = &H->free_head;
+  while (*cur) {
+    uint64_t off = *cur;
+    Block* b = block_at(h, off);
+    if (b->size() >= need) {
+      *cur = next_free_of(h, off);  // unlink
+      uint64_t remainder = b->size() - need;
+      if (remainder >= kAlign + kBlockHdr) {
+        // split: tail becomes a new free block
+        uint64_t tail = off + need;
+        Block* t = block_at(h, tail);
+        t->set(remainder, false);
+        t->prev_size = need;
+        uint64_t after = tail + remainder;
+        if (after < H->arena_offset + H->arena_size)
+          block_at(h, after)->prev_size = remainder;
+        free_list_push(h, tail);
+        b->set(need, true);
+      } else {
+        b->set(b->size(), true);
+      }
+      H->used_bytes += b->size();
+      return off + kBlockHdr;
+    }
+    cur = &next_free_of(h, off);
+  }
+  return 0;
+}
+
+void arena_free(Handle& h, uint64_t payload_off) {
+  uint64_t off = payload_off - kBlockHdr;
+  Block* b = block_at(h, off);
+  hdr(h)->used_bytes -= b->size();
+  b->set(b->size(), false);
+  off = coalesce(h, off);
+  free_list_push(h, off);
+}
+
+// ---- table ----------------------------------------------------------------
+
+Entry* find_entry(Handle& h, const uint8_t* id, bool for_insert) {
+  Header* H = hdr(h);
+  uint64_t cap = H->table_capacity;
+  uint64_t i = hash_id(id) % cap;
+  Entry* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe < cap; probe++, i = (i + 1) % cap) {
+    Entry* e = &table(h)[i];
+    if (e->state == kEmpty)
+      return for_insert ? (first_tomb ? first_tomb : e) : nullptr;
+    if (e->state == kTombstone) {
+      if (for_insert && !first_tomb) first_tomb = e;
+      continue;
+    }
+    if (memcmp(e->id, id, 16) == 0) return e;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+void delete_entry(Handle& h, Entry* e) {
+  arena_free(h, e->offset);
+  e->state = kTombstone;
+  e->refcount = 0;
+  hdr(h)->num_objects--;
+}
+
+// evict sealed refcount==0 objects (LRU first) until `need` payload bytes fit
+bool evict_for(Handle& h, uint64_t need) {
+  Header* H = hdr(h);
+  for (int round = 0; round < 64; round++) {
+    // try alloc
+    uint64_t off = arena_alloc(h, need);
+    if (off) { arena_free(h, off); return true; }
+    // find LRU evictable
+    Entry* victim = nullptr;
+    for (uint64_t i = 0; i < H->table_capacity; i++) {
+      Entry* e = &table(h)[i];
+      if (e->state == kSealed && e->refcount == 0 &&
+          (!victim || e->lru_tick < victim->lru_tick))
+        victim = e;
+    }
+    if (!victim) return false;
+    delete_entry(h, victim);
+    H->num_evictions++;
+  }
+  return false;
+}
+
+int new_handle(Handle&& h) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  // never reuse slots: a stale Handle* must keep seeing valid=false, not
+  // someone else's mapping
+  g_handles.push_back(std::move(h));
+  return (int)g_handles.size() - 1;
+}
+
+Handle* get_handle(int i) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  if (i < 0 || (size_t)i >= g_handles.size() || !g_handles[i].valid)
+    return nullptr;
+  return &g_handles[i];
+}
+
+}  // namespace
+
+extern "C" {
+
+// create the arena (fails with -EEXIST if present); returns handle or -errno
+int rtpu_store_create(const char* name, uint64_t arena_bytes,
+                      uint64_t table_capacity) {
+  uint64_t table_bytes = table_capacity * sizeof(Entry);
+  uint64_t header_bytes = align_up(sizeof(Header), kAlign);
+  uint64_t table_off = header_bytes;
+  uint64_t arena_off = align_up(table_off + table_bytes, kAlign);
+  uint64_t total = arena_off + arena_bytes;
+
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0666);
+  if (fd < 0) return -errno;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    int e = errno; close(fd); shm_unlink(name); return -e;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) { shm_unlink(name); return -errno; }
+
+  Handle h;
+  h.base = (uint8_t*)base;
+  h.size = total;
+  h.name = name;
+  h.valid = true;
+
+  Header* H = hdr(h);
+  memset(H, 0, sizeof(Header));
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&H->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+  H->version = kVersion;
+  H->segment_size = total;
+  H->table_capacity = table_capacity;
+  H->table_offset = table_off;
+  H->arena_offset = arena_off;
+  H->arena_size = arena_bytes;
+  memset(table(h), 0, table_bytes);
+  // one big free block spanning the arena
+  Block* b = block_at(h, arena_off);
+  b->set(arena_bytes, false);
+  b->prev_size = 0;
+  next_free_of(h, arena_off) = 0;
+  H->free_head = arena_off;
+  __atomic_store_n(&H->magic, kMagic, __ATOMIC_RELEASE);
+  return new_handle(std::move(h));
+}
+
+int rtpu_store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0666);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { int e = errno; close(fd); return -e; }
+  void* base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return -errno;
+  Header* H = (Header*)base;
+  // wait for creator to finish initialization (magic written with release)
+  for (int spin = 0; __atomic_load_n(&H->magic, __ATOMIC_ACQUIRE) != kMagic;
+       spin++) {
+    if (spin > 1000000) { munmap(base, st.st_size); return -EINVAL; }
+  }
+  Handle h;
+  h.base = (uint8_t*)base;
+  h.size = st.st_size;
+  h.name = name;
+  h.valid = true;
+  return new_handle(std::move(h));
+}
+
+void rtpu_store_detach(int hi) {
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  if (hi < 0 || (size_t)hi >= g_handles.size()) return;
+  Handle& h = g_handles[hi];
+  if (h.valid && h.base) munmap(h.base, h.size);
+  h.valid = false;
+  h.base = nullptr;
+}
+
+int rtpu_store_unlink(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : -errno;
+}
+
+// allocate an (unsealed) object; returns payload offset or -errno.
+// -EEXIST: already present (sealed or in progress). -ENOMEM: won't fit.
+int64_t rtpu_store_alloc(int hi, const uint8_t* id, uint64_t size) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  int64_t result;
+  Entry* existing = find_entry(*h, id, false);
+  if (existing && existing->state == kAllocated) {
+    // orphaned allocation (creator died between alloc and seal): reclaim it
+    // so deterministic re-execution can store the object.  A live creator
+    // mid-write to the same id would be an ownership violation upstream.
+    delete_entry(*h, existing);
+    existing = nullptr;
+  }
+  if (existing) {
+    result = -EEXIST;
+  } else {
+    uint64_t off = arena_alloc(*h, size);
+    if (!off && evict_for(*h, size)) off = arena_alloc(*h, size);
+    if (!off) {
+      result = -ENOMEM;
+    } else {
+      Entry* e = find_entry(*h, id, true);
+      if (!e) {
+        arena_free(*h, off);
+        result = -ENOSPC;  // table full
+      } else {
+        memcpy(e->id, id, 16);
+        e->offset = off;
+        e->size = size;
+        e->state = kAllocated;
+        e->refcount = 1;  // creator's ref until seal
+        e->lru_tick = ++hdr(*h)->lru_clock;
+        hdr(*h)->num_objects++;
+        result = (int64_t)off;
+      }
+    }
+  }
+  unlock(*h);
+  return result;
+}
+
+int rtpu_store_seal(int hi, const uint8_t* id) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  int rc = 0;
+  Entry* e = find_entry(*h, id, false);
+  if (!e || e->state != kAllocated) rc = -ENOENT;
+  else { e->state = kSealed; e->refcount = 0; }
+  unlock(*h);
+  return rc;
+}
+
+// look up a sealed object; bumps refcount (pin) and LRU tick.
+// size_out receives the payload size. Returns payload offset or -errno.
+int64_t rtpu_store_get(int hi, const uint8_t* id, uint64_t* size_out) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  int64_t result = -ENOENT;
+  Entry* e = find_entry(*h, id, false);
+  if (e && e->state == kSealed) {
+    e->refcount++;
+    e->lru_tick = ++hdr(*h)->lru_clock;
+    *size_out = e->size;
+    result = (int64_t)e->offset;
+  }
+  unlock(*h);
+  return result;
+}
+
+// look up a sealed object WITHOUT pinning (no refcount bump); LRU still
+// refreshed.  For read paths that rely on the creator pin for lifetime.
+int64_t rtpu_store_peek(int hi, const uint8_t* id, uint64_t* size_out) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  int64_t result = -ENOENT;
+  Entry* e = find_entry(*h, id, false);
+  if (e && e->state == kSealed) {
+    e->lru_tick = ++hdr(*h)->lru_clock;
+    *size_out = e->size;
+    result = (int64_t)e->offset;
+  }
+  unlock(*h);
+  return result;
+}
+
+int rtpu_store_release(int hi, const uint8_t* id) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  int rc = -ENOENT;
+  Entry* e = find_entry(*h, id, false);
+  if (e && (e->state == kSealed || e->state == kPendingDelete)) {
+    if (e->refcount > 0) e->refcount--;
+    if (e->state == kPendingDelete && e->refcount == 0)
+      delete_entry(*h, e);  // last reader gone: reclaim the block
+    rc = 0;
+  }
+  unlock(*h);
+  return rc;
+}
+
+int rtpu_store_contains(int hi, const uint8_t* id) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  Entry* e = find_entry(*h, id, false);
+  int rc = (e && e->state == kSealed) ? 1 : 0;
+  unlock(*h);
+  return rc;
+}
+
+// delete an object.  If readers still hold pins the block is NOT freed —
+// the entry flips to kPendingDelete (invisible to get/peek/contains) and
+// the last release reclaims it, so pinned zero-copy views stay valid.
+int rtpu_store_delete(int hi, const uint8_t* id) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  int rc = -ENOENT;
+  Entry* e = find_entry(*h, id, false);
+  if (e && (e->state == kSealed || e->state == kAllocated)) {
+    if (e->refcount > 0) {
+      e->state = kPendingDelete;
+    } else {
+      delete_entry(*h, e);
+    }
+    rc = 0;
+  }
+  unlock(*h);
+  return rc;
+}
+
+// stats: [capacity, used, num_objects, num_evictions]
+int rtpu_store_stats(int hi, uint64_t* out4) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  Header* H = hdr(*h);
+  out4[0] = H->arena_size;
+  out4[1] = H->used_bytes;
+  out4[2] = H->num_objects;
+  out4[3] = H->num_evictions;
+  unlock(*h);
+  return 0;
+}
+
+}  // extern "C"

@@ -25,6 +25,10 @@ _FLAG_DEFS: Dict[str, Any] = {
     "testing_rpc_failure": "",
     # --- object store ---
     "object_store_memory_bytes": 2 * 1024**3,
+    # C++ shm arena (ray_tpu/_native/store.cc) — the plasma-equivalent fast
+    # path; objects > arena_store_bytes/4 use per-object segments instead
+    "use_native_arena_store": True,
+    "arena_store_bytes": 256 * 1024 * 1024,
     # results smaller than this return in-band to the owner's memory store
     # (reference: RayConfig::max_direct_call_object_size, 100KB)
     "max_inline_object_size": 100 * 1024,

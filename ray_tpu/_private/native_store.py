@@ -1,0 +1,195 @@
+"""ctypes binding for the C++ arena object store (ray_tpu/_native/store.cc).
+
+Same interface as ``object_store.SharedObjectStore`` (one shm segment per
+object) but backed by ONE mmap'd arena per node with a boundary-tag
+allocator, an open-addressing object table, and LRU eviction — the
+plasma-store design (``src/ray/object_manager/plasma/store.h:55``) as a
+daemon-less library.  Payload I/O is zero-copy: Python mmaps the same
+segment and slices memoryviews at offsets the C side allocates.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import mmap
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from ray_tpu._native.build import lib_path
+
+        path = lib_path()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_uint64]
+        lib.rtpu_store_create.restype = ctypes.c_int
+        lib.rtpu_store_attach.argtypes = [ctypes.c_char_p]
+        lib.rtpu_store_attach.restype = ctypes.c_int
+        lib.rtpu_store_detach.argtypes = [ctypes.c_int]
+        lib.rtpu_store_unlink.argtypes = [ctypes.c_char_p]
+        lib.rtpu_store_unlink.restype = ctypes.c_int
+        lib.rtpu_store_alloc.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                         ctypes.c_uint64]
+        lib.rtpu_store_alloc.restype = ctypes.c_int64
+        lib.rtpu_store_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rtpu_store_seal.restype = ctypes.c_int
+        lib.rtpu_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_store_get.restype = ctypes.c_int64
+        lib.rtpu_store_peek.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                        ctypes.POINTER(ctypes.c_uint64)]
+        lib.rtpu_store_peek.restype = ctypes.c_int64
+        lib.rtpu_store_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rtpu_store_release.restype = ctypes.c_int
+        lib.rtpu_store_contains.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rtpu_store_contains.restype = ctypes.c_int
+        lib.rtpu_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rtpu_store_delete.restype = ctypes.c_int
+        lib.rtpu_store_stats.argtypes = [ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_uint64 * 4)]
+        lib.rtpu_store_stats.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load_lib() is not None
+
+
+class NativeArenaStore:
+    """Per-process handle onto the node's shared arena."""
+
+    def __init__(self, name: str, arena_bytes: int = 256 * 1024 * 1024,
+                 table_capacity: int = 65536, create: bool = False):
+        lib = _load_lib()
+        if lib is None:
+            from ray_tpu._native.build import build_error
+
+            raise RuntimeError(f"native store unavailable: {build_error()}")
+        self._lib = lib
+        self.name = name
+        self._cname = name.encode()
+        if create:
+            h = lib.rtpu_store_create(self._cname, arena_bytes, table_capacity)
+            if h == -17:  # EEXIST: another process won the create race
+                h = lib.rtpu_store_attach(self._cname)
+        else:
+            h = lib.rtpu_store_attach(self._cname)
+            if h == -2 and create is False:  # ENOENT
+                raise FileNotFoundError(f"no arena {name!r}")
+        if h < 0:
+            raise OSError(-h, os.strerror(-h), name)
+        self._h = h
+        # python-side zero-copy view of the same segment
+        fd = os.open(f"/dev/shm{name if name.startswith('/') else '/' + name}",
+                     os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, 0)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mm)
+        self._closed = False
+
+    # -- SharedObjectStore-compatible interface ----------------------------
+
+    def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
+        oid = object_id.binary()
+        off = self._lib.rtpu_store_alloc(self._h, oid, len(payload))
+        if off == -17:  # EEXIST
+            # idempotent only if the existing entry is actually readable
+            # (a pending-delete entry is invisible — let the caller fall
+            # back to the segment store)
+            if self.contains(object_id):
+                return self.name
+            raise MemoryError(f"object {object_id.hex()} exists but is "
+                              f"not readable (pending delete)")
+        if off < 0:
+            raise MemoryError(
+                f"arena store alloc failed for {len(payload)}B: "
+                f"{os.strerror(-off)}")
+        self._view[off:off + len(payload)] = payload
+        rc = self._lib.rtpu_store_seal(self._h, oid)
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return self.name
+
+    def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int, List]:
+        payload, refs = serialization.serialize(value)
+        name = self.put_serialized(object_id, payload)
+        return name, len(payload), refs
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._lib.rtpu_store_contains(
+            self._h, object_id.binary()) == 1
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Unpinned zero-copy view (peek): lifetime is guaranteed by the
+        creator pin, which only an explicit delete() drops."""
+        size = ctypes.c_uint64()
+        off = self._lib.rtpu_store_peek(self._h, object_id.binary(),
+                                        ctypes.byref(size))
+        if off < 0:
+            return None
+        return self._view[off:off + size.value]
+
+    def pin(self, object_id: ObjectID) -> bool:
+        """Bump the refcount (protects from eviction AND from delete
+        freeing the block under live readers)."""
+        size = ctypes.c_uint64()
+        return self._lib.rtpu_store_get(self._h, object_id.binary(),
+                                        ctypes.byref(size)) >= 0
+
+    def get(self, object_id: ObjectID) -> Tuple[Any, List]:
+        buf = self.get_buffer(object_id)
+        if buf is None:
+            raise KeyError(object_id)
+        return serialization.deserialize(buf)
+
+    def get_bytes(self, object_id: ObjectID) -> Optional[bytes]:
+        buf = self.get_buffer(object_id)
+        return None if buf is None else bytes(buf)
+
+    def release(self, object_id: ObjectID):
+        self._lib.rtpu_store_release(self._h, object_id.binary())
+
+    def delete(self, object_id: ObjectID):
+        self._lib.rtpu_store_delete(self._h, object_id.binary())
+
+    def stats(self) -> Dict[str, int]:
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.rtpu_store_stats(self._h, ctypes.byref(out))
+        return {"capacity": out[0], "used": out[1], "objects": out[2],
+                "evictions": out[3]}
+
+    def close(self, unlink_created: bool = False):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._view.release()
+            self._mm.close()
+        except (BufferError, Exception):
+            pass  # exported buffers: OS reclaims at exit (plasma model)
+        self._lib.rtpu_store_detach(self._h)
+        if unlink_created:
+            self._lib.rtpu_store_unlink(self._cname)
+
+    def unlink(self):
+        self._lib.rtpu_store_unlink(self._cname)

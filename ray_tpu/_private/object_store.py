@@ -176,6 +176,126 @@ class SharedObjectStore:
                     pass
 
 
+def arena_name_for(session_dir: str) -> str:
+    import hashlib
+
+    tag = hashlib.md5(session_dir.encode()).hexdigest()[:12]
+    return f"/rtpu_arena_{tag}"
+
+
+class HybridObjectStore:
+    """Arena-first store: puts go into the node's C++ shm arena
+    (``ray_tpu/_native/store.cc`` — one mmap, boundary-tag allocator, no
+    per-object segment churn); objects that don't fit fall back to
+    per-object segments, so the 100-GiB-object path of the reference
+    (``single_node.json`` max ray.get) still works.
+
+    Lifetime protocol: every put leaves a creator pin (refcount 1), so LRU
+    eviction (which only touches refcount==0 sealed objects) can never
+    reclaim a live object; reads are unpinned peeks relying on that pin.
+    ``delete`` drops the creator pin and frees the block — or defers the
+    free until remote pins release (kPendingDelete), so pinned views never
+    dangle.  Net effect: the arena holds exactly the live object set, and a
+    full arena degrades to the per-object segment path, never to data loss.
+    """
+
+    def __init__(self, session_dir: str):
+        from ray_tpu._private.config import config
+
+        self.segments = SharedObjectStore()
+        self.arena = None
+        self._arena_max = 0
+        if getattr(config, "use_native_arena_store", True):
+            try:
+                from ray_tpu._private import native_store
+
+                if native_store.available():
+                    arena_bytes = int(getattr(config, "arena_store_bytes",
+                                              256 * 1024 * 1024))
+                    self.arena = native_store.NativeArenaStore(
+                        arena_name_for(session_dir), arena_bytes,
+                        create=True)
+                    # leave headroom: very large objects go to segments
+                    self._arena_max = arena_bytes // 4
+            except Exception:
+                logger.debug("native arena store unavailable", exc_info=True)
+                self.arena = None
+
+    # -- writes ---------------------------------------------------------------
+
+    def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
+        if self.arena is not None and len(payload) <= self._arena_max:
+            try:
+                name = self.arena.put_serialized(object_id, payload)
+                # creator pin: protects the object from LRU eviction and
+                # from delete-under-reader
+                self.arena.pin(object_id)
+                return name
+            except MemoryError:
+                pass  # arena full: segment fallback below
+        return self.segments.put_serialized(object_id, payload)
+
+    def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int, List]:
+        payload, refs = serialization.serialize(value)
+        name = self.put_serialized(object_id, payload)
+        return name, len(payload), refs
+
+    # -- reads ----------------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        if self.arena is not None and self.arena.contains(object_id):
+            return True
+        return self.segments.contains(object_id)
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        if self.arena is not None:
+            buf = self.arena.get_buffer(object_id)
+            if buf is not None:
+                return buf
+        return self.segments.get_buffer(object_id)
+
+    def get(self, object_id: ObjectID) -> Tuple[Any, List]:
+        buf = self.get_buffer(object_id)
+        if buf is None:
+            raise KeyError(object_id)
+        return serialization.deserialize(buf)
+
+    def get_bytes(self, object_id: ObjectID) -> Optional[bytes]:
+        buf = self.get_buffer(object_id)
+        return None if buf is None else bytes(buf)
+
+    # -- lifetime --------------------------------------------------------------
+
+    def release(self, object_id: ObjectID):
+        if self.arena is not None:
+            self.arena.release(object_id)
+        self.segments.release(object_id)
+
+    def delete(self, object_id: ObjectID):
+        if self.arena is not None:
+            self.arena.release(object_id)  # drop creator pin
+            self.arena.delete(object_id)
+        self.segments.delete(object_id)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.arena.stats() if self.arena is not None else {}
+
+    def close(self, unlink_created: bool = True):
+        if self.arena is not None:
+            self.arena.close(unlink_created=False)  # node owns arena lifetime
+        self.segments.close(unlink_created=unlink_created)
+
+
+def make_shared_store(session_dir: str):
+    """Store factory: hybrid arena+segments when the native lib builds,
+    pure per-object segments otherwise."""
+    try:
+        return HybridObjectStore(session_dir)
+    except Exception:
+        logger.debug("falling back to segment store", exc_info=True)
+        return SharedObjectStore()
+
+
 class MemoryStore:
     """Per-worker store for small in-band objects (owner serves peers).
 

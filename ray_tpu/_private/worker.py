@@ -46,7 +46,7 @@ from ray_tpu._private.ids import (
     WorkerID,
 )
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.object_store import MemoryStore, SharedObjectStore
+from ray_tpu._private.object_store import MemoryStore, make_shared_store
 from ray_tpu._private.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu._private.task_spec import TaskSpec, TaskType
 
@@ -113,7 +113,7 @@ class CoreWorker:
         self.serve_addr: str = ""
 
         self.memory_store = MemoryStore()
-        self.shared_store = SharedObjectStore()
+        self.shared_store = make_shared_store(session_dir)
         # owner-side: pending return objects → asyncio futures resolved at task reply
         self._result_futures: Dict[ObjectID, asyncio.Future] = {}
         # locations for sealed objects this process knows about

@@ -164,13 +164,15 @@ def make_rollout_fn(module: ActorCriticModule, env, num_steps: int,
             value = module.value(params, obs)
             (env_state, next_obs, reward, terminated, truncated,
              final_obs) = env.step(env_state, action, ke)
-            # time-limit bootstrap: fold V(final_obs) into the reward at
-            # truncations, then treat them as terminal for GAE
+            # time-limit bootstrap: fold V(final_obs) into the TRAINING
+            # reward at truncations, then treat them as terminal for GAE;
+            # the raw env reward is kept separately for progress metrics
             v_final = module.value(params, final_obs)
-            reward = reward + config.gamma * v_final * truncated
+            train_reward = reward + config.gamma * v_final * truncated
             done = terminated | truncated
             out = {"obs": obs, "actions": action, "logp_old": logp,
-                   "rewards": reward, "dones": done, "values": value}
+                   "rewards": train_reward, "raw_rewards": reward,
+                   "dones": done, "values": value}
             return (env_state, next_obs), out
 
         (env_state, obs), traj = jax.lax.scan(
@@ -186,7 +188,7 @@ def make_rollout_fn(module: ActorCriticModule, env, num_steps: int,
             "advantages": advs.reshape(-1),
             "returns": returns.reshape(-1),
         }
-        stats = {"reward_per_step": traj["rewards"].mean(),
+        stats = {"reward_per_step": traj["raw_rewards"].mean(),
                  "episodes_done": traj["dones"].sum()}
         return env_state, obs, flat, stats
 

@@ -1,0 +1,196 @@
+"""Native C++ arena store tests (plasma-equivalent, store.cc).
+
+Reference test model: ``src/ray/object_manager/plasma`` tests + the
+object-store microbenchmarks (``ray_perf.py`` put/get).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.native_store import NativeArenaStore, available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native store failed to build")
+
+
+@pytest.fixture
+def store():
+    name = f"/rtpu_test_{os.getpid()}_{np.random.randint(1 << 30)}"
+    s = NativeArenaStore(name, arena_bytes=16 * 1024 * 1024,
+                         table_capacity=4096, create=True)
+    yield s
+    s.close(unlink_created=True)
+
+
+def test_roundtrip_and_zero_copy(store):
+    oid = ObjectID.from_random()
+    arr = np.arange(10000, dtype=np.float64)
+    store.put(oid, arr)
+    out, _ = store.get(oid)
+    np.testing.assert_array_equal(out, arr)
+    # buffer is a view into the mapped arena (zero copy)
+    buf = store.get_buffer(oid)
+    assert buf is not None and len(buf) > arr.nbytes
+
+
+def test_contains_delete(store):
+    oid = ObjectID.from_random()
+    assert not store.contains(oid)
+    store.put_serialized(oid, b"hello")
+    assert store.contains(oid)
+    store.delete(oid)
+    assert not store.contains(oid)
+    assert store.get_buffer(oid) is None
+
+
+def test_duplicate_put_is_idempotent(store):
+    oid = ObjectID.from_random()
+    store.put_serialized(oid, b"v1")
+    store.put_serialized(oid, b"v1")  # deterministic re-store: no error
+    assert store.get_bytes(oid) == b"v1"
+
+
+def test_allocator_reuse_and_coalescing(store):
+    # fill, delete, refill with larger blocks — only works if freeing
+    # coalesces neighbors back into allocatable space
+    cap = store.stats()["capacity"]
+    oids = []
+    for _ in range(8):
+        o = ObjectID.from_random()
+        store.put_serialized(o, b"x" * (cap // 10))
+        oids.append(o)
+    for o in oids:
+        store.delete(o)
+    assert store.stats()["used"] == 0
+    big = ObjectID.from_random()
+    store.put_serialized(big, b"y" * (cap // 2))  # needs coalesced space
+    assert store.contains(big)
+
+
+def test_eviction_lru_of_released_only(store):
+    cap = store.stats()["capacity"]
+    pinned = ObjectID.from_random()
+    store.put_serialized(pinned, b"p" * (cap // 16))
+    store.pin(pinned)
+    released = []
+    for _ in range(40):
+        o = ObjectID.from_random()
+        store.put_serialized(o, b"r" * (cap // 16))  # unpinned: evictable
+        released.append(o)
+    assert store.stats()["evictions"] > 0
+    assert store.contains(pinned)  # pinned survived the pressure
+    assert not store.contains(released[0])  # oldest released was evicted
+
+
+def test_delete_under_pin_defers_free(store):
+    oid = ObjectID.from_random()
+    store.put_serialized(oid, b"d" * 1024)
+    store.pin(oid)
+    buf = store.get_buffer(oid)
+    assert bytes(buf[:4]) == b"dddd"
+    store.delete(oid)
+    # entry invisible, but the block is NOT freed while the pin lives
+    assert not store.contains(oid)
+    assert bytes(buf[:4]) == b"dddd"
+    used_before = store.stats()["used"]
+    store.release(oid)  # last pin: now reclaimed
+    assert store.stats()["used"] < used_before
+    del buf
+
+
+def test_orphaned_alloc_reclaimed_on_reput(store):
+    """Creator died between alloc and seal -> re-put must succeed."""
+    oid = ObjectID.from_random()
+    off = store._lib.rtpu_store_alloc(store._h, oid.binary(), 128)
+    assert off > 0  # allocated, never sealed (simulated crash)
+    store.put_serialized(oid, b"recovered")
+    assert store.get_bytes(oid) == b"recovered"
+
+
+def test_payload_alignment_for_dma(store):
+    """64-byte payload alignment (zero-copy jax.device_put invariant)."""
+    import ctypes
+
+    for size in (1, 100, 4096, 100001):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, b"a" * size)
+        size_out = ctypes.c_uint64()
+        off = store._lib.rtpu_store_peek(store._h, oid.binary(),
+                                         ctypes.byref(size_out))
+        assert off > 0 and off % 64 == 0, (size, off)
+
+
+def test_oversized_alloc_fails_cleanly(store):
+    cap = store.stats()["capacity"]
+    with pytest.raises(MemoryError):
+        store.put_serialized(ObjectID.from_random(), b"z" * (cap + 1))
+
+
+def test_cross_process_visibility(store):
+    oid = ObjectID.from_random()
+    store.put(oid, {"answer": 42})
+    code = (
+        "import sys; sys.path.insert(0, {repo!r})\n"
+        "from ray_tpu._private.native_store import NativeArenaStore\n"
+        "from ray_tpu._private.ids import ObjectID\n"
+        "s = NativeArenaStore({name!r})\n"
+        "val, _ = s.get(ObjectID(bytes.fromhex({oid!r})))\n"
+        "assert val['answer'] == 42\n"
+        "print('ok')\n"
+    ).format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             name=store.name, oid=oid.hex())
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 0 and "ok" in out.stdout, out.stderr
+
+
+def test_concurrent_multiprocess_stress(store):
+    """8 writer/reader processes hammering one arena (lock correctness)."""
+    n_procs, n_objs = 4, 30
+    code = (
+        "import sys; sys.path.insert(0, {repo!r})\n"
+        "import numpy as np\n"
+        "from ray_tpu._private.native_store import NativeArenaStore\n"
+        "from ray_tpu._private.ids import ObjectID\n"
+        "seed = int(sys.argv[1])\n"
+        "s = NativeArenaStore({name!r})\n"
+        "rng = np.random.default_rng(seed)\n"
+        "oids = []\n"
+        "for i in range({n}):\n"
+        "    oid = ObjectID(bytes([seed]) + i.to_bytes(4, 'little') + b'\\0' * 11)\n"
+        "    payload = bytes([seed, i % 256]) * 4096\n"
+        "    s.put_serialized(oid, payload)\n"
+        "    oids.append((oid, payload))\n"
+        "for oid, payload in oids:\n"
+        "    got = s.get_bytes(oid)\n"
+        "    assert got == payload, (oid, len(got or b''), len(payload))\n"
+        "for oid, _ in oids[: {n} // 2]:\n"
+        "    s.delete(oid)\n"
+        "print('ok')\n"
+    ).format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+             name=store.name, n=n_objs)
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(i)],
+                              stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                              text=True)
+             for i in range(n_procs)]
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0 and "ok" in out, err
+    # survivors readable, used-bytes consistent with half deleted
+    st = store.stats()
+    assert st["objects"] == n_procs * n_objs // 2
+
+
+def test_hybrid_store_fallback_for_huge_objects(ray_start):
+    """Objects beyond the arena threshold transparently use segment shm."""
+    import ray_tpu
+
+    big = np.zeros(90 * 1024 * 1024, dtype=np.uint8)  # > 256MB/4
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(ref)
+    assert out.nbytes == big.nbytes
