@@ -1,0 +1,17 @@
+"""ray_tpu.llm: LLM batch inference + serving (reference: ``python/ray/llm/``).
+
+The engine is TPU-native jax (slot-based continuous batching over a static
+KV cache — ``engine.py``) instead of a vLLM delegation; batch inference
+rides ``ray_tpu.data`` actor pools and serving rides ``ray_tpu.serve``.
+"""
+
+from ray_tpu.llm.batch import LLMPredictor, build_llm_processor
+from ray_tpu.llm.engine import ByteTokenizer, GenerationOutput, LLMEngine
+from ray_tpu.llm.serving import LLMServer, build_llm_deployment
+from ray_tpu.models.generation import SamplingParams
+
+__all__ = [
+    "ByteTokenizer", "GenerationOutput", "LLMEngine", "LLMPredictor",
+    "LLMServer", "SamplingParams", "build_llm_deployment",
+    "build_llm_processor",
+]

@@ -1,0 +1,230 @@
+"""LLMEngine: slot-based continuous batching over the jax generation path.
+
+Reference capability: ``ray.llm`` delegates the engine to vLLM
+(``_internal/serve/deployments/llm/vllm/vllm_engine.py`` — continuous
+batching, paged KV).  TPU-native redesign: the KV cache is one static
+tensor of B slots x max_len (static shapes = one compiled decode program
+reused forever); scheduling is slot-granular continuous batching — a
+finished request frees its slot, the next queued request prefills into it
+while other slots keep decoding.  Paged attention is unnecessary at this
+granularity: slot memory is bounded by B * max_len, chosen at engine
+construction like vLLM's gpu_memory_utilization-derived KV budget.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.models.generation import SamplingParams
+from ray_tpu.models.llama import LlamaConfig
+
+
+class ByteTokenizer:
+    """Dependency-free tokenizer: UTF-8 bytes shifted by the special ids.
+
+    vocab: 0=pad, 1=bos, 2=eos, byte b -> 3+b.  Lets the whole llm stack
+    run hermetically (no tokenizer downloads) — swap in a HF tokenizer via
+    ``LLMEngine(tokenizer=...)`` for real checkpoints.
+    """
+
+    pad_id, bos_id, eos_id = 0, 1, 2
+    vocab_size = 259
+
+    def encode(self, text: str) -> List[int]:
+        return [self.bos_id] + [3 + b for b in text.encode("utf-8")]
+
+    def decode(self, ids) -> str:
+        data = bytes(i - 3 for i in ids if i >= 3)
+        return data.decode("utf-8", "replace")
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: int
+    prompt_tokens: List[int]
+    sampling: SamplingParams
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.out_tokens)
+
+
+@dataclasses.dataclass
+class GenerationOutput:
+    request_id: int
+    prompt_tokens: List[int]
+    token_ids: List[int]
+    text: Optional[str] = None
+
+
+class LLMEngine:
+    def __init__(self, cfg: LlamaConfig, params=None, *,
+                 tokenizer: Optional[Any] = None, batch_slots: int = 8,
+                 max_len: Optional[int] = None, seed: int = 0, mesh=None):
+        import jax
+
+        from ray_tpu.models.llama import llama_init
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.B = batch_slots
+        self.max_len = max_len or cfg.max_seq_len
+        if params is None:
+            params = llama_init(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+        self._key = jax.random.PRNGKey(seed + 1)
+
+        from ray_tpu.models.generation import decode_step, init_kv_cache, prefill
+
+        self.cache = init_kv_cache(cfg, self.B, self.max_len)
+        self._prefill = jax.jit(functools.partial(prefill, cfg=cfg))
+        self._decode = jax.jit(functools.partial(decode_step, cfg=cfg))
+        self._sample = jax.jit(self._sample_impl)
+
+        self._ids = itertools.count()
+        self._queue: "collections.deque[Request]" = collections.deque()
+        self._slots: List[Optional[Request]] = [None] * self.B
+        self._cur_len = np.zeros(self.B, np.int32)
+        self._next_token = np.zeros(self.B, np.int32)
+        self._finished: List[Request] = []
+
+    # -- request API --------------------------------------------------------
+
+    def submit(self, prompt: str | List[int],
+               sampling: Optional[SamplingParams] = None) -> int:
+        if isinstance(prompt, str):
+            prompt = self.tokenizer.encode(prompt)
+        sampling = sampling or SamplingParams(
+            stop_token_id=getattr(self.tokenizer, "eos_id", None))
+        req = Request(next(self._ids), list(prompt), sampling)
+        if len(req.prompt_tokens) >= self.max_len:
+            raise ValueError(
+                f"prompt of {len(req.prompt_tokens)} tokens >= engine "
+                f"max_len {self.max_len}")
+        self._queue.append(req)
+        return req.request_id
+
+    def has_unfinished(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    # -- continuous-batching step ------------------------------------------
+
+    def step(self) -> List[GenerationOutput]:
+        """Admit queued requests into free slots (prefill), run ONE decode
+        step for all active slots, retire finished requests."""
+        import jax
+        import jax.numpy as jnp
+
+        # 1. admit
+        for i in range(self.B):
+            if self._slots[i] is None and self._queue:
+                req = self._queue.popleft()
+                self._slots[i] = req
+                logits = self._prefill_into_slot(i, req)
+                self._key, k = jax.random.split(self._key)
+                tok = int(self._sample(
+                    logits, k, self._temp_vec(slice(i, i + 1)))[0])
+                self._record_token(i, req, tok)
+
+        active = [i for i in range(self.B) if self._slots[i] is not None
+                  and not self._slots[i].done]
+        if active:
+            # 2. one decode step across ALL slots (inactive slots decode
+            # garbage into their own lane; masked out by cur_len bookkeeping)
+            tokens = jnp.asarray(self._next_token)
+            cur = jnp.asarray(self._cur_len)
+            logits, self.cache = self._decode(self.params, tokens, cur,
+                                              self.cache)
+            self._cur_len += np.asarray(
+                [1 if self._slots[i] is not None and not self._slots[i].done
+                 else 0 for i in range(self.B)], np.int32)
+            self._key, k = jax.random.split(self._key)
+            sampled = np.asarray(self._sample(logits, k, self._temp_vec()))
+            for i in active:
+                self._record_token(i, self._slots[i], int(sampled[i]))
+
+        # 3. retire
+        out = []
+        for i in range(self.B):
+            req = self._slots[i]
+            if req is not None and req.done:
+                out.append(GenerationOutput(
+                    req.request_id, req.prompt_tokens, req.out_tokens,
+                    text=self.tokenizer.decode(req.out_tokens)))
+                self._slots[i] = None
+        return out
+
+    def generate(self, prompts: List[str | List[int]],
+                 sampling: Optional[SamplingParams] = None
+                 ) -> List[GenerationOutput]:
+        ids = [self.submit(p, sampling) for p in prompts]
+        results: Dict[int, GenerationOutput] = {}
+        while self.has_unfinished():
+            for out in self.step():
+                results[out.request_id] = out
+        return [results[i] for i in ids]
+
+    # -- internals ----------------------------------------------------------
+
+    def _prefill_into_slot(self, i: int, req: Request):
+        """b=1 prefill, scattered into slot i of the shared cache."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generation import init_kv_cache
+
+        # pad the prompt to a power-of-2 bucket so prefill compiles
+        # O(log max_len) times, not once per distinct prompt length
+        n = len(req.prompt_tokens)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        bucket = min(bucket, self.max_len)
+        toks = jnp.asarray(
+            [req.prompt_tokens + [0] * (bucket - n)], jnp.int32)
+        lengths = jnp.asarray([n], jnp.int32)
+        tmp = init_kv_cache(self.cfg, 1, self.max_len)
+        logits, tmp = self._prefill(self.params, toks, lengths, tmp)
+        self.cache = {
+            "k": self.cache["k"].at[:, i].set(tmp["k"][:, 0]),
+            "v": self.cache["v"].at[:, i].set(tmp["v"][:, 0]),
+        }
+        self._cur_len[i] = len(req.prompt_tokens)
+        return logits
+
+    def _record_token(self, i: int, req: Request, tok: int):
+        sp = req.sampling
+        if sp.stop_token_id is not None and tok == sp.stop_token_id:
+            req.done = True
+            return
+        req.out_tokens.append(tok)
+        self._next_token[i] = tok
+        if (req.num_generated >= sp.max_tokens
+                or len(req.prompt_tokens) + req.num_generated
+                >= self.max_len - 1):
+            req.done = True
+
+    def _temp_vec(self, sl: slice = slice(None)) -> np.ndarray:
+        temps = np.ones(self.B, np.float32)
+        for i in range(self.B):
+            if self._slots[i] is not None:
+                temps[i] = self._slots[i].sampling.temperature
+        return temps[sl]
+
+    def _sample_impl(self, logits, key, temperature):
+        """Vectorized per-slot temperature; 0 => greedy."""
+        import jax
+        import jax.numpy as jnp
+
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        t = jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = jax.random.categorical(key, logits / t).astype(jnp.int32)
+        return jnp.where(temperature <= 0.0, greedy, sampled)

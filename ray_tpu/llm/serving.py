@@ -1,0 +1,97 @@
+"""LLM serving: an engine-per-replica deployment over ray_tpu.serve.
+
+Reference: ``python/ray/llm/_internal/serve/`` (vLLM deployments where
+tensor_parallel_size maps to placement-group bundles,
+``vllm_models.py:123-191``).  TPU-native: a replica owns a whole chip set
+and shards the model over an in-process mesh (tp axis) — parallelism is a
+sharding spec inside the replica, not a bundle of worker processes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="LLMServer", max_ongoing_requests=32)
+class LLMServer:
+    """HTTP/handle API: {"prompt": str, "max_tokens"?, "temperature"?}
+    -> {"generated_text": str, "num_generated_tokens": int}.
+
+    Concurrency model: request threads only SUBMIT into the engine (under a
+    lock) and wait on per-request events; one background thread drives
+    ``engine.step()``.  Concurrent requests therefore share decode batches
+    (continuous batching across HTTP requests) instead of racing the
+    engine's state.
+    """
+
+    def __init__(self, engine_kwargs: Optional[Dict[str, Any]] = None,
+                 tensor_parallel_size: int = 1):
+        import threading
+
+        from ray_tpu.models.llama import LlamaConfig
+        from ray_tpu.llm.engine import LLMEngine
+
+        kw = dict(engine_kwargs or {})
+        cfg = kw.pop("cfg", None) or LlamaConfig.tiny()
+        mesh = None
+        if tensor_parallel_size > 1:
+            from ray_tpu.parallel import MeshConfig, create_mesh
+
+            mesh = create_mesh(MeshConfig(dp=1, tp=tensor_parallel_size))
+        self.engine = LLMEngine(cfg, mesh=mesh, **kw)
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, Any] = {}  # request_id -> {event, output}
+        self._stop = False
+        self._loop = threading.Thread(target=self._engine_loop, daemon=True)
+        self._loop.start()
+
+    def _engine_loop(self):
+        import time
+
+        while not self._stop:
+            with self._lock:
+                busy = self.engine.has_unfinished()
+                outs = self.engine.step() if busy else []
+                for out in outs:
+                    slot = self._waiters.pop(out.request_id, None)
+                    if slot is not None:
+                        slot["output"] = out
+                        slot["event"].set()
+            if not busy:
+                time.sleep(0.005)
+
+    def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        import threading
+
+        from ray_tpu.models.generation import SamplingParams
+
+        prompt = body["prompt"]
+        sp = SamplingParams(
+            temperature=float(body.get("temperature", 0.7)),
+            max_tokens=int(body.get("max_tokens", 64)),
+            stop_token_id=self.engine.tokenizer.eos_id)
+        slot = {"event": threading.Event(), "output": None}
+        with self._lock:
+            rid = self.engine.submit(prompt, sp)
+            self._waiters[rid] = slot
+        if not slot["event"].wait(timeout=600):
+            raise TimeoutError("generation timed out")
+        out = slot["output"]
+        return {"generated_text": out.text,
+                "num_generated_tokens": len(out.token_ids)}
+
+    def __del__(self):
+        self._stop = True
+
+
+def build_llm_deployment(engine_kwargs: Optional[Dict[str, Any]] = None,
+                         *, num_replicas: int = 1,
+                         tensor_parallel_size: int = 1,
+                         num_tpus_per_replica: float = 0):
+    """Configured LLM deployment (reference: ``serve/llm build_llm_deployment``)."""
+    opts: Dict[str, Any] = {"num_replicas": num_replicas}
+    if num_tpus_per_replica:
+        opts["ray_actor_options"] = {"num_tpus": num_tpus_per_replica}
+    return LLMServer.options(**opts).bind(engine_kwargs, tensor_parallel_size)

@@ -24,6 +24,7 @@ class ServeController:
         self._deployments: Dict[str, Dict[str, Any]] = {}
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment
         self._apps: Dict[str, str] = {}  # app name -> ingress deployment
+        self._health_fails: Dict[str, int] = {}  # replica -> consecutive
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._loop = threading.Thread(target=self._reconcile_loop, daemon=True)
@@ -200,18 +201,29 @@ class ServeController:
                      self._deployments.items()]
         for name, replicas in items:
             for r in replicas:
+                key = r._actor_id.hex()
                 try:
                     ray_tpu.get(r.check_health.remote(), timeout=10)
+                    self._health_fails.pop(key, None)
+                    continue
                 except Exception:
-                    with self._lock:
-                        st = self._deployments.get(name)
-                        if st and r in st["replicas"]:
-                            st["replicas"].remove(r)
-                            st["version"] += 1
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
+                    # a slow check (e.g. the replica is jit-compiling and
+                    # holding the GIL) is not death: replace only after
+                    # consecutive failures
+                    fails = self._health_fails.get(key, 0) + 1
+                    self._health_fails[key] = fails
+                    if fails < 3:
+                        continue
+                self._health_fails.pop(key, None)
+                with self._lock:
+                    st = self._deployments.get(name)
+                    if st and r in st["replicas"]:
+                        st["replicas"].remove(r)
+                        st["version"] += 1
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
 
     def _reconcile_loop(self):
         n = 0
