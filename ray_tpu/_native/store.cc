@@ -26,6 +26,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <fcntl.h>
 #include <pthread.h>
 #include <sys/mman.h>
@@ -190,6 +191,9 @@ uint64_t coalesce(Handle& h, uint64_t off) {
 // allocate a block with payload >= want; returns payload offset or 0
 uint64_t arena_alloc(Handle& h, uint64_t want) {
   Header* H = hdr(h);
+  // min 8 payload bytes: a freed block stores next_free in its payload, so
+  // a zero-size block would write into the neighboring block's header
+  if (want < 8) want = 8;
   uint64_t need = align_up(kBlockHdr + want, kAlign);
   uint64_t* cur = &H->free_head;
   while (*cur) {
@@ -356,10 +360,13 @@ int rtpu_store_attach(const char* name) {
   close(fd);
   if (base == MAP_FAILED) return -errno;
   Header* H = (Header*)base;
-  // wait for creator to finish initialization (magic written with release)
-  for (int spin = 0; __atomic_load_n(&H->magic, __ATOMIC_ACQUIRE) != kMagic;
-       spin++) {
-    if (spin > 1000000) { munmap(base, st.st_size); return -EINVAL; }
+  // wait for creator to finish initialization (magic written with release);
+  // time-based so a descheduled creator doesn't fail the attach
+  struct timespec ts = {0, 1000000};  // 1ms
+  for (int ms = 0; __atomic_load_n(&H->magic, __ATOMIC_ACQUIRE) != kMagic;
+       ms++) {
+    if (ms > 5000) { munmap(base, st.st_size); return -ETIMEDOUT; }
+    nanosleep(&ts, nullptr);
   }
   Handle h;
   h.base = (uint8_t*)base;
@@ -432,7 +439,13 @@ int rtpu_store_seal(int hi, const uint8_t* id) {
   int rc = 0;
   Entry* e = find_entry(*h, id, false);
   if (!e || e->state != kAllocated) rc = -ENOENT;
-  else { e->state = kSealed; e->refcount = 0; }
+  else {
+    e->state = kSealed;
+    // the alloc-time creator pin CARRIES OVER through seal (refcount stays
+    // 1): there is no window where a freshly put object is evictable.
+    // release()/delete() drop it.
+    e->refcount = 1;
+  }
   unlock(*h);
   return rc;
 }

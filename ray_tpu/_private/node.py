@@ -164,16 +164,51 @@ class NodeServices:
         self._cleanup_shm()
 
     def _cleanup_shm(self):
-        # unlink any leftover rtpu_* shared-memory objects from this session's
-        # stores (plasma-equivalent teardown)
+        # Always unlink this session's arena (its name is session-keyed).
         try:
-            for name in os.listdir("/dev/shm"):
-                if name.startswith("rtpu_"):
-                    try:
-                        os.unlink(os.path.join("/dev/shm", name))
-                    except OSError:
-                        pass
+            from ray_tpu._private.object_store import arena_name_for
+
+            os.unlink("/dev/shm" + arena_name_for(self.session_dir))
         except OSError:
             pass
+        # Per-object segments are not session-keyed, so sweep them ONLY when
+        # no other live session exists on this host — a concurrent cluster's
+        # objects and channels must not be unlinked out from under it.  A
+        # session dir counts as live only if its creator pid (embedded in
+        # the name: session_<ts>_<pid>_<ns>) is still running; crashed
+        # sessions are reaped here so they can't block cleanup forever.
+        others = []
+        try:
+            for d in os.listdir(_SESSION_ROOT):
+                path = os.path.join(_SESSION_ROOT, d)
+                if not d.startswith("session_") or path == self.session_dir:
+                    continue
+                # name: session_<strftime(%Y-%m-%d_%H-%M-%S)>_<pid>_<ns>
+                # → pid is the second-to-last token.  Unparseable names are
+                # treated as LIVE (never sweep shm under an unknown session).
+                alive = True
+                try:
+                    pid = int(d.split("_")[-2])
+                    os.kill(pid, 0)
+                except (IndexError, ValueError, PermissionError):
+                    pass
+                except ProcessLookupError:
+                    alive = False
+                if alive:
+                    others.append(d)
+                else:
+                    shutil.rmtree(path, ignore_errors=True)
+        except OSError:
+            pass
+        if not others:
+            try:
+                for name in os.listdir("/dev/shm"):
+                    if name.startswith("rtpu_"):
+                        try:
+                            os.unlink(os.path.join("/dev/shm", name))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
         if self.session_dir and os.path.isdir(self.session_dir):
             shutil.rmtree(self.session_dir, ignore_errors=True)

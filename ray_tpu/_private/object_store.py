@@ -190,13 +190,14 @@ class HybridObjectStore:
     per-object segments, so the 100-GiB-object path of the reference
     (``single_node.json`` max ray.get) still works.
 
-    Lifetime protocol: every put leaves a creator pin (refcount 1), so LRU
-    eviction (which only touches refcount==0 sealed objects) can never
-    reclaim a live object; reads are unpinned peeks relying on that pin.
-    ``delete`` drops the creator pin and frees the block — or defers the
-    free until remote pins release (kPendingDelete), so pinned views never
-    dangle.  Net effect: the arena holds exactly the live object set, and a
-    full arena degrades to the per-object segment path, never to data loss.
+    Lifetime protocol: seal leaves the creator pin in place (refcount 1,
+    set at alloc), so LRU eviction — which only touches refcount==0 sealed
+    objects — can never reclaim a live object, with no window between put
+    and pin.  Reads are unpinned peeks: callers that need a view to outlive
+    a possible ``delete`` must ``pin()``/``release()`` explicitly; the
+    ownership layer guarantees ``delete`` only runs once no reader remains,
+    and pinned readers defer the block free (kPendingDelete).  A full arena
+    degrades to the per-object segment path, never to data loss.
     """
 
     def __init__(self, session_dir: str):
@@ -226,11 +227,9 @@ class HybridObjectStore:
     def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
         if self.arena is not None and len(payload) <= self._arena_max:
             try:
-                name = self.arena.put_serialized(object_id, payload)
-                # creator pin: protects the object from LRU eviction and
-                # from delete-under-reader
-                self.arena.pin(object_id)
-                return name
+                # seal retains the creator pin (refcount 1): no eviction
+                # window, and duplicate puts don't stack extra pins
+                return self.arena.put_serialized(object_id, payload)
             except MemoryError:
                 pass  # arena full: segment fallback below
         return self.segments.put_serialized(object_id, payload)

@@ -480,14 +480,14 @@ class Raylet:
         # Cross-node object pull endpoint (reference ObjectManager push/pull,
         # src/ray/object_manager/object_manager.h:106). Single-host topologies
         # resolve through shared memory directly; this is the DCN fallback.
+        # Must read through the hybrid store: most objects live in the
+        # session's C++ arena, not in per-object segments.
         from ray_tpu._private.ids import ObjectID
-        from ray_tpu._private.object_store import SharedObjectStore
+        from ray_tpu._private.object_store import make_shared_store
 
-        store = SharedObjectStore()
-        try:
-            return store.get_bytes(ObjectID.from_hex(oid_hex))
-        finally:
-            store.close(unlink_created=False)
+        if not hasattr(self, "_pull_store"):
+            self._pull_store = make_shared_store(self.session_dir)
+        return self._pull_store.get_bytes(ObjectID.from_hex(oid_hex))
 
     async def handle_shutdown_node(self) -> bool:
         asyncio.ensure_future(self.stop())

@@ -1,0 +1,186 @@
+"""User-defined application metrics (Counter / Gauge / Histogram).
+
+Reference: ``python/ray/util/metrics.py`` over the C++ OpenCensus registry
+(``src/ray/stats/metric.h:105``) exported by the metrics agent.  Here:
+an in-process registry; every worker publishes its metrics into the GCS
+internal KV every few seconds, and the dashboard/state API aggregate and
+expose them in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_publisher_started = False
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+        _ensure_publisher()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        return out
+
+    def snapshot(self) -> List[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tag_key(self._resolve_tags(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tag_key(self._resolve_tags(tags))] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (), tag_keys: Sequence[str] = ()):
+        self.boundaries = sorted(boundaries) or [
+            0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10]
+        super().__init__(name, description, tag_keys)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tag_key(self._resolve_tags(tags))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._values[key] = self._sums[key]
+
+    def snapshot_histogram(self):
+        with self._lock:
+            return {k: (list(v), self._sums.get(k, 0.0))
+                    for k, v in self._counts.items()}
+
+
+def collect_local() -> Dict[str, Dict]:
+    """All metrics registered in this process, as a JSON-able dict."""
+    with _registry_lock:
+        metrics = dict(_registry)
+    out = {}
+    for name, m in metrics.items():
+        entry = {"kind": m.kind, "description": m.description, "series": []}
+        for tags, value in m.snapshot():
+            entry["series"].append({"tags": tags, "value": value})
+        if isinstance(m, Histogram):
+            entry["boundaries"] = m.boundaries
+            entry["histogram"] = [
+                {"tags": dict(k), "counts": c, "sum": s}
+                for k, (c, s) in m.snapshot_histogram().items()]
+        out[name] = entry
+    return out
+
+
+def _publish_once():
+    import ray_tpu
+    from ray_tpu.experimental.internal_kv import _internal_kv_put
+
+    if not ray_tpu.is_initialized():
+        return
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker(required=False)
+    if w is None:
+        return
+    payload = json.dumps({"ts": time.time(), "metrics": collect_local()})
+    _internal_kv_put(f"metrics/{w.worker_id.hex()[:16]}".encode(),
+                     payload.encode(), namespace="metrics")
+
+
+def _ensure_publisher():
+    global _publisher_started
+    with _registry_lock:
+        if _publisher_started:
+            return
+        _publisher_started = True
+
+    def loop():
+        while True:
+            time.sleep(5.0)
+            try:
+                _publish_once()
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, daemon=True, name="rtpu-metrics").start()
+
+
+def prometheus_text(all_metrics: Dict[str, Dict]) -> str:
+    """Render aggregated metrics in Prometheus exposition format
+    (reference: ``python/ray/_private/prometheus_exporter.py``)."""
+    def labels(tags: Dict[str, str], extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in sorted(tags.items())]
+        if extra:
+            parts.append(extra)
+        return f"{{{','.join(parts)}}}" if parts else ""
+
+    lines = []
+    for name, entry in sorted(all_metrics.items()):
+        safe = name.replace("-", "_").replace(".", "_")
+        if entry.get("description"):
+            lines.append(f"# HELP {safe} {entry['description']}")
+        lines.append(f"# TYPE {safe} {entry['kind']}")
+        if entry["kind"] == "histogram":
+            # exposition format requires _bucket{le}/_sum/_count series
+            bounds = entry.get("boundaries", [])
+            for h in entry.get("histogram", []):
+                cum = 0
+                for bound, count in zip(bounds, h["counts"]):
+                    cum += count
+                    lines.append(
+                        f"{safe}_bucket{labels(h['tags'], f'le=\"{bound}\"')}"
+                        f" {cum}")
+                cum += h["counts"][-1] if len(h["counts"]) > len(bounds) else 0
+                lines.append(
+                    f"{safe}_bucket{labels(h['tags'], 'le=\"+Inf\"')} {cum}")
+                lines.append(f"{safe}_sum{labels(h['tags'])} {h['sum']}")
+                lines.append(f"{safe}_count{labels(h['tags'])} {cum}")
+            continue
+        for s in entry.get("series", []):
+            lines.append(f"{safe}{labels(s['tags'])} {s['value']}")
+    return "\n".join(lines) + "\n"

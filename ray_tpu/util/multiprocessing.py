@@ -1,0 +1,163 @@
+"""Drop-in ``multiprocessing.Pool`` over ray_tpu tasks.
+
+Reference: ``python/ray/util/multiprocessing/pool.py`` — same API surface
+(map/starmap/imap/imap_unordered/apply/apply_async/close/join), tasks run
+across the cluster instead of forked locals.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            ray_tpu.get(self._refs)
+            return True
+        except Exception:
+            return False
+
+
+@ray_tpu.remote
+def _run_callable(fn, args, kwargs):
+    return fn(*args, **kwargs)
+
+
+@ray_tpu.remote
+def _run_chunk(fn, chunk, star: bool):
+    return [fn(*item) if star else fn(item) for item in chunk]
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or 8
+        self._closed = False
+        # initializer semantics differ (no dedicated pool processes); run it
+        # inside each chunk-task via a wrapper when provided
+        self._initializer = initializer
+        self._initargs = initargs
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _wrap(self, fn):
+        if self._initializer is None:
+            return fn
+        init, initargs = self._initializer, self._initargs
+
+        def wrapped(*a, **kw):
+            init(*initargs)
+            return fn(*a, **kw)
+
+        return wrapped
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], len(items)
+
+    # -- map family ---------------------------------------------------------
+
+    def map(self, fn, iterable: Iterable, chunksize: Optional[int] = None
+            ) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check()
+        chunks, _ = self._chunks(iterable, chunksize)
+        fn = self._wrap(fn)
+        refs = [_run_chunk.remote(fn, c, False) for c in chunks]
+        return _ChunkedResult(refs)
+
+    def starmap(self, fn, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        self._check()
+        chunks, _ = self._chunks(iterable, chunksize)
+        fn = self._wrap(fn)
+        refs = [_run_chunk.remote(fn, c, True) for c in chunks]
+        return _ChunkedResult(refs).get()
+
+    def imap(self, fn, iterable: Iterable, chunksize: int = 1):
+        self._check()
+        fn = self._wrap(fn)
+        chunks, _ = self._chunks(iterable, chunksize)
+        refs = [_run_chunk.remote(fn, c, False) for c in chunks]
+        for ref in refs:  # submission order
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn, iterable: Iterable, chunksize: int = 1):
+        self._check()
+        fn = self._wrap(fn)
+        chunks, _ = self._chunks(iterable, chunksize)
+        pending = {_run_chunk.remote(fn, c, False) for c in chunks}
+        while pending:
+            done, pending_list = ray_tpu.wait(list(pending), num_returns=1)
+            pending = set(pending_list)
+            for ref in done:
+                yield from ray_tpu.get(ref)
+
+    # -- apply family -------------------------------------------------------
+
+    def apply(self, fn, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args: tuple = (),
+                    kwds: Optional[dict] = None) -> AsyncResult:
+        self._check()
+        ref = _run_callable.remote(self._wrap(fn), args, kwds or {})
+        return AsyncResult([ref], single=True)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _ChunkedResult(AsyncResult):
+    def __init__(self, refs):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: Optional[float] = None):
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        return [x for c in chunks for x in c]

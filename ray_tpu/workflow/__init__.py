@@ -1,0 +1,27 @@
+"""ray_tpu.workflow: durable DAG execution with storage-backed checkpoints.
+
+Reference: ``python/ray/workflow/`` (``api.py`` — run/resume/list_all/
+get_output/get_status; step results persisted so a crashed driver resumes
+where it stopped).  Steps are the classic-DAG nodes of ``ray_tpu.dag``;
+each step's result is checkpointed under
+``{storage}/{workflow_id}/steps/{step_id}`` keyed by a content hash of the
+step's function + upstream lineage, so resume re-executes only what's
+missing.
+"""
+
+from ray_tpu.workflow.api import (
+    WorkflowStatus,
+    delete,
+    get_metadata,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "WorkflowStatus", "delete", "get_metadata", "get_output", "get_status",
+    "list_all", "resume", "run", "run_async",
+]

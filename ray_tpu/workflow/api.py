@@ -1,0 +1,270 @@
+"""Workflow execution engine: checkpointed step-by-step DAG runs.
+
+Reference: ``python/ray/workflow/api.py`` + ``workflow_executor.py`` —
+step results are durable; ``resume`` replays only missing steps.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.dag_node import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+class WorkflowStatus(str, enum.Enum):
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+    RESUMABLE = "RESUMABLE"
+
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
+_registry_lock = threading.Lock()
+
+
+def _storage_root(storage: Optional[str]) -> str:
+    return storage or os.environ.get("RAY_TPU_WORKFLOW_STORAGE",
+                                     _DEFAULT_STORAGE)
+
+
+def _wf_dir(workflow_id: str, storage: Optional[str]) -> str:
+    return os.path.join(_storage_root(storage), workflow_id)
+
+
+def _step_id(node: DAGNode, id_of) -> str:
+    """Content-addressed step id: function identity + lineage + const args
+    (position-sensitive: f(inp, 1) and f(1, inp) hash apart).
+
+    Two runs of the same DAG produce identical ids, so resume matches
+    completed steps; changing a step's code or inputs changes its id and
+    forces re-execution downstream.  ``id_of(node)`` resolves an upstream
+    node to its step id.
+    """
+    h = hashlib.sha256()
+    if isinstance(node, FunctionNode):
+        fn = node.remote_function._function
+        h.update(getattr(fn, "__module__", "").encode())
+        h.update(getattr(fn, "__qualname__", "").encode())
+        try:
+            h.update(fn.__code__.co_code)
+        except AttributeError:
+            pass
+    else:
+        h.update(type(node).__name__.encode())
+        h.update(getattr(node, "key", "") .__repr__().encode())
+    slots = [(f"arg{i}", a) for i, a in enumerate(node._bound_args)]
+    slots += sorted(((f"kw:{k}", v) for k, v in node._bound_kwargs.items()),
+                    key=lambda kv: kv[0])
+    for label, a in slots:
+        h.update(label.encode())
+        if isinstance(a, DAGNode):
+            h.update(b"\x00dag:" + id_of(a).encode())
+        else:
+            try:
+                h.update(b"\x00const:" + pickle.dumps(a))
+            except Exception:
+                h.update(b"\x00const:" + repr(a).encode())
+    return h.hexdigest()[:24]
+
+
+def _write_meta(wf_dir: str, meta: Dict[str, Any]):
+    tmp = os.path.join(wf_dir, "meta.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(wf_dir, "meta.json"))
+
+
+def _read_meta(wf_dir: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(wf_dir, "meta.json")) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+class _StepStore:
+    def __init__(self, wf_dir: str):
+        self.dir = os.path.join(wf_dir, "steps")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(os.path.join(self.dir, f"{step_id}.pkl"))
+
+    def load(self, step_id: str) -> Any:
+        with open(os.path.join(self.dir, f"{step_id}.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value: Any):
+        tmp = os.path.join(self.dir, f"{step_id}.pkl.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, os.path.join(self.dir, f"{step_id}.pkl"))
+
+
+def _execute_workflow(dag: DAGNode, args, kwargs, workflow_id: str,
+                      storage: Optional[str]) -> Any:
+    """Topo-walk the DAG; execute-or-restore each step; checkpoint results."""
+    import ray_tpu
+
+    wf_dir = _wf_dir(workflow_id, storage)
+    os.makedirs(wf_dir, exist_ok=True)
+    store = _StepStore(wf_dir)
+    # persist the input so resume() replays with identical arguments
+    input_path = os.path.join(wf_dir, "input.pkl")
+    if not os.path.exists(input_path):
+        with open(input_path, "wb") as f:
+            pickle.dump((args, kwargs), f)
+    else:
+        with open(input_path, "rb") as f:
+            args, kwargs = pickle.load(f)
+
+    _write_meta(wf_dir, {"status": WorkflowStatus.RUNNING,
+                         "workflow_id": workflow_id, "start_time": time.time()})
+    results: Dict[int, Any] = {}
+    step_ids: Dict[int, str] = {}
+    n_restored = n_executed = 0
+    try:
+        for node in dag._collect():
+            if isinstance(node, InputNode):
+                if len(args) == 1 and not kwargs:
+                    results[id(node)] = args[0]
+                else:
+                    results[id(node)] = (args, kwargs)
+                step_ids[id(node)] = hashlib.sha256(
+                    pickle.dumps((args, kwargs))).hexdigest()[:24]
+                continue
+            if isinstance(node, InputAttributeNode):
+                key = node.key
+                results[id(node)] = (kwargs[key] if isinstance(key, str)
+                                     else args[key])
+                step_ids[id(node)] = _step_id(
+                    node, lambda n: step_ids[id(n)])
+                continue
+            if isinstance(node, MultiOutputNode):
+                results[id(node)] = [results[id(o)] for o in node.outputs]
+                continue
+            if not isinstance(node, FunctionNode):
+                raise TypeError(
+                    f"workflows support task (function) steps; got "
+                    f"{type(node).__name__} — wrap actor state in steps")
+            sid = _step_id(node, lambda n: step_ids[id(n)])
+            step_ids[id(node)] = sid
+            if store.has(sid):
+                results[id(node)] = store.load(sid)
+                n_restored += 1
+                continue
+            a = [results[id(x)] if isinstance(x, DAGNode) else x
+                 for x in node._bound_args]
+            kw = {k: results[id(v)] if isinstance(v, DAGNode) else v
+                  for k, v in node._bound_kwargs.items()}
+            value = ray_tpu.get(node.remote_function.remote(*a, **kw))
+            store.save(sid, value)
+            results[id(node)] = value
+            n_executed += 1
+    except BaseException as e:
+        _write_meta(wf_dir, {"status": WorkflowStatus.RESUMABLE,
+                             "workflow_id": workflow_id,
+                             "error": repr(e), "end_time": time.time()})
+        raise
+    output = results[id(dag)]
+    with open(os.path.join(wf_dir, "output.pkl"), "wb") as f:
+        pickle.dump(output, f)
+    _write_meta(wf_dir, {"status": WorkflowStatus.SUCCESSFUL,
+                         "workflow_id": workflow_id,
+                         "steps_executed": n_executed,
+                         "steps_restored": n_restored,
+                         "end_time": time.time()})
+    return output
+
+
+# -- public API --------------------------------------------------------------
+
+
+def run(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None, **kwargs) -> Any:
+    """Run a DAG as a durable workflow; blocks until the output is ready."""
+    if workflow_id is None:
+        workflow_id = f"wf-{int(time.time() * 1000):x}"
+    return _execute_workflow(dag, args, kwargs, workflow_id, storage)
+
+
+def run_async(dag: DAGNode, *args, workflow_id: Optional[str] = None,
+              storage: Optional[str] = None, **kwargs):
+    """Run on a background thread; returns (workflow_id, future)."""
+    import concurrent.futures
+
+    if workflow_id is None:
+        workflow_id = f"wf-{int(time.time() * 1000):x}"
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(_execute_workflow, dag, args, kwargs, workflow_id,
+                      storage)
+    pool.shutdown(wait=False)
+    return workflow_id, fut
+
+
+def resume(workflow_id: str, dag: DAGNode, *, storage: Optional[str] = None
+           ) -> Any:
+    """Re-run a workflow: completed steps restore from checkpoints.
+
+    The reference serializes the whole DAG into storage; here the caller
+    re-supplies the DAG (cloudpickling arbitrary closures into storage is a
+    portability hazard) and the content-addressed step ids line results up.
+    """
+    wf_dir = _wf_dir(workflow_id, storage)
+    if not os.path.isdir(wf_dir):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    return _execute_workflow(dag, (), {}, workflow_id, storage)
+
+
+def get_status(workflow_id: str, *, storage: Optional[str] = None
+               ) -> Optional[WorkflowStatus]:
+    meta = _read_meta(_wf_dir(workflow_id, storage))
+    return WorkflowStatus(meta["status"]) if meta else None
+
+
+def get_metadata(workflow_id: str, *, storage: Optional[str] = None
+                 ) -> Optional[Dict[str, Any]]:
+    return _read_meta(_wf_dir(workflow_id, storage))
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    path = os.path.join(_wf_dir(workflow_id, storage), "output.pkl")
+    if not os.path.exists(path):
+        status = get_status(workflow_id, storage=storage)
+        raise ValueError(f"workflow {workflow_id!r} has no output "
+                         f"(status: {status})")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def list_all(*, storage: Optional[str] = None
+             ) -> List[Tuple[str, Optional[WorkflowStatus]]]:
+    root = _storage_root(storage)
+    out = []
+    try:
+        for d in sorted(os.listdir(root)):
+            meta = _read_meta(os.path.join(root, d))
+            out.append((d, WorkflowStatus(meta["status"]) if meta else None))
+    except OSError:
+        pass
+    return out
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    import shutil
+
+    shutil.rmtree(_wf_dir(workflow_id, storage), ignore_errors=True)

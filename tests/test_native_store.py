@@ -65,6 +65,7 @@ def test_allocator_reuse_and_coalescing(store):
         store.put_serialized(o, b"x" * (cap // 10))
         oids.append(o)
     for o in oids:
+        store.release(o)  # drop creator pin so delete frees immediately
         store.delete(o)
     assert store.stats()["used"] == 0
     big = ObjectID.from_random()
@@ -80,7 +81,8 @@ def test_eviction_lru_of_released_only(store):
     released = []
     for _ in range(40):
         o = ObjectID.from_random()
-        store.put_serialized(o, b"r" * (cap // 16))  # unpinned: evictable
+        store.put_serialized(o, b"r" * (cap // 16))
+        store.release(o)  # drop the creator pin: now evictable
         released.append(o)
     assert store.stats()["evictions"] > 0
     assert store.contains(pinned)  # pinned survived the pressure
@@ -89,18 +91,58 @@ def test_eviction_lru_of_released_only(store):
 
 def test_delete_under_pin_defers_free(store):
     oid = ObjectID.from_random()
-    store.put_serialized(oid, b"d" * 1024)
-    store.pin(oid)
+    store.put_serialized(oid, b"d" * 1024)  # creator pin (rc=1)
+    store.pin(oid)  # reader pin (rc=2)
     buf = store.get_buffer(oid)
     assert bytes(buf[:4]) == b"dddd"
     store.delete(oid)
-    # entry invisible, but the block is NOT freed while the pin lives
+    # entry invisible, but the block is NOT freed while pins live
     assert not store.contains(oid)
     assert bytes(buf[:4]) == b"dddd"
     used_before = store.stats()["used"]
+    store.release(oid)  # reader pin released: creator pin still holds
+    assert store.stats()["used"] == used_before
     store.release(oid)  # last pin: now reclaimed
     assert store.stats()["used"] < used_before
     del buf
+
+
+def test_no_eviction_window_after_put(store):
+    """A freshly put object survives memory pressure without any explicit
+    pin (the creator pin carries through seal)."""
+    cap = store.stats()["capacity"]
+    fresh = ObjectID.from_random()
+    store.put_serialized(fresh, b"f" * 1024)
+    for _ in range(30):  # pressure: evictable traffic
+        o = ObjectID.from_random()
+        store.put_serialized(o, b"e" * (cap // 8))
+        store.release(o)
+    assert store.contains(fresh)
+
+
+def test_duplicate_put_does_not_stack_pins(store):
+    oid = ObjectID.from_random()
+    store.put_serialized(oid, b"x" * 256)
+    store.put_serialized(oid, b"x" * 256)  # EEXIST path: no extra pin
+    store.release(oid)  # drops the single creator pin
+    store.delete(oid)
+    # block actually reclaimed (no stuck kPendingDelete)
+    assert store.stats()["objects"] == 0 and store.stats()["used"] == 0
+
+
+def test_empty_payload_safe(store):
+    """Zero-length objects must not corrupt the free list (min block)."""
+    oids = [ObjectID.from_random() for _ in range(8)]
+    for o in oids:
+        store.put_serialized(o, b"")
+    for o in oids:
+        assert store.get_bytes(o) == b""
+        store.release(o)
+        store.delete(o)
+    # arena still fully usable after churning empty blocks
+    big = ObjectID.from_random()
+    store.put_serialized(big, b"k" * (store.stats()["capacity"] // 2))
+    assert store.contains(big)
 
 
 def test_orphaned_alloc_reclaimed_on_reput(store):
@@ -170,6 +212,7 @@ def test_concurrent_multiprocess_stress(store):
         "    got = s.get_bytes(oid)\n"
         "    assert got == payload, (oid, len(got or b''), len(payload))\n"
         "for oid, _ in oids[: {n} // 2]:\n"
+        "    s.release(oid)\n"  # drop creator pin, then delete frees
         "    s.delete(oid)\n"
         "print('ok')\n"
     ).format(repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

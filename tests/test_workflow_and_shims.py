@@ -1,0 +1,161 @@
+"""Workflow tier + util shims (multiprocessing Pool, metrics, accelerators)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+def _add(x, y):
+    return x + y
+
+
+@ray_tpu.remote
+def _mul(x, k):
+    return x * k
+
+
+@pytest.fixture
+def wf_storage(tmp_path):
+    return str(tmp_path / "wf")
+
+
+def test_workflow_run_and_output(ray_start, wf_storage):
+    with InputNode() as inp:
+        dag = _add.bind(_mul.bind(inp, 3), 10)
+    out = workflow.run(dag, 5, workflow_id="w1", storage=wf_storage)
+    assert out == 25
+    assert workflow.get_status("w1", storage=wf_storage) == \
+        workflow.WorkflowStatus.SUCCESSFUL
+    assert workflow.get_output("w1", storage=wf_storage) == 25
+    assert ("w1", workflow.WorkflowStatus.SUCCESSFUL) in \
+        workflow.list_all(storage=wf_storage)
+
+
+def test_workflow_resume_skips_completed_steps(ray_start, wf_storage):
+    calls = {"n": 0}
+
+    marker = os.path.join(wf_storage, "calls.txt")
+
+    @ray_tpu.remote
+    def counted(x):
+        with open(marker, "a") as f:
+            f.write("x")
+        return x + 1
+
+    @ray_tpu.remote
+    def boom(x, should_fail_file):
+        if os.path.exists(should_fail_file):
+            raise RuntimeError("transient")
+        return x * 100
+
+    os.makedirs(wf_storage, exist_ok=True)
+    fail_flag = os.path.join(wf_storage, "fail")
+    open(fail_flag, "w").close()
+
+    with InputNode() as inp:
+        dag = boom.bind(counted.bind(inp), fail_flag)
+
+    with pytest.raises(Exception):
+        workflow.run(dag, 1, workflow_id="w2", storage=wf_storage)
+    assert workflow.get_status("w2", storage=wf_storage) == \
+        workflow.WorkflowStatus.RESUMABLE
+    first_calls = len(open(marker).read())
+    assert first_calls == 1
+
+    os.unlink(fail_flag)  # clear the fault
+    with InputNode() as inp:
+        dag2 = boom.bind(counted.bind(inp), fail_flag)
+    out = workflow.resume("w2", dag2, storage=wf_storage)
+    assert out == 200
+    # the counted step restored from its checkpoint — not re-executed
+    assert len(open(marker).read()) == first_calls
+
+
+def test_workflow_metadata_counts(ray_start, wf_storage):
+    with InputNode() as inp:
+        dag = _add.bind(inp, 1)
+    workflow.run(dag, 1, workflow_id="w3", storage=wf_storage)
+    meta = workflow.get_metadata("w3", storage=wf_storage)
+    assert meta["steps_executed"] == 1
+    # re-run same workflow: everything restores
+    with InputNode() as inp:
+        dag2 = _add.bind(inp, 1)
+    workflow.resume("w3", dag2, storage=wf_storage)
+    meta = workflow.get_metadata("w3", storage=wf_storage)
+    assert meta["steps_restored"] == 1 and meta["steps_executed"] == 0
+
+
+def test_multiprocessing_pool(ray_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    # defined inside the test: cloudpickled by value, so workers don't need
+    # the test module importable
+    def _square(x):
+        return x * x
+
+    with Pool(processes=4) as pool:
+        assert pool.map(_square, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(pow, [(2, 3), (3, 2)]) == [8, 9]
+        assert pool.apply(_square, (7,)) == 49
+        r = pool.apply_async(_square, (8,))
+        assert r.get(timeout=60) == 64
+        assert list(pool.imap(_square, range(5), chunksize=2)) == [
+            0, 1, 4, 9, 16]
+        assert sorted(pool.imap_unordered(_square, range(5))) == [
+            0, 1, 4, 9, 16]
+    with pytest.raises(ValueError):
+        pool.map(_square, [1])
+
+
+def test_metrics_registry(ray_start):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(1.0, {"route": "/a"})
+    c.inc(2.0, {"route": "/a"})
+    g = metrics.Gauge("test_depth")
+    g.set(7.0)
+    h = metrics.Histogram("test_lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = metrics.collect_local()
+    assert snap["test_requests"]["series"][0]["value"] == 3.0
+    assert snap["test_depth"]["series"][0]["value"] == 7.0
+    hist = snap["test_lat"]["histogram"][0]
+    assert hist["counts"] == [1, 1, 1]
+    text = metrics.prometheus_text(snap)
+    assert 'test_requests{route="/a"} 3.0' in text
+    assert "# TYPE test_depth gauge" in text
+    # valid histogram exposition: cumulative buckets + sum + count
+    assert 'test_lat_bucket{le="0.1"} 1' in text
+    assert 'test_lat_bucket{le="1.0"} 2' in text
+    assert 'test_lat_bucket{le="+Inf"} 3' in text
+    assert "test_lat_count 3" in text
+    assert "test_lat_sum 5.55" in text
+
+
+def test_accelerator_detection_env(monkeypatch):
+    from ray_tpu._private.accelerators import TPUAcceleratorManager, detect_resources
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    assert TPUAcceleratorManager.get_current_node_accelerator_type() == \
+        "TPU-v5litepod"
+    assert TPUAcceleratorManager.get_current_pod_worker_count() == 2
+    res = TPUAcceleratorManager.slice_resources()
+    assert res.get("TPU-v5litepod-16-head") == 1.0
+    # worker 1 is not a head
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    assert TPUAcceleratorManager.slice_resources() == {}
+    env = {}
+    TPUAcceleratorManager.set_visible_chips(env, [0, 2])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,2"
