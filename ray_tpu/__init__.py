@@ -240,9 +240,30 @@ def method(**kwargs):
     return _wrap
 
 
+def dashboard_url() -> Optional[str]:
+    """HTTP address of this cluster's dashboard (None if disabled).
+
+    No polling needed: the head writes dashboard_address BEFORE the
+    gcs_address marker that init() waits on, so by the time a driver is
+    connected the file either exists or the dashboard is off/failed.
+    """
+    import os
+
+    if os.environ.get("RAY_TPU_DASHBOARD", "1") == "0":
+        return None
+    if _node_services is None or not _node_services.session_dir:
+        return None
+    path = os.path.join(_node_services.session_dir, "dashboard_address")
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
 __all__ = [
     "ObjectRef", "ActorHandle", "init", "shutdown", "is_initialized", "get", "put",
     "wait", "remote", "kill", "cancel", "get_actor", "nodes", "cluster_resources",
-    "available_resources", "get_runtime_context", "method", "exceptions", "timeline",
-    "__version__",
+    "available_resources", "dashboard_url", "get_runtime_context", "method",
+    "exceptions", "timeline", "__version__",
 ]

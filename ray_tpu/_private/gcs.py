@@ -54,6 +54,10 @@ class GcsServer:
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
 
+        from ray_tpu._private.job_manager import JobManager
+
+        self.job_manager = JobManager(session_dir, lambda: self.addr)
+
         self.server.register_all(self)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -107,11 +111,14 @@ class GcsServer:
         await self._mark_node_dead(node_id, reason="unregistered")
         return True
 
-    async def handle_heartbeat(self, node_id: str, available: Dict[str, float]) -> Dict:
+    async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
+                               pending: Optional[List[Dict[str, float]]] = None
+                               ) -> Dict:
         node = self.nodes.get(node_id)
         if node is not None:
             freed = node["available"] != available
             node["available"] = available
+            node["pending_demand"] = pending or []
             node["last_heartbeat"] = time.time()
             if freed:
                 self._kick_pending()
@@ -120,7 +127,9 @@ class GcsServer:
     def _cluster_view(self) -> List[Dict[str, Any]]:
         return [
             {"node_id": n["node_id"], "addr": n["addr"], "total": n["total"],
-             "available": n["available"], "labels": n["labels"], "alive": n["alive"]}
+             "available": n["available"], "labels": n["labels"],
+             "alive": n["alive"],
+             "pending_demand": n.get("pending_demand", [])}
             for n in self.nodes.values()
         ]
 
@@ -192,6 +201,29 @@ class GcsServer:
 
     async def handle_list_jobs(self) -> List[Dict[str, Any]]:
         return list(self.jobs.values())
+
+    # ----------------------------------------- submitted jobs (job manager)
+    # Reference: dashboard job module's REST endpoints; here plain GCS RPCs.
+
+    async def handle_submit_job(self, entrypoint: str,
+                                runtime_env: Optional[Dict[str, Any]] = None,
+                                metadata: Optional[Dict[str, str]] = None,
+                                submission_id: Optional[str] = None) -> str:
+        return await self.job_manager.submit(entrypoint, runtime_env,
+                                             metadata, submission_id)
+
+    async def handle_job_status(self, submission_id: str
+                                ) -> Optional[Dict[str, Any]]:
+        return self.job_manager.status(submission_id)
+
+    async def handle_job_logs(self, submission_id: str) -> str:
+        return self.job_manager.logs(submission_id)
+
+    async def handle_stop_job(self, submission_id: str) -> bool:
+        return await self.job_manager.stop(submission_id)
+
+    async def handle_list_submitted_jobs(self) -> List[Dict[str, Any]]:
+        return self.job_manager.list_jobs()
 
     # ----------------------------------------------------------------- actors
 

@@ -51,6 +51,20 @@ def main():
         raylet.gcs_addr = gcs.addr
         raylet.gcs.addr = gcs.addr
         await raylet.start()
+        # dashboard on the same loop (reference: dashboard head process);
+        # off by RAY_TPU_DASHBOARD=0
+        if os.environ.get("RAY_TPU_DASHBOARD", "1") != "0":
+            try:
+                from ray_tpu.dashboard.app import start_dashboard
+
+                dash_addr = await start_dashboard(
+                    gcs, port=int(os.environ.get("RAY_TPU_DASHBOARD_PORT", 0)))
+                with open(os.path.join(args.session_dir,
+                                       "dashboard_address"), "w") as f:
+                    f.write(dash_addr)
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "dashboard failed to start", exc_info=True)
         # head marker for the driver: address file
         addr_file = os.path.join(args.session_dir, "gcs_address")
         with open(addr_file + ".tmp", "w") as f:

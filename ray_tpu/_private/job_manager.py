@@ -1,0 +1,135 @@
+"""Job submission manager: run driver entrypoints as supervised subprocesses.
+
+Reference: the job-submission stack (``python/ray/dashboard/modules/job/``
+— ``JobManager`` spawning a supervisor per job, status in GCS KV, logs
+tailed from files; CLI ``ray job submit/status/logs/stop``).  Hosted inside
+the head process next to the GCS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class JobInfo:
+    def __init__(self, submission_id: str, entrypoint: str,
+                 metadata: Optional[Dict[str, str]] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.metadata = metadata or {}
+        self.status = "PENDING"
+        self.message = ""
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.pid: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"submission_id": self.submission_id,
+                "entrypoint": self.entrypoint, "status": self.status,
+                "message": self.message, "metadata": self.metadata,
+                "start_time": self.start_time, "end_time": self.end_time}
+
+
+class JobManager:
+    def __init__(self, session_dir: str, gcs_addr_getter):
+        self._session_dir = session_dir
+        self._gcs_addr = gcs_addr_getter  # callable: address known post-start
+        self._jobs: Dict[str, JobInfo] = {}
+        self._procs: Dict[str, Any] = {}
+
+    def _log_path(self, submission_id: str) -> str:
+        return os.path.join(self._session_dir, "logs",
+                            f"job-{submission_id}.log")
+
+    async def submit(self, entrypoint: str,
+                     runtime_env: Optional[Dict[str, Any]] = None,
+                     metadata: Optional[Dict[str, str]] = None,
+                     submission_id: Optional[str] = None) -> str:
+        sid = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        if sid in self._jobs:
+            raise ValueError(f"job {sid!r} already exists")
+        info = JobInfo(sid, entrypoint, metadata)
+        self._jobs[sid] = info
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = self._gcs_addr()
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = sid
+        re = runtime_env or {}
+        env.update({str(k): str(v) for k, v in
+                    (re.get("env_vars") or {}).items()})
+        cwd = re.get("working_dir") or None
+        log = open(self._log_path(sid), "ab")
+        try:
+            proc = await asyncio.create_subprocess_shell(
+                entrypoint, stdout=log, stderr=asyncio.subprocess.STDOUT,
+                env=env, cwd=cwd, start_new_session=True)
+        except Exception as e:
+            info.status = "FAILED"
+            info.message = repr(e)
+            info.end_time = time.time()
+            return sid
+        finally:
+            log.close()  # child holds its own dup; don't leak head fds
+        info.status = "RUNNING"
+        info.pid = proc.pid
+        self._procs[sid] = proc
+        asyncio.ensure_future(self._supervise(sid, proc))
+        return sid
+
+    async def _supervise(self, sid: str, proc):
+        rc = await proc.wait()
+        info = self._jobs[sid]
+        if info.status == "STOPPED":
+            pass
+        elif rc == 0:
+            info.status = "SUCCEEDED"
+        else:
+            info.status = "FAILED"
+            info.message = f"entrypoint exited with code {rc}"
+        info.end_time = time.time()
+        self._procs.pop(sid, None)
+
+    def status(self, submission_id: str) -> Optional[Dict[str, Any]]:
+        info = self._jobs.get(submission_id)
+        return info.to_dict() if info else None
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return [j.to_dict() for j in self._jobs.values()]
+
+    def logs(self, submission_id: str, tail_bytes: int = 1 << 20) -> str:
+        path = self._log_path(submission_id)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    async def stop(self, submission_id: str) -> bool:
+        info = self._jobs.get(submission_id)
+        proc = self._procs.get(submission_id)
+        if info is None:
+            return False
+        if proc is None:
+            return info.status in ("SUCCEEDED", "FAILED", "STOPPED")
+        info.status = "STOPPED"
+        info.message = "stopped by user"
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=10)
+        except asyncio.TimeoutError:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return True

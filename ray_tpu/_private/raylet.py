@@ -118,6 +118,11 @@ class Raylet:
                     "heartbeat",
                     node_id=self.node_id,
                     available=self.available.to_dict(),
+                    # resource shapes of queued lease requests: the demand
+                    # signal the autoscaler scales on (reference: the
+                    # resource_load in raylet heartbeats / syncer messages)
+                    pending=[w[0].to_dict() for w in
+                             list(self._lease_waiters)[:100]],
                 )
                 self.cluster_view = reply.get("nodes", [])
             except Exception as e:  # noqa: BLE001

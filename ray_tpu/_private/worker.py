@@ -561,6 +561,11 @@ class CoreWorker:
         def _run():
             token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
             try:
+                if spec.runtime_env:
+                    from ray_tpu import runtime_env as renv
+
+                    with renv.applied(spec.runtime_env):
+                        return True, fn(*args, **kwargs)
                 return True, fn(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001
                 return False, exc.TaskError.from_exception(e)
@@ -607,6 +612,11 @@ class CoreWorker:
         args, kwargs = await self._resolve_args(spec)
         self.actor_id = spec.actor_id
         self._actor_spec = spec
+        if spec.runtime_env:
+            # an actor owns its worker process: apply for good
+            from ray_tpu import runtime_env as renv
+
+            renv.apply_permanent(spec.runtime_env)
         if spec.max_concurrency > 1:
             self._task_executor = ThreadPoolExecutor(
                 max_workers=spec.max_concurrency, thread_name_prefix="rtpu-actor"

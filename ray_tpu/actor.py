@@ -17,6 +17,7 @@ from ray_tpu._private import api_utils, serialization
 from ray_tpu._private.ids import ActorID
 from ray_tpu._private.task_spec import FunctionDescriptor, TaskSpec, TaskType
 from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.remote_function import _validated_runtime_env
 
 
 class ActorMethod:
@@ -213,6 +214,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=opts.get("max_restarts", config.actor_max_restarts_default),
             max_concurrency=max_concurrency,
+            runtime_env=_validated_runtime_env(opts),
             is_async_actor=is_async,
             actor_name=name,
             namespace=namespace,

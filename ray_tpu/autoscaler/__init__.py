@@ -1,0 +1,21 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling.
+
+Reference: ``python/ray/autoscaler/v2/`` (instance-manager design — the
+one worth copying per SURVEY.md §7.11) — a reconciler loop reads pending
+resource demand from node heartbeats, launches/terminates nodes through a
+pluggable NodeProvider, respects min/max per node type, and scales down
+idle nodes after a timeout.  The TPU twist: node types can carry slice
+resources (``TPU-{type}-head``), so scaling up a slice-head type provisions
+a whole pod slice.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import (
+    LocalSubprocessNodeProvider,
+    NodeProvider,
+)
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "LocalSubprocessNodeProvider",
+    "NodeProvider", "NodeTypeConfig",
+]

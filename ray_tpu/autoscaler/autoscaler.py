@@ -1,0 +1,167 @@
+"""Autoscaler reconciler: demand in, node launches/terminations out.
+
+Reference: ``python/ray/autoscaler/v2/autoscaler.py:42`` (reconciler over
+an instance manager) and the bin-packing demand logic of
+``autoscaler/_private/resource_demand_scheduler.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.rpc import RpcClient, run_sync
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig] = dataclasses.field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+    upscale_interval_s: float = 2.0
+    max_launches_per_round: int = 4
+
+
+def _fits(demand: Dict[str, float], resources: Dict[str, float]) -> bool:
+    return all(resources.get(k, 0.0) >= v for k, v in demand.items())
+
+
+class Autoscaler:
+    def __init__(self, gcs_addr: str, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        self.gcs_addr = gcs_addr
+        self.provider = provider
+        self.config = config
+        self._idle_since: Dict[str, float] = {}
+        self._launched_for: Dict[str, str] = {}  # provider id -> node type
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one reconcile round ------------------------------------------------
+
+    def _get_nodes(self) -> List[Dict[str, Any]]:
+        async def go():
+            c = RpcClient(self.gcs_addr)
+            try:
+                return await c.call("get_all_nodes")
+            finally:
+                await c.close()
+
+        return run_sync(go())
+
+    def reconcile_once(self) -> Dict[str, Any]:
+        """Returns a summary of the decisions taken this round."""
+        nodes = [n for n in self._get_nodes() if n.get("alive")]
+        launched: List[str] = []
+        terminated: List[str] = []
+
+        # 1. unmet demand: pending shapes that fit NO alive node's total
+        demand: List[Dict[str, float]] = []
+        for n in nodes:
+            demand.extend(n.get("pending_demand", []))
+        unmet = [d for d in demand
+                 if not any(_fits(d, n["total"]) for n in nodes)]
+        # plus shapes that fit somewhere but everything is saturated: any
+        # pending demand at all means the cluster is short on slots
+        congested = [d for d in demand if d not in unmet]
+
+        # 2. count current workers per type
+        per_type: Dict[str, int] = {t: 0 for t in self.config.node_types}
+        for pid in self.provider.non_terminated_nodes():
+            t = self._launched_for.get(pid)
+            if t in per_type:
+                per_type[t] += 1
+
+        # 3. scale up: min_workers first, then demand-driven bin packing
+        budget = self.config.max_launches_per_round
+        for t, cfg in self.config.node_types.items():
+            while per_type[t] < cfg.min_workers and budget > 0:
+                self._launch(t, cfg)
+                per_type[t] += 1
+                budget -= 1
+                launched.append(t)
+        for d in unmet + congested:
+            if budget <= 0:
+                break
+            # smallest node type that fits the shape
+            candidates = sorted(
+                ((t, cfg) for t, cfg in self.config.node_types.items()
+                 if _fits(d, cfg.resources) and per_type[t] < cfg.max_workers),
+                key=lambda tc: sum(tc[1].resources.values()))
+            if candidates:
+                t, cfg = candidates[0]
+                self._launch(t, cfg)
+                per_type[t] += 1
+                budget -= 1
+                launched.append(t)
+
+        # 4. scale down: autoscaler-launched nodes idle past the timeout
+        #    (idle = fully available and no pending demand anywhere)
+        now = time.monotonic()
+        by_node_id = {self.provider.node_id_of(pid): pid
+                      for pid in self.provider.non_terminated_nodes()}
+        for n in nodes:
+            pid = by_node_id.get(n["node_id"])
+            if pid is None:
+                continue
+            t = self._launched_for.get(pid)
+            if t is None:
+                # unknown provenance (pre-existing node, or an autoscaler
+                # restart lost the launch map): never terminate it
+                continue
+            cfg = self.config.node_types.get(t)
+            idle = (not demand and n["available"] == n["total"])
+            if not idle:
+                self._idle_since.pop(pid, None)
+                continue
+            first = self._idle_since.setdefault(pid, now)
+            above_min = (cfg is None
+                         or per_type.get(t, 0) > cfg.min_workers)
+            if now - first >= self.config.idle_timeout_s and above_min:
+                logger.info("terminating idle node %s (%s)", pid, t)
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)
+                if t in per_type:
+                    per_type[t] -= 1
+                terminated.append(pid)
+        return {"launched": launched, "terminated": terminated,
+                "unmet_demand": len(unmet), "pending": len(demand)}
+
+    def _launch(self, node_type: str, cfg: NodeTypeConfig):
+        logger.info("launching node of type %s", node_type)
+        pid = self.provider.create_node(node_type, dict(cfg.resources),
+                                       dict(cfg.labels))
+        self._launched_for[pid] = node_type
+
+    # -- loop ---------------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-autoscaler")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:
+                logger.debug("reconcile failed", exc_info=True)
+            self._stop.wait(self.config.upscale_interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -1,0 +1,6 @@
+"""Cluster dashboard (reference: ``python/ray/dashboard/`` head + modules).
+
+Runs inside the head process on the GCS event loop (``app.py``): JSON API
++ Prometheus endpoint + a minimal HTML overview, reading cluster state
+straight from the in-process GCS tables.
+"""

@@ -1,0 +1,167 @@
+"""Dashboard HTTP app: cluster overview, entity lists, metrics.
+
+Reference: ``python/ray/dashboard/head.py:45`` + modules
+(``modules/{node,job,actor,metrics,...}``).  Served from the head process
+(same event loop as the GCS), so every endpoint is a direct read of GCS
+tables — no aggregation RPCs needed on a single head.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; margin-top: .5rem; }
+ td, th { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
+ th { background: #f4f4f4; text-align: left; }
+ code { background: #f4f4f4; padding: 0 .3rem; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="root">loading…</div>
+<script>
+async function j(p) { return (await fetch(p)).json(); }
+function table(rows, cols) {
+  if (!rows.length) return "<i>none</i>";
+  let h = "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => `<td>${JSON.stringify(r[c] ?? "")}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+async function render() {
+  const [cluster, actors, jobs, pgs, subjobs] = await Promise.all([
+    j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
+    j("/api/placement_groups"), j("/api/submitted_jobs")]);
+  document.getElementById("root").innerHTML =
+    "<h2>Nodes</h2>" + table(cluster.nodes, ["node_id","state","resources","available"]) +
+    "<h2>Actors</h2>" + table(actors, ["actor_id","class_name","state","name","node_id"]) +
+    "<h2>Driver jobs</h2>" + table(jobs, ["job_id","state","start_time"]) +
+    "<h2>Submitted jobs</h2>" + table(subjobs, ["submission_id","status","entrypoint","message"]) +
+    "<h2>Placement groups</h2>" + table(pgs, ["placement_group_id","state","strategy"]);
+}
+render(); setInterval(render, 5000);
+</script></body></html>
+"""
+
+
+def build_app(gcs) -> "object":
+    from aiohttp import web
+
+    def jresp(data) -> "web.Response":
+        return web.Response(text=json.dumps(data, default=str),
+                            content_type="application/json")
+
+    async def index(_req):
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def api_cluster(_req):
+        nodes = []
+        for nid, n in gcs.nodes.items():
+            nodes.append({"node_id": nid,
+                          "state": "ALIVE" if n.get("alive") else "DEAD",
+                          "addr": n.get("addr", ""),
+                          "resources": n.get("total", {}),
+                          "available": n.get("available", {})})
+        total = await gcs.handle_cluster_resources()
+        avail = await gcs.handle_available_resources()
+        return jresp({"nodes": nodes, "resources_total": total,
+                      "resources_available": avail, "ts": time.time()})
+
+    async def api_actors(_req):
+        out = []
+        for aid, a in gcs.actors.items():
+            out.append({"actor_id": aid.hex(), "state": a.get("state"),
+                        "class_name": a.get("class_name", ""),
+                        "name": a.get("name", ""),
+                        "node_id": a.get("node_id", "")})
+        return jresp(out)
+
+    async def api_jobs(_req):
+        return jresp(await gcs.handle_list_jobs())
+
+    async def api_submitted_jobs(_req):
+        return jresp(gcs.job_manager.list_jobs())
+
+    async def api_pgs(_req):
+        out = []
+        for pid, pg in gcs.pgs.items():
+            out.append({"placement_group_id": pid.hex(),
+                        "state": pg.get("state"),
+                        "strategy": pg.get("strategy"),
+                        "bundles": pg.get("bundles")})
+        return jresp(out)
+
+    async def api_named_actors(_req):
+        return jresp(await gcs.handle_list_named_actors())
+
+    async def api_events(req):
+        try:
+            cursor = int(req.query.get("cursor", 0))
+        except ValueError:
+            cursor = 0
+        return jresp(gcs._events[cursor:cursor + 1000])
+
+    def _aggregate_metrics() -> Dict[str, Any]:
+        merged: Dict[str, Any] = {}
+        for (ns, _key), raw in list(gcs.kv.items()):
+            if ns != "metrics":
+                continue
+            try:
+                payload = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            for name, entry in payload.get("metrics", {}).items():
+                if name not in merged:
+                    merged[name] = {"kind": entry["kind"],
+                                    "description": entry.get("description", ""),
+                                    "series": [], "histogram": [],
+                                    "boundaries": entry.get("boundaries", [])}
+                merged[name]["series"].extend(entry.get("series", []))
+                merged[name]["histogram"].extend(entry.get("histogram", []))
+        return merged
+
+    async def api_metrics(_req):
+        return jresp(_aggregate_metrics())
+
+    async def prometheus(_req):
+        from ray_tpu.util.metrics import prometheus_text
+
+        return web.Response(text=prometheus_text(_aggregate_metrics()),
+                            content_type="text/plain")
+
+    async def healthz(_req):
+        return jresp({"status": "ok"})
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/cluster", api_cluster)
+    app.router.add_get("/api/actors", api_actors)
+    app.router.add_get("/api/jobs", api_jobs)
+    app.router.add_get("/api/submitted_jobs", api_submitted_jobs)
+    app.router.add_get("/api/placement_groups", api_pgs)
+    app.router.add_get("/api/named_actors", api_named_actors)
+    app.router.add_get("/api/events", api_events)
+    app.router.add_get("/api/metrics", api_metrics)
+    app.router.add_get("/metrics", prometheus)
+    app.router.add_get("/-/healthz", healthz)
+    return app
+
+
+async def start_dashboard(gcs, host: str = "127.0.0.1", port: int = 0
+                          ) -> str:
+    """Start the dashboard on the current loop; returns its http address."""
+    from aiohttp import web
+
+    app = build_app(gcs)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    actual_port = site._server.sockets[0].getsockname()[1]
+    return f"http://{host}:{actual_port}"

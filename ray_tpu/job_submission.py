@@ -1,0 +1,82 @@
+"""JobSubmissionClient: submit driver scripts to a cluster.
+
+Reference: ``ray.job_submission.JobSubmissionClient``
+(``python/ray/dashboard/modules/job/sdk.py``) — submit/status/logs/stop/
+list against the head's job manager (here: GCS RPCs instead of the
+dashboard REST API).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.rpc import RpcClient, run_sync
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.STOPPED)
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        self._address = address
+
+    def _call(self, method: str, **kw):
+        async def go():
+            c = RpcClient(self._address)
+            try:
+                return await c.call(method, **kw)
+            finally:
+                await c.close()
+
+        return run_sync(go())
+
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None,
+                   submission_id: Optional[str] = None) -> str:
+        return self._call("submit_job", entrypoint=entrypoint,
+                          runtime_env=runtime_env, metadata=metadata,
+                          submission_id=submission_id)
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        info = self._call("job_status", submission_id=submission_id)
+        if info is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return JobStatus(info["status"])
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        info = self._call("job_status", submission_id=submission_id)
+        if info is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return info
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._call("job_logs", submission_id=submission_id)
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._call("stop_job", submission_id=submission_id)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._call("list_submitted_jobs")
+
+    def wait_until_finished(self, submission_id: str, timeout: float = 300.0
+                            ) -> JobStatus:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(submission_id)
+            if status.is_terminal():
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(f"job {submission_id!r} still "
+                           f"{self.get_job_status(submission_id)}")

@@ -67,11 +67,21 @@ class RemoteFunction:
             scheduling_strategy=api_utils.normalize_strategy(opts.get("scheduling_strategy")),
             max_retries=opts.get("max_retries", config.task_max_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
+            runtime_env=_validated_runtime_env(opts),
         )
         refs = worker.submit_task(spec)
         if spec.num_returns == 1:
             return refs[0]
         return refs
+
+
+def _validated_runtime_env(opts):
+    re = opts.get("runtime_env")
+    if not re:
+        return None
+    from ray_tpu.runtime_env import validate
+
+    return validate(re)
 
 
 def remote_decorator(*args, **options):

@@ -50,6 +50,10 @@ class RuntimeContext:
         return aid.hex() if aid is not None else None
 
     @property
+    def gcs_address(self) -> str:
+        return self._worker.gcs.addr
+
+    @property
     def was_current_actor_reconstructed(self) -> bool:
         return False
 

@@ -1,0 +1,114 @@
+"""Per-task/actor runtime environments.
+
+Reference: ``python/ray/_private/runtime_env/`` (+ public ``ray.runtime_env
+.RuntimeEnv``) — per-task conda/pip/working_dir/env_vars installed by a
+per-node agent.  Implemented fields here:
+
+- ``env_vars``:   applied around task execution (process-wide for actors,
+  which own their worker process; scoped-with-a-lock for pooled task
+  workers);
+- ``working_dir``: chdir for the task (local path; no packaging/upload —
+  single-host-first);
+- ``py_modules``: local paths prepended to ``sys.path``.
+
+``pip``/``conda`` provisioning is intentionally absent this round: the
+execution substrate ships as a sealed image (SURVEY.md environment notes);
+the validation below rejects them loudly rather than pretending.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
+_UNSUPPORTED = {"pip", "conda", "uv", "container", "image_uri"}
+
+# pooled task workers share a process: env mutations are exclusive
+_apply_lock = threading.Lock()
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env mapping (reference ``ray.runtime_env.RuntimeEnv``)."""
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None, **extra):
+        bad = set(extra) & _UNSUPPORTED
+        if bad:
+            raise ValueError(
+                f"runtime_env fields {sorted(bad)} are not supported (the "
+                f"runtime ships as a sealed image; use env_vars/working_dir/"
+                f"py_modules)")
+        unknown = set(extra) - _UNSUPPORTED
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+        super().__init__()
+        if env_vars:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            self["env_vars"] = dict(env_vars)
+        if working_dir:
+            self["working_dir"] = str(working_dir)
+        if py_modules:
+            self["py_modules"] = [str(p) for p in py_modules]
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not runtime_env:
+        return None
+    if isinstance(runtime_env, RuntimeEnv):
+        return dict(runtime_env)
+    return dict(RuntimeEnv(**runtime_env))
+
+
+def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
+    """Apply to this process for good (actor workers own their process)."""
+    if not runtime_env:
+        return
+    os.environ.update(runtime_env.get("env_vars") or {})
+    wd = runtime_env.get("working_dir")
+    if wd:
+        os.chdir(wd)
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+    for p in runtime_env.get("py_modules") or []:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[Dict[str, Any]]):
+    """Scoped application for pooled task workers.  Exclusive: the worker
+    runs at most one runtime-env'd task at a time (env vars and cwd are
+    process-global state)."""
+    if not runtime_env:
+        yield
+        return
+    with _apply_lock:
+        saved_env: Dict[str, Optional[str]] = {}
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        saved_cwd = os.getcwd()
+        saved_path = list(sys.path)
+        wd = runtime_env.get("working_dir")
+        if wd:
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+        for p in runtime_env.get("py_modules") or []:
+            sys.path.insert(0, p)
+        try:
+            yield
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            os.chdir(saved_cwd)
+            sys.path[:] = saved_path

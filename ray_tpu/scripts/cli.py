@@ -98,7 +98,60 @@ def cmd_list(args):
     ray_tpu.shutdown()
 
 
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address or _default_address())
+    if args.job_command == "submit":
+        entry = list(args.entrypoint)
+        if entry and entry[0] == "--":  # argparse.REMAINDER keeps the sep
+            entry = entry[1:]
+        sid = client.submit_job(entrypoint=" ".join(entry),
+                                runtime_env=json.loads(args.runtime_env)
+                                if args.runtime_env else None)
+        print(sid)
+        if args.wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(status.value)
+            print(client.get_job_logs(sid), end="")
+            if status.value != "SUCCEEDED":
+                raise SystemExit(1)
+    elif args.job_command == "status":
+        print(json.dumps(client.get_job_info(args.submission_id), default=str))
+    elif args.job_command == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_command == "stop":
+        print(client.stop_job(args.submission_id))
+    elif args.job_command == "list":
+        for row in client.list_jobs():
+            print(json.dumps(row, default=str))
+
+
+def cmd_dashboard(args):
+    path = os.path.expanduser("~/.ray_tpu/head.json")
+    if not os.path.exists(path):
+        raise SystemExit("No running head found (raytpu start first).")
+    with open(path) as f:
+        session_dir = json.load(f)["session_dir"]
+    addr_file = os.path.join(session_dir, "dashboard_address")
+    if not os.path.exists(addr_file):
+        raise SystemExit("Dashboard not running (RAY_TPU_DASHBOARD=0?).")
+    with open(addr_file) as f:
+        print(f.read().strip())
+
+
+def cmd_timeline(args):
+    ray_tpu = _connect(args.address or _default_address())
+    from ray_tpu.util import state as state_api
+
+    events = state_api.timeline(args.output)
+    print(f"Wrote {len(events)} events to {args.output}")
+    ray_tpu.shutdown()
+
+
 def _default_address() -> str:
+    if os.environ.get("RAY_TPU_ADDRESS"):
+        return os.environ["RAY_TPU_ADDRESS"]
     path = os.path.expanduser("~/.ray_tpu/head.json")
     if os.path.exists(path):
         with open(path) as f:
@@ -129,6 +182,30 @@ def main(argv=None):
     p.add_argument("entity", choices=["actors", "nodes", "jobs", "placement-groups"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="-- shell command to run")
+    ps.add_argument("--runtime-env", default=None, help="json runtime env")
+    ps.add_argument("--wait", action="store_true",
+                    help="block until finished, print logs")
+    ps.add_argument("--timeout", type=float, default=600.0)
+    for name in ("status", "logs", "stop"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("submission_id")
+    jsub.add_parser("list")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("dashboard", help="print the dashboard URL")
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("timeline", help="export chrome://tracing timeline")
+    p.add_argument("--output", default="timeline.json")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_timeline)
 
     args = parser.parse_args(argv)
     args.fn(args)

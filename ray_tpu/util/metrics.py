@@ -127,9 +127,19 @@ def _publish_once():
     w = get_global_worker(required=False)
     if w is None:
         return
-    payload = json.dumps({"ts": time.time(), "metrics": collect_local()})
-    _internal_kv_put(f"metrics/{w.worker_id.hex()[:16]}".encode(),
-                     payload.encode(), namespace="metrics")
+    wid = w.worker_id.hex()[:12]
+    data = collect_local()
+    # tag every series with the publishing worker: the dashboard aggregator
+    # concatenates across workers, and duplicate label sets would be an
+    # invalid Prometheus exposition
+    for entry in data.values():
+        for s in entry.get("series", []):
+            s["tags"] = dict(s["tags"], worker=wid)
+        for h in entry.get("histogram", []):
+            h["tags"] = dict(h["tags"], worker=wid)
+    payload = json.dumps({"ts": time.time(), "metrics": data})
+    _internal_kv_put(f"metrics/{wid}".encode(), payload.encode(),
+                     namespace="metrics")
 
 
 def _ensure_publisher():

@@ -1,0 +1,100 @@
+"""Autoscaler e2e on real subprocess raylets (reference model:
+``test_autoscaler_fake_multinode``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    LocalSubprocessNodeProvider,
+    NodeTypeConfig,
+)
+
+
+@pytest.fixture
+def scaler(ray_isolated):
+    from ray_tpu import __init__ as _  # noqa: F401
+    import ray_tpu as rt
+
+    services = rt._node_services
+    provider = LocalSubprocessNodeProvider(services.session_dir,
+                                           services.gcs_addr)
+    cfg = AutoscalerConfig(
+        node_types={"cpu-worker": NodeTypeConfig(
+            resources={"CPU": 2.0}, min_workers=0, max_workers=3)},
+        idle_timeout_s=3.0, upscale_interval_s=0.5)
+    a = Autoscaler(services.gcs_addr, provider, cfg)
+    yield a, provider
+    a.stop()
+    for pid in provider.non_terminated_nodes():
+        provider.terminate_node(pid)
+
+
+def _alive_nodes():
+    return [n for n in ray_tpu.nodes() if n["alive"]]
+
+
+def test_scale_up_on_demand_then_down_when_idle(scaler):
+    a, provider = scaler
+    assert len(_alive_nodes()) == 1  # head only
+
+    # saturate the head (8 CPUs) and queue more work than fits
+    @ray_tpu.remote(num_cpus=2)
+    def hold(t):
+        time.sleep(t)
+        return 1
+
+    refs = [hold.remote(8.0) for _ in range(8)]  # demand: 16 CPUs
+    time.sleep(1.5)  # let heartbeats carry the pending demand
+    summary = a.reconcile_once()
+    assert summary["pending"] > 0
+    assert summary["launched"], f"no launch despite demand: {summary}"
+
+    deadline = time.time() + 30
+    while len(_alive_nodes()) < 2 and time.time() < deadline:
+        a.reconcile_once()
+        time.sleep(0.5)
+    assert len(_alive_nodes()) >= 2
+
+    ray_tpu.get(refs, timeout=120)  # work completes across the grown cluster
+
+    # idle scale-down
+    deadline = time.time() + 60
+    while provider.non_terminated_nodes() and time.time() < deadline:
+        a.reconcile_once()
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes(), "idle nodes not terminated"
+
+
+def test_min_workers_maintained(scaler):
+    a, provider = scaler
+    a.config.node_types["cpu-worker"] = NodeTypeConfig(
+        resources={"CPU": 2.0}, min_workers=2, max_workers=3)
+    a.reconcile_once()
+    assert len(provider.non_terminated_nodes()) == 2
+    # idle timeout never drops below min_workers
+    a.config.idle_timeout_s = 0.0
+    time.sleep(1.0)
+    a.reconcile_once()
+    a.reconcile_once()
+    assert len(provider.non_terminated_nodes()) == 2
+
+
+def test_max_workers_cap(scaler):
+    a, provider = scaler
+    a.config.node_types["cpu-worker"] = NodeTypeConfig(
+        resources={"CPU": 2.0}, min_workers=0, max_workers=1)
+
+    @ray_tpu.remote(num_cpus=2)
+    def hold(t):
+        time.sleep(t)
+
+    refs = [hold.remote(6.0) for _ in range(10)]
+    time.sleep(1.5)
+    for _ in range(4):
+        a.reconcile_once()
+    assert len(provider.non_terminated_nodes()) <= 1
+    ray_tpu.get(refs, timeout=120)

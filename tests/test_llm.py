@@ -48,10 +48,13 @@ def test_sampling_params(tiny_model):
     assert out == out2
 
 
-def test_engine_continuous_batching(tiny_model):
+def test_engine_continuous_batching():
     from ray_tpu.llm import LLMEngine
 
-    cfg, params = tiny_model
+    # fp32: the engine decodes slots batched while the solo reference runs
+    # b=1 — bf16 near-ties can argmax-flip between those batch shapes
+    cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
     eng = LLMEngine(cfg, params, batch_slots=2, max_len=64)
     # 5 requests through 2 slots: forces slot reuse (continuous batching)
     sp = SamplingParams(temperature=0.0, max_tokens=5)

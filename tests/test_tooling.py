@@ -1,0 +1,182 @@
+"""Tooling tier: dashboard HTTP API, job submission, CLI, state API."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def _get_json(url: str, timeout: float = 10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_endpoints(ray_start):
+    url = ray_tpu.dashboard_url()
+    assert url, "dashboard should be on by default"
+    health = _get_json(f"{url}/-/healthz")
+    assert health["status"] == "ok"
+    cluster = _get_json(f"{url}/api/cluster")
+    assert cluster["nodes"] and cluster["resources_total"].get("CPU", 0) > 0
+
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    a = Marker.options(name="dash-marker").remote()
+    ray_tpu.get(a.ping.remote())
+    actors = _get_json(f"{url}/api/actors")
+    assert any("Marker" in x["class_name"] for x in actors)
+    # HTML index + prometheus endpoint respond
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        assert b"ray_tpu dashboard" in resp.read()
+    with urllib.request.urlopen(f"{url}/metrics", timeout=10) as resp:
+        resp.read()
+    ray_tpu.kill(a)
+
+
+def test_job_submission_end_to_end(ray_start):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    addr = ray_tpu.get_runtime_context().gcs_address
+    client = JobSubmissionClient(addr)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job says hi')\"",
+        metadata={"owner": "test"})
+    status = client.wait_until_finished(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "job says hi" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info["metadata"]["owner"] == "test"
+    assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+
+def test_job_submission_failure_and_env(ray_start, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    addr = ray_tpu.get_runtime_context().gcs_address
+    client = JobSubmissionClient(addr)
+    # failing entrypoint
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(sid, timeout=120) == JobStatus.FAILED
+    # env var + working_dir runtime_env
+    marker = tmp_path / "out.txt"
+    code = "import os; open('out.txt','w').write(os.environ['MY_FLAG'])"
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{code}\"",
+        runtime_env={"env_vars": {"MY_FLAG": "42"},
+                     "working_dir": str(tmp_path)})
+    assert client.wait_until_finished(sid, timeout=120) == JobStatus.SUCCEEDED
+    assert marker.read_text() == "42"
+
+
+def test_job_stop(ray_start):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    addr = ray_tpu.get_runtime_context().gcs_address
+    client = JobSubmissionClient(addr)
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.time() + 30
+    while client.get_job_status(sid) == JobStatus.PENDING:
+        assert time.time() < deadline
+        time.sleep(0.2)
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout=60) == JobStatus.STOPPED
+
+
+def test_job_submitted_driver_can_connect(ray_start):
+    """A submitted job connects back to THIS cluster via RAY_TPU_ADDRESS."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    addr = ray_tpu.get_runtime_context().gcs_address
+    client = JobSubmissionClient(addr)
+    code = (
+        "import os, ray_tpu;"
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS']);"
+        "print('cpus', ray_tpu.cluster_resources().get('CPU'));"
+        "ray_tpu.shutdown()"
+    )
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c \"{code}\"")
+    assert client.wait_until_finished(sid, timeout=180) == JobStatus.SUCCEEDED
+    assert "cpus" in client.get_job_logs(sid)
+
+
+def test_state_api_lists(ray_start):
+    from ray_tpu.util import state as state_api
+
+    nodes = state_api.list_nodes()
+    assert nodes and all("node_id" in n for n in nodes)
+
+    @ray_tpu.remote
+    class Obs:
+        def hi(self):
+            return 1
+
+    a = Obs.remote()
+    ray_tpu.get(a.hi.remote())
+    actors = state_api.list_actors()
+    assert any("Obs" in x["class_name"] for x in actors)
+    ray_tpu.kill(a)
+
+
+def test_runtime_env_task_and_actor(ray_start, tmp_path):
+    import os
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTE_FLAG": "on"},
+                                 "working_dir": str(tmp_path)})
+    def probe():
+        import os
+
+        return os.environ.get("RTE_FLAG"), os.getcwd()
+
+    flag, cwd = ray_tpu.get(probe.remote())
+    assert flag == "on" and cwd == str(tmp_path)
+
+    # env restored for tasks without a runtime_env on the same workers
+    @ray_tpu.remote
+    def plain():
+        import os
+
+        return os.environ.get("RTE_FLAG")
+
+    assert ray_tpu.get(plain.remote()) is None
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "yes"}})
+    class EnvActor:
+        def read(self):
+            import os
+
+            return os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote()) == "yes"
+    ray_tpu.kill(a)
+
+
+def test_runtime_env_py_modules(ray_start, tmp_path):
+    pkg = tmp_path / "mymod.py"
+    pkg.write_text("VALUE = 123\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+    def use_mod():
+        import mymod
+
+        return mymod.VALUE
+
+    assert ray_tpu.get(use_mod.remote()) == 123
+
+
+def test_runtime_env_rejects_unsupported(ray_start):
+    with pytest.raises(Exception):
+        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        def bad():
+            pass
+
+        bad.remote()
