@@ -93,6 +93,17 @@ class Autoscaler:
                 per_type[t] += 1
                 budget -= 1
                 launched.append(t)
+        # launch-in-flight gate: while a launched node hasn't registered and
+        # heartbeated yet, its capacity isn't visible — launching again for
+        # the same (still-pending) demand would overshoot to max_workers
+        alive_ids = {n["node_id"] for n in nodes}
+        joining = [pid for pid in self.provider.non_terminated_nodes()
+                   if pid in self._launched_for
+                   and self.provider.node_id_of(pid) not in alive_ids]
+        if joining:
+            return {"launched": launched, "terminated": terminated,
+                    "unmet_demand": len(unmet), "pending": len(demand),
+                    "joining": len(joining)}
         for d in unmet + congested:
             if budget <= 0:
                 break

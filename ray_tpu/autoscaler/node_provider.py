@@ -57,6 +57,14 @@ class LocalSubprocessNodeProvider(NodeProvider):
              "--labels", json.dumps(dict(labels, node_type=node_type)),
              "--node-name", pid],
             stdout=subprocess.PIPE, stderr=log, start_new_session=True)
+        # bounded wait for the ready line: a wedged raylet must not hang the
+        # autoscaler's single reconcile thread forever
+        import select
+
+        ready, _, _ = select.select([proc.stdout], [], [], 60.0)
+        if not ready:
+            proc.kill()
+            raise TimeoutError(f"node {pid} did not become ready in 60s")
         line = proc.stdout.readline().decode().strip()
         info = json.loads(line) if line else {}
         self._nodes[pid] = {"proc": proc, "node_type": node_type,

@@ -306,22 +306,28 @@ class Dataset:
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List[DataIterator]:
         """n single-pass iterators consuming a shared streaming execution
-        (reference: ``Dataset.streaming_split`` feeding Train workers)."""
-        optimized = L.optimize(self._plan)
-        sink = plan_physical(optimized.dag)
-        queues = execute_streaming_split(sink, n, equal)
+        (reference: ``Dataset.streaming_split`` feeding Train workers).
 
-        def make_source(q: "queuelib.Queue"):
+        Backed by a SplitCoordinator actor (reference:
+        ``execution/streaming_executor` split coordinator``): the executor
+        runs inside the actor, each rank's iterator pulls RefBundles from
+        it — so the iterators are picklable and can be shipped to train
+        workers in other processes.
+        """
+        coord = _SplitCoordinator.options(
+            max_concurrency=n + 1).remote(self, n, equal)
+
+        def make_source(rank: int):
             def source():
                 while True:
-                    item = q.get()
-                    if item.__class__ is not RefBundle:
+                    bundle = ray_tpu.get(coord.next_bundle.remote(rank))
+                    if bundle is None:
                         break
-                    yield item
+                    yield bundle
 
             return source
 
-        return [DataIterator(make_source(q), owner=self) for q in queues]
+        return [DataIterator(make_source(i), owner=coord) for i in range(n)]
 
     # -- writes ---------------------------------------------------------------
 
@@ -350,6 +356,49 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset({self._plan.dag.name})"
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Runs a streaming_split execution; serves bundles per rank.
+
+    max_concurrency > n so every rank's blocking next_bundle call can wait
+    concurrently without starving the others.  When every rank has drained
+    its stream the actor exits itself — repeated trainer.fit()/tune sweeps
+    must not accumulate coordinator processes.
+    """
+
+    def __init__(self, ds: "Dataset", n: int, equal: bool):
+        import threading
+
+        optimized = L.optimize(ds._plan)
+        sink = plan_physical(optimized.dag)
+        self._queues = execute_streaming_split(sink, n, equal)
+        self._done = [False] * n
+        self._lock = threading.Lock()
+
+    def next_bundle(self, rank: int):
+        item = self._queues[rank].get()
+        if isinstance(item, BaseException):
+            self._queues[rank].get()  # consume the trailing sentinel
+            self._mark_done(rank)
+            raise item  # executor failure: surface, don't truncate silently
+        if item.__class__ is not RefBundle:
+            self._mark_done(rank)
+            return None
+        return item
+
+    def _mark_done(self, rank: int):
+        import os
+        import threading
+
+        with self._lock:
+            self._done[rank] = True
+            if all(self._done):
+                # all streams drained: retire this actor process (the reply
+                # for the final call is already on the wire before the timer
+                # fires)
+                threading.Timer(2.0, os._exit, args=(0,)).start()
 
 
 class MaterializedDataset(Dataset):

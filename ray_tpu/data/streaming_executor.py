@@ -195,6 +195,7 @@ def execute_streaming_split(sink: PhysicalOperator, n: int,
     queues: List[queue.Queue] = [queue.Queue() for _ in range(n)]
 
     def pump():
+        err: Optional[BaseException] = None
         try:
             for op in ex._ops:
                 op.start()
@@ -209,9 +210,13 @@ def execute_streaming_split(sink: PhysicalOperator, n: int,
                 if not progressed:
                     ex._wait_for_completions(timeout=0.05)
         except BaseException as e:
-            ex._error = e
+            ex._error = err = e
         finally:
             for q in queues:
+                # a failed execution must not look like clean end-of-stream:
+                # consumers re-raise the error instead of ending iteration
+                if err is not None:
+                    q.put(err)
                 q.put(_SENTINEL)
 
     threading.Thread(target=pump, daemon=True, name="rtpu-data-split").start()

@@ -15,3 +15,18 @@ from ray_tpu.models.llama import (  # noqa: F401
     llama_loss,
     llama_param_specs,
 )
+from ray_tpu.models.moe import (  # noqa: F401
+    MoEConfig,
+    make_moe_trainer,
+    moe_apply,
+    moe_init,
+    moe_loss,
+    moe_param_specs,
+)
+from ray_tpu.models.generation import (  # noqa: F401
+    SamplingParams,
+    decode_step,
+    generate,
+    init_kv_cache,
+    prefill,
+)

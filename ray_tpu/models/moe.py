@@ -1,0 +1,249 @@
+"""Mixtral-style sparse-MoE decoder — expert parallelism as a mesh axis.
+
+The reference has NO expert-parallel support at all (SURVEY.md §2.4:
+EP/SP/CP verified absent; model-parallel math is delegated to vLLM/torch).
+Here EP is just another sharding rule: expert-stacked weights
+``[E, h, m]`` carry the logical axis ("expert", "embed", "mlp"), and the
+rule table places "expert" on a mesh axis — XLA partitions the expert
+einsums and psums the combine, which IS expert parallelism.
+
+Routing is top-k softmax gating with a Switch-style load-balance auxiliary
+loss.  Dispatch is the dense-einsum formulation (every expert computes
+every token, selection happens in the combine weights): compute scales
+with E, but shapes stay static — the right trade below ~16 experts, where
+capacity-based gather/scatter dispatch pays more in reshuffles than it
+saves in FLOPs.  A capacity-dispatch kernel is the documented upgrade path
+for large E.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    experts_per_token: int = 2
+    router_aux_coef: float = 0.01
+
+    @staticmethod
+    def tiny_moe(**kw) -> "MoEConfig":
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, mlp_dim=128, max_seq_len=128,
+                    num_experts=4, experts_per_token=2)
+        base.update(kw)
+        return MoEConfig(**base)
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoEConfig":
+        return MoEConfig(
+            vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+            num_kv_heads=8, mlp_dim=14336, max_seq_len=32768,
+            rope_theta=1e6, num_experts=8, experts_per_token=2)
+
+    def num_params(self) -> int:
+        hd = self.resolved_head_dim
+        per_layer = (
+            self.hidden_size * (self.num_heads * hd)           # wq
+            + 2 * self.hidden_size * (self.num_kv_heads * hd)  # wk, wv
+            + (self.num_heads * hd) * self.hidden_size         # wo
+            + self.hidden_size * self.num_experts              # router
+            + 3 * self.num_experts * self.hidden_size * self.mlp_dim
+            + 2 * self.hidden_size)                            # norms
+        head = 0 if self.tie_embeddings else \
+            self.vocab_size * self.hidden_size
+        return (self.vocab_size * self.hidden_size + head
+                + self.num_layers * per_layer + self.hidden_size)
+
+
+def _layer_init(key, cfg: MoEConfig) -> Dict[str, jnp.ndarray]:
+    hd = cfg.resolved_head_dim
+    h, E, m = cfg.hidden_size, cfg.num_experts, cfg.mlp_dim
+    init = jax.nn.initializers.normal(0.02)
+    ks = jax.random.split(key, 8)
+    dt = cfg.param_dtype
+    return {
+        "attn_norm": jnp.ones((h,), dt),
+        "wq": init(ks[0], (h, cfg.num_heads * hd), dt),
+        "wk": init(ks[1], (h, cfg.num_kv_heads * hd), dt),
+        "wv": init(ks[2], (h, cfg.num_kv_heads * hd), dt),
+        "wo": init(ks[3], (cfg.num_heads * hd, h), dt),
+        "mlp_norm": jnp.ones((h,), dt),
+        "w_router": init(ks[4], (h, E), dt),
+        "w_gate": init(ks[5], (E, h, m), dt),
+        "w_up": init(ks[6], (E, h, m), dt),
+        "w_down": init(ks[7], (E, m, h), dt),
+    }
+
+
+def moe_init(key: jax.Array, cfg: MoEConfig) -> Dict[str, Any]:
+    ks = jax.random.split(key, cfg.num_layers + 3)
+    init = jax.nn.initializers.normal(0.02)
+    layers = [_layer_init(k, cfg) for k in ks[:cfg.num_layers]]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    params = {
+        "embed": init(ks[-3], (cfg.vocab_size, cfg.hidden_size),
+                      cfg.param_dtype),
+        "layers": stacked,
+        "final_norm": jnp.ones((cfg.hidden_size,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = init(
+            ks[-2], (cfg.hidden_size, cfg.vocab_size), cfg.param_dtype)
+    return params
+
+
+def moe_param_specs(cfg: MoEConfig) -> Dict[str, Any]:
+    layer = {
+        "attn_norm": ("norm",),
+        "wq": ("embed", "heads"),
+        "wk": ("embed", "kv_heads"),
+        "wv": ("embed", "kv_heads"),
+        "wo": ("heads", "embed"),
+        "mlp_norm": ("norm",),
+        "w_router": ("embed", "norm"),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+    layer = {k: ("layers",) + v for k, v in layer.items()}
+    specs = {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = ("embed", "vocab")
+    return specs
+
+
+def moe_block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: MoEConfig
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sparse-MoE FFN: returns (output, router aux loss).
+
+    Dense dispatch: all experts run, the top-k combine weights select.
+    Experts dim shards over the 'expert' mesh axis (EP); XLA psums the
+    combine einsum across expert shards.
+    """
+    dt = cfg.dtype
+    b, s, h = x.shape
+    E, k = cfg.num_experts, cfg.experts_per_token
+    router_logits = jnp.einsum(
+        "bsh,he->bse", x.astype(jnp.float32),
+        lp["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [b,s,E] fp32
+    topk_vals, topk_idx = jax.lax.top_k(probs, k)
+    onehot = jax.nn.one_hot(topk_idx, E, dtype=probs.dtype)  # [b,s,k,E]
+    combine = (onehot * topk_vals[..., None]).sum(axis=2)  # [b,s,E]
+    combine = combine / (combine.sum(-1, keepdims=True) + 1e-9)
+
+    # All-expert FFN (dense dispatch), sharded over the expert axis.
+    # Expert matmuls are expressed as canonical 2D-style gemms ("bsh,hq")
+    # with experts folded into the output dim — the 3D "bsh,ehm" batched
+    # dot form is rejected by the CPU thunk runtime for bf16 inputs, and
+    # XLA:TPU recovers the same fused batched matmul either way.
+    m = cfg.mlp_dim
+
+    def fold(w):  # [E,h,m] -> [h, E*m]
+        return w.astype(dt).transpose(1, 0, 2).reshape(h, E * m)
+
+    gate = jnp.einsum("bsh,hq->bsq", x, fold(lp["w_gate"]),
+                      preferred_element_type=jnp.float32).astype(dt)
+    up = jnp.einsum("bsh,hq->bsq", x, fold(lp["w_up"]),
+                    preferred_element_type=jnp.float32).astype(dt)
+    act = swiglu(gate, up).reshape(b, s, E, m)
+    # down-projection is block-diagonal over experts: E small static gemms
+    per_expert = jnp.stack(
+        [jnp.einsum("bsm,mh->bsh", act[:, :, e], lp["w_down"][e].astype(dt),
+                    preferred_element_type=jnp.float32).astype(dt)
+         for e in range(E)], axis=1)  # [b,E,s,h]
+    out = (per_expert
+           * combine.astype(dt).transpose(0, 2, 1)[..., None]).sum(axis=1)
+
+    # Switch-style load-balance loss: E * sum_e f_e * P_e, where f_e is the
+    # fraction of tokens whose top-1 expert is e, P_e the mean router prob
+    top1 = jax.nn.one_hot(topk_idx[..., 0], E, dtype=jnp.float32)
+    f = top1.mean(axis=(0, 1))
+    P = probs.mean(axis=(0, 1))
+    aux = E * jnp.sum(f * P)
+    return out, aux
+
+
+def moe_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: MoEConfig,
+              *, mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward: tokens [b,s] -> (logits [b,s,V] fp32, total router aux)."""
+    s = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.resolved_head_dim, s, cfg.rope_theta)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    hd = cfg.resolved_head_dim
+
+    def layer_fn(x, lp):
+        b, s, h = x.shape
+        dt = cfg.dtype
+        y = rms_norm(x, lp["attn_norm"])
+        q = (y @ lp["wq"].astype(dt)).reshape(b, s, cfg.num_heads, hd)
+        kk = (y @ lp["wk"].astype(dt)).reshape(b, s, cfg.num_kv_heads, hd)
+        v = (y @ lp["wv"].astype(dt)).reshape(b, s, cfg.num_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        kk = apply_rope(kk, cos, sin)
+        attn = dot_product_attention(q, kk, v, causal=True,
+                                     impl=cfg.attention_impl, mesh=mesh)
+        x = x + attn.reshape(b, s, -1) @ lp["wo"].astype(dt)
+        y = rms_norm(x, lp["mlp_norm"])
+        moe_out, aux = moe_block(y, lp, cfg)
+        return x + moe_out, aux
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    if cfg.scan_layers:
+        x, auxs = jax.lax.scan(
+            lambda carry, lp: layer_fn(carry, lp), x, params["layers"])
+        total_aux = auxs.sum()
+    else:
+        total_aux = jnp.float32(0)
+        L = jax.tree.leaves(params["layers"])[0].shape[0]
+        for i in range(L):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            x, aux = layer_fn(x, lp)
+            total_aux = total_aux + aux
+    x = rms_norm(x, params["final_norm"])
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    logits = jnp.einsum("bsh,hv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, total_aux
+
+
+def moe_loss(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
+             cfg: MoEConfig, *, mesh=None) -> jnp.ndarray:
+    """Next-token cross entropy + router load-balance aux."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = moe_apply(params, inputs, cfg, mesh=mesh)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + cfg.router_aux_coef * aux
+
+
+def make_moe_trainer(cfg: MoEConfig, mesh, *, optimizer=None, rules=None):
+    """ShardedTrainer for the MoE family (EP via the 'expert' rule)."""
+    from ray_tpu.models.training import ShardedTrainer, default_optimizer
+
+    return ShardedTrainer(
+        init_fn=lambda key: moe_init(key, cfg),
+        loss_fn=functools.partial(moe_loss, cfg=cfg, mesh=mesh),
+        param_specs=moe_param_specs(cfg),
+        mesh=mesh,
+        optimizer=optimizer or default_optimizer(),
+        rules=rules,
+    )

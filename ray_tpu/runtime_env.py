@@ -90,19 +90,22 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
         yield
         return
     with _apply_lock:
-        saved_env: Dict[str, Optional[str]] = {}
-        for k, v in (runtime_env.get("env_vars") or {}).items():
-            saved_env[k] = os.environ.get(k)
-            os.environ[k] = v
+        # snapshot BEFORE any mutation, and mutate inside the try: a failing
+        # chdir (bad working_dir) must not leak env vars into the worker
+        saved_env: Dict[str, Optional[str]] = {
+            k: os.environ.get(k)
+            for k in (runtime_env.get("env_vars") or {})}
         saved_cwd = os.getcwd()
         saved_path = list(sys.path)
-        wd = runtime_env.get("working_dir")
-        if wd:
-            os.chdir(wd)
-            sys.path.insert(0, wd)
-        for p in runtime_env.get("py_modules") or []:
-            sys.path.insert(0, p)
         try:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                os.environ[k] = v
+            wd = runtime_env.get("working_dir")
+            if wd:
+                os.chdir(wd)
+                sys.path.insert(0, wd)
+            for p in runtime_env.get("py_modules") or []:
+                sys.path.insert(0, p)
             yield
         finally:
             for k, v in saved_env.items():

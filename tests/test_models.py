@@ -164,3 +164,64 @@ class TestShardingRules:
         spec = logical_to_pspec(("batch", "mlp"), mesh=mesh)
         assert spec[0] == "dp"
         assert len(spec) == 1
+
+
+class TestMoE:
+    """Mixtral-style MoE: routing math + EP sharding (reference has no EP
+    at all — SURVEY.md §2.4)."""
+
+    def test_forward_shapes_and_aux(self):
+        from ray_tpu.models.moe import MoEConfig, moe_apply, moe_init
+
+        cfg = MoEConfig.tiny_moe()
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        tokens = _batch(vocab=cfg.vocab_size)["tokens"]
+        logits, aux = moe_apply(params, tokens, cfg)
+        assert logits.shape == (*tokens.shape, cfg.vocab_size)
+        # balanced-routing lower bound: aux >= 1 (equality iff uniform)
+        assert float(aux) >= 1.0 * cfg.num_layers * 0.99
+
+    def test_param_count_matches_config(self):
+        from ray_tpu.models.moe import MoEConfig, moe_init
+        import numpy as np
+
+        cfg = MoEConfig.tiny_moe()
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+        assert n == cfg.num_params()
+
+    def test_top_k_routing_selects_k_experts(self):
+        from ray_tpu.models.moe import MoEConfig, moe_block, moe_init
+
+        cfg = MoEConfig.tiny_moe(num_layers=1)
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        lp = jax.tree.map(lambda a: a[0], params["layers"])
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 5, cfg.hidden_size))
+        out, aux = moe_block(x.astype(cfg.dtype), lp, cfg)
+        assert out.shape == x.shape
+        assert jnp.isfinite(out).all()
+
+    def test_moe_loss_decreases_and_ep_sharding(self):
+        from ray_tpu.models.moe import (
+            MoEConfig,
+            make_moe_trainer,
+            moe_param_specs,
+        )
+        from ray_tpu.models.training import default_optimizer
+
+        mesh = create_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        cfg = MoEConfig.tiny_moe()
+        tr = make_moe_trainer(
+            cfg, mesh, optimizer=default_optimizer(lr=1e-2, warmup=1,
+                                                   decay_steps=50))
+        state = tr.init_state(jax.random.PRNGKey(0))
+        # expert-stacked weights shard over the expert->tp rule
+        wg = state["params"]["layers"]["w_gate"]
+        spec = wg.sharding.spec
+        assert "tp" in str(spec), f"experts not sharded: {spec}"
+        batch = tr.shard_batch(_batch(b=8, s=17, vocab=cfg.vocab_size))
+        losses = []
+        for _ in range(8):
+            state, m = tr.step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses

@@ -197,3 +197,38 @@ class TestCheckpointManager:
             assert f.read() == "1"
         # latest also survives
         assert os.path.exists(mgr.latest.path)
+
+
+def test_trainer_consumes_dataset_shards(ray_start, tmp_path):
+    """Cross-tier: DataParallelTrainer + ray_tpu.data streaming_split —
+    iterators must survive shipping to worker processes (SplitCoordinator
+    actor), and ranks must see disjoint, complete shards."""
+    import json
+
+    import ray_tpu.data as rd
+    from ray_tpu import train
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    out_dir = str(tmp_path)
+
+    def loop(config):
+        it = train.get_dataset_shard("train")
+        rank = train.get_context().get_world_rank()
+        ids = []
+        for batch in it.iter_batches(batch_size=8, prefetch_batches=0):
+            ids.extend(int(x) for x in batch["id"])
+        with open(f"{config['out']}/rank{rank}.json", "w") as f:
+            json.dump(ids, f)
+        train.report({"rows": len(ids)})
+
+    ds = rd.range(48, parallelism=4)
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={"out": out_dir},
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds})
+    res = trainer.fit()
+    assert res.error is None
+    shards = [json.load(open(tmp_path / f"rank{r}.json")) for r in (0, 1)]
+    assert all(shards), "both ranks must receive data"
+    assert sorted(shards[0] + shards[1]) == list(range(48))
+    assert not set(shards[0]) & set(shards[1])
