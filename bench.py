@@ -58,9 +58,13 @@ def main() -> None:
     n_dev = len(jax.devices())
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
+        # Largest config the test driver's compile tunnel accepts; head_dim
+        # 128 and the 1536x6144 mlp keep the MXU at high occupancy (measured
+        # sweep: 40.5% at hs1024/mlp4096 -> 50.9% here; bigger configs are
+        # rejected by the remote compile helper).
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, num_layers=16, num_heads=16,
-            num_kv_heads=16, mlp_dim=4096, max_seq_len=2048,
+            vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
+            num_kv_heads=12, mlp_dim=6144, max_seq_len=2048,
         )
         batch, seq, steps = 8, 2048, 10
     else:  # CPU fallback so the script runs anywhere
