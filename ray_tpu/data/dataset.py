@@ -115,6 +115,14 @@ class Dataset:
     def zip(self, other: "Dataset") -> "Dataset":
         return Dataset(L.LogicalPlan(L.Zip(self._plan.dag, other._plan.dag)))
 
+    def join(self, other: "Dataset", on, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Hash join on key column(s) (reference:
+        ``Dataset.join`` over ``_internal/execution/operators/join.py``).
+        how: 'inner' | 'left outer' | 'right outer' | 'full outer'."""
+        return Dataset(L.LogicalPlan(
+            L.Join(self._plan.dag, other._plan.dag, on, how, num_partitions)))
+
     def groupby(self, key: Optional[str]) -> "GroupedData":
         return GroupedData(self, key)
 

@@ -131,6 +131,15 @@ class Zip(LogicalOperator):
         super().__init__("Zip", [left, right])
 
 
+class Join(LogicalOperator):
+    def __init__(self, left, right, on, how: str = "inner",
+                 num_partitions: Optional[int] = None):
+        super().__init__(f"Join({on},{how})", [left, right])
+        self.on = on
+        self.how = how
+        self.num_partitions = num_partitions
+
+
 class RandomizeBlocks(LogicalOperator):
     def __init__(self, input_op, seed: Optional[int] = None):
         super().__init__("RandomizeBlocks", [input_op])

@@ -472,6 +472,79 @@ def _realign(blocks: List[Tuple[ObjectRef, BlockMetadata]],
     return out
 
 
+class JoinOperator(PhysicalOperator):
+    """Hash join: partition both sides on the key, join per partition
+    (reference: ``execution/operators/hash_shuffle.py`` + ``join.py``)."""
+
+    def __init__(self, left: PhysicalOperator, right: PhysicalOperator,
+                 on, how: str, num_partitions: Optional[int] = None):
+        super().__init__(f"Join({on})", [left, right])
+        self._on = on
+        self._key = on if isinstance(on, str) else on[0]
+        self._how = how
+        self._np = num_partitions
+        self._sides: Dict[int, List[RefBundle]] = {0: [], 1: []}
+        self._phase = 0  # 0=buffering, 1=partitioning, 2=joining
+        self._active: Dict[ObjectRef, Tuple[int, int]] = {}  # ref->(side,idx)
+        self._parts: Dict[Tuple[int, int], List] = {}  # (side, input idx)
+        self._n_inputs = [0, 0]
+
+    def add_input_from(self, side: int, bundle: RefBundle):
+        self._sides[side].append(bundle)
+
+    def add_input(self, bundle: RefBundle):  # pragma: no cover
+        raise RuntimeError("JoinOperator needs side-tagged input")
+
+    def dispatch(self) -> bool:
+        if not self._inputs_done or self._phase != 0:
+            return False
+        self._phase = 1
+        left = [b for bun in self._sides[0] for b in bun.blocks]
+        right = [b for bun in self._sides[1] for b in bun.blocks]
+        if self._np is None:
+            self._np = max(1, max(len(left), len(right)))
+        for side, blocks in ((0, left), (1, right)):
+            self._n_inputs[side] = len(blocks)
+            for i, (ref, _m) in enumerate(blocks):
+                r = T.hash_partition_block.remote(ref, self._key, self._np)
+                self._active[r] = (side, i)
+        if not self._active:
+            self._phase = 2
+            self._launch_joins()
+        return True
+
+    def _launch_joins(self):
+        left_parts: List[List] = [[] for _ in range(self._np)]
+        right_parts: List[List] = [[] for _ in range(self._np)]
+        for (side, _i), refs in self._parts.items():
+            target = left_parts if side == 0 else right_parts
+            for p, ref in enumerate(refs):
+                target[p].append(ref)
+        for p in range(self._np):
+            r = T.join_partition.remote(
+                self._on, self._how, len(left_parts[p]),
+                *(left_parts[p] + right_parts[p]))
+            self._active[r] = (2, p)
+
+    def active_task_refs(self) -> List[ObjectRef]:
+        return list(self._active.keys())
+
+    def notify_task_done(self, ref: ObjectRef):
+        side, idx = self._active.pop(ref)
+        block_refs, metas = ray_tpu.get(ref)
+        if self._phase == 1:
+            self._parts[(side, idx)] = block_refs
+            if not self._active:
+                self._phase = 2
+                self._launch_joins()
+        else:
+            self._emit(RefBundle(list(zip(block_refs, metas)), seq=idx))
+
+    def completed(self) -> bool:
+        return (self._inputs_done and self._phase == 2
+                and not self._active and not self._out)
+
+
 class OutputSplitter(PhysicalOperator):
     """Split the stream into n round-robin sub-streams (streaming_split).
 

@@ -126,6 +126,12 @@ def plan(dag: L.LogicalOperator) -> PhysicalOperator:
     if isinstance(dag, L.Zip):
         return ZipOperator(plan(dag.inputs[0]), plan(dag.inputs[1]))
 
+    if isinstance(dag, L.Join):
+        from ray_tpu.data.operators import JoinOperator
+
+        return JoinOperator(plan(dag.inputs[0]), plan(dag.inputs[1]),
+                            dag.on, dag.how, dag.num_partitions)
+
     raise NotImplementedError(f"no physical plan for {dag!r}")
 
 

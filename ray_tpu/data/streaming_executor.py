@@ -147,7 +147,7 @@ class StreamingExecutor:
 
     def _route(self, parent: PhysicalOperator, child: PhysicalOperator,
                bundle: RefBundle):
-        if isinstance(child, ZipOperator):
+        if hasattr(child, "add_input_from"):  # two-sided ops (Zip, Join)
             side = child.input_ops.index(parent)
             child.add_input_from(side, bundle)
         else:

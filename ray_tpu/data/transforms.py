@@ -264,6 +264,32 @@ def aggregate_partition(key: Optional[str], agg_specs: List[Tuple[str, str, str]
     return _finalize(iter([res]), t0)
 
 
+def _concat_keep_schema(blocks: List[pa.Table]) -> pa.Table:
+    """concat that keeps the schema even when every part is empty (an
+    all-empty hash partition must still join correctly)."""
+    nonempty = [b for b in blocks if b.num_rows]
+    if nonempty:
+        return concat_blocks(nonempty)
+    return blocks[0].schema.empty_table() if blocks else pa.table({})
+
+
+@ray_tpu.remote
+def join_partition(on, how: str, left_count: int, *blocks: pa.Table):
+    """Join one hash partition: blocks[:left_count] are the left side."""
+    t0 = time.perf_counter()
+    left = _concat_keep_schema(list(blocks[:left_count]))
+    right = _concat_keep_schema(list(blocks[left_count:]))
+    keys = [on] if isinstance(on, str) else list(on)
+    if not left.schema.names or not right.schema.names:
+        # a side with no blocks at all: inner join is empty; outer joins
+        # degrade to the populated side
+        out = left if how.startswith("left") else (
+            right if how.startswith("right") else pa.table({}))
+        return _finalize(iter([out]), t0)
+    joined = left.join(right, keys=keys, join_type=how)
+    return _finalize(iter([joined]), t0)
+
+
 @ray_tpu.remote
 def zip_blocks(left: pa.Table, right: pa.Table):
     t0 = time.perf_counter()

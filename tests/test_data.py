@@ -275,3 +275,32 @@ def test_materialize_reuse(ray_start):
 def test_random_block_order_and_train_test_split(ray_start):
     tr, te = rd.range(100).train_test_split(0.2)
     assert tr.count() == 80 and te.count() == 20
+
+
+def test_join_inner(ray_start):
+    left = rd.from_items([{"k": i, "a": i * 10} for i in range(20)],
+                         parallelism=3)
+    right = rd.from_items([{"k": i, "b": i * 100} for i in range(10, 30)],
+                          parallelism=4)
+    joined = left.join(right, "k")
+    rows = sorted(joined.take_all(), key=lambda r: r["k"])
+    assert [r["k"] for r in rows] == list(range(10, 20))
+    assert all(r["b"] == r["k"] * 100 and r["a"] == r["k"] * 10 for r in rows)
+
+
+def test_join_left_outer(ray_start):
+    left = rd.from_items([{"k": i, "a": i} for i in range(6)])
+    right = rd.from_items([{"k": i, "b": i} for i in range(3)])
+    rows = sorted(left.join(right, "k", how="left outer").take_all(),
+                  key=lambda r: r["k"])
+    assert len(rows) == 6
+    assert rows[5]["b"] is None  # unmatched left rows keep null b
+
+
+def test_join_after_transforms(ray_start):
+    left = rd.range(30).map_batches(lambda b: {"k": b["id"] % 5,
+                                               "v": b["id"]})
+    right = rd.from_items([{"k": k, "w": k * 2} for k in range(5)])
+    joined = left.join(right, "k")
+    assert joined.count() == 30
+    assert all(r["w"] == r["k"] * 2 for r in joined.take(10))
