@@ -50,6 +50,7 @@ class GcsServer:
         self._pending_actors: List[bytes] = []
         self._pending_pgs: List[bytes] = []
         self._events: List[Dict[str, Any]] = []  # pubsub feed with seq numbers
+        self.task_events: List[Dict[str, Any]] = []  # task profile feed
         self._event_waiters: List[asyncio.Future] = []
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
@@ -201,6 +202,27 @@ class GcsServer:
 
     async def handle_list_jobs(self) -> List[Dict[str, Any]]:
         return list(self.jobs.values())
+
+    # ---------------------------------------------------- task event feed
+    # Reference: GcsTaskManager (src/ray/gcs/.../gcs_task_manager.h) fed by
+    # worker TaskEventBuffers; serves `ray list tasks` and `ray timeline`.
+
+    async def handle_report_task_events(self, events: List[Dict[str, Any]]
+                                        ) -> bool:
+        self.task_events.extend(events)
+        max_keep = 100_000
+        if len(self.task_events) > max_keep:
+            del self.task_events[:len(self.task_events) - max_keep]
+        return True
+
+    async def handle_get_task_events(self, cursor: Optional[int] = None,
+                                     limit: int = 10_000
+                                     ) -> List[Dict[str, Any]]:
+        """cursor=None returns the NEWEST `limit` events; an explicit cursor
+        pages forward from that offset (for incremental consumers)."""
+        if cursor is None:
+            return self.task_events[-limit:]
+        return self.task_events[cursor:cursor + limit]
 
     # ----------------------------------------- submitted jobs (job manager)
     # Reference: dashboard job module's REST endpoints; here plain GCS RPCs.

@@ -281,10 +281,15 @@ def join_partition(on, how: str, left_count: int, *blocks: pa.Table):
     right = _concat_keep_schema(list(blocks[left_count:]))
     keys = [on] if isinstance(on, str) else list(on)
     if not left.schema.names or not right.schema.names:
-        # a side with no blocks at all: inner join is empty; outer joins
-        # degrade to the populated side
-        out = left if how.startswith("left") else (
-            right if how.startswith("right") else pa.table({}))
+        # A side with ZERO blocks globally (its schema is unknowable), so
+        # every partition takes this branch — the output schema stays
+        # consistent across partitions: inner -> empty; any outer -> the
+        # populated side's rows/columns (there are no columns to null-fill
+        # from a side that never existed).
+        if how == "inner":
+            out = pa.table({})
+        else:
+            out = left if left.schema.names else right
         return _finalize(iter([out]), t0)
     joined = left.join(right, keys=keys, join_type=how)
     return _finalize(iter([joined]), t0)

@@ -5,6 +5,7 @@ EnvRunner actors for python/gym envs (the reference's architecture).
 """
 
 from ray_tpu.rl.algorithm import PPO, Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import DQN, DQNConfig, DQNParams, ReplayBuffer
 from ray_tpu.rl.env import (
     CartPoleEnv,
     EnvSpec,
@@ -18,7 +19,7 @@ from ray_tpu.rl.models import ActorCriticModule
 from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
 
 __all__ = [
-    "PPO", "Algorithm", "AlgorithmConfig", "ActorCriticModule",
+    "DQN", "DQNConfig", "DQNParams", "ReplayBuffer", "PPO", "Algorithm", "AlgorithmConfig", "ActorCriticModule",
     "CartPoleEnv", "EnvRunner", "EnvRunnerGroup", "EnvSpec", "GymVectorEnv",
     "JaxVectorEnv", "PPOConfig", "PPOLearner", "compute_gae", "make_env",
     "register_env",

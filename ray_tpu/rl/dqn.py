@@ -1,0 +1,260 @@
+"""DQN: double deep Q-learning with a host-side replay buffer.
+
+Reference: ``rllib/algorithms/dqn/`` (replay buffer + TorchLearner update).
+Jax-first split of responsibilities: acting and the double-DQN update are
+jitted device programs; the replay ring buffer is host numpy (sampling is
+random access — a host structure feeding device batches, the same
+host/device split the reference uses).
+
+Second algorithm on the rl tier's Learner/EnvRunner shapes — demonstrates
+the abstractions aren't PPO-shaped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.env import JaxVectorEnv, make_env
+from ray_tpu.rl.models import mlp_apply, mlp_init
+
+
+@dataclasses.dataclass(frozen=True)
+class DQNParams:
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    # both in ENV steps: one gradient update per update_every env steps,
+    # target-network sync every target_update_freq env steps
+    target_update_freq: int = 500
+    update_every: int = 4
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 3_000
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+class ReplayBuffer:
+    """Uniform ring buffer (reference: ``utils/replay_buffers``)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.terminals = np.zeros((capacity,), np.float32)
+        self.pos = 0
+        self.size = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, terminals):
+        for i in range(len(actions)):
+            j = self.pos
+            self.obs[j] = obs[i]
+            self.actions[j] = actions[i]
+            self.rewards[j] = rewards[i]
+            self.next_obs[j] = next_obs[i]
+            self.terminals[j] = terminals[i]
+            self.pos = (self.pos + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=n)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "next_obs": self.next_obs[idx],
+                "terminals": self.terminals[idx]}
+
+
+class DQNConfig:
+    """Builder mirroring AlgorithmConfig's surface for the DQN family."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.num_envs = 8
+        self.params = DQNParams()
+        self.seed = 0
+
+    def environment(self, env: str) -> "DQNConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_envs_per_env_runner: int = 8) -> "DQNConfig":
+        self.num_envs = num_envs_per_env_runner
+        return self
+
+    def training(self, **kw) -> "DQNConfig":
+        self.params = dataclasses.replace(self.params, **kw)
+        return self
+
+    def seed_(self, seed: int) -> "DQNConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        p = config.params
+        env = make_env(config.env_name)
+        if not isinstance(env, JaxVectorEnv):
+            raise TypeError("DQN here drives jax envs; wrap gym envs via "
+                            "register_env with a JaxVectorEnv")
+        self.env = env
+        spec = env.spec
+        self.sizes = [spec.obs_dim, *p.hidden, spec.num_actions]
+        key = jax.random.PRNGKey(config.seed)
+        self.q_params = mlp_init(key, self.sizes)
+        self.target_params = jax.tree.map(jnp.copy, self.q_params)
+        self.tx = optax.adam(p.lr)
+        self.opt_state = self.tx.init(self.q_params)
+        self.rng = np.random.default_rng(config.seed)
+        self.key = jax.random.PRNGKey(config.seed + 1)
+        self.buffer = ReplayBuffer(p.buffer_size, spec.obs_dim)
+        self.env_state, self.obs = env.reset(jax.random.PRNGKey(config.seed),
+                                             config.num_envs)
+        self.total_steps = 0
+        self.updates = 0
+        self.iteration = 0
+        self._ep_returns = np.zeros(config.num_envs)
+        self._completed: List[float] = []
+
+        n_layers = len(self.sizes) - 1
+
+        def q_values(params, obs):
+            return mlp_apply(params, obs, n_layers)
+
+        def update(q_params, target_params, opt_state, batch):
+            def loss_fn(qp):
+                q = q_values(qp, batch["obs"])
+                q_sel = jnp.take_along_axis(
+                    q, batch["actions"][:, None], axis=1)[:, 0]
+                # double DQN: online net argmax, target net evaluation
+                next_online = q_values(qp, batch["next_obs"])
+                next_a = jnp.argmax(next_online, axis=1)
+                next_target = q_values(target_params, batch["next_obs"])
+                next_q = jnp.take_along_axis(
+                    next_target, next_a[:, None], axis=1)[:, 0]
+                target = batch["rewards"] + p.gamma * next_q * (
+                    1.0 - batch["terminals"])
+                td = q_sel - jax.lax.stop_gradient(target)
+                return optax.huber_loss(td).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(q_params)
+            updates, opt_state = self.tx.update(grads, opt_state, q_params)
+            q_params = optax.apply_updates(q_params, updates)
+            return q_params, opt_state, loss
+
+        def act(params, obs, key, eps):
+            q = q_values(params, obs)
+            greedy = jnp.argmax(q, axis=1)
+            k_explore, k_coin = jax.random.split(key)  # independent streams
+            explore = jax.random.randint(k_explore, greedy.shape, 0,
+                                         spec.num_actions)
+            coin = jax.random.uniform(k_coin, greedy.shape)
+            return jnp.where(coin < eps, explore, greedy).astype(jnp.int32)
+
+        self._update = jax.jit(update)
+        self._act = jax.jit(act)
+
+    def _epsilon(self) -> float:
+        p = self.config.params
+        frac = min(1.0, self.total_steps / p.epsilon_decay_steps)
+        return p.epsilon_start + frac * (p.epsilon_end - p.epsilon_start)
+
+    def train(self, steps_per_iteration: int = 512) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.config.params
+        losses = []
+        n_env = self.config.num_envs
+        for _ in range(steps_per_iteration // n_env):
+            self.key, ka, ke = jax.random.split(self.key, 3)
+            actions = self._act(self.q_params, self.obs, ka, self._epsilon())
+            (self.env_state, next_obs, reward, terminated, truncated,
+             final_obs) = self.env.step(self.env_state, actions, ke)
+            done = np.asarray(terminated | truncated)
+            # store the TRUE successor (pre-reset) and terminal flags that
+            # exclude time-limit truncation (bootstrap through it)
+            self.buffer.add_batch(
+                np.asarray(self.obs), np.asarray(actions),
+                np.asarray(reward), np.asarray(final_obs),
+                np.asarray(terminated, np.float32))
+            self._ep_returns += np.asarray(reward)
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self.obs = next_obs
+            self.total_steps += n_env
+            if self.buffer.size >= p.learning_starts:
+                # keep the update:env-step ratio at 1:update_every even with
+                # vectorized envs (n_env steps advance per loop turn); no
+                # backfill for the pre-learning warmup period
+                if not hasattr(self, "_update_base"):
+                    self._update_base = self.total_steps // p.update_every
+                due = ((self.total_steps // p.update_every)
+                       - self._update_base - self.updates)
+                for _ in range(max(0, due)):
+                    batch = {k: jnp.asarray(v) for k, v in
+                             self.buffer.sample(p.train_batch_size,
+                                                self.rng).items()}
+                    self.q_params, self.opt_state, loss = self._update(
+                        self.q_params, self.target_params, self.opt_state,
+                        batch)
+                    self.updates += 1
+                    losses.append(float(loss))
+                if (self.total_steps // p.target_update_freq) > \
+                        getattr(self, "_last_sync", -1):
+                    self._last_sync = self.total_steps // p.target_update_freq
+                    self.target_params = jax.tree.map(jnp.copy, self.q_params)
+        recent = self._completed[-50:]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "total_env_steps": self.total_steps,
+            "num_updates": self.updates,
+            "epsilon": self._epsilon(),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "episode_reward_mean": (float(np.mean(recent)) if recent
+                                    else float("nan")),
+        }
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self) -> Dict[str, Any]:
+        import jax
+
+        return {"q_params": jax.device_get(self.q_params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state),
+                "total_steps": self.total_steps,
+                "updates": self.updates, "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        import jax
+
+        self.q_params = jax.device_put(state["q_params"])
+        self.target_params = jax.device_put(state["target_params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.total_steps = state["total_steps"]
+        self.updates = state["updates"]
+        self.iteration = state["iteration"]
+        # align the update schedule with the restored counters, else `due`
+        # stays negative for updates*update_every env steps after resume
+        p = self.config.params
+        self._update_base = (self.total_steps // p.update_every
+                             - self.updates)
+        self._last_sync = self.total_steps // p.target_update_freq
+
+    def stop(self):
+        pass

@@ -47,12 +47,45 @@ def list_named_actors(namespace: Optional[str] = None) -> List[Dict[str, str]]:
     return w.run_coro(w.gcs.call("list_named_actors", namespace=namespace))
 
 
-def timeline(filename: Optional[str] = None):
-    """Export a chrome://tracing timeline of cluster events (reference
-    ``python/ray/_private/state.py:444 profile_events``)."""
+def list_tasks(limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Recent task executions (reference ``ray list tasks``): name, kind,
+    timing, success, worker/node."""
     w = _worker()
-    reply = w.run_coro(w.gcs.call("subscribe", cursor=0, timeout=0.01))
+    return w.run_coro(w.gcs.call("get_task_events", limit=limit))
+
+
+def summarize_tasks() -> Dict[str, Dict[str, Any]]:
+    """Per-function-name counts/latency (reference ``ray summary tasks``)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in list_tasks():
+        s = out.setdefault(e["name"], {"count": 0, "failed": 0,
+                                       "total_s": 0.0})
+        s["count"] += 1
+        s["failed"] += 0 if e.get("ok") else 1
+        s["total_s"] += e["end"] - e["start"]
+    for s in out.values():
+        s["mean_s"] = s["total_s"] / max(s["count"], 1)
+    return out
+
+
+def timeline(filename: Optional[str] = None):
+    """Export a chrome://tracing timeline: task execution spans (ph=X, one
+    track per worker) + cluster lifecycle instants (reference
+    ``python/ray/_private/state.py:444 profile_events`` → ``ray timeline``)."""
+    w = _worker()
     events = []
+    for e in w.run_coro(w.gcs.call("get_task_events")):
+        events.append({
+            "name": e["name"],
+            "cat": e.get("kind", "TASK"),
+            "ph": "X",
+            "ts": e["start"] * 1e6,
+            "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
+            "pid": e.get("node_id", "node")[:8],
+            "tid": e.get("worker_id", "worker"),
+            "args": {"ok": e.get("ok"), "task_id": e.get("task_id")},
+        })
+    reply = w.run_coro(w.gcs.call("subscribe", cursor=0, timeout=0.01))
     for e in reply.get("events", []):
         events.append({
             "name": e.get("event", "event"),

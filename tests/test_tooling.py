@@ -180,3 +180,36 @@ def test_runtime_env_rejects_unsupported(ray_start):
             pass
 
         bad.remote()
+
+
+def test_task_events_and_timeline(ray_start, tmp_path):
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def traced_task(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([traced_task.remote(i) for i in range(4)])
+    deadline = time.time() + 20
+    while time.time() < deadline:  # events flush every ~2s
+        tasks = [t for t in state_api.list_tasks()
+                 if "traced_task" in t["name"]]
+        if len(tasks) >= 4:
+            break
+        time.sleep(0.5)
+    assert len(tasks) >= 4
+    assert all(t["ok"] and t["end"] > t["start"] for t in tasks)
+
+    summary = state_api.summarize_tasks()
+    key = next(k for k in summary if "traced_task" in k)
+    assert summary[key]["count"] >= 4
+    assert summary[key]["mean_s"] >= 0.05
+
+    out = str(tmp_path / "timeline.json")
+    events = state_api.timeline(out)
+    spans = [e for e in events if e["ph"] == "X"
+             and "traced_task" in e["name"]]
+    assert len(spans) >= 4
+    assert all(e["dur"] >= 5e4 for e in spans)  # >= 50ms in µs
+    assert json.load(open(out))
