@@ -1,0 +1,24 @@
+"""End-to-end: PPO on the device-resident CartPole env.
+
+Run: python examples/rl_cartpole.py
+"""
+
+from ray_tpu.rl import AlgorithmConfig, PPO
+
+
+def main():
+    algo = (AlgorithmConfig(PPO)
+            .environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=16,
+                         rollout_fragment_length=256)
+            .training(lr=3e-4)
+            .build())
+    for i in range(10):
+        m = algo.train()
+        print(f"iter {m['training_iteration']}: "
+              f"reward={m['episode_reward_mean']:.1f} "
+              f"steps/s={m['env_steps_per_sec']:.0f}")
+
+
+if __name__ == "__main__":
+    main()

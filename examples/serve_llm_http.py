@@ -1,0 +1,32 @@
+"""End-to-end: LLM deployment behind the HTTP proxy.
+
+Run: python examples/serve_llm_http.py
+Then: curl -XPOST localhost:8000/llm -d '{"prompt": "hello", "max_tokens": 16}'
+"""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import build_llm_deployment
+
+
+def main():
+    ray_tpu.init()
+    serve.start(http_options={"host": "127.0.0.1", "port": 8000})
+    serve.run(build_llm_deployment({"batch_slots": 4, "max_len": 128}),
+              route_prefix="/llm")
+    req = urllib.request.Request(
+        "http://127.0.0.1:8000/llm",
+        data=json.dumps({"prompt": "hello world", "max_tokens": 8,
+                         "temperature": 0.0}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        print(json.loads(resp.read()))
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""End-to-end: ASHA hyperparameter sweep over a toy objective.
+
+Run: python examples/tune_asha.py
+"""
+
+import ray_tpu
+from ray_tpu import tune
+
+
+def objective(config):
+    acc = 0.0
+    for _ in range(20):
+        acc += config["lr"] * (1.0 - acc)
+        tune.report({"acc": acc})
+
+
+def main():
+    ray_tpu.init()
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-3, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max", num_samples=8,
+            scheduler=tune.ASHAScheduler(grace_period=2, max_t=20),
+            max_concurrent_trials=4, seed=0),
+    ).fit()
+    best = grid.get_best_result()
+    print(f"best acc={best.metrics['acc']:.3f} lr={best.config['lr']:.4f}")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

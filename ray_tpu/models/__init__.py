@@ -30,3 +30,11 @@ from ray_tpu.models.generation import (  # noqa: F401
     init_kv_cache,
     prefill,
 )
+from ray_tpu.models.vit import (  # noqa: F401
+    ViTConfig,
+    make_vit_trainer,
+    vit_apply,
+    vit_init,
+    vit_loss,
+    vit_param_specs,
+)

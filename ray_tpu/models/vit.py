@@ -1,0 +1,195 @@
+"""Vision Transformer classifier — third family in the model zoo.
+
+Same contract as the Llama/MoE families: pure ``apply(params, batch)``
+functions plus a logical-axis spec tree, so the ShardedTrainer runs it
+under any mesh layout (DP/FSDP/TP) without model changes.  Patch embedding
+is a single reshaped gemm (MXU-friendly: no conv needed for ViT), encoder
+blocks are pre-LN attention + GELU MLP stacked under lax.scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import dot_product_attention
+from ray_tpu.ops.layers import rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.num_channels
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        base = dict(image_size=32, patch_size=8, hidden_size=64,
+                    num_layers=2, num_heads=4, mlp_dim=128, num_classes=10)
+        base.update(kw)
+        return ViTConfig(**base)
+
+    @staticmethod
+    def vit_b16() -> "ViTConfig":
+        return ViTConfig()
+
+    def num_params(self) -> int:
+        h, m = self.hidden_size, self.mlp_dim
+        per_layer = 4 * h * h + 2 * h * m + 2 * h  # qkv+o, mlp, norms
+        return (self.patch_dim * h + h              # patch embed + bias
+                + (self.num_patches + 1) * h        # pos embed (incl cls)
+                + h                                  # cls token
+                + self.num_layers * per_layer
+                + h                                  # final norm
+                + h * self.num_classes + self.num_classes)
+
+
+def _layer_init(key, cfg: ViTConfig):
+    h = cfg.hidden_size
+    init = jax.nn.initializers.normal(0.02)
+    ks = jax.random.split(key, 6)
+    dt = cfg.param_dtype
+    return {
+        "attn_norm": jnp.ones((h,), dt),
+        "wq": init(ks[0], (h, h), dt),
+        "wk": init(ks[1], (h, h), dt),
+        "wv": init(ks[2], (h, h), dt),
+        "wo": init(ks[3], (h, h), dt),
+        "mlp_norm": jnp.ones((h,), dt),
+        "w_up": init(ks[4], (h, cfg.mlp_dim), dt),
+        "w_down": init(ks[5], (cfg.mlp_dim, h), dt),
+    }
+
+
+def vit_init(key: jax.Array, cfg: ViTConfig) -> Dict[str, Any]:
+    init = jax.nn.initializers.normal(0.02)
+    ks = jax.random.split(key, cfg.num_layers + 4)
+    layers = [_layer_init(k, cfg) for k in ks[:cfg.num_layers]]
+    return {
+        "patch_embed": init(ks[-4], (cfg.patch_dim, cfg.hidden_size),
+                            cfg.param_dtype),
+        "patch_bias": jnp.zeros((cfg.hidden_size,), cfg.param_dtype),
+        "pos_embed": init(ks[-3], (cfg.num_patches + 1, cfg.hidden_size),
+                          cfg.param_dtype),
+        "cls_token": init(ks[-2], (cfg.hidden_size,), cfg.param_dtype),
+        "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+        "final_norm": jnp.ones((cfg.hidden_size,), cfg.param_dtype),
+        "head_w": init(ks[-1], (cfg.hidden_size, cfg.num_classes),
+                       cfg.param_dtype),
+        "head_b": jnp.zeros((cfg.num_classes,), cfg.param_dtype),
+    }
+
+
+def vit_param_specs(cfg: ViTConfig) -> Dict[str, Any]:
+    layer = {
+        "attn_norm": ("norm",),
+        "wq": ("embed", "heads"),
+        "wk": ("embed", "heads"),
+        "wv": ("embed", "heads"),
+        "wo": ("heads", "embed"),
+        "mlp_norm": ("norm",),
+        "w_up": ("embed", "mlp"),
+        "w_down": ("mlp", "embed"),
+    }
+    return {
+        "patch_embed": (None, "embed"),
+        "patch_bias": ("norm",),
+        "pos_embed": (None, "embed"),
+        "cls_token": ("norm",),
+        "layers": {k: ("layers",) + v for k, v in layer.items()},
+        "final_norm": ("norm",),
+        "head_w": ("embed", "vocab"),
+        "head_b": ("norm",),
+    }
+
+
+def _patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """[b, H, W, C] -> [b, num_patches, patch_dim] (pure reshape/transpose)."""
+    b, H, W, C = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (H // p) * (W // p), p * p * C)
+
+
+def vit_apply(params: Dict[str, Any], images: jnp.ndarray, cfg: ViTConfig,
+              *, mesh=None) -> jnp.ndarray:
+    """images [b, H, W, C] float -> logits [b, num_classes] (fp32)."""
+    dt = cfg.dtype
+    x = _patchify(images.astype(dt), cfg)
+    x = x @ params["patch_embed"].astype(dt) + params["patch_bias"].astype(dt)
+    cls = jnp.broadcast_to(params["cls_token"].astype(dt),
+                           (x.shape[0], 1, cfg.hidden_size))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(dt)[None]
+
+    hd = cfg.hidden_size // cfg.num_heads
+
+    def layer_fn(x, lp):
+        b, s, h = x.shape
+        y = rms_norm(x, lp["attn_norm"])
+        q = (y @ lp["wq"].astype(dt)).reshape(b, s, cfg.num_heads, hd)
+        k = (y @ lp["wk"].astype(dt)).reshape(b, s, cfg.num_heads, hd)
+        v = (y @ lp["wv"].astype(dt)).reshape(b, s, cfg.num_heads, hd)
+        attn = dot_product_attention(q, k, v, causal=False, impl="ref",
+                                     mesh=mesh)
+        x = x + attn.reshape(b, s, h) @ lp["wo"].astype(dt)
+        y = rms_norm(x, lp["mlp_norm"])
+        act = jax.nn.gelu((y @ lp["w_up"].astype(dt)).astype(jnp.float32))
+        return x + act.astype(dt) @ lp["w_down"].astype(dt), None
+
+    f = layer_fn
+    if cfg.remat:
+        f = jax.checkpoint(f)
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(lambda c, lp: f(c, lp), x, params["layers"])
+    else:
+        L = jax.tree.leaves(params["layers"])[0].shape[0]
+        for i in range(L):
+            x, _ = f(x, jax.tree.map(lambda a: a[i], params["layers"]))
+    x = rms_norm(x, params["final_norm"])
+    cls_out = x[:, 0]
+    return (cls_out @ params["head_w"].astype(dt)
+            + params["head_b"].astype(dt)).astype(jnp.float32)
+
+
+def vit_loss(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
+             cfg: ViTConfig, *, mesh=None) -> jnp.ndarray:
+    logits = vit_apply(params, batch["images"], cfg, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(
+        logp, batch["labels"][:, None], axis=-1).mean()
+
+
+def make_vit_trainer(cfg: ViTConfig, mesh, *, optimizer=None, rules=None):
+    from ray_tpu.models.training import ShardedTrainer, default_optimizer
+
+    return ShardedTrainer(
+        init_fn=lambda key: vit_init(key, cfg),
+        loss_fn=functools.partial(vit_loss, cfg=cfg, mesh=mesh),
+        param_specs=vit_param_specs(cfg),
+        mesh=mesh,
+        optimizer=optimizer or default_optimizer(),
+        rules=rules,
+    )
