@@ -44,6 +44,12 @@ _FLAG_DEFS: Dict[str, Any] = {
     "worker_startup_timeout_s": 60.0,
     "idle_worker_kill_s": 300.0,
     "maximum_startup_concurrency": 4,
+    # --- memory monitor / OOM killing ---
+    # (reference src/ray/common/memory_monitor.h:52 +
+    # worker_killing_policy*.h; refresh 0 disables)
+    "memory_monitor_refresh_ms": 250,
+    "memory_usage_threshold": 0.95,
+    "worker_killing_policy": "retriable_fifo",  # | "group_by_owner"
     # --- health / failure detection ---
     # (reference gcs_health_check_manager.h:45 timings)
     "health_check_period_s": 5.0,

@@ -87,6 +87,13 @@ class GcsServer:
                 w.set_result(None)
         self._event_waiters.clear()
 
+    async def handle_publish_event(self, channel: str,
+                                   data: Dict[str, Any]) -> bool:
+        """Cluster components (raylets, libraries) publish to the event
+        feed — e.g. OOM kills (reference: export events, event.h:91)."""
+        self._publish(channel, data)
+        return True
+
     # ------------------------------------------------------------------ nodes
 
     async def handle_register_node(self, node_id: str, addr: str,

@@ -1,0 +1,158 @@
+"""Node memory monitor + OOM worker-killing policies.
+
+TPU-native equivalent of the reference's raylet OOM protection:
+``MemoryMonitor`` (``src/ray/common/memory_monitor.h:52``) samples node
+memory each refresh interval, and when usage crosses the threshold a
+``WorkerKillingPolicy`` picks a victim:
+
+- retriable-FIFO (``worker_killing_policy_retriable_fifo.h:34``): newest
+  lease first, so long-running work survives and the killed task retries;
+- group-by-owner (``worker_killing_policy_group_by_owner.h:90``): the
+  owner with the most in-flight leases loses its newest one, so one
+  fan-out-happy driver can't evict everyone else's workers.
+
+The raylet runs ``MemoryMonitor.maybe_pick_victim`` inside its reaper loop;
+the kill rides the existing worker-death path, so the owner's task retry /
+lineage machinery handles recovery exactly like any other worker crash.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ray_tpu._private.config import config
+
+logger = logging.getLogger(__name__)
+
+
+def system_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) for the node, cgroup-aware.
+
+    Inside a memory-limited cgroup (the common deployment), the cgroup
+    limit is the real ceiling, not the host's; mirrors the reference's
+    cgroup handling in ``memory_monitor.cc``.
+    """
+    global _psutil_warned
+    total = used = None
+    try:  # cgroup v2
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+        if raw != "max":
+            total = int(raw)
+            with open("/sys/fs/cgroup/memory.current") as f:
+                used = int(f.read().strip())
+    except (OSError, ValueError):
+        pass
+    if total is None:
+        try:
+            import psutil
+        except ImportError:
+            if not _psutil_warned:
+                _psutil_warned = True
+                logger.warning(
+                    "psutil unavailable and no cgroup-v2 memory limit: "
+                    "OOM protection disabled on this node"
+                )
+            return 0, 1  # never reads as pressure
+        vm = psutil.virtual_memory()
+        total, used = vm.total, vm.total - vm.available
+    return used, total
+
+
+_psutil_warned = False
+
+
+def process_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+class MemoryMonitor:
+    """Threshold detector + policy dispatch; pure logic, injectable I/O."""
+
+    def __init__(
+        self,
+        usage_fn: Callable[[], Tuple[int, int]] = system_memory_usage,
+        threshold: Optional[float] = None,
+        policy: Optional[str] = None,
+        min_kill_interval_s: float = 2.0,
+    ):
+        self.usage_fn = usage_fn
+        self.threshold = (
+            threshold if threshold is not None
+            else config.memory_usage_threshold
+        )
+        self.policy = policy or config.worker_killing_policy
+        self.min_kill_interval_s = min_kill_interval_s
+        self._last_kill = 0.0
+
+    def is_pressing(self) -> bool:
+        used, total = self.usage_fn()
+        return total > 0 and used / total > self.threshold
+
+    def maybe_pick_victim(self, workers: List) -> Optional[object]:
+        """Return the WorkerHandle to kill, or None.
+
+        ``workers`` is the raylet's live worker list (handles expose
+        ``lease``, ``started_at``, ``dedicated``).  Rate-limited so one
+        pressure episode doesn't massacre the whole pool before the first
+        kill's memory is returned.
+        """
+        if not self.is_pressing():
+            return None
+        now = time.time()
+        if now - self._last_kill < self.min_kill_interval_s:
+            return None
+        victim = pick_victim(workers, self.policy)
+        if victim is not None:
+            self._last_kill = now
+            used, total = self.usage_fn()
+            logger.warning(
+                "memory pressure %.1f%% > %.1f%%: killing worker pid=%s "
+                "(policy=%s, lease=%s)",
+                100 * used / max(total, 1), 100 * self.threshold,
+                getattr(victim, "pid", "?"), self.policy,
+                bool(getattr(victim, "lease", None)),
+            )
+        return victim
+
+
+def pick_victim(workers: List, policy: str = "retriable_fifo"):
+    """Choose the worker to kill under memory pressure.
+
+    Idle workers go first (frees memory without failing anyone's task);
+    then the policy orders the leased ones.
+    """
+    idle = [w for w in workers if w.lease is None and not w.dedicated]
+    if idle:
+        # Newest idle first: oldest idle workers have the warmest caches.
+        return max(idle, key=lambda w: w.started_at)
+    leased = [w for w in workers if w.lease is not None]
+    if not leased:
+        return None
+
+    def lease_time(w):
+        # When the lease was granted — NOT when the worker process spawned
+        # (prestarted pool workers are old but their task may be brand new).
+        return w.lease.get("granted_at", w.started_at)
+
+    if policy == "group_by_owner":
+        groups: dict = {}
+        for w in leased:
+            groups.setdefault(w.lease.get("owner", ""), []).append(w)
+        biggest = max(groups.values(), key=len)
+        # Within the group, retriable task workers before actors.
+        retriable = [w for w in biggest if not w.dedicated]
+        return max(retriable or biggest, key=lease_time)
+    # retriable_fifo: newest lease dies first (its retry loses the least
+    # progress); dedicated (actor) workers are last resorts since actor
+    # restart is costlier than task retry.
+    tasks = [w for w in leased if not w.dedicated]
+    pool = tasks or leased
+    return max(pool, key=lease_time)

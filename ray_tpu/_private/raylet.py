@@ -102,6 +102,13 @@ class Raylet:
         )
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reaper_loop()))
+        if config.memory_monitor_refresh_ms > 0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor()
+            self._tasks.append(
+                asyncio.ensure_future(self._memory_monitor_loop())
+            )
         for _ in range(config.num_prestart_workers):
             self._start_worker()
         logger.info("raylet %s up at %s resources=%s", self.node_id[:8], self.addr,
@@ -155,6 +162,51 @@ class Raylet:
                     logger.warning("worker pid %s exited before registering (rc=%s)",
                                    pid, proc.returncode)
             await asyncio.sleep(0.2)
+
+    async def _memory_monitor_loop(self):
+        """OOM protection: under memory pressure, kill a worker chosen by
+        the killing policy (reference: MemoryMonitor triggering
+        WorkerKillingPolicy in the raylet).  The kill flows through the
+        normal worker-death path so owners retry the lost task."""
+        period = config.memory_monitor_refresh_ms / 1000.0
+        while not self._stopping:
+            try:
+                victim = self.memory_monitor.maybe_pick_victim(
+                    list(self.workers.values())
+                )
+                if victim is not None:
+                    try:
+                        await self.gcs.call(
+                            "publish_event",
+                            channel="oom",
+                            data={
+                                "event": "oom_kill",
+                                "node_id": self.node_id,
+                                "pid": victim.pid,
+                                "policy": self.memory_monitor.policy,
+                            },
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+                    # SIGKILL only: the reaper notices the exit and runs
+                    # _on_worker_death, which releases the lease, reports
+                    # the death to the GCS (so the owner retries), and
+                    # pumps queued leases — same path as any other crash.
+                    # Workers are session leaders (start_new_session=True),
+                    # so killpg reaps any memory-hogging children too.
+                    if victim.pid:
+                        try:
+                            os.killpg(victim.pid, 9)
+                        except (ProcessLookupError, PermissionError):
+                            try:
+                                os.kill(victim.pid, 9)
+                            except ProcessLookupError:
+                                await self._on_worker_death(victim)
+                    else:
+                        await self._on_worker_death(victim)
+            except Exception as e:  # noqa: BLE001
+                logger.debug("memory monitor: %s", e)
+            await asyncio.sleep(period)
 
     async def _on_worker_death(self, h: WorkerHandle):
         logger.warning("worker %s (pid %s) died", h.worker_id.hex()[:8], h.pid)
@@ -392,7 +444,7 @@ class Raylet:
                 pool.subtract(demand)
                 worker.lease = {
                     "demand": demand, "pg_id": pg_id, "bundle_index": resolved_index,
-                    "owner": owner_addr,
+                    "owner": owner_addr, "granted_at": time.time(),
                 }
                 worker.dedicated = dedicated
                 if not fut.done():

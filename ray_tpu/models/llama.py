@@ -47,6 +47,8 @@ class LlamaConfig:
     scan_layers: bool = True
     attention_impl: str = "auto"
     tie_embeddings: bool = False
+    # Microbatches for pipeline parallelism (mesh "pp" axis); default 2*pp.
+    pp_microbatches: Optional[int] = None
 
     @property
     def resolved_head_dim(self) -> int:
@@ -243,7 +245,37 @@ def llama_apply(
                 f"{cfg.remat_policy!r}"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
-    if cfg.scan_layers:
+    from ray_tpu.parallel.pipeline import pipeline_microbatches, pp_size
+
+    n_stages = pp_size(mesh)
+    if n_stages > 1:
+        # Pipeline path: layers are stage-sharded over "pp"; the microbatch
+        # ppermute schedule runs in a partial-manual shard_map.  Sharding
+        # constraints and ring attention do their own (nested) mesh
+        # manipulation, so inside a stage we drop constraints and use an
+        # attention impl GSPMD can partition over the remaining auto axes.
+        if not cfg.scan_layers:
+            raise ValueError("pp>1 requires scan_layers=True (stacked params)")
+        from ray_tpu.parallel.pipeline import pipeline_apply
+
+        if cfg.attention_impl not in ("auto", "ref"):
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} is incompatible "
+                "with pp>1: ring needs its own (nested) shard_map and "
+                "pallas flash can't be auto-partitioned inside the "
+                "pipeline's partial-manual region; use 'auto' or 'ref'"
+            )
+        stage_cfg = dataclasses.replace(cfg, attention_impl="ref")
+        stage_fn = functools.partial(
+            _decoder_layer, cfg=stage_cfg, cos=cos, sin=sin, mesh=None
+        )
+        if cfg.remat:
+            stage_fn = jax.checkpoint(stage_fn, policy=policy)
+        x = pipeline_apply(
+            stage_fn, params["layers"], x, mesh=mesh,
+            num_microbatches=pipeline_microbatches(cfg.pp_microbatches, mesh),
+        )
+    elif cfg.scan_layers:
         x, _ = jax.lax.scan(
             lambda carry, lp: (layer_fn(carry, lp), None),
             x,

@@ -238,7 +238,9 @@ def moe_loss(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
 def make_moe_trainer(cfg: MoEConfig, mesh, *, optimizer=None, rules=None):
     """ShardedTrainer for the MoE family (EP via the 'expert' rule)."""
     from ray_tpu.models.training import ShardedTrainer, default_optimizer
+    from ray_tpu.parallel.pipeline import reject_pp
 
+    rules = reject_pp(mesh, "MoE", rules)
     return ShardedTrainer(
         init_fn=lambda key: moe_init(key, cfg),
         loss_fn=functools.partial(moe_loss, cfg=cfg, mesh=mesh),

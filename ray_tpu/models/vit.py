@@ -184,7 +184,9 @@ def vit_loss(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
 
 def make_vit_trainer(cfg: ViTConfig, mesh, *, optimizer=None, rules=None):
     from ray_tpu.models.training import ShardedTrainer, default_optimizer
+    from ray_tpu.parallel.pipeline import reject_pp
 
+    rules = reject_pp(mesh, "ViT", rules)
     return ShardedTrainer(
         init_fn=lambda key: vit_init(key, cfg),
         loss_fn=functools.partial(vit_loss, cfg=cfg, mesh=mesh),

@@ -16,6 +16,10 @@ from ray_tpu.parallel.mesh import (  # noqa: F401
     mesh_shape_for,
     local_mesh,
 )
+from ray_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    pp_size,
+)
 from ray_tpu.parallel.sharding import (  # noqa: F401
     LogicalAxisRules,
     DEFAULT_RULES,

@@ -1,13 +1,16 @@
 """Device-mesh construction for TPU pod slices.
 
-The canonical mesh has four named axes, outermost to innermost:
+The canonical mesh has five named axes, outermost to innermost:
 
-    ("dp", "fsdp", "tp", "sp")
+    ("dp", "fsdp", "pp", "tp", "sp")
 
 - ``dp``:   pure data parallelism (gradients psum'd; params replicated)
 - ``fsdp``: ZeRO-style sharded data parallelism (params/opt-state sharded,
             all-gathered for compute) — the reference reaches this via torch
             FSDP (``train_loop_utils.py:176-178``); here it is an axis.
+- ``pp``:   pipeline parallelism (layer-stacked params sharded by stage;
+            microbatch ppermute schedule in ``parallel/pipeline.py``) — the
+            reference delegates PP to vLLM (``vllm_models.py:127``).
 - ``tp``:   tensor parallelism (Megatron-style column/row sharding)
 - ``sp``:   sequence/context parallelism (ring attention) — absent from the
             reference entirely (SURVEY.md §2.4); first-class here.
@@ -27,23 +30,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp")
+MESH_AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Sizes for each mesh axis; -1 on at most one axis means "infer".
 
-    ``MeshConfig(dp=-1, tp=4)`` on 16 devices → (4, 1, 4, 1).
+    ``MeshConfig(dp=-1, tp=4)`` on 16 devices → (4, 1, 1, 4, 1).
     """
 
     dp: int = -1
     fsdp: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
-        sizes = [self.dp, self.fsdp, self.tp, self.sp]
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
+        sizes = [self.dp, self.fsdp, self.pp, self.tp, self.sp]
         n_infer = sum(1 for s in sizes if s == -1)
         if n_infer > 1:
             raise ValueError(f"At most one axis may be -1, got {sizes}")
@@ -114,8 +118,8 @@ def create_hybrid_mesh(
         raise ValueError("dp must be 1 in ici_config for hybrid meshes")
     # create_hybrid_device_mesh takes same-rank ICI and DCN shapes; the
     # result shape is their elementwise product, so dp == num_slices lands
-    # on the DCN boundary and fsdp/tp/sp stay within a slice's ICI torus.
-    dcn_shape = (num_slices, 1, 1, 1)
+    # on the DCN boundary and fsdp/pp/tp/sp stay within a slice's ICI torus.
+    dcn_shape = (num_slices,) + (1,) * (len(MESH_AXES) - 1)
     try:
         from jax.experimental import mesh_utils
 

@@ -1,0 +1,166 @@
+"""In-graph pipeline parallelism over the ``pp`` mesh axis.
+
+The reference delegates pipeline parallelism to vLLM
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py:127``
+``pipeline_parallel_size`` → placement-group bundles) and provides only the
+channel substrate for inter-actor pipelining
+(``python/ray/dag/dag_node_operation.py``).  Here PP is a first-class mesh
+axis like dp/fsdp/tp/sp, implemented the TPU way:
+
+- layer-stacked params are sharded over ``pp`` (each stage holds
+  ``L / pp_size`` contiguous layers);
+- the microbatch schedule is a ``lax.scan`` of compute+``ppermute`` ticks
+  inside a *partial-manual* ``shard_map`` — only ``pp`` is manual, the
+  other axes stay auto so GSPMD keeps inserting the dp/fsdp/tp collectives
+  from sharding annotations;
+- reverse-mode AD transposes the ``ppermute`` ring, so the backward pass is
+  the mirrored pipeline schedule for free.  With per-layer remat the live
+  state per stage is one microbatch activation + the output buffer, which
+  is the 1F1B memory profile (activations for at most the in-flight
+  microbatches, not all of them).
+
+Bubble fraction is ``(S-1) / (M + S - 1)`` for S stages and M microbatches;
+raise ``num_microbatches`` to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pp_size(mesh: Optional[Mesh], axis: str = "pp") -> int:
+    """Number of pipeline stages in the mesh (1 when no pp axis)."""
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def pipeline_apply(
+    layer_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    num_microbatches: Optional[int] = None,
+    axis: str = "pp",
+) -> jnp.ndarray:
+    """Run ``x`` through L stacked layers pipelined over the ``axis`` stages.
+
+    ``layer_fn(x, layer_params) -> x`` is the per-layer body (already
+    remat-wrapped by the caller if desired).  ``stacked_params`` is a pytree
+    whose leaves have a leading layer dimension L, sharded over ``axis``
+    (each stage owns a contiguous block of L/S layers).  ``x`` is
+    ``[batch, ...]`` and must be divisible into ``num_microbatches``.
+
+    Returns the activations after all L layers, same shape as ``x``.
+    """
+    S = pp_size(mesh, axis)
+    if S == 1:
+        def body(carry, lp):
+            return layer_fn(carry, lp), None
+        out, _ = jax.lax.scan(body, x, stacked_params)
+        return out
+
+    M = num_microbatches or S
+    b = x.shape[0]
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % S != 0:
+        raise ValueError(f"{n_layers} layers not divisible by {S} stages")
+
+    micro = x.reshape((M, b // M) + x.shape[1:])
+
+    def stage_body(state, layers_shard):
+        def body(carry, lp):
+            return layer_fn(carry, lp), None
+        out, _ = jax.lax.scan(body, state, layers_shard)
+        return out
+
+    def pipelined(layers_shard, micro):
+        stage = jax.lax.axis_index(axis)
+        state = jnp.zeros_like(micro[0])
+        outputs = jnp.zeros_like(micro)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (clamped; masked off past M).
+            inp = jax.lax.dynamic_index_in_dim(
+                micro, jnp.minimum(t, M - 1), axis=0, keepdims=False
+            )
+            state = jnp.where(stage == 0, inp, state)
+            state = stage_body(state, layers_shard)
+            # Last stage emits microbatch t-(S-1) once the fill completes.
+            out_idx = t - (S - 1)
+            emit = (stage == S - 1) & (out_idx >= 0)
+            emitted = jax.lax.dynamic_update_index_in_dim(
+                outputs, state, jnp.maximum(out_idx, 0), axis=0
+            )
+            outputs = jnp.where(emit, emitted, outputs)
+            # Rotate activations one stage down the ring.
+            state = jax.lax.ppermute(
+                state, axis, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(
+            tick, (state, outputs), jnp.arange(M + S - 1)
+        )
+        # Only the last stage holds real outputs; psum replicates them
+        # across the pp ring (zeros elsewhere) so out_specs can be P().
+        outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis)
+
+    shard_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    # Partial-manual shard_map (only `axis` manual, rest auto) has no
+    # equivalent in the legacy jax.experimental.shard_map that
+    # ops/attention.py's compat wrapper can fall back to — fail with a
+    # clear version message instead of an opaque TypeError.
+    try:
+        mapped = jax.shard_map(
+            pipelined,
+            mesh=mesh,
+            in_specs=(shard_spec, P()),
+            out_specs=P(),
+            axis_names={axis},
+            check_vma=False,
+        )
+    except (AttributeError, TypeError) as e:
+        raise RuntimeError(
+            "pipeline parallelism needs jax.shard_map with partial-manual "
+            "axis_names support (jax >= 0.6); this jax lacks it"
+        ) from e
+    out = mapped(stacked_params, micro)
+    return out.reshape(x.shape)
+
+
+def pipeline_microbatches(cfg_microbatches: Optional[int], mesh: Mesh,
+                          axis: str = "pp") -> int:
+    """Default microbatch count: 2*stages (25%→~14% bubble vs M=S)."""
+    return cfg_microbatches or 2 * pp_size(mesh, axis)
+
+
+def reject_pp(mesh: Optional[Mesh], family: str, rules=None):
+    """Guard for model families without a pipeline apply path.
+
+    Raises on pp>1 meshes, and — only when the caller supplied no rule
+    table of their own — replicates stacked layers over pp instead of
+    stage-sharding them (a stage-sharded stack under a plain lax.scan
+    would all-gather every layer, every step).  Returns the rule table to
+    use.
+    """
+    if pp_size(mesh) > 1:
+        raise ValueError(
+            f"{family} has no pipeline (pp) apply path; use dp/fsdp/tp/sp "
+            "axes (pp is llama-only for now)"
+        )
+    if rules is None:
+        from ray_tpu.parallel.sharding import DEFAULT_RULES
+
+        return {**DEFAULT_RULES, "layers": None}
+    return rules

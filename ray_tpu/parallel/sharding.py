@@ -33,7 +33,7 @@ DEFAULT_RULES: LogicalAxisRules = {
     "head_dim": None,
     "vocab": "tp",
     "expert": "tp",
-    "layers": None,
+    "layers": "pp",
 }
 
 # Rules for inference-style TP-only sharding (no fsdp axis in use).

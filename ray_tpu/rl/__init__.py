@@ -5,7 +5,10 @@ EnvRunner actors for python/gym envs (the reference's architecture).
 """
 
 from ray_tpu.rl.algorithm import PPO, Algorithm, AlgorithmConfig
+from ray_tpu.rl.bc import BC, MARWIL, MARWILParams
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNParams, ReplayBuffer
+from ray_tpu.rl.impala import APPO, IMPALA, ImpalaLearner, ImpalaParams, vtrace
+from ray_tpu.rl.sac import SAC, SACConfig, SACParams
 from ray_tpu.rl.env import (
     CartPoleEnv,
     EnvSpec,
@@ -19,8 +22,11 @@ from ray_tpu.rl.models import ActorCriticModule
 from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
 
 __all__ = [
-    "DQN", "DQNConfig", "DQNParams", "ReplayBuffer", "PPO", "Algorithm", "AlgorithmConfig", "ActorCriticModule",
+    "APPO", "BC", "DQN", "DQNConfig", "DQNParams", "IMPALA",
+    "ImpalaLearner", "ImpalaParams", "MARWIL", "MARWILParams",
+    "ReplayBuffer", "PPO", "SAC", "SACConfig", "SACParams",
+    "Algorithm", "AlgorithmConfig", "ActorCriticModule",
     "CartPoleEnv", "EnvRunner", "EnvRunnerGroup", "EnvSpec", "GymVectorEnv",
     "JaxVectorEnv", "PPOConfig", "PPOLearner", "compute_gae", "make_env",
-    "register_env",
+    "register_env", "vtrace",
 ]

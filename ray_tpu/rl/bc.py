@@ -1,0 +1,163 @@
+"""Offline RL: MARWIL (advantage-weighted imitation) and BC (beta=0).
+
+Reference: ``rllib/algorithms/marwil/`` and ``rllib/algorithms/bc/`` —
+in the reference BC literally subclasses MARWIL with beta=0; the same
+relationship holds here.  Offline batches come from the Data tier
+(``ray_tpu.data.Dataset`` of {obs, actions[, returns]} rows) or plain
+numpy arrays; the update is one jitted program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.models import mlp_apply, mlp_init
+
+
+@dataclasses.dataclass(frozen=True)
+class MARWILParams:
+    lr: float = 1e-3
+    # beta=0 -> plain behavior cloning; beta>0 weights the log-likelihood
+    # by exp(beta * normalized advantage) so better-than-average actions
+    # are imitated harder.
+    beta: float = 1.0
+    vf_coef: float = 1.0
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+class MARWIL:
+    def __init__(self, obs_dim: int, num_actions: int,
+                 params: Optional[MARWILParams] = None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.p = params or MARWILParams()
+        p = self.p
+        pi_sizes = [obs_dim, *p.hidden, num_actions]
+        vf_sizes = [obs_dim, *p.hidden, 1]
+        kp, kv = jax.random.split(jax.random.PRNGKey(seed))
+        self.params = {"pi": mlp_init(kp, pi_sizes),
+                       "vf": mlp_init(kv, vf_sizes)}
+        self.tx = optax.adam(p.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        n_layers = len(pi_sizes) - 1
+
+        def update(params, opt_state, batch):
+            def loss_fn(ps):
+                logits = mlp_apply(ps["pi"], batch["obs"], n_layers)
+                logp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits),
+                    batch["actions"][:, None], axis=1)[:, 0]
+                if p.beta == 0.0:
+                    pi_loss = -logp.mean()
+                    vf_loss = jnp.zeros(())
+                else:
+                    values = mlp_apply(ps["vf"], batch["obs"],
+                                       n_layers)[:, 0]
+                    adv = batch["returns"] - values
+                    vf_loss = (adv ** 2).mean()
+                    # moving-free normalization: batch std (reference keeps
+                    # a running MA of the squared advantage norm)
+                    adv_n = adv / (jnp.std(
+                        jax.lax.stop_gradient(adv)) + 1e-8)
+                    w = jnp.exp(jnp.clip(
+                        p.beta * jax.lax.stop_gradient(adv_n), -10.0, 10.0))
+                    pi_loss = -(w * logp).mean()
+                total = pi_loss + p.vf_coef * vf_loss
+                return total, {"pi_loss": pi_loss, "vf_loss": vf_loss}
+
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, aux
+
+        def act_greedy(params, obs):
+            logits = mlp_apply(params["pi"], obs, n_layers)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        self._update = jax.jit(update)
+        self.act_greedy = jax.jit(act_greedy)
+
+    def _to_batch(self, rows) -> Dict[str, np.ndarray]:
+        if isinstance(rows, dict):
+            batch = rows
+        else:
+            batch = {
+                "obs": np.stack([np.asarray(r["obs"], np.float32)
+                                 for r in rows]),
+                "actions": np.asarray([r["actions"] for r in rows],
+                                      np.int32),
+            }
+            if rows and "returns" in rows[0]:
+                batch["returns"] = np.asarray(
+                    [r["returns"] for r in rows], np.float32)
+        if self.p.beta != 0.0 and "returns" not in batch:
+            raise ValueError("MARWIL (beta>0) needs 'returns' in the data; "
+                             "use beta=0 (BC) for (obs, actions)-only data")
+        return batch
+
+    def train_on(self, data, *, batch_size: int = 256,
+                 epochs: int = 1) -> Dict[str, float]:
+        """``data``: a ray_tpu.data.Dataset of rows, an iterable of row
+        dicts, or a column dict of arrays."""
+        import jax.numpy as jnp
+
+        metrics: Dict[str, float] = {}
+        n_batches = 0
+        for _ in range(epochs):
+            for batch in self._iter_batches(data, batch_size):
+                jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.opt_state, aux = self._update(
+                    self.params, self.opt_state, jb)
+                n_batches += 1
+                for k, v in aux.items():
+                    metrics[k] = metrics.get(k, 0.0) + float(v)
+        self.iteration += 1
+        out = {k: v / max(n_batches, 1) for k, v in metrics.items()}
+        out["training_iteration"] = self.iteration
+        return out
+
+    def _iter_batches(self, data, batch_size: int):
+        if hasattr(data, "iter_batches"):  # ray_tpu.data.Dataset
+            for b in data.iter_batches(batch_size=batch_size):
+                yield self._to_batch(b)
+            return
+        if isinstance(data, dict):
+            n = len(data["actions"])
+            for i in range(0, n, batch_size):
+                yield self._to_batch(
+                    {k: np.asarray(v)[i:i + batch_size]
+                     for k, v in data.items()})
+            return
+        rows = list(data)
+        for i in range(0, len(rows), batch_size):
+            yield self._to_batch(rows[i:i + batch_size])
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        import jax
+
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]
+
+
+class BC(MARWIL):
+    """Behavior cloning = MARWIL with beta=0 (as in the reference)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 params: Optional[MARWILParams] = None, seed: int = 0):
+        params = dataclasses.replace(params or MARWILParams(), beta=0.0)
+        super().__init__(obs_dim, num_actions, params, seed)

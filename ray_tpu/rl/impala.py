@@ -1,0 +1,301 @@
+"""IMPALA / APPO: V-trace off-policy actor-critic, fully jitted.
+
+Reference: ``rllib/algorithms/impala/`` (V-trace in
+``rllib/algorithms/impala/vtrace_torch.py`` lineage) and
+``rllib/algorithms/appo/`` (V-trace + PPO-style ratio clip).  TPU-first:
+the V-trace correction is a reverse ``lax.scan`` and the whole update is
+one jitted program; distributed actors reuse the EnvRunnerGroup, whose
+stale-policy lag is exactly what V-trace corrects.
+
+Set ``clip_ratio`` (APPO) to bound the policy update like PPO; leave None
+for plain IMPALA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import JaxVectorEnv, make_env
+from ray_tpu.rl.models import ActorCriticModule
+
+
+@dataclasses.dataclass(frozen=True)
+class ImpalaParams:
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.01
+    max_grad_norm: float = 0.5
+    # V-trace clipping (Espeholt et al. 2018): rho-bar bounds the value
+    # target correction, c-bar bounds the trace propagation.
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    # APPO: additionally clip the surrogate ratio PPO-style; None = IMPALA.
+    clip_ratio: Optional[float] = None
+
+
+def vtrace(behaviour_logp, target_logp, rewards, values, dones, last_value,
+           gamma, rho_clip=1.0, c_clip=1.0):
+    """V-trace targets and policy-gradient advantages.
+
+    All inputs [T, B] (time-major); last_value [B].  Returns (vs, pg_adv):
+    vs are the corrected value targets, pg_adv the clipped-IS advantages
+    ``rho_t * (r_t + gamma * vs_{t+1} - V(x_t))``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rho = jnp.exp(target_logp - behaviour_logp)
+    rho_bar = jnp.minimum(rho, rho_clip)
+    c_bar = jnp.minimum(rho, c_clip)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+
+    next_values = jnp.concatenate(
+        [values[1:], last_value[None]], axis=0)
+    # v_{t+1} is zero after a terminal inside the fragment.
+    deltas = rho_bar * (
+        rewards + gamma * next_values * nonterminal - values)
+
+    def step(acc, inp):
+        delta, c, nt = inp
+        acc = delta + gamma * nt * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(last_value),
+        (deltas, c_bar, nonterminal), reverse=True)
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho_bar * (rewards + gamma * next_vs * nonterminal - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner:
+    """Params + optimizer; one jitted update over a time-major fragment."""
+
+    def __init__(self, module: ActorCriticModule, params_cfg: ImpalaParams,
+                 seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.cfg = params_cfg
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(params_cfg.max_grad_norm),
+            optax.adam(params_cfg.lr))
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(self._update_impl)
+
+    def _loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        c = self.cfg
+        T, B = batch["actions"].shape
+        obs_flat = batch["obs"].reshape(T * B, -1)
+        logits, values = self.module.forward(params, obs_flat)
+        logits = logits.reshape(T, B, -1)
+        values = values.reshape(T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+
+        vs, pg_adv = vtrace(
+            batch["behaviour_logp"], jax.lax.stop_gradient(logp),
+            batch["rewards"], jax.lax.stop_gradient(values),
+            batch["dones"], batch["last_value"],
+            c.gamma, c.rho_clip, c.c_clip)
+
+        if c.clip_ratio is not None:  # APPO surrogate
+            ratio = jnp.exp(logp - batch["behaviour_logp"])
+            unclipped = ratio * pg_adv
+            clipped = jnp.clip(
+                ratio, 1 - c.clip_ratio, 1 + c.clip_ratio) * pg_adv
+            pi_loss = -jnp.minimum(unclipped, clipped).mean()
+        else:  # IMPALA policy gradient
+            pi_loss = -(logp * pg_adv).mean()
+        vf_loss = jnp.mean((values - vs) ** 2)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        total = pi_loss + c.vf_coef * vf_loss - c.entropy_coef * entropy
+        return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    def _update_impl(self, params, opt_state, batch):
+        import jax
+        import optax
+
+        (_, aux), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, aux
+
+    def update(self, batch) -> Dict[str, float]:
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax
+
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+
+class IMPALA(Algorithm):
+    """In-graph rollouts for jax envs or EnvRunner actors for gym envs;
+    behaviour logp is captured at collection time so the update is
+    off-policy-correct even with stale actors."""
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        import jax
+
+        self.params_cfg = getattr(config, "impala", ImpalaParams())
+        env = make_env(config.env_name)
+        self.env = env
+        spec = env.spec
+        self.module = ActorCriticModule(spec.obs_dim, spec.num_actions,
+                                        config.hidden_sizes)
+        self.learner = ImpalaLearner(self.module, self.params_cfg,
+                                     seed=config.seed)
+        self.key = jax.random.PRNGKey(config.seed + 1)
+        self.iteration = 0
+        self._last_ep_reward = float("nan")
+        self._ep_returns: List[float] = []
+        if isinstance(env, JaxVectorEnv) and config.num_env_runners == 0:
+            self.key, k = jax.random.split(self.key)
+            self.env_state, self.obs = env.reset(
+                k, config.num_envs_per_runner)
+            self._rollout = self._make_rollout(
+                config.rollout_fragment_length)
+            self.runner_group = None
+        else:
+            from ray_tpu.rl.env_runner import EnvRunnerGroup
+
+            self.runner_group = EnvRunnerGroup(
+                config.env_name, max(1, config.num_env_runners),
+                config.num_envs_per_runner,
+                {"obs_dim": spec.obs_dim, "num_actions": spec.num_actions,
+                 "hidden": config.hidden_sizes,
+                 "gamma": self.params_cfg.gamma},
+                seed=config.seed)
+            self.runner_group.sync_weights(self._weights())
+
+    def _weights(self):
+        import jax
+
+        return jax.device_get(self.learner.params)
+
+    def _make_rollout(self, num_steps: int):
+        import jax
+
+        module, env, gamma = self.module, self.env, self.params_cfg.gamma
+
+        def rollout(params, env_state, obs, key):
+            def step(carry, k):
+                env_state, obs = carry
+                ka, ke = jax.random.split(k)
+                action, logp = module.sample_action(params, obs, ka)
+                (env_state, next_obs, reward, terminated, truncated,
+                 final_obs) = env.step(env_state, action, ke)
+                v_final = module.value(params, final_obs)
+                train_reward = reward + gamma * v_final * truncated
+                out = {"obs": obs, "actions": action,
+                       "behaviour_logp": logp, "rewards": train_reward,
+                       "raw_rewards": reward,
+                       "dones": terminated | truncated}
+                return (env_state, next_obs), out
+
+            (env_state, obs), traj = jax.lax.scan(
+                step, (env_state, obs), jax.random.split(key, num_steps))
+            traj["last_value"] = module.value(params, obs)
+            stats = {"reward_per_step": traj.pop("raw_rewards").mean(),
+                     "episodes_done": traj["dones"].sum()}
+            return env_state, obs, traj, stats
+
+        return jax.jit(rollout)
+
+    def train(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.perf_counter()
+        cfg = self.config
+        if self.runner_group is None:
+            self.key, kr = jax.random.split(self.key)
+            self.env_state, self.obs, batch, stats = self._rollout(
+                self.learner.params, self.env_state, self.obs, kr)
+            metrics = self.learner.update(batch)
+            n_steps = int(np.prod(batch["actions"].shape))
+            eps = float(stats["episodes_done"])
+            if eps > 0:
+                self._last_ep_reward = (
+                    float(stats["reward_per_step"]) * n_steps / eps)
+            ep_reward = self._last_ep_reward
+        else:
+            trajs = self.runner_group.sample(cfg.rollout_fragment_length)
+            batch = self._assemble(trajs)
+            metrics = self.learner.update(batch)
+            self.runner_group.sync_weights(self._weights())
+            n_steps = int(np.prod(batch["actions"].shape))
+            self._ep_returns.extend(self.runner_group.episode_stats())
+            recent = self._ep_returns[-50:]
+            ep_reward = float(np.mean(recent)) if recent else float("nan")
+        self.iteration += 1
+        metrics.update({
+            "training_iteration": self.iteration,
+            "env_steps_this_iter": n_steps,
+            "env_steps_per_sec": n_steps / (time.perf_counter() - t0),
+            "episode_reward_mean": ep_reward,
+        })
+        return metrics
+
+    def _assemble(self, trajs: List[Dict[str, np.ndarray]]):
+        # EnvRunner fragments are [T, B]-shaped already; stack over B.
+        batch = {}
+        for key in ("obs", "actions", "rewards", "dones"):
+            batch[key] = np.concatenate([t[key] for t in trajs], axis=1)
+        batch["behaviour_logp"] = np.concatenate(
+            [t["logp_old"] for t in trajs], axis=1)
+        batch["last_value"] = np.concatenate(
+            [t["last_value"] for t in trajs], axis=0)
+        return batch
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        return {"learner": self.learner.get_state(),
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        self.learner.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        if self.runner_group is not None:
+            self.runner_group.sync_weights(self._weights())
+
+    def stop(self):
+        if self.runner_group is not None:
+            self.runner_group.stop()
+
+
+class APPO(IMPALA):
+    """IMPALA with a PPO-style clipped surrogate (reference:
+    ``rllib/algorithms/appo/``)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        if getattr(config, "impala", None) is None or (
+            getattr(config, "impala", ImpalaParams()).clip_ratio is None
+        ):
+            config.impala = dataclasses.replace(
+                getattr(config, "impala", ImpalaParams()), clip_ratio=0.3)
+        super().__init__(config)

@@ -1,0 +1,246 @@
+"""SAC (discrete-action): twin soft Q-networks + entropy-tuned policy.
+
+Reference: ``rllib/algorithms/sac/`` (torch learner, replay-buffer driven).
+Discrete variant (Christodoulou 2019): the categorical policy gives exact
+expectations over actions, so no reparameterization trick is needed — the
+soft targets are ``E_pi[min(Q1,Q2) - alpha*log pi]`` computed in closed
+form.  Acting, the twin-Q/policy/temperature updates, and the polyak
+target sync are each single jitted programs; the replay ring buffer is
+host numpy (same host/device split as ``ray_tpu/rl/dqn.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.dqn import ReplayBuffer
+from ray_tpu.rl.env import JaxVectorEnv, make_env
+from ray_tpu.rl.models import mlp_apply, mlp_init
+
+
+@dataclasses.dataclass(frozen=True)
+class SACParams:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005              # polyak target smoothing
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    update_every: int = 4           # env steps per gradient update
+    target_entropy_scale: float = 0.7  # target H = scale * log(n_actions)
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+class SACConfig:
+    """Builder mirroring AlgorithmConfig's surface for the SAC family."""
+
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.num_envs = 8
+        self.params = SACParams()
+        self.seed = 0
+
+    def environment(self, env: str) -> "SACConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_envs_per_env_runner: int = 8) -> "SACConfig":
+        self.num_envs = num_envs_per_env_runner
+        return self
+
+    def training(self, **kw) -> "SACConfig":
+        self.params = dataclasses.replace(self.params, **kw)
+        return self
+
+    def seed_(self, seed: int) -> "SACConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        p = config.params
+        env = make_env(config.env_name)
+        if not isinstance(env, JaxVectorEnv):
+            raise TypeError("SAC here drives jax envs; wrap gym envs via "
+                            "register_env with a JaxVectorEnv")
+        self.env = env
+        spec = env.spec
+        n_actions = spec.num_actions
+        pi_sizes = [spec.obs_dim, *p.hidden, n_actions]
+        q_sizes = [spec.obs_dim, *p.hidden, n_actions]
+        key = jax.random.PRNGKey(config.seed)
+        kp, k1, k2 = jax.random.split(key, 3)
+        self.params = {
+            "pi": mlp_init(kp, pi_sizes),
+            "q1": mlp_init(k1, q_sizes),
+            "q2": mlp_init(k2, q_sizes),
+            # log temperature, auto-tuned toward the entropy target
+            "log_alpha": jnp.zeros(()),
+        }
+        self.target = {
+            "q1": jax.tree.map(jnp.copy, self.params["q1"]),
+            "q2": jax.tree.map(jnp.copy, self.params["q2"]),
+        }
+        self.tx = optax.adam(p.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.rng = np.random.default_rng(config.seed)
+        self.key = jax.random.PRNGKey(config.seed + 1)
+        self.buffer = ReplayBuffer(p.buffer_size, spec.obs_dim)
+        self.env_state, self.obs = env.reset(
+            jax.random.PRNGKey(config.seed), config.num_envs)
+        self.total_steps = 0
+        self.updates = 0
+        self.iteration = 0
+        self._ep_returns = np.zeros(config.num_envs)
+        self._completed: List[float] = []
+        target_entropy = p.target_entropy_scale * float(np.log(n_actions))
+        n_layers = len(pi_sizes) - 1
+
+        def pi_dist(params, obs):
+            logits = mlp_apply(params["pi"], obs, n_layers)
+            logp = jax.nn.log_softmax(logits)
+            return jnp.exp(logp), logp
+
+        def soft_value(params, target, obs, alpha):
+            """E_pi[min(Q1t,Q2t) - alpha log pi], exact over actions."""
+            probs, logp = pi_dist(params, obs)
+            q1 = mlp_apply(target["q1"], obs, n_layers)
+            q2 = mlp_apply(target["q2"], obs, n_layers)
+            qmin = jnp.minimum(q1, q2)
+            return jnp.sum(probs * (qmin - alpha * logp), axis=-1)
+
+        def update(params, target, opt_state, batch):
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+
+            def loss_fn(ps):
+                # --- twin-Q TD loss against the soft target
+                v_next = soft_value(ps, target, batch["next_obs"], alpha)
+                y = batch["rewards"] + p.gamma * v_next * (
+                    1.0 - batch["terminals"])
+                y = jax.lax.stop_gradient(y)
+                q1 = jnp.take_along_axis(
+                    mlp_apply(ps["q1"], batch["obs"], n_layers),
+                    batch["actions"][:, None], axis=1)[:, 0]
+                q2 = jnp.take_along_axis(
+                    mlp_apply(ps["q2"], batch["obs"], n_layers),
+                    batch["actions"][:, None], axis=1)[:, 0]
+                q_loss = ((q1 - y) ** 2).mean() + ((q2 - y) ** 2).mean()
+                # --- policy loss: maximize soft value under current Qs
+                probs, logp = pi_dist(ps, batch["obs"])
+                q1a = mlp_apply(ps["q1"], batch["obs"], n_layers)
+                q2a = mlp_apply(ps["q2"], batch["obs"], n_layers)
+                qmin = jax.lax.stop_gradient(jnp.minimum(q1a, q2a))
+                pi_loss = jnp.sum(
+                    probs * (alpha * logp - qmin), axis=-1).mean()
+                # --- temperature loss toward the entropy target
+                entropy = -jnp.sum(probs * logp, axis=-1).mean()
+                alpha_loss = ps["log_alpha"] * jax.lax.stop_gradient(
+                    entropy - target_entropy)
+                return q_loss + pi_loss + alpha_loss, {
+                    "q_loss": q_loss, "pi_loss": pi_loss,
+                    "entropy": entropy, "alpha": alpha}
+
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            new_target = jax.tree.map(
+                lambda t, o: (1 - p.tau) * t + p.tau * o,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            return params, new_target, opt_state, aux
+
+        def act(params, obs, key):
+            _, logp = pi_dist(params, obs)
+            return jax.random.categorical(key, logp).astype(jnp.int32)
+
+        self._update = jax.jit(update)
+        self._act = jax.jit(act)
+
+    def train(self, steps_per_iteration: int = 512) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.config.params
+        aux_hist: List[Dict[str, float]] = []
+        n_env = self.config.num_envs
+        for _ in range(steps_per_iteration // n_env):
+            self.key, ka, ke = jax.random.split(self.key, 3)
+            actions = self._act(self.params, self.obs, ka)
+            (self.env_state, next_obs, reward, terminated, truncated,
+             final_obs) = self.env.step(self.env_state, actions, ke)
+            done = np.asarray(terminated | truncated)
+            self.buffer.add_batch(
+                np.asarray(self.obs), np.asarray(actions),
+                np.asarray(reward), np.asarray(final_obs),
+                np.asarray(terminated, np.float32))
+            self._ep_returns += np.asarray(reward)
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self.obs = next_obs
+            self.total_steps += n_env
+            if self.buffer.size >= p.learning_starts:
+                if not hasattr(self, "_update_base"):
+                    self._update_base = self.total_steps // p.update_every
+                due = ((self.total_steps // p.update_every)
+                       - self._update_base - self.updates)
+                for _ in range(max(0, due)):
+                    batch = {k: jnp.asarray(v) for k, v in
+                             self.buffer.sample(p.train_batch_size,
+                                                self.rng).items()}
+                    self.params, self.target, self.opt_state, aux = \
+                        self._update(self.params, self.target,
+                                     self.opt_state, batch)
+                    self.updates += 1
+                    aux_hist.append({k: float(v) for k, v in aux.items()})
+        recent = self._completed[-50:]
+        self.iteration += 1
+        out = {
+            "training_iteration": self.iteration,
+            "total_env_steps": self.total_steps,
+            "num_updates": self.updates,
+            "episode_reward_mean": (float(np.mean(recent)) if recent
+                                    else float("nan")),
+        }
+        if aux_hist:
+            for k in aux_hist[0]:
+                out[k] = float(np.mean([a[k] for a in aux_hist]))
+        return out
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "target": jax.device_get(self.target),
+                "opt_state": jax.device_get(self.opt_state),
+                "total_steps": self.total_steps,
+                "updates": self.updates, "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        import jax
+
+        self.params = jax.device_put(state["params"])
+        self.target = jax.device_put(state["target"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.total_steps = state["total_steps"]
+        self.updates = state["updates"]
+        self.iteration = state["iteration"]
+        p = self.config.params
+        self._update_base = (self.total_steps // p.update_every
+                             - self.updates)
+
+    def stop(self):
+        pass

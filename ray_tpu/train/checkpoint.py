@@ -71,7 +71,14 @@ class Checkpoint:
         orbax_path = os.path.join(self.path, "pytree")
         pkl_path = os.path.join(self.path, "pytree.pkl")
         if os.path.exists(orbax_path):
-            import orbax.checkpoint as ocp
+            try:
+                import orbax.checkpoint as ocp
+            except ImportError as e:
+                raise RuntimeError(
+                    f"checkpoint at {self.path} was saved in orbax format; "
+                    "install orbax-checkpoint (pip install "
+                    "'ray-tpu[jax]') to restore it"
+                ) from e
 
             ckptr = ocp.PyTreeCheckpointer()
             if target is not None:

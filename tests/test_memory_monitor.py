@@ -1,0 +1,97 @@
+"""Memory monitor + OOM worker-killing policies (reference:
+``src/ray/common/memory_monitor.h:52``, ``worker_killing_policy*.h``)."""
+
+import time
+
+from ray_tpu._private.memory_monitor import (
+    MemoryMonitor,
+    pick_victim,
+    process_rss_bytes,
+    system_memory_usage,
+)
+
+
+class FakeWorker:
+    """Mirrors WorkerHandle + the raylet's lease dict shape
+    (``raylet.py`` ``worker.lease = {"owner": ..., "granted_at": ...}``)."""
+
+    def __init__(self, pid, started_at, owner=None, granted_at=None,
+                 dedicated=False):
+        self.pid = pid
+        self.started_at = started_at
+        self.lease = (
+            None if owner is None
+            else {"owner": owner,
+                  "granted_at": granted_at if granted_at is not None
+                  else started_at}
+        )
+        self.dedicated = dedicated
+
+
+def test_system_memory_usage_sane():
+    used, total = system_memory_usage()
+    assert 0 < used <= total
+
+
+def test_process_rss_self():
+    import os
+
+    assert process_rss_bytes(os.getpid()) > 1024 * 1024
+
+
+def test_idle_workers_die_first():
+    idle_old = FakeWorker(1, 10.0)
+    idle_new = FakeWorker(2, 20.0)
+    busy = FakeWorker(3, 5.0, owner="a")
+    assert pick_victim([busy, idle_old, idle_new]) is idle_new
+
+
+def test_retriable_fifo_kills_newest_lease():
+    old = FakeWorker(1, 10.0, owner="a")
+    new = FakeWorker(2, 20.0, owner="b")
+    actor = FakeWorker(3, 30.0, owner="c", dedicated=True)
+    # Newest non-actor lease dies; actors are last resorts.
+    assert pick_victim([old, new, actor], "retriable_fifo") is new
+    assert pick_victim([actor], "retriable_fifo") is actor
+
+
+def test_retriable_fifo_orders_by_lease_grant_not_spawn_time():
+    # Old prestarted worker that JUST got a task vs a young worker whose
+    # task has been running for a while: the just-granted lease dies.
+    old_worker_new_lease = FakeWorker(1, started_at=10.0, owner="a",
+                                      granted_at=100.0)
+    new_worker_old_lease = FakeWorker(2, started_at=50.0, owner="b",
+                                      granted_at=60.0)
+    assert pick_victim(
+        [old_worker_new_lease, new_worker_old_lease], "retriable_fifo"
+    ) is old_worker_new_lease
+
+
+def test_group_by_owner_targets_biggest_group():
+    a1 = FakeWorker(1, 10.0, owner="a")
+    a2 = FakeWorker(2, 20.0, owner="a")
+    b1 = FakeWorker(3, 30.0, owner="b")
+    assert pick_victim([a1, a2, b1], "group_by_owner") is a2
+
+
+def test_group_by_owner_prefers_retriable_over_actor():
+    task = FakeWorker(1, 10.0, owner="a")
+    actor = FakeWorker(2, 20.0, owner="a", dedicated=True)
+    b1 = FakeWorker(3, 30.0, owner="b")
+    assert pick_victim([task, actor, b1], "group_by_owner") is task
+
+
+def test_no_workers_no_victim():
+    assert pick_victim([]) is None
+
+
+def test_monitor_threshold_and_rate_limit():
+    usage = {"v": (50, 100)}
+    mon = MemoryMonitor(usage_fn=lambda: usage["v"], threshold=0.9,
+                        min_kill_interval_s=60.0)
+    w = FakeWorker(1, 10.0, owner="a")
+    assert mon.maybe_pick_victim([w]) is None  # below threshold
+    usage["v"] = (95, 100)
+    assert mon.maybe_pick_victim([w]) is w
+    # Rate limited: second pressure reading doesn't immediately kill again.
+    assert mon.maybe_pick_victim([w]) is None

@@ -175,4 +175,6 @@ class TestHybridMesh:
         mesh = create_hybrid_mesh(
             ici_config=MeshConfig(dp=1, fsdp=2, tp=2, sp=1), num_slices=2
         )
-        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+        assert dict(mesh.shape) == {
+            "dp": 2, "fsdp": 2, "pp": 1, "tp": 2, "sp": 1
+        }

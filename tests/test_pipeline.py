@@ -1,0 +1,114 @@
+"""Pipeline parallelism (mesh "pp" axis): numerics + trainer integration.
+
+The reference has no in-graph PP (delegated to vLLM,
+``vllm_models.py:127``); these tests validate the shard_map/ppermute
+schedule against the plain scan path on the virtual 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel import MeshConfig, create_mesh, pipeline_apply
+
+
+def test_pipeline_apply_matches_scan():
+    n_layers, b, d = 4, 8, 16
+    ws = jax.random.normal(jax.random.PRNGKey(0), (n_layers, d, d)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, d))
+    layer_fn = lambda h, w: jnp.tanh(h @ w)
+
+    def plain(x):
+        for i in range(n_layers):
+            x = layer_fn(x, ws[i])
+        return x
+
+    mesh = create_mesh(MeshConfig(dp=2, pp=4))
+    out = jax.jit(
+        lambda ws, x: pipeline_apply(layer_fn, ws, x, mesh=mesh,
+                                     num_microbatches=4)
+    )(ws, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(plain(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_grad_matches_scan():
+    n_layers, b, d = 4, 4, 8
+    ws = jax.random.normal(jax.random.PRNGKey(0), (n_layers, d, d)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, d))
+    layer_fn = lambda h, w: jnp.tanh(h @ w)
+
+    def loss_plain(ws):
+        h = x
+        for i in range(n_layers):
+            h = layer_fn(h, ws[i])
+        return jnp.sum(h**2)
+
+    mesh = create_mesh(MeshConfig(dp=1, pp=2, tp=2, sp=2))
+    def loss_pp(ws):
+        h = pipeline_apply(layer_fn, ws, x, mesh=mesh, num_microbatches=2)
+        return jnp.sum(h**2)
+
+    g_ref = jax.grad(loss_plain)(ws)
+    g_pp = jax.jit(jax.grad(loss_pp))(ws)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_llama_pp_loss_and_grads_match():
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    cfg = LlamaConfig.tiny(num_layers=2, attention_impl="ref")
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 24), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    ref = llama_loss(params, batch, cfg)
+
+    mesh = create_mesh(MeshConfig(dp=2, fsdp=2, pp=2))
+    pp = jax.jit(lambda p, b: llama_loss(p, b, cfg, mesh=mesh))(params, batch)
+    np.testing.assert_allclose(float(pp), float(ref), rtol=2e-5)
+
+    g_ref = jax.grad(lambda p: llama_loss(p, batch, cfg))(params)
+    g_pp = jax.jit(
+        jax.grad(lambda p: llama_loss(p, batch, cfg, mesh=mesh))
+    )(params)
+    errs = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref, g_pp
+    )
+    assert max(jax.tree.leaves(errs)) < 1e-4
+
+
+def test_trainer_pp_tp_step():
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.models.training import make_llama_trainer
+
+    cfg = LlamaConfig.tiny(num_layers=2, attention_impl="ref")
+    mesh = create_mesh(MeshConfig(dp=2, pp=2, tp=2))
+    tr = make_llama_trainer(cfg, mesh)
+    state = tr.init_state(jax.random.PRNGKey(0))
+    # Stage-sharded layer stack: leading (layers) dim over pp.
+    layer_sh = jax.tree.leaves(state["params"]["layers"])[0].sharding
+    assert layer_sh.spec[0] == "pp"
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size)
+    batch = tr.shard_batch({"tokens": tokens})
+    losses = []
+    for _ in range(4):
+        state, m = tr.step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_validates_divisibility():
+    ws = jnp.zeros((3, 4, 4))
+    x = jnp.zeros((4, 4))
+    mesh = create_mesh(MeshConfig(dp=4, pp=2))
+    with pytest.raises(ValueError):
+        pipeline_apply(lambda h, w: h, ws, x, mesh=mesh)
+    ws2 = jnp.zeros((4, 4, 4))
+    x2 = jnp.zeros((5, 4))
+    with pytest.raises(ValueError):
+        pipeline_apply(lambda h, w: h, ws2, x2, mesh=mesh,
+                       num_microbatches=2)

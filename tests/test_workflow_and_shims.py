@@ -113,6 +113,41 @@ def test_multiprocessing_pool(ray_start):
         pool.map(_square, [1])
 
 
+def test_joblib_backend(ray_start):
+    import math
+
+    import joblib
+    from joblib import Parallel, delayed
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_config(backend="ray_tpu"):
+        out = Parallel(n_jobs=4)(
+            delayed(math.factorial)(i) for i in range(12)
+        )
+    assert out == [math.factorial(i) for i in range(12)]
+    # n_jobs=-1 resolves to the cluster's CPU count.
+    with joblib.parallel_config(backend="ray_tpu"):
+        out2 = Parallel(n_jobs=-1)(
+            delayed(lambda x: x * x)(i) for i in range(8)
+        )
+    assert out2 == [i * i for i in range(8)]
+    # task exceptions propagate instead of hanging Parallel
+    def _boom(i):
+        raise RuntimeError("boom")
+
+    with pytest.raises(Exception, match="boom"):
+        with joblib.parallel_config(backend="ray_tpu"):
+            Parallel(n_jobs=2)(delayed(_boom)(i) for i in range(4))
+    # joblib's negative convention: -2 = all but one CPU
+    from ray_tpu.util.joblib import RayTpuBackend
+
+    be = RayTpuBackend()
+    n_all = be.effective_n_jobs(-1)
+    assert be.effective_n_jobs(-2) == max(1, n_all - 1)
+
+
 def test_metrics_registry(ray_start):
     from ray_tpu.util import metrics
 
