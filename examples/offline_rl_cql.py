@@ -1,0 +1,50 @@
+"""End-to-end: offline RL (CQL) from a Data-tier dataset.
+
+Generates a behavior dataset with a noisy scripted policy, loads it
+through ray_tpu.data, and trains a conservative Q-learner that recovers
+the good policy without ever touching the environment.
+
+Run: python examples/offline_rl_cql.py
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.rl import CQL, CQLParams
+
+
+def make_dataset(n=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(n, 4)).astype(np.float32)
+    best = (obs[:, 0] + obs[:, 2] > 0).astype(np.int32)
+    actions = np.where(rng.random(n) < 0.85, best, 1 - best).astype(np.int32)
+    rewards = (actions == best).astype(np.float32)
+    return [
+        {
+            "obs": obs[i],
+            "actions": int(actions[i]),
+            "rewards": float(rewards[i]),
+            "next_obs": obs[(i + 1) % n],
+            "terminals": 1.0,
+        }
+        for i in range(n)
+    ], obs, best
+
+
+def main():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    rows, obs, best = make_dataset()
+    ds = data.from_items(rows)
+    cql = CQL(obs_dim=4, num_actions=2, params=CQLParams(cql_alpha=1.0))
+    for epoch in range(10):
+        m = cql.train_on(ds, batch_size=512)
+        print(f"epoch {epoch}: td={m['td_loss']:.4f} "
+              f"cql={m['cql_penalty']:.4f}")
+    acc = float((np.asarray(cql.act_greedy(cql.params, obs)) == best).mean())
+    print(f"greedy-policy accuracy vs optimal: {acc:.3f}")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

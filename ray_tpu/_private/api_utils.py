@@ -40,6 +40,14 @@ def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]
 
 def build_resources(options: Dict[str, Any], default_num_cpus: float) -> Dict[str, float]:
     resources: Dict[str, float] = dict(options.get("resources") or {})
+    reserved = {"CPU", "GPU", "TPU", "memory"} & resources.keys()
+    if reserved:
+        # reference: ray_option_utils rejects predefined keys in the custom
+        # resources dict — silently overwriting them hides wrong demands
+        raise ValueError(
+            f"Use num_cpus/num_gpus/num_tpus/memory instead of passing "
+            f"{sorted(reserved)} in resources="
+        )
     num_cpus = options.get("num_cpus")
     resources["CPU"] = float(num_cpus if num_cpus is not None else default_num_cpus)
     if options.get("num_gpus"):

@@ -39,6 +39,9 @@ _FLAG_DEFS: Dict[str, Any] = {
     # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc)
     "scheduler_spread_threshold": 0.5,
     "worker_lease_timeout_s": 30.0,
+    # concurrent leased workers per scheduling key (reference
+    # NormalTaskSubmitter requests one worker per queued task)
+    "max_leases_per_scheduling_key": 32,
     # --- worker pool ---
     "num_prestart_workers": 0,
     "worker_startup_timeout_s": 60.0,

@@ -339,6 +339,18 @@ class Raylet:
             for v in self._node_views():
                 if v.node_id != self.node_id and _hard_ok(v):
                     return {"spillback": self._addr_of(v.node_id)}
+            # The heartbeat-cached cluster view can lag a just-registered
+            # node by one sync period; consult the authoritative GCS node
+            # table before declaring the request permanently infeasible.
+            fresh = await self.gcs.call("get_all_nodes")
+            for n in fresh:
+                if n["node_id"] == self.node_id or not n.get("alive", True):
+                    continue
+                view = NodeView(n["node_id"], n["total"],
+                                n.get("available", n["total"]),
+                                n.get("labels"), True)
+                if _hard_ok(view):
+                    return {"spillback": n["addr"]}
             raise RuntimeError(
                 f"No node can ever satisfy resource request {resources} with "
                 f"strategy={strategy_kind} labels={label_selector}; cluster totals: "

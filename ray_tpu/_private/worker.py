@@ -76,16 +76,34 @@ _exec_ctx: contextvars.ContextVar[Optional[ExecutionContext]] = contextvars.Cont
 
 
 class _Lease:
-    """One leased remote worker for a scheduling key."""
+    """One leased remote worker."""
 
-    __slots__ = ("worker_addr", "worker_id", "client", "queue", "pumping")
+    __slots__ = ("worker_addr", "worker_id", "client", "granting_raylet")
 
     def __init__(self):
         self.worker_addr: Optional[str] = None
         self.worker_id: Optional[bytes] = None
         self.client: Optional[RpcClient] = None
+        # The raylet that granted the lease — after spillback this is NOT
+        # the local raylet, and the lease must be returned to the granter
+        # or its node's resources leak.
+        self.granting_raylet: Optional[RpcClient] = None
+
+
+class _LeasePool:
+    """Leased workers for one scheduling key.
+
+    Grows one lease per queued task (up to a cap) so same-key tasks run
+    concurrently across the cluster — the reference's NormalTaskSubmitter
+    requests a new worker per queued task for the same reason
+    (``normal_task_submitter.cc:86`` RequestNewWorkerIfNeeded).
+    """
+
+    __slots__ = ("queue", "pumps")
+
+    def __init__(self):
         self.queue: deque = deque()
-        self.pumping = False
+        self.pumps = 0
 
 
 class CoreWorker:
@@ -127,7 +145,7 @@ class CoreWorker:
         self.raylet = RpcClient(raylet_addr, "raylet-client")
         self._peer_clients: Dict[str, RpcClient] = {}
 
-        self._leases: Dict[Tuple, _Lease] = {}
+        self._leases: Dict[Tuple, _LeasePool] = {}
         self._task_errors: Dict[TaskID, int] = {}
 
         # execution side
@@ -340,35 +358,67 @@ class CoreWorker:
             self._result_futures[oid] = fut
             refs.append(ObjectRef(oid, self.serve_addr))
         key = spec.scheduling_key()
-        lease = self._leases.get(key)
-        if lease is None:
-            lease = self._leases[key] = _Lease()
-        lease.queue.append(spec)
-        if not lease.pumping:
-            lease.pumping = True
-            asyncio.ensure_future(self._pump_lease(key, lease))
+        pool = self._leases.get(key)
+        if pool is None:
+            pool = self._leases[key] = _LeasePool()
+        pool.queue.append(spec)
+        self._grow_pool(key, pool)
         return refs
 
-    async def _pump_lease(self, key: Tuple, lease: _Lease):
+    def _grow_pool(self, key: Tuple, pool: _LeasePool):
+        # One pump per outstanding spec: live pumps are each dispatching
+        # one spec, so the target is pumps + queued, capped.
+        want = min(pool.pumps + len(pool.queue),
+                   config.max_leases_per_scheduling_key)
+        while pool.pumps < want:
+            pool.pumps += 1
+            asyncio.ensure_future(self._pump_lease(key, pool))
+
+    async def _pump_lease(self, key: Tuple, pool: _LeasePool):
+        lease = _Lease()
+        acquire_failed = False
         try:
-            while lease.queue:
-                spec = lease.queue.popleft()
+            while pool.queue:
+                spec = pool.queue.popleft()
+                if lease.client is None:
+                    try:
+                        await self._acquire_lease(lease, spec)
+                    except Exception as e:  # noqa: BLE001
+                        if pool.pumps > 1:
+                            # Hand the spec back and shrink the pool —
+                            # WITHOUT respawning (the acquire_failed guard
+                            # below), so repeated failures drain to a
+                            # single pump that fails specs for real
+                            # instead of livelocking on lease RPCs.
+                            pool.queue.appendleft(spec)
+                            acquire_failed = True
+                            return
+                        self._fail_task(spec, e)
+                        continue
                 try:
                     await self._dispatch_one(lease, spec)
                 except Exception as e:  # noqa: BLE001
                     self._fail_task(spec, e)
+        finally:
             if lease.client is not None:
                 try:
-                    await self.raylet.call("return_lease", worker_id=lease.worker_id)
+                    await (lease.granting_raylet or self.raylet).call(
+                        "return_lease", worker_id=lease.worker_id)
                 except Exception:
                     pass
                 lease.client = None
                 lease.worker_addr = None
-        finally:
-            lease.pumping = False
-            if lease.queue:
-                lease.pumping = True
-                asyncio.ensure_future(self._pump_lease(key, lease))
+            pool.pumps -= 1
+            if pool.queue:
+                if not acquire_failed:
+                    self._grow_pool(key, pool)
+                elif pool.pumps == 0:
+                    # Several pumps can fail acquire concurrently, each
+                    # seeing pumps > 1 and exiting; the last out leaves one
+                    # pump behind to surface the lease errors on the
+                    # queued specs rather than stranding them.
+                    pool.pumps = 1
+                    asyncio.ensure_future(self._pump_lease(key, pool))
 
     async def _acquire_lease(self, lease: _Lease, spec: TaskSpec):
         raylet = self.raylet
@@ -393,6 +443,7 @@ class CoreWorker:
             lease.worker_addr = reply["worker_addr"]
             lease.worker_id = reply["worker_id"]
             lease.client = self._peer(lease.worker_addr)
+            lease.granting_raylet = raylet
             return
         raise exc.RayTpuError("lease spillback loop exceeded 16 hops")
 

@@ -1,0 +1,134 @@
+"""1F1B pipeline schedule over stage actors.
+
+Reference: the compiled-graph scheduler interleaves overlapped
+compute/comm ops per actor (``python/ray/dag/dag_node_operation.py``); the
+reference's actual 1F1B lives inside vLLM/Megatron, outside Ray.  Here the
+schedule is first-class: ``build_1f1b_schedule`` emits the canonical
+one-forward-one-backward op order per stage (warmup forwards, steady
+alternation, cooldown backwards — peak activation memory is ``S - s``
+microbatches at stage ``s``, not ``M``), and ``PipelineRunner`` drives it
+across stage actors using ObjectRef chaining for the cross-stage data
+dependencies (per-caller actor-call ordering guarantees the intra-stage op
+order).
+
+For in-graph pipeline parallelism over the ``pp`` mesh axis — the TPU fast
+path — see ``ray_tpu/parallel/pipeline.py``; this module is the
+actor-level counterpart for heterogeneous / multi-process stages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+F = "F"
+B = "B"
+Op = Tuple[str, int]  # ("F"|"B", microbatch index)
+
+
+def build_1f1b_schedule(n_stages: int, n_microbatches: int
+                        ) -> List[List[Op]]:
+    """Per-stage op order for the non-interleaved 1F1B schedule.
+
+    Stage ``s`` runs ``min(S-1-s, M)`` warmup forwards, then alternates
+    1F1B for the remainder, then drains with cooldown backwards.
+    """
+    if n_stages < 1 or n_microbatches < 1:
+        raise ValueError("need n_stages >= 1 and n_microbatches >= 1")
+    S, M = n_stages, n_microbatches
+    schedule: List[List[Op]] = []
+    for s in range(S):
+        warmup = min(S - 1 - s, M)
+        ops: List[Op] = [(F, i) for i in range(warmup)]
+        for i in range(M - warmup):
+            ops.append((F, warmup + i))
+            ops.append((B, i))
+        for i in range(M - warmup, M):
+            ops.append((B, i))
+        schedule.append(ops)
+    return schedule
+
+
+def max_inflight(schedule_for_stage: Sequence[Op]) -> int:
+    """Peak number of microbatches forwarded but not yet backwarded —
+    the stage's activation-memory high-water mark."""
+    live = peak = 0
+    for kind, _ in schedule_for_stage:
+        live += 1 if kind == F else -1
+        peak = max(peak, live)
+    return peak
+
+
+@dataclasses.dataclass
+class PipelineResult:
+    outputs: Dict[int, Any]      # microbatch -> last-stage forward output
+    input_grads: Dict[int, Any]  # microbatch -> first-stage backward output
+
+
+class PipelineRunner:
+    """Drives stage actors through the 1F1B schedule.
+
+    Each stage actor must expose ``forward(mb_index, x) -> y`` and
+    ``backward(mb_index, grad) -> input_grad`` remote methods (the last
+    stage's backward receives its own forward output's loss-grad seed as
+    ``grad=None``).  Submission follows the per-stage 1F1B order; actor
+    call ordering serializes ops on each stage while ObjectRef arguments
+    chain the cross-stage dependencies, so overlap across stages happens
+    automatically.
+    """
+
+    def __init__(self, stage_actors: Sequence[Any]):
+        if not stage_actors:
+            raise ValueError("need at least one stage actor")
+        self.stages = list(stage_actors)
+
+    def run(self, microbatches: Sequence[Any], *, backward: bool = True,
+            timeout: Optional[float] = None) -> PipelineResult:
+        import ray_tpu
+
+        S, M = len(self.stages), len(microbatches)
+        schedule = build_1f1b_schedule(S, M)
+        fwd: List[Dict[int, Any]] = [dict() for _ in range(S)]
+        bwd: List[Dict[int, Any]] = [dict() for _ in range(S)]
+        if not backward:
+            # forward-only (inference): plain GPipe fill-drain
+            for s in range(S):
+                for mb in range(M):
+                    x = microbatches[mb] if s == 0 else fwd[s - 1][mb]
+                    fwd[s][mb] = self.stages[s].forward.remote(mb, x)
+            outs = ray_tpu.get(list(fwd[-1].values()), timeout=timeout)
+            return PipelineResult(dict(zip(fwd[-1].keys(), outs)), {})
+
+        # Submit in dependency-driven rounds: an op is submittable once the
+        # upstream ref it consumes exists (F needs stage s-1's F; B needs
+        # stage s+1's B).  Per-stage submission still follows the schedule
+        # order, which actor call ordering turns into execution order.
+        idx = [0] * S
+        remaining = sum(len(ops) for ops in schedule)
+        while remaining:
+            progress = False
+            for s in range(S):
+                while idx[s] < len(schedule[s]):
+                    kind, mb = schedule[s][idx[s]]
+                    if kind == F:
+                        if s > 0 and mb not in fwd[s - 1]:
+                            break
+                        x = microbatches[mb] if s == 0 else fwd[s - 1][mb]
+                        fwd[s][mb] = self.stages[s].forward.remote(mb, x)
+                    else:
+                        if s < S - 1 and mb not in bwd[s + 1]:
+                            break
+                        g = None if s == S - 1 else bwd[s + 1][mb]
+                        bwd[s][mb] = self.stages[s].backward.remote(mb, g)
+                    idx[s] += 1
+                    remaining -= 1
+                    progress = True
+            if not progress:
+                raise RuntimeError("1F1B schedule deadlocked; invalid "
+                                   "schedule or stage count")
+        outs = ray_tpu.get(list(fwd[-1].values()), timeout=timeout)
+        grads = ray_tpu.get(list(bwd[0].values()), timeout=timeout)
+        return PipelineResult(
+            dict(zip(fwd[-1].keys(), outs)),
+            dict(zip(bwd[0].keys(), grads)),
+        )

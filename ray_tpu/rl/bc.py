@@ -86,7 +86,9 @@ class MARWIL:
 
     def _to_batch(self, rows) -> Dict[str, np.ndarray]:
         if isinstance(rows, dict):
-            batch = rows
+            from ray_tpu.rl.cql import _densify
+
+            batch = {k: _densify(v) for k, v in rows.items()}
         else:
             batch = {
                 "obs": np.stack([np.asarray(r["obs"], np.float32)

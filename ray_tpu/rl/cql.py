@@ -1,0 +1,165 @@
+"""CQL: conservative Q-learning for offline RL (discrete actions).
+
+Reference: ``rllib/algorithms/cql/`` (SAC-based learner with the
+conservative regularizer).  The CQL(H) penalty for discrete actions is
+exact: ``E_s[logsumexp_a Q(s,a) - Q(s, a_data)]`` pushes down Q on
+out-of-distribution actions and up on dataset actions, so the greedy
+policy stays inside the data's support.  Built on the same twin-Q +
+double-DQN-style target as ``ray_tpu/rl/dqn.py`` but trained purely from
+a fixed batch (no environment interaction) — one jitted update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.models import mlp_apply, mlp_init
+
+
+def _densify(col) -> np.ndarray:
+    """Data-tier batches hand array-valued columns back as object arrays
+    of per-row ndarrays; stack them into one dense array for jax."""
+    arr = np.asarray(col)
+    if arr.dtype == object:
+        arr = np.stack([np.asarray(x) for x in col])
+    return arr
+
+
+@dataclasses.dataclass(frozen=True)
+class CQLParams:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005            # polyak target smoothing
+    cql_alpha: float = 1.0        # conservative-penalty weight
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+class CQL:
+    """Offline Q-learning over {obs, actions, rewards, next_obs, terminals}
+    batches (a ray_tpu.data.Dataset of rows or a column dict)."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 params: Optional[CQLParams] = None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.p = params or CQLParams()
+        p = self.p
+        sizes = [obs_dim, *p.hidden, num_actions]
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        self.params = {"q1": mlp_init(k1, sizes), "q2": mlp_init(k2, sizes)}
+        self.target = jax.tree.map(jnp.copy, self.params)
+        self.tx = optax.adam(p.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        n_layers = len(sizes) - 1
+
+        def update(params, target, opt_state, batch):
+            def loss_fn(ps):
+                q1 = mlp_apply(ps["q1"], batch["obs"], n_layers)
+                q2 = mlp_apply(ps["q2"], batch["obs"], n_layers)
+                a = batch["actions"][:, None]
+                q1_sel = jnp.take_along_axis(q1, a, axis=1)[:, 0]
+                q2_sel = jnp.take_along_axis(q2, a, axis=1)[:, 0]
+                # double-Q target: online argmax, min of targets evaluates
+                next_q1 = mlp_apply(ps["q1"], batch["next_obs"], n_layers)
+                next_a = jnp.argmax(next_q1, axis=1)[:, None]
+                t1 = jnp.take_along_axis(
+                    mlp_apply(target["q1"], batch["next_obs"], n_layers),
+                    next_a, axis=1)[:, 0]
+                t2 = jnp.take_along_axis(
+                    mlp_apply(target["q2"], batch["next_obs"], n_layers),
+                    next_a, axis=1)[:, 0]
+                y = batch["rewards"] + p.gamma * jnp.minimum(t1, t2) * (
+                    1.0 - batch["terminals"])
+                y = jax.lax.stop_gradient(y)
+                td = ((q1_sel - y) ** 2).mean() + ((q2_sel - y) ** 2).mean()
+                # CQL(H) conservative penalty, exact for discrete actions
+                cql = (
+                    (jax.nn.logsumexp(q1, axis=1) - q1_sel).mean()
+                    + (jax.nn.logsumexp(q2, axis=1) - q2_sel).mean()
+                )
+                total = td + p.cql_alpha * cql
+                return total, {"td_loss": td, "cql_penalty": cql}
+
+            (_, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            new_target = jax.tree.map(
+                lambda t, o: (1 - p.tau) * t + p.tau * o, target, params)
+            return params, new_target, opt_state, aux
+
+        def act_greedy(params, obs):
+            q = mlp_apply(params["q1"], obs, n_layers)
+            return jnp.argmax(q, axis=1).astype(jnp.int32)
+
+        self._update = jax.jit(update)
+        self.act_greedy = jax.jit(act_greedy)
+
+    def train_on(self, data, *, batch_size: int = 256,
+                 epochs: int = 1) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        metrics: Dict[str, float] = {}
+        n_batches = 0
+        for _ in range(epochs):
+            for batch in self._iter_batches(data, batch_size):
+                jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.target, self.opt_state, aux = self._update(
+                    self.params, self.target, self.opt_state, jb)
+                n_batches += 1
+                for k, v in aux.items():
+                    metrics[k] = metrics.get(k, 0.0) + float(v)
+        self.iteration += 1
+        out = {k: v / max(n_batches, 1) for k, v in metrics.items()}
+        out["training_iteration"] = self.iteration
+        return out
+
+    REQUIRED = ("obs", "actions", "rewards", "next_obs", "terminals")
+
+    def _iter_batches(self, data, batch_size: int):
+        if hasattr(data, "iter_batches"):  # ray_tpu.data.Dataset
+            for b in data.iter_batches(batch_size=batch_size):
+                yield self._check(b)
+            return
+        if isinstance(data, dict):
+            self._check(data)
+            n = len(data["actions"])
+            for i in range(0, n, batch_size):
+                yield self._check({k: np.asarray(v)[i:i + batch_size]
+                                   for k, v in data.items()})
+            return
+        rows = list(data)
+        for i in range(0, len(rows), batch_size):
+            chunk = rows[i:i + batch_size]
+            yield self._check({
+                k: np.stack([np.asarray(r[k]) for r in chunk])
+                for k in self.REQUIRED})
+
+    def _check(self, batch):
+        missing = [k for k in self.REQUIRED if k not in batch]
+        if missing:
+            raise ValueError(f"CQL batch missing columns {missing}; "
+                             f"needs {self.REQUIRED}")
+        return {k: _densify(v) for k, v in batch.items()}
+
+    def save_checkpoint(self) -> Dict[str, Any]:
+        import jax
+
+        return {"params": jax.device_get(self.params),
+                "target": jax.device_get(self.target),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        import jax
+
+        self.params = jax.device_put(state["params"])
+        self.target = jax.device_put(state["target"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.iteration = state["iteration"]

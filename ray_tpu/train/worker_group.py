@@ -190,12 +190,27 @@ class WorkerGroup:
                 f"(bundles={bundles})")
 
         worker_cls = ray_tpu.remote(TrainWorker)
+        # Predefined resources go through their dedicated options; only
+        # custom keys ride the resources= dict (api_utils rejects CPU/TPU
+        # there, mirroring the reference's option validation).
+        opts: Dict[str, Any] = {
+            "num_cpus": res.get("CPU", 0.0),
+        }
+        if res.get("GPU"):
+            opts["num_gpus"] = res["GPU"]
+        if res.get("TPU"):
+            opts["num_tpus"] = res["TPU"]
+        if res.get("memory"):
+            opts["memory"] = res["memory"]
+        custom = {k: v for k, v in res.items()
+                  if k not in ("CPU", "GPU", "TPU", "memory")}
+        if custom:
+            opts["resources"] = custom
         self.workers = [
             worker_cls.options(
-                num_cpus=0,
-                resources={k: v for k, v in res.items()},
                 scheduling_strategy=PlacementGroupSchedulingStrategy(
                     placement_group=self.pg, placement_group_bundle_index=i),
+                **opts,
             ).remote()
             for i in range(sc.num_workers)
         ]

@@ -12,6 +12,7 @@ from ray_tpu.tune.search import (
     BasicVariantGenerator,
     HyperbandImprovementSearcher,
     Searcher,
+    TPESearcher,
     choice,
     generate_variants,
     grid_search,
@@ -35,7 +36,8 @@ __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
     "FIFOScheduler", "FunctionTrainable", "HyperbandImprovementSearcher",
     "MedianStoppingRule", "PopulationBasedTraining", "Result", "ResultGrid",
-    "Searcher", "Trainable", "TrialScheduler", "TuneConfig", "TuneController",
+    "Searcher", "TPESearcher", "Trainable", "TrialScheduler", "TuneConfig",
+    "TuneController",
     "Tuner", "choice", "generate_variants", "get_checkpoint", "grid_search",
     "loguniform", "quniform", "randint", "report", "run", "sample_from",
     "uniform",

@@ -249,3 +249,187 @@ class HyperbandImprovementSearcher(Searcher):
         if result and self.metric in result and not error:
             self._observed.append(
                 (result[self.metric], self._trial_cfg.get(trial_id, {})))
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (Bergstra et al. 2011), numpy-only.
+
+    Fills the role of the reference's external searcher integrations
+    (``tune/search/``: Optuna/HyperOpt/BOHB — none of which are in this
+    image) with a native implementation.  Completed trials are split into
+    a good quantile and the rest; per-dimension Parzen (kernel-density)
+    models l(x) over the good and g(x) over the bad points score candidate
+    draws, and the candidate maximizing l/g is suggested.  Numeric domains
+    model in the (log-)transformed space; Choice/GridSearch use smoothed
+    categorical counts.  Falls back to random sampling until
+    ``min_observations`` trials complete.
+    """
+
+    def __init__(self, space: Dict[str, Any], num_samples: int,
+                 seed: Optional[int] = None, gamma: float = 0.25,
+                 n_candidates: int = 24, min_observations: int = 8, **kw):
+        super().__init__(**kw)
+        self._space = space
+        self._num = num_samples
+        self._rng = random.Random(seed)
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._min_obs = min_observations
+        self._suggested = 0
+        self._trial_cfg: Dict[str, Dict[str, Any]] = {}
+        self._observed: List[Tuple[float, Dict[str, Any]]] = []
+        # searchable leaves: (path, domain)
+        self._leaves = [(p, v) for p, v in _walk(space)
+                        if isinstance(v, (Domain, GridSearch))
+                        and not isinstance(v, SampleFrom)]
+
+    def total(self) -> int:
+        return self._num
+
+    # --- domain transforms -------------------------------------------------
+    @staticmethod
+    def _to_unit(domain, value: float) -> Optional[float]:
+        import math as _m
+
+        if isinstance(domain, LogUniform):
+            # LogUniform stores lo/hi already in log space
+            return (_m.log(value) - domain.lo) / (domain.hi - domain.lo)
+        if isinstance(domain, (Uniform, QUniform)):
+            return (value - domain.low) / (domain.high - domain.low)
+        if isinstance(domain, RandInt):
+            return (value - domain.low) / max(domain.high - domain.low, 1)
+        return None  # categorical
+
+    @staticmethod
+    def _from_unit(domain, u: float) -> Any:
+        import math as _m
+
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(domain, LogUniform):
+            return _m.exp(domain.lo + u * (domain.hi - domain.lo))
+        if isinstance(domain, QUniform):
+            raw = domain.low + u * (domain.high - domain.low)
+            return round(raw / domain.q) * domain.q
+        if isinstance(domain, Uniform):
+            return domain.low + u * (domain.high - domain.low)
+        if isinstance(domain, RandInt):
+            span = max(domain.high - domain.low, 1)
+            return min(domain.low + int(u * span), domain.high - 1)
+        raise TypeError(domain)
+
+    # --- TPE core ----------------------------------------------------------
+    def _split(self):
+        sign = 1.0 if self.mode == "max" else -1.0
+        ranked = sorted(self._observed, key=lambda t: -sign * t[0])
+        n_good = max(1, int(self._gamma * len(ranked)))
+        return ranked[:n_good], ranked[n_good:]
+
+    # Weight of the uniform-prior pseudo-component mixed into each Parzen
+    # model (hyperopt's adaptive-Parzen trick): keeps l(x) > 0 everywhere
+    # so unexplored regions stay reachable, and keeps g(x) > 0 so the
+    # ratio never blows up.
+    PRIOR_WEIGHT = 1.0
+
+    @classmethod
+    def _kde_logpdf(cls, points: List[float], x: float) -> float:
+        import math as _m
+
+        w = cls.PRIOR_WEIGHT
+        n = len(points)
+        if n == 0:
+            return 0.0  # pure uniform prior on [0, 1]
+        # Silverman-flavored bandwidth on the unit interval, floored so a
+        # tight cluster still explores its neighborhood.
+        mean = sum(points) / n
+        var = sum((p - mean) ** 2 for p in points) / max(n - 1, 1)
+        sigma = max(1.06 * _m.sqrt(var) * n ** (-0.2), 0.05)
+        acc = 0.0
+        for p in points:
+            acc += _m.exp(-0.5 * ((x - p) / sigma) ** 2) / (
+                sigma * _m.sqrt(2 * _m.pi))
+        return _m.log(max((acc + w) / (n + w), 1e-300))
+
+    def _suggest_leaf(self, domain, good_vals, bad_vals):
+        cats = (domain.values if isinstance(domain, GridSearch)
+                else domain.categories if isinstance(domain, Choice)
+                else None)
+        if cats is not None:
+            import math as _m
+
+            k = len(cats)
+
+            def probs(vals):
+                counts = [1.0] * k  # +1 smoothing
+                for v in vals:
+                    if v in cats:
+                        counts[cats.index(v)] += 1
+                tot = sum(counts)
+                return [c / tot for c in counts]
+
+            pg, pb = probs(good_vals), probs(bad_vals)
+            # epsilon-greedy escape hatch: score-based selection alone can
+            # lock in an early categorical winner forever, since a category
+            # that never runs can never enter the good set
+            if self._rng.random() < 0.1:
+                return self._rng.choice(cats)
+            scores = [pg[i] / pb[i] for i in range(k)]
+            # candidates from a pg/uniform mixture: pure-pg draws collapse
+            # onto an early winner and never re-test other categories
+            weights = [0.75 * p + 0.25 / k for p in pg]
+            draws = self._rng.choices(range(k), weights=weights,
+                                      k=self._n_candidates)
+            best = max(draws, key=lambda i: scores[i])
+            return cats[best]
+
+        g = [u for u in (self._to_unit(domain, v) for v in good_vals)
+             if u is not None]
+        b = [u for u in (self._to_unit(domain, v) for v in bad_vals)
+             if u is not None]
+        best_u, best_score = None, None
+        # Draw candidates from l(x) itself — including its uniform-prior
+        # component, which is what keeps exploring — and keep the best
+        # l/g ratio (the TPE acquisition).
+        p_prior = self.PRIOR_WEIGHT / (len(g) + self.PRIOR_WEIGHT)
+        for _ in range(self._n_candidates):
+            if g and self._rng.random() >= p_prior:
+                center = self._rng.choice(g)
+                u = min(max(self._rng.gauss(center, 0.15), 0.0), 1.0)
+            else:
+                u = self._rng.random()
+            score = self._kde_logpdf(g, u) - self._kde_logpdf(b, u)
+            if best_score is None or score > best_score:
+                best_u, best_score = u, score
+        return self._from_unit(domain, best_u)
+
+    # --- Searcher API ------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self._num:
+            return None
+        self._suggested += 1
+        cfg = generate_variants(self._space, 1,
+                                self._rng.randrange(1 << 30))[0]
+        if len(self._observed) >= self._min_obs:
+            good, bad = self._split()
+
+            def leaf_vals(trials, path):
+                out = []
+                for _, c in trials:
+                    d = c
+                    try:
+                        for k in path:
+                            d = d[k]
+                        out.append(d)
+                    except (KeyError, TypeError):
+                        pass
+                return out
+
+            for path, domain in self._leaves:
+                _set_path(cfg, path, self._suggest_leaf(
+                    domain, leaf_vals(good, path), leaf_vals(bad, path)))
+        self._trial_cfg[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if result and self.metric in result and not error:
+            self._observed.append(
+                (result[self.metric], self._trial_cfg.get(trial_id, {})))

@@ -148,3 +148,47 @@ def test_runtime_context(ray_start):
 
     tid, wid = ray_tpu.get(whoami.remote())
     assert tid and wid
+
+
+def test_infeasible_tasks_fail_promptly(ray_start):
+    """Same-key tasks whose demand exceeds cluster totals must error out,
+    not hang or livelock the lease pool."""
+    import time
+
+    @ray_tpu.remote(num_cpus=9999)
+    def impossible():
+        return 1
+
+    # passing predefined keys through resources= is rejected outright
+    with pytest.raises(ValueError, match="num_cpus"):
+        ray_tpu.remote(resources={"CPU": 2.0})(lambda: 1).remote()
+
+    refs = [impossible.remote() for _ in range(4)]
+    t0 = time.time()
+    for r in refs:
+        with pytest.raises(Exception):
+            ray_tpu.get(r, timeout=60)
+    assert time.time() - t0 < 60
+
+
+def test_same_key_tasks_run_concurrently(ray_start):
+    """Tasks sharing a scheduling key lease one worker each (reference
+    NormalTaskSubmitter pipelining), including when submitted while an
+    earlier task is already running."""
+    import time
+
+    @ray_tpu.remote
+    def nap(s):
+        time.sleep(s)
+        return s
+
+    # warm the worker pool so spawn latency doesn't dominate timing
+    ray_tpu.get([nap.remote(0.01) for _ in range(4)], timeout=60)
+
+    t0 = time.time()
+    first = nap.remote(2.0)
+    time.sleep(0.3)  # staggered submission: queue empty, pump busy
+    rest = [nap.remote(2.0) for _ in range(3)]
+    ray_tpu.get([first] + rest, timeout=60)
+    wall = time.time() - t0
+    assert wall < 5.0, f"same-key tasks serialized: wall={wall:.1f}s"

@@ -256,3 +256,28 @@ def test_marwil_requires_returns_and_trains():
     m = mw.train_on({"obs": obs, "actions": acts, "returns": rets},
                     epochs=2)
     assert np.isfinite(m["pi_loss"])
+
+
+def test_cql_offline_learns_greedy_policy():
+    from ray_tpu.rl import CQL, CQLParams
+
+    rng = np.random.default_rng(0)
+    N = 2048
+    obs = rng.normal(size=(N, 4)).astype(np.float32)
+    good = (obs[:, 0] > 0).astype(np.int32)
+    actions = np.where(rng.random(N) < 0.9, good, 1 - good).astype(np.int32)
+    rewards = (actions == good).astype(np.float32)
+    data = {
+        "obs": obs, "actions": actions, "rewards": rewards,
+        "next_obs": rng.normal(size=(N, 4)).astype(np.float32),
+        "terminals": np.ones((N,), np.float32),
+    }
+    cql = CQL(4, 2, CQLParams(cql_alpha=1.0), seed=0)
+    for _ in range(15):
+        m = cql.train_on(data, batch_size=512)
+    pred = np.asarray(cql.act_greedy(cql.params, obs))
+    assert (pred == good).mean() > 0.9
+    # conservative penalty is being paid (Q on OOD actions pushed down)
+    assert m["cql_penalty"] < 3.0
+    with pytest.raises(ValueError, match="missing"):
+        cql.train_on({"obs": obs, "actions": actions})

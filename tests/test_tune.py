@@ -182,3 +182,80 @@ def test_error_without_retry_budget(ray_start):
 
     grid = tune.run(Dies, config={}, metric="loss", mode="min", num_samples=1)
     assert len(grid) == 1 and grid[0].error is not None
+
+
+class TestTPESearcher:
+    def test_converges_on_1d_quadratic(self):
+        from ray_tpu.tune.search import TPESearcher
+
+        space = {"x": tune.uniform(-5.0, 5.0)}
+        tpe = TPESearcher(space, num_samples=60, seed=4, metric="score",
+                          mode="max")
+        best = -1e9
+        for i in range(60):
+            cfg = tpe.suggest(f"t{i}")
+            s = -(cfg["x"] - 2.0) ** 2
+            best = max(best, s)
+            tpe.on_trial_complete(f"t{i}", {"score": s})
+        assert best > -0.05, best
+
+    def test_converges_in_log_space(self):
+        import math
+
+        from ray_tpu.tune.search import TPESearcher
+
+        space = {"lr": tune.loguniform(1e-6, 1.0)}
+        tpe = TPESearcher(space, num_samples=60, seed=0, metric="score",
+                          mode="max")
+        best_lr, best = None, -1e9
+        for i in range(60):
+            cfg = tpe.suggest(f"t{i}")
+            s = -abs(math.log10(cfg["lr"]) + 3.0)  # optimum 1e-3
+            if s > best:
+                best, best_lr = s, cfg["lr"]
+            tpe.on_trial_complete(f"t{i}", {"score": s})
+        assert 1e-4 < best_lr < 1e-2, best_lr
+
+    def test_categorical_concentrates_on_winner(self):
+        from ray_tpu.tune.search import TPESearcher
+
+        space = {"opt": tune.choice(["sgd", "adam", "rmsprop"])}
+        tpe = TPESearcher(space, num_samples=60, seed=1, metric="score",
+                          mode="max")
+        late = []
+        for i in range(60):
+            cfg = tpe.suggest(f"t{i}")
+            s = {"sgd": 0.0, "adam": 5.0, "rmsprop": 1.0}[cfg["opt"]]
+            if i >= 40:
+                late.append(cfg["opt"])
+            tpe.on_trial_complete(f"t{i}", {"score": s})
+        assert late.count("adam") > len(late) * 0.5, late
+
+    def test_min_mode_and_exhaustion(self):
+        from ray_tpu.tune.search import TPESearcher
+
+        space = {"x": tune.uniform(0.0, 10.0)}
+        tpe = TPESearcher(space, num_samples=5, seed=0, metric="loss",
+                          mode="min")
+        for i in range(5):
+            cfg = tpe.suggest(f"t{i}")
+            tpe.on_trial_complete(f"t{i}", {"loss": cfg["x"]})
+        assert tpe.suggest("t5") is None
+
+    def test_tuner_integration(self, ray_start):
+        from ray_tpu.tune.search import TPESearcher
+
+        def train_fn(config):
+            tune.report({"loss": (config["x"] - 1.0) ** 2})
+
+        space = {"x": tune.uniform(-4.0, 4.0)}
+        grid = Tuner(
+            train_fn,
+            param_space=space,
+            tune_config=TuneConfig(
+                metric="loss", mode="min", num_samples=20,
+                search_alg=TPESearcher(space, num_samples=20, seed=0),
+            ),
+        ).fit()
+        assert len(grid) == 20
+        assert grid.get_best_result().metrics["loss"] < 1.0
