@@ -237,12 +237,17 @@ def llama_apply(
             policy = jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "flash_out", "flash_lse"
             )
+        elif cfg.remat_policy == "save_dots":
+            # Save every matmul output (highest memory of the remat
+            # policies, least recompute): the backward replays only the
+            # cheap elementwise ops.
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         elif cfg.remat_policy == "full":
             policy = jax.checkpoint_policies.nothing_saveable
         else:
             raise ValueError(
-                f"remat_policy must be 'full' or 'save_attn', got "
-                f"{cfg.remat_policy!r}"
+                f"remat_policy must be 'full', 'save_attn' or 'save_dots', "
+                f"got {cfg.remat_policy!r}"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
     from ray_tpu.parallel.pipeline import pipeline_microbatches, pp_size

@@ -271,3 +271,28 @@ class TestViT:
             state, m = tr.step(state, batch)
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0], losses
+
+
+def test_remat_policies_same_loss():
+    """All remat policies compute identical losses (they only trade
+    recompute for memory)."""
+    import jax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, 256)
+    batch = {"tokens": tokens}
+    losses = []
+    for policy in ("full", "save_attn", "save_dots"):
+        cfg = LlamaConfig.tiny(remat_policy=policy)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        loss, grads = jax.value_and_grad(
+            lambda p: llama_loss(p, batch, cfg))(params)
+        losses.append(float(loss))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-6)
+    assert losses[0] == pytest.approx(losses[2], rel=1e-6)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        cfg = LlamaConfig.tiny(remat_policy="bogus")
+        llama_loss(llama_init(jax.random.PRNGKey(0), cfg),
+                   batch, cfg)
