@@ -8,6 +8,7 @@ from ray_tpu.rl.algorithm import PPO, Algorithm, AlgorithmConfig
 from ray_tpu.rl.bc import BC, MARWIL, MARWILParams
 from ray_tpu.rl.cql import CQL, CQLParams
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNParams, ReplayBuffer
+from ray_tpu.rl.dreamer import DreamerParams, DreamerV3
 from ray_tpu.rl.impala import APPO, IMPALA, ImpalaLearner, ImpalaParams, vtrace
 from ray_tpu.rl.sac import SAC, SACConfig, SACParams
 from ray_tpu.rl.env import (
@@ -23,7 +24,8 @@ from ray_tpu.rl.models import ActorCriticModule
 from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
 
 __all__ = [
-    "APPO", "BC", "CQL", "CQLParams", "DQN", "DQNConfig", "DQNParams", "IMPALA",
+    "APPO", "BC", "CQL", "CQLParams", "DQN", "DQNConfig", "DQNParams",
+    "DreamerParams", "DreamerV3", "IMPALA",
     "ImpalaLearner", "ImpalaParams", "MARWIL", "MARWILParams",
     "ReplayBuffer", "PPO", "SAC", "SACConfig", "SACParams",
     "Algorithm", "AlgorithmConfig", "ActorCriticModule",

@@ -1,0 +1,531 @@
+"""DreamerV3: model-based RL — RSSM world model + imagination actor-critic.
+
+Reference: ``rllib/algorithms/dreamerv3/`` (the reference's torch/tf
+implementation of Hafner et al. 2023).  Compact jax-native version for
+vector observations and discrete actions, keeping the v3 signature
+pieces:
+
+- RSSM with discrete latents (categorical codes), GRU deterministic path;
+- symlog squashing for observation/reward targets, two-hot distributional
+  reward/value heads;
+- KL balancing with free bits (beta_dyn/beta_rep);
+- imagination rollouts from replayed posterior states; lambda-return
+  critic with an EMA regularizer target; REINFORCE actor with
+  percentile-normalized returns and entropy bonus.
+
+World-model learning, imagination, and the actor/critic updates each run
+as one jitted program; the sequence replay buffer is host numpy (same
+host/device split as dqn.py/sac.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.env import JaxVectorEnv, make_env
+from ray_tpu.rl.models import mlp_apply, mlp_init
+
+
+@dataclasses.dataclass(frozen=True)
+class DreamerParams:
+    lr: float = 3e-4
+    actor_lr: float = 1e-4
+    critic_lr: float = 1e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    horizon: int = 12           # imagination length
+    deter_dim: int = 128        # GRU state
+    codes: int = 8              # number of categorical latents
+    classes: int = 8            # classes per latent
+    hidden: Tuple[int, ...] = (128,)
+    bins: int = 41              # two-hot buckets over symlog space
+    beta_pred: float = 1.0
+    beta_dyn: float = 0.5
+    beta_rep: float = 0.1
+    free_bits: float = 1.0
+    entropy_coef: float = 3e-3
+    critic_ema: float = 0.98
+    batch_size: int = 16
+    batch_length: int = 16
+    buffer_size: int = 1024     # sequences (episode chunks)
+    train_ratio: int = 2        # WM/AC updates per collected sequence-chunk
+
+
+def symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def bucket_edges(bins):
+    """The shared symlog-space bucket grid for all two-hot heads — encode
+    (twohot) and decode (expected value) must use the same edges."""
+    import jax.numpy as jnp
+
+    return jnp.linspace(-20.0, 20.0, bins)
+
+
+def twohot(x, bins):
+    """Two-hot encode scalar x over `bins` symmetric symlog buckets."""
+    import jax.numpy as jnp
+
+    edges = bucket_edges(bins)
+    x = jnp.clip(x, edges[0], edges[-1])
+    idx = jnp.clip(jnp.searchsorted(edges, x) - 1, 0, bins - 2)
+    left, right = edges[idx], edges[idx + 1]
+    w_right = (x - left) / (right - left)
+    return (
+        jax_one_hot(idx, bins) * (1.0 - w_right)[..., None]
+        + jax_one_hot(idx + 1, bins) * w_right[..., None]
+    )
+
+
+def jax_one_hot(idx, n):
+    import jax
+
+    return jax.nn.one_hot(idx, n)
+
+
+class DreamerV3:
+    """Single-process learner+collector (vector obs, discrete actions)."""
+
+    def __init__(self, env_name: str, params: Optional[DreamerParams] = None,
+                 num_envs: int = 8, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.p = p = params or DreamerParams()
+        env = make_env(env_name)
+        if not isinstance(env, JaxVectorEnv):
+            raise TypeError("DreamerV3 here drives jax envs")
+        self.env = env
+        spec = env.spec
+        self.obs_dim, self.n_actions = spec.obs_dim, spec.num_actions
+        self.num_envs = num_envs
+        Z = p.codes * p.classes
+        feat_dim = p.deter_dim + Z
+        H = list(p.hidden)
+        key = jax.random.PRNGKey(seed)
+        ks = jax.random.split(key, 12)
+
+        def linear_init(k, din, dout):
+            return {"w": jax.random.normal(k, (din, dout)) *
+                    np.sqrt(1.0 / din), "b": jnp.zeros((dout,))}
+
+        self.wm = {
+            "enc": mlp_init(ks[0], [self.obs_dim, *H, H[-1]]),
+            # GRU over [z, a] with deterministic state h
+            "gru_x": linear_init(ks[1], Z + self.n_actions, 3 * p.deter_dim),
+            "gru_h": linear_init(ks[2], p.deter_dim, 3 * p.deter_dim),
+            "prior": mlp_init(ks[3], [p.deter_dim, *H, Z]),
+            "post": mlp_init(ks[4], [p.deter_dim + H[-1], *H, Z]),
+            "dec": mlp_init(ks[5], [feat_dim, *H, self.obs_dim]),
+            "rew": mlp_init(ks[6], [feat_dim, *H, p.bins]),
+            "cont": mlp_init(ks[7], [feat_dim, *H, 1]),
+        }
+        self.actor = mlp_init(ks[8], [feat_dim, *H, self.n_actions])
+        self.critic = mlp_init(ks[9], [feat_dim, *H, p.bins])
+        self.critic_ema = jax.tree.map(jnp.copy, self.critic)
+
+        self.wm_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                 optax.adam(p.lr))
+        self.actor_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                    optax.adam(p.actor_lr))
+        self.critic_tx = optax.chain(optax.clip_by_global_norm(100.0),
+                                     optax.adam(p.critic_lr))
+        self.wm_opt = self.wm_tx.init(self.wm)
+        self.actor_opt = self.actor_tx.init(self.actor)
+        self.critic_opt = self.critic_tx.init(self.critic)
+
+        # sequence replay: ring of [T, ...] chunks
+        T = p.batch_length
+        self.buf_obs = np.zeros((p.buffer_size, T, self.obs_dim), np.float32)
+        self.buf_act = np.zeros((p.buffer_size, T), np.int32)
+        self.buf_rew = np.zeros((p.buffer_size, T), np.float32)
+        self.buf_cont = np.zeros((p.buffer_size, T), np.float32)
+        self.buf_first = np.zeros((p.buffer_size, T), np.float32)
+        self.buf_pos = 0
+        self.buf_size = 0
+        self.rng = np.random.default_rng(seed)
+        self.key = jax.random.PRNGKey(seed + 1)
+        self.env_state, self.obs = env.reset(jax.random.PRNGKey(seed),
+                                             num_envs)
+        # per-env rolling chunk under construction
+        self._chunk = {"obs": [], "act": [], "rew": [], "cont": [],
+                       "first": []}
+        self._was_done = np.ones((num_envs,), np.float32)  # step 0 is first
+        self._h = jnp.zeros((num_envs, p.deter_dim))
+        self._z = jnp.zeros((num_envs, Z))
+        self.total_steps = 0
+        self.iteration = 0
+        self._ep_returns = np.zeros(num_envs)
+        self._completed: List[float] = []
+
+        n_mlp = len(H) + 1
+
+        def enc(wm, obs):
+            return mlp_apply(wm["enc"], symlog(obs), n_mlp)
+
+        def gru(wm, h, z, a_onehot):
+            x = jnp.concatenate([z, a_onehot], -1)
+            gx = x @ wm["gru_x"]["w"] + wm["gru_x"]["b"]
+            gh = h @ wm["gru_h"]["w"] + wm["gru_h"]["b"]
+            xr, xu, xc = jnp.split(gx, 3, -1)
+            hr, hu, hc = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(xr + hr)
+            u = jax.nn.sigmoid(xu + hu)
+            c = jnp.tanh(xc + r * hc)
+            return u * c + (1 - u) * h
+
+        def latent_dist(logits):
+            # [.., codes*classes] -> [.., codes, classes] log-probs with 1%
+            # uniform mixing (v3's unimix) for stable KL
+            lg = logits.reshape(logits.shape[:-1] + (p.codes, p.classes))
+            probs = 0.99 * jax.nn.softmax(lg, -1) + 0.01 / p.classes
+            return jnp.log(probs)
+
+        def sample_latent(logp, k):
+            idx = jax.random.categorical(k, logp, axis=-1)  # [.., codes]
+            z = jax.nn.one_hot(idx, p.classes)
+            # straight-through gradients
+            z = z + jnp.exp(logp) - jax.lax.stop_gradient(jnp.exp(logp))
+            return z.reshape(z.shape[:-2] + (Z,))
+
+        def heads(wm, h, z):
+            feat = jnp.concatenate([h, z], -1)
+            recon = mlp_apply(wm["dec"], feat, n_mlp)
+            rew_logits = mlp_apply(wm["rew"], feat, n_mlp)
+            cont_logit = mlp_apply(wm["cont"], feat, n_mlp)[..., 0]
+            return recon, rew_logits, cont_logit
+
+        def kl(logp_a, logp_b):
+            # KL(a || b) over the codes' categoricals, summed
+            pa = jnp.exp(logp_a)
+            return jnp.sum(pa * (logp_a - logp_b), axis=(-1, -2))
+
+        def dist_mean(logits):
+            # expected value of a two-hot head, decoded through symexp
+            edges = bucket_edges(p.bins)
+            probs = jax.nn.softmax(logits, -1)
+            return symexp(jnp.sum(probs * edges, -1))
+
+        def dist_loss(logits, target):
+            hot = twohot(symlog(target), p.bins)
+            return -jnp.sum(hot * jax.nn.log_softmax(logits, -1), -1)
+
+        # ---- world model update over [B, T] sequences ---------------------
+        def wm_loss(wm, batch, k):
+            B, T = batch["act"].shape
+            embed = enc(wm, batch["obs"])  # [B, T, E]
+            a_onehot = jax.nn.one_hot(batch["act"], self.n_actions)
+            # GRU input at step t is the PREVIOUS action a_{t-1} (the one
+            # that led to obs_t) — the same convention policy_step uses
+            # when filtering in the real env.
+            prev_a = jnp.concatenate(
+                [jnp.zeros_like(a_onehot[:, :1]), a_onehot[:, :-1]], 1)
+
+            def step(carry, t):
+                h, z, k = carry
+                k, ks_, kp = jax.random.split(k, 3)
+                # episode boundary: reset the recurrent state and the
+                # previous action (the v3 "is_first" mask) so the model
+                # never predicts across a reset discontinuity
+                first = batch["first"][:, t][:, None]
+                h = h * (1.0 - first)
+                z = z * (1.0 - first)
+                h = gru(wm, h, z, prev_a[:, t] * (1.0 - first))
+                prior_logp = latent_dist(mlp_apply(wm["prior"], h, n_mlp))
+                post_in = jnp.concatenate([h, embed[:, t]], -1)
+                post_logp = latent_dist(mlp_apply(wm["post"], post_in, n_mlp))
+                z = sample_latent(post_logp, ks_)
+                return (h, z, k), (h, z, prior_logp, post_logp)
+
+            h0 = jnp.zeros((B, p.deter_dim))
+            z0 = jnp.zeros((B, Z))
+            (_, _, _), (hs, zs, priors, posts) = jax.lax.scan(
+                step, (h0, z0, k), jnp.arange(T))
+            # [T, B, ...] -> [B, T, ...]
+            tr = lambda x: jnp.swapaxes(x, 0, 1)
+            hs, zs, priors, posts = tr(hs), tr(zs), tr(priors), tr(posts)
+
+            recon, rew_logits, cont_logit = heads(wm, hs, zs)
+            recon_l = jnp.mean(
+                jnp.sum((recon - symlog(batch["obs"])) ** 2, -1))
+            rew_l = jnp.mean(dist_loss(rew_logits, batch["rew"]))
+            cont_l = jnp.mean(
+                optax.sigmoid_binary_cross_entropy(cont_logit,
+                                                   batch["cont"]))
+            dyn = jnp.maximum(
+                kl(jax.lax.stop_gradient(posts), priors), p.free_bits)
+            rep = jnp.maximum(
+                kl(posts, jax.lax.stop_gradient(priors)), p.free_bits)
+            total = (p.beta_pred * (recon_l + rew_l + cont_l)
+                     + p.beta_dyn * dyn.mean() + p.beta_rep * rep.mean())
+            aux = {"recon": recon_l, "reward_loss": rew_l,
+                   "kl": dyn.mean(), "wm_total": total,
+                   "hs": hs, "zs": zs}
+            return total, aux
+
+        def wm_update(wm, opt, batch, k):
+            (_, aux), grads = jax.value_and_grad(wm_loss, has_aux=True)(
+                wm, batch, k)
+            updates, opt = self.wm_tx.update(grads, opt, wm)
+            wm = optax.apply_updates(wm, updates)
+            return wm, opt, aux
+
+        # ---- imagination + actor/critic -----------------------------------
+        def imagine(wm, actor, h, z, k):
+            def step(carry, _):
+                h, z, k = carry
+                k, ka, kz = jax.random.split(k, 3)
+                feat = jnp.concatenate([h, z], -1)
+                logits = mlp_apply(actor, feat, n_mlp)
+                a = jax.random.categorical(ka, logits)
+                logp_a = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits), a[..., None], -1)[..., 0]
+                ent = -jnp.sum(jax.nn.softmax(logits)
+                               * jax.nn.log_softmax(logits), -1)
+                h = gru(wm, h, z, jax.nn.one_hot(a, self.n_actions))
+                prior_logp = latent_dist(mlp_apply(wm["prior"], h, n_mlp))
+                z = sample_latent(prior_logp, kz)
+                return (h, z, k), (h, z, logp_a, ent)
+
+            (_, _, _), (hs, zs, logps, ents) = jax.lax.scan(
+                step, (h, z, k), jnp.arange(p.horizon))
+            return hs, zs, logps, ents  # [H, N, ...]
+
+        def ac_update(wm, actor, critic, critic_ema, a_opt, c_opt,
+                      start_h, start_z, k):
+            # flatten replay states into imagination starts
+            h0 = jax.lax.stop_gradient(start_h.reshape(-1, p.deter_dim))
+            z0 = jax.lax.stop_gradient(start_z.reshape(-1, Z))
+
+            def actor_loss(actor):
+                # logps[t] is the action taken FROM state t; hs/zs[t] is the
+                # state arrived at AFTER that action (t = 0..H-1, so state
+                # indices run 0..H with 0 = the imagination start).
+                hs, zs, logps, ents = imagine(wm, actor, h0, z0, k)
+                feat0 = jnp.concatenate([h0, z0], -1)[None]
+                feat_arr = jnp.concatenate([hs, zs], -1)
+                feats = jnp.concatenate([feat0, feat_arr], 0)  # [H+1, N, F]
+                rew = dist_mean(mlp_apply(wm["rew"], feat_arr, n_mlp))
+                cont = jax.nn.sigmoid(mlp_apply(wm["cont"], feat_arr,
+                                                n_mlp)[..., 0])
+                val = dist_mean(mlp_apply(critic, feats, n_mlp))  # [H+1]
+                disc = p.gamma * cont
+                # lambda returns: G_t = r_{t+1} + gamma*c_{t+1} *
+                # ((1-lam) V(s_{t+1}) + lam G_{t+1}), bootstrapped from
+                # V(s_H); rew/disc index t is the arrival at state t+1.
+                def lam_step(nxt, t):
+                    g = rew[t] + disc[t] * (
+                        (1 - p.lam) * val[t + 1] + p.lam * nxt)
+                    return g, g
+                _, rets = jax.lax.scan(lam_step, val[-1],
+                                       jnp.arange(p.horizon), reverse=True)
+                # continuation weighting: steps imagined past a predicted
+                # terminal are fictional — downweight by the probability
+                # the trajectory is still alive when the action is taken
+                live = jax.lax.stop_gradient(jnp.cumprod(
+                    jnp.concatenate([jnp.ones_like(cont[:1]), cont[:-1]],
+                                    0), 0))
+                # percentile return normalization (v3)
+                lo = jnp.percentile(rets, 5)
+                hi = jnp.percentile(rets, 95)
+                scale = jnp.maximum(hi - lo, 1.0)
+                # baseline: value of the state each action was taken from
+                adv = jax.lax.stop_gradient((rets - val[:-1]) / scale)
+                pg = -(live * logps * adv).mean()
+                ent_bonus = (live * ents).mean()
+                return pg - p.entropy_coef * ent_bonus, (
+                    feats, rets, live, ent_bonus)
+
+            (a_l, (feats, rets, live, ent)), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor)
+            a_updates, a_opt = self.actor_tx.update(a_grads, a_opt, actor)
+            actor = optax.apply_updates(actor, a_updates)
+
+            # critic learns G_t at the state the action was taken from
+            feat = jax.lax.stop_gradient(feats[:-1])
+            rets = jax.lax.stop_gradient(rets)
+
+            def critic_loss(critic):
+                logits = mlp_apply(critic, feat, n_mlp)
+                l = (live * dist_loss(logits, rets)).mean()
+                # regularize toward the EMA head (v3's "slow critic")
+                ema_logits = jax.lax.stop_gradient(
+                    mlp_apply(critic_ema, feat, n_mlp))
+                reg = (live * -jnp.sum(
+                    jax.nn.softmax(ema_logits, -1)
+                    * jax.nn.log_softmax(logits, -1), -1)).mean()
+                return l + 0.1 * reg
+
+            c_l, c_grads = jax.value_and_grad(critic_loss)(critic)
+            c_updates, c_opt = self.critic_tx.update(c_grads, c_opt, critic)
+            critic = optax.apply_updates(critic, c_updates)
+            critic_ema = jax.tree.map(
+                lambda e, c: p.critic_ema * e + (1 - p.critic_ema) * c,
+                critic_ema, critic)
+            return (actor, critic, critic_ema, a_opt, c_opt,
+                    {"actor_loss": a_l, "critic_loss": c_l,
+                     "imag_return": rets.mean(), "entropy": ent})
+
+        # ---- acting in the real env ---------------------------------------
+        def policy_step(wm, actor, h, z, obs, prev_a, k):
+            ka, kz = jax.random.split(k)
+            h = gru(wm, h, z, jax.nn.one_hot(prev_a, self.n_actions))
+            embed = enc(wm, obs)
+            post_in = jnp.concatenate([h, embed], -1)
+            post_logp = latent_dist(mlp_apply(wm["post"], post_in, n_mlp))
+            z = sample_latent(post_logp, kz)
+            feat = jnp.concatenate([h, z], -1)
+            logits = mlp_apply(actor, feat, n_mlp)
+            a = jax.random.categorical(ka, logits)
+            return h, z, a.astype(jnp.int32)
+
+        self._wm_update = jax.jit(wm_update)
+        self._ac_update = jax.jit(ac_update)
+        self._policy_step = jax.jit(policy_step)
+        self._prev_a = -jnp.ones((num_envs,), jnp.int32)  # one_hot(-1)=0
+
+    # ---- replay helpers ----------------------------------------------------
+    def _push_chunk(self, obs, act, rew, cont, first):
+        T = self.p.batch_length
+        c = self._chunk
+        c["obs"].append(obs)
+        c["act"].append(act)
+        c["rew"].append(rew)
+        c["cont"].append(cont)
+        c["first"].append(first)
+        if len(c["obs"]) == T:
+            # each env contributes one [T] sequence
+            obs_b = np.stack(c["obs"], 1)   # [N, T, obs]
+            act_b = np.stack(c["act"], 1)
+            rew_b = np.stack(c["rew"], 1)
+            cont_b = np.stack(c["cont"], 1)
+            first_b = np.stack(c["first"], 1)
+            for i in range(obs_b.shape[0]):
+                j = self.buf_pos
+                self.buf_obs[j] = obs_b[i]
+                self.buf_act[j] = act_b[i]
+                self.buf_rew[j] = rew_b[i]
+                self.buf_cont[j] = cont_b[i]
+                self.buf_first[j] = first_b[i]
+                self.buf_pos = (self.buf_pos + 1) % self.p.buffer_size
+                self.buf_size = min(self.buf_size + 1, self.p.buffer_size)
+            for k in c:
+                c[k].clear()
+            return True
+        return False
+
+    def _sample_batch(self):
+        import jax.numpy as jnp
+
+        idx = self.rng.integers(0, self.buf_size, self.p.batch_size)
+        return {
+            "obs": jnp.asarray(self.buf_obs[idx]),
+            "act": jnp.asarray(self.buf_act[idx]),
+            "rew": jnp.asarray(self.buf_rew[idx]),
+            "cont": jnp.asarray(self.buf_cont[idx]),
+            "first": jnp.asarray(self.buf_first[idx]),
+        }
+
+    # ---- public API --------------------------------------------------------
+    def train(self, steps_per_iteration: int = 256) -> Dict[str, Any]:
+        import jax
+        import numpy as np
+
+        p = self.p
+        metrics: Dict[str, float] = {}
+        n_updates = 0
+        for _ in range(steps_per_iteration // self.num_envs):
+            self.key, kp, ke = jax.random.split(self.key, 3)
+            self._h, self._z, actions = self._policy_step(
+                self.wm, self.actor, self._h, self._z, self.obs,
+                self._prev_a, kp)
+            (self.env_state, next_obs, reward, terminated, truncated,
+             final_obs) = self.env.step(self.env_state, actions, ke)
+            done = np.asarray(terminated | truncated)
+            chunk_full = self._push_chunk(
+                np.asarray(self.obs), np.asarray(actions),
+                np.asarray(reward),
+                1.0 - np.asarray(terminated, np.float32),
+                self._was_done.copy())
+            self._was_done = np.asarray(done, np.float32)
+            self._ep_returns += np.asarray(reward)
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self.obs = next_obs
+            self._prev_a = actions
+            if done.any():
+                # reset recurrent state where an episode ended
+                import jax.numpy as jnp
+
+                mask = jnp.asarray(~done, jnp.float32)[:, None]
+                self._h = self._h * mask
+                self._z = self._z * mask
+                # -1 one-hots to all-zeros: the same "no previous
+                # action" input the world model was trained with at
+                # episode starts
+                self._prev_a = jnp.where(jnp.asarray(done), -1, self._prev_a)
+            self.total_steps += self.num_envs
+
+            if chunk_full and self.buf_size >= p.batch_size:
+                for _ in range(p.train_ratio):
+                    self.key, kw, ka = jax.random.split(self.key, 3)
+                    batch = self._sample_batch()
+                    self.wm, self.wm_opt, aux = self._wm_update(
+                        self.wm, self.wm_opt, batch, kw)
+                    (self.actor, self.critic, self.critic_ema,
+                     self.actor_opt, self.critic_opt, ac_aux) = \
+                        self._ac_update(
+                            self.wm, self.actor, self.critic,
+                            self.critic_ema, self.actor_opt,
+                            self.critic_opt, aux["hs"], aux["zs"], ka)
+                    n_updates += 1
+                    for k in ("recon", "reward_loss", "kl", "wm_total"):
+                        metrics[k] = metrics.get(k, 0.0) + float(aux[k])
+                    for k, v in ac_aux.items():
+                        metrics[k] = metrics.get(k, 0.0) + float(v)
+        self.iteration += 1
+        out = {k: v / max(n_updates, 1) for k, v in metrics.items()}
+        recent = self._completed[-50:]
+        out.update({
+            "training_iteration": self.iteration,
+            "total_env_steps": self.total_steps,
+            "num_updates": n_updates,
+            "episode_reward_mean": (float(np.mean(recent)) if recent
+                                    else float("nan")),
+        })
+        return out
+
+    # ---- checkpointing -----------------------------------------------------
+    def save_checkpoint(self) -> Dict[str, Any]:
+        import jax
+
+        return {k: jax.device_get(getattr(self, k)) for k in
+                ("wm", "actor", "critic", "critic_ema", "wm_opt",
+                 "actor_opt", "critic_opt")} | {
+            "total_steps": self.total_steps, "iteration": self.iteration}
+
+    def load_checkpoint(self, state: Dict[str, Any]):
+        import jax
+
+        for k in ("wm", "actor", "critic", "critic_ema", "wm_opt",
+                  "actor_opt", "critic_opt"):
+            setattr(self, k, jax.device_put(state[k]))
+        self.total_steps = state["total_steps"]
+        self.iteration = state["iteration"]
+
+    def stop(self):
+        pass
