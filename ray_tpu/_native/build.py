@@ -1,6 +1,6 @@
 """Build-on-first-use for the native components.
 
-The wheel-less dev layout compiles ``store.cc`` with the system toolchain
+The wheel-less dev layout compiles each ``.cc`` with the system toolchain
 once and caches the .so keyed by a source hash (reference builds its C++
 core with Bazel into the wheel; here the toolchain is part of the runtime
 environment).
@@ -12,26 +12,25 @@ import hashlib
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 _NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_NATIVE_DIR, "_build")
 _lock = threading.Lock()
-_lib_path: Optional[str] = None
-_build_error: Optional[str] = None
+_lib_paths: Dict[str, Optional[str]] = {}
+_build_errors: Dict[str, str] = {}
 
 
-def lib_path() -> Optional[str]:
-    """Path to the built librtpu_store.so, or None if the build failed."""
-    global _lib_path, _build_error
+def lib_path(name: str = "store") -> Optional[str]:
+    """Path to the built librtpu_{name}.so, or None if the build failed."""
     with _lock:
-        if _lib_path is not None or _build_error is not None:
-            return _lib_path
-        src = os.path.join(_NATIVE_DIR, "store.cc")
+        if name in _lib_paths:
+            return _lib_paths[name]
+        src = os.path.join(_NATIVE_DIR, f"{name}.cc")
         try:
             with open(src, "rb") as f:
                 tag = hashlib.sha256(f.read()).hexdigest()[:16]
-            out = os.path.join(_BUILD_DIR, f"librtpu_store-{tag}.so")
+            out = os.path.join(_BUILD_DIR, f"librtpu_{name}-{tag}.so")
             if not os.path.exists(out):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 tmp = out + f".tmp.{os.getpid()}"
@@ -40,12 +39,12 @@ def lib_path() -> Optional[str]:
                      "-o", tmp, src, "-lpthread", "-lrt"],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, out)  # atomic: racing builders both succeed
-            _lib_path = out
+            _lib_paths[name] = out
         except Exception as e:  # toolchain missing / compile error
-            _build_error = repr(e)
-            _lib_path = None
-        return _lib_path
+            _build_errors[name] = repr(e)
+            _lib_paths[name] = None
+        return _lib_paths[name]
 
 
-def build_error() -> Optional[str]:
-    return _build_error
+def build_error(name: str = "store") -> Optional[str]:
+    return _build_errors.get(name)

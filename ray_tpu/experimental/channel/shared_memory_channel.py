@@ -51,6 +51,54 @@ _CLOSED_BIT = 1 << 63  # high bit of the n_readers word: channel torn down.
 from ray_tpu._private.object_store import _untrack  # noqa: E402
 
 
+def _native_lib():
+    """ctypes binding to _native/channel.cc (same segment layout as this
+    file, plus real atomics and futex blocking).  None when the toolchain
+    is unavailable — the pure-Python path below is the fallback, and the
+    two interoperate on one channel."""
+    global _NATIVE
+    if _NATIVE is not _UNSET:
+        return _NATIVE
+    try:
+        import ctypes
+
+        from ray_tpu._native.build import lib_path
+
+        path = lib_path("channel")
+        if path is None:
+            _NATIVE = None
+            return None
+        lib = ctypes.CDLL(path)
+        lib.rtpu_ch_create.restype = ctypes.c_void_p
+        lib.rtpu_ch_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint64]
+        lib.rtpu_ch_attach.restype = ctypes.c_void_p
+        lib.rtpu_ch_attach.argtypes = [ctypes.c_char_p]
+        lib.rtpu_ch_write.restype = ctypes.c_int64
+        lib.rtpu_ch_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_double]
+        lib.rtpu_ch_read_acquire.restype = ctypes.c_int64
+        lib.rtpu_ch_read_acquire.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint64,
+                                             ctypes.c_double]
+        lib.rtpu_ch_payload.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.rtpu_ch_payload.argtypes = [ctypes.c_void_p]
+        lib.rtpu_ch_read_release.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint64]
+        lib.rtpu_ch_is_closed.restype = ctypes.c_int
+        lib.rtpu_ch_is_closed.argtypes = [ctypes.c_void_p]
+        for fn in ("rtpu_ch_close", "rtpu_ch_detach", "rtpu_ch_destroy"):
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        _NATIVE = lib
+    except Exception:  # pragma: no cover - toolchain missing
+        _NATIVE = None
+    return _NATIVE
+
+
+_UNSET = object()
+_NATIVE: Any = _UNSET
+
+
 class Channel:
     """Handle to one shm channel; picklable (reconstructs by name)."""
 
@@ -71,6 +119,10 @@ class Channel:
         else:
             self._seg = shared_memory.SharedMemory(name=self.name)
             _untrack(self._seg)
+        # Native data plane (atomics + futex waits) over the same segment;
+        # falls back to the pure-Python path when the toolchain is absent.
+        lib = _native_lib()
+        self._nh = lib.rtpu_ch_attach(self.name.encode()) if lib else None
 
     # -- pickling ----------------------------------------------------------
     def __reduce__(self):
@@ -110,6 +162,28 @@ class Channel:
             raise ValueError(
                 f"payload of {len(payload)}B exceeds channel buffer "
                 f"{self.buffer_size}B (set buffer_size at compile time)")
+        if self._nh is not None:
+            lib = _native_lib()
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while True:
+                # bounded per-call budget: returning to Python between
+                # chunks keeps KeyboardInterrupt/signals deliverable
+                budget = 0.2 if deadline is None else max(
+                    0.0, min(0.2, deadline - time.monotonic()))
+                rc = lib.rtpu_ch_write(self._nh, payload, len(payload),
+                                       budget)
+                if rc == 0:
+                    return
+                if rc == -2:
+                    raise ChannelClosedError(f"channel {self.name} closed")
+                if rc == -3:
+                    raise ValueError(
+                        f"payload of {len(payload)}B exceeds channel "
+                        f"segment capacity")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ChannelTimeoutError(
+                        f"channel {self.name}: timeout waiting for readers")
         if self._is_closed():
             raise ChannelClosedError(f"channel {self.name} closed")
         v = self._version()
@@ -123,6 +197,27 @@ class Channel:
 
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
         slot = self._reader_slot or 0
+        if self._nh is not None:
+            import ctypes
+
+            lib = _native_lib()
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while True:
+                budget = 0.2 if deadline is None else max(
+                    0.0, min(0.2, deadline - time.monotonic()))
+                n = lib.rtpu_ch_read_acquire(self._nh, slot, budget)
+                if n >= 0:
+                    break
+                if n == -2:
+                    raise ChannelClosedError(f"channel {self.name} closed")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ChannelTimeoutError(
+                        f"channel {self.name}: timeout waiting for a new "
+                        f"value")
+            out = ctypes.string_at(lib.rtpu_ch_payload(self._nh), n)
+            lib.rtpu_ch_read_release(self._nh, slot)
+            return out
         last = self._ack(slot)
         self._wait(lambda: self._version() > last, timeout, "a new value")
         v = self._version()
@@ -153,13 +248,25 @@ class Channel:
 
     def close(self) -> None:
         try:
-            cur = _U64.unpack_from(self._seg.buf, 16)[0]
-            _U64.pack_into(self._seg.buf, 16, cur | _CLOSED_BIT)
+            if self._nh is not None:
+                _native_lib().rtpu_ch_close(self._nh)  # also futex-wakes
+            else:
+                cur = _U64.unpack_from(self._seg.buf, 16)[0]
+                _U64.pack_into(self._seg.buf, 16, cur | _CLOSED_BIT)
         except Exception:
             pass
 
+    def _drop_native(self) -> None:
+        if self._nh is not None:
+            try:
+                _native_lib().rtpu_ch_detach(self._nh)
+            except Exception:
+                pass
+            self._nh = None
+
     def destroy(self) -> None:
         self.close()
+        self._drop_native()
         try:
             self._seg.close()
             self._seg.unlink()
@@ -167,6 +274,7 @@ class Channel:
             pass
 
     def detach(self) -> None:
+        self._drop_native()
         try:
             self._seg.close()
         except Exception:

@@ -227,12 +227,12 @@ class DreamerV3:
         def wm_loss(wm, batch, k):
             B, T = batch["act"].shape
             embed = enc(wm, batch["obs"])  # [B, T, E]
+            # Rows are ARRIVAL-aligned (see _push_chunk): obs_t is the
+            # observation action act_t landed in, and rew_t/cont_t are that
+            # action's outcomes — so the GRU consumes the same-row action
+            # and the reward/continue heads train at s_t directly, exactly
+            # how imagination reads them.
             a_onehot = jax.nn.one_hot(batch["act"], self.n_actions)
-            # GRU input at step t is the PREVIOUS action a_{t-1} (the one
-            # that led to obs_t) — the same convention policy_step uses
-            # when filtering in the real env.
-            prev_a = jnp.concatenate(
-                [jnp.zeros_like(a_onehot[:, :1]), a_onehot[:, :-1]], 1)
 
             def step(carry, t):
                 h, z, k = carry
@@ -243,7 +243,7 @@ class DreamerV3:
                 first = batch["first"][:, t][:, None]
                 h = h * (1.0 - first)
                 z = z * (1.0 - first)
-                h = gru(wm, h, z, prev_a[:, t] * (1.0 - first))
+                h = gru(wm, h, z, a_onehot[:, t] * (1.0 - first))
                 prior_logp = latent_dist(mlp_apply(wm["prior"], h, n_mlp))
                 post_in = jnp.concatenate([h, embed[:, t]], -1)
                 post_logp = latent_dist(mlp_apply(wm["post"], post_in, n_mlp))
@@ -455,8 +455,12 @@ class DreamerV3:
             (self.env_state, next_obs, reward, terminated, truncated,
              final_obs) = self.env.step(self.env_state, actions, ke)
             done = np.asarray(terminated | truncated)
+            # Arrival-aligned row: final_obs is the observation this
+            # action landed in (pre-reset at terminals, so cont=0 rows
+            # stay in the stream); first marks the start of an episode's
+            # rows, where the wm scan resets its recurrent state.
             chunk_full = self._push_chunk(
-                np.asarray(self.obs), np.asarray(actions),
+                np.asarray(final_obs), np.asarray(actions),
                 np.asarray(reward),
                 1.0 - np.asarray(terminated, np.float32),
                 self._was_done.copy())
