@@ -29,14 +29,20 @@ __all__ = [
 ]
 
 _proxy = None
+_grpc_proxy = None
 
 
-def start(http_options: Optional[Dict[str, Any]] = None):
-    """Start serve (controller + optional HTTP proxy)."""
+def start(http_options: Optional[Dict[str, Any]] = None,
+          grpc_options: Optional[Dict[str, Any]] = None):
+    """Start serve (controller + optional HTTP and/or gRPC proxies).
+
+    Reference runs both proxy flavors per node (``proxy.py:750`` HTTP,
+    ``:530`` gRPC); here each is opt-in via its options dict.
+    """
     from ray_tpu.serve.controller import get_controller
 
     get_controller()
-    global _proxy
+    global _proxy, _grpc_proxy
     if http_options and _proxy is None:
         from ray_tpu.serve.proxy import ProxyActor
 
@@ -44,6 +50,13 @@ def start(http_options: Optional[Dict[str, Any]] = None):
         port = http_options.get("port", 8000)
         _proxy = ProxyActor.remote(host, port)
         ray_tpu.get(_proxy.ready.remote(), timeout=60)
+    if grpc_options and _grpc_proxy is None:
+        from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+        host = grpc_options.get("host", "127.0.0.1")
+        port = grpc_options.get("port", 9000)
+        _grpc_proxy = GrpcProxyActor.remote(host, port)
+        ray_tpu.get(_grpc_proxy.ready.remote(), timeout=60)
     return _proxy
 
 
@@ -124,7 +137,7 @@ def delete(deployment_name: str):
 
 
 def shutdown():
-    global _proxy
+    global _proxy, _grpc_proxy
     from ray_tpu.actor import get_actor_or_none
     from ray_tpu.serve.controller import CONTROLLER_NAME
 
@@ -135,9 +148,11 @@ def shutdown():
             ray_tpu.kill(controller)
         except Exception:
             pass
-    if _proxy is not None:
-        try:
-            ray_tpu.kill(_proxy)
-        except Exception:
-            pass
-        _proxy = None
+    for proxy in (_proxy, _grpc_proxy):
+        if proxy is not None:
+            try:
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
+    _proxy = None
+    _grpc_proxy = None

@@ -1,0 +1,133 @@
+"""gRPC proxy actor: the programmatic (non-HTTP) serve ingress.
+
+Reference: the gRPC proxy in ``python/ray/serve/_private/proxy.py:530``
+(gRPCProxy alongside the HTTP proxy).  The reference compiles user
+protobufs and maps service methods onto deployments; here a generic
+bytes-in/bytes-out gRPC service routes by method path instead, so no
+.proto compilation step is needed:
+
+    call "/<deployment>/<method>" with a cloudpickled (args, kwargs)
+    tuple; the response is the cloudpickled return value.
+
+``grpc_call`` is the matching client helper.  Errors surface as
+grpc.StatusCode.NOT_FOUND (unknown deployment) or INTERNAL (user-code
+exception, message carried in details).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Tuple
+
+import ray_tpu
+
+
+def _dumps(value: Any) -> bytes:
+    from ray_tpu._private import serialization
+
+    return serialization.dumps(value)
+
+
+def _loads(data: bytes) -> Any:
+    from ray_tpu._private import serialization
+
+    return serialization.loads(data)
+
+
+@ray_tpu.remote
+class GrpcProxyActor:
+    """One generic gRPC server routing unary calls to deployment replicas."""
+
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._handles: dict = {}
+        self._ready = threading.Event()
+        self._error: Optional[str] = None
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="serve-grpc-proxy")
+        self._thread.start()
+        if not self._ready.wait(timeout=30):
+            raise RuntimeError(f"grpc proxy failed to bind: {self._error}")
+
+    def ready(self) -> int:
+        return self._port
+
+    def _handle_for(self, deployment: str):
+        if deployment not in self._handles:
+            from ray_tpu.serve.controller import get_controller
+            from ray_tpu.serve.router import DeploymentHandle
+
+            controller = get_controller()
+            known = ray_tpu.get(controller.list_deployments.remote(),
+                                timeout=30)
+            if deployment not in known:
+                return None
+            self._handles[deployment] = DeploymentHandle(deployment)
+        return self._handles[deployment]
+
+    def _serve(self):
+        import asyncio
+
+        import grpc
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        proxy = self
+
+        class Router(grpc.GenericRpcHandler):
+            def service(self, details):
+                parts = details.method.strip("/").split("/")
+                if len(parts) != 2:
+                    return None
+                deployment, method = parts
+
+                async def handler(request: bytes, context):
+                    handle = proxy._handle_for(deployment)
+                    if handle is None:
+                        await context.abort(
+                            grpc.StatusCode.NOT_FOUND,
+                            f"no deployment named {deployment!r}")
+                    try:
+                        args, kwargs = _loads(request)
+                        resp = handle.options(method_name=method).remote(
+                            *args, **kwargs)
+                        result = await asyncio.get_event_loop().run_in_executor(
+                            None, lambda: resp.result(timeout=60))
+                        return _dumps(result)
+                    except Exception as e:  # noqa: BLE001
+                        await context.abort(
+                            grpc.StatusCode.INTERNAL,
+                            f"{type(e).__name__}: {e}")
+
+                return grpc.unary_unary_rpc_method_handler(handler)
+
+        async def main():
+            server = grpc.aio.server()
+            server.add_generic_rpc_handlers((Router(),))
+            bound = server.add_insecure_port(f"{self._host}:{self._port}")
+            if bound == 0:
+                self._error = f"could not bind {self._host}:{self._port}"
+                self._ready.set()
+                return
+            self._port = bound
+            await server.start()
+            self._ready.set()
+            await server.wait_for_termination()
+
+        try:
+            loop.run_until_complete(main())
+        except Exception as e:  # noqa: BLE001
+            self._error = repr(e)
+            self._ready.set()
+
+
+def grpc_call(target: str, deployment: str, method: str = "__call__",
+              *args, timeout: float = 60.0, **kwargs) -> Any:
+    """Client helper: call a deployment through the gRPC proxy."""
+    import grpc
+
+    with grpc.insecure_channel(target) as channel:
+        fn = channel.unary_unary(f"/{deployment}/{method}")
+        payload = _dumps((args, kwargs))
+        return _loads(fn(payload, timeout=timeout))

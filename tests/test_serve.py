@@ -207,3 +207,31 @@ def test_delete_deployment(serve_shutdown):
     assert "Temp" in serve.status()
     serve.delete("Temp")
     assert "Temp" not in serve.status()
+
+
+def test_grpc_proxy(serve_shutdown):
+    """The programmatic ingress (reference gRPC proxy, proxy.py:530):
+    bytes-in/bytes-out unary calls routed by /<deployment>/<method>."""
+    import grpc as grpc_mod
+
+    import ray_tpu
+    from ray_tpu import serve as serve_mod
+    from ray_tpu.serve.grpc_proxy import grpc_call
+
+    @serve.deployment(num_replicas=2)
+    class Calc:
+        def __call__(self, x, y=0):
+            return x + y
+
+        def triple(self, x):
+            return x * 3
+
+    serve.run(Calc.bind())
+    serve.start(grpc_options={"port": 0})  # ephemeral port
+    port = ray_tpu.get(serve_mod._grpc_proxy.ready.remote(), timeout=30)
+    target = f"127.0.0.1:{port}"
+    assert grpc_call(target, "Calc", "__call__", 4, y=5) == 9
+    assert grpc_call(target, "Calc", "triple", 7) == 21
+    with pytest.raises(grpc_mod.RpcError) as ei:
+        grpc_call(target, "Missing", "__call__", 1)
+    assert ei.value.code() == grpc_mod.StatusCode.NOT_FOUND
