@@ -1,0 +1,165 @@
+"""Core-runtime microbenchmarks, mirroring the reference's
+``release/microbenchmark/run_microbenchmark.py`` → ``ray_perf.py:93``
+suite so results compare 1:1 against ``release/perf_metrics/
+microbenchmark.json`` (the numbers in BASELINE.md / SURVEY.md §6).
+
+Run: PYTHONPATH=. python benchmarks/microbench.py [--quick]
+Prints one JSON line per metric plus a summary table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+@ray_tpu.remote
+def _noop_arg(x):
+    return x
+
+
+@ray_tpu.remote
+class _Actor:
+    def noop(self):
+        return None
+
+    def echo(self, x):
+        return x
+
+
+def timeit(name, fn, n, unit="ops/s", baseline=None):
+    # warmup
+    fn(max(1, n // 10))
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    row = {"metric": name, "value": round(rate, 1), "unit": unit}
+    if baseline:
+        row["vs_reference"] = round(rate / baseline, 2)
+        row["reference"] = baseline
+    print(json.dumps(row))
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    scale = 0.2 if args.quick else 1.0
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    rows = []
+
+    # -- single client tasks sync (ray_perf: single_client_tasks_sync) ----
+    def tasks_sync(n):
+        for _ in range(n):
+            ray_tpu.get(_noop.remote())
+
+    rows.append(timeit("single_client_tasks_sync", tasks_sync,
+                       int(500 * scale), baseline=1232.0))
+
+    # -- single client tasks async (8081/s reference) ----------------------
+    def tasks_async(n):
+        ray_tpu.get([_noop.remote() for _ in range(n)])
+
+    rows.append(timeit("single_client_tasks_async", tasks_async,
+                       int(3000 * scale), baseline=8081.0))
+
+    # -- 1:1 actor calls sync (2020/s reference) ---------------------------
+    a = _Actor.remote()
+    ray_tpu.get(a.noop.remote())
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(a.noop.remote())
+
+    rows.append(timeit("1_1_actor_calls_sync", actor_sync,
+                       int(1000 * scale), baseline=2020.0))
+
+    # -- 1:1 actor calls async (4200/s reference ballpark) ------------------
+    def actor_async(n):
+        ray_tpu.get([a.noop.remote() for _ in range(n)])
+
+    rows.append(timeit("1_1_actor_calls_async", actor_async,
+                       int(3000 * scale), baseline=4305.0))
+
+    # -- n:n actor calls async (27465/s reference) -------------------------
+    actors = [_Actor.remote() for _ in range(8)]
+    ray_tpu.get([b.noop.remote() for b in actors])
+
+    def nn_actor_async(n):
+        per = n // len(actors)
+        ray_tpu.get([b.noop.remote() for b in actors for _ in range(per)])
+
+    rows.append(timeit("n_n_actor_calls_async", nn_actor_async,
+                       int(8000 * scale), baseline=27465.0))
+
+    # -- put gigabytes (20.1 GB/s reference) -------------------------------
+    blob = np.ones(64 * 1024 * 1024 // 8, np.float64)  # 64 MB
+
+    def put_gb(n):
+        for _ in range(n):
+            ray_tpu.put(blob)
+
+    n_puts = max(int(20 * scale), 4)
+    t0 = time.perf_counter()
+    put_gb(n_puts)
+    dt = time.perf_counter() - t0
+    gbs = n_puts * blob.nbytes / dt / 1e9
+    row = {"metric": "single_client_put_gigabytes", "value": round(gbs, 2),
+           "unit": "GB/s", "vs_reference": round(gbs / 20.1, 2),
+           "reference": 20.1}
+    print(json.dumps(row))
+    rows.append(row)
+
+    # -- get gigabytes (zero-copy read path) --------------------------------
+    ref = ray_tpu.put(blob)
+
+    def get_gb(n):
+        for _ in range(n):
+            ray_tpu.get(ref)
+
+    t0 = time.perf_counter()
+    get_gb(n_puts)
+    dt = time.perf_counter() - t0
+    row = {"metric": "single_client_get_gigabytes",
+           "value": round(n_puts * blob.nbytes / dt / 1e9, 2), "unit": "GB/s"}
+    print(json.dumps(row))
+    rows.append(row)
+
+    # -- placement group create/remove (768.9/s reference) ------------------
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    def pg_churn(n):
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}])
+            pg.wait(timeout_seconds=10)
+            remove_placement_group(pg)
+
+    rows.append(timeit("placement_group_create/removal", pg_churn,
+                       int(100 * scale), baseline=768.9))
+
+    ray_tpu.shutdown()
+    print("\n== summary (reference = m5.16xlarge nightly numbers) ==")
+    for r in rows:
+        ref = f"  ({r['vs_reference']}x reference)" if "vs_reference" in r \
+            else ""
+        print(f"  {r['metric']:34s} {r['value']:>10} {r['unit']}{ref}")
+
+
+if __name__ == "__main__":
+    main()

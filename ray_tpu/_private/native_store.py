@@ -110,8 +110,15 @@ class NativeArenaStore:
     # -- SharedObjectStore-compatible interface ----------------------------
 
     def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
+        return self.put_into(object_id, len(payload),
+                             lambda view: view.__setitem__(
+                                 slice(0, len(payload)), payload))
+
+    def put_into(self, object_id: ObjectID, nbytes: int, write_fn) -> str:
+        """Alloc → ``write_fn(view)`` writes the payload in place → seal.
+        Serialization packs straight into the arena (no staging copy)."""
         oid = object_id.binary()
-        off = self._lib.rtpu_store_alloc(self._h, oid, len(payload))
+        off = self._lib.rtpu_store_alloc(self._h, oid, nbytes)
         if off == -17:  # EEXIST
             # idempotent only if the existing entry is actually readable
             # (a pending-delete entry is invisible — let the caller fall
@@ -122,9 +129,9 @@ class NativeArenaStore:
                               f"not readable (pending delete)")
         if off < 0:
             raise MemoryError(
-                f"arena store alloc failed for {len(payload)}B: "
+                f"arena store alloc failed for {nbytes}B: "
                 f"{os.strerror(-off)}")
-        self._view[off:off + len(payload)] = payload
+        write_fn(self._view[off:off + nbytes])
         rc = self._lib.rtpu_store_seal(self._h, oid)
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc))

@@ -69,9 +69,15 @@ class SharedObjectStore:
 
     def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
         """Write an already-serialized payload; returns the shm name."""
+        return self.put_into(object_id, len(payload),
+                             lambda view: view.__setitem__(
+                                 slice(0, len(payload)), payload))
+
+    def put_into(self, object_id: ObjectID, nbytes: int, write_fn) -> str:
+        """Create the segment and let ``write_fn(view)`` fill it in place."""
         name = shm_name_for(object_id)
         try:
-            seg = shared_memory.SharedMemory(name=name, create=True, size=max(1, len(payload)))
+            seg = shared_memory.SharedMemory(name=name, create=True, size=max(1, nbytes))
             _untrack(seg)
         except FileExistsError:
             # Object already stored (e.g. deterministic re-execution); reuse.
@@ -81,7 +87,7 @@ class SharedObjectStore:
                     _untrack(seg)
                     self._segments[object_id] = seg
             return name
-        seg.buf[: len(payload)] = payload
+        write_fn(seg.buf[:nbytes] if nbytes else seg.buf)
         with self._lock:
             self._created[object_id] = seg
             self._segments[object_id] = seg
@@ -234,10 +240,22 @@ class HybridObjectStore:
                 pass  # arena full: segment fallback below
         return self.segments.put_serialized(object_id, payload)
 
+    def put_into(self, object_id: ObjectID, nbytes: int, write_fn) -> str:
+        """Single-copy write path: the serializer packs directly into the
+        arena/segment memory instead of staging a bytes payload."""
+        if self.arena is not None and nbytes <= self._arena_max:
+            try:
+                return self.arena.put_into(object_id, nbytes, write_fn)
+            except MemoryError:
+                pass
+        return self.segments.put_into(object_id, nbytes, write_fn)
+
     def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int, List]:
-        payload, refs = serialization.serialize(value)
-        name = self.put_serialized(object_id, payload)
-        return name, len(payload), refs
+        core, raw_bufs, refs, total = serialization.serialize_parts(value)
+        name = self.put_into(
+            object_id, total,
+            lambda view: serialization.write_parts(view, core, raw_bufs))
+        return name, total, refs
 
     # -- reads ----------------------------------------------------------------
 

@@ -92,8 +92,13 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
-def serialize(value: Any) -> Tuple[bytes, List[Any]]:
-    """Serialize ``value``; returns (payload_bytes, contained_object_refs)."""
+def serialize_parts(value: Any):
+    """Two-phase serialization: pickle once, learn the total size WITHOUT
+    copying the out-of-band buffers, then ``write_parts`` packs straight
+    into the destination (shm) — one copy of the big arrays total.
+
+    Returns (core_bytes, raw_buffers, contained_refs, total_nbytes).
+    """
     import io
 
     buffers: List[pickle.PickleBuffer] = []
@@ -106,7 +111,11 @@ def serialize(value: Any) -> Tuple[bytes, List[Any]]:
     total = _pad(_HDR.size + 8 * len(raw_bufs)) + _pad(len(core)) + sum(
         _pad(b.nbytes) for b in raw_bufs
     )
-    out = bytearray(total)
+    return core, raw_bufs, tracker.refs, total
+
+
+def write_parts(out, core: bytes, raw_bufs) -> None:
+    """Pack the output of ``serialize_parts`` into writable buffer ``out``."""
     _HDR.pack_into(out, 0, _MAGIC, len(raw_bufs), len(core))
     off = _HDR.size
     for b in raw_bufs:
@@ -118,14 +127,21 @@ def serialize(value: Any) -> Tuple[bytes, List[Any]]:
     for b in raw_bufs:
         out[off : off + b.nbytes] = b
         off = _pad(off + b.nbytes)
-    return bytes(out), tracker.refs
+
+
+def serialize(value: Any) -> Tuple[bytes, List[Any]]:
+    """Serialize ``value``; returns (payload_bytes, contained_object_refs)."""
+    core, raw_bufs, refs, total = serialize_parts(value)
+    out = bytearray(total)
+    write_parts(out, core, raw_bufs)
+    return bytes(out), refs
 
 
 def serialize_into(value: Any, allocate) -> Tuple[memoryview, List[Any]]:
     """Serialize directly into a buffer from ``allocate(nbytes)`` (e.g. shm)."""
-    payload, refs = serialize(value)
-    buf = allocate(len(payload))
-    buf[: len(payload)] = payload
+    core, raw_bufs, refs, total = serialize_parts(value)
+    buf = allocate(total)
+    write_parts(buf, core, raw_bufs)
     return buf, refs
 
 

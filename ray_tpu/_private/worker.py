@@ -99,11 +99,14 @@ class _LeasePool:
     (``normal_task_submitter.cc:86`` RequestNewWorkerIfNeeded).
     """
 
-    __slots__ = ("queue", "pumps")
+    __slots__ = ("queue", "pumps", "cpu_demand")
 
     def __init__(self):
         self.queue: deque = deque()
         self.pumps = 0
+        # CPU demand per task for this key (all same-key tasks share it);
+        # None until the first spec is seen.
+        self.cpu_demand: Optional[float] = None
 
 
 class CoreWorker:
@@ -222,15 +225,21 @@ class CoreWorker:
         ctx = self.current_ctx()
         ctx.put_index += 1
         oid = ObjectID.from_put(ctx.task_id, ctx.put_index)
-        payload, _refs = serialization.serialize(value)
+        # One pickle pass; large values pack straight into shared memory
+        # (single copy of the big buffers, no staged bytes payload).
+        core, raw_bufs, _refs, total = serialization.serialize_parts(value)
         is_error = isinstance(value, exc.TaskError)
-        if len(payload) <= config.max_inline_object_size:
-            self.memory_store.put(oid, payload)
+        if total <= config.max_inline_object_size:
+            payload = bytearray(total)
+            serialization.write_parts(payload, core, raw_bufs)
+            self.memory_store.put(oid, bytes(payload))
             self._record_location_threadsafe(oid, {"inline": True, "is_error": is_error})
         else:
-            name = self.shared_store.put_serialized(oid, payload)
+            name = self.shared_store.put_into(
+                oid, total,
+                lambda view: serialization.write_parts(view, core, raw_bufs))
             self._record_location_threadsafe(
-                oid, {"shm": name, "node": self.node_id, "size": len(payload), "is_error": is_error}
+                oid, {"shm": name, "node": self.node_id, "size": total, "is_error": is_error}
             )
         return ObjectRef(oid, self.serve_addr)
 
@@ -349,27 +358,69 @@ class CoreWorker:
     # ------------------------------------------------------- normal task submit
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        return self.run_coro(self.submit_task_async(spec))
+        # Fire-and-forget: refs are deterministic from the spec, so the
+        # caller never waits for a loop-thread round trip per .remote()
+        # (the reference pipelines submission the same way).  A get() that
+        # races the enqueue falls back to _wait_local_location, which the
+        # completion/failure paths always fulfill.
+        refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
+        self.loop.call_soon_threadsafe(self._enqueue_spec, spec)
+        return refs
 
-    async def submit_task_async(self, spec: TaskSpec) -> List[ObjectRef]:
-        refs = []
+    def _enqueue_spec(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids():
-            fut = self.loop.create_future()
-            self._result_futures[oid] = fut
-            refs.append(ObjectRef(oid, self.serve_addr))
+            if oid not in self._result_futures:
+                self._result_futures[oid] = self.loop.create_future()
         key = spec.scheduling_key()
         pool = self._leases.get(key)
         if pool is None:
             pool = self._leases[key] = _LeasePool()
         pool.queue.append(spec)
         self._grow_pool(key, pool)
+
+    async def submit_task_async(self, spec: TaskSpec) -> List[ObjectRef]:
+        refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
+        self._enqueue_spec(spec)
         return refs
+
+    def _pool_cap(self, pool: "_LeasePool") -> int:
+        # Don't request more concurrent leases than the cluster could run
+        # for this key's CPU demand: surplus requests make raylets spawn
+        # workers that can never be scheduled together (pathological on
+        # small hosts).  Zero-CPU keys keep the configured cap.
+        cap = config.max_leases_per_scheduling_key
+        demand = pool.cpu_demand
+        if demand is None or demand <= 0:
+            return cap
+        now = self.loop.time()
+        cpus, fetched_at = getattr(self, "_cluster_cpus", (None, 0.0))
+        if (cpus is None or now - fetched_at > 10.0) and not getattr(
+                self, "_cpu_fetch_inflight", False):
+            # refresh off the hot path; keep serving the last value
+            self._cpu_fetch_inflight = True
+
+            async def fetch():
+                try:
+                    nodes = await self.gcs.call("get_all_nodes")
+                    total = sum(
+                        n.get("total", {}).get("CPU", 0) for n in nodes
+                        if n.get("alive", True))
+                    if total > 0:  # never cache a racing empty view
+                        self._cluster_cpus = (total, self.loop.time())
+                finally:
+                    self._cpu_fetch_inflight = False
+
+            asyncio.ensure_future(fetch())
+        if cpus is None:
+            return min(cap, 8)  # conservative until discovery lands
+        return max(1, min(cap, int(cpus / demand)))
 
     def _grow_pool(self, key: Tuple, pool: _LeasePool):
         # One pump per outstanding spec: live pumps are each dispatching
         # one spec, so the target is pumps + queued, capped.
-        want = min(pool.pumps + len(pool.queue),
-                   config.max_leases_per_scheduling_key)
+        if pool.cpu_demand is None and pool.queue:
+            pool.cpu_demand = pool.queue[0].resources.get("CPU", 0.0)
+        want = min(pool.pumps + len(pool.queue), self._pool_cap(pool))
         while pool.pumps < want:
             pool.pumps += 1
             asyncio.ensure_future(self._pump_lease(key, pool))
@@ -685,13 +736,20 @@ class CoreWorker:
             is_error = False
         returns = []
         for oid, value in zip(spec.return_ids(), results):
-            payload, _refs = serialization.serialize(value)
-            if len(payload) <= config.max_inline_object_size:
-                entry = {"oid": oid.binary(), "inline": payload, "is_error": is_error}
+            core, raw_bufs, _refs, total = serialization.serialize_parts(value)
+            if total <= config.max_inline_object_size:
+                payload = bytearray(total)
+                serialization.write_parts(payload, core, raw_bufs)
+                entry = {"oid": oid.binary(), "inline": bytes(payload),
+                         "is_error": is_error}
             else:
-                name = self.shared_store.put_serialized(oid, payload)
+                # big results pack straight into shared memory (one copy)
+                name = self.shared_store.put_into(
+                    oid, total,
+                    lambda view, c=core, rb=raw_bufs:
+                        serialization.write_parts(view, c, rb))
                 entry = {"oid": oid.binary(), "shm": name, "node": self.node_id,
-                         "size": len(payload), "is_error": is_error}
+                         "size": total, "is_error": is_error}
             returns.append(entry)
         return {"returns": returns}
 

@@ -24,7 +24,8 @@ from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
-    "get_app_handle", "get_deployment_handle", "run", "shutdown", "start",
+    "get_app_handle", "get_deployment_handle", "grpc_proxy_port", "run",
+    "shutdown", "start",
     "status",
 ]
 
@@ -58,6 +59,14 @@ def start(http_options: Optional[Dict[str, Any]] = None,
         _grpc_proxy = GrpcProxyActor.remote(host, port)
         ray_tpu.get(_grpc_proxy.ready.remote(), timeout=60)
     return _proxy
+
+
+def grpc_proxy_port() -> int:
+    """Bound port of the gRPC proxy (resolves port=0 ephemeral binds)."""
+    if _grpc_proxy is None:
+        raise RuntimeError("gRPC proxy not started; pass grpc_options to "
+                           "serve.start()")
+    return ray_tpu.get(_grpc_proxy.ready.remote(), timeout=30)
 
 
 def run(target: Application | Deployment, *, name: str = "default",

@@ -34,6 +34,9 @@ def _loads(data: bytes) -> Any:
     return serialization.loads(data)
 
 
+_NOT_FOUND = object()
+
+
 @ray_tpu.remote
 class GrpcProxyActor:
     """One generic gRPC server routing unary calls to deployment replicas."""
@@ -53,8 +56,11 @@ class GrpcProxyActor:
     def ready(self) -> int:
         return self._port
 
-    def _handle_for(self, deployment: str):
-        if deployment not in self._handles:
+    def _handle_for(self, deployment: str, method: str):
+        # cached per (deployment, method): handles keep their Router (and
+        # its controller-refreshed replica cache) across requests
+        key = (deployment, method)
+        if key not in self._handles:
             from ray_tpu.serve.controller import get_controller
             from ray_tpu.serve.router import DeploymentHandle
 
@@ -63,10 +69,17 @@ class GrpcProxyActor:
                                 timeout=30)
             if deployment not in known:
                 return None
-            self._handles[deployment] = DeploymentHandle(deployment)
-        return self._handles[deployment]
+            self._handles[key] = DeploymentHandle(deployment, method)
+        return self._handles[key]
 
     def _serve(self):
+        try:
+            self._serve_inner()
+        except Exception as e:  # noqa: BLE001 — surface via ready()
+            self._error = repr(e)
+            self._ready.set()
+
+    def _serve_inner(self):
         import asyncio
 
         import grpc
@@ -83,22 +96,31 @@ class GrpcProxyActor:
                 deployment, method = parts
 
                 async def handler(request: bytes, context):
-                    handle = proxy._handle_for(deployment)
-                    if handle is None:
-                        await context.abort(
-                            grpc.StatusCode.NOT_FOUND,
-                            f"no deployment named {deployment!r}")
-                    try:
+                    # the whole chain (handle lookup, router refresh,
+                    # replica probe, result wait) does blocking ray_tpu
+                    # RPCs — keep it off the grpc.aio event loop (the
+                    # HTTP proxy does the same)
+                    def call_sync():
+                        handle = proxy._handle_for(deployment, method)
+                        if handle is None:
+                            return _NOT_FOUND
                         args, kwargs = _loads(request)
-                        resp = handle.options(method_name=method).remote(
-                            *args, **kwargs)
-                        result = await asyncio.get_event_loop().run_in_executor(
-                            None, lambda: resp.result(timeout=60))
-                        return _dumps(result)
+                        return _dumps(
+                            handle.remote(*args, **kwargs).result(
+                                timeout=60))
+
+                    try:
+                        out = await asyncio.get_event_loop().run_in_executor(
+                            None, call_sync)
                     except Exception as e:  # noqa: BLE001
                         await context.abort(
                             grpc.StatusCode.INTERNAL,
                             f"{type(e).__name__}: {e}")
+                    if out is _NOT_FOUND:
+                        await context.abort(
+                            grpc.StatusCode.NOT_FOUND,
+                            f"no deployment named {deployment!r}")
+                    return out
 
                 return grpc.unary_unary_rpc_method_handler(handler)
 
@@ -115,11 +137,7 @@ class GrpcProxyActor:
             self._ready.set()
             await server.wait_for_termination()
 
-        try:
-            loop.run_until_complete(main())
-        except Exception as e:  # noqa: BLE001
-            self._error = repr(e)
-            self._ready.set()
+        loop.run_until_complete(main())
 
 
 def grpc_call(target: str, deployment: str, method: str = "__call__",

@@ -212,9 +212,8 @@ def test_delete_deployment(serve_shutdown):
 def test_grpc_proxy(serve_shutdown):
     """The programmatic ingress (reference gRPC proxy, proxy.py:530):
     bytes-in/bytes-out unary calls routed by /<deployment>/<method>."""
-    import grpc as grpc_mod
+    grpc_mod = pytest.importorskip("grpc")
 
-    import ray_tpu
     from ray_tpu import serve as serve_mod
     from ray_tpu.serve.grpc_proxy import grpc_call
 
@@ -228,8 +227,7 @@ def test_grpc_proxy(serve_shutdown):
 
     serve.run(Calc.bind())
     serve.start(grpc_options={"port": 0})  # ephemeral port
-    port = ray_tpu.get(serve_mod._grpc_proxy.ready.remote(), timeout=30)
-    target = f"127.0.0.1:{port}"
+    target = f"127.0.0.1:{serve_mod.grpc_proxy_port()}"
     assert grpc_call(target, "Calc", "__call__", 4, y=5) == 9
     assert grpc_call(target, "Calc", "triple", 7) == 21
     with pytest.raises(grpc_mod.RpcError) as ei:
