@@ -161,6 +161,69 @@ def decode_step(params, token, cur_len, cache, cfg: LlamaConfig):
     return logits[:, 0], cache
 
 
+def verify_step(params, tokens, cur_len, cache, cfg: LlamaConfig):
+    """Speculative-decoding verify: feed K+1 tokens per sequence in ONE
+    forward (tokens[:, 0] is the last accepted token, 1..K the draft).
+
+    logits[:, j] predicts the token at position cur_len+j+1, so greedy
+    acceptance compares argmax(logits[:, j]) with draft token j+1.  Cache
+    slots cur_len..cur_len+K are written; slots past the accepted prefix
+    hold draft-conditioned K/V but stay invisible (masks are <= cur_len)
+    and are overwritten when those positions are genuinely reached.
+
+    The reference reaches speculative decoding through vLLM; here it is a
+    first-class cache op.
+    """
+    b, kp1 = tokens.shape
+    max_len = cache["k"].shape[2]
+    hd = cfg.resolved_head_dim
+    cos, sin = rope_frequencies(hd, max_len, cfg.rope_theta)
+    positions = cur_len[:, None] + jnp.arange(kp1)[None]  # [b, K+1]
+    x = params["embed"][tokens].astype(cfg.dtype)
+    idx = jnp.arange(max_len)
+    # query at global position p sees key slots <= p (its own included)
+    mask = idx[None, None, :] <= positions[:, :, None]
+
+    write = jax.vmap(
+        lambda c, kv, pos: jax.lax.dynamic_update_slice(
+            c, kv, (pos, jnp.int32(0), jnp.int32(0))))
+
+    for i, lp in _stacked_layers(params):
+        def merge(k, v, i=i):
+            ck = write(cache["k"][i], k, cur_len)
+            cv = write(cache["v"][i], v, cur_len)
+            cache["k"] = cache["k"].at[i].set(ck)
+            cache["v"] = cache["v"].at[i].set(cv)
+            return ck, cv
+
+        x, _ = _layer_with_cache(x, lp, merge, cfg=cfg, cos=cos, sin=sin,
+                                 mask=mask, positions=positions)
+    x = rms_norm(x, params["final_norm"])
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    logits = jnp.einsum("bsh,hv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, cache
+
+
+def _propose_ngram(history: List[int], k: int, ngram: int = 2) -> List[int]:
+    """Prompt-lookup drafting (self-speculation, no draft model): find the
+    most recent earlier occurrence of the trailing n-gram and propose the
+    k tokens that followed it."""
+    n = len(history)
+    if n < ngram + 1:
+        return []
+    tail = history[-ngram:]
+    # search right-to-left, excluding the trailing occurrence itself
+    for start in range(n - ngram - 1, -1, -1):
+        if history[start:start + ngram] == tail:
+            cont = history[start + ngram:start + ngram + k]
+            if cont:
+                return cont
+            return []
+    return []
+
+
 def sample_token(logits, key, sp: SamplingParams):
     """Greedy when temperature==0, else temperature/top-k/top-p sampling."""
     if sp.temperature == 0.0:
@@ -183,11 +246,17 @@ def sample_token(logits, key, sp: SamplingParams):
 
 def generate(params, cfg: LlamaConfig, prompts: List[List[int]],
              sampling: SamplingParams, *, key=None,
-             max_len: Optional[int] = None) -> List[List[int]]:
+             max_len: Optional[int] = None,
+             speculative: int = 0) -> List[List[int]]:
     """Batched generation; returns new token ids per prompt (no echo).
 
     Prefill compiles once per padded prompt length bucket; the decode step
     compiles once per (batch, max_len) and is reused for every token.
+
+    ``speculative=K`` turns on prompt-lookup speculative decoding (greedy
+    only): K draft tokens per step are proposed from each sequence's own
+    history and verified in one forward — exact greedy outputs, fewer
+    sequential steps when text repeats (code, structured output).
     """
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -204,6 +273,13 @@ def generate(params, cfg: LlamaConfig, prompts: List[List[int]],
     decode_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
 
     logits, cache = prefill_fn(params, padded, lengths, cache)
+    if speculative > 0:
+        if sampling.temperature != 0.0:
+            raise ValueError("speculative decoding requires greedy "
+                             "sampling (temperature=0)")
+        return _generate_speculative(
+            params, cfg, prompts, sampling, logits, cache, lengths,
+            max_len, speculative, decode_fn)
     cur_len = lengths
     out_tokens = []
     was_done = []  # done state BEFORE each step's token (per sequence)
