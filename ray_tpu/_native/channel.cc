@@ -37,6 +37,10 @@
 namespace {
 
 constexpr uint64_t kClosedBit = 1ull << 63;
+// Set in the flags word by a creator whose process has this native lib;
+// pure-Python peers on weakly-ordered hosts refuse to attach (the Python
+// writer's plain stores lack release ordering vs our acquire loads).
+constexpr uint64_t kNativeBit = 1ull << 62;
 constexpr size_t kHdr = 24;  // version, payload_len, flags
 
 struct Handle {
@@ -116,7 +120,7 @@ Handle* map_segment(const char* name, size_t total_hint, bool create,
   snprintf(h->name, sizeof(h->name), "%s", name);
   if (create) {
     memset(h->base, 0, kHdr + 8 * n_readers);
-    flags_w(h)->store(n_readers, std::memory_order_release);
+    flags_w(h)->store(n_readers | kNativeBit, std::memory_order_release);
     h->buffer_size = buffer_size;
     h->n_readers = n_readers;
   } else {
@@ -129,7 +133,7 @@ Handle* map_segment(const char* name, size_t total_hint, bool create,
       return nullptr;
     }
     uint64_t flags = flags_w(h)->load(std::memory_order_acquire);
-    uint64_t n = flags & ~kClosedBit;
+    uint64_t n = flags & ~(kClosedBit | kNativeBit);
     if (n == 0 || n > 4096 || kHdr + 8 * n > total) {
       munmap(mem, total);
       delete h;

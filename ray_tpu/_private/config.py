@@ -53,6 +53,9 @@ _FLAG_DEFS: Dict[str, Any] = {
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,
     "worker_killing_policy": "retriable_fifo",  # | "group_by_owner"
+    # don't kill when our workers hold less than this share of used bytes
+    # (pressure is then external to the raylet — shared-host tenants)
+    "memory_kill_min_worker_share": 0.10,
     # --- health / failure detection ---
     # (reference gcs_health_check_manager.h:45 timings)
     "health_check_period_s": 5.0,

@@ -82,8 +82,10 @@ class MemoryMonitor:
         threshold: Optional[float] = None,
         policy: Optional[str] = None,
         min_kill_interval_s: float = 2.0,
+        rss_fn: Callable[[int], int] = process_rss_bytes,
     ):
         self.usage_fn = usage_fn
+        self.rss_fn = rss_fn
         self.threshold = (
             threshold if threshold is not None
             else config.memory_usage_threshold
@@ -91,9 +93,13 @@ class MemoryMonitor:
         self.policy = policy or config.worker_killing_policy
         self.min_kill_interval_s = min_kill_interval_s
         self._last_kill = 0.0
+        self._last_attribution_log = 0.0
 
     def is_pressing(self) -> bool:
         used, total = self.usage_fn()
+        return self._pressing(used, total)
+
+    def _pressing(self, used: int, total: int) -> bool:
         return total > 0 and used / total > self.threshold
 
     def maybe_pick_victim(self, workers: List) -> Optional[object]:
@@ -104,15 +110,35 @@ class MemoryMonitor:
         pressure episode doesn't massacre the whole pool before the first
         kill's memory is returned.
         """
-        if not self.is_pressing():
+        used, total = self.usage_fn()  # one sample per tick, reused below
+        if not self._pressing(used, total):
             return None
         now = time.time()
         if now - self._last_kill < self.min_kill_interval_s:
             return None
+        # Attribute pressure before killing: on a shared host an unrelated
+        # process can push node usage past the threshold while our workers
+        # are tiny — killing them then frees ~nothing and fails healthy
+        # tasks.  Only kill when workers own a meaningful share of usage.
+        rss = sum(self.rss_fn(w.pid) for w in workers if w.pid)
+        # rss == 0 means attribution data is unavailable (no /proc statm on
+        # this platform) — fall through to the kill rather than disabling
+        # OOM protection entirely.
+        if 0 < rss < config.memory_kill_min_worker_share * used:
+            if now - self._last_attribution_log > 30:
+                self._last_attribution_log = now
+                logger.warning(
+                    "memory pressure but workers hold only %.1f%% of used "
+                    "bytes (< %.0f%%): not killing — pressure is external "
+                    "to this raylet (disable monitor with "
+                    "RAY_TPU_MEMORY_MONITOR_REFRESH_MS=0)",
+                    100 * rss / used,
+                    100 * config.memory_kill_min_worker_share,
+                )
+            return None
         victim = pick_victim(workers, self.policy)
         if victim is not None:
             self._last_kill = now
-            used, total = self.usage_fn()
             logger.warning(
                 "memory pressure %.1f%% > %.1f%%: killing worker pid=%s "
                 "(policy=%s, lease=%s)",

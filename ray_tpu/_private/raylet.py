@@ -209,8 +209,12 @@ class Raylet:
             await asyncio.sleep(period)
 
     async def _on_worker_death(self, h: WorkerHandle):
+        # Idempotent: the reaper loop and the memory monitor's stale-pid
+        # fallback can both observe one death; only the first caller runs
+        # lease release / GCS reporting / lease pumping.
+        if self.workers.pop(h.worker_id, None) is None:
+            return
         logger.warning("worker %s (pid %s) died", h.worker_id.hex()[:8], h.pid)
-        self.workers.pop(h.worker_id, None)
         self._spawned_procs.pop(h.pid, None)
         if h in self.idle:
             try:

@@ -45,6 +45,15 @@ class ChannelClosedError(RuntimeError):
 _CLOSED_BIT = 1 << 63  # high bit of the n_readers word: channel torn down.
 # The flag lives in a word the writer never stores to, so close() is sticky
 # even if a writer is mid-write when the channel is closed.
+_NATIVE_BIT = 1 << 62  # creator attached the native data plane (channel.cc).
+
+# Mixed native/pure-Python peers on one channel are only safe under x86-TSO:
+# the Python writer publishes payload then version with plain stores, while a
+# native reader pairs them with acquire loads.  On weakly-ordered hosts
+# (ARM), a Python peer refuses to join a native-mode channel instead.
+import platform
+
+_TSO = platform.machine().lower() in ("x86_64", "amd64", "i686", "i386")
 
 # resource_tracker would unlink segments when *any* process exits; channel
 # lifetime is owned by the compiled DAG (same reasoning as the object store)
@@ -109,20 +118,43 @@ class Channel:
         self.num_readers = num_readers
         self._reader_slot: Optional[int] = None
         total = _HDR + 8 * num_readers + buffer_size
+        lib = _native_lib()
         if _create:
             self._seg = shared_memory.SharedMemory(
                 name=self.name, create=True, size=total)
             _untrack(self._seg)
             self._seg.buf[:_HDR + 8 * num_readers] = b"\x00" * (
                 _HDR + 8 * num_readers)
-            _U64.pack_into(self._seg.buf, 16, num_readers)
+            # The creator fixes the channel's data-plane mode for all peers
+            # (see _NATIVE_BIT above) — mixed mode only ever arises when a
+            # later attacher lacks the toolchain, and then only on TSO hosts.
+            flags = num_readers | (_NATIVE_BIT if lib else 0)
+            _U64.pack_into(self._seg.buf, 16, flags)
         else:
             self._seg = shared_memory.SharedMemory(name=self.name)
             _untrack(self._seg)
+        native_mode = bool(_U64.unpack_from(self._seg.buf, 16)[0]
+                           & _NATIVE_BIT)
         # Native data plane (atomics + futex waits) over the same segment;
         # falls back to the pure-Python path when the toolchain is absent.
-        lib = _native_lib()
-        self._nh = lib.rtpu_ch_attach(self.name.encode()) if lib else None
+        self._nh = (lib.rtpu_ch_attach(self.name.encode())
+                    if native_mode and lib else None)
+        if native_mode and not self._nh and not _TSO:
+            # No native handle on a native-mode channel (toolchain absent,
+            # or attach itself failed): falling back to plain Python stores
+            # is exactly the mixed-mode hazard — refuse off x86.  Release
+            # the segment first (we untracked it from resource_tracker, so
+            # nothing else will).
+            try:
+                self._seg.close()
+                if _create:
+                    self._seg.unlink()
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"channel {self.name} uses the native data plane but this "
+                f"process could not attach it; mixed native/Python peers "
+                f"are unsafe on weakly-ordered ({platform.machine()}) hosts")
 
     # -- pickling ----------------------------------------------------------
     def __reduce__(self):

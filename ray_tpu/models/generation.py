@@ -244,6 +244,95 @@ def sample_token(logits, key, sp: SamplingParams):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def _generate_speculative(params, cfg: LlamaConfig, prompts: List[List[int]],
+                          sampling: SamplingParams, logits, cache, lengths,
+                          max_len: int, K: int, decode_fn) -> List[List[int]]:
+    """Greedy prompt-lookup speculative decoding driver.
+
+    Per step: draft up to K tokens per sequence from its own history
+    (``_propose_ngram``), verify pending-token + drafts in one jitted
+    ``verify_step`` forward, accept the longest greedy-matching draft
+    prefix plus the bonus token.  Exactly reproduces greedy ``generate``
+    output (the acceptance rule only keeps tokens argmax would have
+    produced); steps where no sequence has a draft fall back to
+    ``decode_fn``.  All acceptance/stop/budget bookkeeping is host-side;
+    the device work is one verify (or decode) program per step.
+    """
+    b = len(prompts)
+    verify_fn = jax.jit(functools.partial(verify_step, cfg=cfg))
+    stop = sampling.stop_token_id
+    # Greedy emits at most max(1, max_len - prompt_len) tokens before its
+    # capacity stop (cur_len >= max_len - 1) fires — the prefill token is
+    # always emitted BEFORE the stop is checked; mirror that exactly.
+    budget = [min(sampling.max_tokens, max(1, max_len - len(p)))
+              for p in prompts]
+    histories = [list(p) for p in prompts]
+    results: List[List[int]] = [[] for _ in range(b)]
+    done = [budget[i] <= 0 for i in range(b)]
+    # cur_np[i] = cache slot where sequence i's next token's K/V goes; the
+    # last emitted ("pending") token has not been written yet.
+    cur_np = [int(x) for x in jax.device_get(lengths)]
+    pending = [int(t) for t in jax.device_get(jnp.argmax(logits, -1))]
+
+    def emit(i: int, tok: int) -> bool:
+        """Record one accepted token; returns False once i is finished."""
+        if stop is not None and tok == stop:
+            done[i] = True
+            return False
+        results[i].append(tok)
+        histories[i].append(tok)
+        if len(results[i]) >= budget[i]:
+            done[i] = True
+            return False
+        return True
+
+    for i in range(b):
+        if not done[i]:
+            emit(i, pending[i])
+
+    while not all(done):
+        drafts, dlens = [], []
+        for i in range(b):
+            d = _propose_ngram(histories[i], K) if not done[i] else []
+            d = d[:K]
+            dlens.append(len(d))
+            drafts.append(d + [0] * (K - len(d)))
+        cur = jnp.asarray(cur_np, jnp.int32)
+        token_col = jnp.asarray(pending, jnp.int32)
+        if max(dlens) == 0:
+            logits, cache = decode_fn(params, token_col, cur, cache)
+            preds = jax.device_get(jnp.argmax(logits, -1))  # [b]
+            for i in range(b):
+                if done[i]:
+                    continue
+                cur_np[i] += 1
+                tok = int(preds[i])
+                if emit(i, tok):
+                    pending[i] = tok
+            continue
+        tokens = jnp.concatenate(
+            [token_col[:, None], jnp.asarray(drafts, jnp.int32)], axis=1)
+        logits, cache = verify_fn(params, tokens, cur, cache)
+        preds = jax.device_get(jnp.argmax(logits, -1))  # [b, K+1]
+        for i in range(b):
+            if done[i]:
+                continue
+            a = 0
+            while a < dlens[i] and drafts[i][a] == int(preds[i][a]):
+                a += 1
+            # pending + a accepted drafts now hold valid cache slots
+            cur_np[i] += 1 + a
+            alive = True
+            for tok in drafts[i][:a]:
+                if not (alive := emit(i, tok)):
+                    break
+            if alive:
+                bonus = int(preds[i][a])
+                if emit(i, bonus):
+                    pending[i] = bonus
+    return results
+
+
 def generate(params, cfg: LlamaConfig, prompts: List[List[int]],
              sampling: SamplingParams, *, key=None,
              max_len: Optional[int] = None,
@@ -258,6 +347,10 @@ def generate(params, cfg: LlamaConfig, prompts: List[List[int]],
     history and verified in one forward — exact greedy outputs, fewer
     sequential steps when text repeats (code, structured output).
     """
+    if speculative > 0 and sampling.temperature != 0.0:
+        # fail before any device allocation / compilation happens
+        raise ValueError("speculative decoding requires greedy "
+                         "sampling (temperature=0)")
     if key is None:
         key = jax.random.PRNGKey(0)
     b = len(prompts)
@@ -267,16 +360,18 @@ def generate(params, cfg: LlamaConfig, prompts: List[List[int]],
         max_len = min(cfg.max_seq_len, S + sampling.max_tokens)
     padded = jnp.asarray(
         [list(p) + [0] * (S - len(p)) for p in prompts], jnp.int32)
-    cache = init_kv_cache(cfg, b, max_len)
+    # Speculative verify writes K+1 slots per step; give the cache K+1 slots
+    # of slack past the logical max_len so writes never clamp.  The logical
+    # stopping rule (emit at most max_len - prompt_len tokens) is enforced
+    # host-side in _generate_speculative.
+    cache_len = max_len + (speculative + 1 if speculative > 0 else 0)
+    cache = init_kv_cache(cfg, b, cache_len)
 
     prefill_fn = jax.jit(functools.partial(prefill, cfg=cfg))
     decode_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
 
     logits, cache = prefill_fn(params, padded, lengths, cache)
     if speculative > 0:
-        if sampling.temperature != 0.0:
-            raise ValueError("speculative decoding requires greedy "
-                             "sampling (temperature=0)")
         return _generate_speculative(
             params, cfg, prompts, sampling, logits, cache, lengths,
             max_len, speculative, decode_fn)

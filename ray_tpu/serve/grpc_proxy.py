@@ -10,8 +10,10 @@ bytes-in/bytes-out gRPC service routes by method path instead, so no
     tuple; the response is the cloudpickled return value.
 
 ``grpc_call`` is the matching client helper.  Errors surface as
-grpc.StatusCode.NOT_FOUND (unknown deployment) or INTERNAL (user-code
-exception, message carried in details).
+grpc.StatusCode.NOT_FOUND (unknown deployment), DEADLINE_EXCEEDED (the
+client's own deadline expired while waiting on the deployment), or
+INTERNAL (user-code exception or proxy-side timeout/outage, message
+carried in details).
 """
 
 from __future__ import annotations
@@ -35,6 +37,7 @@ def _loads(data: bytes) -> Any:
 
 
 _NOT_FOUND = object()
+_DEADLINE = object()
 
 
 @ray_tpu.remote
@@ -42,8 +45,15 @@ class GrpcProxyActor:
     """One generic gRPC server routing unary calls to deployment replicas."""
 
     def __init__(self, host: str, port: int):
+        import concurrent.futures
+
         self._host = host
         self._port = port
+        # Dedicated pool for the blocking deployment waits: long client
+        # deadlines must not starve the asyncio loop's small default
+        # executor (shared with everything else in this process).
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="grpc-proxy-call")
         self._handles: dict = {}
         self._ready = threading.Event()
         self._error: Optional[str] = None
@@ -96,6 +106,14 @@ class GrpcProxyActor:
                 deployment, method = parts
 
                 async def handler(request: bytes, context):
+                    # honor the client's gRPC deadline: wait that long for
+                    # the deployment (capped: each in-flight call pins one
+                    # proxy pool thread, so an hour-long deadline must not
+                    # hold one that long)
+                    remaining = context.time_remaining()
+                    wait = 60.0 if remaining is None else max(
+                        0.0, min(remaining, 600.0))
+
                     # the whole chain (handle lookup, router refresh,
                     # replica probe, result wait) does blocking ray_tpu
                     # RPCs — keep it off the grpc.aio event loop (the
@@ -105,17 +123,37 @@ class GrpcProxyActor:
                         if handle is None:
                             return _NOT_FOUND
                         args, kwargs = _loads(request)
-                        return _dumps(
-                            handle.remote(*args, **kwargs).result(
-                                timeout=60))
+                        resp = handle.remote(*args, **kwargs)
+                        # Only THIS wait maps to the client's deadline;
+                        # timeouts inside the control-plane lookup above
+                        # stay INTERNAL (they're our outage, not the
+                        # client's budget expiring).
+                        try:
+                            return _dumps(resp.result(timeout=wait))
+                        except TimeoutError:
+                            return _DEADLINE
 
                     try:
                         out = await asyncio.get_event_loop().run_in_executor(
-                            None, call_sync)
+                            proxy._pool, call_sync)
                     except Exception as e:  # noqa: BLE001
                         await context.abort(
                             grpc.StatusCode.INTERNAL,
                             f"{type(e).__name__}: {e}")
+                    if out is _DEADLINE:
+                        # DEADLINE_EXCEEDED only when the CLIENT's budget
+                        # actually expired (wait was bound by remaining);
+                        # the internal default or the 600s proxy cap
+                        # expiring is our failure surface, kept INTERNAL.
+                        if remaining is not None and remaining <= 600.0:
+                            await context.abort(
+                                grpc.StatusCode.DEADLINE_EXCEEDED,
+                                f"deployment {deployment!r} did not "
+                                f"respond within {wait:.1f}s")
+                        await context.abort(
+                            grpc.StatusCode.INTERNAL,
+                            f"deployment {deployment!r} did not respond "
+                            f"within the proxy's {wait:.1f}s limit")
                     if out is _NOT_FOUND:
                         await context.abort(
                             grpc.StatusCode.NOT_FOUND,

@@ -36,6 +36,34 @@ def test_cached_greedy_matches_full_forward(tiny_model):
             toks.append(expected)
 
 
+def test_speculative_matches_greedy(tiny_model):
+    """Prompt-lookup speculative decoding must reproduce greedy output
+    exactly (the acceptance rule only keeps argmax-agreeing tokens).
+    Repetitive prompts make the n-gram drafter actually fire; a ragged
+    non-repetitive prompt exercises the empty-draft decode fallback."""
+    cfg, params = tiny_model
+    prompts = [[5, 9, 5, 9, 5, 9], [7, 1, 2, 8, 4], [3, 4, 3, 4, 3]]
+    sp = SamplingParams(temperature=0.0, max_tokens=10)
+    greedy = generate(params, cfg, prompts, sp)
+    for k in (2, 4):
+        spec = generate(params, cfg, prompts, sp, speculative=k)
+        assert spec == greedy
+    # stop tokens must truncate identically: reuse a token greedy produced
+    stop = greedy[0][len(greedy[0]) // 2] if greedy[0] else 0
+    sp_stop = SamplingParams(temperature=0.0, max_tokens=10,
+                             stop_token_id=stop)
+    assert (generate(params, cfg, prompts, sp_stop, speculative=3)
+            == generate(params, cfg, prompts, sp_stop))
+
+
+def test_speculative_requires_greedy(tiny_model):
+    cfg, params = tiny_model
+    with pytest.raises(ValueError, match="greedy"):
+        generate(params, cfg, [[1, 2, 3]],
+                 SamplingParams(temperature=0.5, max_tokens=4),
+                 speculative=2)
+
+
 def test_sampling_params(tiny_model):
     cfg, params = tiny_model
     prompts = [[1, 2, 3]]

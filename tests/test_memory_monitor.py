@@ -88,10 +88,26 @@ def test_no_workers_no_victim():
 def test_monitor_threshold_and_rate_limit():
     usage = {"v": (50, 100)}
     mon = MemoryMonitor(usage_fn=lambda: usage["v"], threshold=0.9,
-                        min_kill_interval_s=60.0)
+                        min_kill_interval_s=60.0,
+                        rss_fn=lambda pid: 50)  # workers own the usage
     w = FakeWorker(1, 10.0, owner="a")
     assert mon.maybe_pick_victim([w]) is None  # below threshold
     usage["v"] = (95, 100)
     assert mon.maybe_pick_victim([w]) is w
     # Rate limited: second pressure reading doesn't immediately kill again.
     assert mon.maybe_pick_victim([w]) is None
+
+
+def test_monitor_skips_external_pressure():
+    """Shared-host tenant pushes node memory over the threshold while our
+    workers are tiny: killing them frees nothing, so the monitor abstains."""
+    mon = MemoryMonitor(usage_fn=lambda: (95, 100), threshold=0.9,
+                        min_kill_interval_s=0.0,
+                        rss_fn=lambda pid: 1)  # 1B of 95B used: external
+    w = FakeWorker(1, 10.0, owner="a")
+    assert mon.maybe_pick_victim([w]) is None
+    # Same pressure but the workers own it: kill proceeds.
+    mon2 = MemoryMonitor(usage_fn=lambda: (95, 100), threshold=0.9,
+                         min_kill_interval_s=0.0,
+                         rss_fn=lambda pid: 90)
+    assert mon2.maybe_pick_victim([w]) is w
