@@ -261,9 +261,11 @@ def dashboard_url() -> Optional[str]:
         return None
 
 
+from ray_tpu import internal  # noqa: F401,E402  (owner-driven free, stats)
+
 __all__ = [
     "ObjectRef", "ActorHandle", "init", "shutdown", "is_initialized", "get", "put",
     "wait", "remote", "kill", "cancel", "get_actor", "nodes", "cluster_resources",
     "available_resources", "dashboard_url", "get_runtime_context", "method",
-    "exceptions", "timeline", "__version__",
+    "exceptions", "internal", "timeline", "__version__",
 ]

@@ -92,11 +92,17 @@ def build_args(worker, args: Tuple, kwargs: Dict) -> Tuple[List[TaskArg], List[s
         if isinstance(value, ObjectRef):
             task_args.append(TaskArg(is_ref=True, payload=value))
             continue
-        payload, _refs = serialization.serialize(value)
+        payload, refs = serialization.serialize(value)
         if len(payload) > config.max_inline_object_size:
             ref = worker.put(value)
             task_args.append(TaskArg(is_ref=True, payload=ref))
         else:
+            if refs:
+                # refs nested in an inline arg value: grace-pin them at
+                # their owners until the executing worker deserializes the
+                # arg and registers as a borrower (lifetime hold #3)
+                worker.loop.call_soon_threadsafe(
+                    worker._pin_contained_refs, list(refs))
             task_args.append(TaskArg(is_ref=False, payload=payload))
     return task_args, kw_keys
 

@@ -34,6 +34,17 @@ _FLAG_DEFS: Dict[str, Any] = {
     "max_inline_object_size": 100 * 1024,
     "object_spill_dir": "",
     "object_store_fallback_dir": "",
+    # --- object lifetime (reference_count.h:72, object_recovery_manager.h) ---
+    "reference_counting_enabled": True,
+    # grace window for a ref serialized into a payload whose receiver has
+    # not yet registered as a borrower (the reference forwards borrow
+    # records per-message; a TTL pin is the economy equivalent)
+    "transfer_pin_ttl_s": 60.0,
+    # how many producing TaskSpecs the owner retains for lineage
+    # reconstruction (reference max_lineage_bytes, task_manager.h:182)
+    "lineage_max_entries": 100_000,
+    "ref_event_drain_interval_s": 0.05,
+    "borrower_liveness_interval_s": 30.0,
     # --- scheduling ---
     # hybrid policy threshold (reference scheduler_spread_threshold,
     # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc)

@@ -5,6 +5,11 @@ Equivalent of the reference's ``ObjectRef`` (``python/ray/_raylet.pyx`` /
 ``src/ray/core_worker/reference_count.h:72``).  Each ref carries its owner's
 address so any holder can resolve the value directly from the owner (the
 ownership model: the worker that created an object serves and refcounts it).
+
+Lifetime: refs handed out by the framework (put / task submission /
+deserialization) are *counted* — ``__del__`` reports the drop to the
+CoreWorker's ``ReferenceCounter`` so the owner can free the object once no
+holder remains anywhere (see ``reference_counting.py``).
 """
 
 from __future__ import annotations
@@ -15,12 +20,13 @@ from ray_tpu._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("id", "owner_addr", "_in_band")
+    __slots__ = ("id", "owner_addr", "_in_band", "_counted", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_addr: Optional[str] = None):
         self.id = object_id
         self.owner_addr = owner_addr
         self._in_band = None  # local-mode fast path: value carried inline
+        self._counted = False  # set by the worker when this ref is tracked
 
     def hex(self) -> str:
         return self.id.hex()
@@ -45,18 +51,26 @@ class ObjectRef:
         serialization.note_serialized_ref(self)
         return (_rebuild_ref, (self.id, self.owner_addr))
 
-    def future(self):
-        """Return a concurrent.futures.Future resolving to the value."""
-        import concurrent.futures
-
-        import ray_tpu
-
-        fut: concurrent.futures.Future = concurrent.futures.Future()
+    def __del__(self):
+        if not self._counted:
+            return
         try:
-            fut.set_result(ray_tpu.get(self))
-        except Exception as e:  # noqa: BLE001
-            fut.set_exception(e)
-        return fut
+            from ray_tpu._private import worker as _w
+
+            w = _w.global_worker
+            if w is not None and not w._shutdown:
+                # lock-free: deque.append is GIL-atomic; the worker's IO
+                # loop drains the event queue in FIFO order
+                w._ref_events.append(("del", self.id, self.owner_addr))
+        except Exception:  # interpreter teardown
+            pass
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value
+        (non-blocking: resolution rides the worker's IO loop)."""
+        from ray_tpu._private.worker import get_global_worker
+
+        return get_global_worker().future_for(self)
 
     def __await__(self):
         # Awaitable inside async actors/drivers.
@@ -70,4 +84,17 @@ def _rebuild_ref(object_id, owner_addr):
 
     ref = ObjectRef(object_id, owner_addr)
     serialization.note_deserialized_ref(ref)
+    # Borrow registration: deserializing a ref makes this process a holder
+    # (suppressed for task-spec loads — see serialization.uncounted_refs).
+    if serialization.counting_suppressed():
+        return ref
+    try:
+        from ray_tpu._private import worker as _w
+
+        w = _w.global_worker
+        if w is not None and not w._shutdown:
+            ref._counted = True
+            w._ref_events.append(("add", object_id, owner_addr))
+    except Exception:
+        pass
     return ref

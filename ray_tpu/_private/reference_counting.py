@@ -1,0 +1,265 @@
+"""Distributed object lifetime: ownership-based reference counting.
+
+TPU-native equivalent of the reference's ``ReferenceCounter``
+(``src/ray/core_worker/reference_count.h:72`` — the distributed borrow
+protocol) plus the lineage half of ``TaskManager``
+(``task_manager.h:175-234``) that makes objects reconstructable.
+
+The design keeps the reference's OWNERSHIP model — the process that created
+an object (by ``put`` or by submitting the producing task) owns its
+lifetime, serves its location, and decides when it can be freed — with a
+protocol simplified to three kinds of holds:
+
+1. **Local refs**: live ``ObjectRef`` pythons object in some process.  The
+   owner counts its own; every other process counts its borrowed refs
+   locally and registers itself with the owner as a *borrower* (one
+   registration per process, not per ref — the borrower's local counting
+   collapses the rest).
+2. **Pending task args**: refs serialized into a not-yet-finished task
+   spec.  The submitter holds the spec's arg refs alive until the task
+   reply arrives, so arguments can never be freed mid-flight (the
+   reference's submitted-task count, ``reference_count.h`` borrow-by-task).
+3. **Transfer pins**: a ref serialized into any *other* payload (an object
+   value, an actor message) is pinned at the owner for a grace window,
+   closing the race where the sender drops its ref before the receiver's
+   borrower registration lands (the reference closes this with per-message
+   borrow forwarding; a TTL pin is the economy version, and the receiver's
+   registration releases the pin early).
+
+When every hold reaches zero the owner frees the object: inline payloads
+drop out of its memory store; shm objects are deleted on their node
+(``free_object`` raylet RPC for remote nodes).  If a ref is *recreated*
+after a free — lineage reconstruction (owner resubmits the producing task
+spec, deterministic IDs land the value at the same ObjectID,
+``object_recovery_manager.h:43``) — the table entry is simply rebuilt.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+
+class _Record:
+    """Owner-side lifetime record for one owned object."""
+
+    __slots__ = ("local", "borrowers", "transfer_pins", "lineage_task",
+                 "freed")
+
+    def __init__(self):
+        self.local = 0                  # live ObjectRefs in the owner process
+        self.borrowers: Set[str] = set()  # worker addrs registered as holders
+        self.transfer_pins: List[float] = []  # expiry deadlines of serialize pins
+        self.lineage_task = None        # TaskSpec that produced it (if any)
+        self.freed = False
+
+    def pinned(self, now: float) -> bool:
+        # NOTE: hold #2 (in-flight task args) is enforced by the worker
+        # holding the spec's ObjectRefs alive (_pending_arg_refs), which
+        # shows up here as `local` — there is no separate dep count.
+        if self.local > 0 or self.borrowers:
+            return True
+        self.transfer_pins = [t for t in self.transfer_pins if t > now]
+        return bool(self.transfer_pins)
+
+
+class ReferenceCounter:
+    """Owner-side table + borrower-side local counts for one CoreWorker.
+
+    All mutation happens on the worker's IO loop thread (callers off-loop
+    use ``call_soon_threadsafe``); no locks needed, mirroring the
+    reference's single io_service discipline.
+    """
+
+    def __init__(self, free_fn: Callable[[ObjectID], None],
+                 owner_notify: Callable[[str, Dict[str, Any]], Any]):
+        # free_fn(oid): actually release payload storage (worker-provided).
+        # owner_notify(owner_addr, msg): async RPC fire to a remote owner.
+        self._records: Dict[ObjectID, _Record] = {}
+        self._free_fn = free_fn
+        self._owner_notify = owner_notify
+        # borrower side: my local counts for objects owned elsewhere
+        self._borrowed_local: Dict[ObjectID, int] = {}
+        self._borrowed_owner: Dict[ObjectID, str] = {}
+        self._registered: Set[ObjectID] = set()
+        self._lineage_count = 0
+        self.enabled = bool(getattr(config, "reference_counting_enabled", True))
+
+    # ------------------------------------------------------------- owner side
+
+    def _rec(self, oid: ObjectID) -> _Record:
+        rec = self._records.get(oid)
+        if rec is None:
+            rec = self._records[oid] = _Record()
+        return rec
+
+    def on_owned_ref_created(self, oid: ObjectID):
+        """A live ObjectRef for an object this process owns came into
+        existence (put / task submission / reply deserialization)."""
+        rec = self._rec(oid)
+        rec.local += 1
+        rec.freed = False
+
+    def on_owned_ref_deleted(self, oid: ObjectID):
+        rec = self._records.get(oid)
+        if rec is None:
+            return
+        rec.local -= 1
+        self._maybe_free(oid, rec)
+
+    def set_lineage(self, oid: ObjectID, spec):
+        if self._lineage_count >= int(
+                getattr(config, "lineage_max_entries", 100_000)):
+            return  # bounded retention (reference max_lineage_bytes)
+        rec = self._rec(oid)
+        if rec.lineage_task is None:
+            self._lineage_count += 1
+        rec.lineage_task = spec
+
+    def lineage(self, oid: ObjectID):
+        rec = self._records.get(oid)
+        return rec.lineage_task if rec is not None else None
+
+    def add_borrower(self, oid: ObjectID, addr: str):
+        rec = self._rec(oid)
+        rec.borrowers.add(addr)
+        # a registration also retires one transfer pin (the receiver landed)
+        if rec.transfer_pins:
+            rec.transfer_pins.pop()
+
+    def remove_borrower(self, oid: ObjectID, addr: str):
+        rec = self._records.get(oid)
+        if rec is None:
+            return
+        rec.borrowers.discard(addr)
+        self._maybe_free(oid, rec)
+
+    def drop_borrowers_at(self, addr: str):
+        """A peer died: its borrows die with it (reference: borrower failure
+        handling in reference_count.cc)."""
+        for oid, rec in list(self._records.items()):
+            if addr in rec.borrowers:
+                rec.borrowers.discard(addr)
+                self._maybe_free(oid, rec)
+
+    def add_transfer_pin(self, oid: ObjectID,
+                         ttl: Optional[float] = None):
+        ttl = ttl if ttl is not None else float(
+            getattr(config, "transfer_pin_ttl_s", 60.0))
+        self._rec(oid).transfer_pins.append(time.time() + ttl)
+
+    def _maybe_free(self, oid: ObjectID, rec: _Record):
+        if not self.enabled or rec.freed:
+            return
+        if rec.pinned(time.time()):
+            return
+        rec.freed = True
+        try:
+            self._free_fn(oid)
+        except Exception:  # noqa: BLE001
+            logger.debug("free of %s failed", oid, exc_info=True)
+        # keep the record if it carries lineage (a later borrower fetch can
+        # trigger reconstruction); otherwise forget it entirely
+        if rec.lineage_task is None:
+            self._records.pop(oid, None)
+
+    def on_value_stored(self, oid: ObjectID):
+        """A value landed in storage (task reply / recovery).  If nothing
+        holds the object anymore, free it right away (the caller dropped
+        all refs before the producing task finished); otherwise clear the
+        freed flag — the object is live again after reconstruction."""
+        rec = self._records.get(oid)
+        if rec is None:
+            # no holds ever registered and events are drained: unreachable
+            # value — free immediately (callers drain the event queue
+            # before invoking this, so counts are current)
+            if self.enabled:
+                try:
+                    self._free_fn(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+            return
+        if rec.pinned(time.time()):
+            rec.freed = False
+        else:
+            # the record may already be marked freed (refs dropped before
+            # the task finished) — the just-stored value must still be
+            # released, so clear the flag before freeing
+            rec.freed = False
+            self._maybe_free(oid, rec)
+
+    def force_free(self, oids: List[ObjectID]):
+        """``ray_tpu.internal.free``: immediate owner-driven reclaim,
+        regardless of outstanding references (the caller promises no one
+        will read these again — reference ``ray._private.internal_api.free``)."""
+        for oid in oids:
+            rec = self._records.get(oid)
+            if rec is None:
+                rec = _Record()
+            if not rec.freed:
+                rec.freed = True
+                try:
+                    self._free_fn(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+            # keep lineage-bearing records: a later get() may reconstruct
+            if rec.lineage_task is None:
+                self._records.pop(oid, None)
+
+    def sweep_expired_pins(self):
+        """Periodic: retire expired transfer pins so their objects free."""
+        now = time.time()
+        for oid, rec in list(self._records.items()):
+            if rec.transfer_pins and not rec.freed:
+                self._maybe_free(oid, rec)
+        return now
+
+    # ---------------------------------------------------------- borrower side
+
+    def on_borrowed_ref_created(self, oid: ObjectID, owner_addr: str,
+                                my_addr: str):
+        """A ref owned elsewhere was deserialized in this process.  First
+        sighting registers this process as a borrower with the owner."""
+        n = self._borrowed_local.get(oid, 0)
+        self._borrowed_local[oid] = n + 1
+        self._borrowed_owner[oid] = owner_addr
+        if oid not in self._registered:
+            self._registered.add(oid)
+            self._fire(owner_addr, "add_borrower",
+                       oid=oid.binary(), addr=my_addr)
+
+    def on_borrowed_ref_deleted(self, oid: ObjectID, my_addr: str):
+        n = self._borrowed_local.get(oid, 0) - 1
+        if n > 0:
+            self._borrowed_local[oid] = n
+            return
+        self._borrowed_local.pop(oid, None)
+        owner = self._borrowed_owner.pop(oid, None)
+        if oid in self._registered and owner:
+            self._registered.discard(oid)
+            self._fire(owner, "remove_borrower",
+                       oid=oid.binary(), addr=my_addr)
+
+    def _fire(self, owner_addr: str, method: str, **kw):
+        try:
+            self._owner_notify(owner_addr, {"method": method, **kw})
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------ stats
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "owned": len(self._records),
+            "owned_pinned": sum(
+                1 for r in self._records.values()
+                if r.pinned(time.time())),
+            "borrowed": len(self._borrowed_local),
+        }

@@ -46,6 +46,24 @@ def note_deserialized_ref(ref):
         refs.append(ref)
 
 
+def counting_suppressed() -> bool:
+    return bool(getattr(_local, "uncounted", False))
+
+
+class uncounted_refs:
+    """Deserialize without lifetime counting.  Used for task-spec loading:
+    direct arg refs are pinned by the submitter until the reply and are
+    never handed to user code, so borrow-registering them would only add
+    two owner RPCs per task (see ``object_ref._rebuild_ref``)."""
+
+    def __enter__(self):
+        _local.uncounted = True
+        return self
+
+    def __exit__(self, *exc):
+        _local.uncounted = False
+
+
 class _TrackRefs:
     """Context manager collecting ObjectRefs that cross the boundary."""
 

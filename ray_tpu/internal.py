@@ -1,0 +1,36 @@
+"""Internal APIs: owner-driven object reclaim and lifetime introspection.
+
+Reference: ``python/ray/_private/internal_api.py`` (``free()``,
+``memory_summary()``).  These are power-user APIs — ``free`` reclaims
+objects immediately, bypassing the distributed refcount, on the caller's
+promise that nothing will read them again.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Union
+
+from ray_tpu._private.object_ref import ObjectRef
+
+
+def free(refs: Union[ObjectRef, List[ObjectRef]]) -> None:
+    """Immediately reclaim the storage of the given objects, cluster-wide.
+
+    Unlike dropping references (which frees lazily once no holder remains
+    anywhere), ``free`` deletes now even if references are still live;
+    subsequent ``get`` raises ``ObjectLostError`` unless lineage
+    reconstruction can re-create the value.
+    """
+    from ray_tpu._private.worker import get_global_worker
+
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    worker = get_global_worker()
+    worker.free_objects(refs)
+
+
+def object_lifetime_stats() -> Dict[str, Any]:
+    """Owner-side refcount table stats for this process."""
+    from ray_tpu._private.worker import get_global_worker
+
+    return get_global_worker().ref_counter_stats()

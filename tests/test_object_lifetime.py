@@ -1,0 +1,274 @@
+"""Distributed object lifetime: refcounting, borrowers, lineage, GC.
+
+Reference behaviors covered (VERDICT round-1 item #1):
+``src/ray/core_worker/reference_count.h:72`` (borrow protocol),
+``object_recovery_manager.h:43`` (lineage reconstruction),
+``ray._private.internal_api.free`` (owner-driven reclaim).
+"""
+
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import internal
+from ray_tpu._private.ids import ObjectID, TaskID, JobID
+from ray_tpu._private.reference_counting import ReferenceCounter
+
+
+# --------------------------------------------------------------- pure logic
+
+
+def _counter(freed):
+    return ReferenceCounter(
+        free_fn=freed.append, owner_notify=lambda addr, msg: None)
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.from_put(TaskID.for_driver_task(JobID.from_int(1)), i)
+
+
+def test_local_refcount_frees_at_zero():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(1)
+    rc.on_owned_ref_created(oid)
+    rc.on_owned_ref_created(oid)
+    rc.on_owned_ref_deleted(oid)
+    assert freed == []
+    rc.on_owned_ref_deleted(oid)
+    assert freed == [oid]
+
+
+def test_borrower_keeps_alive():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(2)
+    rc.on_owned_ref_created(oid)
+    rc.add_borrower(oid, "unix:/peer1")
+    rc.on_owned_ref_deleted(oid)
+    assert freed == []  # borrower still registered
+    rc.remove_borrower(oid, "unix:/peer1")
+    assert freed == [oid]
+
+
+def test_borrower_death_releases():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(3)
+    rc.on_owned_ref_created(oid)
+    rc.add_borrower(oid, "unix:/peer1")
+    rc.on_owned_ref_deleted(oid)
+    rc.drop_borrowers_at("unix:/peer1")
+    assert freed == [oid]
+
+
+def test_value_stored_after_refs_dropped_frees():
+    """Fire-and-forget: all refs dropped before the task completes — the
+    landing value must be released immediately, not leaked."""
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(4)
+    rc.on_owned_ref_created(oid)
+    rc.set_lineage(oid, "SPEC")
+    rc.on_owned_ref_deleted(oid)   # freed (nothing stored yet)
+    assert freed == [oid]
+    rc.on_value_stored(oid)        # reply lands afterwards
+    assert freed == [oid, oid]     # stored payload released too
+
+
+def test_transfer_pin_ttl():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(5)
+    rc.on_owned_ref_created(oid)
+    rc.add_transfer_pin(oid, ttl=0.05)
+    rc.on_owned_ref_deleted(oid)
+    assert freed == []  # pin active
+    time.sleep(0.08)
+    rc.sweep_expired_pins()
+    assert freed == [oid]
+
+
+def test_borrower_registration_retires_pin():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(6)
+    rc.on_owned_ref_created(oid)
+    rc.add_transfer_pin(oid, ttl=3600.0)
+    rc.add_borrower(oid, "unix:/peer1")  # receiver landed: pin retired
+    rc.on_owned_ref_deleted(oid)
+    rc.remove_borrower(oid, "unix:/peer1")
+    assert freed == [oid]
+
+
+def test_force_free_ignores_refs():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(7)
+    rc.on_owned_ref_created(oid)
+    rc.force_free([oid])
+    assert freed == [oid]
+
+
+def test_lineage_survives_free():
+    freed = []
+    rc = _counter(freed)
+    oid = _oid(8)
+    rc.on_owned_ref_created(oid)
+    rc.set_lineage(oid, "SPEC")
+    rc.on_owned_ref_deleted(oid)
+    assert freed == [oid]
+    assert rc.lineage(oid) == "SPEC"  # record kept for reconstruction
+
+
+# ------------------------------------------------------------ cluster tests
+
+
+def test_dropping_refs_frees_store(ray_isolated):
+    """(c) from the VERDICT: dropping all refs releases arena/segment space."""
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    payload = np.ones(2 * 1024 * 1024, dtype=np.uint8)  # 2 MiB: shm path
+    ref = ray_tpu.put(payload)
+    oid = ref.id
+    assert worker.shared_store.get_buffer(oid) is not None
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if worker.shared_store.get_buffer(oid) is None:
+            break
+        time.sleep(0.1)
+    assert worker.shared_store.get_buffer(oid) is None
+
+
+def test_task_return_freed_after_drop(ray_isolated):
+    @ray_tpu.remote
+    def produce():
+        return np.zeros(1024 * 1024, dtype=np.uint8)
+
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    ref = produce.remote()
+    assert int(ray_tpu.get(ref).sum()) == 0
+    oid = ref.id
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if worker.shared_store.get_buffer(oid) is None:
+            break
+        time.sleep(0.1)
+    assert worker.shared_store.get_buffer(oid) is None
+
+
+def test_borrower_actor_keeps_object_alive(ray_isolated):
+    """(b) from the VERDICT: a borrower holding a deserialized ref keeps the
+    object alive after the owner's original ref is dropped."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, ref):
+            # ref arrives in a list so the actor borrows rather than the
+            # framework auto-resolving the argument value
+            self.ref = ref[0]
+            return True
+
+        def read_sum(self):
+            return int(ray_tpu.get(self.ref).sum())
+
+        def drop(self):
+            self.ref = None
+            gc.collect()
+            return True
+
+    holder = Holder.remote()
+    ref = ray_tpu.put(np.ones(1024 * 1024, dtype=np.uint8))
+    oid = ref.id
+    assert ray_tpu.get(holder.hold.remote([ref])) is True
+    # give the borrower registration a moment to land, then drop owner ref
+    time.sleep(0.5)
+    del ref
+    gc.collect()
+    time.sleep(1.0)
+    # the borrower must still be able to read the value
+    assert ray_tpu.get(holder.read_sum.remote()) == 1024 * 1024
+    # dropping the borrow releases the object
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    assert ray_tpu.get(holder.drop.remote()) is True
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if worker.shared_store.get_buffer(oid) is None:
+            break
+        time.sleep(0.2)
+    assert worker.shared_store.get_buffer(oid) is None
+
+
+def test_free_and_lineage_reconstruction(ray_isolated):
+    """(a) from the VERDICT: losing a task output triggers transparent
+    lineage re-execution on get (object_recovery_manager.h:43)."""
+    marker_dir = ray_tpu.get(_mkdir_tmp.remote())
+
+    @ray_tpu.remote
+    def produce(tag):
+        # side-channel execution counter: each (re)execution appends
+        with open(os.path.join(marker_dir, f"exec_{tag}"), "a") as f:
+            f.write("x")
+        return np.full(512 * 1024, 7, dtype=np.uint8)
+
+    ref = produce.remote("a")
+    assert int(ray_tpu.get(ref)[0]) == 7
+    # destroy the stored value (simulates losing the node that held it)
+    internal.free(ref)
+    # get() must transparently re-execute the producer task
+    value = ray_tpu.get(ref)
+    assert int(value[0]) == 7 and value.shape == (512 * 1024,)
+    with open(os.path.join(marker_dir, "exec_a")) as f:
+        assert len(f.read()) == 2  # executed exactly twice
+
+
+def test_reconstruction_is_recursive(ray_isolated):
+    """A lost object whose producer's args are also lost re-executes the
+    whole upstream chain."""
+
+    @ray_tpu.remote
+    def base():
+        return np.arange(256 * 1024, dtype=np.int32)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    b = base.remote()
+    d = double.remote(b)
+    assert int(ray_tpu.get(d)[1]) == 2
+    internal.free(d)
+    internal.free(b)
+    assert int(ray_tpu.get(d)[2]) == 4
+
+
+def test_free_without_lineage_raises(ray_isolated):
+    from ray_tpu import exceptions as exc
+
+    ref = ray_tpu.put(np.ones(1024 * 1024, dtype=np.uint8))
+    internal.free(ref)
+    with pytest.raises(exc.ObjectLostError):
+        ray_tpu.get(ref, timeout=10)
+
+
+@ray_tpu.remote
+def _mkdir_tmp():
+    import tempfile
+
+    return tempfile.mkdtemp(prefix="rtpu_lifetime_")
