@@ -190,11 +190,19 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Cancel the task producing ``ref`` (reference
+    ``python/ray/_private/worker.py:3128``).
+
+    Queued tasks are failed with ``TaskCancelledError`` without running.
+    Running tasks get a cancellation raised at their next bytecode boundary
+    (``force=False``) or their worker process killed (``force=True``).
+    ``recursive=True`` also cancels tasks the target submitted.  Cancelling
+    a finished task is a no-op; ``get`` on a cancelled ref raises
+    ``TaskCancelledError``.
+    """
     from ray_tpu._private.worker import get_global_worker
 
-    worker = get_global_worker()
-    # best-effort: pending tasks only (running tasks are not interrupted)
-    logger.warning("cancel() is best-effort for queued tasks")
+    get_global_worker().cancel_task(ref, force=force, recursive=recursive)
 
 
 def nodes() -> List[Dict[str, Any]]:

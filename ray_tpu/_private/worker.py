@@ -170,6 +170,19 @@ class CoreWorker:
         self._freed_tombstones: Dict[ObjectID, bool] = {}
         self._borrower_ping_failures: Dict[str, int] = {}
 
+        # --- cancellation (reference worker.py:3128 ray.cancel) ---
+        self._cancel_requested: set = set()          # TaskIDs
+        self._inflight_specs: Dict[ObjectID, TaskSpec] = {}
+        self._inflight_by_task: Dict[TaskID, TaskSpec] = {}
+        self._task_lease_addr: Dict[TaskID, str] = {}  # pushed tasks
+        self._task_children: Dict[TaskID, List[TaskID]] = {}
+        # execution side: running task -> thread id / asyncio task
+        self._running_task_threads: Dict[TaskID, int] = {}
+        self._running_async_tasks: Dict[TaskID, Any] = {}
+        # serializes async-exc injection vs executor-thread handoff so a
+        # cancel can never be injected into the NEXT task on the thread
+        self._inject_lock = threading.Lock()
+
         # execution side
         self._fn_cache: Dict[bytes, Any] = {}
         self._task_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
@@ -388,6 +401,100 @@ class CoreWorker:
                             oid=r.id.binary())
 
         self.run_coro(_do())
+
+    # ------------------------------------------------------------ cancellation
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False,
+                    recursive: bool = True) -> bool:
+        """Cancel the task that produces ``ref`` (reference
+        ``python/ray/_private/worker.py:3128``).  Queued tasks are failed
+        with TaskCancelledError without running; running tasks get a
+        cancellation raised inside them (``force=True`` kills the leased
+        worker instead); finished tasks are a no-op returning False."""
+        return self.run_coro(
+            self._cancel_async(ref.id, force, recursive,
+                               owner_addr=ref.owner_addr))
+
+    async def _cancel_async(self, oid: ObjectID, force: bool,
+                            recursive: bool, owner_addr: Optional[str] = None
+                            ) -> bool:
+        spec = self._inflight_specs.get(oid)
+        if spec is None:
+            # not submitted from this process: route to the ref's owner
+            # (the reference routes cancel through the owning worker)
+            if owner_addr and owner_addr != self.serve_addr:
+                try:
+                    return await self._peer(owner_addr).call(
+                        "cancel_object_task", oid=oid.binary(), force=force,
+                        recursive=recursive)
+                except Exception:  # noqa: BLE001
+                    return False
+            return False  # already finished (or unknown)
+        return await self._cancel_task_id(spec, force, recursive)
+
+    async def handle_cancel_object_task(self, oid: bytes, force: bool = False,
+                                        recursive: bool = True) -> bool:
+        """Owner-side cancel endpoint for refs borrowed by other processes."""
+        return await self._cancel_async(ObjectID(oid), force, recursive)
+
+    async def _cancel_task_id(self, spec: TaskSpec, force: bool,
+                              recursive: bool) -> bool:
+        task_id = spec.task_id
+        if force and spec.task_type == TaskType.ACTOR_TASK:
+            # killing the actor's process would destroy its state and fail
+            # every other caller — the reference rejects this too
+            raise ValueError(
+                "force=True is not supported for actor tasks; use "
+                "ray_tpu.kill(actor) to destroy the actor itself")
+        self._cancel_requested.add(task_id)
+        if recursive:
+            for child_id in list(self._task_children.get(task_id, [])):
+                child_spec = self._inflight_by_task.get(child_id)
+                if child_spec is not None:
+                    try:
+                        await self._cancel_task_id(child_spec, force,
+                                                   recursive)
+                    except ValueError:  # actor child under force: non-force
+                        await self._cancel_task_id(child_spec, False,
+                                                   recursive)
+        # queued in a lease pool: remove + fail without running
+        key = spec.scheduling_key()
+        pool = self._leases.get(key)
+        if pool is not None and spec in pool.queue:
+            try:
+                pool.queue.remove(spec)
+            except ValueError:
+                pass
+            else:
+                self._fail_task(spec, exc.TaskCancelledError(
+                    f"task {task_id.hex()[:8]} was cancelled"))
+                return True
+        # actor task: forward to the actor's worker
+        if spec.task_type == TaskType.ACTOR_TASK and spec.actor_id:
+            addr = self._actor_addr_cache.get(spec.actor_id)
+            if addr is None:
+                try:
+                    addr = await self.resolve_actor_addr(spec.actor_id,
+                                                         timeout=5.0)
+                except Exception:  # noqa: BLE001
+                    return True  # actor gone: task will fail anyway
+            try:
+                await self._peer(addr).call(
+                    "cancel_task", task_id=task_id.binary(), force=force,
+                    recursive=recursive)
+            except Exception:  # noqa: BLE001
+                pass
+            return True
+        # pushed to a leased worker: forward there
+        addr = self._task_lease_addr.get(task_id)
+        if addr:
+            try:
+                await self._peer(addr).call(
+                    "cancel_task", task_id=task_id.binary(), force=force,
+                    recursive=recursive)
+            except Exception:  # noqa: BLE001
+                pass  # worker died (force): dispatch loop fails the task
+        return True
 
     def ref_counter_stats(self) -> Dict[str, Any]:
         async def _stats():
@@ -645,6 +752,14 @@ class CoreWorker:
         arg_refs = [a.payload for a in spec.args if a.is_ref]
         if arg_refs:
             self._pending_arg_refs[spec.task_id] = arg_refs
+        for oid in spec.return_ids():
+            self._inflight_specs[oid] = spec
+        self._inflight_by_task[spec.task_id] = spec
+        if spec.parent_task_id is not None:
+            # child registry for recursive cancel (this process is the
+            # submitter of its children)
+            self._task_children.setdefault(
+                spec.parent_task_id, []).append(spec.task_id)
         key = spec.scheduling_key()
         pool = self._leases.get(key)
         if pool is None:
@@ -707,6 +822,10 @@ class CoreWorker:
         try:
             while pool.queue:
                 spec = pool.queue.popleft()
+                if spec.task_id in self._cancel_requested:
+                    self._fail_task(spec, exc.TaskCancelledError(
+                        f"task {spec.task_id.hex()[:8]} was cancelled"))
+                    continue
                 if lease.client is None:
                     try:
                         await self._acquire_lease(lease, spec)
@@ -777,9 +896,20 @@ class CoreWorker:
     async def _dispatch_one(self, lease: _Lease, spec: TaskSpec):
         attempt = 0
         while True:
+            if spec.task_id in self._cancel_requested:
+                self._fail_task(spec, exc.TaskCancelledError(
+                    f"task {spec.task_id.hex()[:8]} was cancelled"))
+                return
             if lease.client is None:
                 await self._acquire_lease(lease, spec)
+            if spec.task_id in self._cancel_requested:
+                # cancel landed during lease acquisition — the pre-loop
+                # check has already passed and no worker has the task yet
+                self._fail_task(spec, exc.TaskCancelledError(
+                    f"task {spec.task_id.hex()[:8]} was cancelled"))
+                return
             try:
+                self._task_lease_addr[spec.task_id] = lease.worker_addr
                 reply = await lease.client.call(
                     "push_task", spec_bytes=serialization.dumps(spec), timeout=None
                 )
@@ -789,6 +919,13 @@ class CoreWorker:
                 # leased worker died
                 lease.client = None
                 lease.worker_addr = None
+                if spec.task_id in self._cancel_requested:
+                    # force-cancel kills the leased worker: that death is
+                    # the cancellation, not a crash to retry
+                    self._fail_task(spec, exc.TaskCancelledError(
+                        f"task {spec.task_id.hex()[:8]} was cancelled "
+                        f"(force)"))
+                    return
                 attempt += 1
                 if attempt > max(spec.max_retries, 0):
                     self._fail_task(spec, exc.WorkerCrashedError(
@@ -796,9 +933,31 @@ class CoreWorker:
                     return
                 logger.warning("retrying task %s after worker death (attempt %d)",
                                spec.task_id.hex()[:8], attempt)
+            finally:
+                self._task_lease_addr.pop(spec.task_id, None)
+
+    def _task_done_cleanup(self, spec: TaskSpec):
+        self._pending_arg_refs.pop(spec.task_id, None)
+        self._task_lease_addr.pop(spec.task_id, None)
+        self._task_children.pop(spec.task_id, None)
+        self._cancel_requested.discard(spec.task_id)
+        self._inflight_by_task.pop(spec.task_id, None)
+        # unlink from the parent's child list so long-lived parents (the
+        # driver root especially) don't accumulate finished children
+        if spec.parent_task_id is not None:
+            siblings = self._task_children.get(spec.parent_task_id)
+            if siblings is not None:
+                try:
+                    siblings.remove(spec.task_id)
+                except ValueError:
+                    pass
+                if not siblings:
+                    self._task_children.pop(spec.parent_task_id, None)
+        for oid in spec.return_ids():
+            self._inflight_specs.pop(oid, None)
 
     def _apply_task_reply(self, spec: TaskSpec, reply: Dict):
-        self._pending_arg_refs.pop(spec.task_id, None)
+        self._task_done_cleanup(spec)
         self._drain_ref_events()  # counts current before liveness decision
         for ret in reply["returns"]:
             oid = ObjectID(ret["oid"])
@@ -816,7 +975,7 @@ class CoreWorker:
             self.ref_counter.on_value_stored(oid)
 
     def _fail_task(self, spec: TaskSpec, error: Exception):
-        self._pending_arg_refs.pop(spec.task_id, None)
+        self._task_done_cleanup(spec)
         self._drain_ref_events()
         if not isinstance(error, exc.RayTpuError):
             error = exc.TaskError.from_exception(error)
@@ -863,6 +1022,12 @@ class CoreWorker:
         arg_refs = [a.payload for a in spec.args if a.is_ref]
         if arg_refs:
             self._pending_arg_refs[spec.task_id] = arg_refs
+        for oid in spec.return_ids():
+            self._inflight_specs[oid] = spec
+        self._inflight_by_task[spec.task_id] = spec
+        if spec.parent_task_id is not None:
+            self._task_children.setdefault(
+                spec.parent_task_id, []).append(spec.task_id)
         asyncio.ensure_future(self._push_actor_task(spec))
         return refs
 
@@ -951,14 +1116,26 @@ class CoreWorker:
         return await self._exec_in_thread(spec)
 
     async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None) -> Dict:
+        if spec.task_id in self._cancel_requested:
+            self._cancel_requested.discard(spec.task_id)
+            return self._package_returns(spec, False, exc.TaskCancelledError(
+                f"task {spec.task_id.hex()[:8]} was cancelled"))
         fn = bound_method if bound_method is not None else self._load_function(spec)
         args, kwargs = await self._resolve_args(spec)
 
         def _run():
             token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            # register BEFORE the cancel re-check: a cancel that misses the
+            # check will find the registration and inject; one that lands
+            # before it is caught by the check — no lost window
+            self._running_task_threads[spec.task_id] = threading.get_ident()
             t0 = time.time()
             ok = False
             try:
+                if spec.task_id in self._cancel_requested:
+                    # cancelled while args were resolving / task was queued
+                    raise exc.TaskCancelledError(
+                        f"task {spec.task_id.hex()[:8]} was cancelled")
                 if spec.runtime_env:
                     from ray_tpu import runtime_env as renv
 
@@ -966,11 +1143,25 @@ class CoreWorker:
                         out = True, fn(*args, **kwargs)
                 else:
                     out = True, fn(*args, **kwargs)
+                # deregister under the injection lock while still inside
+                # the try: an already-issued async-exc lands HERE (caught
+                # below as a cancellation), never in the next task that
+                # reuses this thread
+                with self._inject_lock:
+                    self._running_task_threads.pop(spec.task_id, None)
                 ok = True
                 return out
+            except exc.TaskCancelledError as e:
+                # keep the cancellation type intact for the caller's get()
+                return False, e if str(e) else exc.TaskCancelledError(
+                    f"task {spec.task_id.hex()[:8]} was cancelled while "
+                    f"running")
             except BaseException as e:  # noqa: BLE001
                 return False, exc.TaskError.from_exception(e)
             finally:
+                with self._inject_lock:
+                    self._running_task_threads.pop(spec.task_id, None)
+                self._cancel_requested.discard(spec.task_id)
                 _exec_ctx.reset(token)
                 self._record_task_event(spec, t0, time.time(), ok)
 
@@ -1146,6 +1337,13 @@ class CoreWorker:
             await waiter
 
     async def _exec_actor_method(self, spec: TaskSpec) -> Dict:
+        if spec.task_id in self._cancel_requested:
+            # cancelled while queued in the ordered scheduling queue: reply
+            # without executing (sequence numbers still advance, so later
+            # tasks from the same caller are unaffected)
+            self._cancel_requested.discard(spec.task_id)
+            return self._package_returns(spec, False, exc.TaskCancelledError(
+                f"task {spec.task_id.hex()[:8]} was cancelled"))
         name = spec.function.method_name
         if name == "__ray_terminate__":
             asyncio.ensure_future(self._terminate_self())
@@ -1175,15 +1373,29 @@ class CoreWorker:
                     limit = max(1, (self._actor_spec.max_concurrency
                                     if self._actor_spec else 1000))
                     self._concurrency_sema = asyncio.Semaphore(limit)
-                async with self._concurrency_sema:
-                    token = _exec_ctx.set(
-                        ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
-                    try:
-                        return True, await method(*args, **kwargs)
-                    except BaseException as e:  # noqa: BLE001
-                        return False, exc.TaskError.from_exception(e)
-                    finally:
-                        _exec_ctx.reset(token)
+                # register before the sema wait so a cancel arriving while
+                # queued on the semaphore still finds and cancels this task
+                self._running_async_tasks[spec.task_id] = (
+                    asyncio.current_task())
+                try:
+                    async with self._concurrency_sema:
+                        token = _exec_ctx.set(
+                            ExecutionContext(spec.task_id, spec.job_id,
+                                             spec.actor_id))
+                        try:
+                            if spec.task_id in self._cancel_requested:
+                                raise asyncio.CancelledError()
+                            return True, await method(*args, **kwargs)
+                        finally:
+                            _exec_ctx.reset(token)
+                except asyncio.CancelledError:
+                    return False, exc.TaskCancelledError(
+                        f"task {spec.task_id.hex()[:8]} was cancelled")
+                except BaseException as e:  # noqa: BLE001
+                    return False, exc.TaskError.from_exception(e)
+                finally:
+                    self._running_async_tasks.pop(spec.task_id, None)
+                    self._cancel_requested.discard(spec.task_id)
 
             assert self._user_loop is not None, "async method on non-async actor"
             cfut = asyncio.run_coroutine_threadsafe(_run_coro(), self._user_loop)
@@ -1248,10 +1460,51 @@ class CoreWorker:
         asyncio.ensure_future(self._terminate_self())
         return True
 
-    async def handle_cancel_task(self, task_id: bytes) -> bool:
-        # Best-effort: running tasks are not interrupted (matching the
-        # reference's non-force cancel semantics for already-running work).
-        return False
+    async def handle_cancel_task(self, task_id: bytes, force: bool = False,
+                                 recursive: bool = False) -> bool:
+        """Executing-side cancel: interrupt the running task (async-exc
+        injection into its executor thread, asyncio cancel for async actor
+        methods, process kill on force), mark queued ones, and recurse into
+        children this worker submitted."""
+        tid = TaskID(task_id)
+        self._cancel_requested.add(tid)
+        if recursive:
+            for child_id in list(self._task_children.get(tid, [])):
+                child_spec = self._inflight_by_task.get(child_id)
+                if child_spec is not None:
+                    try:
+                        await self._cancel_task_id(child_spec, force,
+                                                   recursive)
+                    except ValueError:
+                        await self._cancel_task_id(child_spec, False,
+                                                   recursive)
+        if force:
+            # the reference kills the worker process on force=True; the
+            # submitter's cancelled set turns the death into
+            # TaskCancelledError instead of a retry
+            asyncio.ensure_future(self._terminate_self())
+            return True
+        atask = self._running_async_tasks.get(tid)
+        if atask is not None:
+            self._user_loop.call_soon_threadsafe(atask.cancel)
+            return True
+        import ctypes
+
+        # raise TaskCancelledError inside the executing thread at its next
+        # bytecode boundary (CPython async-exception mechanism — same
+        # behavior as the reference's KeyboardInterrupt injection for
+        # non-force cancel).  The lock pairs with _run's deregistration so
+        # the exception can never land in the NEXT task on the thread.
+        with self._inject_lock:
+            tid_thread = self._running_task_threads.get(tid)
+            if tid_thread is not None:
+                res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid_thread),
+                    ctypes.py_object(exc.TaskCancelledError))
+                if res > 1:  # per CPython docs: undo and give up
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(tid_thread), None)
+        return True  # queued here: _exec paths check _cancel_requested
 
     # ---------------------------------------------------------------- shutdown
 
