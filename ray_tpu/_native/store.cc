@@ -33,6 +33,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <deque>
 #include <mutex>
 #include <string>
@@ -391,7 +392,11 @@ int rtpu_store_unlink(const char* name) {
 
 // allocate an (unsealed) object; returns payload offset or -errno.
 // -EEXIST: already present (sealed or in progress). -ENOMEM: won't fit.
-int64_t rtpu_store_alloc(int hi, const uint8_t* id, uint64_t size) {
+// no_evict=1: return -ENOMEM instead of destructively LRU-evicting
+// refcount-0 sealed objects — the caller (spill manager) persists them to
+// disk first, then retries with no_evict=0.
+int64_t rtpu_store_alloc(int hi, const uint8_t* id, uint64_t size,
+                         uint32_t no_evict) {
   Handle* h = get_handle(hi);
   if (!h) return -EBADF;
   if (lock(*h) != 0) return -EDEADLK;
@@ -408,7 +413,7 @@ int64_t rtpu_store_alloc(int hi, const uint8_t* id, uint64_t size) {
     result = -EEXIST;
   } else {
     uint64_t off = arena_alloc(*h, size);
-    if (!off && evict_for(*h, size)) off = arena_alloc(*h, size);
+    if (!off && !no_evict && evict_for(*h, size)) off = arena_alloc(*h, size);
     if (!off) {
       result = -ENOMEM;
     } else {
@@ -530,6 +535,31 @@ int rtpu_store_delete(int hi, const uint8_t* id) {
   }
   unlock(*h);
   return rc;
+}
+
+// enumerate evictable objects (sealed, refcount==0) in LRU order.
+// out_ids receives up to max_n 16-byte ids; returns the count written.
+// Used by the spill manager to persist cold released objects to disk
+// BEFORE pressure-driven eviction destroys them (reference:
+// LocalObjectManager::SpillObjects, local_object_manager.h:42).
+int64_t rtpu_store_evictable(int hi, uint8_t* out_ids, uint64_t max_n) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  Header* H = hdr(*h);
+  // collect (lru_tick, index) of candidates, then emit oldest-first
+  std::vector<std::pair<uint64_t, uint64_t>> cands;
+  for (uint64_t i = 0; i < H->table_capacity; i++) {
+    Entry* e = &table(*h)[i];
+    if (e->state == kSealed && e->refcount == 0)
+      cands.emplace_back(e->lru_tick, i);
+  }
+  std::sort(cands.begin(), cands.end());
+  uint64_t n = cands.size() < max_n ? cands.size() : max_n;
+  for (uint64_t k = 0; k < n; k++)
+    memcpy(out_ids + 16 * k, table(*h)[cands[k].second].id, 16);
+  unlock(*h);
+  return (int64_t)n;
 }
 
 // stats: [capacity, used, num_objects, num_evictions]

@@ -50,6 +50,7 @@ class GcsServer:
         self._pending_actors: List[bytes] = []
         self._pending_pgs: List[bytes] = []
         self._events: List[Dict[str, Any]] = []  # pubsub feed with seq numbers
+        self._event_base = 0  # absolute seq of _events[0] (snapshot truncation)
         self.task_events: List[Dict[str, Any]] = []  # task profile feed
         self._event_waiters: List[asyncio.Future] = []
         self._tasks: List[asyncio.Task] = []
@@ -59,6 +60,20 @@ class GcsServer:
 
         self.job_manager = JobManager(session_dir, lambda: self.addr)
 
+        # --- fault tolerance: file-backed table persistence --------------
+        # Reference: GcsTableStorage over RedisStoreClient
+        # (src/ray/gcs/store_client/redis_store_client.h:111); here the
+        # pluggable store is "memory" (default) or "file" — a debounced
+        # whole-table snapshot, reloaded on restart so a GCS crash doesn't
+        # lose the cluster (nodes re-attach via heartbeats, actors stay
+        # resolvable, named actors / jobs / PGs / KV survive).
+        self._persist_enabled = config.gcs_storage == "file"
+        self._storage_path = (config.gcs_storage_path
+                              or f"{session_dir}/gcs_state.pkl")
+        self._last_snapshot: bytes = b""
+        if self._persist_enabled:
+            self._load_snapshot()
+
         self.server.register_all(self)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -66,7 +81,90 @@ class GcsServer:
         self.addr = f"tcp:{bound_host}:{bound_port}"
         self._tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._tasks.append(asyncio.ensure_future(self._retry_pending_loop()))
+        if self._persist_enabled:
+            self._tasks.append(asyncio.ensure_future(self._persist_loop()))
         logger.info("gcs up at %s", self.addr)
+
+    # ------------------------------------------------------- persistence
+
+    _SNAPSHOT_TABLES = ("kv", "nodes", "actors", "named_actors", "jobs",
+                        "pgs", "workers")
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        state = {t: getattr(self, t) for t in self._SNAPSHOT_TABLES}
+        # volatile per-heartbeat fields excluded: they'd defeat the
+        # debounce and churn a full disk write every 250ms on idle clusters
+        state["nodes"] = {
+            nid: {k: v for k, v in n.items()
+                  if k not in ("last_heartbeat", "pending_demand")}
+            for nid, n in self.nodes.items()
+        }
+        state["_job_counter"] = self._job_counter
+        # keep the event feed tail so subscriber seq numbers stay monotonic
+        state["_events"] = self._events[-10_000:]
+        state["_event_base"] = self._event_base + max(
+            0, len(self._events) - 10_000)
+        return state
+
+    def _write_snapshot(self):
+        import os
+        import pickle
+
+        blob = pickle.dumps(self._snapshot_state())
+        if blob == self._last_snapshot:
+            return
+        tmp = f"{self._storage_path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._storage_path)  # atomic
+        self._last_snapshot = blob
+
+    async def _persist_loop(self):
+        while not self._stopping:
+            await asyncio.sleep(0.25)
+            try:
+                self._write_snapshot()
+            except Exception:  # noqa: BLE001
+                logger.debug("gcs snapshot failed", exc_info=True)
+
+    def _load_snapshot(self):
+        import os
+        import pickle
+
+        if not os.path.exists(self._storage_path):
+            return
+        try:
+            with open(self._storage_path, "rb") as f:
+                state = pickle.load(f)
+        except Exception:  # noqa: BLE001
+            logger.warning("gcs snapshot unreadable; starting fresh",
+                           exc_info=True)
+            return
+        for t in self._SNAPSHOT_TABLES:
+            getattr(self, t).update(state.get(t, {}))
+        self._job_counter = state.get("_job_counter", 0)
+        self._events = list(state.get("_events", []))
+        self._event_base = state.get("_event_base", 0)
+        now = time.time()
+        for node in self.nodes.values():
+            # grace period: raylets re-attach via their next heartbeat —
+            # stale snapshot timestamps must not mark everyone dead at boot
+            node["last_heartbeat"] = now
+            node.setdefault("pending_demand", [])
+            node.setdefault("available", dict(node.get("total", {})))
+        # re-enqueue work that was mid-flight when the snapshot was taken:
+        # the pending queues are process memory, so actors/PGs persisted in
+        # non-terminal states must be rescheduled or their waiters hang
+        for actor_id, info in self.actors.items():
+            if info.get("state") in ("PENDING_CREATION", "RESTARTING"):
+                self._pending_actors.append(actor_id)
+        for pg_id, info in self.pgs.items():
+            if info.get("state") == "PENDING":
+                self._pending_pgs.append(pg_id)
+        logger.info(
+            "gcs state restored from %s: %d nodes, %d actors, %d jobs",
+            self._storage_path, len(self.nodes), len(self.actors),
+            len(self.jobs))
 
     def _raylet(self, node_id: str) -> Optional[RpcClient]:
         node = self.nodes.get(node_id)
@@ -80,7 +178,8 @@ class GcsServer:
         return client
 
     def _publish(self, channel: str, data: Dict[str, Any]):
-        self._events.append({"seq": len(self._events), "channel": channel,
+        self._events.append({"seq": self._event_base + len(self._events),
+                             "channel": channel,
                              "time": time.time(), **data})
         for w in self._event_waiters:
             if not w.done():
@@ -123,13 +222,24 @@ class GcsServer:
                                pending: Optional[List[Dict[str, float]]] = None
                                ) -> Dict:
         node = self.nodes.get(node_id)
-        if node is not None:
-            freed = node["available"] != available
-            node["available"] = available
-            node["pending_demand"] = pending or []
-            node["last_heartbeat"] = time.time()
-            if freed:
-                self._kick_pending()
+        if node is None:
+            # a GCS that restarted WITHOUT persistence doesn't know this
+            # raylet: tell it to re-register (reference: raylets surviving
+            # GCS restart re-sync from GcsInitData)
+            return {"nodes": self._cluster_view(), "unknown": True}
+        freed = node["available"] != available
+        node["available"] = available
+        node["pending_demand"] = pending or []
+        node["last_heartbeat"] = time.time()
+        if not node["alive"]:
+            # heartbeat from a node marked dead during a GCS outage window:
+            # it's alive after all — resurrect it
+            node["alive"] = True
+            self._publish("nodes", {"event": "node_added",
+                                    "node_id": node_id})
+            self._kick_pending()
+        if freed:
+            self._kick_pending()
         return {"nodes": self._cluster_view()}
 
     def _cluster_view(self) -> List[Dict[str, Any]]:
@@ -592,10 +702,12 @@ class GcsServer:
         """Long-poll pubsub (reference src/ray/pubsub long-poll protocol)."""
         deadline = asyncio.get_event_loop().time() + timeout
         while True:
-            events = [e for e in self._events[cursor:]
+            start = max(0, cursor - self._event_base)  # cursor is absolute
+            events = [e for e in self._events[start:]
                       if channel is None or e["channel"] == channel]
             if events or asyncio.get_event_loop().time() >= deadline:
-                return {"events": events, "cursor": len(self._events)}
+                return {"events": events,
+                        "cursor": self._event_base + len(self._events)}
             fut = asyncio.get_event_loop().create_future()
             self._event_waiters.append(fut)
             try:
@@ -623,6 +735,19 @@ class GcsServer:
     async def handle_shutdown_cluster(self) -> bool:
         asyncio.ensure_future(self.stop_cluster())
         return True
+
+    async def stop(self):
+        """Stop THIS GCS server only (nodes keep running — the GCS-restart
+        FT path; contrast stop_cluster)."""
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        if self._persist_enabled:
+            try:  # final snapshot: a clean stop must not lose the last
+                self._write_snapshot()  # debounce window of mutations
+            except Exception:  # noqa: BLE001
+                logger.debug("final gcs snapshot failed", exc_info=True)
+        await self.server.close()
 
     async def stop_cluster(self):
         self._stopping = True

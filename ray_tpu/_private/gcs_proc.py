@@ -1,0 +1,53 @@
+"""Standalone GCS server process.
+
+Reference: ``gcs_server`` as its own binary
+(``src/ray/gcs/gcs_server/gcs_server_main.cc``).  The default single-host
+topology hosts GCS + head raylet in one process (``head_proc.py``); this
+entry exists for deployments and tests that need the GCS restartable
+independently of any raylet — the GCS fault-tolerance path
+(``gcs_storage="file"``).
+
+Prints one JSON line ``{"addr": ..., "port": ...}`` on stdout when ready.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from ray_tpu._private.gcs import GcsServer
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    gcs = GcsServer(args.session_dir)
+
+    async def _start():
+        await gcs.start(port=args.port)
+        host, port = gcs.addr[len("tcp:"):].rsplit(":", 1)
+        print(json.dumps({"addr": gcs.addr, "port": int(port)}), flush=True)
+
+    loop.run_until_complete(_start())
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,7 +46,7 @@ def _load_lib():
         lib.rtpu_store_unlink.argtypes = [ctypes.c_char_p]
         lib.rtpu_store_unlink.restype = ctypes.c_int
         lib.rtpu_store_alloc.argtypes = [ctypes.c_int, ctypes.c_char_p,
-                                         ctypes.c_uint64]
+                                         ctypes.c_uint64, ctypes.c_uint32]
         lib.rtpu_store_alloc.restype = ctypes.c_int64
         lib.rtpu_store_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.rtpu_store_seal.restype = ctypes.c_int
@@ -65,6 +65,9 @@ def _load_lib():
         lib.rtpu_store_stats.argtypes = [ctypes.c_int,
                                          ctypes.POINTER(ctypes.c_uint64 * 4)]
         lib.rtpu_store_stats.restype = ctypes.c_int
+        lib.rtpu_store_evictable.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                             ctypes.c_uint64]
+        lib.rtpu_store_evictable.restype = ctypes.c_int64
         _lib = lib
         return lib
 
@@ -114,11 +117,15 @@ class NativeArenaStore:
                              lambda view: view.__setitem__(
                                  slice(0, len(payload)), payload))
 
-    def put_into(self, object_id: ObjectID, nbytes: int, write_fn) -> str:
+    def put_into(self, object_id: ObjectID, nbytes: int, write_fn,
+                 no_evict: bool = False) -> str:
         """Alloc → ``write_fn(view)`` writes the payload in place → seal.
-        Serialization packs straight into the arena (no staging copy)."""
+        Serialization packs straight into the arena (no staging copy).
+        ``no_evict`` returns MemoryError instead of destructively evicting
+        refcount-0 objects (the spill manager persists them first)."""
         oid = object_id.binary()
-        off = self._lib.rtpu_store_alloc(self._h, oid, nbytes)
+        off = self._lib.rtpu_store_alloc(self._h, oid, nbytes,
+                                         1 if no_evict else 0)
         if off == -17:  # EEXIST
             # idempotent only if the existing entry is actually readable
             # (a pending-delete entry is invisible — let the caller fall
@@ -172,6 +179,16 @@ class NativeArenaStore:
     def get_bytes(self, object_id: ObjectID) -> Optional[bytes]:
         buf = self.get_buffer(object_id)
         return None if buf is None else bytes(buf)
+
+    def evictable(self, max_n: int = 256) -> List[ObjectID]:
+        """Sealed refcount-0 objects in LRU order (spill candidates —
+        reference LocalObjectManager::SpillObjects)."""
+        buf = ctypes.create_string_buffer(16 * max_n)
+        n = self._lib.rtpu_store_evictable(self._h, buf, max_n)
+        if n <= 0:
+            return []
+        raw = buf.raw
+        return [ObjectID(raw[16 * i:16 * (i + 1)]) for i in range(n)]
 
     def release(self, object_id: ObjectID):
         self._lib.rtpu_store_release(self._h, object_id.binary())

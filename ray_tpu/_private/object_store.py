@@ -189,6 +189,102 @@ def arena_name_for(session_dir: str) -> str:
     return f"/rtpu_arena_{tag}"
 
 
+class SpillStore:
+    """Disk-backed object spill directory (reference:
+    ``src/ray/raylet/local_object_manager.h:42`` +
+    ``python/ray/_private/external_storage.py`` filesystem backend).
+
+    One file per object — ``[u64 payload_len | payload]`` (the header keeps
+    zero-length objects representable and mmap-able) — written atomically
+    (tmp + rename) so concurrent spillers of the same object are
+    idempotent.  All node-local processes share the directory, so any of
+    them can restore on get.
+    """
+
+    _HDR = 8
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, object_id: ObjectID) -> str:
+        return os.path.join(self.dir, shm_name_for(object_id))
+
+    def put_bytes(self, object_id: ObjectID, payload) -> None:
+        import struct
+
+        tmp = f"{self._path(object_id)}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<Q", len(payload)))
+            f.write(payload)
+        os.replace(tmp, self._path(object_id))
+
+    def put_into(self, object_id: ObjectID, nbytes: int, write_fn) -> None:
+        """Single-copy spill write: ``write_fn`` packs straight into the
+        mmapped file."""
+        import mmap as _mmap
+        import struct
+
+        tmp = f"{self._path(object_id)}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.truncate(self._HDR + nbytes)
+        with open(tmp, "r+b") as f:
+            mm = _mmap.mmap(f.fileno(), self._HDR + nbytes)
+            try:
+                struct.pack_into("<Q", mm, 0, nbytes)
+                write_fn(memoryview(mm)[self._HDR:self._HDR + nbytes])
+                mm.flush()
+            finally:
+                mm.close()
+        os.replace(tmp, self._path(object_id))
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return os.path.exists(self._path(object_id))
+
+    def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
+        import mmap as _mmap
+        import struct
+
+        try:
+            with open(self._path(object_id), "rb") as f:
+                mm = _mmap.mmap(f.fileno(), 0, prot=_mmap.PROT_READ)
+        except (FileNotFoundError, ValueError):
+            return None
+        (nbytes,) = struct.unpack_from("<Q", mm, 0)
+        return memoryview(mm)[self._HDR:self._HDR + nbytes]
+
+    def delete(self, object_id: ObjectID) -> None:
+        try:
+            os.unlink(self._path(object_id))
+        except FileNotFoundError:
+            pass
+
+    def destroy(self) -> None:
+        """Remove this session's entire spill tree (session teardown)."""
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def stats(self) -> Dict[str, Any]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return {"spilled_objects": 0, "spilled_bytes": 0}
+        total = 0
+        count = 0
+        for n in names:
+            if n.startswith("rtpu_") and not n.count(".tmp"):
+                try:
+                    total += max(
+                        0,
+                        os.path.getsize(os.path.join(self.dir, n))
+                        - self._HDR)
+                    count += 1
+                except OSError:
+                    pass
+        return {"spilled_objects": count, "spilled_bytes": total}
+
+
 class HybridObjectStore:
     """Arena-first store: puts go into the node's C++ shm arena
     (``ray_tpu/_native/store.cc`` — one mmap, boundary-tag allocator, no
@@ -212,6 +308,19 @@ class HybridObjectStore:
         self.segments = SharedObjectStore()
         self.arena = None
         self._arena_max = 0
+        # spill tier (reference local_object_manager.h:42): cold released
+        # objects and arena/shm overflow land in a shared on-disk directory
+        # and are restored on get.  object_spill_dir overrides the default
+        # location; either way the files live in a SESSION-scoped subdir so
+        # teardown can reclaim them and sessions never collide.
+        base = getattr(config, "object_spill_dir", "") or os.path.join(
+            session_dir, "spill")
+        spill_dir = os.path.join(
+            base, os.path.basename(session_dir.rstrip("/")) or "session")
+        try:
+            self.spill: Optional[SpillStore] = SpillStore(spill_dir)
+        except OSError:
+            self.spill = None
         if getattr(config, "use_native_arena_store", True):
             try:
                 from ray_tpu._private import native_store
@@ -228,27 +337,79 @@ class HybridObjectStore:
                 logger.debug("native arena store unavailable", exc_info=True)
                 self.arena = None
 
+    def _spill_cold_objects(self, max_n: int = 64) -> int:
+        """Persist evictable (sealed, refcount-0) arena objects to disk so
+        pressure-driven LRU eviction can't destroy data, then delete them
+        from the arena to make room.  Returns objects spilled."""
+        if self.arena is None or self.spill is None:
+            return 0
+        spilled = 0
+        # drain ALL candidates (multiple rounds): anything left evictable
+        # when the caller retries with destructive eviction would be lost
+        for _round in range(64):
+            batch = self.arena.evictable(max_n)
+            if not batch:
+                break
+            progressed = False
+            for oid in batch:
+                # pin so the bytes can't be evicted mid-copy
+                if not self.arena.pin(oid):
+                    continue
+                try:
+                    buf = self.arena.get_buffer(oid)
+                    if buf is not None and not self.spill.contains(oid):
+                        self.spill.put_bytes(oid, buf)
+                        spilled += 1
+                except OSError:
+                    logger.warning("spill write failed", exc_info=True)
+                    self.arena.release(oid)
+                    return spilled
+                self.arena.release(oid)
+                self.arena.delete(oid)
+                progressed = True
+            if not progressed:
+                break
+        if spilled:
+            logger.info("spilled %d cold objects to %s", spilled,
+                        self.spill.dir)
+        return spilled
+
     # -- writes ---------------------------------------------------------------
 
     def put_serialized(self, object_id: ObjectID, payload: bytes) -> str:
-        if self.arena is not None and len(payload) <= self._arena_max:
-            try:
-                # seal retains the creator pin (refcount 1): no eviction
-                # window, and duplicate puts don't stack extra pins
-                return self.arena.put_serialized(object_id, payload)
-            except MemoryError:
-                pass  # arena full: segment fallback below
-        return self.segments.put_serialized(object_id, payload)
+        return self.put_into(object_id, len(payload),
+                             lambda view: view.__setitem__(
+                                 slice(0, len(payload)), payload))
 
     def put_into(self, object_id: ObjectID, nbytes: int, write_fn) -> str:
         """Single-copy write path: the serializer packs directly into the
-        arena/segment memory instead of staging a bytes payload."""
+        arena/segment/spill memory instead of staging a bytes payload."""
         if self.arena is not None and nbytes <= self._arena_max:
             try:
-                return self.arena.put_into(object_id, nbytes, write_fn)
+                # seal retains the creator pin (refcount 1): no eviction
+                # window, and duplicate puts don't stack extra pins.
+                # no_evict: under pressure we want the MemoryError so cold
+                # objects are SPILLED to disk, not destroyed by LRU evict.
+                return self.arena.put_into(object_id, nbytes, write_fn,
+                                           no_evict=True)
             except MemoryError:
-                pass
-        return self.segments.put_into(object_id, nbytes, write_fn)
+                # arena pressure: spill cold released objects to disk and
+                # retry (destructive eviction allowed as the last resort)
+                self._spill_cold_objects()
+                try:
+                    return self.arena.put_into(object_id, nbytes, write_fn)
+                except MemoryError:
+                    pass
+        try:
+            return self.segments.put_into(object_id, nbytes, write_fn)
+        except OSError:
+            # /dev/shm exhausted: last tier is the disk spill directory
+            if self.spill is None:
+                raise
+            logger.warning("shm exhausted: writing %s (%d B) to spill dir",
+                           object_id.hex()[:12], nbytes)
+            self.spill.put_into(object_id, nbytes, write_fn)
+            return "spill"
 
     def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int, List]:
         core, raw_bufs, refs, total = serialization.serialize_parts(value)
@@ -262,14 +423,46 @@ class HybridObjectStore:
     def contains(self, object_id: ObjectID) -> bool:
         if self.arena is not None and self.arena.contains(object_id):
             return True
-        return self.segments.contains(object_id)
+        if self.segments.contains(object_id):
+            return True
+        return self.spill is not None and self.spill.contains(object_id)
 
     def get_buffer(self, object_id: ObjectID) -> Optional[memoryview]:
         if self.arena is not None:
             buf = self.arena.get_buffer(object_id)
             if buf is not None:
                 return buf
-        return self.segments.get_buffer(object_id)
+        buf = self.segments.get_buffer(object_id)
+        if buf is not None:
+            return buf
+        if self.spill is not None:
+            buf = self.spill.get_buffer(object_id)
+            if buf is not None:
+                # restore on get: promote back into the arena when it fits
+                # so repeated reads are shm-speed again (reference:
+                # restore_spilled_objects).  no_evict: restoring must not
+                # destructively evict OTHER not-yet-spilled cold objects.
+                # The fresh creator pin is released immediately (the object
+                # was already cold/unpinned when spilled) and the disk copy
+                # is kept as the durable tier, so a later re-eviction of
+                # the promoted copy can never lose data; delete() clears
+                # both copies at end of life.
+                if self.arena is not None and len(buf) <= self._arena_max:
+                    try:
+                        n = len(buf)
+                        self.arena.put_into(
+                            object_id, n,
+                            lambda view, b=buf: view.__setitem__(
+                                slice(0, n), b),
+                            no_evict=True)
+                        self.arena.release(object_id)
+                        restored = self.arena.get_buffer(object_id)
+                        if restored is not None:
+                            return restored
+                    except MemoryError:
+                        pass
+                return buf
+        return None
 
     def get(self, object_id: ObjectID) -> Tuple[Any, List]:
         buf = self.get_buffer(object_id)
@@ -293,14 +486,22 @@ class HybridObjectStore:
             self.arena.release(object_id)  # drop creator pin
             self.arena.delete(object_id)
         self.segments.delete(object_id)
+        if self.spill is not None:
+            self.spill.delete(object_id)
 
     def stats(self) -> Dict[str, Any]:
-        return self.arena.stats() if self.arena is not None else {}
+        out = self.arena.stats() if self.arena is not None else {}
+        if self.spill is not None:
+            out.update(self.spill.stats())
+        return out
 
     def close(self, unlink_created: bool = True):
         if self.arena is not None:
             self.arena.close(unlink_created=False)  # node owns arena lifetime
         self.segments.close(unlink_created=unlink_created)
+        if unlink_created and self.spill is not None:
+            # session teardown owns the session-scoped spill subtree
+            self.spill.destroy()
 
 
 def make_shared_store(session_dir: str):

@@ -134,6 +134,13 @@ class Raylet:
                              list(self._lease_waiters)[:100]],
                 )
                 self.cluster_view = reply.get("nodes", [])
+                if reply.get("unknown"):
+                    # GCS restarted without our registration: re-attach
+                    logger.info("gcs forgot this node: re-registering")
+                    await self.gcs.call(
+                        "register_node", node_id=self.node_id,
+                        addr=self.addr, resources=self.total.to_dict(),
+                        labels=self.labels, node_name=self.node_name)
             except Exception as e:  # noqa: BLE001
                 logger.debug("heartbeat failed: %s", e)
             await asyncio.sleep(period)

@@ -1,0 +1,248 @@
+"""GCS file persistence + object spilling (VERDICT round-1 item #2).
+
+Reference: ``GcsTableStorage`` over ``RedisStoreClient``
+(``src/ray/gcs/store_client/redis_store_client.h:111``) and
+``LocalObjectManager`` spilling (``src/ray/raylet/local_object_manager.h:42``).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    HybridObjectStore,
+    SpillStore,
+    arena_name_for,
+)
+
+
+# ------------------------------------------------------------- GCS snapshot
+
+
+def _mk_session(tmp):
+    os.makedirs(os.path.join(tmp, "sockets"), exist_ok=True)
+    os.makedirs(os.path.join(tmp, "logs"), exist_ok=True)
+    return tmp
+
+
+def test_gcs_snapshot_roundtrip(monkeypatch, tmp_path):
+    """Tables written by one GcsServer instance are visible in a fresh one
+    pointed at the same storage path."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+
+    session = _mk_session(str(tmp_path))
+    config.reload({"gcs_storage": "file"})
+    try:
+        loop = asyncio.new_event_loop()
+
+        async def phase1():
+            gcs = GcsServer(session)
+            await gcs.start(port=0)
+            # populate a few tables through handlers
+            await gcs.handle_register_node(
+                node_id="n1", addr="tcp:127.0.0.1:1", resources={"CPU": 4},
+                labels={})
+            await gcs.handle_kv_put(ns="test", key="k", value=b"v")
+            await gcs.handle_add_job(job_id=7, info={"driver_pid": 1})
+            # wait for a snapshot write
+            for _ in range(40):
+                await asyncio.sleep(0.1)
+                if os.path.exists(gcs._storage_path):
+                    break
+            assert os.path.exists(gcs._storage_path)
+            await gcs.stop()
+
+        loop.run_until_complete(phase1())
+
+        async def phase2():
+            gcs2 = GcsServer(session)  # loads the snapshot in __init__
+            assert "n1" in gcs2.nodes
+            assert gcs2.nodes["n1"]["total"] == {"CPU": 4}
+            assert await gcs2.handle_kv_get(ns="test", key="k") == b"v"
+            assert 7 in gcs2.jobs
+
+        loop.run_until_complete(phase2())
+        loop.close()
+    finally:
+        config.reload()
+
+
+def test_gcs_process_restart_actors_survive(monkeypatch, tmp_path):
+    """Kill -9 the standalone GCS, restart it on the same port with the
+    same storage: the driver reconnects, named actors resolve, and the
+    still-running actor keeps serving calls."""
+    import ray_tpu
+
+    session = _mk_session(str(tmp_path / "session"))
+    os.makedirs(session, exist_ok=True)
+    _mk_session(session)
+    env = dict(os.environ)
+    env["RAY_TPU_GCS_STORAGE"] = "file"
+    env["RAY_TPU_DASHBOARD"] = "0"
+
+    def start_gcs(port):
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.gcs_proc",
+             "--session-dir", session, "--port", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            start_new_session=True)
+        line = p.stdout.readline().decode().strip()
+        info = json.loads(line)
+        return p, info["addr"], info["port"]
+
+    gcs_proc, gcs_addr, gcs_port = start_gcs(0)
+    raylet_log = open(os.path.join(session, "logs", "raylet.log"), "ab")
+    raylet = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.raylet_proc",
+         "--session-dir", session, "--gcs-addr", gcs_addr,
+         "--resources", json.dumps({"CPU": 4}),
+         "--labels", "{}", "--node-name", "head"],
+        stdout=subprocess.PIPE, stderr=raylet_log, env=env,
+        start_new_session=True)
+    raylet.stdout.readline()  # ready line
+    try:
+        ray_tpu.init(address=gcs_addr)
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="survivor").remote()
+        assert ray_tpu.get(c.incr.remote()) == 1
+        time.sleep(1.0)  # let a snapshot land
+
+        # hard-kill the GCS and restart on the SAME port
+        gcs_proc.kill()
+        gcs_proc.wait(timeout=10)
+        gcs_proc, gcs_addr2, _ = start_gcs(gcs_port)
+        assert gcs_addr2 == gcs_addr
+
+        # actor state survived (the actor process never died) and the
+        # restarted GCS still resolves it by name
+        time.sleep(2.0)  # raylet heartbeat re-attach window
+        c2 = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(c2.incr.remote(), timeout=60) == 2
+        nodes = ray_tpu.nodes()
+        assert any(n["alive"] for n in nodes)
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for p in (gcs_proc, raylet):
+            try:
+                p.kill()
+                p.wait(timeout=5)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------- spilling
+
+
+def test_spill_store_roundtrip(tmp_path):
+    sp = SpillStore(str(tmp_path))
+    oid = ObjectID.from_random()
+    sp.put_bytes(oid, b"hello-spill")
+    assert sp.contains(oid)
+    assert bytes(sp.get_buffer(oid)) == b"hello-spill"
+    st = sp.stats()
+    assert st["spilled_objects"] == 1 and st["spilled_bytes"] == 11
+    sp.delete(oid)
+    assert not sp.contains(oid)
+    assert sp.get_buffer(oid) is None
+
+
+@pytest.fixture
+def small_arena_store(tmp_path):
+    """Hybrid store with a tiny arena so pressure paths are reachable."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private import native_store
+
+    if not native_store.available():
+        pytest.skip("native store unavailable")
+    config.reload({"arena_store_bytes": 4 * 1024 * 1024,
+                   "object_spill_dir": str(tmp_path / "spill")})
+    session = str(tmp_path / "sess")
+    os.makedirs(session, exist_ok=True)
+    store = HybridObjectStore(session)
+    yield store
+    store.close(unlink_created=True)
+    try:
+        from multiprocessing import shared_memory
+
+        seg = shared_memory.SharedMemory(name=arena_name_for(session))
+        seg.close()
+        seg.unlink()
+    except Exception:
+        pass
+    config.reload()
+
+
+def test_pressure_spills_cold_objects_instead_of_destroying(
+        small_arena_store):
+    """Released (refcount-0) objects under arena pressure are persisted to
+    the spill dir and remain readable — LRU eviction no longer loses data."""
+    store = small_arena_store
+    assert store.arena is not None
+    payload = os.urandom(256 * 1024)
+    cold = []
+    for i in range(8):
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, payload)
+        store.arena.release(oid)  # drop creator pin: cold + unreferenced
+        cold.append(oid)
+    # fill the arena past capacity: pressure must spill the cold ones
+    for i in range(16):
+        store.put_serialized(ObjectID.from_random(), payload)
+    spilled = [oid for oid in cold if store.spill.contains(oid)]
+    assert spilled, "pressure did not spill any cold objects"
+    # spilled objects are still readable through the store (restore path)
+    for oid in spilled:
+        assert bytes(store.get_buffer(oid)) == payload
+
+
+def test_shm_exhausted_falls_back_to_spill_dir(small_arena_store,
+                                               monkeypatch):
+    """When the segment tier cannot allocate (shm full), puts land in the
+    spill directory and reads restore transparently."""
+    store = small_arena_store
+
+    def boom(*a, **kw):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(store.segments, "put_into", boom)
+    oid = ObjectID.from_random()
+    big = os.urandom(2 * 1024 * 1024)  # > arena_max (4MiB/4=1MiB): segments tier
+    name = store.put_serialized(oid, big)
+    assert name == "spill"
+    assert store.contains(oid)
+    assert bytes(store.get_buffer(oid)) == big
+
+
+def test_put_larger_than_arena_completes(small_arena_store):
+    """The VERDICT acceptance case: a workload bigger than the arena
+    completes, objects stay readable."""
+    store = small_arena_store
+    oids = []
+    payload = os.urandom(512 * 1024)
+    for i in range(20):  # 10 MiB through a 4 MiB arena
+        oid = ObjectID.from_random()
+        store.put_serialized(oid, payload)
+        oids.append(oid)
+    for oid in oids:
+        assert bytes(store.get_buffer(oid)) == payload

@@ -148,7 +148,7 @@ def test_empty_payload_safe(store):
 def test_orphaned_alloc_reclaimed_on_reput(store):
     """Creator died between alloc and seal -> re-put must succeed."""
     oid = ObjectID.from_random()
-    off = store._lib.rtpu_store_alloc(store._h, oid.binary(), 128)
+    off = store._lib.rtpu_store_alloc(store._h, oid.binary(), 128, 0)
     assert off > 0  # allocated, never sealed (simulated crash)
     store.put_serialized(oid, b"recovered")
     assert store.get_bytes(oid) == b"recovered"
