@@ -19,7 +19,7 @@ from ray_tpu._private.task_spec import SchedulingStrategy, TaskArg
 _TASK_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns", "max_retries",
     "retry_exceptions", "scheduling_strategy", "name", "runtime_env", "memory",
-    "label_selector", "_metadata",
+    "label_selector", "_metadata", "_generator_backpressure_num_objects",
 }
 _ACTOR_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
@@ -36,6 +36,19 @@ def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]
             kind = "actor" if for_actor else "task"
             raise ValueError(f"Invalid option {k!r} for {kind}; allowed: {sorted(allowed)}")
     return options
+
+
+def coerce_num_returns(value) -> int:
+    """``num_returns``: an int, or "streaming"/"dynamic" for generator
+    tasks (reference ``num_returns="streaming"``, ``_raylet.pyx:279``)."""
+    from ray_tpu._private.streaming import STREAMING_RETURNS
+
+    if value in ("streaming", "dynamic"):
+        return STREAMING_RETURNS
+    n = int(value)
+    if n < 0:
+        raise ValueError("num_returns must be >= 0 or 'streaming'")
+    return n
 
 
 def build_resources(options: Dict[str, Any], default_num_cpus: float) -> Dict[str, float]:

@@ -89,6 +89,8 @@ _FLAG_DEFS: Dict[str, Any] = {
     # --- data ---
     "data_target_block_size_bytes": 128 * 1024**2,
     "data_max_inflight_tasks_per_op": 8,
+    # unfused unordered reads stream blocks via generator tasks
+    "data_streaming_reads": True,
     # --- metrics ---
     "metrics_report_interval_s": 5.0,
 }

@@ -1,0 +1,122 @@
+"""Streaming generator returns: ``num_returns="streaming"``.
+
+TPU-native equivalent of the reference's streaming generators
+(``python/ray/_raylet.pyx:279`` ``ObjectRefGenerator``,
+``src/ray/core_worker/task_manager.h`` HandleReportGeneratorItemReturns):
+a task whose function is a generator streams each yielded value to its
+owner as a separate object the moment it is produced, instead of
+materializing all outputs before any can be consumed.
+
+Protocol:
+
+- The executing worker runs the generator on its executor thread; each
+  item is serialized like a task return (inline payload or shm location)
+  and shipped to the owner with a ``streaming_item`` RPC.  A bounded
+  in-flight window pipelines items; the owner additionally delays the
+  reply of item ``i`` until the consumer is within
+  ``_generator_backpressure_num_objects`` items — the reference's
+  consumer-driven backpressure.
+- ``streaming_end`` carries the final count (or the raised error); the
+  normal ``push_task`` reply then releases the lease.
+- Item ObjectIDs derive from (task_id, index) like fixed returns, so
+  ``ray_tpu.get`` on yielded refs flows through the ordinary owner
+  resolution path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.ids import ObjectID, TaskID
+
+# TaskSpec.num_returns sentinel for streaming tasks
+STREAMING_RETURNS = -1
+
+
+class StreamState:
+    """Owner-side bookkeeping for one in-flight generator task."""
+
+    __slots__ = ("task_id", "produced", "consumed", "finished", "count",
+                 "error", "waiters", "backpressure", "consume_waiters")
+
+    def __init__(self, task_id: TaskID, backpressure: int = 0):
+        self.task_id = task_id
+        self.produced = 0          # items whose location has been recorded
+        self.consumed = 0          # items handed out by the generator
+        self.finished = False
+        self.count: Optional[int] = None
+        self.error: Optional[Exception] = None
+        self.waiters: List[asyncio.Future] = []   # consumers awaiting items
+        self.consume_waiters: List[asyncio.Future] = []  # producer backpressure
+        self.backpressure = backpressure
+
+    def wake_consumers(self):
+        for w in self.waiters:
+            if not w.done():
+                w.set_result(None)
+        self.waiters.clear()
+
+    def wake_producer(self):
+        for w in self.consume_waiters:
+            if not w.done():
+                w.set_result(None)
+        self.consume_waiters.clear()
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded object refs.
+
+    Yields ``ObjectRef``s in production order, blocking until the next
+    item lands (or the stream finishes → ``StopIteration`` / raises the
+    task's error).  Supports both sync and async iteration.  The handle is
+    bound to the owner process (the submitter) and is not serializable.
+    """
+
+    def __init__(self, task_id: TaskID, worker):
+        self._task_id = task_id
+        self._worker = worker
+
+    @property
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return self._worker.run_coro(
+                self._worker.stream_next(self._task_id))
+        except StopAsyncIteration:
+            raise StopIteration from None
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        return await self._worker.stream_next(self._task_id)
+
+    def completed_count(self) -> int:
+        st = self._worker._streams.get(self._task_id)
+        return st.produced if st else 0
+
+    def __del__(self):
+        # dropping an undrained generator must not leak the stream state
+        # or wedge a backpressured producer: cancel + clean up
+        try:
+            w = self._worker
+            if (w is not None and not w._shutdown
+                    and self._task_id in w._streams):
+                w.loop.call_soon_threadsafe(w._abandon_stream, self._task_id)
+        except Exception:  # interpreter teardown
+            pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator is bound to its owner process and cannot "
+            "be serialized; pass the individual ObjectRefs instead")
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({self._task_id.hex()[:12]})"

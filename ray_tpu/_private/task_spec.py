@@ -84,10 +84,16 @@ class TaskSpec:
     runtime_env: Optional[Dict[str, Any]] = None
     # execution metadata
     attempt_number: int = 0
+    # streaming generators: producer pauses when the consumer lags this
+    # many items (0 = window-only pipelining, no consumer coupling)
+    backpressure_num_objects: int = 0
 
     def return_ids(self) -> List[ObjectID]:
+        # num_returns < 0 marks a streaming generator task: returns are
+        # dynamic, announced one at a time (streaming.py STREAMING_RETURNS)
         return [
-            ObjectID.from_task_and_index(self.task_id, i) for i in range(self.num_returns)
+            ObjectID.from_task_and_index(self.task_id, i)
+            for i in range(max(0, self.num_returns))
         ]
 
     def scheduling_key(self) -> Tuple:

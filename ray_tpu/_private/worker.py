@@ -49,6 +49,11 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import MemoryStore, make_shared_store
 from ray_tpu._private.reference_counting import ReferenceCounter
 from ray_tpu._private.rpc import RpcClient, RpcConnectionError, RpcServer
+from ray_tpu._private.streaming import (
+    STREAMING_RETURNS,
+    ObjectRefGenerator,
+    StreamState,
+)
 from ray_tpu._private.task_spec import TaskSpec, TaskType
 
 logger = logging.getLogger(__name__)
@@ -182,6 +187,10 @@ class CoreWorker:
         # serializes async-exc injection vs executor-thread handoff so a
         # cancel can never be injected into the NEXT task on the thread
         self._inject_lock = threading.Lock()
+
+        # owner-side streaming generator state (streaming.py)
+        self._streams: Dict[TaskID, StreamState] = {}
+        self._stream_received: Dict[TaskID, set] = {}
 
         # execution side
         self._fn_cache: Dict[bytes, Any] = {}
@@ -503,6 +512,116 @@ class CoreWorker:
 
         return self.run_coro(_stats())
 
+    # ------------------------------------------- streaming generator returns
+
+    async def stream_next(self, task_id: TaskID) -> ObjectRef:
+        """Next yielded ref of a streaming task; StopAsyncIteration at the
+        end; raises the task's error once available items are drained."""
+        st = self._streams.get(task_id)
+        if st is None:
+            raise StopAsyncIteration
+        while True:
+            if st.consumed < st.produced:
+                idx = st.consumed
+                st.consumed += 1
+                st.wake_producer()
+                oid = ObjectID.from_task_and_index(task_id, idx)
+                ref = ObjectRef(oid, self.serve_addr)
+                self._track_new_ref(ref)
+                return ref
+            if st.finished:
+                self._streams.pop(task_id, None)
+                self._stream_received.pop(task_id, None)
+                if st.error is not None:
+                    raise st.error
+                raise StopAsyncIteration
+            fut = self.loop.create_future()
+            st.waiters.append(fut)
+            await fut
+
+    def _abandon_stream(self, task_id: TaskID):
+        """Consumer dropped its ObjectRefGenerator before draining: tear
+        the stream down — cancel the producer task, unblock any producer
+        ack waiting on backpressure, and release buffered item payloads
+        (loop thread only; scheduled from ObjectRefGenerator.__del__)."""
+        st = self._streams.pop(task_id, None)
+        received = self._stream_received.pop(task_id, None)
+        if st is None:
+            return
+        st.finished = True
+        st.wake_producer()
+        st.wake_consumers()
+        # free buffered-but-unconsumed items
+        indexes = set(range(st.consumed, st.produced)) | (received or set())
+        for i in indexes:
+            oid = ObjectID.from_task_and_index(task_id, i)
+            self.memory_store.delete(oid)
+            self._locations.pop(oid, None)
+        spec = self._inflight_by_task.get(task_id)
+        if spec is not None:
+            asyncio.ensure_future(self._cancel_task_id(spec, False, True))
+
+    async def handle_streaming_item(self, task_id: bytes, index: int,
+                                    entry: Dict[str, Any]) -> bool:
+        """Owner-side: one generator item landed (reference
+        ``HandleReportGeneratorItemReturns``).  The reply doubles as the
+        producer's ack — it is delayed while the consumer lags beyond the
+        backpressure threshold."""
+        tid = TaskID(task_id)
+        st = self._streams.get(tid)
+        if st is None:
+            return False  # cancelled/finished: producer should stop
+        oid = ObjectID(entry["oid"])
+        if entry.get("inline") is not None:
+            self.memory_store.put(oid, entry["inline"])
+            loc = {"inline": True, "is_error": entry.get("is_error", False)}
+        else:
+            loc = {"shm": entry["shm"], "node": entry.get("node"),
+                   "size": entry.get("size"),
+                   "is_error": entry.get("is_error", False)}
+        self._record_location(oid, loc)
+        # out-of-order arrival (windowed pipeline + concurrent dispatch):
+        # advance the contiguous watermark so refs are handed out in order
+        received = self._stream_received.setdefault(tid, set())
+        received.add(index)
+        while st.produced in received:
+            received.discard(st.produced)
+            st.produced += 1
+        st.wake_consumers()
+        if st.backpressure > 0:
+            while (not st.finished
+                   and index + 1 - st.consumed > st.backpressure):
+                fut = self.loop.create_future()
+                st.consume_waiters.append(fut)
+                await fut
+        return True
+
+    async def handle_streaming_end(self, task_id: bytes, count: int,
+                                   error: Optional[bytes] = None) -> bool:
+        tid = TaskID(task_id)
+        st = self._streams.get(tid)
+        if st is None:
+            return True
+        st.count = count
+        if error is not None:
+            err, _ = serialization.deserialize(error)
+            st.error = err
+        st.finished = True
+        st.wake_consumers()
+        st.wake_producer()
+        return True
+
+    def _fail_stream(self, spec: TaskSpec, error: Exception):
+        st = self._streams.get(spec.task_id)
+        if st is None:
+            return
+        if not isinstance(error, exc.RayTpuError):
+            error = exc.TaskError.from_exception(error)
+        st.error = error
+        st.finished = True
+        st.wake_consumers()
+        st.wake_producer()
+
     # ------------------------------------------------- lineage reconstruction
 
     async def _recover_object(self, oid: ObjectID):
@@ -729,12 +848,17 @@ class CoreWorker:
 
     # ------------------------------------------------------- normal task submit
 
-    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+    def submit_task(self, spec: TaskSpec):
         # Fire-and-forget: refs are deterministic from the spec, so the
         # caller never waits for a loop-thread round trip per .remote()
         # (the reference pipelines submission the same way).  A get() that
         # races the enqueue falls back to _wait_local_location, which the
         # completion/failure paths always fulfill.
+        if spec.num_returns == STREAMING_RETURNS:
+            self._streams[spec.task_id] = StreamState(
+                spec.task_id, spec.backpressure_num_objects)
+            self.loop.call_soon_threadsafe(self._enqueue_spec, spec)
+            return ObjectRefGenerator(spec.task_id, self)
         refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
         for r in refs:
             self._track_new_ref(r)
@@ -926,6 +1050,14 @@ class CoreWorker:
                         f"task {spec.task_id.hex()[:8]} was cancelled "
                         f"(force)"))
                     return
+                if spec.num_returns == STREAMING_RETURNS:
+                    # no streaming replay: already-consumed items can't be
+                    # un-consumed, so a mid-stream worker death fails the
+                    # stream rather than re-yielding from scratch
+                    self._fail_task(spec, exc.WorkerCrashedError(
+                        f"worker died mid-stream for task "
+                        f"{spec.task_id.hex()[:8]}: {e}"))
+                    return
                 attempt += 1
                 if attempt > max(spec.max_retries, 0):
                     self._fail_task(spec, exc.WorkerCrashedError(
@@ -959,6 +1091,20 @@ class CoreWorker:
     def _apply_task_reply(self, spec: TaskSpec, reply: Dict):
         self._task_done_cleanup(spec)
         self._drain_ref_events()  # counts current before liveness decision
+        if spec.num_returns == STREAMING_RETURNS:
+            # the reply must never leave the stream unfinished: a task that
+            # failed before streaming began (bad method, cancelled while
+            # queued) replies without a streaming_end
+            st = self._streams.get(spec.task_id)
+            if st is not None and not st.finished:
+                if reply.get("error") is not None:
+                    err, _ = serialization.deserialize(reply["error"])
+                else:
+                    err = exc.RayTpuError(
+                        f"streaming task {spec.task_id.hex()[:8]} replied "
+                        f"without an end-of-stream marker")
+                self._fail_stream(spec, err)
+            return
         for ret in reply["returns"]:
             oid = ObjectID(ret["oid"])
             if ret.get("inline") is not None:
@@ -977,6 +1123,9 @@ class CoreWorker:
     def _fail_task(self, spec: TaskSpec, error: Exception):
         self._task_done_cleanup(spec)
         self._drain_ref_events()
+        if spec.num_returns == STREAMING_RETURNS:
+            self._fail_stream(spec, error)
+            return
         if not isinstance(error, exc.RayTpuError):
             error = exc.TaskError.from_exception(error)
         payload, _ = serialization.serialize(error)
@@ -1011,7 +1160,10 @@ class CoreWorker:
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
         return self.run_coro(self.submit_actor_task_async(spec))
 
-    async def submit_actor_task_async(self, spec: TaskSpec) -> List[ObjectRef]:
+    async def submit_actor_task_async(self, spec: TaskSpec):
+        if spec.num_returns == STREAMING_RETURNS:
+            self._streams[spec.task_id] = StreamState(
+                spec.task_id, spec.backpressure_num_objects)
         refs = []
         for oid in spec.return_ids():
             fut = self.loop.create_future()
@@ -1029,6 +1181,8 @@ class CoreWorker:
             self._task_children.setdefault(
                 spec.parent_task_id, []).append(spec.task_id)
         asyncio.ensure_future(self._push_actor_task(spec))
+        if spec.num_returns == STREAMING_RETURNS:
+            return ObjectRefGenerator(spec.task_id, self)
         return refs
 
     async def _push_actor_task(self, spec: TaskSpec):
@@ -1113,7 +1267,109 @@ class CoreWorker:
             return await self._exec_actor_creation(spec)
         if spec.task_type == TaskType.ACTOR_TASK:
             return await self._exec_actor_task(spec)
+        if spec.num_returns == STREAMING_RETURNS:
+            return await self._exec_streaming(spec)
         return await self._exec_in_thread(spec)
+
+    def _package_stream_item(self, spec: TaskSpec, index: int,
+                             value: Any, is_error: bool = False) -> Dict:
+        """Serialize one yielded value exactly like a task return."""
+        oid = ObjectID.from_task_and_index(spec.task_id, index)
+        core, raw_bufs, refs, total = serialization.serialize_parts(value)
+        if refs:
+            self.loop.call_soon_threadsafe(self._pin_contained_refs,
+                                           list(refs))
+        if total <= config.max_inline_object_size:
+            payload = bytearray(total)
+            serialization.write_parts(payload, core, raw_bufs)
+            return {"oid": oid.binary(), "inline": bytes(payload),
+                    "is_error": is_error}
+        name = self.shared_store.put_into(
+            oid, total,
+            lambda view: serialization.write_parts(view, core, raw_bufs))
+        return {"oid": oid.binary(), "shm": name, "node": self.node_id,
+                "size": total, "is_error": is_error}
+
+    async def _exec_streaming(self, spec: TaskSpec,
+                              bound_method: Any = None) -> Dict:
+        """Run a generator task, streaming each yielded item to the owner
+        as it is produced (reference: streaming generator execution in
+        ``_raylet.pyx`` + ``task_manager`` generator item reports)."""
+        fn = (bound_method if bound_method is not None
+              else self._load_function(spec))
+        args, kwargs = await self._resolve_args(spec)
+        owner = self._peer(spec.owner_addr)
+        window = threading.Semaphore(8)  # in-flight item sends
+        send_errors: List[BaseException] = []
+
+        async def _send(index: int, entry: Dict):
+            try:
+                ok = await owner.call("streaming_item",
+                                      task_id=spec.task_id.binary(),
+                                      index=index, entry=entry, timeout=None)
+                if ok is False:
+                    raise exc.TaskCancelledError(
+                        "stream consumer is gone (cancelled or finished)")
+            except BaseException as e:  # noqa: BLE001
+                send_errors.append(e)
+            finally:
+                window.release()
+
+        def _run():
+            token = _exec_ctx.set(
+                ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            self._running_task_threads[spec.task_id] = threading.get_ident()
+            t0 = time.time()
+            count = 0
+            ok = False
+            try:
+                if spec.task_id in self._cancel_requested:
+                    raise exc.TaskCancelledError(
+                        f"task {spec.task_id.hex()[:8]} was cancelled")
+                gen = fn(*args, **kwargs)
+                for value in gen:
+                    if send_errors:
+                        raise send_errors[0]
+                    if spec.task_id in self._cancel_requested:
+                        raise exc.TaskCancelledError(
+                            f"task {spec.task_id.hex()[:8]} was cancelled")
+                    entry = self._package_stream_item(spec, count, value)
+                    # bounded pipeline: block the generator while the
+                    # window is full (the owner's delayed acks implement
+                    # consumer-lag backpressure on top)
+                    window.acquire()
+                    asyncio.run_coroutine_threadsafe(
+                        _send(count, entry), self.loop)
+                    count += 1
+                with self._inject_lock:
+                    self._running_task_threads.pop(spec.task_id, None)
+                ok = True
+                return count, None
+            except BaseException as e:  # noqa: BLE001
+                if not isinstance(e, exc.RayTpuError):
+                    e = exc.TaskError.from_exception(e)
+                return count, e
+            finally:
+                with self._inject_lock:
+                    self._running_task_threads.pop(spec.task_id, None)
+                self._cancel_requested.discard(spec.task_id)
+                _exec_ctx.reset(token)
+                self._record_task_event(spec, t0, time.time(), ok)
+
+        count, error = await self.loop.run_in_executor(
+            self._task_executor, _run)
+        # drain in-flight item sends before announcing the end
+        for _ in range(8):
+            await self.loop.run_in_executor(None, window.acquire)
+        err_payload = None
+        if error is not None:
+            err_payload, _ = serialization.serialize(error)
+        try:
+            await owner.call("streaming_end", task_id=spec.task_id.binary(),
+                             count=count, error=err_payload, timeout=None)
+        except Exception:  # noqa: BLE001
+            pass  # owner gone: nothing to report to
+        return {"returns": [], "streaming": True, "count": count}
 
     async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None) -> Dict:
         if spec.task_id in self._cancel_requested:
@@ -1336,14 +1592,28 @@ class CoreWorker:
             self._actor_queue_waiters[caller] = waiter
             await waiter
 
+    def _streaming_error_reply(self, spec: TaskSpec,
+                               error: Exception) -> Dict:
+        """Reply for a streaming task that failed before streaming began;
+        the owner fails the stream from the carried error."""
+        if not isinstance(error, exc.RayTpuError):
+            error = exc.TaskError.from_exception(error)
+        payload, _ = serialization.serialize(error)
+        return {"returns": [], "streaming": True, "count": 0,
+                "error": payload}
+
     async def _exec_actor_method(self, spec: TaskSpec) -> Dict:
+        streaming = spec.num_returns == STREAMING_RETURNS
         if spec.task_id in self._cancel_requested:
             # cancelled while queued in the ordered scheduling queue: reply
             # without executing (sequence numbers still advance, so later
             # tasks from the same caller are unaffected)
             self._cancel_requested.discard(spec.task_id)
-            return self._package_returns(spec, False, exc.TaskCancelledError(
-                f"task {spec.task_id.hex()[:8]} was cancelled"))
+            err = exc.TaskCancelledError(
+                f"task {spec.task_id.hex()[:8]} was cancelled")
+            if streaming:
+                return self._streaming_error_reply(spec, err)
+            return self._package_returns(spec, False, err)
         name = spec.function.method_name
         if name == "__ray_terminate__":
             asyncio.ensure_future(self._terminate_self())
@@ -1361,7 +1631,13 @@ class CoreWorker:
         if method is None:
             err = exc.TaskError.from_exception(
                 AttributeError(f"actor has no method {name!r}"))
+            if streaming:
+                return self._streaming_error_reply(spec, err)
             return self._package_returns(spec, False, err)
+        if spec.num_returns == STREAMING_RETURNS:
+            # streaming actor method (generator): items flow to the owner
+            # as produced; the ordered queue holds until the stream ends
+            return await self._exec_streaming(spec, bound_method=method)
         if asyncio.iscoroutinefunction(method):
             args, kwargs = await self._resolve_args(spec)
 

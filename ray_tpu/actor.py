@@ -101,7 +101,8 @@ class ActorHandle:
             ),
             args=task_args,
             kwargs_keys=kw_keys,
-            num_returns=options.get("num_returns", 1),
+            num_returns=api_utils.coerce_num_returns(
+                options.get("num_returns", 1)),
             resources={},
             owner_addr=worker.serve_addr,
             parent_task_id=worker.current_ctx().task_id,

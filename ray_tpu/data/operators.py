@@ -12,6 +12,7 @@ The executor drives it: ``add_input`` → (internal task submission) →
 from __future__ import annotations
 
 import collections
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
@@ -172,8 +173,16 @@ class MapOperator(PhysicalOperator):
         self._active: Dict[ObjectRef, int] = {}  # result ref -> seq
         if preserve_order is None:
             preserve_order = DataContext.get_current().execution_options.preserve_order
+        self._preserve_order = preserve_order
         self._releaser = _OrderedReleaser(preserve_order, self._emit_or_skip)
         self._seq_counter = 0
+        # streaming reads (generator tasks): blocks surface incrementally
+        # instead of after the whole ReadTask finishes.  Drainer threads
+        # append to _out (GIL-atomic deque ops); counters/errors below are
+        # their thread-safe handoff to the executor's control thread.
+        self._streaming_active = 0
+        self._streaming_lock = threading.Lock()
+        self._stream_error: Optional[BaseException] = None
 
     def _emit_or_skip(self, bundle: Optional[RefBundle]):
         if bundle is not None and bundle.blocks:
@@ -194,11 +203,46 @@ class MapOperator(PhysicalOperator):
             opts["num_tpus"] = self._num_tpus
         if self._is_read:
             read_task = self._read_tasks[bundle.blocks[0][0]]  # ref slot holds index
+            if self._streaming_read_ok():
+                gen = T.run_read_task_streaming.options(**opts).remote(
+                    read_task)
+                with self._streaming_lock:
+                    self._streaming_active += 1
+                threading.Thread(
+                    target=self._drain_stream, args=(gen, bundle.seq),
+                    daemon=True,
+                    name=f"data-stream-{self.name}-{bundle.seq}").start()
+                return True
             ref = T.run_read_task.options(**opts).remote(read_task, self._chain)
         else:
             ref = T.run_map_task.options(**opts).remote(self._chain, *bundle.refs())
         self._active[ref] = bundle.seq
         return True
+
+    def _streaming_read_ok(self) -> bool:
+        """Streaming reads apply when per-block order across tasks doesn't
+        have to be reconstructed and no fused chain forces whole-task
+        materialization (reference: Data built on streaming generators)."""
+        from ray_tpu._private.config import config
+
+        return (not self._preserve_order
+                and not (self._chain and self._chain.steps)
+                and bool(getattr(config, "data_streaming_reads", True)))
+
+    def _drain_stream(self, gen, seq: int):
+        """Consume one streaming read task, emitting a single-block bundle
+        per yielded item as it lands (runs on its own thread)."""
+        import ray_tpu as _ray
+
+        try:
+            for item_ref in gen:
+                block_ref, meta = _ray.get(item_ref)
+                self._out.append(RefBundle([(block_ref, meta)], seq=seq))
+        except BaseException as e:  # noqa: BLE001
+            self._stream_error = e
+        finally:
+            with self._streaming_lock:
+                self._streaming_active -= 1
 
     def active_task_refs(self) -> List[ObjectRef]:
         return list(self._active.keys())
@@ -212,9 +256,18 @@ class MapOperator(PhysicalOperator):
             raise
         self._releaser.release(seq, RefBundle(list(zip(block_refs, metas)), seq=seq))
 
+    def has_output(self) -> bool:
+        if self._stream_error is not None:
+            err, self._stream_error = self._stream_error, None
+            raise err
+        return bool(self._out)
+
+    def num_active_tasks(self) -> int:
+        return len(self._active) + self._streaming_active
+
     def completed(self) -> bool:
         return (self._inputs_done and not self._queue and not self._active
-                and not self._out)
+                and self._streaming_active == 0 and not self._out)
 
 
 class ActorPoolMapOperator(MapOperator):

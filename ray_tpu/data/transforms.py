@@ -137,6 +137,20 @@ def run_read_task(read_task, chain: Optional[MapChain]):
     return _finalize(blocks, t0, input_files=read_task.metadata.input_files)
 
 
+@ray_tpu.remote(num_returns="streaming")
+def run_read_task_streaming(read_task):
+    """Streaming read: each produced block is announced to the consumer the
+    moment it exists instead of after the whole ReadTask finishes
+    (reference: Data's map tasks are built on streaming generators,
+    ``_raylet.pyx:279``).  Yields ``(block_ref, metadata)`` per block."""
+    t0 = time.perf_counter()
+    for b in read_task():
+        yield (ray_tpu.put(b),
+               BlockMetadata.for_block(
+                   b, input_files=read_task.metadata.input_files,
+                   start_time=t0))
+
+
 @ray_tpu.remote
 class MapWorker:
     """Actor-pool map worker: caches stateful callables across calls.

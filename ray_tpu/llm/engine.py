@@ -96,6 +96,9 @@ class LLMEngine:
         self._cur_len = np.zeros(self.B, np.int32)
         self._next_token = np.zeros(self.B, np.int32)
         self._finished: List[Request] = []
+        # per-token hook for streaming consumers: on_token(request_id, tok)
+        # fires the moment a token is accepted (serve token streaming)
+        self.on_token: Optional[Any] = None
 
     # -- request API --------------------------------------------------------
 
@@ -207,6 +210,11 @@ class LLMEngine:
             return
         req.out_tokens.append(tok)
         self._next_token[i] = tok
+        if self.on_token is not None:
+            try:
+                self.on_token(req.request_id, tok)
+            except Exception:  # noqa: BLE001 - consumer hook must not kill decode
+                pass
         if (req.num_generated >= sp.max_tokens
                 or len(req.prompt_tokens) + req.num_generated
                 >= self.max_len - 1):

@@ -43,9 +43,16 @@ class LLMServer:
         self.engine = LLMEngine(cfg, mesh=mesh, **kw)
         self._lock = threading.Lock()
         self._waiters: Dict[int, Any] = {}  # request_id -> {event, output}
+        self._token_queues: Dict[int, Any] = {}  # request_id -> queue.Queue
+        self.engine.on_token = self._on_token
         self._stop = False
         self._loop = threading.Thread(target=self._engine_loop, daemon=True)
         self._loop.start()
+
+    def _on_token(self, request_id: int, tok: int):
+        q = self._token_queues.get(request_id)
+        if q is not None:
+            q.put(tok)
 
     def _engine_loop(self):
         import time
@@ -81,6 +88,70 @@ class LLMServer:
         out = slot["output"]
         return {"generated_text": out.text,
                 "num_generated_tokens": len(out.token_ids)}
+
+    def stream(self, body: Dict[str, Any]):
+        """Token-streaming twin of ``__call__``: a generator yielding one
+        ``{"token_id", "text", "index"}`` chunk per decoded token and a
+        final ``{"done": True, ...}`` summary.  Served over SSE by the
+        HTTP proxy (``?stream=1&method=stream``) and consumable directly
+        via ``handle.stream.remote_streaming(body)``.
+        """
+        import queue as queue_mod
+        import threading
+
+        from ray_tpu.models.generation import SamplingParams
+
+        prompt = body["prompt"]
+        sp = SamplingParams(
+            temperature=float(body.get("temperature", 0.7)),
+            max_tokens=int(body.get("max_tokens", 64)),
+            stop_token_id=self.engine.tokenizer.eos_id)
+        import time as time_mod
+
+        slot = {"event": threading.Event(), "output": None}
+        tq: "queue_mod.Queue" = queue_mod.Queue()
+        with self._lock:
+            rid = self.engine.submit(prompt, sp)
+            self._waiters[rid] = slot
+            self._token_queues[rid] = tq
+        deadline = time_mod.time() + 600.0
+        try:
+            index = 0
+            all_ids: list = []
+            emitted = ""  # stable decoded prefix already streamed
+            while True:
+                if slot["event"].is_set() and tq.empty():
+                    break
+                if time_mod.time() > deadline:
+                    raise TimeoutError("generation timed out")
+                if not self._loop.is_alive():
+                    raise RuntimeError("engine loop died mid-generation")
+                try:
+                    tok = tq.get(timeout=0.05)
+                except queue_mod.Empty:
+                    continue
+                all_ids.append(int(tok))
+                # incremental decode: emit the delta of the CUMULATIVE
+                # decode, holding back a trailing replacement char (an
+                # incomplete multi-byte sequence at the boundary) until the
+                # bytes completing it arrive — per-token decode would turn
+                # every multi-byte character into mojibake
+                full = self.engine.tokenizer.decode(all_ids)
+                stable = full.rstrip("�")
+                delta = stable[len(emitted):]
+                if delta:
+                    yield {"token_id": int(tok), "text": delta,
+                           "index": index}
+                    index += 1
+                emitted = stable
+            out = slot["output"]
+            tail = out.text[len(emitted):]
+            if tail:  # flush any held-back suffix so chunks sum to text
+                yield {"token_id": -1, "text": tail, "index": index}
+            yield {"done": True, "generated_text": out.text,
+                   "num_generated_tokens": len(out.token_ids)}
+        finally:
+            self._token_queues.pop(rid, None)
 
     def __del__(self):
         self._stop = True

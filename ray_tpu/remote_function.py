@@ -60,7 +60,8 @@ class RemoteFunction:
             ),
             args=task_args,
             kwargs_keys=kw_keys,
-            num_returns=opts.get("num_returns", 1),
+            num_returns=api_utils.coerce_num_returns(
+                opts.get("num_returns", 1)),
             resources=api_utils.build_resources(opts, default_num_cpus=1),
             owner_addr=worker.serve_addr,
             parent_task_id=worker.current_ctx().task_id,
@@ -68,6 +69,8 @@ class RemoteFunction:
             max_retries=opts.get("max_retries", config.task_max_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
             runtime_env=_validated_runtime_env(opts),
+            backpressure_num_objects=int(
+                opts.get("_generator_backpressure_num_objects", 0) or 0),
         )
         refs = worker.submit_task(spec)
         if spec.num_returns == 1:

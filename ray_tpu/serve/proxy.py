@@ -65,14 +65,59 @@ class ProxyActor:
                         best = (prefix, dep)
         return best[1] if best else None
 
-    def _handle_for(self, deployment: str):
-        h = self._handles.get(deployment)
+    def _handle_for(self, deployment: str, method: str = "__call__"):
+        # cached per (deployment, method): a fresh DeploymentHandle per
+        # request would rebuild its Router (controller round trip) and
+        # lose the pow-2 scheduler's cross-request queue-length cache
+        key = (deployment, method)
+        h = self._handles.get(key)
         if h is None:
             from ray_tpu.serve.router import DeploymentHandle
 
-            h = DeploymentHandle(deployment)
-            self._handles[deployment] = h
+            h = DeploymentHandle(deployment, method)
+            self._handles[key] = h
         return h
+
+    async def _stream_sse(self, request, handle, body, loop):
+        """Proxy a streaming deployment call as Server-Sent Events."""
+        import json
+
+        from aiohttp import web
+
+        _END = object()
+
+        try:
+            stream = await loop.run_in_executor(
+                None, lambda: iter(handle.remote_streaming(body)))
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": repr(e)}, status=500)
+
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await resp.prepare(request)
+
+        def _next():
+            try:
+                return next(stream)
+            except StopIteration:
+                return _END
+
+        try:
+            while True:
+                item = await loop.run_in_executor(None, _next)
+                if item is _END:
+                    break
+                try:
+                    frame = json.dumps(item)
+                except TypeError:
+                    frame = json.dumps({"text": str(item)})
+                await resp.write(f"data: {frame}\n\n".encode())
+        except Exception as e:  # noqa: BLE001
+            await resp.write(
+                f"event: error\ndata: {json.dumps(repr(e))}\n\n".encode())
+        await resp.write_eof()
+        return resp
 
     def _serve(self):
         loop = asyncio.new_event_loop()
@@ -95,6 +140,20 @@ class ProxyActor:
             else:
                 body = dict(request.query)
             handle = self._handle_for(dep)
+            # SSE streaming: the deployment method is a generator and the
+            # client opted in (Accept: text/event-stream or ?stream=1);
+            # each yielded item becomes one `data:` event the moment the
+            # replica produces it (reference: serve StreamingResponse).
+            wants_stream = (
+                "text/event-stream" in request.headers.get("Accept", "")
+                or request.query.get("stream") in ("1", "true"))
+            if wants_stream:
+                # optional ?method= routes to a named generator method
+                # (e.g. the LLM deployment's token `stream`)
+                method = request.query.get("method")
+                if method and not method.startswith("_"):
+                    handle = self._handle_for(dep, method)
+                return await self._stream_sse(request, handle, body, loop)
             try:
                 resp = await loop.run_in_executor(
                     None, lambda: handle.remote(body).result(timeout=60))

@@ -148,6 +148,25 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict):
+        """Generator twin of handle_request: invoked with
+        ``num_returns="streaming"`` so each yielded item reaches the
+        caller the moment the user generator produces it (reference:
+        serve streaming responses over streaming generators)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            fn = getattr(self._callable, method, None)
+            if fn is None:
+                raise AttributeError(
+                    f"deployment {self._deployment} has no method {method!r}")
+            yield from fn(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def get_queue_len(self) -> int:
         return self._ongoing
 

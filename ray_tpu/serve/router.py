@@ -125,6 +125,21 @@ class Router:
                     raise
                 self.refresh()
 
+    def assign_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Route one streaming request; returns an ObjectRefGenerator."""
+        for attempt in range(3):
+            self._maybe_refresh()
+            replica = self.choose_replica()
+            try:
+                gen = replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(method, args, kwargs)
+                self.note_dispatch(replica)
+                return gen
+            except Exception:
+                if attempt == 2:
+                    raise
+                self.refresh()
+
 
 class DeploymentHandle:
     """Client-side handle; composition-safe (picklable into replicas)."""
@@ -157,3 +172,27 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         ref = self._get_router().assign(self._method, args, kwargs)
         return DeploymentResponse(ref)
+
+    def remote_streaming(self, *args, **kwargs) -> "DeploymentStreamingResponse":
+        """Call a generator method of the deployment; iterate the result
+        to receive items as the replica yields them (reference:
+        handle.options(stream=True))."""
+        gen = self._get_router().assign_streaming(self._method, args, kwargs)
+        return DeploymentStreamingResponse(gen)
+
+
+class DeploymentStreamingResponse:
+    """Iterator over a streaming deployment call's yielded values."""
+
+    def __init__(self, ref_gen):
+        self._gen = ref_gen
+
+    def __iter__(self):
+        import ray_tpu
+
+        for ref in self._gen:
+            yield ray_tpu.get(ref)
+
+    @property
+    def ref_generator(self):
+        return self._gen
