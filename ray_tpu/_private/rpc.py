@@ -319,15 +319,33 @@ class RpcClient:
                 self._writer = None
                 await asyncio.sleep(config.rpc_retry_delay_ms / 1000.0)
 
+    def _connected(self) -> bool:
+        return (self._writer is not None
+                and not self._writer.is_closing()
+                and self._recv_task is not None
+                and not self._recv_task.done())
+
     async def _call_once(self, method: str, timeout: Optional[float], kwargs: Dict) -> Any:
-        async with self._lock:
-            await self._connect()
+        # hot path: connection already up — write without taking the lock
+        # (single loop thread; write_frame is synchronous buffering and
+        # drain only suspends under backpressure), skipping two task
+        # switches per call
+        if self._connected():
             req_id = next(self._ids)
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
             self._pending[req_id] = fut
             write_frame(self._writer, {"method": method, "req_id": req_id, "kwargs": kwargs})
             await self._writer.drain()
-        reply = await asyncio.wait_for(fut, timeout)
+        else:
+            async with self._lock:
+                await self._connect()
+                req_id = next(self._ids)
+                fut = asyncio.get_event_loop().create_future()
+                self._pending[req_id] = fut
+                write_frame(self._writer, {"method": method, "req_id": req_id, "kwargs": kwargs})
+                await self._writer.drain()
+        reply = (await asyncio.wait_for(fut, timeout)
+                 if timeout is not None else await fut)
         if not reply["ok"]:
             err = reply["error"]
             raise err if isinstance(err, Exception) else RemoteError(str(err))
