@@ -81,6 +81,11 @@ _FLAG_DEFS: Dict[str, Any] = {
     # --- logging / events ---
     "event_log_enabled": True,
     "log_rotation_bytes": 100 * 1024 * 1024,
+    # --- object transfer (pull/push managers, object_manager.h:106) ---
+    "transfer_chunk_bytes": 8 * 1024 * 1024,
+    "transfer_window_chunks": 4,
+    "transfer_max_bytes_in_flight": 256 * 1024 * 1024,
+    "transfer_push_concurrency": 8,
     # --- collective ---
     "collective_op_timeout_s": 120.0,
     # --- compiled graphs / channels ---

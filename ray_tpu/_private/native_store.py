@@ -180,6 +180,21 @@ class NativeArenaStore:
         buf = self.get_buffer(object_id)
         return None if buf is None else bytes(buf)
 
+    def create_writable(self, object_id: ObjectID, nbytes: int):
+        """(view, seal) split of put_into for incremental chunk writes."""
+        oid = object_id.binary()
+        off = self._lib.rtpu_store_alloc(self._h, oid, nbytes, 0)
+        if off < 0:
+            raise MemoryError(
+                f"arena alloc failed for {nbytes}B: {os.strerror(-off)}")
+
+        def seal():
+            rc = self._lib.rtpu_store_seal(self._h, oid)
+            if rc != 0:
+                raise OSError(-rc, os.strerror(-rc))
+
+        return self._view[off:off + nbytes], seal
+
     def evictable(self, max_n: int = 256) -> List[ObjectID]:
         """Sealed refcount-0 objects in LRU order (spill candidates —
         reference LocalObjectManager::SpillObjects)."""

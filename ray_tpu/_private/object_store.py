@@ -98,6 +98,22 @@ class SharedObjectStore:
         name = self.put_serialized(object_id, payload)
         return name, len(payload), refs
 
+    def create_writable(self, object_id: ObjectID, nbytes: int):
+        """(view, seal) for incremental writes (chunked transfer landing
+        zone — avoids a whole-object staging copy).  Segment objects are
+        name-visible before seal; callers own that window."""
+        name = shm_name_for(object_id)
+        seg = shared_memory.SharedMemory(name=name, create=True,
+                                         size=max(1, nbytes))
+        _untrack(seg)
+
+        def seal():
+            with self._lock:
+                self._created[object_id] = seg
+                self._segments[object_id] = seg
+
+        return seg.buf[:nbytes], seal
+
     # -- access (consumer side) ----------------------------------------------
 
     def contains(self, object_id: ObjectID) -> bool:
@@ -417,6 +433,17 @@ class HybridObjectStore:
             object_id, total,
             lambda view: serialization.write_parts(view, core, raw_bufs))
         return name, total, refs
+
+    def create_writable(self, object_id: ObjectID, nbytes: int):
+        """(view, seal) landing zone for chunked transfers: arena when it
+        fits (alloc/seal split keeps it invisible until sealed), segment
+        otherwise."""
+        if self.arena is not None and nbytes <= self._arena_max:
+            try:
+                return self.arena.create_writable(object_id, nbytes)
+            except MemoryError:
+                pass
+        return self.segments.create_writable(object_id, nbytes)
 
     # -- reads ----------------------------------------------------------------
 

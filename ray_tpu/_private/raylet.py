@@ -84,6 +84,11 @@ class Raylet:
         self._stopping = False
         self._pull_store = None
         self._pull_store_lock = asyncio.Lock()
+        from ray_tpu._private.object_transfer import PushLimiter
+
+        self._push_limiter = PushLimiter()
+        self._puller = None
+        self._transfer_clients: Dict[str, RpcClient] = {}
 
         self.server.register_all(self)
 
@@ -571,13 +576,55 @@ class Raylet:
         return self._pull_store
 
     async def handle_pull_object(self, oid_hex: str) -> Optional[bytes]:
-        # Cross-node object pull endpoint (reference ObjectManager push/pull,
-        # src/ray/object_manager/object_manager.h:106). Single-host topologies
-        # resolve through shared memory directly; this is the DCN fallback.
+        # Legacy whole-object pull (small objects only); large transfers go
+        # through object_info + pull_chunk below.
         from ray_tpu._private.ids import ObjectID
 
         store = await self._get_pull_store()
         return store.get_bytes(ObjectID.from_hex(oid_hex))
+
+    # ----------------- chunked transfer plane (object_manager.h:106) -----
+
+    async def handle_object_info(self, oid: str) -> Optional[dict]:
+        """Size lookup preceding a chunked pull (reference: object
+        directory + buffer pool metadata)."""
+        from ray_tpu._private.ids import ObjectID
+
+        store = await self._get_pull_store()
+        buf = store.get_buffer(ObjectID.from_hex(oid))
+        if buf is None:
+            return None
+        return {"size": len(buf)}
+
+    async def handle_pull_chunk(self, oid: str, offset: int,
+                                length: int) -> Optional[bytes]:
+        """Serve one bounded chunk of a sealed object (reference
+        PushManager chunked sends; concurrency capped by PushLimiter)."""
+        from ray_tpu._private.ids import ObjectID
+
+        store = await self._get_pull_store()
+        return await self._push_limiter.read_chunk(
+            store, ObjectID.from_hex(oid), offset, length)
+
+    async def handle_fetch_remote_object(self, oid: bytes,
+                                         source_addr: str) -> bool:
+        """Worker-facing: pull an object from another raylet into this
+        node's store via the chunked protocol (reference PullManager)."""
+        from ray_tpu._private.ids import ObjectID
+
+        store = await self._get_pull_store()
+        if self._puller is None:
+            from ray_tpu._private.object_transfer import ChunkedPuller
+
+            self._puller = ChunkedPuller(store, self._transfer_peer)
+        return await self._puller.pull(ObjectID(oid), source_addr)
+
+    def _transfer_peer(self, addr: str):
+        client = self._transfer_clients.get(addr)
+        if client is None:
+            client = RpcClient(addr, "raylet-transfer")
+            self._transfer_clients[addr] = client
+        return client
 
     async def handle_free_object(self, oid: bytes) -> bool:
         """Owner-driven reclaim of an object stored on this node (the
@@ -607,3 +654,5 @@ class Raylet:
             pass
         await self.server.close()
         await self.gcs.close()
+        for c in self._transfer_clients.values():
+            await c.close()

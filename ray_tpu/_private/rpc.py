@@ -30,6 +30,11 @@ logger = logging.getLogger(__name__)
 _LEN = struct.Struct("<Q")
 
 MAX_FRAME = 16 * 1024**3
+# StreamReader buffer limit: the default 64 KiB forces an event-loop pass
+# per 64 KiB of a large frame (chunked object transfers move MiBs per
+# frame); 16 MiB lets one chunk land in a few reads.  Allocated lazily per
+# connection, so idle control-plane links don't pay for it.
+STREAM_LIMIT = 16 * 1024 * 1024
 
 
 def run_sync(coro):
@@ -154,12 +159,14 @@ class RpcServer:
                 self.register(prefix + attr[len("handle_"):], getattr(obj, attr))
 
     async def listen_unix(self, path: str):
-        server = await asyncio.start_unix_server(self._on_conn, path=path)
+        server = await asyncio.start_unix_server(self._on_conn, path=path,
+                                                 limit=STREAM_LIMIT)
         self._servers.append(server)
         return path
 
     async def listen_tcp(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
-        server = await asyncio.start_server(self._on_conn, host=host, port=port)
+        server = await asyncio.start_server(self._on_conn, host=host, port=port,
+                                            limit=STREAM_LIMIT)
         self._servers.append(server)
         sock = server.sockets[0]
         return sock.getsockname()[:2]
@@ -210,12 +217,16 @@ class RpcServer:
     async def close(self):
         for s in self._servers:
             s.close()
-            try:
-                await s.wait_closed()
-            except Exception:
-                pass
+        # cancel connection handlers BEFORE wait_closed: since 3.12,
+        # Server.wait_closed blocks until every live connection ends, so
+        # the old order deadlocked whenever a client was still attached
         for t in list(self._conn_tasks):
             t.cancel()
+        for s in self._servers:
+            try:
+                await asyncio.wait_for(s.wait_closed(), 2.0)
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -266,7 +277,8 @@ class RpcClient:
                 if self.addr.startswith("unix:"):
                     path = self.addr[len("unix:"):]
                     try:
-                        self._reader, self._writer = await asyncio.open_unix_connection(path)
+                        self._reader, self._writer = await asyncio.open_unix_connection(
+                            path, limit=STREAM_LIMIT)
                     except (FileNotFoundError, ConnectionRefusedError) as e:
                         # unix sockets exist iff the server process is alive and
                         # listening — no point retrying for 30s (a dead actor /
@@ -275,7 +287,8 @@ class RpcClient:
                             f"cannot connect to {self.addr}: {e}") from None
                 elif self.addr.startswith("tcp:"):
                     _, host, port = self.addr.split(":")
-                    self._reader, self._writer = await asyncio.open_connection(host, int(port))
+                    self._reader, self._writer = await asyncio.open_connection(
+                        host, int(port), limit=STREAM_LIMIT)
                 else:
                     raise RpcError(f"bad address: {self.addr}")
                 self._recv_task = asyncio.ensure_future(self._recv_loop())

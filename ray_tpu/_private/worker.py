@@ -174,6 +174,7 @@ class CoreWorker:
         # objects freed with no lineage: get() must raise, not hang
         self._freed_tombstones: Dict[ObjectID, bool] = {}
         self._borrower_ping_failures: Dict[str, int] = {}
+        self._node_addr_cache: Dict[str, str] = {}
 
         # --- cancellation (reference worker.py:3128 ray.cancel) ---
         self._cancel_requested: set = set()          # TaskIDs
@@ -824,9 +825,36 @@ class CoreWorker:
                 raise exc.ObjectLostError(oid)
             return payload, loc.get("is_error", False)
         buf = self.shared_store.get_buffer(oid)
+        if buf is None and loc.get("node") not in (None, self.node_id):
+            # stored on another node and not visible through host shm:
+            # have our raylet pull it over the chunked transfer plane
+            # (reference PullManager, pull_manager.h:49)
+            if await self._pull_from_node(oid, loc["node"]):
+                buf = self.shared_store.get_buffer(oid)
         if buf is None:
             raise exc.ObjectLostError(oid)
         return buf, loc.get("is_error", False)
+
+    async def _pull_from_node(self, oid: ObjectID, node_id: str) -> bool:
+        try:
+            addr = self._node_addr_cache.get(node_id)
+            if addr is None:
+                nodes = await self.gcs.call("get_all_nodes")
+                for n in nodes:
+                    self._node_addr_cache[n["node_id"]] = n["addr"]
+                addr = self._node_addr_cache.get(node_id)
+            if not addr:
+                return False
+            # no outer timeout: transfer duration scales with object size
+            # and the puller's per-chunk timeouts already bound progress —
+            # a fixed cap would misreport large healthy objects as lost
+            return bool(await self.raylet.call(
+                "fetch_remote_object", oid=oid.binary(), source_addr=addr,
+                timeout=None))
+        except Exception:  # noqa: BLE001
+            logger.debug("chunked pull of %s from %s failed",
+                         oid.hex()[:12], node_id[:8], exc_info=True)
+            return False
 
     async def _wait_local_location(self, oid: ObjectID, timeout: Optional[float] = None):
         loc = self._locations.get(oid)

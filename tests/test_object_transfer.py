@@ -1,0 +1,190 @@
+"""Chunked node-to-node object transfer (VERDICT round-1 item #5).
+
+Reference: ``src/ray/object_manager/object_manager.h:106``,
+``pull_manager.h:49`` (windowed pulls + admission control),
+``push_manager.h:28`` (bounded chunk sends).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import SharedObjectStore
+from ray_tpu._private.object_transfer import ChunkedPuller, PushLimiter
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+
+class _SourceNode:
+    """Minimal sender side: object_info + pull_chunk over a real socket."""
+
+    def __init__(self, store):
+        self.store = store
+        self.server = RpcServer("src")
+        self.limiter = PushLimiter(max_concurrent=4)
+        self.chunk_requests = 0
+        self.server.register("object_info", self.object_info)
+        self.server.register("pull_chunk", self.pull_chunk)
+
+    async def object_info(self, oid):
+        buf = self.store.get_buffer(ObjectID.from_hex(oid))
+        return None if buf is None else {"size": len(buf)}
+
+    async def pull_chunk(self, oid, offset, length):
+        self.chunk_requests += 1
+        return await self.limiter.read_chunk(
+            self.store, ObjectID.from_hex(oid), offset, length)
+
+
+class _LocalStore(SharedObjectStore):
+    """Receiver store namespaced so it never sees the source's segments."""
+
+    def __init__(self, tag):
+        super().__init__()
+        self._tag = tag
+        self._data = {}
+
+    def put_into(self, object_id, nbytes, write_fn):
+        buf = bytearray(nbytes)
+        write_fn(memoryview(buf))
+        self._data[object_id] = bytes(buf)
+        return self._tag
+
+    def put_serialized(self, object_id, payload):
+        self._data[object_id] = bytes(payload)
+        return self._tag
+
+    def contains(self, object_id):
+        return object_id in self._data
+
+    def get_buffer(self, object_id):
+        v = self._data.get(object_id)
+        return None if v is None else memoryview(v)
+
+    def create_writable(self, object_id, nbytes):
+        buf = bytearray(nbytes)
+
+        def seal():
+            self._data[object_id] = bytes(buf)
+
+        return memoryview(buf), seal
+
+    def delete(self, object_id):
+        self._data.pop(object_id, None)
+
+
+@pytest.fixture
+def transfer_pair(tmp_path):
+    loop = asyncio.new_event_loop()
+    src_store = _LocalStore("src")
+    dst_store = _LocalStore("dst")
+    src = _SourceNode(src_store)
+    sock = str(tmp_path / "src.sock")
+    loop.run_until_complete(src.server.listen_unix(sock))
+    clients = {}
+
+    def peer(addr):
+        c = clients.get(addr)
+        if c is None:
+            c = clients[addr] = RpcClient(addr)
+        return c
+
+    puller = ChunkedPuller(dst_store, peer, chunk_bytes=64 * 1024, window=4)
+    yield loop, src, src_store, dst_store, puller, f"unix:{sock}"
+    for c in clients.values():
+        loop.run_until_complete(c.close())
+    loop.run_until_complete(src.server.close())
+    loop.close()
+
+
+def test_chunked_pull_roundtrip(transfer_pair):
+    loop, src, src_store, dst_store, puller, addr = transfer_pair
+    oid = ObjectID.from_random()
+    payload = os.urandom(1 * 1024 * 1024 + 123)  # not chunk-aligned
+    src_store.put_serialized(oid, payload)
+    ok = loop.run_until_complete(puller.pull(oid, addr))
+    assert ok
+    assert bytes(dst_store.get_buffer(oid)) == payload
+    # 1MiB+123B over 64KiB chunks = 17 chunk RPCs, not one giant frame
+    assert src.chunk_requests == 17
+    assert puller.stats["chunks"] == 17
+    assert puller.stats["bytes"] == len(payload)
+
+
+def test_pull_missing_object(transfer_pair):
+    loop, src, _, dst_store, puller, addr = transfer_pair
+    assert not loop.run_until_complete(
+        puller.pull(ObjectID.from_random(), addr))
+
+
+def test_concurrent_pulls_dedup(transfer_pair):
+    loop, src, src_store, dst_store, puller, addr = transfer_pair
+    oid = ObjectID.from_random()
+    src_store.put_serialized(oid, os.urandom(256 * 1024))
+
+    async def both():
+        return await asyncio.gather(puller.pull(oid, addr),
+                                    puller.pull(oid, addr))
+
+    assert loop.run_until_complete(both()) == [True, True]
+    # second pull coalesced onto the first transfer
+    assert puller.stats["pulls"] == 1
+    assert puller.stats["dedup_hits"] == 1
+
+
+def test_admission_bounds_inflight_bytes(transfer_pair):
+    loop, src, src_store, dst_store, puller, addr = transfer_pair
+    puller._budget = 300 * 1024  # two 256KiB objects can't be in flight
+    oids = [ObjectID.from_random() for _ in range(3)]
+    for oid in oids:
+        src_store.put_serialized(oid, os.urandom(256 * 1024))
+    peak = 0
+    orig_fetch = puller._pull_once
+
+    async def tracked(oid, a):
+        nonlocal peak
+        out = await orig_fetch(oid, a)
+        peak = max(peak, puller._in_flight_bytes)
+        return out
+
+    puller._pull_once = tracked
+
+    async def all_three():
+        return await asyncio.gather(*(puller.pull(o, addr) for o in oids))
+
+    assert loop.run_until_complete(all_three()) == [True, True, True]
+    assert all(dst_store.contains(o) for o in oids)
+    # the budget admitted transfers one at a time
+    assert puller._in_flight_bytes == 0
+
+
+def test_empty_object_pull(transfer_pair):
+    loop, src, src_store, dst_store, puller, addr = transfer_pair
+    oid = ObjectID.from_random()
+    src_store.put_serialized(oid, b"")
+    assert loop.run_until_complete(puller.pull(oid, addr))
+    assert bytes(dst_store.get_buffer(oid)) == b""
+
+
+def test_raylet_transfer_endpoints(ray_isolated):
+    """The live raylet serves object_info + pull_chunk for store objects."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.rpc import RpcClient
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    ref = ray_tpu.put(np.ones(2 * 1024 * 1024, dtype=np.uint8))
+    oid_hex = ref.id.hex()
+
+    async def probe():
+        info = await w.raylet.call("object_info", oid=oid_hex)
+        chunk = await w.raylet.call("pull_chunk", oid=oid_hex, offset=0,
+                                    length=64 * 1024)
+        return info, chunk
+
+    info, chunk = w.run_coro(probe())
+    assert info["size"] > 2 * 1024 * 1024  # payload + serialization header
+    assert len(chunk) == 64 * 1024
