@@ -60,6 +60,20 @@ def init(
                 return RuntimeInfo(_node_services.gcs_addr if _node_services else address or "")
             raise RuntimeError("ray_tpu.init() called twice; use ignore_reinit_error=True")
 
+        if address is not None and address.startswith("ray_tpu://"):
+            # remote interactive driver: proxy all ops through the cluster's
+            # client server (reference Ray Client, python/ray/util/client/)
+            from ray_tpu.util.client import connect as _client_connect
+
+            if num_cpus or num_tpus or resources or labels or _system_config:
+                raise ValueError(
+                    "resource/config arguments are ignored with a "
+                    "ray_tpu:// address — the cluster is already running; "
+                    "pass them where the cluster is started")
+            worker_mod.global_worker = _client_connect(
+                address, namespace=namespace or None)
+            _node_services = None
+            return RuntimeInfo(address)
         if address is None or address == "local":
             base = default_resources(num_cpus=num_cpus, num_tpus=num_tpus)
             if resources:

@@ -65,6 +65,30 @@ def main():
             except Exception:
                 logging.getLogger(__name__).warning(
                     "dashboard failed to start", exc_info=True)
+        # remote-driver client proxy (reference: Ray Client server on the
+        # head, default port 10001); RAY_TPU_CLIENT_SERVER_PORT=-1 disables
+        client_port = int(os.environ.get("RAY_TPU_CLIENT_SERVER_PORT",
+                                         "10001"))
+        if client_port >= 0:
+            try:
+                from ray_tpu._private.ids import JobID
+                from ray_tpu._private.worker import CoreWorker, WorkerMode
+                from ray_tpu.util.client import ClientServer
+
+                proxy_worker = CoreWorker(
+                    mode=WorkerMode.DRIVER, session_dir=args.session_dir,
+                    gcs_addr=gcs.addr, raylet_addr=raylet.addr,
+                    node_id=raylet.node_id, job_id=JobID.from_int(0))
+                proxy_worker.start()
+                client_server = ClientServer(proxy_worker)
+                host, bound = await client_server.start(port=client_port)
+                await gcs.handle_kv_put(
+                    ns="cluster", key="client_server_addr",
+                    value=f"{host}:{bound}".encode())
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "client server failed to start", exc_info=True)
+
         # head marker for the driver: address file
         addr_file = os.path.join(args.session_dir, "gcs_address")
         with open(addr_file + ".tmp", "w") as f:

@@ -704,6 +704,25 @@ class CoreWorker:
         self._track_new_ref(out)
         return out
 
+    def put_payload(self, payload: bytes, is_error: bool = False) -> ObjectRef:
+        """Store an ALREADY-SERIALIZED payload as an owned object (client
+        proxy puts land here: the proxy never deserializes client data)."""
+        ctx = self.current_ctx()
+        ctx.put_index += 1
+        oid = ObjectID.from_put(ctx.task_id, ctx.put_index)
+        if len(payload) <= config.max_inline_object_size:
+            self.memory_store.put(oid, bytes(payload))
+            self._record_location_threadsafe(
+                oid, {"inline": True, "is_error": is_error})
+        else:
+            name = self.shared_store.put_serialized(oid, payload)
+            self._record_location_threadsafe(
+                oid, {"shm": name, "node": self.node_id,
+                      "size": len(payload), "is_error": is_error})
+        out = ObjectRef(oid, self.serve_addr)
+        self._track_new_ref(out)
+        return out
+
     def _record_location_threadsafe(self, oid: ObjectID, loc: Dict[str, Any]):
         if threading.current_thread() is self._loop_thread:
             self._record_location(oid, loc)

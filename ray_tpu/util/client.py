@@ -1,0 +1,367 @@
+"""Remote-driver client: drive a cluster without being a member of it.
+
+TPU-native equivalent of Ray Client (``python/ray/util/client/``,
+``src/ray/protobuf/ray_client.proto``): an interactive process on a
+laptop/notebook connects to a proxy on the cluster with
+``ray_tpu.init(address="ray_tpu://host:port")`` and uses the normal API —
+tasks, actors, get/put/wait, cancel, state calls — multiplexed over one
+connection.  The proxy owns the objects on the client's behalf (its
+CoreWorker is the owner recorded in every ref), retains a per-session
+registry of handed-out refs so the lifetime protocol can't reclaim them
+mid-session, and drops that registry when the client disconnects.
+
+Server side: ``ClientServer`` — runs next to a connected driver/head
+worker.  Client side: ``ClientCoreWorker`` — duck-types the slice of the
+CoreWorker surface the public API layer uses (put/get/wait/submit/gcs
+calls), forwarding each op.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import serialization
+from ray_tpu._private.config import config
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------- server
+
+
+class _Session:
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        # live ObjectRefs pin the session's objects against the lifetime
+        # protocol until disconnect (reference: per-client server state)
+        self.refs: Dict[bytes, ObjectRef] = {}
+
+
+class ClientServer:
+    """Proxy endpoint multiplexing remote drivers onto a local CoreWorker."""
+
+    def __init__(self, worker=None):
+        from ray_tpu._private.worker import get_global_worker
+
+        self._worker = worker or get_global_worker()
+        self._server = RpcServer("client-proxy")
+        self._sessions: Dict[str, _Session] = {}
+        self.addr: Tuple[str, int] = ("", 0)
+        self._server.register_all(self, prefix="")
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0):
+        self.addr = await self._server.listen_tcp(host, port)
+        logger.info("client proxy listening on %s:%d", *self.addr)
+        return self.addr
+
+    async def stop(self):
+        for sid in list(self._sessions):
+            await self.handle_client_disconnect(session=sid)
+        await self._server.close()
+
+    def _session(self, session: str) -> _Session:
+        s = self._sessions.get(session)
+        if s is None:
+            raise exc.RayTpuError(f"unknown client session {session!r}")
+        return s
+
+    def _retain(self, s: _Session, ref: ObjectRef):
+        s.refs[ref.id.binary()] = ref
+
+    # -- handlers ---------------------------------------------------------
+
+    async def handle_client_connect(self, session: str) -> Dict[str, Any]:
+        self._sessions[session] = _Session(session)
+        job_no = await self._worker.gcs.call("next_job_id")
+        await self._worker.gcs.call(
+            "add_job", job_id=job_no,
+            info={"driver": f"ray_tpu_client:{session[:8]}"})
+        return {"job_id": job_no, "owner_addr": self._worker.serve_addr,
+                "namespace": self._worker.namespace}
+
+    async def handle_client_disconnect(self, session: str) -> bool:
+        s = self._sessions.pop(session, None)
+        if s is not None:
+            s.refs.clear()  # drop pins: normal lifetime GC takes over
+        return True
+
+    async def handle_client_gcs(self, session: str, gcs_method: str,
+                                kwargs: Dict[str, Any]) -> Any:
+        self._session(session)
+        return await self._worker.gcs.call(gcs_method, **kwargs)
+
+    async def handle_client_put(self, session: str, payload: bytes) -> bytes:
+        s = self._session(session)
+        ref = self._worker.put_payload(payload)
+        self._retain(s, ref)
+        return ref.id.binary()
+
+    async def handle_client_get(self, session: str, oids: List[bytes],
+                                get_timeout: Optional[float] = None
+                                ) -> List[Dict]:
+        self._session(session)
+
+        async def one(oid: bytes):
+            ref = ObjectRef(ObjectID(oid), self._worker.serve_addr)
+            payload, is_error = await self._worker._resolve_payload(ref)
+            return {"payload": bytes(payload), "is_error": is_error}
+
+        coros = [one(o) for o in oids]
+        if get_timeout is not None:
+            return await asyncio.wait_for(asyncio.gather(*coros),
+                                          get_timeout)
+        return await asyncio.gather(*coros)
+
+    async def handle_client_wait(self, session: str, oids: List[bytes],
+                                 num_returns: int,
+                                 wait_timeout: Optional[float] = None
+                                 ) -> List[bytes]:
+        self._session(session)
+        refs = [ObjectRef(ObjectID(o), self._worker.serve_addr)
+                for o in oids]
+        ready, _ = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self._worker.wait(refs, num_returns, wait_timeout))
+        return [r.id.binary() for r in ready]
+
+    async def handle_client_submit(self, session: str,
+                                   spec_bytes: bytes) -> bool:
+        s = self._session(session)
+        with serialization.uncounted_refs():
+            spec: TaskSpec = serialization.loads(spec_bytes)
+        spec.owner_addr = self._worker.serve_addr  # proxy owns the returns
+        refs = (self._worker.submit_actor_task(spec)
+                if spec.actor_id is not None
+                else self._worker.submit_task(spec))
+        if isinstance(refs, list):
+            for r in refs:
+                self._retain(s, r)
+        return True
+
+    async def handle_client_cancel(self, session: str, oid: bytes,
+                                   force: bool, recursive: bool) -> bool:
+        self._session(session)
+        ref = ObjectRef(ObjectID(oid), self._worker.serve_addr)
+        return await self._worker._cancel_async(
+            ref.id, force, recursive, owner_addr=self._worker.serve_addr)
+
+    async def handle_client_free(self, session: str,
+                                 oids: List[bytes]) -> bool:
+        s = self._session(session)
+        refs = [ObjectRef(ObjectID(o), self._worker.serve_addr)
+                for o in oids]
+        await asyncio.get_event_loop().run_in_executor(
+            None, self._worker.free_objects, refs)
+        for o in oids:
+            s.refs.pop(o, None)
+        return True
+
+
+# --------------------------------------------------------------------- client
+
+
+class _GcsShim:
+    """Forwards ``worker.gcs.call(...)`` through the client connection."""
+
+    def __init__(self, client: "ClientCoreWorker"):
+        self._client = client
+
+    async def call(self, method: str, timeout: Optional[float] = None,
+                   **kwargs) -> Any:
+        if timeout is not None:
+            # some GCS handlers take their own timeout kwarg (e.g.
+            # wait_actor_ready); forward it to the handler, not the wire
+            kwargs["timeout"] = timeout
+        return await self._client._proxy.call(
+            "client_gcs", session=self._client._session, gcs_method=method,
+            kwargs=kwargs, timeout=None)
+
+    async def close(self):
+        return None
+
+
+class _ClientContext:
+    def __init__(self, task_id: TaskID, job_id: JobID):
+        self.task_id = task_id
+        self.job_id = job_id
+        self.put_index = 0
+        self.submit_index = 0
+
+
+class ClientCoreWorker:
+    """Client-side stand-in for CoreWorker: the slice of its surface the
+    public API layer touches, each op forwarded to the proxy."""
+
+    def __init__(self, host: str, port: int,
+                 namespace: Optional[str] = None):
+        import uuid
+
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, daemon=True, name="rtpu-client-io")
+        self._ready = threading.Event()
+        self._loop_thread.start()
+        self._ready.wait()
+        self._session = uuid.uuid4().hex
+        self._proxy = RpcClient(f"tcp:{host}:{port}", "client")
+        self._shutdown = False
+        self._ref_events: Any = __import__("collections").deque()
+        self.gcs = _GcsShim(self)
+        info = self.run_coro(self._proxy.call(
+            "client_connect", session=self._session,
+            timeout=config.rpc_connect_timeout_s))
+        self.job_id = JobID.from_int(info["job_id"])
+        self.serve_addr = info["owner_addr"]  # specs name the PROXY as owner
+        self.namespace = namespace or info.get("namespace", "")
+        self.node_id = "client"
+        self.mode = "CLIENT"
+        self._root_ctx = _ClientContext(TaskID.from_random(), self.job_id)
+        # _ref_events receives add/del notes from deserialized refs; the
+        # client does no distributed counting (the proxy SESSION retains
+        # every ref it hands out, which subsumes per-ref borrows), so a
+        # janitor just empties the queue
+        self.loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(self._drain_events_loop()))
+
+    async def _drain_events_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            self._ref_events.clear()
+
+    def _pin_contained_refs(self, refs):
+        # no-op: every ref a client holds was handed out by the proxy and
+        # is retained in its session registry until disconnect, which is a
+        # strictly stronger hold than a transfer grace pin
+        return None
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self._ready.set()
+        self.loop.run_forever()
+
+    def run_coro(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def current_ctx(self) -> _ClientContext:
+        return self._root_ctx
+
+    # -- core ops ---------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        payload, _refs = serialization.serialize(value)
+        oid = self.run_coro(self._proxy.call(
+            "client_put", session=self._session, payload=payload))
+        return ObjectRef(ObjectID(oid), self.serve_addr)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        import concurrent.futures
+
+        try:
+            replies = self.run_coro(
+                self._proxy.call(
+                    "client_get", session=self._session,
+                    oids=[r.id.binary() for r in ref_list],
+                    get_timeout=timeout, timeout=None),
+                None if timeout is None else timeout + 10.0)
+        except (asyncio.TimeoutError, concurrent.futures.TimeoutError):
+            raise exc.GetTimeoutError(
+                f"get timed out after {timeout}s") from None
+        values = []
+        for rep in replies:
+            value, _ = serialization.deserialize(rep["payload"])
+            if isinstance(value, exc.RayTpuError):
+                raise value
+            values.append(value)
+        return values[0] if single else values
+
+    async def get_async(self, refs, timeout: Optional[float] = None):
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: self.get(refs, timeout))
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        ready_ids = self.run_coro(self._proxy.call(
+            "client_wait", session=self._session,
+            oids=[r.id.binary() for r in refs], num_returns=num_returns,
+            wait_timeout=timeout, timeout=None))
+        ready_set = set(ready_ids)
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        return ready, not_ready
+
+    def future_for(self, ref: ObjectRef):
+        import concurrent.futures
+
+        pool = getattr(self, "_fut_pool", None)
+        if pool is None:
+            pool = self._fut_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="rtpu-client-fut")
+        return pool.submit(self.get, ref)
+
+    def submit_task(self, spec: TaskSpec):
+        from ray_tpu._private.streaming import STREAMING_RETURNS
+
+        if spec.num_returns == STREAMING_RETURNS:
+            raise NotImplementedError(
+                "streaming generators are not supported over "
+                "ray_tpu:// client connections yet")
+        refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
+        self.run_coro(self._proxy.call(
+            "client_submit", session=self._session,
+            spec_bytes=serialization.dumps(spec)))
+        return refs
+
+    def submit_actor_task(self, spec: TaskSpec):
+        return self.submit_task(spec)
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False,
+                    recursive: bool = True) -> bool:
+        return self.run_coro(self._proxy.call(
+            "client_cancel", session=self._session, oid=ref.id.binary(),
+            force=force, recursive=recursive))
+
+    def free_objects(self, refs: List[ObjectRef]):
+        self.run_coro(self._proxy.call(
+            "client_free", session=self._session,
+            oids=[r.id.binary() for r in refs]))
+
+    def ref_counter_stats(self) -> Dict[str, Any]:
+        return {"owned": 0, "borrowed": 0, "client": True}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self.run_coro(self._proxy.call(
+                "client_disconnect", session=self._session, timeout=5.0),
+                timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.run_coro(self._proxy.close(), timeout=5.0)
+        except Exception:  # noqa: BLE001
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._loop_thread.join(timeout=2)
+
+
+def connect(address: str,
+            namespace: Optional[str] = None) -> ClientCoreWorker:
+    """``address``: ``ray_tpu://host:port``."""
+    hostport = address[len("ray_tpu://"):]
+    host, _, port = hostport.rpartition(":")
+    return ClientCoreWorker(host or "127.0.0.1", int(port),
+                            namespace=namespace)
