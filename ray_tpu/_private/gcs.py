@@ -96,7 +96,7 @@ class GcsServer:
         # debounce and churn a full disk write every 250ms on idle clusters
         state["nodes"] = {
             nid: {k: v for k, v in n.items()
-                  if k not in ("last_heartbeat", "pending_demand")}
+                  if k not in ("last_heartbeat", "pending_demand", "stats")}
             for nid, n in self.nodes.items()
         }
         state["_job_counter"] = self._job_counter
@@ -219,7 +219,8 @@ class GcsServer:
         return True
 
     async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
-                               pending: Optional[List[Dict[str, float]]] = None
+                               pending: Optional[List[Dict[str, float]]] = None,
+                               stats: Optional[Dict[str, Any]] = None
                                ) -> Dict:
         node = self.nodes.get(node_id)
         if node is None:
@@ -230,6 +231,8 @@ class GcsServer:
         freed = node["available"] != available
         node["available"] = available
         node["pending_demand"] = pending or []
+        if stats is not None:
+            node["stats"] = stats
         node["last_heartbeat"] = time.time()
         if not node["alive"]:
             # heartbeat from a node marked dead during a GCS outage window:

@@ -137,6 +137,7 @@ class Raylet:
                     # resource_load in raylet heartbeats / syncer messages)
                     pending=[w[0].to_dict() for w in
                              list(self._lease_waiters)[:100]],
+                    stats=self._node_stats(),
                 )
                 self.cluster_view = reply.get("nodes", [])
                 if reply.get("unknown"):
@@ -149,6 +150,27 @@ class Raylet:
             except Exception as e:  # noqa: BLE001
                 logger.debug("heartbeat failed: %s", e)
             await asyncio.sleep(period)
+
+    def _node_stats(self) -> dict:
+        """Per-node runtime stats shipped with heartbeats — the role of
+        the reference's per-node dashboard agent
+        (``python/ray/dashboard/agent.py:22``); the raylet already IS a
+        per-node daemon, so it reports instead of a separate process."""
+        import os as _os
+
+        from ray_tpu._private.memory_monitor import system_memory_usage
+
+        used, total = system_memory_usage()
+        try:
+            load1 = _os.getloadavg()[0]
+        except OSError:
+            load1 = 0.0
+        return {
+            "mem_used_gb": round(used / 1024**3, 2),
+            "mem_total_gb": round(total / 1024**3, 2),
+            "load1": round(load1, 2),
+            "workers": len(self.workers),
+        }
 
     async def _reaper_loop(self):
         while not self._stopping:

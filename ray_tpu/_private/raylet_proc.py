@@ -45,6 +45,23 @@ def main():
     with open(marker, "w") as f:
         f.write(raylet.addr)
     print(json.dumps({"node_id": raylet.node_id, "addr": raylet.addr}), flush=True)
+
+    # graceful SIGTERM: unregister from the GCS before exiting so the node
+    # flips to dead immediately instead of after the heartbeat timeout
+    # (the autoscaler/slice-provider terminate path sends SIGTERM)
+    import signal
+
+    def _term(_sig, _frm):
+        async def _stop_and_exit():
+            try:
+                await asyncio.wait_for(raylet.stop(), timeout=8.0)
+            except Exception:  # noqa: BLE001
+                pass
+            loop.stop()
+
+        asyncio.ensure_future(_stop_and_exit())
+
+    loop.add_signal_handler(signal.SIGTERM, _term, signal.SIGTERM, None)
     try:
         loop.run_forever()
     except KeyboardInterrupt:

@@ -10,12 +10,22 @@ a whole pod slice.
 """
 
 from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.instance_manager import (
+    Instance,
+    InstanceManager,
+    InstanceState,
+)
 from ray_tpu.autoscaler.node_provider import (
     LocalSubprocessNodeProvider,
     NodeProvider,
 )
+from ray_tpu.autoscaler.tpu_slice_provider import (
+    TPUPodSliceProvider,
+    parse_pod_type,
+)
 
 __all__ = [
-    "Autoscaler", "AutoscalerConfig", "LocalSubprocessNodeProvider",
-    "NodeProvider", "NodeTypeConfig",
+    "Autoscaler", "AutoscalerConfig", "Instance", "InstanceManager",
+    "InstanceState", "LocalSubprocessNodeProvider", "NodeProvider",
+    "NodeTypeConfig", "TPUPodSliceProvider", "parse_pod_type",
 ]

@@ -14,6 +14,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.rpc import RpcClient, run_sync
+from ray_tpu.autoscaler.instance_manager import InstanceManager, InstanceState
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
 logger = logging.getLogger(__name__)
@@ -40,13 +41,17 @@ def _fits(demand: Dict[str, float], resources: Dict[str, float]) -> bool:
 
 
 class Autoscaler:
+    """Reconciler over an InstanceManager (the v2 design): demand and
+    min/max intents become instance REQUESTs; idleness becomes DRAINING;
+    the instance manager converges records with provider reality."""
+
     def __init__(self, gcs_addr: str, provider: NodeProvider,
                  config: AutoscalerConfig):
         self.gcs_addr = gcs_addr
         self.provider = provider
         self.config = config
+        self.instance_manager = InstanceManager(provider)
         self._idle_since: Dict[str, float] = {}
-        self._launched_for: Dict[str, str] = {}  # provider id -> node type
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -64,9 +69,14 @@ class Autoscaler:
 
     def reconcile_once(self) -> Dict[str, Any]:
         """Returns a summary of the decisions taken this round."""
+        im = self.instance_manager
         nodes = [n for n in self._get_nodes() if n.get("alive")]
+        alive_ids = {n["node_id"] for n in nodes}
         launched: List[str] = []
         terminated: List[str] = []
+
+        # 0. converge existing instances with provider/cluster reality
+        im.reconcile(alive_ids)
 
         # 1. unmet demand: pending shapes that fit NO alive node's total
         demand: List[Dict[str, float]] = []
@@ -78,84 +88,72 @@ class Autoscaler:
         # pending demand at all means the cluster is short on slots
         congested = [d for d in demand if d not in unmet]
 
-        # 2. count current workers per type
-        per_type: Dict[str, int] = {t: 0 for t in self.config.node_types}
-        for pid in self.provider.non_terminated_nodes():
-            t = self._launched_for.get(pid)
-            if t in per_type:
-                per_type[t] += 1
+        # 2. active capacity per type (REQUESTED/LAUNCHING count so one
+        #    demand burst can't over-request while instances come up)
+        per_type = {t: 0 for t in self.config.node_types}
+        per_type.update(im.count_by_type())
 
         # 3. scale up: min_workers first, then demand-driven bin packing
         budget = self.config.max_launches_per_round
         for t, cfg in self.config.node_types.items():
-            while per_type[t] < cfg.min_workers and budget > 0:
-                self._launch(t, cfg)
-                per_type[t] += 1
+            while per_type.get(t, 0) < cfg.min_workers and budget > 0:
+                im.request(t, cfg.resources, cfg.labels)
+                per_type[t] = per_type.get(t, 0) + 1
                 budget -= 1
                 launched.append(t)
-        # launch-in-flight gate: while a launched node hasn't registered and
-        # heartbeated yet, its capacity isn't visible — launching again for
-        # the same (still-pending) demand would overshoot to max_workers
-        alive_ids = {n["node_id"] for n in nodes}
-        joining = [pid for pid in self.provider.non_terminated_nodes()
-                   if pid in self._launched_for
-                   and self.provider.node_id_of(pid) not in alive_ids]
+        # launch-in-flight gate: while an instance is still coming up its
+        # capacity isn't visible in heartbeats — requesting again for the
+        # same (still-pending) demand would overshoot to max_workers
+        joining = im.by_state(InstanceState.REQUESTED,
+                              InstanceState.LAUNCHING)
         if joining:
+            im.reconcile(alive_ids)  # kick REQUESTED -> LAUNCHING now
             return {"launched": launched, "terminated": terminated,
                     "unmet_demand": len(unmet), "pending": len(demand),
-                    "joining": len(joining)}
+                    "joining": len(joining),
+                    "instances": im.summary()}
         for d in unmet + congested:
             if budget <= 0:
                 break
             # smallest node type that fits the shape
             candidates = sorted(
                 ((t, cfg) for t, cfg in self.config.node_types.items()
-                 if _fits(d, cfg.resources) and per_type[t] < cfg.max_workers),
+                 if _fits(d, cfg.resources)
+                 and per_type.get(t, 0) < cfg.max_workers),
                 key=lambda tc: sum(tc[1].resources.values()))
             if candidates:
                 t, cfg = candidates[0]
-                self._launch(t, cfg)
-                per_type[t] += 1
+                im.request(t, cfg.resources, cfg.labels)
+                per_type[t] = per_type.get(t, 0) + 1
                 budget -= 1
                 launched.append(t)
 
-        # 4. scale down: autoscaler-launched nodes idle past the timeout
-        #    (idle = fully available and no pending demand anywhere)
+        # 4. scale down: RUNNING instances idle past the timeout drain
+        #    (idle = every member node fully available, no pending demand)
         now = time.monotonic()
-        by_node_id = {self.provider.node_id_of(pid): pid
-                      for pid in self.provider.non_terminated_nodes()}
-        for n in nodes:
-            pid = by_node_id.get(n["node_id"])
-            if pid is None:
-                continue
-            t = self._launched_for.get(pid)
-            if t is None:
-                # unknown provenance (pre-existing node, or an autoscaler
-                # restart lost the launch map): never terminate it
-                continue
-            cfg = self.config.node_types.get(t)
-            idle = (not demand and n["available"] == n["total"])
+        by_node_id = {n["node_id"]: n for n in nodes}
+        for inst in im.by_state(InstanceState.RUNNING):
+            cfg = self.config.node_types.get(inst.node_type)
+            members = [by_node_id.get(nid) for nid in inst.node_ids]
+            idle = (not demand and all(
+                m is not None and m["available"] == m["total"]
+                for m in members))
             if not idle:
-                self._idle_since.pop(pid, None)
+                self._idle_since.pop(inst.instance_id, None)
                 continue
-            first = self._idle_since.setdefault(pid, now)
+            first = self._idle_since.setdefault(inst.instance_id, now)
             above_min = (cfg is None
-                         or per_type.get(t, 0) > cfg.min_workers)
+                         or per_type.get(inst.node_type, 0) > cfg.min_workers)
             if now - first >= self.config.idle_timeout_s and above_min:
-                logger.info("terminating idle node %s (%s)", pid, t)
-                self.provider.terminate_node(pid)
-                self._idle_since.pop(pid, None)
-                if t in per_type:
-                    per_type[t] -= 1
-                terminated.append(pid)
+                im.drain(inst)
+                self._idle_since.pop(inst.instance_id, None)
+                per_type[inst.node_type] = per_type.get(
+                    inst.node_type, 1) - 1
+                terminated.append(inst.provider_id or inst.instance_id)
+        im.reconcile(alive_ids)  # apply new REQUESTs + DRAIN terminations
         return {"launched": launched, "terminated": terminated,
-                "unmet_demand": len(unmet), "pending": len(demand)}
-
-    def _launch(self, node_type: str, cfg: NodeTypeConfig):
-        logger.info("launching node of type %s", node_type)
-        pid = self.provider.create_node(node_type, dict(cfg.resources),
-                                       dict(cfg.labels))
-        self._launched_for[pid] = node_type
+                "unmet_demand": len(unmet), "pending": len(demand),
+                "instances": im.summary()}
 
     # -- loop ---------------------------------------------------------------
 

@@ -1,0 +1,213 @@
+"""Instance manager: explicit lifecycle for autoscaler-owned capacity.
+
+Reference: ``python/ray/autoscaler/v2/instance_manager/`` — the v2
+redesign SURVEY.md §7.11 marks as the one worth copying: every unit of
+capacity is an ``Instance`` record moving through an explicit state
+machine, and the reconciler's job is to converge instance states with
+cloud/provider reality instead of keeping ad-hoc dicts.
+
+    REQUESTED ──launch──▶ LAUNCHING ──all nodes alive──▶ RUNNING
+        │                     │  └─launch timeout─▶ FAILED
+        │                     └─proc died──────────▶ FAILED
+        ▼                                               │
+    (cancelled)               RUNNING ──idle──▶ DRAINING ──▶ TERMINATED
+
+One instance may span multiple cluster nodes (a TPU pod SLICE is one
+instance whose hosts register as separate raylets); the instance is
+RUNNING only when every member node is alive, and draining terminates
+the whole slice atomically — the gang semantics flat per-node
+autoscalers can't express.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class InstanceState(enum.Enum):
+    REQUESTED = "REQUESTED"
+    LAUNCHING = "LAUNCHING"
+    RUNNING = "RUNNING"
+    DRAINING = "DRAINING"
+    TERMINATED = "TERMINATED"
+    FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    resources: Dict[str, float]
+    labels: Dict[str, str]
+    state: InstanceState = InstanceState.REQUESTED
+    provider_id: Optional[str] = None
+    node_ids: List[str] = dataclasses.field(default_factory=list)
+    requested_at: float = dataclasses.field(default_factory=time.time)
+    launched_at: Optional[float] = None
+    running_at: Optional[float] = None
+    draining_at: Optional[float] = None
+    terminated_at: Optional[float] = None
+    failure: str = ""
+    dead_since: Optional[float] = None  # first reconcile members were dead
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["state"] = self.state.value
+        return d
+
+
+class InstanceManager:
+    """Owns instance records; the reconciler drives their transitions."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, provider, launch_timeout_s: float = 120.0,
+                 dead_grace_s: float = 30.0, keep_terminal: int = 50):
+        self.provider = provider
+        self.launch_timeout_s = launch_timeout_s
+        # a transiently-dead node (missed heartbeats during a blip; the
+        # GCS resurrects on the next heartbeat) must not fail the instance
+        # on the first reconcile that observes it
+        self.dead_grace_s = dead_grace_s
+        self.keep_terminal = keep_terminal
+        self.instances: Dict[str, Instance] = {}
+
+    # -- intents ----------------------------------------------------------
+
+    def request(self, node_type: str, resources: Dict[str, float],
+                labels: Dict[str, str]) -> Instance:
+        inst = Instance(
+            instance_id=f"inst-{next(self._ids)}", node_type=node_type,
+            resources=dict(resources), labels=dict(labels))
+        self.instances[inst.instance_id] = inst
+        logger.info("instance %s (%s) REQUESTED", inst.instance_id,
+                    node_type)
+        return inst
+
+    def drain(self, inst: Instance):
+        if inst.state is InstanceState.RUNNING:
+            inst.state = InstanceState.DRAINING
+            inst.draining_at = time.time()
+            logger.info("instance %s DRAINING", inst.instance_id)
+
+    # -- views ------------------------------------------------------------
+
+    def by_state(self, *states: InstanceState) -> List[Instance]:
+        return [i for i in self.instances.values() if i.state in states]
+
+    def active(self) -> List[Instance]:
+        return self.by_state(InstanceState.REQUESTED,
+                             InstanceState.LAUNCHING, InstanceState.RUNNING)
+
+    def count_by_type(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for i in self.active():
+            out[i.node_type] = out.get(i.node_type, 0) + 1
+        return out
+
+    def summary(self) -> List[Dict[str, Any]]:
+        return [i.to_dict() for i in self.instances.values()]
+
+    # -- reconciliation ---------------------------------------------------
+
+    def reconcile(self, alive_node_ids: set) -> None:
+        """Advance every instance toward its goal state against provider
+        + cluster reality."""
+        now = time.time()
+        live = set(self.provider.non_terminated_nodes())
+        for inst in list(self.instances.values()):
+            if inst.state is InstanceState.REQUESTED:
+                try:
+                    inst.provider_id = self.provider.create_node(
+                        inst.node_type, dict(inst.resources),
+                        dict(inst.labels))
+                    inst.state = InstanceState.LAUNCHING
+                    inst.launched_at = now
+                except Exception as e:  # noqa: BLE001
+                    inst.state = InstanceState.FAILED
+                    inst.failure = f"launch error: {e!r}"
+                    logger.warning("instance %s FAILED: %s",
+                                   inst.instance_id, inst.failure)
+            elif inst.state is InstanceState.LAUNCHING:
+                if inst.provider_id not in live:
+                    # reclaim any surviving members (a partial slice must
+                    # not keep heartbeating as unmanaged capacity)
+                    self._terminate_provider(inst)
+                    inst.state = InstanceState.FAILED
+                    inst.failure = "provider node died before joining"
+                    continue
+                node_ids = self._member_node_ids(inst)
+                if node_ids and all(n in alive_node_ids for n in node_ids):
+                    inst.node_ids = node_ids
+                    inst.state = InstanceState.RUNNING
+                    inst.running_at = now
+                    logger.info("instance %s RUNNING (%d node(s))",
+                                inst.instance_id, len(node_ids))
+                elif now - (inst.launched_at or now) > self.launch_timeout_s:
+                    self._terminate_provider(inst)
+                    inst.state = InstanceState.FAILED
+                    inst.failure = "launch timeout"
+                    logger.warning("instance %s FAILED: launch timeout",
+                                   inst.instance_id)
+            elif inst.state is InstanceState.RUNNING:
+                if inst.provider_id not in live:
+                    # the provider itself reports the instance gone: no
+                    # resurrection possible — fail now, reclaim survivors
+                    self._terminate_provider(inst)
+                    inst.state = InstanceState.FAILED
+                    inst.failure = "provider node died"
+                    logger.warning("instance %s FAILED: provider node died",
+                                   inst.instance_id)
+                elif all(n in alive_node_ids for n in inst.node_ids):
+                    inst.dead_since = None
+                elif inst.dead_since is None:
+                    # GCS says a member missed heartbeats — may be a blip
+                    # the GCS will resurrect; hold for the grace window
+                    inst.dead_since = now
+                elif now - inst.dead_since > self.dead_grace_s:
+                    self._terminate_provider(inst)
+                    inst.state = InstanceState.FAILED
+                    inst.failure = "node died"
+                    logger.warning("instance %s FAILED: node died",
+                                   inst.instance_id)
+            elif inst.state is InstanceState.DRAINING:
+                # economy drain: no per-task wait — leases drain via the
+                # idle precondition the reconciler applied before draining
+                self._terminate_provider(inst)
+                inst.state = InstanceState.TERMINATED
+                inst.terminated_at = now
+                logger.info("instance %s TERMINATED", inst.instance_id)
+        self._prune_terminal()
+
+    def _prune_terminal(self):
+        """Bound record retention: terminal instances beyond keep_terminal
+        are evicted oldest-first (long-lived autoscalers churn instances)."""
+        terminal = [i for i in self.instances.values()
+                    if i.state in (InstanceState.TERMINATED,
+                                   InstanceState.FAILED)]
+        excess = len(terminal) - self.keep_terminal
+        if excess > 0:
+            terminal.sort(key=lambda i: i.terminated_at or i.requested_at)
+            for i in terminal[:excess]:
+                self.instances.pop(i.instance_id, None)
+
+    def _member_node_ids(self, inst: Instance) -> List[str]:
+        ids = getattr(self.provider, "node_ids_of", None)
+        if ids is not None:  # multi-node instances (pod slices)
+            return list(ids(inst.provider_id) or [])
+        one = self.provider.node_id_of(inst.provider_id)
+        return [one] if one else []
+
+    def _terminate_provider(self, inst: Instance):
+        if inst.provider_id is not None:
+            try:
+                self.provider.terminate_node(inst.provider_id)
+            except Exception:  # noqa: BLE001
+                logger.debug("terminate failed", exc_info=True)

@@ -34,6 +34,43 @@ class NodeProvider:
         raise NotImplementedError
 
 
+def spawn_raylet(session_dir: str, gcs_addr: str, name: str,
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 ready_timeout_s: float = 60.0) -> Dict[str, Any]:
+    """Launch one raylet subprocess and wait for its ready line.
+
+    Shared by every subprocess-backed provider (single-node, pod-slice);
+    returns ``{"proc", "node_id", "addr"}``.  The parent's copy of the
+    log handle is closed after spawn (the child holds its own dup).
+    """
+    log = open(os.path.join(session_dir, "logs", f"raylet-{name}.log"),
+               "ab")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.raylet_proc",
+             "--session-dir", session_dir,
+             "--gcs-addr", gcs_addr,
+             "--resources", json.dumps(resources),
+             "--labels", json.dumps(labels),
+             "--node-name", name],
+            stdout=subprocess.PIPE, stderr=log, start_new_session=True)
+    finally:
+        log.close()
+    # bounded wait for the ready line: a wedged raylet must not hang the
+    # autoscaler's single reconcile thread forever
+    import select
+
+    ready, _, _ = select.select([proc.stdout], [], [], ready_timeout_s)
+    if not ready:
+        proc.kill()
+        raise TimeoutError(f"node {name} did not become ready in "
+                           f"{ready_timeout_s:.0f}s")
+    line = proc.stdout.readline().decode().strip()
+    info = json.loads(line) if line else {}
+    return {"proc": proc, "node_id": info.get("node_id"),
+            "addr": info.get("addr")}
+
+
 class LocalSubprocessNodeProvider(NodeProvider):
     """Nodes are raylet subprocesses on this host (one session)."""
 
@@ -47,28 +84,12 @@ class LocalSubprocessNodeProvider(NodeProvider):
                     labels: Dict[str, str]) -> str:
         self._counter += 1
         pid = f"{node_type}-{self._counter}"
-        log = open(os.path.join(self._session_dir, "logs",
-                                f"raylet-auto-{pid}.log"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.raylet_proc",
-             "--session-dir", self._session_dir,
-             "--gcs-addr", self._gcs_addr,
-             "--resources", json.dumps(resources),
-             "--labels", json.dumps(dict(labels, node_type=node_type)),
-             "--node-name", pid],
-            stdout=subprocess.PIPE, stderr=log, start_new_session=True)
-        # bounded wait for the ready line: a wedged raylet must not hang the
-        # autoscaler's single reconcile thread forever
-        import select
-
-        ready, _, _ = select.select([proc.stdout], [], [], 60.0)
-        if not ready:
-            proc.kill()
-            raise TimeoutError(f"node {pid} did not become ready in 60s")
-        line = proc.stdout.readline().decode().strip()
-        info = json.loads(line) if line else {}
-        self._nodes[pid] = {"proc": proc, "node_type": node_type,
-                            "node_id": info.get("node_id"),
+        spawned = spawn_raylet(
+            self._session_dir, self._gcs_addr, f"auto-{pid}", resources,
+            dict(labels, node_type=node_type))
+        self._nodes[pid] = {"proc": spawned["proc"],
+                            "node_type": node_type,
+                            "node_id": spawned["node_id"],
                             "created_at": time.time()}
         return pid
 

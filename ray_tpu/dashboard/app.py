@@ -8,6 +8,7 @@ tables — no aggregation RPCs needed on a single head.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Any, Dict, Optional
@@ -35,11 +36,18 @@ function table(rows, cols) {
   return h + "</table>";
 }
 async function render() {
-  const [cluster, actors, jobs, pgs, subjobs] = await Promise.all([
+  const [cluster, actors, jobs, pgs, subjobs, tasks] = await Promise.all([
     j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
-    j("/api/placement_groups"), j("/api/submitted_jobs")]);
+    j("/api/placement_groups"), j("/api/submitted_jobs"),
+    j("/api/tasks/summary")]);
+  const taskRows = Object.entries(tasks).map(([name, s]) =>
+    ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   document.getElementById("root").innerHTML =
-    "<h2>Nodes</h2>" + table(cluster.nodes, ["node_id","state","resources","available"]) +
+    '<p><a href="/api/timeline" download="timeline.json">download ' +
+    'chrome://tracing timeline</a> · <a href="/api/logs">logs</a> · ' +
+    '<a href="/metrics">prometheus</a></p>' +
+    "<h2>Nodes</h2>" + table(cluster.nodes, ["node_id","state","resources","available","stats"]) +
+    "<h2>Tasks</h2>" + table(taskRows, ["name","count","failed","mean_ms"]) +
     "<h2>Actors</h2>" + table(actors, ["actor_id","class_name","state","name","node_id"]) +
     "<h2>Driver jobs</h2>" + table(jobs, ["job_id","state","start_time"]) +
     "<h2>Submitted jobs</h2>" + table(subjobs, ["submission_id","status","entrypoint","message"]) +
@@ -67,11 +75,85 @@ def build_app(gcs) -> "object":
                           "state": "ALIVE" if n.get("alive") else "DEAD",
                           "addr": n.get("addr", ""),
                           "resources": n.get("total", {}),
-                          "available": n.get("available", {})})
+                          "available": n.get("available", {}),
+                          # per-node runtime stats shipped in heartbeats
+                          # (the raylet IS the per-node agent here)
+                          "stats": n.get("stats", {})})
         total = await gcs.handle_cluster_resources()
         avail = await gcs.handle_available_resources()
         return jresp({"nodes": nodes, "resources_total": total,
                       "resources_available": avail, "ts": time.time()})
+
+    async def api_tasks(_req):
+        return jresp(gcs.task_events[-2000:])
+
+    async def api_tasks_summary(_req):
+        out: Dict[str, Any] = {}
+        for e in gcs.task_events:
+            s = out.setdefault(e["name"], {"count": 0, "failed": 0,
+                                           "total_s": 0.0})
+            s["count"] += 1
+            s["failed"] += 0 if e.get("ok") else 1
+            s["total_s"] += e["end"] - e["start"]
+        for s in out.values():
+            s["mean_s"] = s["total_s"] / max(s["count"], 1)
+        return jresp(out)
+
+    async def api_timeline(_req):
+        # chrome://tracing export, one track per worker (same shape as
+        # ray_tpu.timeline() / the reference's `ray timeline`)
+        events = []
+        for e in gcs.task_events:
+            events.append({
+                "name": e["name"], "cat": e.get("kind", "TASK"), "ph": "X",
+                "ts": e["start"] * 1e6,
+                "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
+                "pid": e.get("node_id", "node")[:8],
+                "tid": e.get("worker_id", "worker"),
+                "args": {"ok": e.get("ok"), "task_id": e.get("task_id")},
+            })
+        return web.Response(
+            text=json.dumps(events),
+            content_type="application/json",
+            headers={"Content-Disposition":
+                     'attachment; filename="timeline.json"'})
+
+    async def api_logs(req):
+        import os
+
+        log_dir = os.path.join(gcs.session_dir, "logs")
+        name = req.query.get("file")
+        if not name:
+            try:
+                files = sorted(os.listdir(log_dir))
+            except OSError:
+                files = []
+            return jresp([{"file": f, "href": f"/api/logs?file={f}"}
+                          for f in files])
+        # path-traversal guard: serve only plain files inside logs/
+        path = os.path.realpath(os.path.join(log_dir, name))
+        if not path.startswith(os.path.realpath(log_dir) + os.sep) or \
+                not os.path.isfile(path):
+            return web.Response(status=404, text="no such log")
+        try:
+            tail = int(req.query.get("tail", 10_000))
+        except ValueError:
+            return web.Response(status=400, text="tail must be an integer")
+        tail = max(0, min(tail, 4 * 1024 * 1024))  # bound the read
+
+        def _read_tail() -> bytes:
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                return f.read()
+
+        # off the loop: this loop also serves GCS RPCs — a slow disk read
+        # must not stall heartbeats/scheduling
+        data = await asyncio.get_event_loop().run_in_executor(
+            None, _read_tail)
+        return web.Response(text=data.decode("utf-8", "replace"),
+                            content_type="text/plain")
 
     async def api_actors(_req):
         out = []
@@ -147,6 +229,10 @@ def build_app(gcs) -> "object":
     app.router.add_get("/api/placement_groups", api_pgs)
     app.router.add_get("/api/named_actors", api_named_actors)
     app.router.add_get("/api/events", api_events)
+    app.router.add_get("/api/tasks", api_tasks)
+    app.router.add_get("/api/tasks/summary", api_tasks_summary)
+    app.router.add_get("/api/timeline", api_timeline)
+    app.router.add_get("/api/logs", api_logs)
     app.router.add_get("/api/metrics", api_metrics)
     app.router.add_get("/metrics", prometheus)
     app.router.add_get("/-/healthz", healthz)

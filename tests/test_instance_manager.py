@@ -1,0 +1,215 @@
+"""Instance-manager lifecycle + TPU pod-slice provider (VERDICT item #8).
+
+Reference: ``python/ray/autoscaler/v2/instance_manager/`` state machine
+and the TPU slice model (``_private/accelerators/tpu.py:326-372``).
+"""
+
+from typing import Dict, List, Optional
+
+import pytest
+
+from ray_tpu.autoscaler.instance_manager import (
+    Instance,
+    InstanceManager,
+    InstanceState,
+)
+from ray_tpu.autoscaler.tpu_slice_provider import parse_pod_type
+
+
+class FakeProvider:
+    """In-memory provider: instances 'join' when the test says so."""
+
+    def __init__(self, nodes_per_instance: int = 1):
+        self._n = nodes_per_instance
+        self._alive: Dict[str, List[str]] = {}
+        self._counter = 0
+        self.fail_next = False
+
+    def create_node(self, node_type, resources, labels):
+        if self.fail_next:
+            self.fail_next = False
+            raise RuntimeError("cloud quota exceeded")
+        self._counter += 1
+        pid = f"{node_type}-{self._counter}"
+        self._alive[pid] = [f"{pid}-n{i}" for i in range(self._n)]
+        return pid
+
+    def terminate_node(self, pid):
+        self._alive.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return list(self._alive)
+
+    def node_id_of(self, pid):
+        ids = self._alive.get(pid)
+        return ids[0] if ids else None
+
+    def node_ids_of(self, pid):
+        return list(self._alive.get(pid, []))
+
+
+def test_lifecycle_requested_to_running():
+    prov = FakeProvider()
+    im = InstanceManager(prov)
+    inst = im.request("cpu", {"CPU": 4}, {})
+    assert inst.state is InstanceState.REQUESTED
+    im.reconcile(alive_node_ids=set())
+    assert inst.state is InstanceState.LAUNCHING
+    assert inst.provider_id in prov.non_terminated_nodes()
+    # node registers with the GCS -> RUNNING
+    im.reconcile(alive_node_ids=set(prov.node_ids_of(inst.provider_id)))
+    assert inst.state is InstanceState.RUNNING
+    assert inst.node_ids == prov.node_ids_of(inst.provider_id)
+
+
+def test_drain_terminates():
+    prov = FakeProvider()
+    im = InstanceManager(prov)
+    inst = im.request("cpu", {"CPU": 4}, {})
+    im.reconcile(set())
+    im.reconcile(set(prov.node_ids_of(inst.provider_id)))
+    im.drain(inst)
+    assert inst.state is InstanceState.DRAINING
+    im.reconcile(set())
+    assert inst.state is InstanceState.TERMINATED
+    assert not prov.non_terminated_nodes()
+
+
+def test_launch_failure_marks_failed():
+    prov = FakeProvider()
+    prov.fail_next = True
+    im = InstanceManager(prov)
+    inst = im.request("cpu", {"CPU": 4}, {})
+    im.reconcile(set())
+    assert inst.state is InstanceState.FAILED
+    assert "quota" in inst.failure
+
+
+def test_launch_timeout_fails_and_cleans_up():
+    prov = FakeProvider()
+    im = InstanceManager(prov, launch_timeout_s=0.0)
+    inst = im.request("cpu", {"CPU": 4}, {})
+    im.reconcile(set())
+    assert inst.state is InstanceState.LAUNCHING
+    im.reconcile(set())  # node never joins; timeout elapsed (0s)
+    assert inst.state is InstanceState.FAILED
+    assert inst.failure == "launch timeout"
+    assert not prov.non_terminated_nodes()  # provider node reclaimed
+
+
+def test_running_node_death_fails_instance():
+    prov = FakeProvider()
+    im = InstanceManager(prov)
+    inst = im.request("cpu", {"CPU": 4}, {})
+    im.reconcile(set())
+    alive = set(prov.node_ids_of(inst.provider_id))
+    im.reconcile(alive)
+    assert inst.state is InstanceState.RUNNING
+    prov.terminate_node(inst.provider_id)  # cloud killed it
+    im.reconcile(alive)
+    assert inst.state is InstanceState.FAILED
+
+
+def test_transient_heartbeat_blip_survives_grace():
+    """A member missing from GCS-alive briefly (heartbeat blip) must not
+    fail the instance; a persistent absence past the grace does."""
+    prov = FakeProvider()
+    im = InstanceManager(prov, dead_grace_s=3600.0)
+    inst = im.request("cpu", {"CPU": 4}, {})
+    im.reconcile(set())
+    alive = set(prov.node_ids_of(inst.provider_id))
+    im.reconcile(alive)
+    assert inst.state is InstanceState.RUNNING
+    im.reconcile(set())  # GCS says dead, provider says alive: blip
+    assert inst.state is InstanceState.RUNNING
+    im.reconcile(alive)  # resurrected
+    assert inst.state is InstanceState.RUNNING and inst.dead_since is None
+    im2 = InstanceManager(prov, dead_grace_s=0.0)
+    inst2 = im2.request("cpu", {"CPU": 4}, {})
+    im2.reconcile(set())
+    alive2 = set(prov.node_ids_of(inst2.provider_id))
+    im2.reconcile(alive2)
+    im2.reconcile(set())   # first observation starts the clock
+    im2.reconcile(set())   # grace (0s) elapsed -> FAILED + reclaimed
+    assert inst2.state is InstanceState.FAILED
+    assert inst2.provider_id not in prov.non_terminated_nodes()
+
+
+def test_terminal_records_pruned():
+    prov = FakeProvider()
+    im = InstanceManager(prov, keep_terminal=3)
+    for _ in range(6):
+        inst = im.request("cpu", {"CPU": 1}, {})
+        im.reconcile(set())
+        im.reconcile(set(prov.node_ids_of(inst.provider_id)))
+        im.drain(inst)
+        im.reconcile(set())
+    terminal = im.by_state(InstanceState.TERMINATED, InstanceState.FAILED)
+    assert len(terminal) == 3  # oldest evicted
+
+
+def test_multi_host_instance_runs_only_when_all_join():
+    """A pod slice is RUNNING only once EVERY host raylet registered."""
+    prov = FakeProvider(nodes_per_instance=4)
+    im = InstanceManager(prov)
+    inst = im.request("v5e-16", {"TPU": 4}, {})
+    im.reconcile(set())
+    all_ids = prov.node_ids_of(inst.provider_id)
+    im.reconcile(set(all_ids[:2]))  # half the hosts joined
+    assert inst.state is InstanceState.LAUNCHING
+    im.reconcile(set(all_ids))
+    assert inst.state is InstanceState.RUNNING
+    assert len(inst.node_ids) == 4
+
+
+def test_parse_pod_type():
+    spec = parse_pod_type("v5e-16")
+    assert (spec.num_hosts, spec.chips_per_host, spec.total_chips) == (4, 4, 16)
+    spec = parse_pod_type("v4-8")
+    assert spec.num_hosts == 2
+    assert parse_pod_type("v5e-4").num_hosts == 1
+
+
+def test_tpu_slice_provider_end_to_end(ray_isolated):
+    """Provision a real (subprocess) 2-host slice: both hosts register
+    with slice labels, the head host carries the slice-head resource, and
+    termination tears down the whole slice atomically."""
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.autoscaler.tpu_slice_provider import TPUPodSliceProvider
+
+    w = get_global_worker()
+    # v4-8 = 2 hosts x 4 chips
+    prov = TPUPodSliceProvider(w.session_dir, w.gcs.addr, host_cpus=1)
+    sid = prov.create_node("v4-8", {}, {})
+    try:
+        node_ids = prov.node_ids_of(sid)
+        assert len(node_ids) == 2
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            nodes = {n["node_id"]: n for n in ray_tpu.nodes()
+                     if n["alive"]}
+            if all(nid in nodes for nid in node_ids):
+                break
+            time.sleep(0.5)
+        members = [nodes[nid] for nid in node_ids]
+        assert all(m["Resources"].get("TPU") == 4.0 for m in members)
+        heads = [m for m in members
+                 if any(k.startswith("TPU-v4-8-head")
+                        for k in m["Resources"])]
+        assert len(heads) == 1  # exactly one slice-head
+        labels = [m["labels"] for m in members]
+        assert {l["tpu-worker-index"] for l in labels} == {"0", "1"}
+        assert len({l["tpu-slice"] for l in labels}) == 1
+    finally:
+        prov.terminate_node(sid)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        alive = [n for n in ray_tpu.nodes()
+                 if n["alive"] and n["node_id"] in node_ids]
+        if not alive:
+            break
+        time.sleep(0.5)
+    assert not alive
