@@ -506,7 +506,16 @@ class GcsServer:
                 fut.set_result(None)
         return True
 
-    async def handle_wait_actor_ready(self, actor_id: bytes, timeout: float = 60.0) -> Dict:
+    async def handle_wait_actor_ready(self, actor_id: bytes,
+                                      poll_s: float = 20.0,
+                                      timeout: Optional[float] = None
+                                      ) -> Dict:
+        # poll_s is the SERVER-side long-poll window; callers set their
+        # wire timeout LONGER than it so the server always replies with
+        # the current state before the client gives up (``timeout`` kept
+        # for wire-compat with older callers that passed it through)
+        if timeout is not None:
+            poll_s = min(poll_s, timeout)
         info = self.actors.get(actor_id)
         if info is None:
             return {"state": "NOT_FOUND"}
@@ -515,9 +524,13 @@ class GcsServer:
         fut = asyncio.get_event_loop().create_future()
         self._actor_waiters.setdefault(actor_id, []).append(fut)
         try:
-            await asyncio.wait_for(fut, timeout)
+            await asyncio.wait_for(fut, poll_s)
         except asyncio.TimeoutError:
             pass
+        finally:
+            waiters = self._actor_waiters.get(actor_id)
+            if waiters and fut in waiters:
+                waiters.remove(fut)  # no stacked stale waiters per poll
         info = self.actors.get(actor_id, {"state": "NOT_FOUND"})
         return {"state": info.get("state"), "addr": info.get("addr")}
 

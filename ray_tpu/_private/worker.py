@@ -1209,8 +1209,16 @@ class CoreWorker:
             return addr
         deadline = self.loop.time() + timeout
         while True:
-            info = await self.gcs.call("wait_actor_ready", actor_id=actor_id.binary(),
-                                       timeout=30.0)
+            try:
+                # server long-poll window (poll_s) deliberately SHORTER
+                # than the wire timeout so the server replies with current
+                # state before the client gives up
+                info = await self.gcs.call(
+                    "wait_actor_ready", actor_id=actor_id.binary(),
+                    poll_s=20.0, timeout=30.0)
+            except asyncio.TimeoutError:
+                # network-slowness backstop: poll again until OUR deadline
+                info = {}
             state = info.get("state")
             if state == "ALIVE":
                 self._actor_addr_cache[actor_id] = info["addr"]

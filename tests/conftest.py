@@ -4,6 +4,12 @@ JAX tests run on a virtual 8-device CPU mesh (the reference's trick of
 emulating multi-node on one host, and the compiled-graph CPU-communicator
 trick at ``python/ray/experimental/channel/cpu_communicator.py``): multi-chip
 sharding logic is validated without TPU hardware.
+
+Tiers (the intent of the reference's Bazel size/tag sharding,
+``python/ray/tests/BUILD:16-72``): JAX-compile-heavy model/learning
+modules carry ``pytest.mark.slow``; the core-runtime tier runs with
+``-m "not slow"`` for fast iteration.  The default run executes
+everything.
 """
 
 import os
@@ -28,12 +34,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+# the shared session cluster's shape — every fixture that restores it
+# after isolation must use the same parameters
+SESSION_CLUSTER = {"num_cpus": 16, "num_tpus": 0}
+
+
 @pytest.fixture(scope="session")
 def ray_session():
     """One shared cluster for the whole test session (fast: workers reused)."""
     import ray_tpu
 
-    ray_tpu.init(num_cpus=16, num_tpus=0)
+    ray_tpu.init(**SESSION_CLUSTER)
     yield
     ray_tpu.shutdown()
 
@@ -62,7 +73,25 @@ def ray_isolated():
     finally:
         ray_tpu.shutdown()
         if was_up:
-            ray_tpu.init(num_cpus=16, num_tpus=0)
+            ray_tpu.init(**SESSION_CLUSTER)
+
+
+@pytest.fixture
+def no_cluster():
+    """A clean slate for tests that drive ray_tpu.init() themselves (bare
+    init while the session cluster is up raises 'called twice', and a
+    shutdown inside such a test would strand every later ray_start test);
+    restores the shared session cluster afterwards."""
+    import ray_tpu
+
+    was_up = ray_tpu.is_initialized()
+    if was_up:
+        ray_tpu.shutdown()
+    yield
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    if was_up:
+        ray_tpu.init(**SESSION_CLUSTER)
 
 
 @pytest.fixture

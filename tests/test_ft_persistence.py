@@ -76,7 +76,7 @@ def test_gcs_snapshot_roundtrip(monkeypatch, tmp_path):
         config.reload()
 
 
-def test_gcs_process_restart_actors_survive(monkeypatch, tmp_path):
+def test_gcs_process_restart_actors_survive(no_cluster, tmp_path):
     """Kill -9 the standalone GCS, restart it on the same port with the
     same storage: the driver reconnects, named actors resolve, and the
     still-running actor keeps serving calls."""

@@ -5,6 +5,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# JAX-compile-heavy tier: deselect with -m 'not slow' for fast runs
+pytestmark = pytest.mark.slow
+
 from ray_tpu.models.llama import (
     LlamaConfig,
     llama_apply,

@@ -14,7 +14,8 @@ from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.checkpoint import Checkpoint
 
 
-pytestmark = pytest.mark.usefixtures("ray_start")
+pytestmark = [pytest.mark.usefixtures("ray_start"),
+              pytest.mark.slow]
 
 
 class TestDataParallelTrainer:

@@ -3,6 +3,9 @@
 import numpy as np
 import pytest
 
+# JAX-compile-heavy tier: deselect with -m 'not slow' for fast runs
+pytestmark = pytest.mark.slow
+
 import ray_tpu
 from ray_tpu import tune
 from ray_tpu.tune import (
