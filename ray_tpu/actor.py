@@ -166,6 +166,16 @@ class ActorClass:
         ]
         return tuple(names)
 
+    def _packaged_runtime_env(self, worker):
+        """Env snapshot at first creation (see RemoteFunction twin)."""
+        from ray_tpu.remote_function import _UNSET
+
+        cached = getattr(self, "_runtime_env_snapshot", _UNSET)
+        if cached is _UNSET:
+            cached = _validated_runtime_env(self._options, worker)
+            self._runtime_env_snapshot = cached
+        return cached
+
     def _method_options(self) -> Dict[str, Dict[str, Any]]:
         """Collect per-method defaults set via @ray_tpu.method(...)."""
         out: Dict[str, Dict[str, Any]] = {}
@@ -215,7 +225,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=opts.get("max_restarts", config.actor_max_restarts_default),
             max_concurrency=max_concurrency,
-            runtime_env=_validated_runtime_env(opts),
+            runtime_env=self._packaged_runtime_env(worker),
             is_async_actor=is_async,
             actor_name=name,
             namespace=namespace,

@@ -12,6 +12,8 @@ from typing import Any, Dict, Optional
 from ray_tpu._private import api_utils, serialization
 from ray_tpu._private.task_spec import FunctionDescriptor, TaskSpec, TaskType
 
+_UNSET = object()
+
 
 class RemoteFunction:
     def __init__(self, function, options: Optional[Dict[str, Any]] = None):
@@ -42,6 +44,17 @@ class RemoteFunction:
 
         return FunctionNode(self, args, kwargs)
 
+    def _packaged_runtime_env(self, worker):
+        """Validate + package the runtime env ONCE per function object:
+        the env is a snapshot at first submission (local dirs become
+        content-addressed packages), so later calls reuse the URI even if
+        the source path has since changed or vanished."""
+        cached = getattr(self, "_runtime_env_snapshot", _UNSET)
+        if cached is _UNSET:
+            cached = _validated_runtime_env(self._options, worker)
+            self._runtime_env_snapshot = cached
+        return cached
+
     def remote(self, *args, **kwargs):
         from ray_tpu._private.config import config
         from ray_tpu._private.worker import get_global_worker
@@ -68,7 +81,7 @@ class RemoteFunction:
             scheduling_strategy=api_utils.normalize_strategy(opts.get("scheduling_strategy")),
             max_retries=opts.get("max_retries", config.task_max_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
-            runtime_env=_validated_runtime_env(opts),
+            runtime_env=self._packaged_runtime_env(worker),
             backpressure_num_objects=int(
                 opts.get("_generator_backpressure_num_objects", 0) or 0),
         )
@@ -78,13 +91,19 @@ class RemoteFunction:
         return refs
 
 
-def _validated_runtime_env(opts):
+def _validated_runtime_env(opts, worker=None):
     re = opts.get("runtime_env")
     if not re:
         return None
-    from ray_tpu.runtime_env import validate
+    from ray_tpu.runtime_env import package_local_dirs, validate
 
-    return validate(re)
+    validated = validate(re)
+    if worker is not None:
+        # local working_dir/py_modules become content-addressed packages
+        # in the cluster KV so any node can materialize them (reference:
+        # runtime_env packaging + gcs:// URIs)
+        validated = package_local_dirs(validated, worker)
+    return validated
 
 
 def remote_decorator(*args, **options):

@@ -7,9 +7,15 @@ per-node agent.  Implemented fields here:
 - ``env_vars``:   applied around task execution (process-wide for actors,
   which own their worker process; scoped-with-a-lock for pooled task
   workers);
-- ``working_dir``: chdir for the task (local path; no packaging/upload —
-  single-host-first);
-- ``py_modules``: local paths prepended to ``sys.path``.
+- ``working_dir``: local path OR packaged URI — local directories are
+  zipped at submission into a content-addressed package uploaded to the
+  GCS KV (``pkg://<hash>``), and executing workers download + extract it
+  into a session cache (reference: ``runtime_env/packaging.py`` gcs://
+  URIs + ``working_dir`` plugin);
+- ``py_modules``: list of local paths or packaged URIs, prepended to
+  ``sys.path`` after the same package/extract cycle;
+- plugins: extra fields validated/applied through ``register_plugin``
+  (the reference's plugin protocol, ``runtime_env/plugin.py``).
 
 ``pip``/``conda`` provisioning is intentionally absent this round: the
 execution substrate ships as a sealed image (SURVEY.md environment notes);
@@ -19,16 +25,194 @@ the validation below rejects them loudly rather than pretending.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import io
+import logging
 import os
 import sys
 import threading
-from typing import Any, Dict, List, Optional
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _SUPPORTED = {"env_vars", "working_dir", "py_modules"}
 _UNSUPPORTED = {"pip", "conda", "uv", "container", "image_uri"}
 
 # pooled task workers share a process: env mutations are exclusive
 _apply_lock = threading.Lock()
+
+# ---------------------------------------------------------------- plugins
+
+# name -> (validate_fn(value) -> value, apply_fn(value) -> None | context)
+_PLUGINS: Dict[str, Tuple[Callable, Optional[Callable]]] = {}
+
+
+def register_plugin(name: str, validate_fn: Callable[[Any], Any],
+                    apply_fn: Optional[Callable[[Any], Any]] = None):
+    """Extend runtime_env with a custom field (reference plugin protocol,
+    ``python/ray/_private/runtime_env/plugin.py``).  ``validate_fn`` runs
+    at submission; ``apply_fn`` (optional) runs in the executing worker —
+    it may return a context manager to scope the application."""
+    if name in _SUPPORTED or name in _UNSUPPORTED:
+        raise ValueError(f"cannot override built-in field {name!r}")
+    _PLUGINS[name] = (validate_fn, apply_fn)
+
+
+# -------------------------------------------------------------- packaging
+
+_PKG_PREFIX = "pkg://"
+_PKG_MAX_BYTES = 100 * 1024 * 1024  # reference GCS package size cap
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+# submit-side cache: (gcs_addr, abs_path, manifest_digest) -> uploaded uri;
+# keyed by the cluster so a fresh cluster (empty KV) never reuses an URI
+# that was only uploaded to a previous one
+_pkg_cache: Dict[Tuple[str, str, str], str] = {}
+_pkg_lock = threading.Lock()
+
+
+def _zip_dir(path: str) -> Tuple[bytes, str]:
+    """Deterministic zip of a directory; returns (bytes, content_hash)."""
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            entries.append((os.path.relpath(full, path), full))
+    h = hashlib.blake2b(digest_size=16)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for rel, full in entries:
+            try:
+                with open(full, "rb") as f:
+                    data = f.read()
+            except OSError:
+                # vanished mid-walk / broken symlink: skip, like the
+                # manifest scan does
+                logger.debug("skipping unreadable %s while packaging", full)
+                continue
+            h.update(rel.encode())
+            h.update(data)
+            # fixed date_time -> byte-stable archives for equal content
+            info = zipfile.ZipInfo(rel, date_time=(2020, 1, 1, 0, 0, 0))
+            z.writestr(info, data)
+    blob = buf.getvalue()
+    if len(blob) > _PKG_MAX_BYTES:
+        raise ValueError(
+            f"runtime_env package of {path!r} is {len(blob)} bytes, over "
+            f"the {_PKG_MAX_BYTES} limit; exclude large data from "
+            f"working_dir/py_modules")
+    return blob, h.hexdigest()
+
+
+def _manifest_digest(path: str) -> str:
+    """Cheap change detector: hash of the sorted (relpath, size, mtime)
+    manifest — catches deletions and preserved-mtime additions that a
+    newest-mtime key would miss, without reading file contents."""
+    h = hashlib.blake2b(digest_size=16)
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(os.path.relpath(full, path).encode())
+            h.update(st.st_size.to_bytes(8, "little"))
+            h.update(st.st_mtime_ns.to_bytes(12, "little", signed=True))
+    return h.hexdigest()
+
+
+def _upload_dir(path: str, worker) -> str:
+    """Package a local dir and upload to the GCS KV; returns a pkg:// URI.
+    Cached by (cluster, path, manifest digest) so repeated submissions
+    don't re-zip and a fresh cluster never reuses a stale upload."""
+    cluster = getattr(worker.gcs, "addr", "")
+    key = (cluster, os.path.abspath(path), _manifest_digest(path))
+    with _pkg_lock:
+        hit = _pkg_cache.get(key)
+    if hit is not None:
+        return hit
+    blob, digest = _zip_dir(path)
+    uri = f"{_PKG_PREFIX}{digest}"
+    exists = worker.run_coro(worker.gcs.call(
+        "kv_exists", ns="packages", key=uri))
+    if not exists:
+        worker.run_coro(worker.gcs.call(
+            "kv_put", ns="packages", key=uri, value=blob))
+        logger.info("uploaded runtime_env package %s (%d bytes) from %s",
+                    uri, len(blob), path)
+    with _pkg_lock:
+        _pkg_cache[key] = uri
+    return uri
+
+
+def package_local_dirs(env: Optional[Dict[str, Any]],
+                       worker) -> Optional[Dict[str, Any]]:
+    """Submission side: replace local working_dir/py_modules paths with
+    content-addressed package URIs so any node can materialize them."""
+    if not env:
+        return env
+    out = dict(env)
+    wd = out.get("working_dir")
+    if wd and not wd.startswith(_PKG_PREFIX) and os.path.isdir(wd):
+        out["working_dir"] = _upload_dir(wd, worker)
+    mods = out.get("py_modules")
+    if mods:
+        packed = []
+        for m in mods:
+            if not m.startswith(_PKG_PREFIX) and os.path.isdir(m):
+                packed.append(_upload_dir(m, worker))
+            else:
+                packed.append(m)
+        out["py_modules"] = packed
+    return out
+
+
+def _resolve_uri(value: str) -> str:
+    """Executing side: materialize a pkg:// URI into a cached local dir."""
+    if not value.startswith(_PKG_PREFIX):
+        return value
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    digest = value[len(_PKG_PREFIX):]
+    base = os.path.join(worker.session_dir, "runtime_resources")
+    dest = os.path.join(base, digest)
+    if os.path.isdir(dest):
+        return dest
+    os.makedirs(base, exist_ok=True)
+    if threading.current_thread() is getattr(worker, "_loop_thread", None):
+        # actor creation runs ON the worker's IO loop: blocking run_coro
+        # there would deadlock — fetch over a short-lived side connection
+        from ray_tpu._private.rpc import RpcClient, run_sync
+
+        async def _fetch():
+            c = RpcClient(worker.gcs.addr)
+            try:
+                return await c.call("kv_get", ns="packages", key=value)
+            finally:
+                await c.close()
+
+        blob = run_sync(_fetch())
+    else:
+        blob = worker.run_coro(worker.gcs.call(
+            "kv_get", ns="packages", key=value))
+    if blob is None:
+        raise FileNotFoundError(f"runtime_env package {value} not found "
+                                f"in the cluster KV store")
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)  # an empty package is a valid dir
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        z.extractall(tmp)
+    try:
+        os.rename(tmp, dest)  # atomic: concurrent extractors both win
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
 
 
 class RuntimeEnv(dict):
@@ -43,7 +227,7 @@ class RuntimeEnv(dict):
                 f"runtime_env fields {sorted(bad)} are not supported (the "
                 f"runtime ships as a sealed image; use env_vars/working_dir/"
                 f"py_modules)")
-        unknown = set(extra) - _UNSUPPORTED
+        unknown = set(extra) - _UNSUPPORTED - set(_PLUGINS)
         if unknown:
             raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
         super().__init__()
@@ -56,6 +240,20 @@ class RuntimeEnv(dict):
             self["working_dir"] = str(working_dir)
         if py_modules:
             self["py_modules"] = [str(p) for p in py_modules]
+        for name in set(extra) & set(_PLUGINS):
+            validate_fn, apply_fn = _PLUGINS[name]
+            value = validate_fn(extra[name])
+            if apply_fn is not None:
+                # the executing worker has no plugin registry: ship the
+                # apply function with the env (cloudpickled, same trust
+                # domain as the task function itself)
+                from ray_tpu._private import serialization
+
+                self[name] = {"__plugin_apply__":
+                              serialization.dumps(apply_fn),
+                              "value": value}
+            else:
+                self[name] = {"value": value}
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -73,12 +271,36 @@ def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
     os.environ.update(runtime_env.get("env_vars") or {})
     wd = runtime_env.get("working_dir")
     if wd:
+        wd = _resolve_uri(wd)
         os.chdir(wd)
         if wd not in sys.path:
             sys.path.insert(0, wd)
     for p in runtime_env.get("py_modules") or []:
+        p = _resolve_uri(p)
         if p not in sys.path:
             sys.path.insert(0, p)
+    # permanent application: context managers returned by plugins are
+    # entered and never exited (the actor owns its process)
+    for cm in _apply_plugins(runtime_env):
+        cm.__enter__()
+
+
+def _apply_plugins(runtime_env: Dict[str, Any]) -> list:
+    """Run shipped plugin apply fns; returns any context managers they
+    return so the caller can scope them (entered-for-good by
+    apply_permanent, stacked by applied())."""
+    from ray_tpu._private import serialization
+
+    cms = []
+    for name, entry in runtime_env.items():
+        if name in _SUPPORTED or not isinstance(entry, dict):
+            continue
+        payload = entry.get("__plugin_apply__")
+        if payload is not None:
+            out = serialization.loads(payload)(entry.get("value"))
+            if hasattr(out, "__enter__"):
+                cms.append(out)
+    return cms
 
 
 @contextlib.contextmanager
@@ -102,11 +324,15 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
                 os.environ[k] = v
             wd = runtime_env.get("working_dir")
             if wd:
+                wd = _resolve_uri(wd)
                 os.chdir(wd)
                 sys.path.insert(0, wd)
             for p in runtime_env.get("py_modules") or []:
-                sys.path.insert(0, p)
-            yield
+                sys.path.insert(0, _resolve_uri(p))
+            with contextlib.ExitStack() as stack:
+                for cm in _apply_plugins(runtime_env):
+                    stack.enter_context(cm)  # scoped to this task
+                yield
         finally:
             for k, v in saved_env.items():
                 if v is None:

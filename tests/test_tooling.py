@@ -189,7 +189,10 @@ def test_runtime_env_task_and_actor(ray_start, tmp_path):
         return os.environ.get("RTE_FLAG"), os.getcwd()
 
     flag, cwd = ray_tpu.get(probe.remote())
-    assert flag == "on" and cwd == str(tmp_path)
+    # working_dir is materialized from its content-addressed package, so
+    # the task's cwd is the extracted copy, not the submitter's path
+    assert flag == "on" and os.path.basename(os.path.dirname(cwd)) == \
+        "runtime_resources"
 
     # env restored for tasks without a runtime_env on the same workers
     @ray_tpu.remote
@@ -223,6 +226,86 @@ def test_runtime_env_py_modules(ray_start, tmp_path):
         return mymod.VALUE
 
     assert ray_tpu.get(use_mod.remote()) == 123
+
+
+def test_runtime_env_packaging_roundtrip(ray_start, tmp_path):
+    """Local working_dir/py_modules become content-addressed pkg:// URIs
+    in the cluster KV; executing workers materialize them from the package
+    — not from the original path (reference: runtime_env packaging)."""
+    import shutil
+
+    src = tmp_path / "proj"
+    src.mkdir()
+    (src / "data.txt").write_text("packaged-payload")
+    (src / "pkgmod.py").write_text("WHO = 'from-package'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(src)})
+    def read_data():
+        import os
+
+        # cwd is the EXTRACTED package dir, not the source path
+        with open("data.txt") as f:
+            return f.read(), os.getcwd()
+
+    content, cwd = ray_tpu.get(read_data.remote())
+    assert content == "packaged-payload"
+    assert "runtime_resources" in cwd and str(src) not in cwd
+
+    # the spec carries a pkg:// URI, so the env survives source deletion
+    @ray_tpu.remote(runtime_env={"py_modules": [str(src)]})
+    def use_mod():
+        import pkgmod
+
+        return pkgmod.WHO
+
+    first = use_mod.remote()
+    assert ray_tpu.get(first) == "from-package"
+
+    # actor creation applies the packaged env on the worker's own IO loop
+    # (the apply_permanent path — must not deadlock on the KV fetch)
+    @ray_tpu.remote(runtime_env={"working_dir": str(src)})
+    class PkgActor:
+        def read(self):
+            with open("data.txt") as f:
+                return f.read()
+
+    a = PkgActor.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "packaged-payload"
+    ray_tpu.kill(a)
+
+    shutil.rmtree(src)
+    assert ray_tpu.get(use_mod.remote()) == "from-package"
+
+
+def test_runtime_env_plugin_protocol(ray_start):
+    """register_plugin extends runtime_env with validated custom fields
+    applied in the executing worker (reference plugin.py protocol)."""
+    import os
+
+    from ray_tpu import runtime_env as renv
+
+    def validate_banner(v):
+        if not isinstance(v, str):
+            raise TypeError("banner must be a string")
+        return v.upper()
+
+    def apply_banner(v):
+        os.environ["RTPU_TEST_BANNER"] = v
+
+    renv.register_plugin("banner", validate_banner, apply_banner)
+    try:
+        @ray_tpu.remote(runtime_env={"banner": "hello"})
+        def read_banner():
+            import os
+
+            return os.environ.get("RTPU_TEST_BANNER")
+
+        assert ray_tpu.get(read_banner.remote()) == "HELLO"
+        with pytest.raises(Exception):
+            ray_tpu.get(ray_tpu.remote(
+                runtime_env={"banner": 42})(lambda: 1).remote())
+    finally:
+        renv._PLUGINS.pop("banner", None)
 
 
 def test_runtime_env_rejects_unsupported(ray_start):
