@@ -97,9 +97,19 @@ def normalize_strategy(strategy) -> SchedulingStrategy:
     raise ValueError(f"Unsupported scheduling strategy: {strategy!r}")
 
 
-def build_args(worker, args: Tuple, kwargs: Dict) -> Tuple[List[TaskArg], List[str]]:
-    """Serialize positional + keyword args; promote large values to objects."""
+def build_args(worker, args: Tuple, kwargs: Dict
+               ) -> Tuple[List[TaskArg], List[str], List[ObjectRef]]:
+    """Serialize positional + keyword args; promote large values to objects.
+
+    Returns ``(task_args, kw_keys, nested_refs)`` — ``nested_refs`` are the
+    live ObjectRefs serialized *inside* inline argument values.  The
+    submitter must hold them until the task reply (alongside the top-level
+    arg refs) so a task queued arbitrarily long can never have a nested
+    argument object freed underneath it (no TTL in this path; the
+    reference's submitted-task borrow count, ``reference_count.h``).
+    """
     task_args: List[TaskArg] = []
+    nested_refs: List[ObjectRef] = []
     kw_keys = list(kwargs.keys())
     for value in list(args) + [kwargs[k] for k in kw_keys]:
         if isinstance(value, ObjectRef):
@@ -110,14 +120,9 @@ def build_args(worker, args: Tuple, kwargs: Dict) -> Tuple[List[TaskArg], List[s
             ref = worker.put(value)
             task_args.append(TaskArg(is_ref=True, payload=ref))
         else:
-            if refs:
-                # refs nested in an inline arg value: grace-pin them at
-                # their owners until the executing worker deserializes the
-                # arg and registers as a borrower (lifetime hold #3)
-                worker.loop.call_soon_threadsafe(
-                    worker._pin_contained_refs, list(refs))
+            nested_refs.extend(refs)
             task_args.append(TaskArg(is_ref=False, payload=payload))
-    return task_args, kw_keys
+    return task_args, kw_keys, nested_refs
 
 
 def next_task_id(worker) -> TaskID:

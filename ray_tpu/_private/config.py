@@ -33,12 +33,12 @@ _FLAG_DEFS: Dict[str, Any] = {
     # (reference: RayConfig::max_direct_call_object_size, 100KB)
     "max_inline_object_size": 100 * 1024,
     "object_spill_dir": "",
-    "object_store_fallback_dir": "",
     # --- object lifetime (reference_count.h:72, object_recovery_manager.h) ---
     "reference_counting_enabled": True,
-    # grace window for a ref serialized into a payload whose receiver has
-    # not yet registered as a borrower (the reference forwards borrow
-    # records per-message; a TTL pin is the economy equivalent)
+    # failsafe expiry for the executor→submitter bridge pin on refs
+    # embedded in return values (the submitter's reply-time registration
+    # retires it; the TTL only fires for replies that were lost) —
+    # correctness does not depend on any receiver deserializing in time
     "transfer_pin_ttl_s": 60.0,
     # how many producing TaskSpecs the owner retains for lineage
     # reconstruction (reference max_lineage_bytes, task_manager.h:182)
@@ -78,9 +78,6 @@ _FLAG_DEFS: Dict[str, Any] = {
     # --- GCS ---
     "gcs_storage": "memory",  # "memory" | "file" (persistence for FT)
     "gcs_storage_path": "",
-    # --- logging / events ---
-    "event_log_enabled": True,
-    "log_rotation_bytes": 100 * 1024 * 1024,
     # --- object transfer (pull/push managers, object_manager.h:106) ---
     "transfer_chunk_bytes": 8 * 1024 * 1024,
     "transfer_window_chunks": 4,

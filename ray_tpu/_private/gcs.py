@@ -27,6 +27,24 @@ from ray_tpu._private.scheduling import NodeView, ResourceSet
 
 logger = logging.getLogger(__name__)
 
+# Handlers that never touch snapshot-persisted state (reads, volatile-only
+# writes): they skip the dirty mark so an idle cluster never re-pickles.
+# Heartbeats mark dirty themselves only when `available` changes.
+_READONLY_HANDLERS = frozenset({
+    "heartbeat", "get_all_nodes", "kv_get", "kv_keys", "kv_exists",
+    "list_jobs", "get_task_events", "report_task_events", "job_status",
+    "job_logs", "list_submitted_jobs", "wait_actor_ready", "get_actor_info",
+    "get_named_actor", "list_named_actors", "list_actors",
+    "wait_placement_group_ready", "get_placement_group",
+    "list_placement_groups", "subscribe", "cluster_resources",
+    "available_resources",
+})
+
+# kv values at or above this size are persisted as individual
+# content-addressed side files instead of inside the snapshot pickle —
+# runtime-env packages (up to 100 MB) must not be re-serialized every tick.
+_KV_BLOB_MIN = 64 * 1024
+
 
 class GcsServer:
     def __init__(self, session_dir: str):
@@ -71,10 +89,24 @@ class GcsServer:
         self._storage_path = (config.gcs_storage_path
                               or f"{session_dir}/gcs_state.pkl")
         self._last_snapshot: bytes = b""
+        # dirty flag gates the snapshot pickle: an idle cluster (heartbeats
+        # only) pays zero serialization cost.  Set by every non-read RPC
+        # handler (wrapped below), by _publish, and by resource-changing
+        # heartbeats; a periodic unconditional tick backstops any missed
+        # mutation path.
+        self._dirty = True
+        self._snapshot_warned = False
+        # kv key -> (value identity, blob name): kv values are replaced,
+        # never mutated, so identity lets the unconditional backstop tick
+        # skip re-copying + re-hashing 100MB packages every 5 s
+        self._blob_name_cache: Dict[Any, Tuple[Any, str]] = {}
         if self._persist_enabled:
             self._load_snapshot()
 
         self.server.register_all(self)
+        for name, h in list(self.server._handlers.items()):
+            if name not in _READONLY_HANDLERS:
+                self.server.register(name, self._mark_dirty_wrapper(h))
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         bound_host, bound_port = await self.server.listen_tcp(host, port)
@@ -90,8 +122,52 @@ class GcsServer:
     _SNAPSHOT_TABLES = ("kv", "nodes", "actors", "named_actors", "jobs",
                         "pgs", "workers")
 
+    def _mark_dirty_wrapper(self, handler):
+        async def wrapped(**kwargs):
+            self._dirty = True
+            return await handler(**kwargs)
+
+        return wrapped
+
+    def _blob_dir(self) -> str:
+        return self._storage_path + ".blobs"
+
+    def _ensure_blob(self, value: bytes) -> str:
+        """Write a content-addressed side file for a large kv value (once —
+        content hash makes rewrites idempotent); returns the blob name."""
+        import hashlib
+        import os
+
+        name = hashlib.sha256(value).hexdigest()[:40]
+        path = os.path.join(self._blob_dir(), name)
+        if not os.path.exists(path):
+            os.makedirs(self._blob_dir(), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(value)
+            os.replace(tmp, path)
+        return name
+
     def _snapshot_state(self) -> Dict[str, Any]:
         state = {t: getattr(self, t) for t in self._SNAPSHOT_TABLES}
+        # large kv values (runtime-env packages) live in side files; the
+        # snapshot carries a (sentinel, blob-name) pointer
+        kv_out: Dict[Any, Any] = {}
+        new_cache: Dict[Any, Tuple[Any, str]] = {}
+        for k, v in self.kv.items():
+            if (isinstance(v, (bytes, bytearray, memoryview))
+                    and len(v) >= _KV_BLOB_MIN):
+                cached = self._blob_name_cache.get(k)
+                if cached is not None and cached[0] is v:
+                    name = cached[1]
+                else:
+                    name = self._ensure_blob(bytes(v))
+                new_cache[k] = (v, name)
+                kv_out[k] = ("__kv_blob__", name)
+            else:
+                kv_out[k] = v
+        self._blob_name_cache = new_cache  # drops deleted keys
+        state["kv"] = kv_out
         # volatile per-heartbeat fields excluded: they'd defeat the
         # debounce and churn a full disk write every 250ms on idle clusters
         state["nodes"] = {
@@ -110,7 +186,28 @@ class GcsServer:
         import os
         import pickle
 
-        blob = pickle.dumps(self._snapshot_state())
+        state = self._snapshot_state()
+        try:
+            blob = pickle.dumps(state)
+        except Exception:  # noqa: BLE001
+            # an unpicklable value must not silently kill persistence for
+            # the whole cluster: sweep the kv copy (the only table holding
+            # arbitrary user values), drop offenders loudly, retry
+            bad = []
+            for k, v in state["kv"].items():
+                try:
+                    pickle.dumps(v)
+                except Exception:  # noqa: BLE001
+                    bad.append(k)
+            if not bad:
+                raise
+            logger.warning(
+                "gcs snapshot: dropping %d unpicklable kv entries "
+                "(e.g. %r) — these will NOT survive a GCS restart",
+                len(bad), bad[0])
+            state["kv"] = {k: v for k, v in state["kv"].items()
+                           if k not in bad}
+            blob = pickle.dumps(state)
         if blob == self._last_snapshot:
             return
         tmp = f"{self._storage_path}.tmp"
@@ -118,14 +215,47 @@ class GcsServer:
             f.write(blob)
         os.replace(tmp, self._storage_path)  # atomic
         self._last_snapshot = blob
+        self._gc_blobs(state["kv"])
+
+    def _gc_blobs(self, kv_state: Dict[Any, Any]):
+        """Unlink side files no longer referenced by the snapshot just
+        written (kv_del / overwritten packages)."""
+        import os
+
+        try:
+            names = os.listdir(self._blob_dir())
+        except OSError:
+            return
+        live = {v[1] for v in kv_state.values()
+                if isinstance(v, tuple) and len(v) == 2
+                and v[0] == "__kv_blob__"}
+        for n in names:
+            if n not in live and ".tmp." not in n:
+                try:
+                    os.unlink(os.path.join(self._blob_dir(), n))
+                except OSError:
+                    pass
 
     async def _persist_loop(self):
+        tick = 0
         while not self._stopping:
             await asyncio.sleep(0.25)
+            tick += 1
+            # dirty-gated: idle clusters pay nothing; every 20th tick (5 s)
+            # writes unconditionally to backstop any missed dirty mark
+            if not self._dirty and tick % 20:
+                continue
             try:
+                self._dirty = False
                 self._write_snapshot()
+                self._snapshot_warned = False
             except Exception:  # noqa: BLE001
-                logger.debug("gcs snapshot failed", exc_info=True)
+                if not self._snapshot_warned:
+                    self._snapshot_warned = True
+                    logger.warning("gcs snapshot failed (will keep retrying)",
+                                   exc_info=True)
+                else:
+                    logger.debug("gcs snapshot failed", exc_info=True)
 
     def _load_snapshot(self):
         import os
@@ -140,6 +270,18 @@ class GcsServer:
             logger.warning("gcs snapshot unreadable; starting fresh",
                            exc_info=True)
             return
+        kv_state = state.get("kv", {})
+        for k, v in list(kv_state.items()):
+            if (isinstance(v, tuple) and len(v) == 2
+                    and v[0] == "__kv_blob__"):
+                try:
+                    with open(os.path.join(self._blob_dir(), v[1]),
+                              "rb") as f:
+                        kv_state[k] = f.read()
+                except OSError:
+                    logger.warning("gcs restore: kv blob %s missing for %r",
+                                   v[1], k)
+                    del kv_state[k]
         for t in self._SNAPSHOT_TABLES:
             getattr(self, t).update(state.get(t, {}))
         self._job_counter = state.get("_job_counter", 0)
@@ -178,6 +320,7 @@ class GcsServer:
         return client
 
     def _publish(self, channel: str, data: Dict[str, Any]):
+        self._dirty = True  # the event feed tail is part of the snapshot
         self._events.append({"seq": self._event_base + len(self._events),
                              "channel": channel,
                              "time": time.time(), **data})
@@ -242,6 +385,7 @@ class GcsServer:
                                     "node_id": node_id})
             self._kick_pending()
         if freed:
+            self._dirty = True  # `available` is snapshot-persisted
             self._kick_pending()
         return {"nodes": self._cluster_view()}
 
@@ -289,12 +433,14 @@ class GcsServer:
         if not overwrite and k in self.kv:
             return False
         self.kv[k] = value
+        self._dirty = True  # also for direct (non-RPC) callers
         return True
 
     async def handle_kv_get(self, ns: str, key: str) -> Optional[bytes]:
         return self.kv.get((ns, key))
 
     async def handle_kv_del(self, ns: str, key: str) -> bool:
+        self._dirty = True
         return self.kv.pop((ns, key), None) is not None
 
     async def handle_kv_keys(self, ns: str, prefix: str = "") -> List[str]:
@@ -307,17 +453,20 @@ class GcsServer:
 
     async def handle_next_job_id(self) -> int:
         self._job_counter += 1
+        self._dirty = True
         return self._job_counter
 
     async def handle_add_job(self, job_id: int, info: Dict[str, Any]) -> bool:
         self.jobs[job_id] = {"job_id": job_id, "start_time": time.time(),
                              "state": "RUNNING", **info}
+        self._dirty = True
         return True
 
     async def handle_mark_job_finished(self, job_id: int) -> bool:
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
+            self._dirty = True
         return True
 
     async def handle_list_jobs(self) -> List[Dict[str, Any]]:

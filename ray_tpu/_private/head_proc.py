@@ -18,6 +18,49 @@ import os
 import sys
 
 
+# strong refs to fire-and-forget startup tasks (the event loop keeps only
+# weak references; an un-referenced task can be garbage-collected mid-await)
+_BG_TASKS: list = []
+
+
+async def _start_client_server(session_dir, gcs, raylet, client_port: int):
+    """Start the remote-driver proxy (reference: Ray Client server on the
+    head, default port 10001), retrying the bind while a previous session
+    releases the port, then publish a routable address in the cluster KV."""
+    log = logging.getLogger(__name__)
+    try:
+        from ray_tpu._private.ids import JobID
+        from ray_tpu._private.worker import CoreWorker, WorkerMode
+        from ray_tpu.util.client import ClientServer
+
+        proxy_worker = CoreWorker(
+            mode=WorkerMode.DRIVER, session_dir=session_dir,
+            gcs_addr=gcs.addr, raylet_addr=raylet.addr,
+            node_id=raylet.node_id, job_id=JobID.from_int(0))
+        proxy_worker.start()
+        client_server = ClientServer(proxy_worker)
+        deadline = asyncio.get_event_loop().time() + 20.0
+        while True:
+            try:
+                host, bound = await client_server.start(port=client_port)
+                break
+            except OSError:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.5)
+        # advertise a ROUTABLE address, never the bind host: a remote
+        # driver can't connect to "0.0.0.0".  Derive it from the GCS
+        # advertise address (same interface reachability)
+        if host in ("0.0.0.0", "::", ""):
+            gcs_host = gcs.addr.split(":")[1] if ":" in gcs.addr else ""
+            host = gcs_host or "127.0.0.1"
+        await gcs.handle_kv_put(
+            ns="cluster", key="client_server_addr",
+            value=f"{host}:{bound}".encode())
+    except Exception:
+        log.warning("client server failed to start", exc_info=True)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--session-dir", required=True)
@@ -70,24 +113,15 @@ def main():
         client_port = int(os.environ.get("RAY_TPU_CLIENT_SERVER_PORT",
                                          "10001"))
         if client_port >= 0:
-            try:
-                from ray_tpu._private.ids import JobID
-                from ray_tpu._private.worker import CoreWorker, WorkerMode
-                from ray_tpu.util.client import ClientServer
-
-                proxy_worker = CoreWorker(
-                    mode=WorkerMode.DRIVER, session_dir=args.session_dir,
-                    gcs_addr=gcs.addr, raylet_addr=raylet.addr,
-                    node_id=raylet.node_id, job_id=JobID.from_int(0))
-                proxy_worker.start()
-                client_server = ClientServer(proxy_worker)
-                host, bound = await client_server.start(port=client_port)
-                await gcs.handle_kv_put(
-                    ns="cluster", key="client_server_addr",
-                    value=f"{host}:{bound}".encode())
-            except Exception:
-                logging.getLogger(__name__).warning(
-                    "client server failed to start", exc_info=True)
+            # background: the fixed default port may still be held by a
+            # just-killed previous session for a few seconds — retry the
+            # bind instead of silently giving up, and don't delay head
+            # readiness (the gcs_address file) on it.  The task handle is
+            # retained: the loop only weak-refs tasks, and a gc mid-retry
+            # would silently abort the startup.
+            _BG_TASKS.append(asyncio.ensure_future(
+                _start_client_server(args.session_dir, gcs, raylet,
+                                     client_port)))
 
         # head marker for the driver: address file
         addr_file = os.path.join(args.session_dir, "gcs_address")

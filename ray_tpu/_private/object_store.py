@@ -63,6 +63,9 @@ class SharedObjectStore:
     def __init__(self):
         self._segments: Dict[ObjectID, shared_memory.SharedMemory] = {}
         self._created: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        # in-progress chunked-transfer landing segments (staged under a
+        # private name; published by rename at seal — see create_writable)
+        self._staging: Dict[ObjectID, shared_memory.SharedMemory] = {}
         self._lock = threading.Lock()
 
     # -- creation (producer side) --------------------------------------------
@@ -100,15 +103,37 @@ class SharedObjectStore:
 
     def create_writable(self, object_id: ObjectID, nbytes: int):
         """(view, seal) for incremental writes (chunked transfer landing
-        zone — avoids a whole-object staging copy).  Segment objects are
-        name-visible before seal; callers own that window."""
-        name = shm_name_for(object_id)
-        seg = shared_memory.SharedMemory(name=name, create=True,
+        zone — avoids a whole-object staging copy).
+
+        The segment is created under a private per-process staging name and
+        atomically renamed over the final name at seal time (``/dev/shm`` is
+        a tmpfs, so rename is atomic and existing mappings stay valid).
+        Until seal, ``contains()``/``get_buffer()`` cannot see the object —
+        a concurrent reader on this host can never attach a half-written
+        payload (mirrors the reference plasma seal: unsealed buffers are
+        invisible to Get, ``src/ray/object_manager/plasma/store.h:55``).
+        An aborted transfer is reclaimed by ``delete()``.
+        """
+        final = shm_name_for(object_id)
+        staging = f"{final}_stg{os.getpid()}"
+        seg = shared_memory.SharedMemory(name=staging, create=True,
                                          size=max(1, nbytes))
         _untrack(seg)
+        with self._lock:
+            self._staging[object_id] = seg
 
         def seal():
+            try:
+                os.rename(f"/dev/shm/{staging}", f"/dev/shm/{final}")
+            except OSError:
+                # staging vanished (aborted/deleted concurrently): nothing
+                # to publish
+                with self._lock:
+                    self._staging.pop(object_id, None)
+                return
+            seg._name = f"/{final}"  # so unlink() targets the published name
             with self._lock:
+                self._staging.pop(object_id, None)
                 self._created[object_id] = seg
                 self._segments[object_id] = seg
 
@@ -164,6 +189,16 @@ class SharedObjectStore:
         with self._lock:
             seg = self._segments.pop(object_id, None)
             self._created.pop(object_id, None)
+            stg = self._staging.pop(object_id, None)
+        if stg is not None:  # abort an in-progress landing zone
+            try:
+                stg.unlink()  # before close: an exported buffer can block
+            except Exception:  # close() but never the unlink
+                pass
+            try:
+                stg.close()
+            except Exception:
+                pass
         try:
             if seg is None:
                 seg = shared_memory.SharedMemory(name=shm_name_for(object_id))
@@ -178,8 +213,19 @@ class SharedObjectStore:
         with self._lock:
             segments = dict(self._segments)
             created = dict(self._created)
+            staging = dict(self._staging)
             self._segments.clear()
             self._created.clear()
+            self._staging.clear()
+        for seg in staging.values():  # abandon in-progress landings
+            try:
+                seg.unlink()  # before close: an exported buffer can block
+            except Exception:  # close() but never the unlink
+                pass
+            try:
+                seg.close()
+            except Exception:
+                pass
         for oid, seg in segments.items():
             try:
                 seg.close()

@@ -16,15 +16,25 @@ protocol simplified to three kinds of holds:
    registration per process, not per ref — the borrower's local counting
    collapses the rest).
 2. **Pending task args**: refs serialized into a not-yet-finished task
-   spec.  The submitter holds the spec's arg refs alive until the task
-   reply arrives, so arguments can never be freed mid-flight (the
+   spec — including refs nested inside inline argument *values*.  The
+   submitter holds them alive until the task reply arrives, so arguments
+   can never be freed mid-flight no matter how long the task queues (the
    reference's submitted-task count, ``reference_count.h`` borrow-by-task).
-3. **Transfer pins**: a ref serialized into any *other* payload (an object
-   value, an actor message) is pinned at the owner for a grace window,
-   closing the race where the sender drops its ref before the receiver's
-   borrower registration lands (the reference closes this with per-message
-   borrow forwarding; a TTL pin is the economy version, and the receiver's
-   registration releases the pin early).
+3. **Contained-in holds**: refs serialized into a stored object value are
+   held by the *outer* object's record at its owner — alive exactly as
+   long as the container is (the reference's CONTAINED_IN/NESTED tracking,
+   ``reference_count.h:72``).  For task returns and stream items the
+   executor ships ref *descriptors* out-of-band in the reply; the
+   submitter attaches the contained holds the moment the reply lands —
+   no deserialization required — and registers as a borrower, which
+   retires the executor's bridge pin at the owner.
+4. **Transfer pins**: the short bridge between an executor serializing a
+   return value and the submitter's reply-time registration landing at
+   the owner.  The TTL (``transfer_pin_ttl_s``) is a failsafe for lost
+   replies only — correctness no longer depends on any receiver
+   deserializing within the window.  Receiver registration retires the
+   earliest-expiring pin (the conservative choice for the messages still
+   outstanding).
 
 When every hold reaches zero the owner frees the object: inline payloads
 drop out of its memory store; shm objects are deleted on their node
@@ -51,7 +61,7 @@ class _Record:
     """Owner-side lifetime record for one owned object."""
 
     __slots__ = ("local", "borrowers", "transfer_pins", "lineage_task",
-                 "freed")
+                 "freed", "contained")
 
     def __init__(self):
         self.local = 0                  # live ObjectRefs in the owner process
@@ -59,6 +69,9 @@ class _Record:
         self.transfer_pins: List[float] = []  # expiry deadlines of serialize pins
         self.lineage_task = None        # TaskSpec that produced it (if any)
         self.freed = False
+        # ObjectRefs serialized INSIDE this object's value: held alive for
+        # the container's lifetime (reference CONTAINED_IN)
+        self.contained: Optional[List[Any]] = None
 
     def pinned(self, now: float) -> bool:
         # NOTE: hold #2 (in-flight task args) is enforced by the worker
@@ -130,9 +143,21 @@ class ReferenceCounter:
     def add_borrower(self, oid: ObjectID, addr: str):
         rec = self._rec(oid)
         rec.borrowers.add(addr)
-        # a registration also retires one transfer pin (the receiver landed)
+        # a registration also retires one transfer pin (the receiver
+        # landed) — the EARLIEST-expiring one, so the longest remaining
+        # deadline keeps protecting whatever message is still outstanding
         if rec.transfer_pins:
-            rec.transfer_pins.pop()
+            rec.transfer_pins.remove(min(rec.transfer_pins))
+
+    def add_contained(self, oid: ObjectID, refs: List[Any]):
+        """Live ObjectRefs serialized inside ``oid``'s value: hold them for
+        the container's lifetime (reference CONTAINED_IN nesting)."""
+        if not refs:
+            return
+        rec = self._rec(oid)
+        if rec.contained is None:
+            rec.contained = []
+        rec.contained.extend(refs)
 
     def remove_borrower(self, oid: ObjectID, addr: str):
         rec = self._records.get(oid)
@@ -156,19 +181,29 @@ class ReferenceCounter:
         self._rec(oid).transfer_pins.append(time.time() + ttl)
 
     def _maybe_free(self, oid: ObjectID, rec: _Record):
-        if not self.enabled or rec.freed:
+        if not self.enabled:
             return
         if rec.pinned(time.time()):
             return
-        rec.freed = True
-        try:
-            self._free_fn(oid)
-        except Exception:  # noqa: BLE001
-            logger.debug("free of %s failed", oid, exc_info=True)
-        # keep the record if it carries lineage (a later borrower fetch can
-        # trigger reconstruction); otherwise forget it entirely
-        if rec.lineage_task is None:
-            self._records.pop(oid, None)
+        # Zero holds anywhere: nothing can ever legitimately fetch this
+        # object again.  Release lineage BEFORE the payload free so the
+        # owner's free hook sees lineage=None and records a tombstone (a
+        # straggler fetch must raise ObjectLostError, not hang) — and the
+        # retained TaskSpec (with its inline args) is reclaimed, matching
+        # the reference's TaskManager lineage release on ref deletion
+        # (task_manager.h:228).
+        if rec.lineage_task is not None:
+            self._lineage_count -= 1
+            rec.lineage_task = None
+        if not rec.freed:
+            rec.freed = True
+            try:
+                self._free_fn(oid)
+            except Exception:  # noqa: BLE001
+                logger.debug("free of %s failed", oid, exc_info=True)
+        # dropping the record releases contained refs; their __del__
+        # cascades the decrement to nested objects
+        self._records.pop(oid, None)
 
     def on_value_stored(self, oid: ObjectID):
         """A value landed in storage (task reply / recovery).  If nothing

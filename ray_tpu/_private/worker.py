@@ -169,6 +169,10 @@ class CoreWorker:
         # arguments can never be freed mid-execution (the reference's
         # submitted-task counts)
         self._pending_arg_refs: Dict[TaskID, list] = {}
+        # actor-creation arg refs: creation goes through the GCS (no lease
+        # reply to release on), and every restart re-resolves the creation
+        # spec's args — held until the actor can no longer (re)start
+        self._actor_creation_refs: Dict[ActorID, list] = {}
         # in-flight lineage reconstructions (object_recovery_manager.h:43)
         self._recovering: Dict[ObjectID, asyncio.Future] = {}
         # objects freed with no lineage: get() must raise, not hang
@@ -381,6 +385,26 @@ class CoreWorker:
             rc.force_free([object_id])
         return True
 
+    def _attach_contained_from_descriptors(self, oid: ObjectID, desc):
+        """Reply-time contained-hold attachment (loop thread only).
+
+        The executor ships ``[oid, owner_addr]`` descriptors for refs it
+        serialized into a return value / stream item; the submitter — owner
+        of the return object — constructs counted refs from them the moment
+        the reply lands (no deserialize needed) and holds them on the
+        return object's record.  The borrower registration this fires
+        retires the executor's bridge pin at the inner owner.
+        """
+        if not desc:
+            return
+        contained = []
+        for item in desc:
+            r = ObjectRef(ObjectID(item[0]), item[1])
+            self._track_new_ref(r)
+            contained.append(r)
+        self._drain_ref_events()  # register the borrows with owners now
+        self.ref_counter.add_contained(oid, contained)
+
     def _pin_contained_refs(self, refs: List[ObjectRef]):
         """Refs serialized into a payload: pin each at its owner for the
         transfer grace window (loop thread only)."""
@@ -582,6 +606,7 @@ class CoreWorker:
                    "size": entry.get("size"),
                    "is_error": entry.get("is_error", False)}
         self._record_location(oid, loc)
+        self._attach_contained_from_descriptors(oid, entry.get("refs"))
         # out-of-order arrival (windowed pipeline + concurrent dispatch):
         # advance the contiguous watermark so refs are handed out in order
         received = self._stream_received.setdefault(tid, set())
@@ -697,9 +722,12 @@ class CoreWorker:
                 oid, {"shm": name, "node": self.node_id, "size": total, "is_error": is_error}
             )
         if refs:
-            # refs serialized INTO the stored value: grace-pin them at
-            # their owners until readers register as borrowers
-            self.loop.call_soon_threadsafe(self._pin_contained_refs, refs)
+            # refs serialized INTO the stored value: the container's record
+            # holds them alive for the container's lifetime (reference
+            # CONTAINED_IN) — readers registering as borrowers take over
+            # from there, with no TTL anywhere in the chain
+            self.loop.call_soon_threadsafe(
+                self.ref_counter.add_contained, oid, list(refs))
         out = ObjectRef(oid, self.serve_addr)
         self._track_new_ref(out)
         return out
@@ -912,7 +940,7 @@ class CoreWorker:
 
     # ------------------------------------------------------- normal task submit
 
-    def submit_task(self, spec: TaskSpec):
+    def submit_task(self, spec: TaskSpec, nested_arg_refs: Optional[list] = None):
         # Fire-and-forget: refs are deterministic from the spec, so the
         # caller never waits for a loop-thread round trip per .remote()
         # (the reference pipelines submission the same way).  A get() that
@@ -921,23 +949,29 @@ class CoreWorker:
         if spec.num_returns == STREAMING_RETURNS:
             self._streams[spec.task_id] = StreamState(
                 spec.task_id, spec.backpressure_num_objects)
-            self.loop.call_soon_threadsafe(self._enqueue_spec, spec)
+            self.loop.call_soon_threadsafe(self._enqueue_spec, spec,
+                                           nested_arg_refs)
             return ObjectRefGenerator(spec.task_id, self)
         refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
         for r in refs:
             self._track_new_ref(r)
-        self.loop.call_soon_threadsafe(self._enqueue_spec, spec)
+        self.loop.call_soon_threadsafe(self._enqueue_spec, spec,
+                                       nested_arg_refs)
         return refs
 
-    def _enqueue_spec(self, spec: TaskSpec) -> None:
+    def _enqueue_spec(self, spec: TaskSpec,
+                      nested_arg_refs: Optional[list] = None) -> None:
         for oid in spec.return_ids():
             if oid not in self._result_futures:
                 self._result_futures[oid] = self.loop.create_future()
             # retain the producing spec: lost outputs re-execute it
             # (task_manager.h:228 resubmit for lineage)
             self.ref_counter.set_lineage(oid, spec)
-        # hold arg refs until the reply — args can't be freed mid-flight
-        arg_refs = [a.payload for a in spec.args if a.is_ref]
+        # hold arg refs until the reply — args can't be freed mid-flight.
+        # nested_arg_refs are refs serialized INSIDE inline arg values:
+        # held the same way, so queue time is never a free window
+        arg_refs = ([a.payload for a in spec.args if a.is_ref]
+                    + list(nested_arg_refs or []))
         if arg_refs:
             self._pending_arg_refs[spec.task_id] = arg_refs
         for oid in spec.return_ids():
@@ -1178,6 +1212,7 @@ class CoreWorker:
                 loc = {"shm": ret["shm"], "node": ret.get("node"), "size": ret.get("size"),
                        "is_error": ret.get("is_error", False)}
             self._record_location(oid, loc)
+            self._attach_contained_from_descriptors(oid, ret.get("refs"))
             fut = self._result_futures.pop(oid, None)
             if fut is not None and not fut.done():
                 fut.set_result(loc)
@@ -1229,7 +1264,49 @@ class CoreWorker:
                 raise exc.ActorUnavailableError(
                     actor_id, f"actor {actor_id.hex()} stuck in state {state}")
 
-    def submit_actor_task(self, spec: TaskSpec):
+    def hold_actor_creation_refs(self, actor_id: ActorID, refs: list,
+                                 until_dead: bool):
+        """Keep creation-arg refs (top-level AND nested in inline values)
+        alive while the actor can still (re)execute its creation task.
+
+        ``until_dead=False`` (max_restarts=0): released once the actor is
+        ALIVE — the constructor already resolved its args.  Restartable
+        actors hold until DEAD, since each restart re-resolves the
+        creation spec (reference: the GCS-owned creation spec keeps its
+        borrows for the actor's lifetime, gcs_actor_manager.h:328).
+        """
+        if not refs:
+            return
+        self._actor_creation_refs[actor_id] = refs
+        self.loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(
+                self._release_creation_refs_when_done(actor_id, until_dead)))
+
+    async def _release_creation_refs_when_done(self, actor_id: ActorID,
+                                               until_dead: bool):
+        try:
+            while not self._shutdown:
+                try:
+                    info = await self.gcs.call(
+                        "wait_actor_ready", actor_id=actor_id.binary(),
+                        poll_s=20.0, timeout=30.0)
+                except asyncio.TimeoutError:
+                    continue
+                except Exception:  # noqa: BLE001 - control plane hiccup
+                    await asyncio.sleep(5.0)
+                    continue
+                state = (info or {}).get("state")
+                if state in ("DEAD", "NOT_FOUND"):
+                    return
+                if state == "ALIVE":
+                    if not until_dead:
+                        return
+                    await asyncio.sleep(30.0)
+        finally:
+            self._actor_creation_refs.pop(actor_id, None)
+
+    def submit_actor_task(self, spec: TaskSpec,
+                          nested_arg_refs: Optional[list] = None):
         # Fire-and-forget like submit_task: refs are deterministic, so the
         # caller thread never blocks on a loop round trip per method call
         # (this alone is ~2x on the 1:1 sync actor-call microbench).  A
@@ -1239,19 +1316,23 @@ class CoreWorker:
         if spec.num_returns == STREAMING_RETURNS:
             self._streams[spec.task_id] = StreamState(
                 spec.task_id, spec.backpressure_num_objects)
-            self.loop.call_soon_threadsafe(self._enqueue_actor_spec, spec)
+            self.loop.call_soon_threadsafe(self._enqueue_actor_spec, spec,
+                                           nested_arg_refs)
             return ObjectRefGenerator(spec.task_id, self)
         refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
         for r in refs:
             self._track_new_ref(r)
-        self.loop.call_soon_threadsafe(self._enqueue_actor_spec, spec)
+        self.loop.call_soon_threadsafe(self._enqueue_actor_spec, spec,
+                                       nested_arg_refs)
         return refs
 
-    def _enqueue_actor_spec(self, spec: TaskSpec) -> None:
+    def _enqueue_actor_spec(self, spec: TaskSpec,
+                            nested_arg_refs: Optional[list] = None) -> None:
         for oid in spec.return_ids():
             if oid not in self._result_futures:
                 self._result_futures[oid] = self.loop.create_future()
-        arg_refs = [a.payload for a in spec.args if a.is_ref]
+        arg_refs = ([a.payload for a in spec.args if a.is_ref]
+                    + list(nested_arg_refs or []))
         if arg_refs:
             self._pending_arg_refs[spec.task_id] = arg_refs
         for oid in spec.return_ids():
@@ -1359,18 +1440,25 @@ class CoreWorker:
         oid = ObjectID.from_task_and_index(spec.task_id, index)
         core, raw_bufs, refs, total = serialization.serialize_parts(value)
         if refs:
+            # bridge pin + descriptors: see _package_returns
             self.loop.call_soon_threadsafe(self._pin_contained_refs,
                                            list(refs))
+        ref_desc = ([[r.id.binary(), r.owner_addr or self.serve_addr]
+                     for r in refs] if refs else None)
         if total <= config.max_inline_object_size:
             payload = bytearray(total)
             serialization.write_parts(payload, core, raw_bufs)
-            return {"oid": oid.binary(), "inline": bytes(payload),
-                    "is_error": is_error}
-        name = self.shared_store.put_into(
-            oid, total,
-            lambda view: serialization.write_parts(view, core, raw_bufs))
-        return {"oid": oid.binary(), "shm": name, "node": self.node_id,
-                "size": total, "is_error": is_error}
+            entry = {"oid": oid.binary(), "inline": bytes(payload),
+                     "is_error": is_error}
+        else:
+            name = self.shared_store.put_into(
+                oid, total,
+                lambda view: serialization.write_parts(view, core, raw_bufs))
+            entry = {"oid": oid.binary(), "shm": name, "node": self.node_id,
+                     "size": total, "is_error": is_error}
+        if ref_desc:
+            entry["refs"] = ref_desc
+        return entry
 
     async def _exec_streaming(self, spec: TaskSpec,
                               bound_method: Any = None) -> Dict:
@@ -1556,9 +1644,11 @@ class CoreWorker:
         for oid, value in zip(spec.return_ids(), results):
             core, raw_bufs, refs, total = serialization.serialize_parts(value)
             if refs:
-                # refs embedded in a return value: grace-pin at their
-                # owners so the executor's local refs dropping (task end)
-                # can't free them before the caller deserializes
+                # refs embedded in a return value: bridge-pin at their
+                # owners (task end drops the executor's local refs), and
+                # ship descriptors so the submitter attaches contained
+                # holds the instant the reply lands — the pin only has to
+                # survive one reply flight, not a user deserialize
                 self.loop.call_soon_threadsafe(self._pin_contained_refs,
                                                list(refs))
             if total <= config.max_inline_object_size:
@@ -1574,6 +1664,10 @@ class CoreWorker:
                         serialization.write_parts(view, c, rb))
                 entry = {"oid": oid.binary(), "shm": name, "node": self.node_id,
                          "size": total, "is_error": is_error}
+            if refs:
+                entry["refs"] = [[r.id.binary(),
+                                  r.owner_addr or self.serve_addr]
+                                 for r in refs]
             returns.append(entry)
         return {"returns": returns}
 

@@ -87,7 +87,8 @@ class ActorHandle:
         from ray_tpu._private.worker import get_global_worker
 
         worker = get_global_worker()
-        task_args, kw_keys = api_utils.build_args(worker, args, kwargs)
+        task_args, kw_keys, nested_refs = api_utils.build_args(
+            worker, args, kwargs)
         seq = worker._actor_seq_out = getattr(worker, "_actor_seq_out", {})
         seq_no = seq.get(self._actor_id, 0)
         seq[self._actor_id] = seq_no + 1
@@ -111,7 +112,7 @@ class ActorHandle:
             max_concurrency=self._max_concurrency,
             is_async_actor=self._is_async,
         )
-        refs = worker.submit_actor_task(spec)
+        refs = worker.submit_actor_task(spec, nested_arg_refs=nested_refs)
         if spec.num_returns == 1:
             return refs[0]
         return refs
@@ -203,7 +204,8 @@ class ActorClass:
         ctx = worker.current_ctx()
         ctx.submit_index += 1
         actor_id = ActorID.of(worker.job_id, ctx.task_id, ctx.submit_index)
-        task_args, kw_keys = api_utils.build_args(worker, args, kwargs)
+        task_args, kw_keys, nested_refs = api_utils.build_args(
+            worker, args, kwargs)
         is_async = self._is_async_class()
         max_concurrency = opts.get("max_concurrency") or (1000 if is_async else 1)
         spec = TaskSpec(
@@ -233,6 +235,10 @@ class ActorClass:
         worker.run_coro(
             worker.gcs.call("create_actor", spec_bytes=serialization.dumps(spec))
         )
+        creation_refs = ([a.payload for a in task_args if a.is_ref]
+                         + list(nested_refs))
+        worker.hold_actor_creation_refs(
+            actor_id, creation_refs, until_dead=spec.max_restarts != 0)
         return ActorHandle(actor_id, self._cls.__qualname__, is_async, max_concurrency,
                            self._method_names(), self._method_options())
 

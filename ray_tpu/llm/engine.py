@@ -1,14 +1,29 @@
-"""LLMEngine: slot-based continuous batching over the jax generation path.
+"""LLMEngine: continuous batching over a paged block-table KV cache.
 
 Reference capability: ``ray.llm`` delegates the engine to vLLM
 (``_internal/serve/deployments/llm/vllm/vllm_engine.py`` — continuous
-batching, paged KV).  TPU-native redesign: the KV cache is one static
-tensor of B slots x max_len (static shapes = one compiled decode program
-reused forever); scheduling is slot-granular continuous batching — a
-finished request frees its slot, the next queued request prefills into it
-while other slots keep decoding.  Paged attention is unnecessary at this
-granularity: slot memory is bounded by B * max_len, chosen at engine
-construction like vLLM's gpu_memory_utilization-derived KV budget.
+batching, paged attention, automatic prefix caching,
+``vllm_models.py:123-127``).  TPU-native redesign:
+
+* **Paged KV**: one global block pool ``[L, num_blocks, bs, KVH, hd]``
+  (``models/paged_generation.py``); each request holds a block table.
+  Capacity is measured in blocks, not worst-case slots×max_len, so many
+  short requests fit where the dense layout held few.
+* **Prefix caching**: full prompt blocks are registered under a rolling
+  hash chain ``key = (parent_key, block_tokens)``; a new request walks its
+  prompt's chain and reuses every hit — the shared-system-prompt pattern
+  prefills only the suffix.  Refcounted blocks; refcount-0 blocks retire
+  into an LRU that retains contents for future hits and is evicted last.
+* **Static shapes**: decode is ONE compiled program (B slots × MB blocks,
+  gather + mask); prefill compiles per power-of-2 (suffix, prefix) bucket.
+  Host-side scheduling (admit/preempt/retire) is plain numpy — no jit
+  boundary crossings beyond the two program calls.
+* **Preemption**: out of blocks mid-decode → the youngest request is
+  rolled back to the queue (its tokens re-prefill later), matching vLLM's
+  recompute-preemption policy.
+
+The default tokenizer is the in-repo byte-level BPE (``llm/bpe.py``);
+``ByteTokenizer`` remains as the dependency-free fallback.
 """
 
 from __future__ import annotations
@@ -26,12 +41,8 @@ from ray_tpu.models.llama import LlamaConfig
 
 
 class ByteTokenizer:
-    """Dependency-free tokenizer: UTF-8 bytes shifted by the special ids.
-
-    vocab: 0=pad, 1=bos, 2=eos, byte b -> 3+b.  Lets the whole llm stack
-    run hermetically (no tokenizer downloads) — swap in a HF tokenizer via
-    ``LLMEngine(tokenizer=...)`` for real checkpoints.
-    """
+    """Dependency-free fallback tokenizer: UTF-8 bytes shifted by the
+    special ids (0=pad, 1=bos, 2=eos, byte b -> 3+b)."""
 
     pad_id, bos_id, eos_id = 0, 1, 2
     vocab_size = 259
@@ -44,6 +55,22 @@ class ByteTokenizer:
         return data.decode("utf-8", "replace")
 
 
+def default_tokenizer(model_vocab_size: Optional[int] = None):
+    """The in-repo BPE vocab when it fits the model's embedding table,
+    byte fallback otherwise (ids past ``cfg.vocab_size`` would be clamped
+    silently by the gather — garbage generation, no error)."""
+    try:
+        from ray_tpu.llm.bpe import BPETokenizer
+
+        tok = BPETokenizer()
+        if (model_vocab_size is None
+                or tok.vocab_size <= model_vocab_size):
+            return tok
+    except Exception:  # noqa: BLE001 - vocab artifact missing
+        pass
+    return ByteTokenizer()
+
+
 @dataclasses.dataclass
 class Request:
     request_id: int
@@ -51,10 +78,25 @@ class Request:
     sampling: SamplingParams
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    cached_prefix_len: int = 0  # tokens served from the prefix cache
+    # preemption folds generated tokens into prompt_tokens for re-prefill;
+    # n_prompt remembers the ORIGINAL prompt length so outputs and the
+    # max_tokens budget survive any number of preemptions
+    n_prompt: int = -1
+
+    def __post_init__(self):
+        if self.n_prompt < 0:
+            self.n_prompt = len(self.prompt_tokens)
 
     @property
     def num_generated(self) -> int:
-        return len(self.out_tokens)
+        return (len(self.prompt_tokens) - self.n_prompt
+                + len(self.out_tokens))
+
+    @property
+    def all_out_tokens(self) -> List[int]:
+        return self.prompt_tokens[self.n_prompt:] + self.out_tokens
 
 
 @dataclasses.dataclass
@@ -65,29 +107,108 @@ class GenerationOutput:
     text: Optional[str] = None
 
 
+class _BlockManager:
+    """Host-side pool bookkeeping: free list, refcounts, prefix hash chain
+    with LRU retention of refcount-0 blocks (vLLM's automatic prefix
+    caching, evict-last)."""
+
+    def __init__(self, num_blocks: int):
+        # block 0 is the jit-side scratch block (padding / masked writes)
+        self.free: collections.deque = collections.deque(
+            range(1, num_blocks))
+        self.refs: Dict[int, int] = {}
+        self.key_of: Dict[int, Any] = {}
+        self.by_key: Dict[Any, int] = {}
+        self.lru: "collections.OrderedDict[Any, int]" = \
+            collections.OrderedDict()
+        self.stats = {"prefix_hits": 0, "prefix_blocks_reused": 0,
+                      "evictions": 0, "preemptions": 0}
+
+    def available(self) -> int:
+        return len(self.free) + len(self.lru)
+
+    def alloc(self) -> Optional[int]:
+        if self.free:
+            bid = self.free.popleft()
+        elif self.lru:
+            key, bid = self.lru.popitem(last=False)  # evict oldest cached
+            self.by_key.pop(key, None)
+            self.key_of.pop(bid, None)
+            self.stats["evictions"] += 1
+        else:
+            return None
+        self.refs[bid] = 1
+        return bid
+
+    def acquire_cached(self, key) -> Optional[int]:
+        """Prefix hit: bump the block's refcount (reviving it from the
+        LRU if it was retired)."""
+        bid = self.by_key.get(key)
+        if bid is None:
+            return None
+        if key in self.lru:
+            del self.lru[key]
+            self.refs[bid] = 0
+        self.refs[bid] = self.refs.get(bid, 0) + 1
+        self.stats["prefix_blocks_reused"] += 1
+        return bid
+
+    def register(self, bid: int, key) -> None:
+        """Publish a freshly-filled full block under its chain key."""
+        if key in self.by_key:
+            return  # a concurrent identical prefill won the race; keep ours unpublished
+        self.key_of[bid] = key
+        self.by_key[key] = bid
+
+    def release(self, bid: int) -> None:
+        n = self.refs.get(bid, 0) - 1
+        if n > 0:
+            self.refs[bid] = n
+            return
+        self.refs.pop(bid, None)
+        key = self.key_of.get(bid)
+        if key is not None:
+            self.lru[key] = bid  # retain contents for future prefix hits
+        else:
+            self.free.append(bid)
+
+
 class LLMEngine:
     def __init__(self, cfg: LlamaConfig, params=None, *,
                  tokenizer: Optional[Any] = None, batch_slots: int = 8,
-                 max_len: Optional[int] = None, seed: int = 0, mesh=None):
+                 max_len: Optional[int] = None, block_size: int = 16,
+                 num_blocks: Optional[int] = None, seed: int = 0,
+                 mesh=None):
         import jax
 
         from ray_tpu.models.llama import llama_init
+        from ray_tpu.models.paged_generation import (init_kv_pool,
+                                                     paged_decode_step,
+                                                     prefill_suffix)
 
         self.cfg = cfg
         self.mesh = mesh
-        self.tokenizer = tokenizer or ByteTokenizer()
+        self.tokenizer = tokenizer or default_tokenizer(cfg.vocab_size)
         self.B = batch_slots
         self.max_len = max_len or cfg.max_seq_len
+        self.bs = block_size
+        self.MB = -(-self.max_len // block_size)  # blocks per sequence
+        # default pool = dense-equivalent capacity (callers can shrink it:
+        # prefix sharing + short requests usually need far less)
+        self.num_blocks = num_blocks or (self.B * self.MB + 1)
         if params is None:
             params = llama_init(jax.random.PRNGKey(seed), cfg)
         self.params = params
         self._key = jax.random.PRNGKey(seed + 1)
 
-        from ray_tpu.models.generation import decode_step, init_kv_cache, prefill
-
-        self.cache = init_kv_cache(cfg, self.B, self.max_len)
-        self._prefill = jax.jit(functools.partial(prefill, cfg=cfg))
-        self._decode = jax.jit(functools.partial(decode_step, cfg=cfg))
+        self.pool = init_kv_pool(cfg, self.num_blocks, self.bs)
+        self.blocks = _BlockManager(self.num_blocks)
+        self._decode = jax.jit(
+            functools.partial(paged_decode_step, cfg=cfg),
+            donate_argnums=(4,))
+        self._prefill = jax.jit(
+            functools.partial(prefill_suffix, cfg=cfg),
+            donate_argnums=(9,))  # the pool (avoid a full second copy)
         self._sample = jax.jit(self._sample_impl)
 
         self._ids = itertools.count()
@@ -95,15 +216,13 @@ class LLMEngine:
         self._slots: List[Optional[Request]] = [None] * self.B
         self._cur_len = np.zeros(self.B, np.int32)
         self._next_token = np.zeros(self.B, np.int32)
-        self._finished: List[Request] = []
+        self._tables = np.zeros((self.B, self.MB), np.int32)
         # per-token hook for streaming consumers: on_token(request_id, tok)
-        # fires the moment a token is accepted (serve token streaming)
         self.on_token: Optional[Any] = None
 
     # -- request API --------------------------------------------------------
 
-    def submit(self, prompt: str | List[int],
-               sampling: Optional[SamplingParams] = None) -> int:
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None) -> int:
         if isinstance(prompt, str):
             prompt = self.tokenizer.encode(prompt)
         sampling = sampling or SamplingParams(
@@ -122,31 +241,29 @@ class LLMEngine:
     # -- continuous-batching step ------------------------------------------
 
     def step(self) -> List[GenerationOutput]:
-        """Admit queued requests into free slots (prefill), run ONE decode
-        step for all active slots, retire finished requests."""
+        """Admit queued requests into free slots (prefix-cached prefill),
+        run ONE decode step for all active slots, retire finished."""
         import jax
         import jax.numpy as jnp
 
         # 1. admit
         for i in range(self.B):
             if self._slots[i] is None and self._queue:
-                req = self._queue.popleft()
-                self._slots[i] = req
-                logits = self._prefill_into_slot(i, req)
-                self._key, k = jax.random.split(self._key)
-                tok = int(self._sample(
-                    logits, k, self._temp_vec(slice(i, i + 1)))[0])
-                self._record_token(i, req, tok)
+                if not self._admit(i):
+                    break  # out of blocks: stop admitting this step
 
         active = [i for i in range(self.B) if self._slots[i] is not None
                   and not self._slots[i].done]
         if active:
-            # 2. one decode step across ALL slots (inactive slots decode
-            # garbage into their own lane; masked out by cur_len bookkeeping)
+            # ensure every active slot has a block for its write position;
+            # preempt the youngest request if the pool is exhausted
+            active = self._ensure_decode_blocks(active)
+        if active:
             tokens = jnp.asarray(self._next_token)
             cur = jnp.asarray(self._cur_len)
-            logits, self.cache = self._decode(self.params, tokens, cur,
-                                              self.cache)
+            tables = jnp.asarray(self._tables)
+            logits, self.pool = self._decode(self.params, tokens, cur,
+                                             tables, self.pool)
             self._cur_len += np.asarray(
                 [1 if self._slots[i] is not None and not self._slots[i].done
                  else 0 for i in range(self.B)], np.int32)
@@ -160,14 +277,18 @@ class LLMEngine:
         for i in range(self.B):
             req = self._slots[i]
             if req is not None and req.done:
+                toks = req.all_out_tokens
                 out.append(GenerationOutput(
-                    req.request_id, req.prompt_tokens, req.out_tokens,
-                    text=self.tokenizer.decode(req.out_tokens)))
+                    req.request_id, req.prompt_tokens[:req.n_prompt], toks,
+                    text=self.tokenizer.decode(toks)))
+                for bid in req.blocks:
+                    self.blocks.release(bid)
+                req.blocks = []
                 self._slots[i] = None
+                self._tables[i] = 0
         return out
 
-    def generate(self, prompts: List[str | List[int]],
-                 sampling: Optional[SamplingParams] = None
+    def generate(self, prompts, sampling: Optional[SamplingParams] = None
                  ) -> List[GenerationOutput]:
         ids = [self.submit(p, sampling) for p in prompts]
         results: Dict[int, GenerationOutput] = {}
@@ -176,32 +297,133 @@ class LLMEngine:
                 results[out.request_id] = out
         return [results[i] for i in ids]
 
-    # -- internals ----------------------------------------------------------
+    # -- admission / prefill ------------------------------------------------
 
-    def _prefill_into_slot(self, i: int, req: Request):
-        """b=1 prefill, scattered into slot i of the shared cache."""
+    def _prompt_chain_keys(self, tokens: List[int]) -> List[Any]:
+        keys = []
+        parent = None
+        for b in range(len(tokens) // self.bs):
+            parent = (parent, tuple(tokens[b * self.bs:(b + 1) * self.bs]))
+            keys.append(parent)
+        return keys
+
+    def _admit(self, i: int) -> bool:
+        """Prefill the next queued request into slot i (returns False and
+        leaves the queue untouched when the pool can't hold its suffix)."""
+        import jax
         import jax.numpy as jnp
 
-        from ray_tpu.models.generation import init_kv_cache
+        from ray_tpu.models.paged_generation import gather_prefix
 
-        # pad the prompt to a power-of-2 bucket so prefill compiles
-        # O(log max_len) times, not once per distinct prompt length
-        n = len(req.prompt_tokens)
-        bucket = 1
-        while bucket < n:
-            bucket *= 2
-        bucket = min(bucket, self.max_len)
-        toks = jnp.asarray(
-            [req.prompt_tokens + [0] * (bucket - n)], jnp.int32)
-        lengths = jnp.asarray([n], jnp.int32)
-        tmp = init_kv_cache(self.cfg, 1, self.max_len)
-        logits, tmp = self._prefill(self.params, toks, lengths, tmp)
-        self.cache = {
-            "k": self.cache["k"].at[:, i].set(tmp["k"][:, 0]),
-            "v": self.cache["v"].at[:, i].set(tmp["v"][:, 0]),
-        }
-        self._cur_len[i] = len(req.prompt_tokens)
-        return logits
+        req = self._queue[0]
+        toks = req.prompt_tokens
+        n = len(toks)
+        # prefix walk: reuse every leading full block already cached (but
+        # always leave >=1 token to prefill — its logits seed sampling)
+        keys = self._prompt_chain_keys(toks)
+        hit_blocks: List[int] = []
+        for key in keys:
+            if len(hit_blocks) * self.bs >= n - 1:
+                break
+            bid = self.blocks.acquire_cached(key)
+            if bid is None:
+                break
+            hit_blocks.append(bid)
+        cached_len = len(hit_blocks) * self.bs
+        if cached_len > n - 1:  # whole prompt cached: recompute last block
+            for bid in hit_blocks[-1:]:
+                self.blocks.release(bid)
+            hit_blocks = hit_blocks[:-1]
+            cached_len = len(hit_blocks) * self.bs
+        suffix = toks[cached_len:]
+        need = -(-(n + 1) // self.bs) - len(hit_blocks)  # +1: first decode
+        if self.blocks.available() < need:
+            for bid in hit_blocks:
+                self.blocks.release(bid)
+            return False
+        if hit_blocks:
+            self.blocks.stats["prefix_hits"] += 1
+
+        new_blocks = [self.blocks.alloc() for _ in range(need)]
+        req.blocks = hit_blocks + new_blocks
+        req.cached_prefix_len = cached_len
+        self._queue.popleft()
+        self._slots[i] = req
+
+        # jit-bucketed shapes: suffix length and prefix block count
+        S = _bucket(len(suffix), self.max_len)
+        P = _bucket(len(hit_blocks), self.MB) if hit_blocks else 0
+        pad_tok = suffix + [0] * (S - len(suffix))
+        # pool coordinates for each padded suffix lane (pads -> scratch 0)
+        dst_b = np.zeros(S, np.int32)
+        dst_o = np.zeros(S, np.int32)
+        for j in range(len(suffix)):
+            p = cached_len + j
+            dst_b[j] = req.blocks[p // self.bs]
+            dst_o[j] = p % self.bs
+        prefix_ids = np.zeros(P, np.int32)
+        prefix_ids[:len(hit_blocks)] = hit_blocks
+        pk, pv = gather_prefix(self.pool, jnp.asarray(prefix_ids))
+        logits, self.pool = self._prefill(
+            self.params, jnp.asarray([pad_tok], jnp.int32),
+            jnp.int32(len(suffix)), jnp.int32(cached_len),
+            pk, pv, jnp.int32(cached_len),
+            jnp.asarray(dst_b), jnp.asarray(dst_o), self.pool)
+        # register freshly-computed full blocks for future prefix hits
+        for b in range(len(hit_blocks), n // self.bs):
+            if (b + 1) * self.bs <= n:
+                self.blocks.register(req.blocks[b], keys[b])
+        self._cur_len[i] = n
+        self._tables[i] = 0
+        self._tables[i, :len(req.blocks)] = req.blocks
+        self._key, k = jax.random.split(self._key)
+        tok = int(self._sample(logits, k, self._temp_vec(slice(i, i + 1)))[0])
+        self._record_token(i, req, tok)
+        return True
+
+    def _ensure_decode_blocks(self, active: List[int]) -> List[int]:
+        """Allocate the write-position block for each active slot,
+        preempting the youngest request when the pool is exhausted
+        (vLLM recompute preemption)."""
+        for i in list(active):
+            req = self._slots[i]
+            if req is None or req.done:
+                continue
+            blk_idx = int(self._cur_len[i]) // self.bs
+            while blk_idx >= len(req.blocks):
+                bid = self.blocks.alloc()
+                if bid is None:
+                    victim = self._preempt_youngest()
+                    if victim is None or victim == i:
+                        break
+                    continue
+                req.blocks.append(bid)
+                self._tables[i, len(req.blocks) - 1] = bid
+        return [i for i in active if self._slots[i] is not None
+                and not self._slots[i].done]
+
+    def _preempt_youngest(self) -> Optional[int]:
+        cand = [i for i in range(self.B) if self._slots[i] is not None
+                and not self._slots[i].done]
+        if not cand:
+            return None
+        i = max(cand, key=lambda j: self._slots[j].request_id)
+        req = self._slots[i]
+        for bid in req.blocks:
+            self.blocks.release(bid)
+        req.blocks = []
+        # roll generated tokens into the prompt: re-prefill resumes exactly
+        # (n_prompt keeps outputs and the max_tokens budget intact)
+        req.prompt_tokens = req.prompt_tokens + req.out_tokens
+        req.out_tokens = []
+        req.cached_prefix_len = 0
+        self._queue.appendleft(req)
+        self._slots[i] = None
+        self._tables[i] = 0
+        self.blocks.stats["preemptions"] += 1
+        return i
+
+    # -- internals ----------------------------------------------------------
 
     def _record_token(self, i: int, req: Request, tok: int):
         sp = req.sampling
@@ -216,7 +438,7 @@ class LLMEngine:
             except Exception:  # noqa: BLE001 - consumer hook must not kill decode
                 pass
         if (req.num_generated >= sp.max_tokens
-                or len(req.prompt_tokens) + req.num_generated
+                or len(req.prompt_tokens) + len(req.out_tokens)
                 >= self.max_len - 1):
             req.done = True
 
@@ -236,3 +458,11 @@ class LLMEngine:
         t = jnp.maximum(temperature, 1e-6)[:, None]
         sampled = jax.random.categorical(key, logits / t).astype(jnp.int32)
         return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Smallest power of two >= n (>=1), capped."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)

@@ -1,0 +1,147 @@
+"""Paged KV cache ops: block-table attention for the LLM engine.
+
+Reference capability: ``ray.llm`` reaches paged attention + automatic
+prefix caching through vLLM
+(``python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py:123-127``).
+TPU-native redesign of the same ideas:
+
+* The KV cache is a global **block pool** ``[L, num_blocks, block_size,
+  KVH, hd]``; a sequence's cache is a **block table** (int32 indices into
+  the pool).  Capacity is blocks, not slots×max_len — short requests stop
+  reserving worst-case memory, and identical prompt prefixes share blocks.
+* All shapes are static: the decode step gathers each sequence's blocks
+  with ``jnp.take`` (``[b, MB·bs]`` keys, MB = max_len/block_size) and
+  masks by ``cur_len`` — one compiled program forever, XLA-friendly, no
+  dynamic shapes.  Block 0 is a reserved scratch block: table padding and
+  masked scatter lanes land there, so no write needs a branch.
+* Prefix-cached prefill runs per request (b=1): the cached prefix KV is
+  gathered from the pool, only the suffix runs through the layers (RoPE
+  offset by ``start_pos``), and the suffix KV is scattered back into
+  freshly allocated blocks.
+
+The block manager / prefix hash-chain lives in ``llm/engine.py`` (host
+side, pure numpy); this module is only the jittable math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.models.generation import _layer_with_cache, _stacked_layers
+from ray_tpu.ops.layers import rms_norm, rope_frequencies
+
+
+def init_kv_pool(cfg: LlamaConfig, num_blocks: int, block_size: int):
+    """Block pool; block 0 is the reserved scratch block."""
+    hd = cfg.resolved_head_dim
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, hd)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _lm_head(params, cfg, x):
+    x = rms_norm(x, params["final_norm"])
+    head = (params["embed"].T if cfg.tie_embeddings
+            else params["lm_head"]).astype(cfg.dtype)
+    return jnp.einsum("bsh,hv->bsv", x, head,
+                      preferred_element_type=jnp.float32)
+
+
+def paged_decode_step(params, token, cur_len, block_tables, pool,
+                      cfg: LlamaConfig):
+    """One token for every slot against block-table caches.
+
+    token ``[b]`` int32; cur_len ``[b]`` write positions; block_tables
+    ``[b, MB]`` int32 pool indices (pad with 0 = scratch).  Returns
+    ``(logits [b, vocab], pool)`` with each sequence's new KV written at
+    ``block_tables[i, cur_len // bs][cur_len % bs]``.
+    """
+    b = token.shape[0]
+    MB = block_tables.shape[1]
+    bs = pool["k"].shape[2]
+    hd = cfg.resolved_head_dim
+    dt = cfg.dtype
+    cos, sin = rope_frequencies(hd, MB * bs, cfg.rope_theta)
+    positions = cur_len[:, None]
+    x = params["embed"][token][:, None].astype(dt)
+    # logical position j visible iff j <= cur_len (own slot included)
+    idx = jnp.arange(MB * bs)
+    mask = idx[None, None, :] <= cur_len[:, None, None]
+    rows = jnp.arange(b)
+    blk = block_tables[rows, cur_len // bs]  # [b] target block per seq
+    off = cur_len % bs
+
+    for i, lp in _stacked_layers(params):
+        def merge(k, v, i=i):
+            # write new kv first so the token attends to itself
+            pool["k"] = pool["k"].at[i, blk, off].set(k[:, 0])
+            pool["v"] = pool["v"].at[i, blk, off].set(v[:, 0])
+            # gather this sequence's blocks in logical order
+            k_all = pool["k"][i][block_tables].reshape(b, MB * bs,
+                                                       *k.shape[2:])
+            v_all = pool["v"][i][block_tables].reshape(b, MB * bs,
+                                                       *v.shape[2:])
+            return k_all, v_all
+
+        x, _ = _layer_with_cache(x, lp, merge, cfg=cfg, cos=cos, sin=sin,
+                                 mask=mask, positions=positions)
+    return _lm_head(params, cfg, x)[:, 0], pool
+
+
+def prefill_suffix(params, tokens, length, start_pos, prefix_k, prefix_v,
+                   prefix_len, dst_blocks, dst_offsets, pool,
+                   cfg: LlamaConfig):
+    """b=1 prefill of a prompt *suffix* against a cached prefix.
+
+    tokens ``[1, S]`` right-padded suffix; length: true suffix length;
+    start_pos: absolute position of tokens[0] (== true prefix length);
+    prefix_k/v ``[L, P, KVH, hd]`` gathered prefix (P static bucket,
+    ``prefix_len`` true length, 0 for no prefix); dst_blocks/dst_offsets
+    ``[S]`` pool coordinates for each suffix position (pad lanes -> the
+    scratch block).  Returns ``(logits_at_last [1, vocab], pool)``.
+    """
+    _, S = tokens.shape
+    P = prefix_k.shape[1]
+    hd = cfg.resolved_head_dim
+    dt = cfg.dtype
+    cos, sin = rope_frequencies(hd, P + S, cfg.rope_theta)
+    positions = start_pos + jnp.arange(S)[None, :]  # [1, S] absolute
+    x = params["embed"][tokens].astype(dt)
+    sfx = jnp.arange(S)
+    # keys = [prefix (P) | suffix (S)]; query i sees prefix j < prefix_len
+    # and suffix j' <= i (within true suffix length)
+    pmask = (jnp.arange(P)[None, None, :] < prefix_len)  # [1, 1, P]
+    smask = (sfx[None, None, :] <= sfx[None, :, None]) & (
+        sfx[None, None, :] < length)  # [1, S, S]
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(pmask, (1, S, P)), smask], axis=-1)
+
+    for i, lp in _stacked_layers(params):
+        def merge(k, v, i=i):
+            # scatter suffix kv into its blocks (pad lanes hit scratch)
+            pool["k"] = pool["k"].at[i, dst_blocks, dst_offsets].set(k[0])
+            pool["v"] = pool["v"].at[i, dst_blocks, dst_offsets].set(v[0])
+            k_all = jnp.concatenate([prefix_k[i][None], k], axis=1)
+            v_all = jnp.concatenate([prefix_v[i][None], v], axis=1)
+            return k_all, v_all
+
+        x, _ = _layer_with_cache(x, lp, merge, cfg=cfg, cos=cos, sin=sin,
+                                 mask=mask, positions=positions)
+    logits = _lm_head(params, cfg, x)
+    last = jnp.take_along_axis(
+        logits, (length - 1)[None, None, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    return last, pool
+
+
+def gather_prefix(pool, blocks):
+    """Gather ``[L, P·bs, KVH, hd]`` prefix KV for a block list ``[P]``."""
+    L, _, bs = pool["k"].shape[:3]
+    P = blocks.shape[0]
+    k = pool["k"][:, blocks].reshape(L, P * bs, *pool["k"].shape[3:])
+    v = pool["v"][:, blocks].reshape(L, P * bs, *pool["v"].shape[3:])
+    return k, v
+
+

@@ -61,7 +61,8 @@ class RemoteFunction:
 
         worker = get_global_worker()
         opts = self._options
-        task_args, kw_keys = api_utils.build_args(worker, args, kwargs)
+        task_args, kw_keys, nested_refs = api_utils.build_args(
+            worker, args, kwargs)
         spec = TaskSpec(
             task_id=api_utils.next_task_id(worker),
             job_id=worker.job_id,
@@ -85,7 +86,7 @@ class RemoteFunction:
             backpressure_num_objects=int(
                 opts.get("_generator_backpressure_num_objects", 0) or 0),
         )
-        refs = worker.submit_task(spec)
+        refs = worker.submit_task(spec, nested_arg_refs=nested_refs)
         if spec.num_returns == 1:
             return refs[0]
         return refs

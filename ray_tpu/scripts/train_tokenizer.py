@@ -1,0 +1,48 @@
+"""One-time trainer for the committed BPE vocab (``ray_tpu/llm/bpe_vocab.json``).
+
+Hermetic: the corpus is the repo's own documentation and source — mixed
+English prose and Python code — which gives the LLM tier a realistic
+subword vocabulary without any network fetch.  Re-run only when changing
+the tokenizer; the artifact is committed.
+
+    python -m ray_tpu.scripts.train_tokenizer [vocab_size]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def build_corpus(repo_root: str) -> str:
+    parts = []
+    for pattern in ("*.md", "ray_tpu/**/*.py", "tests/*.py"):
+        for path in sorted(glob.glob(os.path.join(repo_root, pattern),
+                                     recursive=True)):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    parts.append(f.read())
+            except OSError:
+                pass
+    return "\n".join(parts)
+
+
+def main():
+    from ray_tpu.llm.bpe import train_bpe
+
+    vocab_size = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    corpus = build_corpus(repo)
+    print(f"corpus: {len(corpus):,} chars")
+    vocab = train_bpe(corpus, vocab_size=vocab_size)
+    out = os.path.join(repo, "ray_tpu", "llm", "bpe_vocab.json")
+    with open(out, "w") as f:
+        json.dump(vocab, f)
+    print(f"wrote {out}: {len(vocab['merges'])} merges")
+
+
+if __name__ == "__main__":
+    main()

@@ -235,6 +235,10 @@ class ClientCoreWorker:
             await asyncio.sleep(1.0)
             self._ref_events.clear()
 
+    def hold_actor_creation_refs(self, actor_id, refs, until_dead):
+        """No-op on the client: the proxy's session registry retains the
+        real objects server-side for the session's lifetime."""
+
     def _pin_contained_refs(self, refs):
         # no-op: every ref a client holds was handed out by the proxy and
         # is retained in its session registry until disconnect, which is a
@@ -308,7 +312,10 @@ class ClientCoreWorker:
                 max_workers=4, thread_name_prefix="rtpu-client-fut")
         return pool.submit(self.get, ref)
 
-    def submit_task(self, spec: TaskSpec):
+    def submit_task(self, spec: TaskSpec,
+                    nested_arg_refs: Optional[list] = None):
+        # nested_arg_refs: client-side refs are proxies — the server-side
+        # session registry pins the real objects, so no client hold needed
         from ray_tpu._private.streaming import STREAMING_RETURNS
 
         if spec.num_returns == STREAMING_RETURNS:
@@ -321,7 +328,8 @@ class ClientCoreWorker:
             spec_bytes=serialization.dumps(spec)))
         return refs
 
-    def submit_actor_task(self, spec: TaskSpec):
+    def submit_actor_task(self, spec: TaskSpec,
+                          nested_arg_refs: Optional[list] = None):
         return self.submit_task(spec)
 
     def cancel_task(self, ref: ObjectRef, force: bool = False,

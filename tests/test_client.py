@@ -9,6 +9,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -107,8 +108,16 @@ def test_head_starts_client_server(ray_isolated):
     from ray_tpu.util.client import ClientCoreWorker
 
     w = get_global_worker()
-    addr = w.run_coro(w.gcs.call("kv_get", ns="cluster",
-                                 key="client_server_addr"))
+    # the head retries the fixed default port while a previous session
+    # releases it, so the address can appear a few seconds after init
+    deadline = time.time() + 25
+    addr = None
+    while time.time() < deadline:
+        addr = w.run_coro(w.gcs.call("kv_get", ns="cluster",
+                                     key="client_server_addr"))
+        if addr:
+            break
+        time.sleep(0.5)
     assert addr, "head did not publish client_server_addr"
     host, _, port = addr.decode().rpartition(":")
     client = ClientCoreWorker("127.0.0.1", int(port))

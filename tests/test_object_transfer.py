@@ -188,3 +188,43 @@ def test_raylet_transfer_endpoints(ray_isolated):
     info, chunk = w.run_coro(probe())
     assert info["size"] > 2 * 1024 * 1024  # payload + serialization header
     assert len(chunk) == 64 * 1024
+
+
+def test_landing_segment_invisible_until_seal():
+    """ADVICE r2 (high): a chunked-transfer landing segment must not be
+    attachable under the object's name until the payload is complete —
+    a concurrent reader attaching mid-transfer would deserialize zeros."""
+    writer = SharedObjectStore()
+    reader = SharedObjectStore()  # separate process stand-in: attach by name
+    oid = ObjectID.from_random()
+    payload = os.urandom(256 * 1024)
+    try:
+        view, seal = writer.create_writable(oid, len(payload))
+        # pre-seal: invisible to everyone, including name-based attach
+        assert not writer.contains(oid)
+        assert not reader.contains(oid)
+        assert reader.get_buffer(oid) is None
+        view[:] = payload
+        seal()
+        assert writer.contains(oid)
+        assert bytes(reader.get_buffer(oid)) == payload
+    finally:
+        view = None
+        reader.close(unlink_created=False)
+        writer.delete(oid)
+        writer.close()
+
+
+def test_landing_segment_abort_reclaimed():
+    """delete() on an unsealed landing zone reclaims the staging segment."""
+    store = SharedObjectStore()
+    oid = ObjectID.from_random()
+    view, seal = store.create_writable(oid, 4096)
+    staging = f"/dev/shm/rtpu_{oid.hex()}_stg{os.getpid()}"
+    assert os.path.exists(staging)
+    view = None
+    store.delete(oid)
+    assert not os.path.exists(staging)
+    seal()  # late seal after abort: publishes nothing
+    assert not store.contains(oid)
+    store.close()
