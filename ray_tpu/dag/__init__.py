@@ -4,6 +4,12 @@ Parity: ``python/ray/dag/`` — ``DAGNode.experimental_compile``
 (``dag_node.py:265``) → ``CompiledDAG`` (``compiled_dag_node.py:805``).
 """
 
+from ray_tpu.dag.collective_node import (
+    CollectiveNode,
+    allgather,
+    allreduce,
+    reducescatter,
+)
 from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
@@ -17,4 +23,5 @@ from ray_tpu.dag.dag_node import (
 __all__ = [
     "DAGNode", "InputNode", "InputAttributeNode", "ClassMethodNode",
     "FunctionNode", "MultiOutputNode", "CompiledDAG", "CompiledDAGRef",
+    "CollectiveNode", "allreduce", "allgather", "reducescatter",
 ]

@@ -20,6 +20,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.dag.collective_node import CollectiveNode, run_collective
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
     DAGNode,
@@ -30,6 +31,9 @@ from ray_tpu.dag.dag_node import (
 )
 from ray_tpu.exceptions import TaskError
 from ray_tpu.experimental.channel import Channel, ChannelClosedError
+
+# node types that execute as tasks inside an actor's exec loop
+_TASK_NODES = (ClassMethodNode, CollectiveNode)
 
 
 class _Stop:
@@ -89,18 +93,52 @@ def _exec_loop_status(instance, dag_id: str) -> Dict[str, Any]:
     return {"done": st["done"], "error": st["error"]}
 
 
+class _Pending:
+    """An in-flight overlapped collective; joined at first consumption."""
+
+    __slots__ = ("fut",)
+
+    def __init__(self, fut):
+        self.fut = fut
+
+    def join(self):
+        try:
+            return self.fut.result()
+        except BaseException as e:  # noqa: BLE001 — propagated downstream
+            return TaskError.from_exception(e)
+
+
 def _run_exec_loop(instance, spec: Dict[str, Any]) -> None:
     """One iteration per execute(): read in-edges, run tasks, write out-edges.
 
     spec = {"read_channels": {name: Channel}, "tasks": [
         {"method": str, "args": [argspec], "kwargs": {k: argspec},
-         "out_channel": Channel|None, "local_idx": int}]}
+         "out_channel": Channel|None, "local_idx": int,
+         "collective": None | {"kind", "group"}}]}
     argspec = ("const", v) | ("input",) | ("input_attr", key)
              | ("chan", name) | ("local", idx)
+
+    Comm/compute overlap (reference ``dag_node_operation.py``): a
+    collective whose result is consumed only LATER on this actor runs on a
+    background thread; tasks between the collective and its first consumer
+    execute concurrently with the communication.
     """
     read_channels: Dict[str, Channel] = spec["read_channels"]
     tasks = spec["tasks"]
+    coll_pool = None
+    if any(t.get("collective") for t in tasks):
+        from concurrent.futures import ThreadPoolExecutor
 
+        coll_pool = ThreadPoolExecutor(max_workers=1,
+                                       thread_name_prefix="dag-coll")
+    try:
+        _exec_iterations(instance, spec, read_channels, tasks, coll_pool)
+    finally:
+        if coll_pool is not None:
+            coll_pool.shutdown(wait=False)
+
+
+def _exec_iterations(instance, spec, read_channels, tasks, coll_pool):
     while True:
         # Channels are read LAZILY, at first use within the iteration: an
         # A->B->A shape needs A to run its first task (filling B's input)
@@ -136,7 +174,10 @@ def _run_exec_loop(instance, spec: Dict[str, Any]) -> None:
             if kind == "chan":
                 return get_chan(a[1])
             if kind == "local":
-                return local[a[1]]
+                v = local[a[1]]
+                if isinstance(v, _Pending):  # join an overlapped collective
+                    v = local[a[1]] = v.join()
+                return v
             raise ValueError(f"bad argspec {a!r}")
 
         stopping = False
@@ -147,8 +188,21 @@ def _run_exec_loop(instance, spec: Dict[str, Any]) -> None:
                 vals = list(args) + list(kwargs.values())
                 upstream_err = next(
                     (v for v in vals if isinstance(v, TaskError)), None)
+                coll = t.get("collective")
                 if upstream_err is not None:
+                    # skip the op (a collective's peers fail the iteration
+                    # via the group timeout instead of hanging forever)
                     result = upstream_err
+                elif coll is not None:
+                    if t["out_channel"] is None:
+                        # result consumed later on this actor: overlap the
+                        # communication with the compute in between
+                        local[t["local_idx"]] = _Pending(coll_pool.submit(
+                            run_collective, coll["kind"], args[0],
+                            coll["group"]))
+                        continue
+                    result = run_collective(coll["kind"], args[0],
+                                            coll["group"])
                 else:
                     result = getattr(instance, t["method"])(*args, **kwargs)
             except _StopSignal:
@@ -198,6 +252,7 @@ class CompiledDAG:
         self._output_channels: List[Channel] = []
         self._all_channels: List[Channel] = []
         self._actors: List[Any] = []
+        self._collective_groups: List[Any] = []
         self._next_exec_idx = 0
         self._next_get_idx = 0
         # values already drained from output channels for the execution
@@ -243,12 +298,29 @@ class CompiledDAG:
         else:
             terminals = [self.root]
         for t in terminals:
-            if not isinstance(t, ClassMethodNode):
+            if not isinstance(t, _TASK_NODES):
                 raise TypeError(
                     f"compiled DAG outputs must be actor-method nodes, got "
                     f"{type(t).__name__}")
 
-        method_nodes = [n for n in nodes if isinstance(n, ClassMethodNode)]
+        method_nodes = [n for n in nodes if isinstance(n, _TASK_NODES)]
+        # collective groups: every rank's output node must be part of THIS
+        # dag — an absent rank would deadlock the group at runtime
+        group_members: Dict[int, List[CollectiveNode]] = {}
+        self._collective_groups = []
+        for n in method_nodes:
+            if isinstance(n, CollectiveNode):
+                members = group_members.setdefault(id(n.group), [])
+                if not members:
+                    self._collective_groups.append(n.group)
+                members.append(n)
+        for group in self._collective_groups:
+            found = {m.index for m in group_members[id(group)]}
+            if len(found) != group.world_size:
+                raise ValueError(
+                    f"collective group over {group.world_size} actors but "
+                    f"only ranks {sorted(found)} are reachable in this DAG "
+                    f"— bind ALL returned collective nodes")
         # every task must depend (transitively) on the input: the exec loop
         # paces iterations by channel reads, so a read-less task would spin
         depends: Dict[int, bool] = {}
@@ -276,7 +348,7 @@ class CompiledDAG:
             for dep in n._upstream():
                 if isinstance(dep, (InputNode, InputAttributeNode)):
                     consumes_input[actor_of[id(n)]] = True
-                elif isinstance(dep, ClassMethodNode):
+                elif isinstance(dep, _TASK_NODES):
                     if actor_of[id(dep)] != actor_of[id(n)]:
                         consumers[id(dep)].append(actor_of[id(n)])
 
@@ -348,7 +420,7 @@ class CompiledDAG:
                     return ("input",)
                 if isinstance(v, InputAttributeNode):
                     return ("input_attr", v.key)
-                if isinstance(v, ClassMethodNode):
+                if isinstance(v, _TASK_NODES):
                     if actor_of[id(v)] == aid:
                         return ("local", node_idx[id(v)])
                     ch = out_channel[id(v)]
@@ -369,7 +441,20 @@ class CompiledDAG:
                 "out_channel": out_channel[id(n)],
                 "local_idx": node_idx[id(n)],
             }
+            if isinstance(n, CollectiveNode):
+                task["collective"] = {"kind": n.group.op,
+                                      "group": n.group.group_name}
             spec["tasks"].append(task)
+
+        # join each collective group's actors (rank order = bind order)
+        # BEFORE exec loops start: the first iteration may hit the op
+        # immediately (reference: Communicator init in dag compilation)
+        from ray_tpu.util.collective import collective as _coll
+
+        for group in self._collective_groups:
+            _coll.create_collective_group(
+                [inp.actor for inp in group.inputs], group.world_size,
+                backend=group.backend, group_name=group.group_name)
 
         # start exec loops
         import ray_tpu
@@ -452,6 +537,20 @@ class CompiledDAG:
                 if st["done"]:
                     break
                 time.sleep(0.05)
+        for group in self._collective_groups:
+
+            def _destroy(_self, name):
+                from ray_tpu.util.collective import collective as coll
+
+                coll.destroy_collective_group(name)
+                return True
+
+            for inp in group.inputs:
+                try:
+                    ray_tpu.get(inp.actor._remote_call.remote(
+                        _destroy, group.group_name), timeout=5)
+                except Exception:  # noqa: BLE001 - actor may be gone
+                    pass
         for ch in self._all_channels:
             ch.destroy()
 

@@ -177,13 +177,14 @@ class LLMEngine:
     def __init__(self, cfg: LlamaConfig, params=None, *,
                  tokenizer: Optional[Any] = None, batch_slots: int = 8,
                  max_len: Optional[int] = None, block_size: int = 16,
-                 num_blocks: Optional[int] = None, seed: int = 0,
-                 mesh=None):
+                 num_blocks: Optional[int] = None, decode_window: int = 16,
+                 seed: int = 0, mesh=None):
         import jax
+        import jax.numpy as jnp
 
         from ray_tpu.models.llama import llama_init
         from ray_tpu.models.paged_generation import (init_kv_pool,
-                                                     paged_decode_step,
+                                                     paged_decode_sample,
                                                      prefill_suffix)
 
         self.cfg = cfg
@@ -203,9 +204,15 @@ class LLMEngine:
 
         self.pool = init_kv_pool(cfg, self.num_blocks, self.bs)
         self.blocks = _BlockManager(self.num_blocks)
-        self._decode = jax.jit(
-            functools.partial(paged_decode_step, cfg=cfg),
+        # multi-step window: K on-device steps chained without any host
+        # sync (token/position/key stay device-resident), sampled tokens
+        # fetched ONCE per window — the host↔device round trip (100ms+
+        # through a tunnel'd chip) amortizes over window*slots tokens
+        self.K = max(1, decode_window)
+        self._decode1 = jax.jit(
+            functools.partial(paged_decode_sample, cfg=cfg),
             donate_argnums=(4,))
+        self._stack = jax.jit(lambda *ts: jnp.stack(ts))
         self._prefill = jax.jit(
             functools.partial(prefill_suffix, cfg=cfg),
             donate_argnums=(9,))  # the pool (avoid a full second copy)
@@ -217,6 +224,14 @@ class LLMEngine:
         self._cur_len = np.zeros(self.B, np.int32)
         self._next_token = np.zeros(self.B, np.int32)
         self._tables = np.zeros((self.B, self.MB), np.int32)
+        # device mirrors of the decode inputs, kept resident across
+        # windows: re-uploading unchanged tables/temps/token/cur costs a
+        # dispatch each through a high-latency link.  Any host-side slot
+        # mutation (admit/retire/preempt/block growth) sets the flag.
+        self._dev: Optional[Tuple[Any, Any]] = None  # (tok_d, cur_d)
+        self._tables_d = None
+        self._temps_d = None
+        self._dev_dirty = True
         # per-token hook for streaming consumers: on_token(request_id, tok)
         self.on_token: Optional[Any] = None
 
@@ -246,31 +261,67 @@ class LLMEngine:
         import jax
         import jax.numpy as jnp
 
-        # 1. admit
+        # 1. admit — prefills dispatch back-to-back; the first tokens of
+        # ALL admissions are sampled and fetched in ONE host sync
+        admitted: List[Tuple[int, Any]] = []
         for i in range(self.B):
             if self._slots[i] is None and self._queue:
-                if not self._admit(i):
+                logits_d = self._admit(i)
+                if logits_d is None:
                     break  # out of blocks: stop admitting this step
+                admitted.append((i, logits_d))
+        if admitted:
+            self._key, k = jax.random.split(self._key)
+            lg = self._stack(*[d for _, d in admitted])[:, 0]
+            temps = np.asarray([self._slots[i].sampling.temperature
+                                for i, _ in admitted], np.float32)
+            first = np.asarray(self._sample(lg, k, jnp.asarray(temps)))
+            for (i, _), tok in zip(admitted, first):
+                self._record_token(i, self._slots[i], int(tok))
 
         active = [i for i in range(self.B) if self._slots[i] is not None
                   and not self._slots[i].done]
         if active:
-            # ensure every active slot has a block for its write position;
+            # ensure every active slot has blocks for the whole window;
             # preempt the youngest request if the pool is exhausted
-            active = self._ensure_decode_blocks(active)
+            active = self._ensure_decode_blocks(active, horizon=self.K)
         if active:
-            tokens = jnp.asarray(self._next_token)
-            cur = jnp.asarray(self._cur_len)
-            tables = jnp.asarray(self._tables)
-            logits, self.pool = self._decode(self.params, tokens, cur,
-                                             tables, self.pool)
-            self._cur_len += np.asarray(
-                [1 if self._slots[i] is not None and not self._slots[i].done
-                 else 0 for i in range(self.B)], np.int32)
-            self._key, k = jax.random.split(self._key)
-            sampled = np.asarray(self._sample(logits, k, self._temp_vec()))
+            # adaptive window: never decode past what the longest-running
+            # active request can still accept
+            rem = 1
             for i in active:
-                self._record_token(i, self._slots[i], int(sampled[i]))
+                req = self._slots[i]
+                r = min(req.sampling.max_tokens - req.num_generated,
+                        self.max_len - 1 - len(req.prompt_tokens)
+                        - len(req.out_tokens))
+                rem = max(rem, r)
+            window_k = max(1, min(self.K, rem))
+            if self._dev_dirty or self._dev is None:
+                tok_d = jnp.asarray(self._next_token)
+                cur_d = jnp.asarray(self._cur_len)
+                self._tables_d = jnp.asarray(self._tables)
+                self._temps_d = jnp.asarray(self._temp_vec())
+                self._dev_dirty = False
+            else:
+                tok_d, cur_d = self._dev
+            key_d = self._key
+            toks = []
+            for _ in range(window_k):  # device-chained: no host sync inside
+                tok_d, cur_d, key_d, self.pool = self._decode1(
+                    self.params, tok_d, cur_d, self._tables_d, self.pool,
+                    key_d, self._temps_d)
+                toks.append(tok_d)
+            self._key = key_d
+            self._dev = (tok_d, cur_d)
+            # ONE host sync for the whole window_k * B window
+            window = np.asarray(self._stack(*toks))
+            for step in range(window_k):
+                for i in active:
+                    req = self._slots[i]
+                    if req is None or req.done:
+                        continue  # stopped mid-window: discard the tail
+                    self._cur_len[i] += 1
+                    self._record_token(i, req, int(window[step, i]))
 
         # 3. retire
         out = []
@@ -286,6 +337,7 @@ class LLMEngine:
                 req.blocks = []
                 self._slots[i] = None
                 self._tables[i] = 0
+                self._dev_dirty = True
         return out
 
     def generate(self, prompts, sampling: Optional[SamplingParams] = None
@@ -307,10 +359,11 @@ class LLMEngine:
             keys.append(parent)
         return keys
 
-    def _admit(self, i: int) -> bool:
-        """Prefill the next queued request into slot i (returns False and
-        leaves the queue untouched when the pool can't hold its suffix)."""
-        import jax
+    def _admit(self, i: int):
+        """Prefill the next queued request into slot i.  Returns the
+        last-position logits as a DEVICE array (the caller batch-samples
+        all admissions with one sync), or None when the pool can't hold
+        the suffix (queue left untouched)."""
         import jax.numpy as jnp
 
         from ray_tpu.models.paged_generation import gather_prefix
@@ -337,10 +390,24 @@ class LLMEngine:
             cached_len = len(hit_blocks) * self.bs
         suffix = toks[cached_len:]
         need = -(-(n + 1) // self.bs) - len(hit_blocks)  # +1: first decode
+        # worst-case footprint from the ORIGINAL prompt + full budget: after
+        # a preemption, prompt_tokens already contains generated tokens and
+        # the remaining budget shrinks accordingly — double-counting here
+        # would spuriously reject a request that admitted fine before
+        worst = -(-min(req.n_prompt + req.sampling.max_tokens + 1,
+                       self.max_len) // self.bs)
+        if worst >= self.num_blocks:
+            # even an empty pool could never hold this one sequence: loud
+            # config error beats an admit/preempt/requeue livelock
+            self._queue.popleft()
+            raise RuntimeError(
+                f"KV pool ({self.num_blocks} blocks of {self.bs}) cannot "
+                f"hold one sequence of up to {worst} blocks; raise "
+                f"num_blocks or lower max_tokens")
         if self.blocks.available() < need:
             for bid in hit_blocks:
                 self.blocks.release(bid)
-            return False
+            return None
         if hit_blocks:
             self.blocks.stats["prefix_hits"] += 1
 
@@ -376,29 +443,35 @@ class LLMEngine:
         self._cur_len[i] = n
         self._tables[i] = 0
         self._tables[i, :len(req.blocks)] = req.blocks
-        self._key, k = jax.random.split(self._key)
-        tok = int(self._sample(logits, k, self._temp_vec(slice(i, i + 1)))[0])
-        self._record_token(i, req, tok)
-        return True
+        self._dev_dirty = True
+        return logits  # device array; caller batch-samples all admissions
 
-    def _ensure_decode_blocks(self, active: List[int]) -> List[int]:
-        """Allocate the write-position block for each active slot,
-        preempting the youngest request when the pool is exhausted
-        (vLLM recompute preemption)."""
+    def _ensure_decode_blocks(self, active: List[int],
+                              horizon: int = 1) -> List[int]:
+        """Allocate blocks covering the next ``horizon`` write positions
+        for each active slot, preempting the youngest request when the
+        pool is exhausted (vLLM recompute preemption)."""
         for i in list(active):
             req = self._slots[i]
             if req is None or req.done:
                 continue
-            blk_idx = int(self._cur_len[i]) // self.bs
+            # cap at the request's remaining budget: tail tokens past
+            # max_tokens are discarded (and clamp to scratch), so reserving
+            # blocks for them could only cause needless preemption
+            remaining = max(1, req.sampling.max_tokens - req.num_generated)
+            last_pos = min(int(self._cur_len[i]) + min(horizon, remaining)
+                           - 1, self.max_len - 1)
+            blk_idx = last_pos // self.bs
             while blk_idx >= len(req.blocks):
                 bid = self.blocks.alloc()
                 if bid is None:
                     victim = self._preempt_youngest()
                     if victim is None or victim == i:
-                        break
+                        break  # self-preempted: slot is back in the queue
                     continue
                 req.blocks.append(bid)
                 self._tables[i, len(req.blocks) - 1] = bid
+                self._dev_dirty = True
         return [i for i in active if self._slots[i] is not None
                 and not self._slots[i].done]
 
@@ -420,6 +493,7 @@ class LLMEngine:
         self._queue.appendleft(req)
         self._slots[i] = None
         self._tables[i] = 0
+        self._dev_dirty = True
         self.blocks.stats["preemptions"] += 1
         return i
 

@@ -34,7 +34,24 @@ class LLMServer:
         from ray_tpu.llm.engine import LLMEngine
 
         kw = dict(engine_kwargs or {})
-        cfg = kw.pop("cfg", None) or LlamaConfig.tiny()
+        cfg = kw.pop("cfg", None)
+        model = kw.pop("model", None)
+        if cfg is None:
+            if model:
+                # by-name config so the DRIVER never has to import jax
+                # (on a one-chip host the replica must own the TPU);
+                # inference weights default to bf16 (f32 7B = 27 GB)
+                import dataclasses
+
+                import jax.numpy as jnp
+
+                cfg = getattr(LlamaConfig, model)()
+                if model != "tiny":
+                    cfg = dataclasses.replace(
+                        cfg, param_dtype=jnp.bfloat16,
+                        max_seq_len=kw.get("max_len", cfg.max_seq_len))
+            else:
+                cfg = LlamaConfig.tiny()
         mesh = None
         if tensor_parallel_size > 1:
             from ray_tpu.parallel import MeshConfig, create_mesh

@@ -136,6 +136,37 @@ def prefill_suffix(params, tokens, length, start_pos, prefix_k, prefix_v,
     return last, pool
 
 
+def paged_decode_sample(params, token, cur_len, block_tables, pool, key,
+                        temps, cfg: LlamaConfig):
+    """One decode step with ON-DEVICE sampling, shaped for host-free
+    chaining: every output the next step needs (token, position, PRNG key)
+    is returned as a device array, so the engine can dispatch K steps
+    back-to-back and fetch the sampled tokens ONCE per window.
+
+    Why not fuse the K steps into one ``lax.scan`` program: under a scan
+    the per-layer weight slices of the stacked params materialize as HLO
+    temps (~weights-sized extra HBM), which OOMs a 7B model on one 16 GB
+    chip.  Chained single-step dispatch keeps memory at single-step level
+    while still amortizing the host↔device round trip (a tunnel'd chip
+    pays ~100 ms per sync; per-token host sampling caps decode at ~10
+    steps/s regardless of model speed).
+
+    Sampling: greedy for temp<=0, else categorical at the slot's
+    temperature.  Finished slots clamp their writes to the last position
+    (the host discards their tokens).
+    """
+    ML = block_tables.shape[1] * pool["k"].shape[2]
+    safe_cur = jnp.minimum(cur_len, ML - 1)
+    logits, pool = paged_decode_step(params, token, safe_cur, block_tables,
+                                     pool, cfg=cfg)
+    key, sub = jax.random.split(key)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(sub, logits / t).astype(jnp.int32)
+    nxt = jnp.where(temps <= 0.0, greedy, sampled)
+    return nxt, cur_len + 1, key, pool
+
+
 def gather_prefix(pool, blocks):
     """Gather ``[L, P·bs, KVH, hd]`` prefix KV for a block list ``[P]``."""
     L, _, bs = pool["k"].shape[:3]

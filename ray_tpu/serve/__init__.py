@@ -49,7 +49,8 @@ def start(http_options: Optional[Dict[str, Any]] = None,
 
         host = http_options.get("host", "127.0.0.1")
         port = http_options.get("port", 8000)
-        _proxy = ProxyActor.remote(host, port)
+        _proxy = ProxyActor.remote(
+            host, port, http_options.get("request_timeout_s", 120.0))
         ray_tpu.get(_proxy.ready.remote(), timeout=60)
     if grpc_options and _grpc_proxy is None:
         from ray_tpu.serve.grpc_proxy import GrpcProxyActor

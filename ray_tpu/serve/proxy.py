@@ -22,9 +22,14 @@ import ray_tpu
 
 @ray_tpu.remote
 class ProxyActor:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 request_timeout_s: float = 120.0):
         self._host = host
         self._port = port
+        # reference: serve HTTPOptions.request_timeout_s — a big model's
+        # FIRST request includes jit compilation and can far exceed a
+        # one-size-fits-all minute
+        self._request_timeout_s = request_timeout_s
         self._routes: Dict[str, str] = {}
         self._routes_at = 0.0
         self._handles: Dict[str, Any] = {}
@@ -156,7 +161,8 @@ class ProxyActor:
                 return await self._stream_sse(request, handle, body, loop)
             try:
                 resp = await loop.run_in_executor(
-                    None, lambda: handle.remote(body).result(timeout=60))
+                    None, lambda: handle.remote(body).result(
+                        timeout=self._request_timeout_s))
             except Exception as e:
                 return web.json_response({"error": repr(e)}, status=500)
             try:

@@ -317,3 +317,107 @@ class TestCommunicator:
         assert ray_tpu.get(actors[0].world.remote()) == 2
         for a in actors:
             ray_tpu.kill(a)
+
+
+@ray_tpu.remote
+class DPWorker:
+    """Data-parallel rank for the collective-node tests: tiny linear model,
+    local gradient, in-graph allreduce, local apply."""
+
+    def __init__(self, seed):
+        self.w = np.zeros(4, np.float32)
+        self.rng = np.random.default_rng(seed)
+        self.lr = 0.1
+
+    def grad(self, batch_id):
+        # deterministic per (rank-seed, batch): ranks produce DIFFERENT grads
+        return (self.rng.standard_normal(4).astype(np.float32)
+                + np.float32(batch_id))
+
+    def busy_work(self, batch_id):
+        # independent compute that can overlap the in-flight allreduce
+        return float(batch_id) * 2.0
+
+    def apply(self, g, aux):
+        self.w = self.w - self.lr * g
+        return (self.w.copy(), aux)
+
+    def weights(self):
+        return self.w.copy()
+
+
+class TestCollectiveDag:
+    """VERDICT r2 #3: dag.allreduce.bind over the Communicator ABC —
+    reference python/ray/dag/collective_node.py:23 + comm/compute overlap
+    of dag_node_operation.py."""
+
+    def test_allreduce_sum(self):
+        from ray_tpu.dag import allreduce
+
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            ga = a.add.bind(inp)   # x+1
+            gb = b.add.bind(inp)   # x+2
+            ra, rb = allreduce.bind([ga, gb])
+            dag = MultiOutputNode([ra, rb])
+        compiled = dag.experimental_compile()
+        try:
+            for x in (0, 5):
+                out = compiled.execute(np.float32(x)).get(timeout=30)
+                assert out[0] == out[1] == 2 * x + 3
+        finally:
+            compiled.teardown()
+
+    def test_dp_training_step_with_overlap(self):
+        """A multi-actor DP training step as ONE compiled DAG: local grads,
+        in-graph gradient allreduce (overlapped with independent compute),
+        local apply.  Replicas stay bit-identical across steps."""
+        from ray_tpu.dag import allreduce
+
+        w0 = DPWorker.remote(seed=0)
+        w1 = DPWorker.remote(seed=1)
+        with InputNode() as inp:
+            g0 = w0.grad.bind(inp)
+            g1 = w1.grad.bind(inp)
+            r0, r1 = allreduce.bind([g0, g1])
+            # independent tasks between the collective and its consumer:
+            # executed while the allreduce is in flight (overlap path —
+            # the collective result is consumed LOCALLY by apply)
+            aux0 = w0.busy_work.bind(inp)
+            aux1 = w1.busy_work.bind(inp)
+            dag = MultiOutputNode([w0.apply.bind(r0, aux0),
+                                   w1.apply.bind(r1, aux1)])
+        compiled = dag.experimental_compile()
+        try:
+            for step in range(4):
+                (wa, auxa), (wb, auxb) = compiled.execute(step).get(
+                    timeout=30)
+                assert np.allclose(wa, wb), (step, wa, wb)
+                assert auxa == auxb == step * 2.0
+            final = ray_tpu.get([w0.weights.remote(), w1.weights.remote()])
+            assert np.allclose(final[0], final[1])
+            assert np.abs(final[0]).sum() > 0  # training actually moved
+        finally:
+            compiled.teardown()
+
+    def test_collective_needs_distinct_actors(self):
+        from ray_tpu.dag import allreduce
+
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            ga = a.add.bind(inp)
+            gb = a.add.bind(inp)
+            with pytest.raises(ValueError, match="distinct actors"):
+                allreduce.bind([ga, gb])
+
+    def test_collective_requires_all_ranks_bound(self):
+        from ray_tpu.dag import allreduce
+
+        a = Adder.remote(1)
+        b = Adder.remote(2)
+        with InputNode() as inp:
+            ra, rb = allreduce.bind([a.add.bind(inp), b.add.bind(inp)])
+            dag = ra  # rank 1's output dropped: would deadlock at runtime
+        with pytest.raises(ValueError, match="bind ALL"):
+            dag.experimental_compile()

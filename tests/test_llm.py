@@ -264,3 +264,20 @@ def test_bpe_tokenizer_roundtrip_and_engine_default():
     # a model with a big enough vocab gets BPE; tiny models fall back
     assert isinstance(default_tokenizer(32000), BPETokenizer)
     assert isinstance(default_tokenizer(256), ByteTokenizer)
+
+
+def test_multi_window_decode_matches(tiny_model):
+    """Greedy output is window-size invariant: K=1 vs K=4 vs the
+    cache-free reference path all agree across several windows."""
+    from ray_tpu.llm import LLMEngine
+    from ray_tpu.models.generation import generate
+
+    cfg, params = tiny_model
+    sp = SamplingParams(temperature=0.0, max_tokens=19)  # not a K multiple
+    prompts = [[3, 4, 5], [11, 12, 13, 14, 15]]
+    ref = generate(params, cfg, prompts, sp, key=jax.random.PRNGKey(0))
+    for K in (1, 4):
+        eng = LLMEngine(cfg, params, batch_slots=2, max_len=64,
+                        block_size=4, decode_window=K)
+        outs = eng.generate(prompts, sp)
+        assert [o.token_ids for o in outs] == ref, (K, ref)
