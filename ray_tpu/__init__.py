@@ -127,6 +127,9 @@ def init(
         core.namespace = namespace or ""
         worker_mod.global_worker = core
         core.run_coro(core.gcs.call("add_job", job_id=job_no, info={"driver_pid": _pid()}))
+        if log_to_driver:
+            # worker prints stream back to this process's stdout
+            core.start_log_streaming()
         return RuntimeInfo(gcs_addr)
 
 

@@ -21,21 +21,39 @@ _lib_paths: Dict[str, Optional[str]] = {}
 _build_errors: Dict[str, str] = {}
 
 
+def _sanitize_mode() -> str:
+    """'' | 'asan' | 'tsan' — sanitizer builds for the native data plane
+    (the TSAN/ASAN CI intent of the reference, SURVEY §5 race detection).
+    Processes loading a sanitized .so must usually preload the runtime:
+    ``LD_PRELOAD=$(g++ -print-file-name=libtsan.so)``."""
+    return os.environ.get("RAY_TPU_NATIVE_SANITIZE", "").lower()
+
+
 def lib_path(name: str = "store") -> Optional[str]:
     """Path to the built librtpu_{name}.so, or None if the build failed."""
     with _lock:
         if name in _lib_paths:
             return _lib_paths[name]
         src = os.path.join(_NATIVE_DIR, f"{name}.cc")
+        san = _sanitize_mode()
+        flags = {
+            "": ["-O2"],
+            "asan": ["-O1", "-g", "-fsanitize=address",
+                     "-fno-omit-frame-pointer"],
+            "tsan": ["-O1", "-g", "-fsanitize=thread",
+                     "-fno-omit-frame-pointer"],
+        }.get(san, ["-O2"])
         try:
             with open(src, "rb") as f:
                 tag = hashlib.sha256(f.read()).hexdigest()[:16]
+            if san:
+                tag = f"{tag}-{san}"
             out = os.path.join(_BUILD_DIR, f"librtpu_{name}-{tag}.so")
             if not os.path.exists(out):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
                 tmp = out + f".tmp.{os.getpid()}"
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                    ["g++", *flags, "-shared", "-fPIC", "-std=c++17",
                      "-o", tmp, src, "-lpthread", "-lrt"],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, out)  # atomic: racing builders both succeed

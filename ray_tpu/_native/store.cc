@@ -29,6 +29,7 @@
 #include <ctime>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -42,7 +43,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x5254505553544f52ULL;  // "RTPUSTOR"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;  // v2: Entry.creator_pid (dead-pin reclaim)
 constexpr uint64_t kAlign = 64;
 // Block header is a full alignment unit so payloads (block base + header)
 // stay 64-byte aligned — the invariant jax.device_put zero-copy DMA needs.
@@ -64,6 +65,11 @@ struct Entry {
   uint32_t state;
   uint32_t refcount;
   uint64_t lru_tick;
+  // nonzero while the creator's alloc-time pin is outstanding: lets
+  // reclaim_dead() drop pins leaked by SIGKILLed processes (the
+  // daemon-less stand-in for plasma's client-disconnect cleanup)
+  uint32_t creator_pid;
+  uint32_t _pad;
 };
 
 struct Header {
@@ -427,6 +433,7 @@ int64_t rtpu_store_alloc(int hi, const uint8_t* id, uint64_t size,
         e->size = size;
         e->state = kAllocated;
         e->refcount = 1;  // creator's ref until seal
+        e->creator_pid = (uint32_t)getpid();
         e->lru_tick = ++hdr(*h)->lru_clock;
         hdr(*h)->num_objects++;
         result = (int64_t)off;
@@ -498,12 +505,44 @@ int rtpu_store_release(int hi, const uint8_t* id) {
   Entry* e = find_entry(*h, id, false);
   if (e && (e->state == kSealed || e->state == kPendingDelete)) {
     if (e->refcount > 0) e->refcount--;
+    // the creator releasing retires its tracked pin: reclaim_dead must
+    // not double-drop it later
+    if (e->creator_pid == (uint32_t)getpid()) e->creator_pid = 0;
     if (e->state == kPendingDelete && e->refcount == 0)
       delete_entry(*h, e);  // last reader gone: reclaim the block
     rc = 0;
   }
   unlock(*h);
   return rc;
+}
+
+// drop pins held by processes that died without releasing (SIGKILL mid-
+// churn): any entry still tracking a creator pin whose pid is gone loses
+// that ONE pin; refcount-0 results become evictable (or are freed when
+// pending delete).  Returns pins reclaimed.  kAllocated orphans are
+// already reclaimed lazily by rtpu_store_alloc.
+int64_t rtpu_store_reclaim_dead(int hi) {
+  Handle* h = get_handle(hi);
+  if (!h) return -EBADF;
+  if (lock(*h) != 0) return -EDEADLK;
+  Header* H = hdr(*h);
+  Entry* tab = table(*h);
+  int64_t reclaimed = 0;
+  for (uint64_t i = 0; i < H->table_capacity; i++) {
+    Entry* e = &tab[i];
+    if (e->creator_pid == 0) continue;
+    if (e->state != kSealed && e->state != kPendingDelete) continue;
+    if (kill((pid_t)e->creator_pid, 0) == 0 || errno != ESRCH) continue;
+    e->creator_pid = 0;
+    if (e->refcount > 0) {
+      e->refcount--;
+      reclaimed++;
+    }
+    if (e->state == kPendingDelete && e->refcount == 0)
+      delete_entry(*h, e);
+  }
+  unlock(*h);
+  return reclaimed;
 }
 
 int rtpu_store_contains(int hi, const uint8_t* id) {

@@ -75,9 +75,18 @@ _FLAG_DEFS: Dict[str, Any] = {
     # --- task/actor fault tolerance ---
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,
+    # how long a caller waits for an actor to leave PENDING_CREATION —
+    # creation bursts spawn worker processes serially, so scale this with
+    # expected burst size (reference: actor creation has no client-side
+    # deadline at all)
+    "actor_resolve_timeout_s": 300.0,
     # --- GCS ---
     "gcs_storage": "memory",  # "memory" | "file" (persistence for FT)
     "gcs_storage_path": "",
+    # --- logging ---
+    # worker output files are truncated in place once they exceed this
+    # (drained by the raylet log monitor first); 0 disables rotation
+    "log_rotation_bytes": 100 * 1024 * 1024,
     # --- object transfer (pull/push managers, object_manager.h:106) ---
     "transfer_chunk_bytes": 8 * 1024 * 1024,
     "transfer_window_chunks": 4,

@@ -37,7 +37,7 @@ _READONLY_HANDLERS = frozenset({
     "get_named_actor", "list_named_actors", "list_actors",
     "wait_placement_group_ready", "get_placement_group",
     "list_placement_groups", "subscribe", "cluster_resources",
-    "available_resources",
+    "available_resources", "publish_logs", "tail_logs", "job_logs_delta",
 })
 
 # kv values at or above this size are persisted as individual
@@ -64,11 +64,17 @@ class GcsServer:
         self._job_counter = 0
         self._raylet_clients: Dict[str, RpcClient] = {}
         self._actor_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self._actor_scheduling_inflight: set = set()
         self._pg_waiters: Dict[bytes, List[asyncio.Future]] = {}
         self._pending_actors: List[bytes] = []
         self._pending_pgs: List[bytes] = []
         self._events: List[Dict[str, Any]] = []  # pubsub feed with seq numbers
         self._event_base = 0  # absolute seq of _events[0] (snapshot truncation)
+        self._log_lines: List[Dict[str, Any]] = []  # worker log feed (ring)
+        self._log_base = 0
+        self._log_line_count = 0
+        self._log_waiters: List[asyncio.Future] = []
+        self._last_log_poll = 0.0  # drives heartbeat "logs_wanted"
         self.task_events: List[Dict[str, Any]] = []  # task profile feed
         self._event_waiters: List[asyncio.Future] = []
         self._tasks: List[asyncio.Task] = []
@@ -387,7 +393,10 @@ class GcsServer:
         if freed:
             self._dirty = True  # `available` is snapshot-persisted
             self._kick_pending()
-        return {"nodes": self._cluster_view()}
+        return {"nodes": self._cluster_view(),
+                # raylets tail+publish worker logs only while a driver is
+                # actually polling the feed (cost gate)
+                "logs_wanted": time.time() - self._last_log_poll < 60.0}
 
     def _cluster_view(self) -> List[Dict[str, Any]]:
         return [
@@ -420,6 +429,16 @@ class GcsServer:
         node["alive"] = False
         node["death_reason"] = reason
         self._publish("nodes", {"event": "node_dead", "node_id": node_id, "reason": reason})
+        # fail the dead node's RPC client so UNTIMED calls parked on it
+        # (actor lease requests) raise now — a raylet that stalls without
+        # a TCP disconnect would otherwise wedge its in-flight schedules
+        # behind the single-flight guard forever
+        client = self._raylet_clients.pop(node["addr"], None)
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001
+                pass
         # restart or fail actors that lived there
         for actor_id, info in list(self.actors.items()):
             if info.get("node_id") == node_id and info["state"] == "ALIVE":
@@ -510,6 +529,10 @@ class GcsServer:
     async def handle_job_logs(self, submission_id: str) -> str:
         return self.job_manager.logs(submission_id)
 
+    async def handle_job_logs_delta(self, submission_id: str,
+                                    log_offset: int = 0) -> Dict[str, Any]:
+        return self.job_manager.logs_delta(submission_id, log_offset)
+
     async def handle_stop_job(self, submission_id: str) -> bool:
         return await self.job_manager.stop(submission_id)
 
@@ -553,6 +576,19 @@ class GcsServer:
         info = self.actors.get(actor_id)
         if info is None or info["state"] == "DEAD":
             return
+        # single-flight: a retry kick must not stack a second lease request
+        # while one is already waiting in a raylet's queue (each abandoned
+        # request would eventually be granted a worker nobody owns)
+        inflight = self._actor_scheduling_inflight
+        if actor_id in inflight:
+            return
+        inflight.add(actor_id)
+        try:
+            await self._schedule_actor_inner(actor_id, info)
+        finally:
+            inflight.discard(actor_id)
+
+    async def _schedule_actor_inner(self, actor_id: bytes, info):
         spec = serialization.loads(info["spec"])
         demand = ResourceSet(spec.resources)
         strategy = spec.scheduling_strategy
@@ -583,6 +619,12 @@ class GcsServer:
                 self._pending_actors.append(actor_id)
             return
         try:
+            # NO client timeout on the lease: under a creation burst the
+            # worker pool spawns serially, and a timed-out call would leave
+            # its raylet-side waiter alive — the eventual grant leases a
+            # worker to a ghost and the retry requests yet another (the
+            # round-2 actor-burst snowball).  Raylet death still fails the
+            # call via disconnect.
             lease = await raylet.call(
                 "lease_worker",
                 resources=spec.resources,
@@ -594,7 +636,7 @@ class GcsServer:
                 bundle_index=strategy.bundle_index,
                 owner_addr="gcs",
                 dedicated=True,
-                timeout=config.worker_lease_timeout_s * 4,
+                timeout=None,
             )
             if "spillback" in lease:
                 # stale view; retry via pending queue
@@ -861,6 +903,59 @@ class GcsServer:
         return True
 
     # ----------------------------------------------------------------- pubsub
+
+    # ----------------------------------------------------------- log feed
+    # Reference: log_monitor.py tails worker files and publishes lines to
+    # a GCS pubsub channel the driver subscribes to.  A DEDICATED ring
+    # (not the persisted event feed) so log volume never bloats snapshots.
+
+    _LOG_RING_MAX_LINES = 100_000  # bound by LINES, not batches: one
+    # entry can carry 500 x 4000-char lines, so an entry-count cap would
+    # let the ring grow unbounded under chatty workers
+
+    async def handle_publish_logs(self, node: str, pid: int,
+                                  lines: List[str]) -> bool:
+        self._log_lines.append({"node": node, "pid": pid, "lines": lines})
+        self._log_line_count += len(lines)
+        while (self._log_line_count > self._LOG_RING_MAX_LINES
+               and len(self._log_lines) > 1):
+            dropped = self._log_lines.pop(0)
+            self._log_line_count -= len(dropped["lines"])
+            self._log_base += 1
+        for w in self._log_waiters:
+            if not w.done():
+                w.set_result(None)
+        self._log_waiters.clear()
+        return True
+
+    async def handle_tail_logs(self, cursor: int = -1,
+                               poll_s: float = 20.0) -> Dict:
+        """Long-poll the log feed.  cursor=-1 starts at the current end
+        (a driver attaching late doesn't replay history).
+
+        KNOWN LIMITATION vs the reference: entries carry (node, pid) but
+        no job id — on a SHARED cluster every tailing driver sees every
+        worker's output (the reference's log_monitor filters by job).
+        Job attribution needs worker-side cooperation (a worker serves
+        tasks of many jobs over its lifetime); planned follow-up."""
+        self._last_log_poll = time.time()
+        if cursor < 0:
+            cursor = self._log_base + len(self._log_lines)
+        deadline = asyncio.get_event_loop().time() + poll_s
+        while True:
+            start = max(0, cursor - self._log_base)
+            batch = self._log_lines[start:]
+            if batch or asyncio.get_event_loop().time() >= deadline:
+                return {"entries": batch,
+                        "cursor": self._log_base + len(self._log_lines)}
+            fut = asyncio.get_event_loop().create_future()
+            self._log_waiters.append(fut)
+            try:
+                await asyncio.wait_for(
+                    fut,
+                    max(0.01, deadline - asyncio.get_event_loop().time()))
+            except asyncio.TimeoutError:
+                pass
 
     async def handle_subscribe(self, cursor: int = 0, channel: Optional[str] = None,
                                timeout: float = 30.0) -> Dict:

@@ -112,6 +112,22 @@ class JobManager:
         except OSError:
             return ""
 
+    def logs_delta(self, submission_id: str, offset: int,
+                   max_bytes: int = 1 << 20) -> Dict[str, Any]:
+        """Forward read from a byte offset (the `--follow` delta path —
+        refetching the whole file every poll would be quadratic).  Returns
+        ``{"text", "next"}`` with the EXACT next byte offset, so decoding
+        replacements can't drift the cursor."""
+        path = self._log_path(submission_id)
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                raw = f.read(max_bytes)
+        except OSError:
+            return {"text": "", "next": offset}
+        return {"text": raw.decode("utf-8", "replace"),
+                "next": offset + len(raw)}
+
     async def stop(self, submission_id: str) -> bool:
         info = self._jobs.get(submission_id)
         proc = self._procs.get(submission_id)

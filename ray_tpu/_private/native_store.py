@@ -50,6 +50,8 @@ def _load_lib():
         lib.rtpu_store_alloc.restype = ctypes.c_int64
         lib.rtpu_store_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.rtpu_store_seal.restype = ctypes.c_int
+        lib.rtpu_store_reclaim_dead.argtypes = [ctypes.c_int]
+        lib.rtpu_store_reclaim_dead.restype = ctypes.c_int64
         lib.rtpu_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                        ctypes.POINTER(ctypes.c_uint64)]
         lib.rtpu_store_get.restype = ctypes.c_int64
@@ -126,6 +128,12 @@ class NativeArenaStore:
         oid = object_id.binary()
         off = self._lib.rtpu_store_alloc(self._h, oid, nbytes,
                                          1 if no_evict else 0)
+        if off == -12:  # ENOMEM: pins leaked by SIGKILLed processes may
+            # be the pressure — reclaim and retry once (the daemon-less
+            # equivalent of plasma's client-disconnect cleanup)
+            if self.reclaim_dead() > 0:
+                off = self._lib.rtpu_store_alloc(self._h, oid, nbytes,
+                                                 1 if no_evict else 0)
         if off == -17:  # EEXIST
             # idempotent only if the existing entry is actually readable
             # (a pending-delete entry is invisible — let the caller fall
@@ -207,6 +215,10 @@ class NativeArenaStore:
 
     def release(self, object_id: ObjectID):
         self._lib.rtpu_store_release(self._h, object_id.binary())
+
+    def reclaim_dead(self) -> int:
+        """Drop pins leaked by dead processes; returns pins reclaimed."""
+        return max(0, int(self._lib.rtpu_store_reclaim_dead(self._h)))
 
     def delete(self, object_id: ObjectID):
         self._lib.rtpu_store_delete(self._h, object_id.binary())

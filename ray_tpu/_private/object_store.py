@@ -405,6 +405,12 @@ class HybridObjectStore:
         from the arena to make room.  Returns objects spilled."""
         if self.arena is None or self.spill is None:
             return 0
+        # pins leaked by SIGKILLed workers would otherwise hold their
+        # blocks forever (and hide them from evictable())
+        try:
+            self.arena.reclaim_dead()
+        except Exception:  # noqa: BLE001
+            pass
         spilled = 0
         # drain ALL candidates (multiple rounds): anything left evictable
         # when the caller retries with destructive eviction would be lost

@@ -1,0 +1,32 @@
+"""Process teardown helpers shared by the raylet, launcher, and tests."""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def sigkill_tree(pid: int, reap: bool = False) -> None:
+    """SIGKILL a process group (fallback: the pid alone).
+
+    ``reap=True`` additionally waits it out when it is OUR child — a
+    zombie would still look alive to ``kill(pid, 0)`` (launch and
+    teardown in one process, e.g. the launcher's tests).
+    """
+    try:
+        os.killpg(pid, 9)
+    except Exception:  # noqa: BLE001 - not a group leader / gone / EPERM
+        try:
+            os.kill(pid, 9)
+        except (ProcessLookupError, PermissionError):
+            pass
+    if not reap:
+        return
+    try:
+        for _ in range(50):
+            done, _status = os.waitpid(pid, os.WNOHANG)
+            if done:
+                break
+            time.sleep(0.1)
+    except ChildProcessError:
+        pass

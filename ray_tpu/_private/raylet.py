@@ -89,6 +89,13 @@ class Raylet:
         self._push_limiter = PushLimiter()
         self._puller = None
         self._transfer_clients: Dict[str, RpcClient] = {}
+        # pid -> {path, off, buf, gone_ticks}: files the log monitor tails
+        self._worker_logs: Dict[int, Dict[str, Any]] = {}
+        # standalone raylet procs set this to exit after shutdown_node
+        self.on_shutdown = None
+        # set from heartbeat replies: publish worker logs only while some
+        # driver is actually tailing the feed
+        self._logs_wanted = False
 
         self.server.register_all(self)
 
@@ -109,6 +116,7 @@ class Raylet:
         )
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reaper_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_monitor_loop()))
         if config.memory_monitor_refresh_ms > 0:
             from ray_tpu._private.memory_monitor import MemoryMonitor
 
@@ -126,6 +134,7 @@ class Raylet:
         # (src/ray/common/ray_syncer/ray_syncer.h:83) — periodic usage sync,
         # with the GCS returning the aggregated cluster view.
         period = config.health_check_period_s / 5.0
+        hb_failures = 0
         while not self._stopping:
             try:
                 reply = await self.gcs.call(
@@ -139,6 +148,8 @@ class Raylet:
                              list(self._lease_waiters)[:100]],
                     stats=self._node_stats(),
                 )
+                hb_failures = 0
+                self._logs_wanted = bool(reply.get("logs_wanted"))
                 self.cluster_view = reply.get("nodes", [])
                 if reply.get("unknown"):
                     # GCS restarted without our registration: re-attach
@@ -148,7 +159,21 @@ class Raylet:
                         addr=self.addr, resources=self.total.to_dict(),
                         labels=self.labels, node_name=self.node_name)
             except Exception as e:  # noqa: BLE001
-                logger.debug("heartbeat failed: %s", e)
+                hb_failures += 1
+                logger.debug("heartbeat failed (%d in a row): %s",
+                             hb_failures, e)
+                # a STANDALONE raylet whose control plane is gone for good
+                # must die with it, or a crashed head orphans worker
+                # raylets (and their workers) forever — the launcher's
+                # `down` can't reach what it has no record of.  ~60 s of
+                # consecutive failures ≈ well past any GCS restart window.
+                if (self.on_shutdown is not None
+                        and hb_failures * period > 60.0):
+                    logger.error("gcs unreachable for %.0fs: shutting "
+                                 "down this node", hb_failures * period)
+                    await self.stop()
+                    self.on_shutdown()
+                    return
             await asyncio.sleep(period)
 
     def _node_stats(self) -> dict:
@@ -171,6 +196,73 @@ class Raylet:
             "load1": round(load1, 2),
             "workers": len(self.workers),
         }
+
+    # ------------------------------------------------- per-node agent API
+    # The dashboard proxies these per node (reference: dashboard/agent.py
+    # node-local endpoints for stats/logs/profiling).
+
+    async def handle_agent_stats(self) -> Dict[str, Any]:
+        """Deep node stats: cpu%, per-worker RSS, accelerator presence."""
+        stats = self._node_stats()
+        stats["cpu_percent"] = self._cpu_percent()
+        per_worker = []
+        for h in list(self.workers.values()):
+            rss = 0
+            try:
+                with open(f"/proc/{h.pid}/statm") as f:
+                    rss = int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+            except (OSError, IndexError, ValueError):
+                pass
+            per_worker.append({"pid": h.pid,
+                               "worker_id": h.worker_id.hex()[:12],
+                               "rss_mb": round(rss / 1024**2, 1),
+                               "leased": h.lease is not None})
+        stats["worker_procs"] = per_worker
+        try:
+            stats["accelerators"] = sorted(
+                d for d in os.listdir("/dev") if d.startswith("accel"))
+        except OSError:
+            stats["accelerators"] = []
+        stats["node_id"] = self.node_id
+        return stats
+
+    def _cpu_percent(self) -> float:
+        """System CPU utilization since the previous call (/proc/stat)."""
+        try:
+            with open("/proc/stat") as f:
+                parts = f.readline().split()[1:8]
+            vals = list(map(int, parts))
+        except (OSError, ValueError):
+            return 0.0
+        idle, total = vals[3] + vals[4], sum(vals)
+        prev = getattr(self, "_cpu_prev", None)
+        self._cpu_prev = (idle, total)
+        if prev is None or total == prev[1]:
+            return 0.0
+        didle, dtotal = idle - prev[0], total - prev[1]
+        return round(100.0 * (1.0 - didle / max(dtotal, 1)), 1)
+
+    async def handle_agent_list_logs(self) -> List[str]:
+        log_dir = os.path.join(self.session_dir, "logs")
+        try:
+            return sorted(os.listdir(log_dir))
+        except OSError:
+            return []
+
+    async def handle_agent_read_log(self, name: str,
+                                    tail_bytes: int = 65536) -> str:
+        log_dir = os.path.realpath(os.path.join(self.session_dir, "logs"))
+        path = os.path.realpath(os.path.join(log_dir, name))
+        if not path.startswith(log_dir + os.sep) or not os.path.isfile(path):
+            return ""
+        tail_bytes = max(0, min(int(tail_bytes), 4 * 1024 * 1024))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - tail_bytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
 
     async def _reaper_loop(self):
         while not self._stopping:
@@ -283,7 +375,8 @@ class Raylet:
         )
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"worker-{time.time_ns()}.log"), "ab")
+        log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
+        out = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
             env=env,
@@ -292,7 +385,94 @@ class Raylet:
             start_new_session=True,
         )
         self._spawned_procs[proc.pid] = proc
+        # the log monitor tails this file and streams new lines to the
+        # driver via the GCS log feed (reference log_monitor.py)
+        self._worker_logs[proc.pid] = {"path": log_path, "off": 0,
+                                       "buf": b"", "gone_ticks": 0}
         return proc
+
+    async def _log_monitor_loop(self):
+        """Tail every worker's output file; push new complete lines to the
+        GCS log feed so the driver can print them with (pid=, node=)
+        prefixes.  Reference: ``python/ray/_private/log_monitor.py`` (a
+        per-node monitor publishing via GCS pubsub).
+
+        Rotation: once a file exceeds ``log_rotation_bytes`` it is
+        truncated in place after draining (the worker writes with
+        O_APPEND, which continues at the new end) — bounded disk, with a
+        tiny copytruncate-style loss window.
+        """
+        max_batch = 500
+        max_line = 4000
+        rotate_at = int(config.log_rotation_bytes)
+        while not self._stopping:
+            await asyncio.sleep(0.3)
+            for pid, st in list(self._worker_logs.items()):
+                try:
+                    size = os.path.getsize(st["path"])
+                except OSError:
+                    self._worker_logs.pop(pid, None)
+                    continue
+                if not self._logs_wanted:
+                    # nobody is tailing: skip the read entirely and jump
+                    # the cursor so a late consumer starts at fresh output
+                    # instead of replaying a huge backlog
+                    st["off"] = size
+                    st["buf"] = b""
+                    continue
+                lines: List[str] = []
+                if size > st["off"]:
+                    try:
+                        with open(st["path"], "rb") as f:
+                            f.seek(st["off"])
+                            chunk = f.read(1 << 20)
+                    except OSError:
+                        continue
+                    st["off"] += len(chunk)
+                    data = st["buf"] + chunk
+                    parts = data.split(b"\n")
+                    st["buf"] = parts.pop()  # trailing partial line
+                    lines = [p.decode("utf-8", "replace")[:max_line]
+                             for p in parts]
+                if lines:
+                    for i in range(0, len(lines), max_batch):
+                        try:
+                            await self.gcs.call(
+                                "publish_logs", node=self.node_id,
+                                pid=pid, lines=lines[i:i + max_batch])
+                        except Exception:  # noqa: BLE001 - gcs hiccup
+                            break
+                # rotate only once fully drained: truncating with unread
+                # backlog (a worker outpacing the 1 MiB/tick read cap)
+                # would silently discard it
+                if rotate_at > 0 and st["off"] >= rotate_at \
+                        and st["off"] >= size:
+                    try:
+                        os.truncate(st["path"], 0)
+                        st["off"] = 0
+                    except OSError:
+                        pass
+                # drop entries for dead workers once fully drained
+                alive = True
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    alive = False
+                if not alive and not lines:
+                    st["gone_ticks"] += 1
+                    if st["gone_ticks"] >= 3:
+                        self._worker_logs.pop(pid, None)
+                        if st["buf"]:
+                            # a crash's final unterminated line is the most
+                            # diagnostic output — flush it
+                            try:
+                                await self.gcs.call(
+                                    "publish_logs", node=self.node_id,
+                                    pid=pid,
+                                    lines=[st["buf"].decode(
+                                        "utf-8", "replace")[:max_line]])
+                            except Exception:  # noqa: BLE001
+                                pass
 
     async def handle_register_worker(self, worker_id: bytes, addr: str, pid: int) -> Dict:
         h = WorkerHandle(worker_id, addr, pid, self._spawned_procs.get(pid))
@@ -486,7 +666,13 @@ class Raylet:
                     self._lease_waiters.rotate(-1)
                     continue
                 if not self.idle:
-                    can_start = (len(self.workers) + self._starting) < self._max_workers()
+                    # _max_workers bounds the REUSABLE task-worker pool;
+                    # dedicated (actor) workers are one-per-actor and gated
+                    # by resource accounting instead — a CPU-derived cap
+                    # would silently stall the 65th zero-cpu actor forever
+                    can_start = dedicated or (
+                        (len(self.workers) + self._starting)
+                        < self._max_workers())
                     if self._starting < config.maximum_startup_concurrency and can_start:
                         self._start_worker()
                     self._lease_waiters.rotate(-1)
@@ -661,15 +847,33 @@ class Raylet:
         return True
 
     async def handle_shutdown_node(self) -> bool:
-        asyncio.ensure_future(self.stop())
+        async def _stop_then_exit():
+            await self.stop()
+            # standalone raylet processes (raylet_proc) exit with the node;
+            # an embedded head raylet leaves loop lifetime to the GCS
+            if self.on_shutdown is not None:
+                self.on_shutdown()
+
+        asyncio.ensure_future(_stop_then_exit())
         return True
 
     async def stop(self):
         self._stopping = True
         for t in self._tasks:
             t.cancel()
-        for h in list(self.workers.values()):
-            await self._kill_worker(h)
+        # node teardown: SIGKILL straight away and in bulk — a graceful
+        # exit RPC per worker (1 s timeout each, serial) would outlive the
+        # 3 s shutdown budget at ~4 workers and orphan the rest of a
+        # 100-actor fleet when the head is then hard-killed.  Includes
+        # workers still mid-spawn (not yet registered).
+        from ray_tpu._private.process_utils import sigkill_tree
+
+        pids = {h.pid for h in self.workers.values() if h.pid}
+        pids |= set(self._spawned_procs)
+        self.workers.clear()
+        self._spawned_procs.clear()
+        for pid in pids:
+            sigkill_tree(pid)
         try:
             await self.gcs.call("unregister_node", node_id=self.node_id)
         except Exception:

@@ -39,6 +39,9 @@ def main():
         labels=json.loads(args.labels),
         node_name=args.node_name,
     )
+    # a cluster-wide shutdown_node must end this PROCESS, not just the
+    # raylet object (the launcher's `down` relies on it)
+    raylet.on_shutdown = lambda: loop.call_later(0.2, loop.stop)
     loop.run_until_complete(raylet.start())
     # readiness marker for the parent
     marker = os.path.join(args.session_dir, f"raylet_{raylet.node_id[:12]}.ready")

@@ -142,6 +142,8 @@ class ReferenceCounter:
 
     def add_borrower(self, oid: ObjectID, addr: str):
         rec = self._rec(oid)
+        if addr in rec.borrowers:
+            return  # duplicate (reply-carried + async registration)
         rec.borrowers.add(addr)
         # a registration also retires one transfer pin (the receiver
         # landed) — the EARLIEST-expiring one, so the longest remaining

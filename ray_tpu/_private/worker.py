@@ -59,6 +59,11 @@ from ray_tpu._private.task_spec import TaskSpec, TaskType
 logger = logging.getLogger(__name__)
 
 
+def _hold_refs(refs):
+    """No-op whose bound args keep ObjectRefs alive until it fires (the
+    reply-borrow grace hold in _package_returns)."""
+
+
 class WorkerMode(enum.Enum):
     DRIVER = 0
     WORKER = 1
@@ -193,6 +198,9 @@ class CoreWorker:
         # cancel can never be injected into the NEXT task on the thread
         self._inject_lock = threading.Lock()
 
+        # executor-side: refs deserialized from each running task's args,
+        # reported as borrows in the task reply (see _resolve_args)
+        self._task_arg_borrows: Dict[TaskID, list] = {}
         # owner-side streaming generator state (streaming.py)
         self._streams: Dict[TaskID, StreamState] = {}
         self._stream_received: Dict[TaskID, set] = {}
@@ -281,14 +289,26 @@ class CoreWorker:
     async def _ref_lifetime_loop(self):
         """Periodic lifetime work: drain ref events, expire transfer pins,
         probe borrower liveness (a dead borrower must not pin forever —
-        reference: borrower failure handling in reference_count.cc)."""
+        reference: borrower failure handling in reference_count.cc).
+
+        Adaptive cadence: the 50 ms tick only while events are flowing.
+        An IDLE worker backs off to 500 ms — at 1,000 workers per host the
+        constant tick alone was measured saturating the CPU (the envelope
+        benchmark's 1k-actor section), and idle GC latency is not worth
+        20 wakeups/s per process.
+        """
         drain_every = config.ref_event_drain_interval_s
         probe_every = config.borrower_liveness_interval_s
+        idle_max = max(drain_every, 0.5)
+        interval = drain_every
         last_sweep = last_probe = time.time()
         while not self._shutdown:
-            await asyncio.sleep(drain_every)
+            await asyncio.sleep(interval)
             try:
+                had_events = bool(self._ref_events)
                 self._drain_ref_events()
+                interval = drain_every if had_events else min(
+                    interval * 2, idle_max)
                 now = time.time()
                 if now - last_sweep > 5.0:
                     last_sweep = now
@@ -913,13 +933,32 @@ class CoreWorker:
 
     # ------------------------------------------------------------------- wait
 
+    async def _resolve_ready(self, ref: ObjectRef):
+        """Readiness WITHOUT pulling the payload (``wait(...,
+        fetch_local=False)`` — reference semantics: the object exists
+        somewhere in the cluster).  Owned refs await the local location
+        record; borrowed refs fall back to a full fetch (their owner
+        serves the payload in the same round trip anyway)."""
+        oid = ref.id
+        if self.memory_store.contains(oid) or oid in self._locations:
+            return True
+        if not ref.owner_addr or ref.owner_addr == self.serve_addr:
+            if oid in self._result_futures:
+                await asyncio.shield(self._result_futures[oid])
+                return True
+            await self._wait_local_location(oid)
+            return True
+        return await self._resolve_payload(ref)
+
     def wait(self, refs: List[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None,
              fetch_local: bool = True):
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
+        resolver = self._resolve_payload if fetch_local else \
+            self._resolve_ready
 
         async def _wait():
-            pending = {asyncio.ensure_future(self._resolve_payload(r)): r for r in refs}
+            pending = {asyncio.ensure_future(resolver(r)): r for r in refs}
             ready: List[ObjectRef] = []
             deadline = None if timeout is None else self.loop.time() + timeout
             while pending and len(ready) < num_returns:
@@ -1187,6 +1226,20 @@ class CoreWorker:
             self._inflight_specs.pop(oid, None)
 
     def _apply_task_reply(self, spec: TaskSpec, reply: Dict):
+        # reply-carried borrows register BEFORE the pending-arg holds drop
+        # (reference: borrow records piggy-backed on the task reply) — the
+        # executor's own async registration can lose the race against a
+        # submitter that deletes its ref the moment the reply lands
+        addr = reply.get("borrower_addr")
+        if addr:
+            for item in reply.get("borrows", []):
+                boid, owner = ObjectID(item[0]), item[1]
+                if not owner or owner == self.serve_addr:
+                    self.ref_counter.add_borrower(boid, addr)
+                else:
+                    self._notify_owner(owner, {"method": "add_borrower",
+                                               "oid": boid.binary(),
+                                               "addr": addr})
         self._task_done_cleanup(spec)
         self._drain_ref_events()  # counts current before liveness decision
         if spec.num_returns == STREAMING_RETURNS:
@@ -1238,7 +1291,10 @@ class CoreWorker:
 
     # ------------------------------------------------------------ actor submit
 
-    async def resolve_actor_addr(self, actor_id: ActorID, timeout: float = 300.0) -> str:
+    async def resolve_actor_addr(self, actor_id: ActorID,
+                                 timeout: Optional[float] = None) -> str:
+        if timeout is None:
+            timeout = float(config.actor_resolve_timeout_s)
         addr = self._actor_addr_cache.get(actor_id)
         if addr:
             return addr
@@ -1404,17 +1460,26 @@ class CoreWorker:
 
     async def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         args: List[Any] = []
+        arg_refs: List[ObjectRef] = []
         for a in spec.args:
             if a.is_ref:
                 args.append(await self._resolve_value_maybe_error(a.payload))
             else:
-                value, _ = serialization.deserialize(a.payload)
+                value, rs = serialization.deserialize(a.payload)
+                arg_refs.extend(rs)
                 args.append(value)
         kwargs = {}
         if spec.kwargs_keys:
             n = len(spec.kwargs_keys)
             kwargs = dict(zip(spec.kwargs_keys, args[-n:]))
             args = args[:-n]
+        # refs deserialized from inline arg values: reported back IN the
+        # task reply (reference: borrows piggy-backed on the reply) so the
+        # owner hears about this borrower synchronously, BEFORE the
+        # submitter's pending-arg hold is released — the async
+        # registration alone races a submitter that drops its own ref the
+        # moment the reply lands
+        self._task_arg_borrows[spec.task_id] = arg_refs
         return args, kwargs
 
     async def _resolve_value_maybe_error(self, ref: ObjectRef):
@@ -1607,6 +1672,35 @@ class CoreWorker:
             "node_id": self.node_id,
         })
 
+    def start_log_streaming(self):
+        """Driver-side: stream worker stdout/stderr lines from the GCS log
+        feed to this process's stdout with ``(pid=, node=)`` prefixes —
+        a ``print`` inside a task shows up at the driver (reference:
+        ``log_monitor.py`` + worker.py print_logs)."""
+        self.loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(self._log_stream_loop()))
+
+    async def _log_stream_loop(self):
+        import sys
+
+        cursor = -1
+        while not self._shutdown:
+            try:
+                out = await self.gcs.call("tail_logs", cursor=cursor,
+                                          poll_s=20.0, timeout=30.0)
+            except asyncio.TimeoutError:
+                continue
+            except Exception:  # noqa: BLE001 - gcs restart window
+                await asyncio.sleep(1.0)
+                continue
+            cursor = out["cursor"]
+            for entry in out.get("entries", []):
+                prefix = (f"(pid={entry['pid']}, "
+                          f"node={entry['node'][:8]})")
+                for line in entry["lines"]:
+                    print(f"{prefix} {line}", file=sys.stdout, flush=False)
+            sys.stdout.flush()
+
     async def _flush_task_events_loop(self):
         while True:
             await asyncio.sleep(2.0)
@@ -1669,7 +1763,23 @@ class CoreWorker:
                                   r.owner_addr or self.serve_addr]
                                  for r in refs]
             returns.append(entry)
-        return {"returns": returns}
+        reply: Dict[str, Any] = {"returns": returns}
+        # borrows piggy-backed on the reply (reference reply-carried
+        # borrow records): refs this process deserialized from the task's
+        # args and still holds — the submitter registers them with their
+        # owners BEFORE dropping its pending-arg hold
+        borrows = self._task_arg_borrows.pop(spec.task_id, None)
+        if borrows:
+            reply["borrows"] = [[r.id.binary(),
+                                 r.owner_addr or self.serve_addr]
+                                for r in borrows]
+            reply["borrower_addr"] = self.serve_addr
+            # keep the ref objects alive briefly past the reply: if the
+            # task did NOT retain them, their remove_borrower must never
+            # outrun the reply-carried add at the owner
+            self.loop.call_soon_threadsafe(
+                self.loop.call_later, 5.0, _hold_refs, borrows)
+        return reply
 
     # actor execution ---------------------------------------------------------
 

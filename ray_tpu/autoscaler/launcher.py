@@ -1,0 +1,289 @@
+"""Cluster launcher: stand a cluster up (and down) from a YAML config.
+
+Reference: ``ray up / ray down`` (``python/ray/scripts/scripts.py:706``,
+``python/ray/autoscaler/_private/commands.py`` get_or_create_head_node /
+teardown_cluster).  TPU-native shape: the head is a local head process,
+workers come from a ``NodeProvider`` (subprocess raylets for tests /
+single-host pods, ``TPUSliceProvider`` for pod slices), and the
+autoscaler's reconcile loop runs in the launcher-started monitor to keep
+``min_workers``..``max_workers`` satisfied.
+
+Config schema (YAML or JSON)::
+
+    cluster_name: demo
+    provider:
+      type: subprocess          # | tpu_slice
+    head:
+      resources: {CPU: 4}
+      labels: {role: head}
+    worker_types:
+      default:
+        resources: {CPU: 2}
+        min_workers: 2
+        max_workers: 4
+    idle_timeout_s: 300
+
+State for ``down``/``attach`` lives in ``~/.ray_tpu/clusters/<name>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        text = f.read()
+    try:
+        import yaml
+
+        cfg = yaml.safe_load(text)
+    except ImportError:  # pragma: no cover - yaml is in the image
+        cfg = json.loads(text)
+    if not isinstance(cfg, dict) or "cluster_name" not in cfg:
+        raise ValueError(f"{path}: config must be a mapping with "
+                         f"cluster_name")
+    cfg.setdefault("provider", {"type": "subprocess"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("worker_types", {})
+    return cfg
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(_STATE_DIR, f"{name}.json")
+
+
+def _make_provider(cfg: Dict[str, Any], session_dir: str, gcs_addr: str):
+    kind = cfg["provider"].get("type", "subprocess")
+    if kind == "subprocess":
+        from ray_tpu.autoscaler.node_provider import \
+            LocalSubprocessNodeProvider
+
+        return LocalSubprocessNodeProvider(session_dir, gcs_addr)
+    if kind == "tpu_slice":
+        from ray_tpu.autoscaler.tpu_slice_provider import TPUSliceProvider
+
+        return TPUSliceProvider(session_dir, gcs_addr,
+                                **cfg["provider"].get("options", {}))
+    raise ValueError(f"unknown provider type {kind!r}")
+
+
+def cluster_up(config_path: str, *, no_monitor: bool = False
+               ) -> Dict[str, Any]:
+    """``raytpu up``: head + min_workers + (optionally) the autoscaling
+    monitor.  Idempotent per cluster_name: an existing live cluster is
+    re-used (reference: get_or_create_head_node)."""
+    from ray_tpu._private.node import NodeServices, default_resources
+
+    cfg = load_config(config_path)
+    name = cfg["cluster_name"]
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    state = _load_state(name)
+    if state is not None and _head_alive(state):
+        logger.info("cluster %s already running at %s", name,
+                    state["gcs_addr"])
+        return state
+
+    head_cfg = cfg.get("head", {})
+    resources = default_resources(
+        num_cpus=head_cfg.get("num_cpus"), num_tpus=head_cfg.get("num_tpus", 0))
+    resources.update({k: float(v)
+                      for k, v in (head_cfg.get("resources") or {}).items()})
+    services = NodeServices()
+    gcs_addr = services.start_head(resources, head_cfg.get("labels"))
+    import atexit
+
+    atexit.unregister(services.stop)  # the cluster outlives this command
+
+    state = {
+        "cluster_name": name,
+        "config_path": os.path.abspath(config_path),
+        "gcs_addr": gcs_addr,
+        "head_pid": services.head_proc.pid,
+        "session_dir": services.session_dir,
+        "workers": [],
+        "monitor_pid": None,
+        "started_at": time.time(),
+    }
+
+    # worker ownership: WITH a monitor, the monitor's reconcile loop
+    # brings up (and maintains) min_workers — the launcher starting them
+    # too would double-provision, since the monitor's fresh provider
+    # can't see nodes another process started.  Without a monitor the
+    # launcher provisions min_workers directly, one-shot.
+    worker_pids: List[Dict[str, Any]] = []
+    if no_monitor or not cfg.get("worker_types"):
+        provider = _make_provider(cfg, services.session_dir, gcs_addr)
+        for wtype, wcfg in cfg.get("worker_types", {}).items():
+            for _ in range(int(wcfg.get("min_workers", 0))):
+                pid = provider.create_node(
+                    wtype,
+                    {k: float(v)
+                     for k, v in (wcfg.get("resources") or {}).items()},
+                    dict(wcfg.get("labels") or {}))
+                node = getattr(provider, "_nodes", {}).get(pid, {})
+                proc = node.get("proc")
+                worker_pids.append({"provider_id": pid, "node_type": wtype,
+                                    "pid": getattr(proc, "pid", None)})
+    state["workers"] = worker_pids
+
+    if not no_monitor and cfg.get("worker_types"):
+        state["monitor_pid"] = _spawn_monitor(config_path, state)
+
+    _save_state(name, state)
+    logger.info("cluster %s up: gcs=%s head_pid=%s workers=%d", name,
+                gcs_addr, state["head_pid"], len(worker_pids))
+    return state
+
+
+def cluster_down(config_or_name: str) -> bool:
+    """``raytpu down``: stop monitor, workers, then the head; remove
+    state (reference: teardown_cluster)."""
+    name = config_or_name
+    if os.path.exists(config_or_name):
+        name = load_config(config_or_name)["cluster_name"]
+    state = _load_state(name)
+    if state is None:
+        logger.info("no state for cluster %s", name)
+        return False
+    for pid in filter(None, [state.get("monitor_pid")]):
+        _kill(pid)
+    # graceful: ask the GCS to shut the whole cluster down (kills worker
+    # processes through each raylet), then reap anything left
+    try:
+        import asyncio
+
+        from ray_tpu._private.rpc import RpcClient
+
+        async def _down():
+            c = RpcClient(state["gcs_addr"])
+            try:
+                await asyncio.wait_for(c.call("shutdown_cluster"), 5.0)
+            finally:
+                await c.close()
+
+        asyncio.new_event_loop().run_until_complete(_down())
+        time.sleep(1.0)
+    except Exception:  # noqa: BLE001 - head may already be dead
+        pass
+    for w in state.get("workers", []):
+        if w.get("pid"):
+            _kill(w["pid"])
+    if state.get("head_pid"):
+        _kill(state["head_pid"])
+    try:
+        os.unlink(_state_path(name))
+    except OSError:
+        pass
+    logger.info("cluster %s down", name)
+    return True
+
+
+def cluster_status(name: str) -> Optional[Dict[str, Any]]:
+    state = _load_state(name)
+    if state is None:
+        return None
+    state["head_alive"] = _head_alive(state)
+    return state
+
+
+# ----------------------------------------------------------------- monitor
+
+def _spawn_monitor(config_path: str, state: Dict[str, Any]) -> int:
+    """The autoscaling monitor as a detached process: reconciles
+    min/max/demand via the instance manager until the cluster dies
+    (reference: monitor.py on the head node)."""
+    import subprocess
+    import sys
+
+    log = open(os.path.join(state["session_dir"], "logs", "monitor.log"),
+               "ab")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.autoscaler.launcher",
+             "--monitor", config_path,
+             "--gcs-addr", state["gcs_addr"],
+             "--session-dir", state["session_dir"]],
+            stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+    finally:
+        log.close()
+    return proc.pid
+
+
+def _monitor_main(config_path: str, gcs_addr: str, session_dir: str):
+    from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
+                                               NodeTypeConfig)
+
+    cfg = load_config(config_path)
+    provider = _make_provider(cfg, session_dir, gcs_addr)
+    types = {}
+    for wtype, wcfg in cfg.get("worker_types", {}).items():
+        types[wtype] = NodeTypeConfig(
+            resources={k: float(v)
+                       for k, v in (wcfg.get("resources") or {}).items()},
+            min_workers=int(wcfg.get("min_workers", 0)),
+            max_workers=int(wcfg.get("max_workers", 10)),
+        )
+    auto = Autoscaler(gcs_addr, provider, AutoscalerConfig(
+        node_types=types,
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 300.0))))
+    auto.start()
+    try:
+        while True:
+            time.sleep(5.0)
+    except KeyboardInterrupt:
+        pass
+
+
+# ------------------------------------------------------------------- utils
+
+def _load_state(name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_state_path(name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_state(name: str, state: Dict[str, Any]):
+    tmp = _state_path(name) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, _state_path(name))
+
+
+def _head_alive(state: Dict[str, Any]) -> bool:
+    pid = state.get("head_pid")
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def _kill(pid: int):
+    from ray_tpu._private.process_utils import sigkill_tree
+
+    sigkill_tree(pid, reap=True)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--monitor", required=True)
+    ap.add_argument("--gcs-addr", required=True)
+    ap.add_argument("--session-dir", required=True)
+    a = ap.parse_args()
+    logging.basicConfig(level="INFO")
+    _monitor_main(a.monitor, a.gcs_addr, a.session_dir)

@@ -217,6 +217,43 @@ def build_app(gcs) -> "object":
         return web.Response(text=prometheus_text(_aggregate_metrics()),
                             content_type="text/plain")
 
+    def _raylet_for(node_id: str):
+        node = gcs.nodes.get(node_id)
+        if node is None or not node.get("alive"):
+            return None
+        return gcs._raylet(node_id)
+
+    async def api_node_stats(req):
+        """Per-node agent stats (reference dashboard/agent.py): cpu%,
+        per-worker RSS, accelerators — proxied to that node's raylet."""
+        raylet = _raylet_for(req.match_info["node_id"])
+        if raylet is None:
+            return web.Response(status=404, text="no such live node")
+        try:
+            return jresp(await raylet.call("agent_stats", timeout=10.0))
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=502, text=repr(e))
+
+    async def api_node_logs(req):
+        """Node-local log access, proxied through the node's raylet."""
+        raylet = _raylet_for(req.match_info["node_id"])
+        if raylet is None:
+            return web.Response(status=404, text="no such live node")
+        name = req.query.get("file")
+        try:
+            if not name:
+                files = await raylet.call("agent_list_logs", timeout=10.0)
+                nid = req.match_info["node_id"]
+                return jresp([{"file": f,
+                               "href": f"/api/node/{nid}/logs?file={f}"}
+                              for f in files])
+            tail = int(req.query.get("tail", 65536))
+            text = await raylet.call("agent_read_log", name=name,
+                                     tail_bytes=tail, timeout=10.0)
+            return web.Response(text=text, content_type="text/plain")
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=502, text=repr(e))
+
     async def healthz(_req):
         return jresp({"status": "ok"})
 
@@ -233,6 +270,8 @@ def build_app(gcs) -> "object":
     app.router.add_get("/api/tasks/summary", api_tasks_summary)
     app.router.add_get("/api/timeline", api_timeline)
     app.router.add_get("/api/logs", api_logs)
+    app.router.add_get("/api/node/{node_id}/stats", api_node_stats)
+    app.router.add_get("/api/node/{node_id}/logs", api_node_logs)
     app.router.add_get("/api/metrics", api_metrics)
     app.router.add_get("/metrics", prometheus)
     app.router.add_get("/-/healthz", healthz)

@@ -166,7 +166,11 @@ class DataIterator:
             out = {}
             for k, v in batch.items():
                 if dtypes and k in dtypes:
-                    v = v.astype(dtypes[k])
+                    # copy=False: blocks deserialize as zero-copy views
+                    # over the 64B-aligned shm arena; a matching dtype
+                    # must DMA straight from that mapping, not via a
+                    # silent astype copy
+                    v = v.astype(dtypes[k], copy=False)
                 out[k] = jax.device_put(v, sharding) if sharding is not None \
                     else jax.device_put(v)
             return out

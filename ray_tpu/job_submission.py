@@ -64,6 +64,13 @@ class JobSubmissionClient:
     def get_job_logs(self, submission_id: str) -> str:
         return self._call("job_logs", submission_id=submission_id)
 
+    def poll_job_logs(self, submission_id: str, offset: int = 0):
+        """Delta poll: returns ``(new_text, next_offset)`` reading forward
+        from ``offset`` (for `--follow`; avoids refetching the whole log)."""
+        out = self._call("job_logs_delta", submission_id=submission_id,
+                         log_offset=offset)
+        return out["text"], out["next"]
+
     def stop_job(self, submission_id: str) -> bool:
         return self._call("stop_job", submission_id=submission_id)
 

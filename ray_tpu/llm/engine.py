@@ -84,6 +84,7 @@ class Request:
     # n_prompt remembers the ORIGINAL prompt length so outputs and the
     # max_tokens budget survive any number of preemptions
     n_prompt: int = -1
+    error: Optional[str] = None
 
     def __post_init__(self):
         if self.n_prompt < 0:
@@ -105,6 +106,7 @@ class GenerationOutput:
     prompt_tokens: List[int]
     token_ids: List[int]
     text: Optional[str] = None
+    error: Optional[str] = None  # per-request failure (e.g. pool too small)
 
 
 class _BlockManager:
@@ -213,13 +215,16 @@ class LLMEngine:
             functools.partial(paged_decode_sample, cfg=cfg),
             donate_argnums=(4,))
         self._stack = jax.jit(lambda *ts: jnp.stack(ts))
+        from ray_tpu.models.paged_generation import sample_token_batch
+
         self._prefill = jax.jit(
             functools.partial(prefill_suffix, cfg=cfg),
             donate_argnums=(9,))  # the pool (avoid a full second copy)
-        self._sample = jax.jit(self._sample_impl)
+        self._sample = jax.jit(sample_token_batch)
 
         self._ids = itertools.count()
         self._queue: "collections.deque[Request]" = collections.deque()
+        self._failed: List[Request] = []  # per-request admission failures
         self._slots: List[Optional[Request]] = [None] * self.B
         self._cur_len = np.zeros(self.B, np.int32)
         self._next_token = np.zeros(self.B, np.int32)
@@ -251,7 +256,8 @@ class LLMEngine:
         return req.request_id
 
     def has_unfinished(self) -> bool:
-        return bool(self._queue) or any(s is not None for s in self._slots)
+        return (bool(self._queue) or bool(self._failed)
+                or any(s is not None for s in self._slots))
 
     # -- continuous-batching step ------------------------------------------
 
@@ -325,6 +331,11 @@ class LLMEngine:
 
         # 3. retire
         out = []
+        while self._failed:
+            req = self._failed.pop()
+            out.append(GenerationOutput(
+                req.request_id, req.prompt_tokens[:req.n_prompt], [],
+                text="", error=req.error))
         for i in range(self.B):
             req = self._slots[i]
             if req is not None and req.done:
@@ -397,13 +408,20 @@ class LLMEngine:
         worst = -(-min(req.n_prompt + req.sampling.max_tokens + 1,
                        self.max_len) // self.bs)
         if worst >= self.num_blocks:
-            # even an empty pool could never hold this one sequence: loud
-            # config error beats an admit/preempt/requeue livelock
+            # even an empty pool could never hold this one sequence: fail
+            # THIS request (an admit/preempt livelock otherwise) — never
+            # the whole batch; one oversized HTTP request must not kill
+            # every other in-flight generation
             self._queue.popleft()
-            raise RuntimeError(
+            for bid in hit_blocks:
+                self.blocks.release(bid)
+            req.done = True
+            req.error = (
                 f"KV pool ({self.num_blocks} blocks of {self.bs}) cannot "
                 f"hold one sequence of up to {worst} blocks; raise "
                 f"num_blocks or lower max_tokens")
+            self._failed.append(req)
+            return self._admit(i) if self._queue else None
         if self.blocks.available() < need:
             for bid in hit_blocks:
                 self.blocks.release(bid)
@@ -522,17 +540,6 @@ class LLMEngine:
             if self._slots[i] is not None:
                 temps[i] = self._slots[i].sampling.temperature
         return temps[sl]
-
-    def _sample_impl(self, logits, key, temperature):
-        """Vectorized per-slot temperature; 0 => greedy."""
-        import jax
-        import jax.numpy as jnp
-
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        t = jnp.maximum(temperature, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, logits / t).astype(jnp.int32)
-        return jnp.where(temperature <= 0.0, greedy, sampled)
-
 
 def _bucket(n: int, cap: int) -> int:
     """Smallest power of two >= n (>=1), capped."""

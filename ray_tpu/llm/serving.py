@@ -94,7 +94,10 @@ class LLMServer:
         prompt = body["prompt"]
         sp = SamplingParams(
             temperature=float(body.get("temperature", 0.7)),
-            max_tokens=int(body.get("max_tokens", 64)),
+            # clamp to what the engine can ever hold: an unclamped
+            # client value must fail THIS request at most, not others
+            max_tokens=min(int(body.get("max_tokens", 64)),
+                           self.engine.max_len - 1),
             stop_token_id=self.engine.tokenizer.eos_id)
         slot = {"event": threading.Event(), "output": None}
         with self._lock:
@@ -103,6 +106,8 @@ class LLMServer:
         if not slot["event"].wait(timeout=600):
             raise TimeoutError("generation timed out")
         out = slot["output"]
+        if out.error:
+            raise RuntimeError(out.error)
         return {"generated_text": out.text,
                 "num_generated_tokens": len(out.token_ids)}
 
@@ -121,7 +126,8 @@ class LLMServer:
         prompt = body["prompt"]
         sp = SamplingParams(
             temperature=float(body.get("temperature", 0.7)),
-            max_tokens=int(body.get("max_tokens", 64)),
+            max_tokens=min(int(body.get("max_tokens", 64)),
+                           self.engine.max_len - 1),
             stop_token_id=self.engine.tokenizer.eos_id)
         import time as time_mod
 
@@ -162,6 +168,8 @@ class LLMServer:
                     index += 1
                 emitted = stable
             out = slot["output"]
+            if out.error:
+                raise RuntimeError(out.error)
             tail = out.text[len(emitted):]
             if tail:  # flush any held-back suffix so chunks sum to text
                 yield {"token_id": -1, "text": tail, "index": index}

@@ -160,11 +160,18 @@ def paged_decode_sample(params, token, cur_len, block_tables, pool, key,
     logits, pool = paged_decode_step(params, token, safe_cur, block_tables,
                                      pool, cfg=cfg)
     key, sub = jax.random.split(key)
+    nxt = sample_token_batch(logits, sub, temps)
+    return nxt, cur_len + 1, key, pool
+
+
+def sample_token_batch(logits, key, temps):
+    """Per-slot temperature sampling: greedy for temp<=0, categorical
+    otherwise.  The ONE sampler for both the decode window and batched
+    admission first-tokens (``LLMEngine._sample``)."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(sub, logits / t).astype(jnp.int32)
-    nxt = jnp.where(temps <= 0.0, greedy, sampled)
-    return nxt, cur_len + 1, key, pool
+    sampled = jax.random.categorical(key, logits / t).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
 
 
 def gather_prefix(pool, blocks):

@@ -98,6 +98,29 @@ def cmd_list(args):
     ray_tpu.shutdown()
 
 
+def cmd_up(args):
+    import logging
+
+    logging.basicConfig(level="INFO")
+    from ray_tpu.autoscaler.launcher import cluster_up
+
+    state = cluster_up(args.config, no_monitor=args.no_monitor)
+    print(json.dumps({"cluster_name": state["cluster_name"],
+                      "address": state["gcs_addr"],
+                      "head_pid": state["head_pid"],
+                      "workers": len(state.get("workers", []))}))
+
+
+def cmd_down(args):
+    import logging
+
+    logging.basicConfig(level="INFO")
+    from ray_tpu.autoscaler.launcher import cluster_down
+
+    ok = cluster_down(args.config)
+    print("down" if ok else "no such cluster")
+
+
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -119,7 +142,25 @@ def cmd_job(args):
     elif args.job_command == "status":
         print(json.dumps(client.get_job_info(args.submission_id), default=str))
     elif args.job_command == "logs":
-        print(client.get_job_logs(args.submission_id), end="")
+        if getattr(args, "follow", False):
+            # stream: poll the DELTA (byte offset) until the job
+            # terminates (reference: `ray job logs --follow`)
+            import time as _time
+
+            seen = 0
+            while True:
+                delta, seen = client.poll_job_logs(args.submission_id,
+                                                   offset=seen)
+                if delta:
+                    print(delta, end="", flush=True)
+                done = client.get_job_status(
+                    args.submission_id).is_terminal()
+                if done and not delta:
+                    break
+                if not delta:
+                    _time.sleep(0.5)
+        else:
+            print(client.get_job_logs(args.submission_id), end="")
     elif args.job_command == "stop":
         print(client.stop_job(args.submission_id))
     elif args.job_command == "list":
@@ -174,6 +215,16 @@ def main(argv=None):
     p = sub.add_parser("stop", help="stop the head started on this machine")
     p.set_defaults(fn=cmd_stop)
 
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config", help="cluster yaml/json")
+    p.add_argument("--no-monitor", action="store_true",
+                   help="skip the autoscaling monitor process")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down a launched cluster")
+    p.add_argument("config", help="cluster yaml/json or cluster name")
+    p.set_defaults(fn=cmd_down)
+
     p = sub.add_parser("status", help="show cluster nodes and resources")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_status)
@@ -195,6 +246,9 @@ def main(argv=None):
     for name in ("status", "logs", "stop"):
         pj = jsub.add_parser(name)
         pj.add_argument("submission_id")
+        if name == "logs":
+            pj.add_argument("--follow", action="store_true",
+                            help="stream logs until the job terminates")
     jsub.add_parser("list")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_job)

@@ -1,0 +1,112 @@
+"""Fault tolerance under REAL process death (VERDICT r2 #4a/b).
+
+Round-2 simulated loss with ``internal.free()``; these tests kill actual
+processes: a raylet node holding the only sealed copy of an object
+(lineage reconstruction across the cluster, reference
+``object_recovery_manager.h:43``), and a borrower worker whose death must
+release its holds (``reference_count.cc`` borrower failure handling).
+"""
+
+import gc
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.mark.slow
+def test_node_death_triggers_lineage_reconstruction(no_cluster):
+    """The ONLY sealed copy of a task output lives on a worker node; the
+    node is SIGKILLed; the owner's get() must reconstruct via lineage on
+    a replacement node and return the right value."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        side = cluster.add_node(num_cpus=4, resources={"side": 2})
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote(resources={"side": 1})
+        def produce():
+            return np.arange(2 * 1024 * 1024, dtype=np.uint8) % 251
+
+        ref = produce.remote()
+        # wait for completion WITHOUT pulling the payload to this node —
+        # the only sealed copy must stay on the side node
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60,
+                                fetch_local=False)
+        assert ready
+
+        # SIGKILL the node holding the only copy (real process death)
+        os.kill(side.proc.pid, signal.SIGKILL)
+        side.proc.wait(timeout=10)
+
+        # replacement capacity for the re-execution
+        cluster.add_node(num_cpus=4, resources={"side": 2})
+
+        out = ray_tpu.get(ref, timeout=180)
+        expected = np.arange(2 * 1024 * 1024, dtype=np.uint8) % 251
+        assert np.array_equal(out, expected)
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_borrower_process_death_releases_holds(no_cluster, monkeypatch):
+    """An actor registers as a borrower of a driver-owned object (nested
+    ref in an inline arg); the driver drops its own ref; the object stays
+    alive for the borrower.  SIGKILL the actor's worker process: the
+    owner's liveness probes drop its borrows and the object is freed."""
+    from ray_tpu._private.config import config
+
+    monkeypatch.setitem(config._values, "borrower_liveness_interval_s", 1.5)
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.box = None
+
+        def hold(self, box):
+            self.box = box  # keeps the nested ObjectRef alive in-process
+            return os.getpid()
+
+        def peek(self):
+            return int(ray_tpu.get(self.box["r"])[0])
+
+    payload = np.full(2 * 1024 * 1024, 9, np.uint8)  # > inline: shm object
+    ref = ray_tpu.put(payload)
+    oid = ref.id
+    h = Holder.remote()
+    pid = ray_tpu.get(h.hold.remote({"r": ref}), timeout=60)
+    assert ray_tpu.get(h.peek.remote(), timeout=60) == 9
+
+    # drop the owner's own ref: the borrower alone keeps it alive
+    del ref
+    gc.collect()
+    time.sleep(2.0)
+    w._drain_ref_events()
+    assert w.shared_store.get_buffer(oid) is not None, \
+        "borrower hold did not keep the object alive"
+
+    # real process death: SIGKILL the actor's worker
+    os.kill(pid, signal.SIGKILL)
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if w.shared_store.get_buffer(oid) is None:
+            break
+        time.sleep(0.5)
+    assert w.shared_store.get_buffer(oid) is None, \
+        "dead borrower's holds were never dropped"

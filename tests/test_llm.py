@@ -281,3 +281,26 @@ def test_multi_window_decode_matches(tiny_model):
                         block_size=4, decode_window=K)
         outs = eng.generate(prompts, sp)
         assert [o.token_ids for o in outs] == ref, (K, ref)
+
+
+def test_oversized_request_fails_alone(tiny_model):
+    """A request whose worst-case KV footprint exceeds the whole pool
+    fails with .error set — it must never crash the batch (one bad HTTP
+    body vs every in-flight generation)."""
+    from ray_tpu.llm import LLMEngine
+
+    cfg, params = tiny_model
+    eng = LLMEngine(cfg, params, batch_slots=2, max_len=64, block_size=4,
+                    num_blocks=6)  # ~24 tokens of pool
+    good_sp = SamplingParams(temperature=0.0, max_tokens=4)
+    bad_sp = SamplingParams(temperature=0.0, max_tokens=60)
+    outs = {o.request_id: o
+            for o in eng.generate([[3, 4, 5]], good_sp)}
+    bad = eng.submit([6, 7, 8], bad_sp)
+    good = eng.submit([9, 10, 11], good_sp)
+    while eng.has_unfinished():
+        for o in eng.step():
+            outs[o.request_id] = o
+    assert outs[bad].error and "KV pool" in outs[bad].error
+    assert not outs[bad].token_ids
+    assert outs[good].error is None and len(outs[good].token_ids) == 4

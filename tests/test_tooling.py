@@ -348,3 +348,87 @@ def test_task_events_and_timeline(ray_start, tmp_path):
     assert len(spans) >= 4
     assert all(e["dur"] >= 5e4 for e in spans)  # >= 50ms in µs
     assert json.load(open(out))
+
+
+def test_worker_prints_stream_to_driver(tmp_path):
+    """VERDICT r2 #5: a `print` inside a task must appear in the driver's
+    output with a (pid=, node=) prefix (reference log_monitor.py)."""
+    import subprocess
+    import sys
+
+    prog = tmp_path / "driver_prog.py"
+    prog.write_text(
+        "import os, time\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=4, num_tpus=0, log_to_driver=True)\n"
+        "@ray_tpu.remote\n"
+        "def shout():\n"
+        "    print('HELLO_FROM_WORKER_TASK')\n"
+        "    return 1\n"
+        "assert ray_tpu.get(shout.remote()) == 1\n"
+        "deadline = time.time() + 30\n"
+        "while time.time() < deadline:\n"
+        "    time.sleep(0.5)  # give the tail->feed->driver path a moment\n"
+        "ray_tpu.shutdown()\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, str(prog)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+    # read until the streamed line shows up (the driver program itself
+    # waits up to 30s before shutting down)
+    import time as _t
+
+    out_lines = []
+    deadline = _t.time() + 120
+    found = None
+    while _t.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                break
+            continue
+        out_lines.append(line)
+        if "HELLO_FROM_WORKER_TASK" in line:
+            found = line
+            break
+    proc.kill()
+    proc.wait()
+    assert found, "worker print never reached driver:\n" + "".join(
+        out_lines[-40:])
+    assert "pid=" in found and "node=" in found, found
+
+
+def test_dashboard_per_node_agent(ray_start):
+    """VERDICT r2 #10: per-node agent endpoints — deep node stats
+    (cpu%, per-worker RSS, accelerators) and node-local log access,
+    proxied through each node's raylet (reference dashboard/agent.py)."""
+    url = ray_tpu.dashboard_url()
+    nodes = [n for n in _get_json(f"{url}/api/cluster")["nodes"]
+             if n["state"] == "ALIVE"]
+    assert nodes
+    nid = nodes[0]["node_id"]
+    stats = _get_json(f"{url}/api/node/{nid}/stats")
+    assert stats["node_id"] == nid
+    assert "cpu_percent" in stats and "worker_procs" in stats
+    assert stats["mem_total_gb"] > 0
+    logs = _get_json(f"{url}/api/node/{nid}/logs")
+    assert any(e["file"].startswith("worker-") or
+               e["file"].startswith("head") for e in logs), logs
+    name = logs[0]["file"]
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"{url}/api/node/{nid}/logs?file={name}&tail=2048",
+            timeout=10) as resp:
+        assert resp.status == 200
+    # unknown node -> 404
+    import urllib.error
+
+    try:
+        urllib.request.urlopen(f"{url}/api/node/deadbeef/stats", timeout=10)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
