@@ -375,6 +375,13 @@ int rtpu_store_attach(const char* name) {
     if (ms > 5000) { munmap(base, st.st_size); return -ETIMEDOUT; }
     nanosleep(&ts, nullptr);
   }
+  if (H->version != kVersion) {
+    // Entry layout changed across versions (v2 added creator_pid): a
+    // mixed-version attach would walk the table with the wrong stride
+    // and corrupt the arena — refuse loudly instead
+    munmap(base, st.st_size);
+    return -EINVAL;
+  }
   Handle h;
   h.base = (uint8_t*)base;
   h.size = st.st_size;

@@ -956,6 +956,14 @@ class GcsServer:
                     max(0.01, deadline - asyncio.get_event_loop().time()))
             except asyncio.TimeoutError:
                 pass
+            finally:
+                # self-cleanup: on a quiet cluster publish_logs (the only
+                # other clearer) may not run for days — timed-out pollers
+                # must not pile dead futures up in the GCS
+                try:
+                    self._log_waiters.remove(fut)
+                except ValueError:
+                    pass
 
     async def handle_subscribe(self, cursor: int = 0, channel: Optional[str] = None,
                                timeout: float = 30.0) -> Dict:

@@ -46,7 +46,12 @@ async def _start_client_server(session_dir, gcs, raylet, client_port: int):
                 break
             except OSError:
                 if asyncio.get_event_loop().time() > deadline:
-                    raise
+                    # another cluster owns the default port for good (a
+                    # shared host): serve from an ephemeral port instead —
+                    # drivers discover the address via the KV, not the
+                    # port number
+                    host, bound = await client_server.start(port=0)
+                    break
                 await asyncio.sleep(0.5)
         # advertise a ROUTABLE address, never the bind host: a remote
         # driver can't connect to "0.0.0.0".  Derive it from the GCS

@@ -413,15 +413,16 @@ class Raylet:
                 except OSError:
                     self._worker_logs.pop(pid, None)
                     continue
+                lines: List[str] = []
                 if not self._logs_wanted:
-                    # nobody is tailing: skip the read entirely and jump
-                    # the cursor so a late consumer starts at fresh output
-                    # instead of replaying a huge backlog
+                    # nobody is tailing: skip the read and jump the cursor
+                    # so a late consumer starts at fresh output instead of
+                    # replaying a huge backlog — but FALL THROUGH to the
+                    # dead-worker cleanup below, or churned workers' file
+                    # entries would be stat()ed every tick forever
                     st["off"] = size
                     st["buf"] = b""
-                    continue
-                lines: List[str] = []
-                if size > st["off"]:
+                elif size > st["off"]:
                     try:
                         with open(st["path"], "rb") as f:
                             f.seek(st["off"])
@@ -525,9 +526,28 @@ class Raylet:
         demand = ResourceSet(resources)
         if pg_id is not None:
             # Placement-group lease: the bundle's node is authoritative.
+            # A task scheduled into the PG can race its two-phase
+            # reservation (pg.ready() does exactly this) — WAIT for
+            # placement rather than failing the task; only a removed /
+            # unknown group is a real error.
             target = await self._pg_bundle_node(pg_id, bundle_index, demand)
-            if target is None:
-                raise RuntimeError("placement group bundle not found/ready")
+            deadline = (asyncio.get_event_loop().time()
+                        + config.worker_lease_timeout_s * 20)
+            while target is None:
+                pg = await self.gcs.call("get_placement_group", pg_id=pg_id)
+                if pg is None or pg.get("state") == "REMOVED":
+                    raise RuntimeError(
+                        "placement group removed or never created")
+                if asyncio.get_event_loop().time() > deadline:
+                    # bounded: an infeasible PG stays PENDING forever, and
+                    # every abandoned client retry would otherwise leave an
+                    # immortal poll loop hammering the GCS
+                    raise RuntimeError(
+                        "placement group still pending placement (bundles "
+                        "may exceed cluster capacity)")
+                await asyncio.sleep(0.25)
+                target = await self._pg_bundle_node(pg_id, bundle_index,
+                                                    demand)
             if target != self.node_id:
                 addr = self._addr_of(target) or (await self._gcs_node_addr(target))
                 return {"spillback": addr}

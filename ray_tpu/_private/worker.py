@@ -1604,7 +1604,18 @@ class CoreWorker:
                              count=count, error=err_payload, timeout=None)
         except Exception:  # noqa: BLE001
             pass  # owner gone: nothing to report to
-        return {"returns": [], "streaming": True, "count": count}
+        reply: Dict[str, Any] = {"returns": [], "streaming": True,
+                                 "count": count}
+        # reply-carried borrows, same as _package_returns (and the pop
+        # keeps _task_arg_borrows from leaking for generator tasks)
+        borrows = self._task_arg_borrows.pop(spec.task_id, None)
+        if borrows:
+            reply["borrows"] = [[r.id.binary(),
+                                 r.owner_addr or self.serve_addr]
+                                for r in borrows]
+            reply["borrower_addr"] = self.serve_addr
+            self.loop.call_later(5.0, _hold_refs, borrows)
+        return reply
 
     async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None) -> Dict:
         if spec.task_id in self._cancel_requested:
