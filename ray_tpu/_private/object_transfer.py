@@ -79,12 +79,21 @@ class ChunkedPuller:
         self._inflight: Dict[ObjectID, asyncio.Future] = {}
         self.stats: Dict[str, Any] = {
             "pulls": 0, "chunks": 0, "bytes": 0, "dedup_hits": 0,
+            "same_host_handoffs": 0,
         }
 
     async def pull(self, object_id: ObjectID, source_addr: str) -> bool:
         """Pull one object from the raylet at ``source_addr`` into the
         local store.  Returns True when the object is available locally."""
         if self._store.contains(object_id):
+            # already visible — possibly a foreign same-host segment this
+            # session doesn't own yet: adopt (idempotent for own objects,
+            # no-op for arena-resident ones) so it survives the creator's
+            # teardown
+            adopt = (getattr(self._store, "adopt_segment", None)
+                     or getattr(self._store, "adopt", None))
+            if adopt is not None:
+                adopt(object_id)
             return True
         existing = self._inflight.get(object_id)
         if existing is not None:
@@ -112,6 +121,32 @@ class ChunkedPuller:
         if not info or info.get("size") is None:
             return False
         size = int(info["size"])
+        # same-host fast path: when source and destination share /dev/shm
+        # (token match), ask the source to publish the object as a
+        # machine-global segment — one local memcpy at memory bandwidth,
+        # no chunk framing, no admission (nothing crosses the wire)
+        from ray_tpu._private.object_store import shm_host_token
+
+        src_token = info.get("host_token")
+        if (src_token and src_token != "no-shm"
+                and src_token == shm_host_token()):
+            try:
+                if (await client.call("export_object", oid=object_id.hex(),
+                                      timeout=config.rpc_connect_timeout_s * 4)
+                        and self._store.contains(object_id)):
+                    # adopt the exported segment (take unlink
+                    # responsibility): the exporter disowned it, so it now
+                    # lives until THIS session tears down — independent-
+                    # copy durability without a second payload copy
+                    adopt = (getattr(self._store, "adopt_segment", None)
+                             or getattr(self._store, "adopt", None))
+                    if adopt is not None:
+                        adopt(object_id)
+                    self.stats["same_host_handoffs"] += 1
+                    self.stats["pulls"] += 1
+                    return True
+            except Exception:  # noqa: BLE001 — fall back to chunked pull
+                pass
         # admission: wait until the global in-flight budget has room (an
         # object larger than the whole budget is admitted alone)
         async with self._admission:

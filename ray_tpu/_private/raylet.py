@@ -531,8 +531,12 @@ class Raylet:
             # placement rather than failing the task; only a removed /
             # unknown group is a real error.
             target = await self._pg_bundle_node(pg_id, bundle_index, demand)
+            # server deadline STRICTLY below the client's lease RPC timeout
+            # (worker.py: worker_lease_timeout_s * 4) so the diagnostic
+            # error below reaches the caller instead of an opaque RPC
+            # timeout — and so an abandoned call's poll loop dies with it
             deadline = (asyncio.get_event_loop().time()
-                        + config.worker_lease_timeout_s * 20)
+                        + config.worker_lease_timeout_s * 3)
             while target is None:
                 pg = await self.gcs.call("get_placement_group", pg_id=pg_id)
                 if pg is None or pg.get("state") == "REMOVED":
@@ -822,7 +826,22 @@ class Raylet:
         buf = store.get_buffer(ObjectID.from_hex(oid))
         if buf is None:
             return None
-        return {"size": len(buf)}
+        from ray_tpu._private.object_store import shm_host_token
+
+        return {"size": len(buf), "host_token": shm_host_token()}
+
+    async def handle_export_object(self, oid: str) -> bool:
+        """Same-host handoff: publish an arena-resident object as a
+        machine-global segment the requesting raylet attaches directly —
+        one local memcpy replaces the whole chunked-RPC copy chain."""
+        from ray_tpu._private.ids import ObjectID
+
+        store = await self._get_pull_store()
+        export = getattr(store, "export_to_segment", None)
+        if export is None:
+            return False
+        return await asyncio.get_event_loop().run_in_executor(
+            None, export, ObjectID.from_hex(oid))
 
     async def handle_pull_chunk(self, oid: str, offset: int,
                                 length: int) -> Optional[bytes]:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -36,6 +37,7 @@ class Cluster:
         self._services = NodeServices()
         self.head_node: Optional[ClusterNode] = None
         self.worker_nodes: List[ClusterNode] = []
+        self._extra_sessions: List[str] = []
         self.gcs_address = ""
         if initialize_head:
             args = dict(head_node_args or {})
@@ -58,16 +60,27 @@ class Cluster:
     def add_node(self, num_cpus: float = 4, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 node_name: str = "") -> ClusterNode:
+                 node_name: str = "",
+                 separate_session: bool = False) -> ClusterNode:
         res = default_resources(num_cpus=num_cpus, num_tpus=num_tpus)
         if resources:
             res.update(resources)
-        log = open(os.path.join(self._services.session_dir, "logs",
+        session_dir = self._services.session_dir
+        if separate_session:
+            # own session dir -> own object-store arena: cross-node gets
+            # exercise the REAL transfer plane (chunked pull / same-host
+            # handoff) instead of reading a shared test arena — what a
+            # distinct physical host would look like
+            session_dir = f"{session_dir}_n{time.time_ns() % 10**9}"
+            os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+            os.makedirs(os.path.join(session_dir, "sockets"), exist_ok=True)
+            self._extra_sessions.append(session_dir)
+        log = open(os.path.join(session_dir, "logs",
                                 f"raylet-{time.time_ns()}.log"), "ab")
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "ray_tpu._private.raylet_proc",
-                "--session-dir", self._services.session_dir,
+                "--session-dir", session_dir,
                 "--gcs-addr", self.gcs_address,
                 "--resources", json.dumps(res),
                 "--labels", json.dumps(labels or {}),
@@ -110,3 +123,12 @@ class Cluster:
             ray_tpu.shutdown()
         else:
             self._services.stop()
+        for sess in self._extra_sessions:
+            try:
+                from ray_tpu._private.object_store import arena_name_for
+
+                os.unlink("/dev/shm" + arena_name_for(sess))
+            except OSError:
+                pass
+            shutil.rmtree(sess, ignore_errors=True)
+        self._extra_sessions.clear()

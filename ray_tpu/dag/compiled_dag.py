@@ -12,6 +12,21 @@ Same-actor edges short-circuit through a local cache (no channel).  Device
 values: jax.Arrays are staged through host shm on cross-process edges; keep
 a DAG's nodes in one mesh-holding process (or fuse the step under jit) for
 the ICI path — see ``channel.communicator.TpuCommunicator``.
+
+In-mesh jit fusion: a method bound with ``.options(jit=True)`` promises a
+jax-traceable body; adjacent jit-marked nodes on the same actor are fused
+at compile time into ONE ``jax.jit`` program, so intermediates between
+them never leave the device (no host staging, no per-node dispatch, XLA
+fuses across node boundaries).  Cross-actor edges still host-stage —
+measured in ``benchmarks/dag_fusion_bench.py``.
+
+The ``jit=True`` contract is jax's: the method must be a pure function
+of its ARGUMENTS.  Actor attributes it reads (``self.w``) are traced
+once and baked into the compiled program as constants — state mutated
+by other methods between iterations is NOT seen, exactly as with any
+hand-written ``jax.jit`` over a bound method.  Methods that read
+mutable actor state must stay unfused (omit ``jit=True``) or take the
+state as a DAG argument.
 """
 
 from __future__ import annotations
@@ -108,6 +123,194 @@ class _Pending:
             return TaskError.from_exception(e)
 
 
+def _fuse_jit_runs(tasks: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Merge maximal runs of ADJACENT jit-marked tasks into fused tasks.
+
+    Safety rule: fusing hoists the run's channel reads before its channel
+    writes (externals resolve first, emits write last).  A candidate that
+    reads a cross-actor channel therefore may not join a run that has
+    already written an out-channel — an A→B→A shape would deadlock (A's
+    read of B's output would precede the write B needs).  DAG-input reads
+    are always safe to hoist: the driver writes the input before any task
+    runs.
+    """
+    out: List[Dict[str, Any]] = []
+    i = 0
+    while i < len(tasks):
+        t = tasks[i]
+        if not t.get("jit"):
+            out.append(t)
+            i += 1
+            continue
+        run = [t]
+        wrote = t["out_channel"] is not None
+        j = i + 1
+        while j < len(tasks) and tasks[j].get("jit"):
+            cand = tasks[j]
+            reads_chan = any(
+                a[0] == "chan"
+                for a in list(cand["args"]) + list(cand["kwargs"].values()))
+            if wrote and reads_chan:
+                break
+            run.append(cand)
+            wrote = wrote or cand["out_channel"] is not None
+            j += 1
+        out.append(_make_fused_task(run, tasks[j:]))
+        i = j
+    return out
+
+
+def _make_fused_task(run: List[Dict[str, Any]],
+                     later_tasks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Build the fused task dict: external argspecs in first-read order
+    (preserving the unfused channel-read order), and the emit list — every
+    sub-result consumed outside the run (out-channel or a later local)."""
+    run_idx = {t["local_idx"] for t in run}
+    later_refs = set()
+    for lt in later_tasks:
+        subs = lt["fused"] if lt.get("fused") is not None else [lt]
+        for s in subs:
+            for a in list(s["args"]) + list(s["kwargs"].values()):
+                if a[0] == "local":
+                    later_refs.add(a[1])
+    ext: List[Tuple] = []
+    seen = set()
+    for t in run:
+        for a in list(t["args"]) + list(t["kwargs"].values()):
+            if a[0] == "const" or (a[0] == "local" and a[1] in run_idx):
+                continue
+            key = tuple(a)
+            if key not in seen:
+                seen.add(key)
+                ext.append(a)
+    emit = [(t["local_idx"], t["out_channel"]) for t in run
+            if t["out_channel"] is not None or t["local_idx"] in later_refs]
+    if not emit:  # nothing consumed outside: keep the tail result visible
+        emit = [(run[-1]["local_idx"], None)]
+    return {
+        "fused": [{"method": t["method"], "args": t["args"],
+                   "kwargs": t["kwargs"], "local_idx": t["local_idx"]}
+                  for t in run],
+        "ext": ext,
+        "emit": emit,
+        "out_channel": None,
+        "local_idx": run[-1]["local_idx"],
+    }
+
+
+def _build_fused_fn(instance, t: Dict[str, Any]):
+    """One jax.jit program over a run of adjacent jit-marked tasks.
+
+    External values (channel reads, earlier locals, DAG input) are traced
+    arguments; consts are closed over statically; intermediates between
+    subtasks stay device-resident tracers.
+    """
+    import jax
+
+    run = t["fused"]
+    run_idx = {s["local_idx"] for s in run}
+    ext_slot = {tuple(a): k for k, a in enumerate(t["ext"])}
+    emit_idx = [idx for idx, _ch in t["emit"]]
+
+    def fused(ext_vals):
+        loc: Dict[int, Any] = {}
+
+        def res(a):
+            if a[0] == "const":
+                return a[1]
+            if a[0] == "local" and a[1] in run_idx:
+                return loc[a[1]]
+            return ext_vals[ext_slot[tuple(a)]]
+
+        for s in run:
+            args = [res(a) for a in s["args"]]
+            kwargs = {k: res(v) for k, v in s["kwargs"].items()}
+            loc[s["local_idx"]] = getattr(instance, s["method"])(
+                *args, **kwargs)
+        return tuple(loc[i] for i in emit_idx)
+
+    return jax.jit(fused)
+
+
+def _exec_fused(instance, t: Dict[str, Any], resolve, local) -> None:
+    """Execute one fused task: resolve externals (lazy channel reads, in
+    original task order), run the jitted program once, fan results out to
+    the emitted locals/out-channels.
+
+    Error semantics match unfused execution EXACTLY: an upstream TaskError
+    propagates to every emit without running the program, and if the fused
+    program itself raises, the run re-executes eagerly one subtask at a
+    time so only the genuinely-failing subtask (and its downstream
+    consumers) error — a fused sibling that would have succeeded unfused
+    still emits its value."""
+    try:
+        ext_vals = [resolve(a) for a in t["ext"]]  # may raise _StopSignal
+    except _StopSignal:
+        raise
+    except BaseException as e:  # noqa: BLE001 — bad input shape, closed chan
+        # the fused task's top-level out_channel is always None, so the
+        # generic per-task handler would write this error NOWHERE and
+        # downstream consumers would hang — fan it out to every emit
+        err = TaskError.from_exception(e)
+        for idx, ch in t["emit"]:
+            local[idx] = err
+            if ch is not None:
+                ch.write(err)
+        return
+    err = next((v for v in ext_vals if isinstance(v, TaskError)), None)
+    if err is not None:
+        for idx, ch in t["emit"]:
+            local[idx] = err
+            if ch is not None:
+                ch.write(err)
+        return
+    fn = t.get("_fn")
+    if fn is None:
+        fn = t["_fn"] = _build_fused_fn(instance, t)
+    try:
+        outs = fn(ext_vals)
+        for k, (idx, ch) in enumerate(t["emit"]):
+            local[idx] = outs[k]
+            if ch is not None:
+                ch.write(outs[k])
+        return
+    except BaseException:  # noqa: BLE001 — localize via the eager path
+        pass
+    _exec_fused_eager(instance, t, ext_vals, local)
+
+
+def _exec_fused_eager(instance, t: Dict[str, Any], ext_vals, local) -> None:
+    """Per-subtask eager re-execution of a failed fused run (unfused
+    semantics: each subtask errors individually, errors flow to their own
+    consumers only)."""
+    run_idx = {s["local_idx"] for s in t["fused"]}
+    ext_slot = {tuple(a): k for k, a in enumerate(t["ext"])}
+    loc: Dict[int, Any] = {}
+
+    def res(a):
+        if a[0] == "const":
+            return a[1]
+        if a[0] == "local" and a[1] in run_idx:
+            return loc[a[1]]
+        return ext_vals[ext_slot[tuple(a)]]
+
+    for s in t["fused"]:
+        try:
+            args = [res(a) for a in s["args"]]
+            kwargs = {k: res(v) for k, v in s["kwargs"].items()}
+            up = next((v for v in list(args) + list(kwargs.values())
+                       if isinstance(v, TaskError)), None)
+            result = up if up is not None else getattr(
+                instance, s["method"])(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — propagated downstream
+            result = TaskError.from_exception(e)
+        loc[s["local_idx"]] = result
+    for idx, ch in t["emit"]:
+        local[idx] = loc[idx]
+        if ch is not None:
+            ch.write(loc[idx])
+
+
 def _run_exec_loop(instance, spec: Dict[str, Any]) -> None:
     """One iteration per execute(): read in-edges, run tasks, write out-edges.
 
@@ -183,6 +386,9 @@ def _exec_iterations(instance, spec, read_channels, tasks, coll_pool):
         stopping = False
         for t in tasks:
             try:
+                if t.get("fused") is not None:
+                    _exec_fused(instance, t, resolve, local)
+                    continue
                 args = [resolve(a) for a in t["args"]]
                 kwargs = {k: resolve(v) for k, v in t["kwargs"].items()}
                 vals = list(args) + list(kwargs.values())
@@ -215,6 +421,11 @@ def _exec_iterations(instance, spec, read_channels, tasks, coll_pool):
                 t["out_channel"].write(result)
         if stopping:
             for t in tasks:
+                if t.get("fused") is not None:
+                    for idx, ch in t["emit"]:
+                        if ch is not None and idx not in local:
+                            ch.write(_STOP)
+                    continue
                 out = t["out_channel"]
                 if out is not None and t["local_idx"] not in local:
                     out.write(_STOP)
@@ -444,7 +655,16 @@ class CompiledDAG:
             if isinstance(n, CollectiveNode):
                 task["collective"] = {"kind": n.group.op,
                                       "group": n.group.group_name}
+            elif n.options.get("jit"):
+                task["jit"] = True
             spec["tasks"].append(task)
+
+        # in-mesh jit fusion: adjacent jit-marked tasks per actor become one
+        # jax.jit program (device-resident intermediates, one dispatch)
+        for spec in specs.values():
+            spec["tasks"] = _fuse_jit_runs(spec["tasks"])
+
+        self._exec_specs = specs  # introspection (tests, debugging)
 
         # join each collective group's actors (rank order = bind order)
         # BEFORE exec loops start: the first iteration may hit the op

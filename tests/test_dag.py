@@ -421,3 +421,162 @@ class TestCollectiveDag:
             dag = ra  # rank 1's output dropped: would deadlock at runtime
         with pytest.raises(ValueError, match="bind ALL"):
             dag.experimental_compile()
+
+
+@ray_tpu.remote
+class JitWorker:
+    """Methods marked jit=True promise jax-traceable bodies."""
+
+    def __init__(self):
+        self.w = np.arange(4, dtype=np.float32)
+
+    def scale(self, x):
+        return x * 2.0
+
+    def addw(self, x):
+        import jax.numpy as jnp
+
+        return x + jnp.asarray(self.w)
+
+    def combine(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise ValueError("kapow")
+
+
+def _single_spec(compiled):
+    (spec,) = compiled._exec_specs.values()
+    return spec
+
+
+class TestJitFusion:
+    def test_adjacent_jit_chain_fuses_into_one_task(self):
+        w = JitWorker.remote()
+        with InputNode() as inp:
+            a = w.scale.options(jit=True).bind(inp)
+            b = w.scale.options(jit=True).bind(a)
+            dag = w.addw.options(jit=True).bind(b)
+        compiled = dag.experimental_compile()
+        try:
+            tasks = _single_spec(compiled)["tasks"]
+            assert len(tasks) == 1
+            assert len(tasks[0]["fused"]) == 3
+            x = np.ones(4, np.float32)
+            out = compiled.execute(x).get(timeout=30)
+            np.testing.assert_allclose(
+                np.asarray(out), x * 4.0 + np.arange(4, dtype=np.float32))
+            # second iteration reuses the traced program
+            out2 = compiled.execute(2 * x).get(timeout=30)
+            np.testing.assert_allclose(
+                np.asarray(out2), x * 8.0 + np.arange(4, dtype=np.float32))
+        finally:
+            compiled.teardown()
+
+    def test_mid_run_value_consumed_by_later_task(self):
+        w = JitWorker.remote()
+        with InputNode() as inp:
+            a = w.scale.options(jit=True).bind(inp)
+            b = w.scale.options(jit=True).bind(a)
+            dag = w.combine.bind(a, b)  # non-jit task consumes mid local
+        compiled = dag.experimental_compile()
+        try:
+            tasks = _single_spec(compiled)["tasks"]
+            assert len(tasks) == 2  # fused(a,b) + combine
+            assert len(tasks[0]["fused"]) == 2
+            assert len(tasks[0]["emit"]) == 2  # a and b both leave the run
+            x = np.ones(4, np.float32)
+            out = compiled.execute(x).get(timeout=30)
+            np.testing.assert_allclose(np.asarray(out), x * 2.0 + x * 4.0)
+        finally:
+            compiled.teardown()
+
+    def test_fused_error_propagates_and_dag_survives(self):
+        w = JitWorker.remote()
+        with InputNode() as inp:
+            a = w.scale.options(jit=True).bind(inp)
+            dag = w.boom.options(jit=True).bind(a)
+        compiled = dag.experimental_compile()
+        try:
+            with pytest.raises(Exception, match="kapow"):
+                compiled.execute(np.ones(4, np.float32)).get(timeout=30)
+            with pytest.raises(Exception, match="kapow"):
+                compiled.execute(np.ones(4, np.float32)).get(timeout=30)
+        finally:
+            compiled.teardown()
+
+    def test_read_after_write_guard_splits_aba_run(self):
+        # A's second jit task reads B's output, which depends on A's first
+        # task's out-channel: fusing them would hoist the read before the
+        # write and deadlock — the compiler must split the run.
+        wa = JitWorker.remote()
+        wb = JitWorker.remote()
+        with InputNode() as inp:
+            a1 = wa.scale.options(jit=True).bind(inp)
+            b1 = wb.scale.bind(a1)
+            dag = wa.combine.options(jit=True).bind(a1, b1)
+        compiled = dag.experimental_compile()
+        try:
+            spec_a = compiled._exec_specs[wa._actor_id]
+            assert len(spec_a["tasks"]) == 2  # NOT fused across the B read
+            x = np.ones(4, np.float32)
+            out = compiled.execute(x).get(timeout=30)
+            np.testing.assert_allclose(np.asarray(out), x * 6.0)
+        finally:
+            compiled.teardown()
+
+    def test_fused_terminals_multi_output(self):
+        w = JitWorker.remote()
+        with InputNode() as inp:
+            a = w.scale.options(jit=True).bind(inp)
+            b = w.addw.options(jit=True).bind(a)
+            dag = MultiOutputNode([a, b])
+        compiled = dag.experimental_compile()
+        try:
+            x = np.ones(4, np.float32)
+            oa, ob = compiled.execute(x).get(timeout=30)
+            np.testing.assert_allclose(np.asarray(oa), x * 2.0)
+            np.testing.assert_allclose(
+                np.asarray(ob), x * 2.0 + np.arange(4, dtype=np.float32))
+        finally:
+            compiled.teardown()
+
+    def test_fused_sibling_survives_subtask_error(self):
+        # Unfused, only boom's output errors; fused must match: the jit
+        # program fails, the run re-executes eagerly, and `a` still
+        # delivers its VALUE downstream — observable because the Adder
+        # consumer actually runs (an upstream TaskError would skip it).
+        w = JitWorker.remote()
+        consumer = Adder.remote(1)
+        with InputNode() as inp:
+            a = w.scale.options(jit=True).bind(inp)
+            b = w.boom.options(jit=True).bind(a)
+            dag = MultiOutputNode([consumer.add.bind(a), b])
+        compiled = dag.experimental_compile()
+        try:
+            spec_w = compiled._exec_specs[w._actor_id]
+            assert len(spec_w["tasks"]) == 1
+            assert len(spec_w["tasks"][0]["fused"]) == 2
+            ref = compiled.execute(np.ones(4, np.float32))
+            with pytest.raises(Exception, match="kapow"):
+                ref.get(timeout=30)
+        finally:
+            compiled.teardown()
+        # consumer.add ran on a's real value (not a poisoned TaskError)
+        assert ray_tpu.get(consumer.get_calls.remote()) == 1
+
+    def test_fused_bad_input_errors_instead_of_hanging(self):
+        # resolve() of the whole-input argspec raises TypeError when
+        # execute() got multiple args; the error must reach the driver
+        # through the emit channels (review finding: it was written to
+        # the fused task's always-None out_channel, hanging the get).
+        w = JitWorker.remote()
+        with InputNode() as inp:
+            dag = w.scale.options(jit=True).bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            ref = compiled.execute(1, 2)
+            with pytest.raises(Exception, match="multiple"):
+                ref.get(timeout=30)
+        finally:
+            compiled.teardown()

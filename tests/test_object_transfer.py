@@ -228,3 +228,202 @@ def test_landing_segment_abort_reclaimed():
     seal()  # late seal after abort: publishes nothing
     assert not store.contains(oid)
     store.close()
+
+
+# ---------------- same-host shm handoff (VERDICT r2 weak #9) ----------------
+
+
+class _HandoffSource(_SourceNode):
+    """Source advertising a host token + serving export_object."""
+
+    def __init__(self, store, token, published):
+        super().__init__(store)
+        self.token = token
+        self.published = published  # the "machine-global" SharedObjectStore
+        self.exports = 0
+        self.server.register("export_object", self.export_object)
+
+    async def object_info(self, oid):
+        buf = self.store.get_buffer(ObjectID.from_hex(oid))
+        if buf is None:
+            return None
+        return {"size": len(buf), "host_token": self.token}
+
+    async def export_object(self, oid):
+        self.exports += 1
+        o = ObjectID.from_hex(oid)
+        buf = self.store.get_buffer(o)
+        if buf is None:
+            return False
+        self.published.put_serialized(o, bytes(buf))
+        return True
+
+
+class _HybridLikeDest(_LocalStore):
+    """Destination that (like HybridObjectStore) also sees machine-global
+    per-object segments."""
+
+    def __init__(self, tag):
+        super().__init__(tag)
+        self.segments = SharedObjectStore()
+
+    def contains(self, object_id):
+        return (object_id in self._data
+                or self.segments.contains(object_id))
+
+
+@pytest.fixture
+def handoff_pair(tmp_path):
+    from ray_tpu._private.object_store import shm_host_token
+
+    loop = asyncio.new_event_loop()
+    published = SharedObjectStore()
+    src_store = _LocalStore("src")  # arena stand-in: NOT globally visible
+    dst_store = _HybridLikeDest("dst")
+    src = _HandoffSource(src_store, shm_host_token(), published)
+    sock = str(tmp_path / "src.sock")
+    loop.run_until_complete(src.server.listen_unix(sock))
+    clients = {}
+
+    def peer(addr):
+        c = clients.get(addr)
+        if c is None:
+            c = clients[addr] = RpcClient(addr)
+        return c
+
+    puller = ChunkedPuller(dst_store, peer, chunk_bytes=64 * 1024, window=4)
+    oids = []
+    yield loop, src, src_store, dst_store, puller, f"unix:{sock}", oids
+    for o in oids:
+        published.delete(o)
+    dst_store.segments.close(unlink_created=False)
+    published.close()
+    for c in clients.values():
+        loop.run_until_complete(c.close())
+    loop.run_until_complete(src.server.close())
+    loop.close()
+
+
+def test_same_host_handoff_skips_chunking(handoff_pair):
+    loop, src, src_store, dst_store, puller, addr, oids = handoff_pair
+    oid = ObjectID.from_random()
+    oids.append(oid)
+    payload = os.urandom(1 * 1024 * 1024 + 7)
+    src_store.put_serialized(oid, payload)
+    assert not dst_store.contains(oid)
+    ok = loop.run_until_complete(puller.pull(oid, addr))
+    assert ok
+    assert src.exports == 1
+    assert src.chunk_requests == 0           # no chunk RPCs at all
+    assert puller.stats["same_host_handoffs"] == 1
+    assert puller.stats["chunks"] == 0
+    assert bytes(
+        dst_store.segments.get_buffer(oid))[:len(payload)] == payload
+
+
+def test_foreign_host_token_falls_back_to_chunks(handoff_pair):
+    loop, src, src_store, dst_store, puller, addr, oids = handoff_pair
+    src.token = "some-other-machine"
+    oid = ObjectID.from_random()
+    payload = os.urandom(256 * 1024)
+    src_store.put_serialized(oid, payload)
+    ok = loop.run_until_complete(puller.pull(oid, addr))
+    assert ok
+    assert src.exports == 0
+    assert puller.stats["same_host_handoffs"] == 0
+    assert puller.stats["chunks"] == 4
+    assert bytes(dst_store.get_buffer(oid)) == payload
+
+
+def test_hybrid_store_export_to_segment(tmp_path):
+    """Arena-resident object published as a global segment on demand."""
+    from ray_tpu._private import native_store
+    from ray_tpu._private.config import config
+    from ray_tpu._private.object_store import (
+        HybridObjectStore,
+        arena_name_for,
+    )
+
+    if not native_store.available():
+        pytest.skip("native store unavailable")
+    config.reload({"arena_store_bytes": 4 * 1024 * 1024,
+                   "object_spill_dir": str(tmp_path / "spill")})
+    session = str(tmp_path / "sess")
+    os.makedirs(session, exist_ok=True)
+    store = HybridObjectStore(session)
+    peer = SharedObjectStore()
+    oid = ObjectID.from_random()
+    payload = os.urandom(64 * 1024)
+    try:
+        store.put_serialized(oid, payload)
+        assert store.arena is not None and store.arena.contains(oid)
+        assert peer.get_buffer(oid) is None      # arena is session-private
+        assert store.export_to_segment(oid)
+        assert bytes(peer.get_buffer(oid))[:len(payload)] == payload
+        assert store.export_to_segment(oid)      # idempotent
+    finally:
+        peer.close(unlink_created=False)
+        store.delete(oid)
+        store.close(unlink_created=True)
+        try:
+            from multiprocessing import shared_memory
+
+            seg = shared_memory.SharedMemory(name=arena_name_for(session))
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+        config.reload()
+
+
+def test_adopted_segment_survives_exporter_teardown(tmp_path):
+    """After a handoff the destination must hold a DURABLE copy: the
+    exporter's session teardown must not lose the object (code-review
+    finding: handoff without ownership transfer left the only copy in
+    the source's _created set).  Design: export disowns, adopt takes
+    unlink responsibility — no second payload copy."""
+    from ray_tpu._private import native_store
+    from ray_tpu._private.config import config
+    from ray_tpu._private.object_store import (
+        HybridObjectStore,
+        arena_name_for,
+        shm_name_for,
+    )
+
+    if not native_store.available():
+        pytest.skip("native store unavailable")
+    config.reload({"arena_store_bytes": 4 * 1024 * 1024,
+                   "object_spill_dir": str(tmp_path / "spill")})
+    src_sess = str(tmp_path / "src_sess")
+    dst_sess = str(tmp_path / "dst_sess")
+    os.makedirs(src_sess)
+    os.makedirs(dst_sess)
+    src = HybridObjectStore(src_sess)
+    dst = HybridObjectStore(dst_sess)
+    oid = ObjectID.from_random()
+    payload = os.urandom(64 * 1024)
+    try:
+        src.put_serialized(oid, payload)
+        assert src.export_to_segment(oid)          # source publishes+disowns
+        assert dst.contains(oid)                   # dest sees the segment
+        assert dst.adopt_segment(oid)              # dest takes ownership
+        src.close(unlink_created=True)             # exporter tears down
+        buf = dst.get_buffer(oid)                  # still readable from dst
+        assert buf is not None and bytes(buf)[:len(payload)] == payload
+        buf = None
+        dst.close(unlink_created=True)             # adopter teardown unlinks
+        assert not os.path.exists(f"/dev/shm/{shm_name_for(oid)}")
+    finally:
+        for sess, store in ((src_sess, None), (dst_sess, dst)):
+            if store is not None:
+                store.delete(oid)
+                store.close(unlink_created=True)
+            try:
+                from multiprocessing import shared_memory
+
+                seg = shared_memory.SharedMemory(name=arena_name_for(sess))
+                seg.close()
+                seg.unlink()
+            except Exception:
+                pass
+        config.reload()
