@@ -126,7 +126,9 @@ def init(
         core.start()
         core.namespace = namespace or ""
         worker_mod.global_worker = core
-        core.run_coro(core.gcs.call("add_job", job_id=job_no, info={"driver_pid": _pid()}))
+        core.run_coro(core.gcs.call(
+            "add_job", job_id=job_no,
+            info={"driver_pid": _pid(), "driver_addr": core.serve_addr}))
         if log_to_driver:
             # worker prints stream back to this process's stdout
             core.start_log_streaming()

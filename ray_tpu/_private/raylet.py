@@ -830,6 +830,31 @@ class Raylet:
 
         return {"size": len(buf), "host_token": shm_host_token()}
 
+    async def handle_memory_report(self) -> Dict:
+        """Fan a ``memory_report`` to every pool worker on this node and
+        aggregate (the per-node leg of ``raytpu memory``; reference
+        ``ray memory`` collects CoreWorker ref tables the same way)."""
+        async def _ask(addr: str):
+            client = RpcClient(addr)  # ephemeral: no leak on worker death
+            try:
+                return await client.call("memory_report", timeout=5.0)
+            except Exception:  # noqa: BLE001 — dying worker: best-effort
+                return None
+            finally:
+                await client.close()
+
+        gathered = await asyncio.gather(
+            *(_ask(h.addr) for h in list(self.workers.values())))
+        reports = [r for r in gathered if r]
+        store = await self._get_pull_store()
+        stats = {}
+        try:
+            stats = store.stats()
+        except Exception:  # noqa: BLE001
+            pass
+        return {"node_id": self.node_id, "workers": reports,
+                "store": stats}
+
     async def handle_export_object(self, oid: str) -> bool:
         """Same-host handoff: publish an arena-resident object as a
         machine-global segment the requesting raylet attaches directly —

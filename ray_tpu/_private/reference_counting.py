@@ -258,6 +258,26 @@ class ReferenceCounter:
                 self._maybe_free(oid, rec)
         return now
 
+    def memory_rows(self):
+        """One debugging row per owned object — the ``raytpu memory``
+        view (reference ``ray memory``,
+        ``python/ray/_private/internal_api.py`` memory_summary: per-ref
+        hold kinds grouped by worker)."""
+        now = time.time()
+        rows = []
+        for oid, rec in self._records.items():
+            rows.append({
+                "object_id": oid.hex(),
+                "local_refs": rec.local,
+                "borrowers": sorted(rec.borrowers),
+                "transfer_pins": sum(1 for t in rec.transfer_pins
+                                     if t > now),
+                "contained_refs": len(rec.contained or ()),
+                "has_lineage": rec.lineage_task is not None,
+                "freed": rec.freed,
+            })
+        return rows
+
     # ---------------------------------------------------------- borrower side
 
     def on_borrowed_ref_created(self, oid: ObjectID, owner_addr: str,

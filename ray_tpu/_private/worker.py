@@ -2050,6 +2050,31 @@ class CoreWorker:
     async def handle_ping(self) -> str:
         return "pong"
 
+    def memory_report_local(self) -> Dict[str, Any]:
+        """Owned-object lifetime dump for ``raytpu memory`` (reference
+        ``ray memory`` / internal_api.memory_summary): this worker's
+        refcount table plus where each payload currently lives.  Call on
+        the IO loop thread (the table mutates there)."""
+        rows = self.ref_counter.memory_rows()
+        inline = self.memory_store._objects
+        for row in rows:
+            oid = ObjectID.from_hex(row["object_id"])
+            payload = inline.get(oid)
+            if payload is not None:
+                row["where"] = "inline"
+                row["size"] = len(payload)
+            elif self.shared_store.contains(oid):
+                row["where"] = "shm"
+            else:
+                row["where"] = "-"
+        return {"pid": os.getpid(),
+                "worker_id": self.worker_id.hex(),
+                "actor_id": self.actor_id.hex() if self.actor_id else None,
+                "rows": rows}
+
+    async def handle_memory_report(self) -> Dict[str, Any]:
+        return self.memory_report_local()
+
     async def handle_kill_actor(self, no_restart: bool = True) -> bool:
         logger.info("actor %s killed", self.actor_id.hex() if self.actor_id else "?")
         asyncio.ensure_future(self._terminate_self())

@@ -83,6 +83,43 @@ def cmd_status(args):
     ray_tpu.shutdown()
 
 
+def cmd_memory(args):
+    """Cluster object-ref debugging view (reference ``ray memory``)."""
+    ray_tpu = _connect(args.address or _default_address())
+    from ray_tpu.util import state as state_api
+
+    summary = state_api.memory_summary()
+    if args.json:
+        print(json.dumps(summary, default=str))
+    else:
+        hdr = (f"{'object_id':<32} {'refs':>4} {'borr':>4} {'pins':>4} "
+               f"{'cont':>4} {'lin':>3} {'where':<6} size")
+
+        def row(r, indent):
+            print(f"{indent}{r['object_id']:<32} {r['local_refs']:>4} "
+                  f"{len(r['borrowers']):>4} {r['transfer_pins']:>4} "
+                  f"{r['contained_refs']:>4} "
+                  f"{'y' if r['has_lineage'] else '-':>3} "
+                  f"{r.get('where', '-'):<6} {r.get('size', '')}")
+
+        for drv in summary["drivers"]:
+            print(f"driver pid={drv.get('pid')}")
+            print("  " + hdr)
+            for r in drv["rows"]:
+                row(r, "  ")
+        for node in summary["nodes"]:
+            print(f"node {node['node_id'][:12]} store={node.get('store')}")
+            for wrep in node["workers"]:
+                kind = (f"actor {wrep['actor_id'][:12]}"
+                        if wrep.get("actor_id") else "worker")
+                print(f"  {kind} pid={wrep['pid']}")
+                if wrep["rows"]:
+                    print("    " + hdr)
+                for r in wrep["rows"]:
+                    row(r, "    ")
+    ray_tpu.shutdown()
+
+
 def cmd_list(args):
     ray_tpu = _connect(args.address or _default_address())
     from ray_tpu.util import state as state_api
@@ -233,6 +270,12 @@ def main(argv=None):
     p.add_argument("entity", choices=["actors", "nodes", "jobs", "placement-groups"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory", help="object-ref debugging view "
+                                      "(per-process refcount tables)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("job", help="submit and manage jobs")
     jsub = p.add_subparsers(dest="job_command", required=True)

@@ -100,3 +100,59 @@ def timeline(filename: Optional[str] = None):
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+def memory_summary() -> Dict[str, Any]:
+    """Cluster-wide owned-object lifetime view (reference ``ray memory``:
+    every CoreWorker's reference table, grouped by process).
+
+    Pool workers are reached through their node's raylet; drivers through
+    the ``driver_addr`` they registered with their job.  Both legs are
+    best-effort — a process that died mid-query is skipped, like the
+    reference's memory_summary.
+    """
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcClient
+
+    w = _worker()
+    nodes = w.run_coro(w.gcs.call("get_all_nodes"))
+    jobs = w.run_coro(w.gcs.call("list_jobs")) or []
+
+    async def _fetch(addr: str, timeout: float):
+        client = RpcClient(addr)
+        try:
+            return await client.call("memory_report", timeout=timeout)
+        except Exception:  # noqa: BLE001 — dead/slow process: best-effort
+            return None
+        finally:
+            await client.close()
+
+    node_addrs = [n["addr"] for n in nodes if n.get("alive")]
+    driver_addrs = []
+    self_driver = False
+    for job in jobs:
+        addr = job.get("driver_addr")
+        if not addr or job.get("state") not in (None, "RUNNING"):
+            continue
+        if addr == w.serve_addr:
+            self_driver = True  # our own table: read on the loop, no RPC
+        else:
+            driver_addrs.append(addr)
+
+    async def _gather_all():
+        # every query is independent: wall time is the slowest single
+        # process, not the sum (raylet node leg caps workers at 5 s each,
+        # so 12 s bounds it)
+        node_f = [_fetch(a, 12.0) for a in node_addrs]
+        drv_f = [_fetch(a, 5.0) for a in driver_addrs]
+        results = await asyncio.gather(*node_f, *drv_f)
+        me = w.memory_report_local() if self_driver else None
+        return results[:len(node_f)], results[len(node_f):], me
+
+    node_reps, drv_reps, me = w.run_coro(_gather_all())
+    out: Dict[str, Any] = {
+        "nodes": [r for r in node_reps if r],
+        "drivers": ([me] if me else []) + [r for r in drv_reps if r],
+    }
+    return out
