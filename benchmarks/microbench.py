@@ -15,6 +15,10 @@ import time
 
 import numpy as np
 
+import os, sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import ray_tpu
 
 

@@ -25,6 +25,9 @@ import argparse
 import json
 import time
 
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def engine_bench(args) -> dict:
     import dataclasses
@@ -47,7 +50,8 @@ def engine_bench(args) -> dict:
     jax.block_until_ready(params)
     init_s = time.perf_counter() - t0
     eng = LLMEngine(cfg, params, batch_slots=args.slots,
-                    max_len=args.max_len, block_size=16)
+                    max_len=args.max_len, block_size=16,
+                    kv_cache_dtype=args.kv_dtype or None)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(3, min(cfg.vocab_size, 30000),
                             size=args.prompt_len).tolist()
@@ -98,6 +102,7 @@ def engine_bench(args) -> dict:
         "tokens_per_s": round(gen / wall, 1),
         "shared_prefix_tokens_per_s": round(shared_gen / shared_wall, 1),
         "decode_only_tokens_per_s": round(decode_tps, 1),
+        "kv_cache_dtype": args.kv_dtype or "bf16",
         "decode_window": eng.K,
         "prefix_cache": eng.blocks.stats,
     }
@@ -118,7 +123,8 @@ def serve_bench(args) -> dict:
     try:
         app = build_llm_deployment(
             {"model": args.model, "batch_slots": args.slots,
-             "max_len": args.max_len},
+             "max_len": args.max_len,
+             "kv_cache_dtype": args.kv_dtype or None},
             num_tpus_per_replica=1)
         port = 18499
         serve.start(http_options={"host": "127.0.0.1", "port": port,
@@ -158,6 +164,8 @@ def main():
     ap.add_argument("--prompt-len", type=int, default=64)
     ap.add_argument("--max-tokens", type=int, default=64)
     ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--kv-dtype", default="", choices=["", "int8"],
+                    help="int8: half-size KV pool, ~2x slots per chip")
     args = ap.parse_args()
     out = engine_bench(args) if args.mode == "engine" else serve_bench(args)
     print(json.dumps(out))

@@ -2056,10 +2056,9 @@ class CoreWorker:
         refcount table plus where each payload currently lives.  Call on
         the IO loop thread (the table mutates there)."""
         rows = self.ref_counter.memory_rows()
-        inline = self.memory_store._objects
         for row in rows:
             oid = ObjectID.from_hex(row["object_id"])
-            payload = inline.get(oid)
+            payload = self.memory_store.get(oid)
             if payload is not None:
                 row["where"] = "inline"
                 row["size"] = len(payload)

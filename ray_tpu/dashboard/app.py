@@ -234,6 +234,22 @@ def build_app(gcs) -> "object":
         except Exception as e:  # noqa: BLE001
             return web.Response(status=502, text=repr(e))
 
+    async def api_memory(_req):
+        """Cluster object-ref debugging view (the ``raytpu memory``
+        data): every node's pool-worker refcount tables + store stats,
+        fanned through the per-node raylets in parallel."""
+        async def ask(nid):
+            raylet = _raylet_for(nid)
+            if raylet is None:
+                return None
+            try:
+                return await raylet.call("memory_report", timeout=12.0)
+            except Exception:  # noqa: BLE001 — dying node: best-effort
+                return None
+
+        reps = await asyncio.gather(*(ask(nid) for nid in list(gcs.nodes)))
+        return jresp({"nodes": [r for r in reps if r]})
+
     async def api_node_logs(req):
         """Node-local log access, proxied through the node's raylet."""
         raylet = _raylet_for(req.match_info["node_id"])
@@ -270,6 +286,7 @@ def build_app(gcs) -> "object":
     app.router.add_get("/api/tasks/summary", api_tasks_summary)
     app.router.add_get("/api/timeline", api_timeline)
     app.router.add_get("/api/logs", api_logs)
+    app.router.add_get("/api/memory", api_memory)
     app.router.add_get("/api/node/{node_id}/stats", api_node_stats)
     app.router.add_get("/api/node/{node_id}/logs", api_node_logs)
     app.router.add_get("/api/metrics", api_metrics)

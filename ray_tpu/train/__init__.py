@@ -26,6 +26,7 @@ from ray_tpu.train.session import (
     TrainContext,
     get_context,
     get_dataset_shard,
+    profile,
     report,
 )
 from ray_tpu.train.trainer import (
@@ -39,6 +40,6 @@ __all__ = [
     "ScalingConfig", "DefaultFailurePolicy", "ElasticScalingPolicy",
     "FailureDecision", "FailurePolicy", "FixedScalingPolicy", "ResizeDecision",
     "ScalingPolicy", "TrainContext", "get_context", "get_dataset_shard",
-    "report", "DataParallelTrainer", "JaxTrainer",
+    "profile", "report", "DataParallelTrainer", "JaxTrainer",
     "initialize_jax_distributed",
 ]

@@ -123,3 +123,51 @@ def get_dataset_shard(name: str = "train"):
             raise KeyError(f"no dataset shard named {name!r}; have {list(shards)}")
         return shards[name]
     return shards
+
+
+class _ProfileCapture:
+    """Context manager for ``ray_tpu.train.profile`` (device-level
+    profiler; complements the task-span chrome trace of
+    ``raytpu timeline``).  Reference counterpart: torch-profiler hooks in
+    ``ray.train`` callbacks; here it is ``jax.profiler.trace`` capturing
+    XLA/TPU execution (xplane + trace-viewer files, loadable in
+    TensorBoard or Perfetto)."""
+
+    def __init__(self, logdir: Optional[str] = None):
+        import os
+
+        if logdir is None:
+            base = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+            rank = _session.rank if _session is not None else 0
+            logdir = os.path.join(base, "profiles", f"rank{rank}")
+        self.logdir = logdir
+
+    def __enter__(self):
+        import os
+
+        import jax
+
+        os.makedirs(self.logdir, exist_ok=True)
+        jax.profiler.start_trace(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.profiler.stop_trace()
+        return False
+
+
+def profile(logdir: Optional[str] = None) -> _ProfileCapture:
+    """Capture a device-level profiler trace around training steps::
+
+        for step in range(10):
+            if step == 3:
+                prof = train.profile().__enter__()
+            state, m = train_step(state, batch)
+            if step == 5:
+                prof.__exit__()
+
+    or as a context manager around a block of steps.  Writes per-rank
+    trace directories under the session dir by default."""
+    return _ProfileCapture(logdir)

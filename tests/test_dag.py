@@ -463,11 +463,11 @@ class TestJitFusion:
             assert len(tasks) == 1
             assert len(tasks[0]["fused"]) == 3
             x = np.ones(4, np.float32)
-            out = compiled.execute(x).get(timeout=30)
+            out = compiled.execute(x).get(timeout=90)
             np.testing.assert_allclose(
                 np.asarray(out), x * 4.0 + np.arange(4, dtype=np.float32))
             # second iteration reuses the traced program
-            out2 = compiled.execute(2 * x).get(timeout=30)
+            out2 = compiled.execute(2 * x).get(timeout=90)
             np.testing.assert_allclose(
                 np.asarray(out2), x * 8.0 + np.arange(4, dtype=np.float32))
         finally:
@@ -486,7 +486,7 @@ class TestJitFusion:
             assert len(tasks[0]["fused"]) == 2
             assert len(tasks[0]["emit"]) == 2  # a and b both leave the run
             x = np.ones(4, np.float32)
-            out = compiled.execute(x).get(timeout=30)
+            out = compiled.execute(x).get(timeout=90)
             np.testing.assert_allclose(np.asarray(out), x * 2.0 + x * 4.0)
         finally:
             compiled.teardown()
@@ -499,9 +499,9 @@ class TestJitFusion:
         compiled = dag.experimental_compile()
         try:
             with pytest.raises(Exception, match="kapow"):
-                compiled.execute(np.ones(4, np.float32)).get(timeout=30)
+                compiled.execute(np.ones(4, np.float32)).get(timeout=90)
             with pytest.raises(Exception, match="kapow"):
-                compiled.execute(np.ones(4, np.float32)).get(timeout=30)
+                compiled.execute(np.ones(4, np.float32)).get(timeout=90)
         finally:
             compiled.teardown()
 
@@ -520,7 +520,7 @@ class TestJitFusion:
             spec_a = compiled._exec_specs[wa._actor_id]
             assert len(spec_a["tasks"]) == 2  # NOT fused across the B read
             x = np.ones(4, np.float32)
-            out = compiled.execute(x).get(timeout=30)
+            out = compiled.execute(x).get(timeout=90)
             np.testing.assert_allclose(np.asarray(out), x * 6.0)
         finally:
             compiled.teardown()
@@ -534,7 +534,7 @@ class TestJitFusion:
         compiled = dag.experimental_compile()
         try:
             x = np.ones(4, np.float32)
-            oa, ob = compiled.execute(x).get(timeout=30)
+            oa, ob = compiled.execute(x).get(timeout=90)
             np.testing.assert_allclose(np.asarray(oa), x * 2.0)
             np.testing.assert_allclose(
                 np.asarray(ob), x * 2.0 + np.arange(4, dtype=np.float32))
@@ -559,7 +559,7 @@ class TestJitFusion:
             assert len(spec_w["tasks"][0]["fused"]) == 2
             ref = compiled.execute(np.ones(4, np.float32))
             with pytest.raises(Exception, match="kapow"):
-                ref.get(timeout=30)
+                ref.get(timeout=90)
         finally:
             compiled.teardown()
         # consumer.add ran on a's real value (not a poisoned TaskError)
@@ -577,6 +577,6 @@ class TestJitFusion:
         try:
             ref = compiled.execute(1, 2)
             with pytest.raises(Exception, match="multiple"):
-                ref.get(timeout=30)
+                ref.get(timeout=90)
         finally:
             compiled.teardown()

@@ -304,3 +304,103 @@ def test_oversized_request_fails_alone(tiny_model):
     assert outs[bad].error and "KV pool" in outs[bad].error
     assert not outs[bad].token_ids
     assert outs[good].error is None and len(outs[good].token_ids) == 4
+
+
+def test_int8_kv_pool_logits_close_to_bf16(tiny_model):
+    """kv_cache_dtype="int8" (half-size pool -> ~2x slots on chip): the
+    quantized decode step's logits must track the full-precision pool
+    closely.  (Token-exact greedy parity is NOT asserted: a random tiny
+    model's logit gaps are smaller than 1% quantization noise; on trained
+    weights per-token-per-head int8 KV is a standard accuracy-neutral
+    config — vLLM kv_cache_dtype.)"""
+    import numpy as np
+
+    from ray_tpu.models.paged_generation import (
+        init_kv_pool,
+        paged_decode_step,
+        prefill_suffix,
+    )
+
+    cfg, params = tiny_model
+    bs, MB = 4, 8
+    prompt = jnp.array([[3, 4, 5, 6, 7, 9, 8, 2]], jnp.int32)
+    S = prompt.shape[1]
+    no_prefix_k = jnp.zeros((cfg.num_layers, bs, cfg.num_kv_heads,
+                             cfg.resolved_head_dim), cfg.dtype)
+    dst_blocks = jnp.arange(S, dtype=jnp.int32) // bs + 1
+    dst_offsets = jnp.arange(S, dtype=jnp.int32) % bs
+    tables = jnp.concatenate(
+        [jnp.arange(1, 3, dtype=jnp.int32),
+         jnp.zeros(MB - 2, jnp.int32)])[None]
+
+    logits = {}
+    for kv_dtype in (None, "int8"):
+        pool = init_kv_pool(cfg, 16, bs, kv_dtype=kv_dtype)
+        first, pool = prefill_suffix(
+            params, prompt, jnp.int32(S), jnp.int32(0), no_prefix_k,
+            no_prefix_k, jnp.int32(0), dst_blocks, dst_offsets, pool,
+            cfg=cfg)
+        tok = jnp.argmax(first, axis=-1).astype(jnp.int32)
+        step, pool = paged_decode_step(
+            params, tok, jnp.array([S], jnp.int32), tables, pool, cfg=cfg)
+        logits[kv_dtype or "ref"] = (np.asarray(first, np.float32),
+                                     np.asarray(step, np.float32))
+
+    for ref, q in zip(logits["ref"], logits["int8"]):
+        denom = np.abs(ref).max() or 1.0
+        rel = np.abs(ref - q).max() / denom
+        assert rel < 0.05, f"int8 KV logits off by {rel:.3f}"
+
+
+def test_int8_kv_engine_flow(tiny_model):
+    """The int8-pool engine runs the full continuous-batching + prefix
+    cache flow deterministically (greedy decode twice -> same tokens,
+    quantized cached blocks reused)."""
+    from ray_tpu.llm import LLMEngine
+
+    cfg, params = tiny_model
+    sp = SamplingParams(temperature=0.0, max_tokens=6)
+    eng = LLMEngine(cfg, params, batch_slots=2, max_len=64, block_size=4,
+                    kv_cache_dtype="int8")
+    assert eng.pool["k"].dtype.name == "int8" and "k_scale" in eng.pool
+    prompts = [[3, 4, 5, 6, 7], [9, 8]]
+    out1 = eng.generate(prompts, sp)
+    out2 = eng.generate(prompts, sp)
+    assert [o.token_ids for o in out1] == [o.token_ids for o in out2]
+    assert all(len(o.token_ids) == 6 for o in out1)
+    system = list(range(3, 3 + 24))
+    ref = eng.generate([system + [50, 51]], sp)[0]
+    hit = eng.generate([system + [50, 51]], sp)[0]
+    assert eng.blocks.stats["prefix_hits"] >= 1
+    assert hit.token_ids == ref.token_ids
+
+
+def test_int8_kv_folded_attend_matches_eager(tiny_model, monkeypatch):
+    """Above INT8_FOLD_MIN_CONTEXT the decode step keeps KV quantized
+    through the scale-folded attend; the fold is mathematically the same
+    dequantize (scales are constant along hd), so logits must match the
+    eager-dequant path almost exactly."""
+    import numpy as np
+
+    from ray_tpu.models import paged_generation as pg
+
+    cfg, params = tiny_model
+    bs, MB = 4, 8
+    pool = pg.init_kv_pool(cfg, 16, bs, kv_dtype="int8")
+    tables = jnp.concatenate(
+        [jnp.arange(1, 3, dtype=jnp.int32),
+         jnp.zeros(MB - 2, jnp.int32)])[None]
+    tok = jnp.array([5], jnp.int32)
+    # write a few positions so the cache is non-trivial
+    for pos in range(4):
+        _, pool = pg.paged_decode_step(
+            params, tok, jnp.array([pos], jnp.int32), tables, pool,
+            cfg=cfg)
+    eager, _ = pg.paged_decode_step(
+        params, tok, jnp.array([4], jnp.int32), tables, pool, cfg=cfg)
+    monkeypatch.setattr(pg, "INT8_FOLD_MIN_CONTEXT", 1)
+    folded, _ = pg.paged_decode_step(
+        params, tok, jnp.array([4], jnp.int32), tables, pool, cfg=cfg)
+    np.testing.assert_allclose(np.asarray(eager, np.float32),
+                               np.asarray(folded, np.float32),
+                               rtol=2e-2, atol=2e-2)

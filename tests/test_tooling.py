@@ -470,4 +470,8 @@ def test_memory_summary(ray_start):
             "has_lineage"} <= set(blob)
     # node leg aggregates pool workers without error
     assert isinstance(summary["nodes"], list)
+    # the dashboard serves the same view
+    dash = _get_json(f"{ray_tpu.dashboard_url()}/api/memory", timeout=30)
+    assert isinstance(dash["nodes"], list) and dash["nodes"]
+    assert all("workers" in n and "store" in n for n in dash["nodes"])
     ray_tpu.kill(h)
