@@ -67,11 +67,12 @@ def _gqa_attend_quant(q, k_q, ks, v_q, vs, mask):
     the contracted ``hd`` axis: ``q·(k_q·s) == (q·k_q)·s`` and
     ``(p·s)·v_q == p·(v_q·s)``.
 
-    Measured on v5e @ 7B decode: wins at LONG context (194 vs 160 tok/s
-    at 512) where the avoided dequant-materialization traffic dominates,
-    loses at short context (230 vs 295 at 176) where the int8-operand
-    dot's slower mixed-precision path dominates — callers gate on
-    context length (``paged_generation.INT8_FOLD_MIN_CONTEXT``).
+    Measured on v5e @ 7B decode: wins at LARGE table capacity (194 vs
+    160 tok/s at max_len 512) where the avoided dequant-materialization
+    traffic dominates, loses at small capacity (230 vs 295 at max_len
+    176) where the int8-operand dot's slower mixed-precision path
+    dominates — callers gate on block-table capacity
+    (``paged_generation.INT8_FOLD_MIN_CONTEXT``).
 
     q [b,sq,H,hd]; k_q/v_q [b,sk,KVH,hd] int8; ks/vs [b,sk,KVH];
     mask [b,sq,sk].

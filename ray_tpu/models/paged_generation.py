@@ -81,12 +81,16 @@ def _store_kv(pool, i, blk, off, k, v):
     return pool
 
 
-# Context length (in cached tokens) above which the int8 decode path
-# keeps KV quantized through attention (scale-folded dots) instead of
-# dequantizing eagerly in the gather.  Measured crossover on v5e @ 7B:
-# eager wins at 176 ctx (295 vs 230 tok/s — the int8-operand dot's
-# mixed-precision path is slower), folded wins at 512 ctx (194 vs 160 —
-# the avoided [b, T, KVH, hd] dequant materialization dominates).
+# BLOCK-TABLE CAPACITY (MB*bs == the engine's max_len, in tokens) above
+# which the int8 decode path keeps KV quantized through attention
+# (scale-folded dots) instead of dequantizing eagerly in the gather.
+# Capacity — not the sequences' true lengths — is the right knob: the
+# decode step always gathers the full static table width, so the
+# dequant-materialization cost scales with capacity.  Measured crossover
+# on v5e @ 7B: eager wins at max_len 176 (295 vs 230 tok/s — the
+# int8-operand dot's mixed-precision path is slower), folded wins at
+# max_len 512 (194 vs 160 — the avoided [b, max_len, KVH, hd] dequant
+# materialization dominates).
 INT8_FOLD_MIN_CONTEXT = 384
 
 
@@ -94,9 +98,9 @@ def _gather_kv(pool, i, block_tables, dt):
     """Gather one layer's KV for [b, MB] block tables.
 
     Dense pool -> ``(k, v)`` in dt.  Int8 pool -> eager-dequantized
-    ``(k, v)`` below ``INT8_FOLD_MIN_CONTEXT`` cached tokens, still-
-    quantized ``(k_q, ks, v_q, vs)`` above it (consumed by the
-    scale-folded attend) — see the crossover note above."""
+    ``(k, v)`` below ``INT8_FOLD_MIN_CONTEXT`` tokens of table CAPACITY
+    (max_len), still-quantized ``(k_q, ks, v_q, vs)`` above it (consumed
+    by the scale-folded attend) — see the crossover note above."""
     k = pool["k"][i][block_tables]
     v = pool["v"][i][block_tables]
     if "k_scale" in pool:

@@ -1,0 +1,6 @@
+"""Utility APIs (reference ``ray.util``): ActorPool, Queue, metrics,
+placement groups, scheduling strategies, state, collective, shims."""
+
+from ray_tpu.util.actor_pool import ActorPool
+
+__all__ = ["ActorPool"]

@@ -1,0 +1,163 @@
+"""Fixed-pool actor work distribution.
+
+Parity: ``python/ray/util/actor_pool.py:13`` (``ActorPool``: map /
+map_unordered / submit / get_next / get_next_unordered / has_next /
+has_free / pop_idle / push).  Rebuilt over ``ray_tpu.wait``: a FIFO of
+idle actors, a FIFO of not-yet-dispatched submissions (work queued when
+every actor is busy dispatches as completions free actors), and a
+dispatch-order deque driving the ordered fetch path.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    """Operate on a fixed pool of actors::
+
+        pool = ActorPool([Actor.remote(), Actor.remote()])
+        out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    """
+
+    def __init__(self, actors: List[Any]):
+        self._idle: collections.deque = collections.deque(actors)
+        self._queued: collections.deque = collections.deque()  # (fn, value)
+        self._owner: dict = {}     # in-flight ref -> actor
+        self._ordered: collections.deque = collections.deque()  # dispatch order
+        self._consumed: set = set()  # refs taken by get_next_unordered
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """Schedule ``fn(actor, value)`` on the next free actor; queued
+        until one frees if all are busy."""
+        self._queued.append((fn, value))
+        self._dispatch()
+
+    def _dispatch(self) -> None:
+        while self._idle and self._queued:
+            fn, value = self._queued.popleft()
+            actor = self._idle.popleft()
+            ref = fn(actor, value)
+            self._owner[ref] = actor
+            self._ordered.append(ref)
+
+    def _return_actor(self, ref) -> None:
+        self._idle.append(self._owner.pop(ref))
+        self._dispatch()
+
+    # -- retrieval ---------------------------------------------------------
+
+    def has_next(self) -> bool:
+        return bool(self._owner) or bool(self._queued)
+
+    def get_next(self, timeout: Optional[float] = None,
+                 ignore_if_timedout: bool = False) -> Any:
+        """Next result in SUBMISSION order (blocks up to ``timeout``)."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            while self._ordered and self._ordered[0] in self._consumed:
+                self._consumed.discard(self._ordered.popleft())
+            if self._ordered:
+                ref = self._ordered[0]
+                break
+            # head-of-line task still queued: absorb a completion so an
+            # actor frees and dispatch pulls it in
+            if not self._wait_any(deadline):
+                if ignore_if_timedout:
+                    return None
+                raise TimeoutError("get_next timed out")
+        t = None if deadline is None else max(0.0, deadline - time.monotonic())
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=t)
+        if not ready:
+            if ignore_if_timedout:
+                return None
+            raise TimeoutError("get_next timed out")
+        self._ordered.popleft()
+        self._return_actor(ref)
+        return ray_tpu.get(ref)
+
+    def get_next_unordered(self, timeout: Optional[float] = None,
+                           ignore_if_timedout: bool = False) -> Any:
+        """Next result in COMPLETION order (blocks up to ``timeout``)."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._owner:  # everything still queued: cannot happen
+            if not self._wait_any(deadline):  # unless actors were popped
+                if ignore_if_timedout:
+                    return None
+                raise TimeoutError("get_next_unordered timed out")
+        t = None if deadline is None else max(0.0, deadline - time.monotonic())
+        ready, _ = ray_tpu.wait(list(self._owner), num_returns=1, timeout=t)
+        if not ready:
+            if ignore_if_timedout:
+                return None
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        self._consumed.add(ref)
+        self._return_actor(ref)
+        # trim consumed refs off the ordered head NOW: a pure-unordered
+        # consumer never calls get_next, and without this every result
+        # ref (and its payload, via refcounting) stays pinned for the
+        # pool's lifetime
+        while self._ordered and self._ordered[0] in self._consumed:
+            self._consumed.discard(self._ordered.popleft())
+        return ray_tpu.get(ref)
+
+    def _wait_any(self, deadline) -> bool:
+        if not self._owner:
+            return False
+        t = None if deadline is None else max(0.0, deadline - time.monotonic())
+        ready, _ = ray_tpu.wait(list(self._owner), num_returns=1, timeout=t)
+        return bool(ready)
+
+    # -- bulk --------------------------------------------------------------
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]):
+        """Apply over values; yields results in submission order."""
+        for v in values:
+            self.submit(fn, v)
+
+        def gen():
+            while self.has_next():
+                yield self.get_next()
+
+        return gen()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]):
+        """Apply over values; yields results as they complete."""
+        for v in values:
+            self.submit(fn, v)
+
+        def gen():
+            while self.has_next():
+                yield self.get_next_unordered()
+
+        return gen()
+
+    # -- pool management ---------------------------------------------------
+
+    def has_free(self) -> bool:
+        """True iff an actor is idle AND nothing is queued."""
+        return bool(self._idle) and not self._queued
+
+    def pop_idle(self) -> Optional[Any]:
+        """Remove and return an idle actor (None if all are busy)."""
+        if not self.has_free():
+            return None
+        return self._idle.popleft()
+
+    def push(self, actor: Any) -> None:
+        """Add an actor to the pool (queued work dispatches onto it)."""
+        self._idle.append(actor)
+        self._dispatch()

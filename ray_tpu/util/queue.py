@@ -1,0 +1,186 @@
+"""Distributed FIFO queue backed by an actor.
+
+Parity: ``python/ray/util/queue.py:21`` (``Queue`` over a ``_QueueActor``
+wrapping ``asyncio.Queue``; ``Empty``/``Full`` subclass the stdlib
+exceptions so existing handlers keep working).  The actor runs its queue
+on its own asyncio loop, so blocking ``put``/``get`` from many callers
+interleave without holding worker threads.
+"""
+
+from __future__ import annotations
+
+import queue as _stdlib_queue
+from typing import Any, Dict, Iterable, List, Optional
+
+import ray_tpu
+
+
+class Empty(_stdlib_queue.Empty):
+    pass
+
+
+class Full(_stdlib_queue.Full):
+    pass
+
+
+@ray_tpu.remote(num_cpus=0)
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+
+        self._q: "asyncio.Queue" = asyncio.Queue(maxsize)
+        self._maxsize = maxsize
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+    async def put(self, item: Any, timeout: Optional[float] = None) -> bool:
+        import asyncio
+
+        try:
+            await asyncio.wait_for(self._q.put(item), timeout)
+        except asyncio.TimeoutError:
+            return False
+        return True
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+
+        try:
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    def put_nowait(self, item: Any) -> bool:
+        import asyncio
+
+        try:
+            self._q.put_nowait(item)
+        except asyncio.QueueFull:
+            return False
+        return True
+
+    def put_nowait_batch(self, items: List[Any]) -> bool:
+        """All-or-nothing batch insert (reference semantics: rejected
+        whole if the batch would exceed maxsize)."""
+        if self._maxsize and self._q.qsize() + len(items) > self._maxsize:
+            return False
+        for it in items:
+            self._q.put_nowait(it)
+        return True
+
+    def get_nowait(self):
+        import asyncio
+
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    def get_nowait_batch(self, num_items: int):
+        if self._q.qsize() < num_items:
+            return False, None
+        return True, [self._q.get_nowait() for _ in range(num_items)]
+
+
+class Queue:
+    """Shared FIFO usable from any driver/task/actor holding a handle::
+
+        q = Queue(maxsize=100)
+        q.put(1)
+        q.get()            # 1
+    """
+
+    def __init__(self, maxsize: int = 0,
+                 actor_options: Optional[Dict] = None):
+        self.maxsize = maxsize
+        opts = actor_options or {}
+        self.actor = _QueueActor.options(**opts).remote(maxsize)
+
+    def __len__(self) -> int:
+        return self.size()
+
+    def size(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def qsize(self) -> int:
+        return self.size()
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self.actor.empty.remote())
+
+    def full(self) -> bool:
+        return ray_tpu.get(self.actor.full.remote())
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        if not block:
+            if not ray_tpu.get(self.actor.put_nowait.remote(item)):
+                raise Full
+            return
+        if not ray_tpu.get(self.actor.put.remote(item, timeout)):
+            raise Full
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if timeout is not None and timeout < 0:
+            raise ValueError("'timeout' must be a non-negative number")
+        if not block:
+            ok, item = ray_tpu.get(self.actor.get_nowait.remote())
+            if not ok:
+                raise Empty
+            return item
+        ok, item = ray_tpu.get(self.actor.get.remote(timeout))
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: Iterable[Any]) -> None:
+        items = list(items)
+        if not ray_tpu.get(self.actor.put_nowait_batch.remote(items)):
+            raise Full(f"batch of {len(items)} exceeds maxsize "
+                       f"{self.maxsize}")
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        if num_items < 0:
+            raise ValueError("'num_items' must be non-negative")
+        ok, items = ray_tpu.get(
+            self.actor.get_nowait_batch.remote(num_items))
+        if not ok:
+            raise Empty(f"fewer than {num_items} items in the queue")
+        return items
+
+    def shutdown(self, force: bool = False,
+                 grace_period_s: float = 5.0) -> None:
+        """Terminate the backing actor (pending handles error after)."""
+        if self.actor is None:
+            return
+        if force:
+            ray_tpu.kill(self.actor, no_restart=True)
+        else:
+            # graceful: let in-flight calls drain, then kill
+            import time
+
+            deadline = time.monotonic() + grace_period_s
+            while time.monotonic() < deadline:
+                try:
+                    ray_tpu.get(self.actor.qsize.remote(), timeout=1.0)
+                    break
+                except Exception:  # noqa: BLE001 — actor busy/dying
+                    time.sleep(0.1)
+            ray_tpu.kill(self.actor, no_restart=True)
+        self.actor = None

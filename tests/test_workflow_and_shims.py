@@ -194,3 +194,104 @@ def test_accelerator_detection_env(monkeypatch):
     env = {}
     TPUAcceleratorManager.set_visible_chips(env, [0, 2])
     assert env["TPU_VISIBLE_CHIPS"] == "0,2"
+
+
+class TestActorPool:
+    def test_map_ordered_and_unordered(self, ray_start):
+        @ray_tpu.remote
+        class Doubler:
+            def double(self, v):
+                return 2 * v
+
+        from ray_tpu.util import ActorPool
+
+        pool = ActorPool([Doubler.remote(), Doubler.remote()])
+        out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+        assert out == [2, 4, 6, 8]  # submission order, > pool size
+        out2 = sorted(pool.map_unordered(
+            lambda a, v: a.double.remote(v), [5, 6, 7]))
+        assert out2 == [10, 12, 14]
+
+    def test_submit_get_next_and_pool_management(self, ray_start):
+        import pytest as _pytest
+
+        @ray_tpu.remote
+        class Echo:
+            def echo(self, v):
+                return v
+
+        a1, a2 = Echo.remote(), Echo.remote()
+        from ray_tpu.util import ActorPool
+
+        pool = ActorPool([a1, a2])
+        assert pool.has_free() and not pool.has_next()
+        pool.submit(lambda a, v: a.echo.remote(v), "x")
+        assert pool.has_next()
+        assert pool.get_next(timeout=30) == "x"
+        with _pytest.raises(StopIteration):
+            pool.get_next()
+        # pop an idle actor out, push it back, queued work dispatches
+        popped = pool.pop_idle()
+        assert popped is not None
+        pool.submit(lambda a, v: a.echo.remote(v), 1)
+        pool.submit(lambda a, v: a.echo.remote(v), 2)
+        pool.submit(lambda a, v: a.echo.remote(v), 3)  # queues (1 actor)
+        pool.push(popped)
+        assert sorted(pool.get_next_unordered(timeout=30)
+                      for _ in range(3)) == [1, 2, 3]
+
+
+class TestQueue:
+    def test_fifo_put_get(self, ray_start):
+        from ray_tpu.util.queue import Queue
+
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert len(q) == 5 and not q.empty()
+        assert [q.get(timeout=30) for _ in range(5)] == list(range(5))
+        assert q.empty()
+        q.shutdown(force=True)
+
+    def test_maxsize_full_empty_and_batches(self, ray_start):
+        import pytest as _pytest
+
+        from ray_tpu.util.queue import Empty, Full, Queue
+
+        q = Queue(maxsize=2)
+        q.put(1)
+        q.put(2)
+        assert q.full()
+        with _pytest.raises(Full):
+            q.put_nowait(3)
+        with _pytest.raises(Full):
+            q.put(3, timeout=0.2)
+        assert q.get_nowait() == 1
+        q.put_nowait(3)
+        assert q.get_nowait_batch(2) == [2, 3]
+        with _pytest.raises(Empty):
+            q.get_nowait()
+        with _pytest.raises(Empty):
+            q.get(timeout=0.2)
+        with _pytest.raises(Empty):
+            q.get_nowait_batch(1)
+        q.put_nowait_batch([7, 8])
+        with _pytest.raises(Full):
+            q.put_nowait_batch([9])  # all-or-nothing over maxsize
+        assert q.get_nowait_batch(2) == [7, 8]
+        q.shutdown()
+
+    def test_queue_shared_across_tasks(self, ray_start):
+        from ray_tpu.util.queue import Queue
+
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(q, n):
+            for i in range(n):
+                q.put(i)
+            return n
+
+        assert ray_tpu.get(producer.remote(q, 4), timeout=60) == 4
+        assert sorted(q.get(timeout=30) for _ in range(4)) == [0, 1, 2, 3]
+        q.shutdown(force=True)
