@@ -42,6 +42,12 @@ def _write_block(block: pa.Table, path: str, file_format: str) -> str:
     return path
 
 
+@ray_tpu.remote
+def _write_numpy_block(block: pa.Table, path: str, column: str) -> str:
+    np.save(path, block.column(column).to_numpy(zero_copy_only=False))
+    return path
+
+
 class Dataset:
     def __init__(self, plan: L.LogicalPlan):
         self._plan = plan
@@ -138,6 +144,81 @@ class Dataset:
         n = mat.count()
         n_test = int(n * test_size)
         return mat.split_at_indices([n - n_test])
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Keep each row independently with probability ``fraction``
+        (reference ``Dataset.random_sample``).  With ``seed`` the draw is
+        deterministic per block position within the batch."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        def sample(batch):
+            import zlib
+
+            import numpy as _np
+
+            n = len(next(iter(batch.values()))) if batch else 0
+            if seed is None:
+                rng = _np.random.default_rng()
+            else:
+                # per-block stream: seeding every block identically would
+                # correlate the keep-mask across blocks (same positions
+                # kept everywhere); mix in a digest of the block's data so
+                # the draw is deterministic yet block-independent
+                first = _np.ascontiguousarray(next(iter(batch.values()))) \
+                    if batch else _np.empty(0)
+                rng = _np.random.default_rng(
+                    [seed, zlib.crc32(first.tobytes())])
+            keep = rng.random(n) < fraction
+            return {c: v[keep] for c, v in batch.items()}
+
+        return self.map_batches(sample)
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["MaterializedDataset"]:
+        """Split into ``len(proportions) + 1`` datasets; the last gets the
+        remainder (reference ``Dataset.split_proportionately``)."""
+        if not proportions:
+            raise ValueError("proportions must be non-empty")
+        if any(p <= 0 for p in proportions) or sum(proportions) >= 1.0:
+            raise ValueError(
+                "each proportion must be > 0 and their sum < 1.0")
+        mat = self.materialize()
+        n = mat.count()
+        indices = []
+        acc = 0.0
+        for p in proportions:
+            acc += p
+            indices.append(int(n * acc))
+        return mat.split_at_indices(indices)
+
+    def input_files(self) -> List[str]:
+        """Source file paths feeding this dataset (reference
+        ``Dataset.input_files``); empty for non-file sources.  Walks
+        EVERY input branch (union/join/zip have several)."""
+        files: List[str] = []
+        stack = [self._plan.dag]
+        while stack:
+            node = stack.pop()
+            stack.extend(getattr(node, "inputs", []) or [])
+            ds = getattr(node, "datasource", None)
+            files.extend(getattr(ds, "_paths", []) or [])
+        return files
+
+    def to_torch(self, **iter_kwargs):
+        """Iterable torch dataset over this Dataset's batches (reference
+        ``Dataset.to_torch`` economy form: wraps ``iter_torch_batches``
+        so ``torch.utils.data.DataLoader``-free loops work the same)."""
+        import torch
+
+        outer = self
+
+        class _IterableDS(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                return outer.iter_torch_batches(**iter_kwargs)
+
+        return _IterableDS()
 
     # -- execution ------------------------------------------------------------
 
@@ -339,14 +420,20 @@ class Dataset:
 
     # -- writes ---------------------------------------------------------------
 
-    def _write(self, path: str, file_format: str) -> List[str]:
+    def _write(self, path: str, file_format: str, submit=None) -> List[str]:
+        """One writer task per block.  ``submit(block_ref, fname)`` -> ref
+        customizes the per-block writer (default: format-tagged
+        ``write_block_file``)."""
+        if submit is None:
+            def submit(ref, fname):
+                return _write_block.remote(ref, fname, file_format)
         os.makedirs(path, exist_ok=True)
         refs = []
         i = 0
         for bundle in self._execute():
             for ref, _meta in bundle.blocks:
                 fname = os.path.join(path, f"part-{i:05d}.{file_format}")
-                refs.append(_write_block.remote(ref, fname, file_format))
+                refs.append(submit(ref, fname))
                 i += 1
         return ray_tpu.get(refs)
 
@@ -358,6 +445,14 @@ class Dataset:
 
     def write_json(self, path: str) -> List[str]:
         return self._write(path, "json")
+
+    def write_numpy(self, path: str, *, column: str) -> List[str]:
+        """One ``.npy`` file per block of ``column`` (reference
+        ``Dataset.write_numpy``); read back with ``read_numpy``."""
+        return self._write(
+            path, "npy",
+            submit=lambda ref, fname: _write_numpy_block.remote(
+                ref, fname, column))
 
     def stats(self) -> str:
         return self.explain()

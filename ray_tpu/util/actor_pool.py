@@ -62,18 +62,17 @@ class ActorPool:
         if not self.has_next():
             raise StopIteration("no pending results")
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            while self._ordered and self._ordered[0] in self._consumed:
-                self._consumed.discard(self._ordered.popleft())
-            if self._ordered:
-                ref = self._ordered[0]
-                break
-            # head-of-line task still queued: absorb a completion so an
-            # actor frees and dispatch pulls it in
-            if not self._wait_any(deadline):
-                if ignore_if_timedout:
-                    return None
-                raise TimeoutError("get_next timed out")
+        while self._ordered and self._ordered[0] in self._consumed:
+            self._consumed.discard(self._ordered.popleft())
+        if not self._ordered:
+            # every in-flight ref lives in _ordered, so an empty _ordered
+            # with pending work means everything is QUEUED and the pool
+            # has no actors (pop_idle drained it) — blocking would
+            # deadlock a single-threaded caller forever
+            raise RuntimeError(
+                "submissions are queued but the pool has no actors — "
+                "push() an actor to run them")
+        ref = self._ordered[0]
         t = None if deadline is None else max(0.0, deadline - time.monotonic())
         ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=t)
         if not ready:
@@ -90,11 +89,10 @@ class ActorPool:
         if not self.has_next():
             raise StopIteration("no pending results")
         deadline = None if timeout is None else time.monotonic() + timeout
-        while not self._owner:  # everything still queued: cannot happen
-            if not self._wait_any(deadline):  # unless actors were popped
-                if ignore_if_timedout:
-                    return None
-                raise TimeoutError("get_next_unordered timed out")
+        if not self._owner:  # everything queued and no actors to run it
+            raise RuntimeError(
+                "submissions are queued but the pool has no actors — "
+                "push() an actor to run them")
         t = None if deadline is None else max(0.0, deadline - time.monotonic())
         ready, _ = ray_tpu.wait(list(self._owner), num_returns=1, timeout=t)
         if not ready:
@@ -112,18 +110,19 @@ class ActorPool:
             self._consumed.discard(self._ordered.popleft())
         return ray_tpu.get(ref)
 
-    def _wait_any(self, deadline) -> bool:
-        if not self._owner:
-            return False
-        t = None if deadline is None else max(0.0, deadline - time.monotonic())
-        ready, _ = ray_tpu.wait(list(self._owner), num_returns=1, timeout=t)
-        return bool(ready)
-
     # -- bulk --------------------------------------------------------------
+
+    def _drain_stale(self) -> None:
+        """Discard results of earlier submit() calls so a map's output
+        contains exactly its own results (reference ActorPool.map
+        semantics)."""
+        while self.has_next():
+            self.get_next_unordered()
 
     def map(self, fn: Callable[[Any, Any], Any],
             values: Iterable[Any]):
         """Apply over values; yields results in submission order."""
+        self._drain_stale()
         for v in values:
             self.submit(fn, v)
 
@@ -136,6 +135,7 @@ class ActorPool:
     def map_unordered(self, fn: Callable[[Any, Any], Any],
                       values: Iterable[Any]):
         """Apply over values; yields results as they complete."""
+        self._drain_stale()
         for v in values:
             self.submit(fn, v)
 

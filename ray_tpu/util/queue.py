@@ -172,15 +172,12 @@ class Queue:
         if force:
             ray_tpu.kill(self.actor, no_restart=True)
         else:
-            # graceful: let in-flight calls drain, then kill
-            import time
-
-            deadline = time.monotonic() + grace_period_s
-            while time.monotonic() < deadline:
-                try:
-                    ray_tpu.get(self.actor.qsize.remote(), timeout=1.0)
-                    break
-                except Exception:  # noqa: BLE001 — actor busy/dying
-                    time.sleep(0.1)
-            ray_tpu.kill(self.actor, no_restart=True)
+            # graceful: __ray_terminate__ queues BEHIND in-flight calls
+            # (ordered actor queue), so pending puts/gets drain first;
+            # escalate to kill only if the grace period expires
+            ref = self.actor.__ray_terminate__.remote()
+            try:
+                ray_tpu.get(ref, timeout=grace_period_s)
+            except Exception:  # noqa: BLE001 — still blocked: escalate
+                ray_tpu.kill(self.actor, no_restart=True)
         self.actor = None

@@ -1,5 +1,7 @@
 """Tests for ray_tpu.data (reference test model: python/ray/data/tests/)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -304,3 +306,74 @@ def test_join_after_transforms(ray_start):
     joined = left.join(right, "k")
     assert joined.count() == 30
     assert all(r["w"] == r["k"] * 2 for r in joined.take(10))
+
+
+def test_random_sample(ray_start):
+    ds = rd.range(1000)
+    n = rd.range(1000).random_sample(0.3, seed=7).count()
+    assert 150 < n < 450  # ~300 expected
+    assert ds.random_sample(0.0).count() == 0
+    assert ds.random_sample(1.0).count() == 1000
+    with pytest.raises(ValueError):
+        ds.random_sample(1.5)
+
+
+def test_split_proportionately(ray_start):
+    parts = rd.range(100).split_proportionately([0.1, 0.3])
+    counts = [p.count() for p in parts]
+    assert counts == [10, 30, 60]
+    total = sum(r["id"] for p in parts for r in p.take_all())
+    assert total == sum(range(100))
+    with pytest.raises(ValueError):
+        rd.range(10).split_proportionately([0.5, 0.6])
+
+
+def test_write_read_numpy_roundtrip(ray_start, tmp_path):
+    import numpy as np
+
+    path = str(tmp_path / "np_out")
+    files = rd.range(50).repartition(4).write_numpy(path, column="id")
+    assert len(files) == 4
+    back = rd.read_numpy(os.path.join(path, "*.npy"))
+    vals = sorted(int(v) for r in back.take_all()
+                  for v in np.atleast_1d(r["data"] if "data" in r
+                                         else list(r.values())[0]))
+    assert vals == list(range(50))
+
+
+def test_input_files(ray_start, tmp_path):
+    path = str(tmp_path / "csv_out")
+    rd.range(10).write_csv(path)
+    ds = rd.read_csv(os.path.join(path, "*.csv"))
+    files = ds.input_files()
+    assert files and all(f.endswith(".csv") for f in files)
+    assert rd.range(5).input_files() == []
+
+
+def test_to_torch(ray_start):
+    import torch
+
+    tds = rd.range(8).to_torch(batch_size=4)
+    batches = list(iter(tds))
+    assert len(batches) == 2
+    assert all(isinstance(next(iter(b.values())), torch.Tensor)
+               for b in batches)
+
+
+def test_random_sample_blocks_uncorrelated(ray_start):
+    # seeded sampling must not apply the same keep-mask to every block
+    parts = rd.range(400).repartition(8).random_sample(0.5, seed=3)
+    kept = sorted(r["id"] for r in parts.take_all())
+    per_block = [sum(1 for v in kept if lo <= v < lo + 50)
+                 for lo in range(0, 400, 50)]
+    assert len(set(per_block)) > 1, per_block  # blocks drew differently
+
+
+def test_input_files_union_covers_both_branches(ray_start, tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    rd.range(5).write_csv(a)
+    rd.range(5).write_csv(b)
+    ds = rd.read_csv(os.path.join(a, "*.csv")).union(
+        rd.read_csv(os.path.join(b, "*.csv")))
+    files = ds.input_files()
+    assert any("/a/" in f for f in files) and any("/b/" in f for f in files)

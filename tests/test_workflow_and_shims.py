@@ -295,3 +295,34 @@ class TestQueue:
         assert ray_tpu.get(producer.remote(q, 4), timeout=60) == 4
         assert sorted(q.get(timeout=30) for _ in range(4)) == [0, 1, 2, 3]
         q.shutdown(force=True)
+
+    def test_map_drains_stale_results(self, ray_start):
+        @ray_tpu.remote
+        class Echo2:
+            def echo(self, v):
+                return v
+
+        from ray_tpu.util import ActorPool
+
+        pool = ActorPool([Echo2.remote()])
+        pool.submit(lambda a, v: a.echo.remote(v), 99)  # never consumed
+        out = list(pool.map(lambda a, v: a.echo.remote(v), [1, 2]))
+        assert out == [1, 2]  # the stale 99 is NOT in the map output
+
+    def test_no_actor_pool_errors_loudly(self, ray_start):
+        import pytest as _pytest
+
+        @ray_tpu.remote
+        class Echo3:
+            def echo(self, v):
+                return v
+
+        from ray_tpu.util import ActorPool
+
+        pool = ActorPool([Echo3.remote()])
+        pool.pop_idle()
+        pool.submit(lambda a, v: a.echo.remote(v), 1)
+        with _pytest.raises(RuntimeError, match="no actors"):
+            pool.get_next(timeout=5)
+        with _pytest.raises(RuntimeError, match="no actors"):
+            pool.get_next_unordered(timeout=5)
