@@ -1,0 +1,89 @@
+"""BASELINE row (b): Data.map_batches batch inference — batches/s.
+
+Reference target: "Data map_batches ImageNet inference — batches/s"
+(`BASELINE.md:72-81`; the reference's driver class is the
+`release/nightly_tests/dataset/` image-inference suite).  The reference
+repo publishes no absolute number, so the checked-in result is this
+box's absolute batches/s and images/s through the full framework path:
+
+  synthetic ImageNet-shaped blocks (uint8 [B, 224, 224, 3])
+  -> ``ray_tpu.data`` lazy plan -> streaming executor (byte-budget
+  backpressure) -> ``map_batches`` on a TPU actor (ActorPoolStrategy)
+  running ViT-B/16 bf16 inference, weights resident in HBM.
+
+Run: ``python benchmarks/data_inference_bench.py [--blocks N] [--batch B]``
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+class ViTInfer:
+    """map_batches actor: owns the chip, weights stay in HBM."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.vit import ViTConfig, vit_apply, vit_init
+
+        cfg = ViTConfig(dtype=jnp.bfloat16)  # ViT-B/16, 86M params
+        self.cfg = cfg
+        self.params = vit_init(jax.random.PRNGKey(0), cfg)
+        self._apply = jax.jit(lambda p, x: jnp.argmax(
+            vit_apply(p, x, cfg), axis=-1))
+        self._jnp = jnp
+
+    def __call__(self, batch):
+        x = self._jnp.asarray(batch["image"], self._jnp.bfloat16) / 127.5 - 1.0
+        pred = self._apply(self.params, x)
+        return {"pred": np.asarray(pred)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    import ray_tpu
+    import ray_tpu.data as rd
+    from ray_tpu.data import ActorPoolStrategy
+
+    ray_tpu.init(num_cpus=4, num_tpus=1)
+    try:
+        rng = np.random.default_rng(0)
+        items = [{"image": rng.integers(
+            0, 255, (args.batch, 224, 224, 3), dtype=np.uint8)}
+            for _ in range(args.blocks)]
+        ds = rd.from_items(items, parallelism=args.blocks)
+        ds = ds.map_batches(
+            ViTInfer, compute=ActorPoolStrategy(size=1), batch_size=None,
+            num_tpus=1)
+        # warm pass 1 block (compile + actor start excluded from timing)
+        _ = ds.limit(1).take_all()
+        t0 = time.perf_counter()
+        out = ds.take_all()
+        dt = time.perf_counter() - t0
+        n_imgs = sum(np.asarray(r["pred"]).size
+                     for r in out) if out and hasattr(
+            out[0]["pred"], "__len__") else len(out)
+        n_imgs = args.blocks * args.batch
+        print(json.dumps({
+            "benchmark": "data_map_batches_inference",
+            "model": "ViT-B/16 bf16 (ImageNet-shaped 224x224)",
+            "batches_per_s": round(args.blocks / dt, 2),
+            "images_per_s": round(n_imgs / dt, 1),
+            "batch_size": args.batch,
+            "blocks": args.blocks,
+            "wall_s": round(dt, 2),
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

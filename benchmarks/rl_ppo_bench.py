@@ -1,0 +1,83 @@
+"""BASELINE row (c): PPO throughput — env-steps/s and learner-updates/s.
+
+Reference target: "RLlib-equivalent PPO Breakout multi-learner —
+env-steps/s" (`BASELINE.md:72-81`; the reference's drivers are
+`release/rllib_tests/`).  Breakout needs the ALE ROM stack, which is not
+in this image, so the environment is CartPole in both of this driver's
+modes; the measured quantity — runtime env-step + learner-update
+throughput through the framework's RL stack — is the same.
+
+Two modes, both through ``ray_tpu.rl`` (AlgorithmConfig -> PPO):
+
+* **vectorized**  (num_env_runners=0): the jax CartPole vector env rides
+  the chip inside one ``lax.scan`` rollout; measures the TPU-native
+  single-process ceiling.
+* **distributed** (num_env_runners=N): env-runner ACTORS sample in
+  parallel, the learner updates on the chip, weights broadcast each
+  iteration — the reference's multi-learner topology.
+
+Run: ``python benchmarks/rl_ppo_bench.py [--iters N]``
+"""
+
+import argparse
+import json
+import time
+
+
+def run_mode(num_runners: int, iters: int, num_envs: int, frag: int):
+    from ray_tpu.rl import AlgorithmConfig, PPO
+
+    cfg = (AlgorithmConfig(PPO)
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=num_runners,
+                        num_envs_per_env_runner=num_envs,
+                        rollout_fragment_length=frag)
+           .training(lr=3e-4, num_epochs=2, num_minibatches=4))
+    algo = cfg.build()
+    algo.train()  # compile + first sync excluded
+    t0 = time.perf_counter()
+    steps = 0
+    updates = 0
+    for _ in range(iters):
+        m = algo.train()
+        steps += m["env_steps_this_iter"]
+        updates += 1
+    dt = time.perf_counter() - t0
+    if getattr(algo, "runner_group", None) is not None:
+        algo.runner_group.stop()
+    return {
+        "env_steps_per_s": round(steps / dt, 1),
+        "learner_updates_per_s": round(updates / dt, 2),
+        "env_steps_total": steps,
+        "wall_s": round(dt, 2),
+        "final_reward_mean": round(float(m["episode_reward_mean"]), 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--runners", type=int, default=4)
+    args = ap.parse_args()
+
+    import ray_tpu
+
+    # vectorized mode needs no cluster; distributed mode needs actors
+    ray_tpu.init(num_cpus=max(4, args.runners + 1), num_tpus=1)
+    try:
+        vec = run_mode(0, args.iters, num_envs=1024, frag=128)
+        print(json.dumps({"benchmark": "rl_ppo_vectorized",
+                          "env": "CartPole-v1 (jax, on-device)",
+                          **vec}))
+        dist = run_mode(args.runners, max(4, args.iters // 4),
+                        num_envs=32, frag=128)
+        print(json.dumps({"benchmark": "rl_ppo_distributed",
+                          "env": "CartPole-v1",
+                          "num_env_runners": args.runners,
+                          **dist}))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

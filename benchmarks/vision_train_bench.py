@@ -1,0 +1,124 @@
+"""BASELINE row (a): vision training throughput, data-parallel trainer.
+
+Reference target: "Train ResNet-18 CIFAR-10 data-parallel — throughput
+parity per chip" (`BASELINE.md:72-81`; the reference's runnable driver
+class lives in `release/air_tests/`).  The reference repo publishes no
+absolute number for this row, so the checked-in result is the absolute
+per-chip throughput (images/s) plus model-FLOPs utilisation — the
+"parity" evidence is that the chip is compute-bound, not runtime-bound.
+
+TPU-native shape: a ResNet-18-class ViT (~14M params, CIFAR-10 geometry:
+32x32x3, 10 classes) trained bf16 through the real framework path —
+``ray_tpu.train.JaxTrainer`` -> gang-scheduled worker actor ->
+``make_vit_trainer`` (ShardedTrainer, GSPMD mesh).  On this one-chip host
+the worker group is 1 worker owning the chip; multi-worker DP is the
+same code path (proven on the virtual mesh by ``dryrun_multichip``).
+
+Run: ``python benchmarks/vision_train_bench.py [--steps N] [--batch B]``
+Prints one JSON line per phase and a final summary line.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def train_loop(config):
+    """Runs INSIDE the JaxTrainer worker (owns the chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import train
+    from ray_tpu.models.vit import ViTConfig, make_vit_trainer
+    from ray_tpu.models.training import default_optimizer
+    from ray_tpu.parallel import MeshConfig, create_mesh
+
+    batch = config["batch"]
+    steps = config["steps"]
+    cfg = ViTConfig(
+        image_size=32, patch_size=4, num_channels=3,
+        hidden_size=config["hidden"], num_layers=config["layers"],
+        num_heads=config["heads"], mlp_dim=config["mlp"], num_classes=10,
+        dtype=jnp.bfloat16,
+    )
+    n_dev = len(jax.devices())
+    mesh = create_mesh(MeshConfig(dp=n_dev), devices=jax.devices())
+    tr = make_vit_trainer(
+        cfg, mesh, optimizer=default_optimizer(warmup=10, decay_steps=1000))
+    state = tr.init_state(jax.random.PRNGKey(0))
+
+    key = jax.random.PRNGKey(1)
+    images = jax.random.normal(key, (batch, 32, 32, 3), jnp.bfloat16)
+    labels = jax.random.randint(key, (batch,), 0, 10)
+    b = tr.shard_batch({"images": images, "labels": labels})
+
+    state, m = tr.step(state, b)  # compile
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = tr.step(state, b)
+    loss = float(m["loss"])  # host readback syncs the device stream
+    dt = time.perf_counter() - t0
+    img_s = batch * steps / dt
+
+    # model FLOPs (fwd 2N + bwd 4N per matmul param-use) for MFU context
+    tokens = cfg.num_patches + 1
+    per_layer = 4 * cfg.hidden_size**2 + 2 * cfg.hidden_size * cfg.mlp_dim
+    dense = 6 * (per_layer * cfg.num_layers
+                 + cfg.patch_dim * cfg.hidden_size
+                 + cfg.hidden_size * cfg.num_classes) * tokens
+    attn = 12 * cfg.num_layers * tokens * tokens * cfg.hidden_size
+    flops_img = float(dense + attn)
+    train.report({
+        "loss": loss, "images_per_s": img_s,
+        "step_ms": dt / steps * 1e3,
+        "gflops_per_image": flops_img / 1e9,
+        "achieved_tflops": img_s * flops_img / 1e12,
+        "params_m": cfg.num_params() / 1e6,
+        "device": str(jax.devices()[0].device_kind),
+    })
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=384)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=6)
+    ap.add_argument("--mlp", type=int, default=1536)
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import train
+
+    ray_tpu.init(num_cpus=4, num_tpus=1)
+    try:
+        trainer = train.JaxTrainer(
+            train_loop,
+            train_loop_config=vars(args) | {"steps": args.steps},
+            scaling_config=train.ScalingConfig(
+                num_workers=1, resources_per_worker={"TPU": 1}),
+        )
+        result = trainer.fit()
+        if result.error is not None:
+            print(json.dumps({"error": str(result.error)}))
+            sys.exit(1)
+        m = result.metrics
+        print(json.dumps({
+            "benchmark": "vision_train_dp",
+            "model": f"vit-cifar {m['params_m']:.1f}M params",
+            "images_per_s_per_chip": round(m["images_per_s"], 1),
+            "step_ms": round(m["step_ms"], 2),
+            "achieved_tflops": round(m["achieved_tflops"], 2),
+            "gflops_per_image": round(m["gflops_per_image"], 2),
+            "loss": round(m["loss"], 4),
+            "device": m["device"],
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,14 @@ _FLAG_DEFS: Dict[str, Any] = {
     "worker_startup_timeout_s": 60.0,
     "idle_worker_kill_s": 300.0,
     "maximum_startup_concurrency": 4,
+    # fork-server worker spawning: one zygote process pays the
+    # interpreter+jax import once, workers fork from it in ~ms
+    # (reference WorkerPool prestart, src/ray/raylet/worker_pool.h)
+    "use_worker_zygote": 1,
+    # generous: the zygote's accept loop is serial (one ~ms fork per
+    # request), so a deep spawn backlog is delay, not failure — timing
+    # out after the request was sent risks a duplicate worker
+    "zygote_spawn_timeout_s": 60.0,
     # --- memory monitor / OOM killing ---
     # (reference src/ray/common/memory_monitor.h:52 +
     # worker_killing_policy*.h; refresh 0 disables)

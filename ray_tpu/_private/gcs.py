@@ -638,8 +638,8 @@ class GcsServer:
                 dedicated=True,
                 timeout=None,
             )
-            if "spillback" in lease:
-                # stale view; retry via pending queue
+            if "spillback" in lease or lease.get("retry_pg_pending"):
+                # stale view / PG still placing; retry via pending queue
                 if actor_id not in self._pending_actors:
                     self._pending_actors.append(actor_id)
                 return

@@ -195,6 +195,11 @@ class SharedObjectStore:
         with self._lock:
             self._created.pop(object_id, None)
 
+    def owns(self, object_id: ObjectID) -> bool:
+        """True when this process holds unlink responsibility."""
+        with self._lock:
+            return object_id in self._created
+
     # -- lifetime -------------------------------------------------------------
 
     def release(self, object_id: ObjectID):
@@ -620,6 +625,16 @@ class HybridObjectStore:
         independent-copy durability a chunked pull provides — without a
         second payload copy."""
         return self.segments.adopt(object_id)
+
+    def owns_locally(self, object_id: ObjectID) -> bool:
+        """True when this session already holds lifetime responsibility
+        for a local copy (arena/spill resident, or an owned/adopted
+        segment) — no ownership handshake needed before relying on it."""
+        if self.arena is not None and self.arena.contains(object_id):
+            return True
+        if self.segments.owns(object_id):
+            return True
+        return self.spill is not None and self.spill.contains(object_id)
 
     # -- lifetime --------------------------------------------------------------
 

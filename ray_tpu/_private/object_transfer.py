@@ -86,14 +86,31 @@ class ChunkedPuller:
         """Pull one object from the raylet at ``source_addr`` into the
         local store.  Returns True when the object is available locally."""
         if self._store.contains(object_id):
-            # already visible — possibly a foreign same-host segment this
-            # session doesn't own yet: adopt (idempotent for own objects,
-            # no-op for arena-resident ones) so it survives the creator's
-            # teardown
+            # already visible — possibly a foreign same-host segment a LIVE
+            # peer session still owns.  Adopting here would take unlink
+            # responsibility for a segment the owner is still serving (our
+            # teardown would unlink it under them), so adoption only happens
+            # after an explicit export handshake: the source disowns first,
+            # then we adopt.  If the handshake fails the object stays
+            # readable now; a later loss re-resolves via the chunked pull.
+            # Already-owned copies (arena/spill resident, or a previously
+            # adopted segment) skip the handshake entirely — at most one
+            # RPC per (object, session), not one per repeated get.
+            owns = (getattr(self._store, "owns_locally", None)
+                    or getattr(self._store, "owns", None))
+            if owns is not None and owns(object_id):
+                return True
             adopt = (getattr(self._store, "adopt_segment", None)
                      or getattr(self._store, "adopt", None))
             if adopt is not None:
-                adopt(object_id)
+                try:
+                    client = self._peer(source_addr)
+                    if await client.call(
+                            "export_object", oid=object_id.hex(),
+                            timeout=config.rpc_connect_timeout_s * 4):
+                        adopt(object_id)
+                except Exception:  # noqa: BLE001 — visible copy suffices
+                    pass
             return True
         existing = self._inflight.get(object_id)
         if existing is not None:

@@ -34,6 +34,34 @@ from ray_tpu._private.scheduling import NodeView, ResourceSet
 logger = logging.getLogger(__name__)
 
 
+class _ZygoteChild:
+    """Popen-shaped handle for a zygote-forked worker.  The process is
+    the ZYGOTE's child (the zygote reaps the zombie promptly), so the pid
+    can be RECYCLED — liveness is therefore (pid, /proc starttime)
+    identity, never a bare kill-0 probe: a recycled pid must read as
+    'worker dead', not as an unrelated process to keep leasing to (or
+    worse, to SIGKILL)."""
+
+    __slots__ = ("pid", "starttime", "returncode")
+
+    def __init__(self, pid: int, starttime):
+        self.pid = pid
+        self.starttime = starttime
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        from ray_tpu._private.worker_zygote import proc_starttime
+
+        now = proc_starttime(self.pid)
+        if now is None or (self.starttime is not None
+                           and now != self.starttime):
+            self.returncode = -1  # gone, or the pid was recycled
+            return self.returncode
+        return None
+
+
 class WorkerHandle:
     __slots__ = ("worker_id", "addr", "pid", "proc", "client", "lease", "dedicated", "started_at")
 
@@ -96,6 +124,14 @@ class Raylet:
         # set from heartbeat replies: publish worker logs only while some
         # driver is actually tailing the feed
         self._logs_wanted = False
+        # worker zygote (fork-server): one process pays interpreter+jax
+        # import, every worker is an os.fork() away (reference WorkerPool
+        # prestart, src/ray/raylet/worker_pool.h)
+        self._zygote_proc = None
+        self._zygote_sock = ""
+        # log paths of spawns whose zygote reply was lost — adopted (in
+        # order) when the forked child registers
+        self._lost_spawn_logs: List[str] = []
 
         self.server.register_all(self)
 
@@ -124,6 +160,8 @@ class Raylet:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop())
             )
+        if config.use_worker_zygote:
+            self._start_zygote()
         for _ in range(config.num_prestart_workers):
             self._start_worker()
         logger.info("raylet %s up at %s resources=%s", self.node_id[:8], self.addr,
@@ -322,7 +360,12 @@ class Raylet:
                     # pumps queued leases — same path as any other crash.
                     # Workers are session leaders (start_new_session=True),
                     # so killpg reaps any memory-hogging children too.
-                    if victim.pid:
+                    # identity-checked: a zygote-forked worker's pid can
+                    # be recycled once the zygote reaps it — never kill a
+                    # pid whose incarnation no longer matches
+                    stale = (isinstance(victim.proc, _ZygoteChild)
+                             and victim.proc.poll() is not None)
+                    if victim.pid and not stale:
                         try:
                             os.killpg(victim.pid, 9)
                         except (ProcessLookupError, PermissionError):
@@ -364,18 +407,118 @@ class Raylet:
 
     # ------------------------------------------------------------ worker pool
 
+    def _start_zygote(self):
+        """Launch the fork-server.  Failure is non-fatal: spawn falls
+        back to the Popen path until the zygote's socket appears."""
+        sock = os.path.join(self.session_dir, "sockets",
+                            f"zygote_{self.node_id[:12]}.sock")
+        os.makedirs(os.path.dirname(sock), exist_ok=True)
+        env = dict(os.environ)
+        env["RAY_TPU_ZYGOTE_SOCK"] = sock
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir,
+                                f"zygote-{self.node_id[:8]}.log"), "ab")
+        try:
+            self._zygote_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            self._zygote_sock = sock
+        except OSError as e:  # pragma: no cover - exec failure
+            logger.warning("worker zygote failed to start: %s", e)
+            self._zygote_proc = None
+            self._zygote_sock = ""
+
+    def _zygote_spawn_blocking(self, env: Dict[str, str], log_path: str):
+        """Ask the zygote to fork a worker (BLOCKING socket I/O — callers
+        run this on an executor thread, never on the event loop).
+        Returns ``(pid, starttime)`` or None (zygote not ready / wedged →
+        caller falls back to Popen)."""
+        import socket as _socket
+
+        from ray_tpu._private.worker_zygote import _recv_msg, _send_msg
+
+        if not self._zygote_sock or not os.path.exists(self._zygote_sock):
+            return None
+        alive = (self._zygote_proc is not None
+                 and self._zygote_proc.poll() is None)
+        if not alive:
+            return None
+        sent = False
+        try:
+            with _socket.socket(_socket.AF_UNIX,
+                                _socket.SOCK_STREAM) as conn:
+                conn.settimeout(config.zygote_spawn_timeout_s)
+                conn.connect(self._zygote_sock)
+                _send_msg(conn, {"env": env, "log_path": log_path})
+                sent = True
+                reply = _recv_msg(conn)
+            pid = reply.get("pid")
+            if not pid:
+                return None
+            return pid, reply.get("starttime")
+        except (OSError, ValueError, ConnectionError) as e:
+            if sent:
+                # the request reached the zygote: the fork very likely
+                # HAPPENED and only the reply was lost (backlog past the
+                # timeout).  Falling back to Popen now would spawn a
+                # DUPLICATE worker — report 'lost' instead; if the forked
+                # child lives it registers later (identity adopted at
+                # registration), if not the pool's accounting self-heals
+                # via the register/reaper paths.
+                logger.warning("zygote spawn reply lost (%s); not "
+                               "duplicating via Popen", e)
+                return "lost"
+            logger.debug("zygote unavailable, falling back to Popen: %s", e)
+            return None
+
     def _start_worker(self):
         self._starting += 1
-        env = dict(os.environ)
-        env.update(
-            RAY_TPU_SESSION_DIR=self.session_dir,
-            RAY_TPU_GCS_ADDR=self.gcs_addr,
-            RAY_TPU_RAYLET_ADDR=self.addr,
-            RAY_TPU_NODE_ID=self.node_id,
-        )
+        worker_env = {
+            "RAY_TPU_SESSION_DIR": self.session_dir,
+            "RAY_TPU_GCS_ADDR": self.gcs_addr,
+            "RAY_TPU_RAYLET_ADDR": self.addr,
+            "RAY_TPU_NODE_ID": self.node_id,
+        }
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{time.time_ns()}.log")
+        asyncio.ensure_future(self._spawn_worker_async(worker_env, log_path))
+
+    async def _spawn_worker_async(self, worker_env: Dict[str, str],
+                                  log_path: str):
+        """Spawn off the event loop: the zygote handshake (fast path,
+        ~ms fork instead of a ~2.4 s cold interpreter+imports start) runs
+        on an executor thread so a wedged zygote can never stall
+        heartbeats/leases/pulls for the whole node."""
+        loop = asyncio.get_event_loop()
+        got = await loop.run_in_executor(
+            None, self._zygote_spawn_blocking, worker_env, log_path)
+        if self._stopping:
+            # raced Raylet.stop(): the kill sweep already ran — never
+            # create a worker nothing will reap; kill a forked one
+            if isinstance(got, tuple):
+                from ray_tpu._private.process_utils import sigkill_tree
+
+                sigkill_tree(got[0])
+            return
+        if isinstance(got, tuple):
+            pid, starttime = got
+            self._spawned_procs[pid] = _ZygoteChild(pid, starttime)
+            self._worker_logs[pid] = {"path": log_path, "off": 0,
+                                      "buf": b"", "gone_ticks": 0}
+            return
+        if got == "lost":
+            # fork likely happened but the reply was lost: the child (if
+            # alive) registers on its own; don't double-spawn.  Release
+            # the startup slot — registration's decrement clamps at 0.
+            self._starting = max(0, self._starting - 1)
+            self._lost_spawn_logs.append(log_path)
+            return
+        env = dict(os.environ)
+        env.update(worker_env)
         out = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
@@ -389,7 +532,6 @@ class Raylet:
         # driver via the GCS log feed (reference log_monitor.py)
         self._worker_logs[proc.pid] = {"path": log_path, "off": 0,
                                        "buf": b"", "gone_ticks": 0}
-        return proc
 
     async def _log_monitor_loop(self):
         """Tail every worker's output file; push new complete lines to the
@@ -476,7 +618,20 @@ class Raylet:
                                 pass
 
     async def handle_register_worker(self, worker_id: bytes, addr: str, pid: int) -> Dict:
-        h = WorkerHandle(worker_id, addr, pid, self._spawned_procs.get(pid))
+        proc = self._spawned_procs.get(pid)
+        if proc is None:
+            # unknown pid (e.g. a zygote fork whose spawn reply was lost):
+            # adopt with a (pid, starttime) identity so liveness/kills
+            # never act on a recycled pid
+            from ray_tpu._private.worker_zygote import proc_starttime
+
+            proc = _ZygoteChild(pid, proc_starttime(pid))
+            self._spawned_procs[pid] = proc
+            if self._lost_spawn_logs and pid not in self._worker_logs:
+                self._worker_logs[pid] = {
+                    "path": self._lost_spawn_logs.pop(0), "off": 0,
+                    "buf": b"", "gone_ticks": 0}
+        h = WorkerHandle(worker_id, addr, pid, proc)
         self.workers[worker_id] = h
         self._starting = max(0, self._starting - 1)
         self.idle.append(h)
@@ -543,12 +698,12 @@ class Raylet:
                     raise RuntimeError(
                         "placement group removed or never created")
                 if asyncio.get_event_loop().time() > deadline:
-                    # bounded: an infeasible PG stays PENDING forever, and
-                    # every abandoned client retry would otherwise leave an
-                    # immortal poll loop hammering the GCS
-                    raise RuntimeError(
-                        "placement group still pending placement (bundles "
-                        "may exceed cluster capacity)")
+                    # bounded server-side poll: a PG that places slower than
+                    # the deadline (nodes joining, autoscaling) is NOT an
+                    # error — tell the client to re-issue the lease call
+                    # (reference ray queues such tasks until the PG places).
+                    # An abandoned client's poll loop still dies here.
+                    return {"retry_pg_pending": True}
                 await asyncio.sleep(0.25)
                 target = await self._pg_bundle_node(pg_id, bundle_index,
                                                     demand)
@@ -932,12 +1087,31 @@ class Raylet:
         # workers still mid-spawn (not yet registered).
         from ray_tpu._private.process_utils import sigkill_tree
 
-        pids = {h.pid for h in self.workers.values() if h.pid}
-        pids |= set(self._spawned_procs)
+        # identity-check zygote-forked pids (recyclable once the zygote
+        # reaps them) before bulk-killing; Popen pids are pinned zombies
+        # until we reap them, so they are safe as-is
+        live: set = set()
+        for h in list(self.workers.values()):
+            if not h.pid:
+                continue
+            if isinstance(h.proc, _ZygoteChild) and h.proc.poll() is not None:
+                continue
+            live.add(h.pid)
+        for pid, proc in self._spawned_procs.items():
+            if isinstance(proc, _ZygoteChild) and proc.poll() is not None:
+                continue
+            live.add(pid)
         self.workers.clear()
         self._spawned_procs.clear()
-        for pid in pids:
+        for pid in live:
             sigkill_tree(pid)
+        if self._zygote_proc is not None:
+            sigkill_tree(self._zygote_proc.pid)
+            self._zygote_proc = None
+            try:
+                os.unlink(self._zygote_sock)
+            except OSError:
+                pass
         try:
             await self.gcs.call("unregister_node", node_id=self.node_id)
         except Exception:

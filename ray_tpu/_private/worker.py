@@ -1129,7 +1129,8 @@ class CoreWorker:
 
     async def _acquire_lease(self, lease: _Lease, spec: TaskSpec):
         raylet = self.raylet
-        for _hop in range(16):
+        hops = 0
+        while hops < 16:
             strategy = spec.scheduling_strategy
             reply = await raylet.call(
                 "lease_worker",
@@ -1144,8 +1145,17 @@ class CoreWorker:
                 dedicated=spec.task_type == TaskType.ACTOR_CREATION_TASK,
                 timeout=config.worker_lease_timeout_s * 4,
             )
+            if reply.get("retry_pg_pending"):
+                # PG placing slower than the server's bounded poll — keep
+                # the task queued by re-issuing the lease call (does not
+                # count as a spillback hop; a removed PG raises server-side)
+                if spec.task_id in self._cancel_requested:
+                    raise exc.TaskCancelledError(
+                        f"task {spec.task_id.hex()[:8]} was cancelled")
+                continue
             if "spillback" in reply:
                 raylet = self._peer(reply["spillback"])
+                hops += 1
                 continue
             lease.worker_addr = reply["worker_addr"]
             lease.worker_id = reply["worker_id"]

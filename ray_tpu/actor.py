@@ -117,7 +117,9 @@ class ActorHandle:
             return refs[0]
         return refs
 
-    def __ray_terminate__(self):
+    @property
+    def __ray_terminate__(self) -> ActorMethod:
+        """Graceful in-band termination (parity: ray ActorHandle.__ray_terminate__)."""
         return ActorMethod(self, "__ray_terminate__")
 
     @property

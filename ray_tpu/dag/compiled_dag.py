@@ -257,12 +257,11 @@ def _exec_fused(instance, t: Dict[str, Any], resolve, local) -> None:
             if ch is not None:
                 ch.write(err)
         return
-    err = next((v for v in ext_vals if isinstance(v, TaskError)), None)
-    if err is not None:
-        for idx, ch in t["emit"]:
-            local[idx] = err
-            if ch is not None:
-                ch.write(err)
+    if any(isinstance(v, TaskError) for v in ext_vals):
+        # per-subtask propagation: only subtasks that (transitively) consume
+        # the failing input error; a fused sibling on a clean input path
+        # still emits its value — exactly the unfused semantics
+        _exec_fused_eager(instance, t, ext_vals, local)
         return
     fn = t.get("_fn")
     if fn is None:
@@ -437,7 +436,9 @@ def _exec_iterations(instance, spec, read_channels, tasks, coll_pool):
 # --------------------------------------------------------------------------
 
 class CompiledDAGRef:
-    """Result handle for one execute(); must be gotten in submission order."""
+    """Result handle for one execute().  Results may be gotten out of
+    submission order (earlier executions' values are buffered, capped by
+    ``max_buffered_results``); each ref can be gotten once."""
 
     def __init__(self, dag: "CompiledDAG", idx: int):
         self._dag = dag
@@ -452,12 +453,37 @@ class CompiledDAGRef:
         return f"CompiledDAGRef(idx={self._idx})"
 
 
+class CompiledDAGFuture:
+    """Awaitable result of ``execute_async()`` (reference:
+    ``compiled_dag_node.py:2633 execute_async`` → ``CompiledDAGFuture``).
+    Await resolves when this execution's outputs arrive; earlier
+    executions' results are drained into the buffer, so futures may be
+    awaited in any order and N>1 executions can be in flight."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._awaited = False
+
+    def __await__(self):
+        if self._awaited:
+            raise ValueError(
+                "a CompiledDAGFuture can only be awaited once")
+        self._awaited = True
+        return self._dag._await_result(self._idx).__await__()
+
+    def __repr__(self):
+        return f"CompiledDAGFuture(idx={self._idx})"
+
+
 class CompiledDAG:
     def __init__(self, root: DAGNode, *, buffer_size_bytes: int = 1 << 20,
-                 submit_timeout: float = 30.0):
+                 submit_timeout: float = 30.0,
+                 max_buffered_results: int = 1000):
         self.root = root
         self.buffer_size = buffer_size_bytes
         self.submit_timeout = submit_timeout
+        self.max_buffered_results = max_buffered_results
         self.dag_id = uuid.uuid4().hex
         self._input_channel: Optional[Channel] = None
         self._output_channels: List[Channel] = []
@@ -470,11 +496,19 @@ class CompiledDAG:
         # currently being gotten (lets a timed-out get() resume without
         # re-reading channels it already consumed)
         self._partial_values: List[Any] = []
+        # out-of-order delivery: executions drained past a waiter's index
+        # park here until their ref/future claims them
+        self._buffered_results: Dict[int, List[Any]] = {}
         self._torn_down = False
         # separate locks: a producer blocked in a backpressured execute()
         # must not prevent a consumer's get() from draining the pipeline
         self._submit_lock = threading.Lock()
         self._get_lock = threading.Lock()
+        self._drain_task: Optional[Any] = None  # eager async drainer
+        self._drain_error: Optional[BaseException] = None
+        # (loop, Event) pairs pulsed (threadsafe) after each drained
+        # execution so futures waiting on any event loop wake up
+        self._result_waiters: List[Any] = []
 
     # -- compilation -------------------------------------------------------
     def _compile(self) -> None:
@@ -697,36 +731,170 @@ class CompiledDAG:
             self._next_exec_idx += 1
             return ref
 
-    def _get_result(self, ref: CompiledDAGRef, timeout: Optional[float]):
+    async def execute_async(self, *args, **kwargs) -> CompiledDAGFuture:
+        """Asyncio twin of ``execute()``: submits without blocking the
+        event loop (the backpressured channel write runs on the default
+        executor) and returns an awaitable ``CompiledDAGFuture``.
+        Multiple executions may be in flight; an eager background drainer
+        moves completed executions into the result buffer (so pipelined
+        submits never deadlock on full output slots) and futures resolve
+        out-of-order-safely (reference:
+        ``compiled_dag_node.py:2633 execute_async``)."""
+        import asyncio
+
+        if self._torn_down:
+            raise RuntimeError("compiled DAG has been torn down")
+        loop = asyncio.get_event_loop()
+        # drain BEFORE blocking on the input write: submits past the
+        # pipeline depth only proceed as earlier executions retire.
+        # Cross-coroutine/-loop submit ordering comes from the threading
+        # _submit_lock inside the executor call (an asyncio.Lock here
+        # would bind to one loop and break multi-loop callers).
+        self._ensure_drainer()
+
+        def _submit():
+            with self._submit_lock:
+                self._input_channel.write((args, kwargs),
+                                          timeout=self.submit_timeout)
+                idx = self._next_exec_idx
+                self._next_exec_idx += 1
+                return idx
+
+        idx = await loop.run_in_executor(None, _submit)
+        self._ensure_drainer()
+        return CompiledDAGFuture(self, idx)
+
+    def _ensure_drainer(self) -> None:
+        """Start (or restart) the eager drain task on the current event
+        loop.  One drainer runs at a time; it exits when every submitted
+        execution has been drained into the buffer."""
+        import asyncio
+
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_error = None  # fresh drainer, fresh slate
+            self._drain_task = asyncio.ensure_future(self._drain_loop())
+
+    async def _drain_loop(self) -> None:
+        import asyncio
         import time
 
-        with self._get_lock:
-            if ref._has_result:
-                raise ValueError("a CompiledDAGRef can only be gotten once")
-            if ref._idx != self._next_get_idx:
-                raise ValueError(
-                    f"results must be gotten in submission order (next is "
-                    f"execution #{self._next_get_idx}, this ref is "
-                    f"#{ref._idx})")
-            # one deadline across ALL output channels; resume after a timeout
-            # from the first unread channel (each read consumes its ack slot,
-            # so re-reading a drained channel would desync the pipeline)
-            deadline = None if timeout is None else time.monotonic() + timeout
-            while len(self._partial_values) < len(self._output_channels):
-                ch = self._output_channels[len(self._partial_values)]
-                budget = (None if deadline is None
-                          else max(0.0, deadline - time.monotonic()))
-                self._partial_values.append(ch.read(budget))
-            values = self._partial_values
-            self._partial_values = []
-            self._next_get_idx += 1
-            ref._has_result = True
+        loop = asyncio.get_event_loop()
+        while not self._torn_down:
+            with self._get_lock:
+                drained_all = self._next_get_idx >= self._next_exec_idx
+            if drained_all:
+                break
+
+            def _drain_one():
+                # bounded budget per round: the drainer must not camp on
+                # _get_lock in a deadline-less read, or a concurrent sync
+                # ref.get(timeout=...) could never honor its timeout
+                with self._get_lock:
+                    if self._next_get_idx >= self._next_exec_idx:
+                        return
+                    self._read_next_execution(time.monotonic() + 0.25)
+
+            try:
+                await loop.run_in_executor(None, _drain_one)
+            except TimeoutError:  # partial drain; resume next round
+                continue
+            except Exception as e:  # noqa: BLE001 — closed channel /
+                # buffer-cap RuntimeError: record it so waiters RAISE
+                # instead of hanging on a silently-dead drainer
+                self._drain_error = e
+                break
+            finally:
+                self._pulse_waiters()
+        self._pulse_waiters()
+
+    def _pulse_waiters(self) -> None:
+        """Wake every future waiting on any event loop (threadsafe)."""
+        for lp, ev in list(self._result_waiters):
+            try:
+                lp.call_soon_threadsafe(ev.set)
+            except RuntimeError:  # that loop is closed; its waiter is gone
+                try:
+                    self._result_waiters.remove((lp, ev))
+                except ValueError:
+                    pass
+
+    def _read_next_execution(self, deadline) -> None:
+        """Read one full execution's outputs (in pipeline order) into the
+        result buffer.  Caller holds ``_get_lock``.  A timeout mid-way
+        leaves the partially-drained values in ``_partial_values`` so the
+        next attempt resumes from the first unread channel (each read
+        consumes its ack slot — re-reading would desync the pipeline)."""
+        import time
+
+        if len(self._buffered_results) >= self.max_buffered_results:
+            raise RuntimeError(
+                f"{len(self._buffered_results)} executions are buffered "
+                f"and unclaimed (max_buffered_results="
+                f"{self.max_buffered_results}); get()/await results to "
+                f"drain the pipeline")
+        while len(self._partial_values) < len(self._output_channels):
+            ch = self._output_channels[len(self._partial_values)]
+            budget = (None if deadline is None
+                      else max(0.0, deadline - time.monotonic()))
+            self._partial_values.append(ch.read(budget))
+        self._buffered_results[self._next_get_idx] = self._partial_values
+        self._partial_values = []
+        self._next_get_idx += 1
+
+    def _deliver(self, values: List[Any]):
         err = next((v for v in values if isinstance(v, TaskError)), None)
         if err is not None:
             raise err
         if isinstance(self.root, MultiOutputNode):
             return values
         return values[0]
+
+    def _get_result(self, ref: CompiledDAGRef, timeout: Optional[float]):
+        import time
+
+        if ref._has_result:
+            raise ValueError("a CompiledDAGRef can only be gotten once")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._get_lock:
+            while ref._idx not in self._buffered_results:
+                self._read_next_execution(deadline)
+            ref._has_result = True
+            values = self._buffered_results.pop(ref._idx)
+        return self._deliver(values)
+
+    async def _await_result(self, idx: int):
+        """Resolve one execution's result for ``CompiledDAGFuture``: the
+        eager drainer buffers executions as they retire; this waits for
+        ``idx``'s values on an event pulsed after every drained
+        execution (with a short timeout re-check as a safety net), so
+        futures resolve in any order — including from different event
+        loops."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        ev = asyncio.Event()
+        self._result_waiters.append((loop, ev))
+        try:
+            while True:
+                with self._get_lock:
+                    if idx in self._buffered_results:
+                        values = self._buffered_results.pop(idx)
+                        return self._deliver(values)
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG has been torn down")
+                if self._drain_error is not None:
+                    raise self._drain_error
+                self._ensure_drainer()
+                ev.clear()
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=0.25)
+                except asyncio.TimeoutError:
+                    pass  # re-check the buffer (missed-pulse safety net)
+        finally:
+            try:
+                self._result_waiters.remove((loop, ev))
+            except ValueError:
+                pass
 
     # -- teardown ----------------------------------------------------------
     def teardown(self, *, timeout: float = 10.0) -> None:

@@ -210,6 +210,8 @@ class LLMEngine:
         self.kv_cache_dtype = kv_cache_dtype
         self.pool = init_kv_pool(cfg, self.num_blocks, self.bs,
                                  kv_dtype=kv_cache_dtype)
+        if mesh is not None:
+            self._shard_over_mesh(mesh)
         self.blocks = _BlockManager(self.num_blocks)
         # multi-step window: K on-device steps chained without any host
         # sync (token/position/key stay device-resident), sampled tokens
@@ -244,6 +246,49 @@ class LLMEngine:
         self._dev_dirty = True
         # per-token hook for streaming consumers: on_token(request_id, tok)
         self.on_token: Optional[Any] = None
+
+    def _shard_over_mesh(self, mesh) -> None:
+        """Tensor-parallel inference: place params by the logical-axis rule
+        table (heads/kv_heads/mlp/vocab over the mesh's ``tp`` axis) and
+        the KV pool over its kv-head dim; every existing jitted program
+        (prefill, decode window, sampling) then compiles SPMD with XLA
+        inserting the collectives.  Reference capability:
+        ``ray.llm`` tensor_parallel_size → vLLM worker bundles
+        (``vllm_models.py:123-127``); here TP is a sharding spec, not a
+        process group.
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models.llama import llama_param_specs
+        from ray_tpu.parallel.sharding import (TP_INFERENCE_RULES,
+                                               shard_tree)
+
+        tp = int(mesh.shape.get("tp", 1))
+        if tp > 1:
+            if self.cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"num_kv_heads={self.cfg.num_kv_heads} not divisible "
+                    f"by tp={tp}")
+            if self.cfg.num_heads % tp:
+                raise ValueError(
+                    f"num_heads={self.cfg.num_heads} not divisible by "
+                    f"tp={tp}")
+        self.params = shard_tree(self.params, llama_param_specs(self.cfg),
+                                 mesh, TP_INFERENCE_RULES)
+        # pool tensors: [L, blocks, bs, KVH, hd] (values) and
+        # [L, blocks, bs, KVH] (int8 scales) — KVH is axis 3 in both.
+        # With a pp axis the layer dim shards alongside the stacked
+        # per-layer weights (each stage holds its own layers' KV).
+        pp = ("pp" if "pp" in mesh.axis_names
+              and int(mesh.shape.get("pp", 1)) > 1 else None)
+        if pp and self.cfg.num_layers % int(mesh.shape["pp"]):
+            raise ValueError(
+                f"num_layers={self.cfg.num_layers} not divisible by "
+                f"pp={int(mesh.shape['pp'])}")
+        kv_s = NamedSharding(mesh, P(pp, None, None, "tp"))
+        self.pool = {k: jax.device_put(v, kv_s)
+                     for k, v in self.pool.items()}
 
     # -- request API --------------------------------------------------------
 

@@ -6,6 +6,11 @@ has_free / pop_idle / push).  Rebuilt over ``ray_tpu.wait``: a FIFO of
 idle actors, a FIFO of not-yet-dispatched submissions (work queued when
 every actor is busy dispatches as completions free actors), and a
 dispatch-order deque driving the ordered fetch path.
+
+Stale-work semantics (``map`` after earlier ``submit`` calls): earlier
+submissions still EXECUTE (their side effects are preserved and their
+actors return to rotation on completion) but their results are never
+yielded by the new map — and the new map never blocks on them.
 """
 
 from __future__ import annotations
@@ -26,35 +31,54 @@ class ActorPool:
 
     def __init__(self, actors: List[Any]):
         self._idle: collections.deque = collections.deque(actors)
-        self._queued: collections.deque = collections.deque()  # (fn, value)
+        self._queued: collections.deque = collections.deque()  # (fn, value, stale)
         self._owner: dict = {}     # in-flight ref -> actor
         self._ordered: collections.deque = collections.deque()  # dispatch order
         self._consumed: set = set()  # refs taken by get_next_unordered
+        self._stale: set = set()   # in-flight refs whose results are discarded
 
     # -- submission --------------------------------------------------------
 
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         """Schedule ``fn(actor, value)`` on the next free actor; queued
         until one frees if all are busy."""
-        self._queued.append((fn, value))
+        self._queued.append((fn, value, False))
         self._dispatch()
 
     def _dispatch(self) -> None:
         while self._idle and self._queued:
-            fn, value = self._queued.popleft()
+            fn, value, stale = self._queued.popleft()
             actor = self._idle.popleft()
             ref = fn(actor, value)
             self._owner[ref] = actor
-            self._ordered.append(ref)
+            if stale:
+                self._stale.add(ref)  # executes, result never yielded
+            else:
+                self._ordered.append(ref)
 
     def _return_actor(self, ref) -> None:
         self._idle.append(self._owner.pop(ref))
         self._dispatch()
 
+    def _stale_inflight(self) -> List[Any]:
+        return [r for r in self._owner if r in self._stale]
+
+    def _reap_stale(self, timeout: Optional[float] = 0) -> None:
+        """Return actors of completed stale submissions (non-blocking by
+        default); their results are dropped."""
+        refs = self._stale_inflight()
+        if not refs:
+            return
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+        for ref in ready:
+            self._stale.discard(ref)
+            self._return_actor(ref)
+
     # -- retrieval ---------------------------------------------------------
 
     def has_next(self) -> bool:
-        return bool(self._owner) or bool(self._queued)
+        return (any(r not in self._stale for r in self._owner)
+                or any(not stale for _, _, stale in self._queued))
 
     def get_next(self, timeout: Optional[float] = None,
                  ignore_if_timedout: bool = False) -> Any:
@@ -62,26 +86,39 @@ class ActorPool:
         if not self.has_next():
             raise StopIteration("no pending results")
         deadline = None if timeout is None else time.monotonic() + timeout
-        while self._ordered and self._ordered[0] in self._consumed:
-            self._consumed.discard(self._ordered.popleft())
-        if not self._ordered:
-            # every in-flight ref lives in _ordered, so an empty _ordered
-            # with pending work means everything is QUEUED and the pool
-            # has no actors (pop_idle drained it) — blocking would
-            # deadlock a single-threaded caller forever
-            raise RuntimeError(
-                "submissions are queued but the pool has no actors — "
-                "push() an actor to run them")
-        ref = self._ordered[0]
-        t = None if deadline is None else max(0.0, deadline - time.monotonic())
-        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=t)
-        if not ready:
-            if ignore_if_timedout:
-                return None
-            raise TimeoutError("get_next timed out")
-        self._ordered.popleft()
-        self._return_actor(ref)
-        return ray_tpu.get(ref)
+        while True:
+            while self._ordered and self._ordered[0] in self._consumed:
+                self._consumed.discard(self._ordered.popleft())
+            stale = self._stale_inflight()
+            if self._ordered:
+                head = self._ordered[0]
+                waitset = [head] + stale
+            elif stale:
+                # all actors are busy with stale work; pending submissions
+                # dispatch as those complete — wait on the stale refs
+                head, waitset = None, stale
+            else:
+                # pending work is queued but the pool has no actors at all
+                # (pop_idle drained it) — blocking would deadlock a
+                # single-threaded caller forever
+                raise RuntimeError(
+                    "submissions are queued but the pool has no actors — "
+                    "push() an actor to run them")
+            t = (None if deadline is None
+                 else max(0.0, deadline - time.monotonic()))
+            ready, _ = ray_tpu.wait(waitset, num_returns=1, timeout=t)
+            if not ready:
+                if ignore_if_timedout:
+                    return None
+                raise TimeoutError("get_next timed out")
+            ref = ready[0]
+            if ref in self._stale:
+                self._stale.discard(ref)
+                self._return_actor(ref)
+                continue  # head not ready yet; keep waiting
+            self._ordered.popleft()
+            self._return_actor(ref)
+            return ray_tpu.get(ref)
 
     def get_next_unordered(self, timeout: Optional[float] = None,
                            ignore_if_timedout: bool = False) -> Any:
@@ -89,35 +126,50 @@ class ActorPool:
         if not self.has_next():
             raise StopIteration("no pending results")
         deadline = None if timeout is None else time.monotonic() + timeout
-        if not self._owner:  # everything queued and no actors to run it
-            raise RuntimeError(
-                "submissions are queued but the pool has no actors — "
-                "push() an actor to run them")
-        t = None if deadline is None else max(0.0, deadline - time.monotonic())
-        ready, _ = ray_tpu.wait(list(self._owner), num_returns=1, timeout=t)
-        if not ready:
-            if ignore_if_timedout:
-                return None
-            raise TimeoutError("get_next_unordered timed out")
-        ref = ready[0]
-        self._consumed.add(ref)
-        self._return_actor(ref)
-        # trim consumed refs off the ordered head NOW: a pure-unordered
-        # consumer never calls get_next, and without this every result
-        # ref (and its payload, via refcounting) stays pinned for the
-        # pool's lifetime
-        while self._ordered and self._ordered[0] in self._consumed:
-            self._consumed.discard(self._ordered.popleft())
-        return ray_tpu.get(ref)
+        while True:
+            if not self._owner:  # everything queued and no actors to run it
+                raise RuntimeError(
+                    "submissions are queued but the pool has no actors — "
+                    "push() an actor to run them")
+            t = (None if deadline is None
+                 else max(0.0, deadline - time.monotonic()))
+            ready, _ = ray_tpu.wait(list(self._owner), num_returns=1,
+                                    timeout=t)
+            if not ready:
+                if ignore_if_timedout:
+                    return None
+                raise TimeoutError("get_next_unordered timed out")
+            ref = ready[0]
+            if ref in self._stale:
+                self._stale.discard(ref)
+                self._return_actor(ref)
+                continue  # discarded result; keep waiting for live work
+            self._consumed.add(ref)
+            self._return_actor(ref)
+            # trim consumed refs off the ordered head NOW: a pure-unordered
+            # consumer never calls get_next, and without this every result
+            # ref (and its payload, via refcounting) stays pinned for the
+            # pool's lifetime
+            while self._ordered and self._ordered[0] in self._consumed:
+                self._consumed.discard(self._ordered.popleft())
+            return ray_tpu.get(ref)
 
     # -- bulk --------------------------------------------------------------
 
     def _drain_stale(self) -> None:
-        """Discard results of earlier submit() calls so a map's output
-        contains exactly its own results (reference ActorPool.map
-        semantics)."""
-        while self.has_next():
-            self.get_next_unordered()
+        """Mark every earlier submission stale so a map's output contains
+        exactly its own results (reference ActorPool.map semantics).
+        Non-blocking: completed stale results are reaped immediately with
+        a zero timeout; a still-RUNNING earlier submission must not hang
+        map() before any new work is submitted — it keeps executing (side
+        effects preserved) and its actor re-enters rotation on completion,
+        but its result is never yielded."""
+        self._stale.update(self._owner)
+        self._ordered.clear()
+        self._consumed.clear()
+        self._queued = collections.deque(
+            (fn, value, True) for fn, value, _ in self._queued)
+        self._reap_stale(timeout=0)
 
     def map(self, fn: Callable[[Any, Any], Any],
             values: Iterable[Any]):

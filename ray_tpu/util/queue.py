@@ -175,8 +175,8 @@ class Queue:
             # graceful: __ray_terminate__ queues BEHIND in-flight calls
             # (ordered actor queue), so pending puts/gets drain first;
             # escalate to kill only if the grace period expires
-            ref = self.actor.__ray_terminate__.remote()
             try:
+                ref = self.actor.__ray_terminate__.remote()
                 ray_tpu.get(ref, timeout=grace_period_s)
             except Exception:  # noqa: BLE001 — still blocked: escalate
                 ray_tpu.kill(self.actor, no_restart=True)

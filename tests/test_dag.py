@@ -177,7 +177,10 @@ class TestCompiledDag:
         finally:
             compiled.teardown()
 
-    def test_get_out_of_order_rejected(self):
+    def test_get_out_of_order_buffered(self):
+        """Out-of-order gets are served by buffering earlier executions'
+        results (reference max_buffered_results semantics); each ref is
+        still single-get."""
         a = Adder.remote(1)
         with InputNode() as inp:
             dag = a.add.bind(inp)
@@ -185,10 +188,10 @@ class TestCompiledDag:
         try:
             r1 = compiled.execute(1)
             r2 = compiled.execute(2)
-            with pytest.raises(ValueError, match="submission order"):
-                r2.get(timeout=5)
+            assert r2.get(timeout=10) == 3  # drains r1 into the buffer
             assert r1.get(timeout=10) == 2
-            assert r2.get(timeout=10) == 3
+            with pytest.raises(ValueError, match="gotten once"):
+                r1.get(timeout=5)
         finally:
             compiled.teardown()
 
@@ -578,5 +581,133 @@ class TestJitFusion:
             ref = compiled.execute(1, 2)
             with pytest.raises(Exception, match="multiple"):
                 ref.get(timeout=90)
+        finally:
+            compiled.teardown()
+
+
+class TestExecuteAsync:
+    def test_execute_async_basic(self):
+        import asyncio
+
+        a = Adder.remote(10)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+
+        async def main():
+            fut = await compiled.execute_async(5)
+            return await fut
+
+        try:
+            assert asyncio.run(main()) == 15
+        finally:
+            compiled.teardown()
+
+    def test_execute_async_pipelined_out_of_order(self):
+        """N>1 in-flight executions; futures awaited out of submission
+        order resolve correctly (reference: _execute_until + buffered
+        results)."""
+        import asyncio
+
+        a = Adder.remote(100)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+
+        async def main():
+            futs = [await compiled.execute_async(i) for i in range(4)]
+            # await in reverse order: earlier results must buffer
+            out = []
+            for f in reversed(futs):
+                out.append(await f)
+            return out
+
+        try:
+            assert asyncio.run(main()) == [103, 102, 101, 100]
+        finally:
+            compiled.teardown()
+
+    def test_execute_async_concurrent_awaiters_overlap(self):
+        """Two concurrent tasks drive the same DAG without blocking the
+        event loop — their iterations interleave (a serve replica can
+        answer other requests while a DAG execution is in flight)."""
+        import asyncio
+
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+
+        async def worker(base, n):
+            out = []
+            for k in range(n):
+                fut = await compiled.execute_async(base + k)
+                out.append(await fut)
+            return out
+
+        async def main():
+            r1, r2 = await asyncio.gather(worker(0, 3), worker(1000, 3))
+            return r1, r2
+
+        try:
+            r1, r2 = asyncio.run(main())
+            assert r1 == [1, 2, 3]
+            assert r2 == [1001, 1002, 1003]
+        finally:
+            compiled.teardown()
+
+    def test_execute_async_error_propagates(self):
+        import asyncio
+
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.boom.bind(inp)
+        compiled = dag.experimental_compile()
+
+        async def main():
+            fut = await compiled.execute_async(1)
+            return await fut
+
+        try:
+            with pytest.raises(Exception, match="kapow"):
+                asyncio.run(main())
+        finally:
+            compiled.teardown()
+
+    def test_future_single_await(self):
+        import asyncio
+
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+
+        async def main():
+            fut = await compiled.execute_async(1)
+            v = await fut
+            try:
+                await fut
+            except ValueError as e:
+                return v, str(e)
+            return v, None
+
+        try:
+            v, err = asyncio.run(main())
+            assert v == 2 and err and "awaited once" in err
+        finally:
+            compiled.teardown()
+
+
+class TestMixedSyncAsync:
+    def test_sync_get_out_of_order_with_buffer(self):
+        a = Adder.remote(1)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            refs = [compiled.execute(i) for i in range(3)]
+            assert refs[2].get(timeout=10) == 3
+            assert refs[0].get(timeout=10) == 1
+            assert refs[1].get(timeout=10) == 2
         finally:
             compiled.teardown()

@@ -326,3 +326,66 @@ class TestQueue:
             pool.get_next(timeout=5)
         with _pytest.raises(RuntimeError, match="no actors"):
             pool.get_next_unordered(timeout=5)
+
+    def test_map_stale_work_still_executes_without_blocking(self, ray_start):
+        """A hung-looking earlier submission must not hang map(), yet its
+        side effects must still land (it executes; only its result is
+        discarded)."""
+        import time as _time
+
+        @ray_tpu.remote
+        class Counter4:
+            def __init__(self):
+                self.calls = []
+
+            def slow(self, v):
+                _time.sleep(1.0)
+                self.calls.append(v)
+                return v
+
+            def fast(self, v):
+                self.calls.append(v)
+                return v
+
+            def get_calls(self):
+                return list(self.calls)
+
+        from ray_tpu.util import ActorPool
+
+        actor = Counter4.remote()
+        pool = ActorPool([actor])
+        pool.submit(lambda a, v: a.slow.remote(v), "stale")
+        t0 = _time.monotonic()
+        out = list(pool.map(lambda a, v: a.fast.remote(v), ["a", "b"]))
+        assert out == ["a", "b"]
+        assert _time.monotonic() - t0 < 30
+        # the stale submission still executed (side effect present)
+        calls = ray_tpu.get(actor.get_calls.remote(), timeout=30)
+        assert calls[0] == "stale" and set(calls) == {"stale", "a", "b"}
+
+    def test_map_discards_stale_queued_results_but_runs_them(self, ray_start):
+        """Queued-but-undispatched earlier submissions also execute
+        (side effects preserved) without appearing in map output."""
+        @ray_tpu.remote
+        class Recorder5:
+            def __init__(self):
+                self.seen = []
+
+            def rec(self, v):
+                self.seen.append(v)
+                return v
+
+            def get_seen(self):
+                return list(self.seen)
+
+        from ray_tpu.util import ActorPool
+
+        actor = Recorder5.remote()
+        pool = ActorPool([actor])
+        # first submit dispatches; the next two queue behind it
+        for v in ["q1", "q2", "q3"]:
+            pool.submit(lambda a, v: a.rec.remote(v), v)
+        out = list(pool.map(lambda a, v: a.rec.remote(v), ["m1", "m2"]))
+        assert out == ["m1", "m2"]
+        seen = ray_tpu.get(actor.get_seen.remote(), timeout=30)
+        assert set(seen) == {"q1", "q2", "q3", "m1", "m2"}
