@@ -15,6 +15,10 @@ Run: ``python benchmarks/data_inference_bench.py [--blocks N] [--batch B]``
 """
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import json
 import time
 
@@ -33,14 +37,38 @@ class ViTInfer:
         cfg = ViTConfig(dtype=jnp.bfloat16)  # ViT-B/16, 86M params
         self.cfg = cfg
         self.params = vit_init(jax.random.PRNGKey(0), cfg)
-        self._apply = jax.jit(lambda p, x: jnp.argmax(
-            vit_apply(p, x, cfg), axis=-1))
-        self._jnp = jnp
+
+        # uint8 in, normalize ON DEVICE: a host-side uint8->bf16 numpy
+        # conversion (ml_dtypes scalar loop) costs ~1s/batch on a weak
+        # vCPU and would dominate the measurement
+        def fwd(p, x_u8):
+            x = x_u8.astype(jnp.bfloat16) / 127.5 - 1.0
+            return jnp.argmax(vit_apply(p, x, cfg), axis=-1)
+
+        self._apply = jax.jit(fwd)
 
     def __call__(self, batch):
-        x = self._jnp.asarray(batch["image"], self._jnp.bfloat16) / 127.5 - 1.0
-        pred = self._apply(self.params, x)
-        return {"pred": np.asarray(pred)}
+        import jax
+
+        t0 = time.time()
+        pred = np.asarray(self._apply(self.params, batch["image"]))
+        t1 = time.time()
+        n = len(pred)
+        if not hasattr(self, "_dev_rate"):
+            # chip-capability reference point: the same program with the
+            # input already device-resident — separates compute from the
+            # host->device link (which is a ~4 MB/s tunnel on this CI
+            # rig but PCIe/DMA at GB/s on a real TPU host)
+            xd = jax.device_put(batch["image"])
+            np.asarray(self._apply(self.params, xd))
+            td = time.time()
+            for _ in range(3):
+                r = self._apply(self.params, xd)
+            np.asarray(r)
+            self._dev_rate = 3 * n / (time.time() - td)
+        return {"pred": pred, "t_start": np.full(n, t0),
+                "t_end": np.full(n, t1),
+                "dev_rate": np.full(n, self._dev_rate)}
 
 
 def main():
@@ -55,28 +83,39 @@ def main():
 
     ray_tpu.init(num_cpus=4, num_tpus=1)
     try:
+        from ray_tpu.data.block import batch_to_block
+
         rng = np.random.default_rng(0)
-        items = [{"image": rng.integers(
-            0, 255, (args.batch, 224, 224, 3), dtype=np.uint8)}
+        blocks = [batch_to_block({"image": rng.integers(
+            0, 255, (args.batch, 224, 224, 3), dtype=np.uint8)})
             for _ in range(args.blocks)]
-        ds = rd.from_items(items, parallelism=args.blocks)
+        ds = rd.from_arrow(blocks)
         ds = ds.map_batches(
             ViTInfer, compute=ActorPoolStrategy(size=1), batch_size=None,
             num_tpus=1)
-        # warm pass 1 block (compile + actor start excluded from timing)
-        _ = ds.limit(1).take_all()
-        t0 = time.perf_counter()
+        t0 = time.time()
         out = ds.take_all()
-        dt = time.perf_counter() - t0
-        n_imgs = sum(np.asarray(r["pred"]).size
-                     for r in out) if out and hasattr(
-            out[0]["pred"], "__len__") else len(out)
+        dt = time.time() - t0
         n_imgs = args.blocks * args.batch
+        # steady state: the FIRST block pays actor start + 86M-param init
+        # + XLA compile (one-time costs in any long-running pipeline);
+        # per-block timestamps from inside the actor separate that out
+        starts = sorted({float(r["t_start"]) for r in out})
+        ends = sorted({float(r["t_end"]) for r in out})
+        steady_batches = len(starts) - 1
+        steady_s = ends[-1] - ends[0] if steady_batches else float("nan")
         print(json.dumps({
             "benchmark": "data_map_batches_inference",
             "model": "ViT-B/16 bf16 (ImageNet-shaped 224x224)",
-            "batches_per_s": round(args.blocks / dt, 2),
-            "images_per_s": round(n_imgs / dt, 1),
+            "steady_batches_per_s": round(steady_batches / steady_s, 2),
+            "steady_images_per_s": round(
+                steady_batches * args.batch / steady_s, 1),
+            "e2e_batches_per_s": round(args.blocks / dt, 2),
+            "e2e_images_per_s": round(n_imgs / dt, 1),
+            "first_batch_overhead_s": round(
+                ends[0] - t0 if ends else float("nan"), 2),
+            "device_resident_images_per_s": round(
+                float(out[0]["dev_rate"]), 1) if out else None,
             "batch_size": args.batch,
             "blocks": args.blocks,
             "wall_s": round(dt, 2),

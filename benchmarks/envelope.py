@@ -170,13 +170,17 @@ def bench_pg_churn(ray_tpu, n: int) -> dict:
     return {"n": n, "s": round(s, 2), "pgs_per_s": round(n / s, 1)}
 
 
+# full sizes == the reference's single-node envelope
+# (release/benchmarks/README.md:27-31: 10k args, 3k returns, 10k-object
+# get, 1M queued tasks; 100 GiB object is RAM-bound — 10 GiB here
+# proves the same arena->segment->spill path on this 125 GB box)
 SECTIONS = {
-    "queued_tasks": (bench_queued_tasks, 100_000, 10_000),
+    "queued_tasks": (bench_queued_tasks, 1_000_000, 10_000),
     "actors": (bench_actors, 1_000, 100),
     "many_objects": (bench_many_objects, 10_000, 2_000),
-    "task_args": (bench_task_args, 1_000, 200),
-    "task_returns": (bench_task_returns, 1_000, 200),
-    "big_object": (bench_big_object, 3.0, 1.0),
+    "task_args": (bench_task_args, 10_000, 200),
+    "task_returns": (bench_task_returns, 3_000, 200),
+    "big_object": (bench_big_object, 10.0, 1.0),
     "pg_churn": (bench_pg_churn, 200, 30),
 }
 

@@ -20,6 +20,10 @@ Run: ``python benchmarks/rl_ppo_bench.py [--iters N]``
 """
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import json
 import time
 

@@ -155,9 +155,110 @@ def serve_bench(args) -> dict:
         ray_tpu.shutdown()
 
 
+def serve_breakdown(args) -> dict:
+    """Per-stage serve-path cost isolation (VERDICT r3 weak #3): the same
+    workload through each successive layer —
+
+      replica-direct : actor.handle_request (engine loop + actor call;
+                       no serve framework at all)
+      handle         : serve.run + DeploymentHandle.remote (adds router)
+      http           : + HTTP proxy (the full 28.4 tok/s path)
+
+    The deltas attribute the engine->serve collapse to specific layers.
+    """
+    import concurrent.futures
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.serving import LLMServer
+
+    ray_tpu.init(num_cpus=4, num_tpus=1)
+    out: dict = {"mode": "serve-breakdown", "model": args.model,
+                 "requests": args.requests}
+    body = {"prompt": "benchmark " * (args.prompt_len // 2),
+            "max_tokens": args.max_tokens, "temperature": 0.0}
+    engine_kwargs = {"model": args.model, "batch_slots": args.slots,
+                     "max_len": args.max_len,
+                     "kv_cache_dtype": args.kv_dtype or None}
+    try:
+        # ---- stage 1: replica actor direct (no serve) ----
+        from ray_tpu._private import serialization
+        from ray_tpu.serve.replica import ReplicaActor
+
+        replica = ReplicaActor.options(num_tpus=1).remote(
+            serialization.dumps(LLMServer._target),
+            (engine_kwargs, 1), {}, None, "bench", "r0")
+
+        def direct_one():
+            return ray_tpu.get(replica.handle_request.remote(
+                "__call__", (body,), {}), timeout=600)
+
+        direct_one()  # compile
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.slots * 2) as pool:
+            rs = list(pool.map(lambda _: direct_one(), range(args.requests)))
+        dt = time.perf_counter() - t0
+        gen = sum(r["num_generated_tokens"] for r in rs)
+        out["replica_direct_tokens_per_s"] = round(gen / dt, 1)
+        # the ONE chip must be fully released before the serve replica
+        # starts: wait for the actor's process to actually exit
+        rpid = ray_tpu.get(replica.stats.remote(), timeout=60)["pid"]
+        ray_tpu.kill(replica)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                os.kill(rpid, 0)
+                time.sleep(0.5)
+            except ProcessLookupError:
+                break
+
+        # ---- stage 2: serve handle path (router, no proxy) ----
+        from ray_tpu.llm.serving import build_llm_deployment
+
+        app = build_llm_deployment(engine_kwargs, num_tpus_per_replica=1)
+        handle = serve.run(app, name="llm-bench", route_prefix="/llm")
+
+        def handle_one():
+            return handle.remote(body).result(timeout=600)
+
+        handle_one()  # compile on the serve replica
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.slots * 2) as pool:
+            rs = list(pool.map(lambda _: handle_one(), range(args.requests)))
+        dt = time.perf_counter() - t0
+        gen = sum(r["num_generated_tokens"] for r in rs)
+        out["handle_tokens_per_s"] = round(gen / dt, 1)
+
+        # ---- stage 3: full HTTP path ----
+        port = 18499
+        serve.start(http_options={"host": "127.0.0.1", "port": port,
+                                  "request_timeout_s": 900.0})
+        url = f"http://127.0.0.1:{port}/llm"
+
+        def http_one():
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=600) as r:
+                return json.loads(r.read())
+
+        http_one()
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(args.slots * 2) as pool:
+            rs = list(pool.map(lambda _: http_one(), range(args.requests)))
+        dt = time.perf_counter() - t0
+        gen = sum(r["num_generated_tokens"] for r in rs)
+        out["http_tokens_per_s"] = round(gen / dt, 1)
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", default="engine", choices=["engine", "serve"])
+    ap.add_argument("--mode", default="engine",
+                    choices=["engine", "serve", "serve-breakdown"])
     ap.add_argument("--model", default="llama2_7b")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=256)
@@ -167,7 +268,8 @@ def main():
     ap.add_argument("--kv-dtype", default="", choices=["", "int8"],
                     help="int8: half-size KV pool, ~2x slots per chip")
     args = ap.parse_args()
-    out = engine_bench(args) if args.mode == "engine" else serve_bench(args)
+    out = {"engine": engine_bench, "serve": serve_bench,
+           "serve-breakdown": serve_breakdown}[args.mode](args)
     print(json.dumps(out))
 
 

@@ -19,6 +19,10 @@ Prints one JSON line per phase and a final summary line.
 """
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import json
 import sys
 import time

@@ -132,6 +132,11 @@ class Raylet:
         # log paths of spawns whose zygote reply was lost — adopted (in
         # order) when the forked child registers
         self._lost_spawn_logs: List[str] = []
+        # monotonic deadlines for lost spawns: each entry holds ONE
+        # _starting slot until its child registers (entry popped there)
+        # or the deadline expires (reaper decrements _starting) — never
+        # both, so the startup-concurrency cap stays accurate
+        self._lost_spawn_deadlines: List[float] = []
 
         self.server.register_all(self)
 
@@ -327,6 +332,16 @@ class Raylet:
                     self._starting = max(0, self._starting - 1)
                     logger.warning("worker pid %s exited before registering (rc=%s)",
                                    pid, proc.returncode)
+            # lost zygote spawns whose child never registered: release
+            # their startup slots at the deadline
+            now_m = time.monotonic()
+            while (self._lost_spawn_deadlines
+                   and self._lost_spawn_deadlines[0] < now_m):
+                self._lost_spawn_deadlines.pop(0)
+                self._starting = max(0, self._starting - 1)
+                logger.warning(
+                    "lost zygote spawn never registered; releasing its "
+                    "startup slot")
             await asyncio.sleep(0.2)
 
     async def _memory_monitor_loop(self):
@@ -512,10 +527,13 @@ class Raylet:
             return
         if got == "lost":
             # fork likely happened but the reply was lost: the child (if
-            # alive) registers on its own; don't double-spawn.  Release
-            # the startup slot — registration's decrement clamps at 0.
-            self._starting = max(0, self._starting - 1)
+            # alive) registers on its own; don't double-spawn.  The
+            # _starting slot stays held until the child registers or the
+            # startup timeout expires (reaper) — decrementing here AND at
+            # registration would under-count concurrent spawns.
             self._lost_spawn_logs.append(log_path)
+            self._lost_spawn_deadlines.append(
+                time.monotonic() + config.worker_startup_timeout_s)
             return
         env = dict(os.environ)
         env.update(worker_env)
@@ -627,6 +645,8 @@ class Raylet:
 
             proc = _ZygoteChild(pid, proc_starttime(pid))
             self._spawned_procs[pid] = proc
+            if self._lost_spawn_deadlines:
+                self._lost_spawn_deadlines.pop(0)  # slot consumed here
             if self._lost_spawn_logs and pid not in self._worker_logs:
                 self._worker_logs[pid] = {
                     "path": self._lost_spawn_logs.pop(0), "off": 0,
@@ -698,11 +718,17 @@ class Raylet:
                     raise RuntimeError(
                         "placement group removed or never created")
                 if asyncio.get_event_loop().time() > deadline:
-                    # bounded server-side poll: a PG that places slower than
-                    # the deadline (nodes joining, autoscaling) is NOT an
-                    # error — tell the client to re-issue the lease call
-                    # (reference ray queues such tasks until the PG places).
-                    # An abandoned client's poll loop still dies here.
+                    # A PG that places slower than the deadline (nodes
+                    # joining, autoscaling) is NOT an error — tell the
+                    # client to re-issue the lease call (reference ray
+                    # queues such tasks until the PG places).  But a PG
+                    # whose bundles can NEVER fit any alive node must
+                    # fail loudly, or the client retries forever with no
+                    # diagnostic.
+                    if self._pg_infeasible(pg):
+                        raise RuntimeError(
+                            "placement group is infeasible: some bundle "
+                            "exceeds every alive node's total resources")
                     return {"retry_pg_pending": True}
                 await asyncio.sleep(0.25)
                 target = await self._pg_bundle_node(pg_id, bundle_index,
@@ -765,6 +791,21 @@ class Raylet:
             if n["node_id"] == node_id:
                 return n["addr"]
         return None
+
+    def _pg_infeasible(self, pg: Dict[str, Any]) -> bool:
+        """True when some bundle of a PENDING placement group exceeds
+        every alive node's TOTAL resources — it can never place (ignores
+        fragmentation: a fragmented-but-fittable PG stays retryable)."""
+        bundles = pg.get("bundles") or []
+        nodes = self._node_views()
+        alive = [v.total for v in nodes if v.alive]
+        if not alive:
+            return False  # no view yet: treat as pending, not infeasible
+        for b in bundles:
+            need = ResourceSet(b)
+            if not any(tot.is_superset_of(need) for tot in alive):
+                return True
+        return False
 
     async def _pg_bundle_node(self, pg_id: bytes, bundle_index: int, demand: ResourceSet):
         local_totals = self._bundle_totals.get(pg_id)

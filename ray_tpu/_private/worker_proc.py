@@ -19,6 +19,27 @@ def main():
         level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    # Honor JAX_PLATFORMS in workers DETERMINISTICALLY.  Environments
+    # that pre-register an accelerator plugin at interpreter start (the
+    # axon sitecustomize) do it via jax.config.update("jax_platforms",
+    # "axon,cpu"), which silently overrides the env var — a worker in a
+    # CPU test cluster would then grab the real chip when it happens to
+    # be free and run a 1-device mesh when the test expects 8 virtual
+    # CPU devices (or fall back to CPU only when the chip is busy:
+    # nondeterministic either way).  Re-assert the env contract before
+    # any user code initializes a backend.
+    # Only needed when jax is ALREADY imported (zygote preload, where the
+    # sitecustomize's config write beat the env var); a cold Popen worker
+    # honors the env var natively at jax import and must not pay the
+    # ~1s+ import here for non-jax workloads.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "jax" in sys.modules:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 - partial/broken jax install
+            pass
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     gcs_addr = os.environ["RAY_TPU_GCS_ADDR"]
     raylet_addr = os.environ["RAY_TPU_RAYLET_ADDR"]

@@ -18,13 +18,15 @@ from ray_tpu.serve.deployment import (
     DeploymentConfig,
     deployment,
 )
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import batch
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
-    "get_app_handle", "get_deployment_handle", "grpc_proxy_port", "run",
+    "get_app_handle", "get_deployment_handle", "get_multiplexed_model_id",
+    "grpc_proxy_port", "multiplexed", "run",
     "shutdown", "start",
     "status",
 ]
@@ -166,3 +168,7 @@ def shutdown():
                 pass
     _proxy = None
     _grpc_proxy = None
+    # drop cached per-deployment routers: they hold handles to the dead
+    # controller/replicas and would poison the next serve session
+    with DeploymentHandle._routers_lock:
+        DeploymentHandle._routers.clear()

@@ -1,0 +1,140 @@
+"""Model multiplexing: many models per replica with LRU retention.
+
+Reference: ``python/ray/serve/api.py:719`` (``@serve.multiplexed``) +
+``python/ray/serve/multiplex.py`` (``_ModelMultiplexWrapper``) — the
+many-models-per-replica pattern (LoRA-adapter serving): a replica lazily
+loads models by id, retains up to ``max_num_models_per_replica`` in an
+LRU, and the router prefers replicas that already hold the requested
+model.
+
+TPU-native notes: a "model" here is typically a params pytree already
+resident in HBM; eviction drops the host reference and XLA frees the
+device buffers.  Loading happens inside the replica's request thread —
+no extra event loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextvars
+import threading
+from typing import Any, Callable, List, Optional
+
+# set by ReplicaActor.handle_request around each user-code call
+_mux_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a deployment method: the model id of the current request
+    (``handle.options(multiplexed_model_id=...)`` or the
+    ``serve_multiplexed_model_id`` HTTP header).  Empty string if unset.
+    Reference: ``serve.get_multiplexed_model_id``."""
+    return _mux_model_id.get()
+
+
+class _MultiplexWrapper:
+    """Per-replica LRU of loaded models keyed by model id."""
+
+    def __init__(self, fn: Callable, instance: Any, max_models: int):
+        self._fn = fn
+        self._instance = instance
+        self._max = max_models
+        self._models: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        # model_id -> Event: single-flight guard so concurrent first
+        # requests for one id load ONCE (a double load of an HBM-resident
+        # params pytree could transiently hold two full copies)
+        self._loading: dict = {}
+        self._loads = 0
+        self._evictions = 0
+
+    def load(self, model_id: str):
+        while True:
+            with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                ev = self._loading.get(model_id)
+                if ev is None:
+                    ev = self._loading[model_id] = threading.Event()
+                    break  # this thread loads
+            ev.wait()  # another thread is loading this id; re-check
+        # load OUTSIDE the lock: a slow model load must not block lookups
+        # of already-loaded models from other request threads
+        try:
+            model = self._fn(self._instance, model_id)
+            with self._lock:
+                self._models[model_id] = model
+                self._loads += 1
+                while len(self._models) > self._max:
+                    evicted_id, evicted = self._models.popitem(last=False)
+                    self._evictions += 1
+                    del evicted  # drop the ref; HBM frees with it
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        finally:
+            with self._lock:
+                self._loading.pop(model_id, None)
+            ev.set()
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._models)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"loaded": list(self._models), "loads": self._loads,
+                    "evictions": self._evictions, "max": self._max}
+
+
+def multiplexed(fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """``@serve.multiplexed``: decorate the deployment's model-loader
+    method.  Calls are LRU-cached per replica by model id::
+
+        @serve.deployment
+        class LoraServer:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            def get_model(self, model_id: str):
+                return load_adapter(model_id)
+
+            def __call__(self, body):
+                model = self.get_model(serve.get_multiplexed_model_id())
+                ...
+    """
+    if max_num_models_per_replica < 1:
+        raise ValueError("max_num_models_per_replica must be >= 1")
+
+    def wrap(f: Callable):
+        attr = f"__serve_mux_{f.__name__}"
+
+        def call(self, model_id: str):
+            wrapper = self.__dict__.get(attr)
+            if wrapper is None:
+                wrapper = self.__dict__.setdefault(
+                    attr, _MultiplexWrapper(
+                        f, self, max_num_models_per_replica))
+            # registry so the replica can report loaded ids to the router
+            reg = self.__dict__.setdefault("__serve_mux_wrappers__", [])
+            if wrapper not in reg:
+                reg.append(wrapper)
+            return wrapper.load(model_id)
+
+        call.__name__ = f.__name__
+        call._is_serve_multiplexed = True
+        return call
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+def loaded_model_ids(instance: Any) -> List[str]:
+    """All model ids currently loaded across an instance's multiplexed
+    loaders (the replica reports these for model-aware routing)."""
+    out: List[str] = []
+    for wrapper in instance.__dict__.get("__serve_mux_wrappers__", []):
+        out.extend(wrapper.model_ids())
+    return out

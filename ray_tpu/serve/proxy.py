@@ -145,6 +145,11 @@ class ProxyActor:
             else:
                 body = dict(request.query)
             handle = self._handle_for(dep)
+            # model multiplexing: the reference's serve_multiplexed_model_id
+            # header routes to a replica that already holds the model
+            mux_id = request.headers.get("serve_multiplexed_model_id", "")
+            if mux_id:
+                handle = handle.options(multiplexed_model_id=mux_id)
             # SSE streaming: the deployment method is a generator and the
             # client opted in (Accept: text/event-stream or ?stream=1);
             # each yielded item becomes one `data:` event the moment the

@@ -131,10 +131,14 @@ class ReplicaActor:
             self._callable.reconfigure(user_config)
         return True
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict):
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = ""):
+        from ray_tpu.serve.multiplex import _mux_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _mux_model_id.set(multiplexed_model_id)
         try:
             fn = getattr(self._callable, method, None)
             if fn is None:
@@ -145,18 +149,23 @@ class ReplicaActor:
                 result = asyncio.run(result)  # creates AND closes the loop
             return result
         finally:
+            _mux_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
     def handle_request_streaming(self, method: str, args: tuple,
-                                 kwargs: dict):
+                                 kwargs: dict,
+                                 multiplexed_model_id: str = ""):
         """Generator twin of handle_request: invoked with
         ``num_returns="streaming"`` so each yielded item reaches the
         caller the moment the user generator produces it (reference:
         serve streaming responses over streaming generators)."""
+        from ray_tpu.serve.multiplex import _mux_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _mux_model_id.set(multiplexed_model_id)
         try:
             fn = getattr(self._callable, method, None)
             if fn is None:
@@ -164,15 +173,28 @@ class ReplicaActor:
                     f"deployment {self._deployment} has no method {method!r}")
             yield from fn(*args, **kwargs)
         finally:
+            _mux_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
     def get_queue_len(self) -> int:
         return self._ongoing
 
+    def probe(self) -> Dict[str, Any]:
+        """Router probe: queue length + currently loaded multiplexed
+        model ids in one RPC — the model-aware routing signal (so the
+        affinity map reflects replica-side LRU EVICTION, not just what
+        the router once dispatched)."""
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        return {"qlen": self._ongoing,
+                "models": loaded_model_ids(self._callable)}
+
     def stats(self) -> Dict[str, Any]:
+        import os
+
         return {"replica_id": self._replica_id, "ongoing": self._ongoing,
-                "total": self._total}
+                "total": self._total, "pid": os.getpid()}
 
     def check_health(self) -> bool:
         if hasattr(self._callable, "check_health"):

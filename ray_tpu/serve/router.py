@@ -35,6 +35,10 @@ class Router:
     """Pow-2 replica chooser with a queue-length cache."""
 
     QUEUE_LEN_CACHE_S = 2.0
+    # deployment-version polls ride the request path; uncapped they cost
+    # one controller RPC PER REQUEST (measured: the largest serve-path
+    # overhead after the replica call itself on a 1-vCPU box)
+    VERSION_CHECK_INTERVAL_S = 0.5
 
     def __init__(self, deployment_name: str, controller):
         self._deployment = deployment_name
@@ -43,8 +47,12 @@ class Router:
         self._max_ongoing = 16
         self._version = -1
         self._qlen_cache: Dict[str, tuple] = {}  # actor id -> (len, expiry)
+        # model-aware routing (reference multiplex.py): model id ->
+        # replica cache keys that recently served / reported that model
+        self._mux_affinity: Dict[str, List[str]] = {}
         self._lock = threading.Lock()
         self._rng = random.Random()
+        self._last_version_check = 0.0
         self.refresh()
 
     def refresh(self):
@@ -59,7 +67,14 @@ class Router:
             self._qlen_cache.clear()  # cache keys are replica ids; drop stale
 
     def _maybe_refresh(self):
-        # long-poll analog: cheap version check piggybacked on the probe path
+        # long-poll analog: cheap version check piggybacked on the probe
+        # path — throttled so the hot path isn't one controller RPC per
+        # request (a replica-set change waits at most the interval)
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_version_check < self.VERSION_CHECK_INTERVAL_S:
+                return
+            self._last_version_check = now
         try:
             v = ray_tpu.get(
                 self._controller.get_version.remote(self._deployment))
@@ -79,14 +94,33 @@ class Router:
             if hit and hit[1] > now:
                 return hit[0]
         try:
-            qlen = ray_tpu.get(replica.get_queue_len.remote(), timeout=5)
+            info = ray_tpu.get(replica.probe.remote(), timeout=5)
+            qlen = info["qlen"]
+            self._sync_models(key, info.get("models") or [])
         except Exception:
             qlen = 1 << 30  # unreachable replica: never prefer it
         with self._lock:
             self._qlen_cache[key] = (qlen, now + self.QUEUE_LEN_CACHE_S)
         return qlen
 
-    def choose_replica(self):
+    def _sync_models(self, key: str, models: List[str]) -> None:
+        """Reconcile the affinity map with a replica's AUTHORITATIVE
+        loaded-model report: models it evicted stop routing to it, and
+        the map is bounded (stale ids age out)."""
+        with self._lock:
+            loaded = set(models)
+            for mid, lst in list(self._mux_affinity.items()):
+                if mid in loaded:
+                    if key not in lst:
+                        lst.append(key)
+                elif key in lst:
+                    lst.remove(key)
+                    if not lst:
+                        del self._mux_affinity[mid]
+            while len(self._mux_affinity) > 1024:
+                self._mux_affinity.pop(next(iter(self._mux_affinity)))
+
+    def choose_replica(self, model_id: str = ""):
         # operate on a snapshot: a concurrent refresh() must not shift
         # indices under us
         with self._lock:
@@ -98,11 +132,45 @@ class Router:
             if not reps:
                 raise RuntimeError(
                     f"deployment {self._deployment!r} has no replicas")
+        if model_id:
+            pick = self._choose_for_model(model_id, reps)
+            if pick is not None:
+                return pick
         if len(reps) == 1:
             return reps[0]
         i, j = self._rng.sample(range(len(reps)), 2)
         return reps[i] if self._probe(reps[i]) <= self._probe(reps[j]) \
             else reps[j]
+
+    def _choose_for_model(self, model_id: str, reps: List[Any]):
+        """Prefer a replica that already holds ``model_id`` (avoids a
+        load + possible LRU eviction elsewhere); fall back to pow-2 when
+        none does or the holder is saturated.  Reference:
+        ``multiplex.py`` model-aware routing in the pow-2 scheduler."""
+        with self._lock:
+            keys = list(self._mux_affinity.get(model_id, ()))
+        if keys:
+            by_key = {self._cache_key(r): r for r in reps}
+            holders = [by_key[k] for k in keys if k in by_key]
+            if holders:
+                best = min(holders, key=self._probe)
+                if self._probe(best) < self._max_ongoing:
+                    return best
+        return None
+
+    def note_model(self, model_id: str, replica) -> None:
+        """Record that ``replica`` now holds ``model_id`` (front of the
+        affinity list); trimmed to a handful — stale entries age out as
+        other replicas take over."""
+        if not model_id:
+            return
+        key = self._cache_key(replica)
+        with self._lock:
+            lst = self._mux_affinity.setdefault(model_id, [])
+            if key in lst:
+                lst.remove(key)
+            lst.insert(0, key)
+            del lst[4:]
 
     def note_dispatch(self, replica):
         """Bump the cached queue length so back-to-back requests spread."""
@@ -112,28 +180,35 @@ class Router:
             if hit:
                 self._qlen_cache[key] = (hit[0] + 1, hit[1])
 
-    def assign(self, method: str, args: tuple, kwargs: dict):
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               model_id: str = ""):
         for attempt in range(3):
             self._maybe_refresh()
-            replica = self.choose_replica()
+            replica = self.choose_replica(model_id)
             try:
-                ref = replica.handle_request.remote(method, args, kwargs)
+                ref = replica.handle_request.remote(
+                    method, args, kwargs, multiplexed_model_id=model_id)
                 self.note_dispatch(replica)
+                self.note_model(model_id, replica)
                 return ref
             except Exception:
                 if attempt == 2:
                     raise
                 self.refresh()
 
-    def assign_streaming(self, method: str, args: tuple, kwargs: dict):
+    def assign_streaming(self, method: str, args: tuple, kwargs: dict,
+                         model_id: str = ""):
         """Route one streaming request; returns an ObjectRefGenerator."""
         for attempt in range(3):
             self._maybe_refresh()
-            replica = self.choose_replica()
+            replica = self.choose_replica(model_id)
             try:
                 gen = replica.handle_request_streaming.options(
-                    num_returns="streaming").remote(method, args, kwargs)
+                    num_returns="streaming").remote(
+                        method, args, kwargs,
+                        multiplexed_model_id=model_id)
                 self.note_dispatch(replica)
+                self.note_model(model_id, replica)
                 return gen
             except Exception:
                 if attempt == 2:
@@ -144,40 +219,58 @@ class Router:
 class DeploymentHandle:
     """Client-side handle; composition-safe (picklable into replicas)."""
 
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    # routers are shared per (deployment) across handle copies in one
+    # process so model-affinity state survives handle.options() chains
+    _routers: Dict[str, Router] = {}
+    _routers_lock = threading.Lock()
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self._deployment = deployment_name
         self._method = method_name
-        self._router: Optional[Router] = None
-        self._router_lock = threading.Lock()
+        self._mux_id = multiplexed_model_id
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._deployment, self._method))
+        return (DeploymentHandle,
+                (self._deployment, self._method, self._mux_id))
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._deployment, method_name)
+    def options(self, method_name: Optional[str] = None, *,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Reference: ``handle.options(multiplexed_model_id="m1")``
+        routes to a replica that already has model "m1" loaded."""
+        return DeploymentHandle(
+            self._deployment,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._mux_id)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self._deployment, name)
+        return DeploymentHandle(self._deployment, name, self._mux_id)
 
     def _get_router(self) -> Router:
-        with self._router_lock:
-            if self._router is None:
+        with DeploymentHandle._routers_lock:
+            router = DeploymentHandle._routers.get(self._deployment)
+            if router is None:
                 from ray_tpu.serve.controller import get_controller
 
-                self._router = Router(self._deployment, get_controller())
-            return self._router
+                router = Router(self._deployment, get_controller())
+                DeploymentHandle._routers[self._deployment] = router
+            return router
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref = self._get_router().assign(self._method, args, kwargs)
+        ref = self._get_router().assign(self._method, args, kwargs,
+                                        model_id=self._mux_id)
         return DeploymentResponse(ref)
 
     def remote_streaming(self, *args, **kwargs) -> "DeploymentStreamingResponse":
         """Call a generator method of the deployment; iterate the result
         to receive items as the replica yields them (reference:
         handle.options(stream=True))."""
-        gen = self._get_router().assign_streaming(self._method, args, kwargs)
+        gen = self._get_router().assign_streaming(
+            self._method, args, kwargs, model_id=self._mux_id)
         return DeploymentStreamingResponse(gen)
 
 

@@ -111,6 +111,27 @@ class TrainController:
             self.checkpoint_manager.latest, shards, dist_env)
         return group
 
+    def _restart_group(self) -> WorkerGroup:
+        """Start a replacement group, treating start-time failures (e.g.
+        a placement group that cannot place because the cluster view
+        still includes a just-dead node) as ordinary failures: consult
+        the FailurePolicy and retry — the next attempt re-runs the
+        ScalingPolicy against the updated cluster."""
+        while True:
+            try:
+                return self._start_group()
+            except Exception as e:  # noqa: BLE001 — placement/start errors
+                self._ctx.errors_seen += 1
+                decision = self.failure_policy.make_decision(
+                    self._ctx, str(e))
+                if decision != FailureDecision.RETRY:
+                    raise
+                logger.warning(
+                    "train %s: group start failed (%d so far), retrying "
+                    "with a fresh scaling decision:\n%s",
+                    self.name, self._ctx.errors_seen, e)
+                time.sleep(1.0)
+
     def _split_datasets(self, n: int) -> Optional[List[Any]]:
         if not self.datasets:
             return None
@@ -148,7 +169,7 @@ class TrainController:
                             "from latest checkpoint:\n%s",
                             self.name, self._ctx.errors_seen, first)
                         group.shutdown()
-                        group = self._start_group()
+                        group = self._restart_group()
                         continue
                     error = RuntimeError(
                         f"training failed after {self._ctx.errors_seen} "

@@ -74,20 +74,28 @@ class ElasticScalingPolicy(ScalingPolicy):
 
     TPU note: resizes only happen at restart boundaries (mesh re-formation);
     a running group is never resized in place.
+
+    The decision samples ``available_resources`` over a short settle
+    window (``settle_s``): at a restart boundary the dying group's leases
+    are still being released and a just-dead node's resources still being
+    dropped — a single instantaneous sample under-counts (or
+    over-counts) the capacity the new group can actually use.  Sampling
+    stops early once the max fits.
     """
 
     def __init__(self, min_workers: int, max_workers: int,
-                 resources_per_worker: Optional[dict] = None):
+                 resources_per_worker: Optional[dict] = None,
+                 settle_s: float = 3.0):
         if min_workers < 1 or max_workers < min_workers:
             raise ValueError("need 1 <= min_workers <= max_workers")
         self.min_workers = min_workers
         self.max_workers = max_workers
         self.resources_per_worker = resources_per_worker
+        self.settle_s = settle_s
 
-    def make_decision_for_non_running_worker_group(self, scaling_config):
+    def _fit_now(self, res) -> int:
         import ray_tpu
 
-        res = self.resources_per_worker or scaling_config.worker_resources()
         avail = ray_tpu.available_resources()
         fit = self.max_workers
         for k, per in res.items():
@@ -95,5 +103,16 @@ class ElasticScalingPolicy(ScalingPolicy):
                 continue
             have = avail.get(k, 0.0)
             fit = min(fit, int(have // per))
-        n = max(self.min_workers, min(self.max_workers, fit))
+        return fit
+
+    def make_decision_for_non_running_worker_group(self, scaling_config):
+        import time
+
+        res = self.resources_per_worker or scaling_config.worker_resources()
+        deadline = time.monotonic() + self.settle_s
+        best = self._fit_now(res)
+        while best < self.max_workers and time.monotonic() < deadline:
+            time.sleep(0.25)
+            best = max(best, self._fit_now(res))
+        n = max(self.min_workers, min(self.max_workers, best))
         return ResizeDecision(num_workers=n)

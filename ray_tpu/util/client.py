@@ -43,6 +43,8 @@ class _Session:
         # live ObjectRefs pin the session's objects against the lifetime
         # protocol until disconnect (reference: per-client server state)
         self.refs: Dict[bytes, ObjectRef] = {}
+        # in-flight streaming generators the proxy drives for the client
+        self.streams: Dict[str, Any] = {}
 
 
 class ClientServer:
@@ -91,6 +93,7 @@ class ClientServer:
         s = self._sessions.pop(session, None)
         if s is not None:
             s.refs.clear()  # drop pins: normal lifetime GC takes over
+            s.streams.clear()  # generator __del__ tears down the stream
         return True
 
     async def handle_client_gcs(self, session: str, gcs_method: str,
@@ -144,6 +147,53 @@ class ClientServer:
             for r in refs:
                 self._retain(s, r)
         return True
+
+    async def handle_client_submit_stream(self, session: str,
+                                          spec_bytes: bytes) -> str:
+        """Submit a ``num_returns="streaming"`` task; the proxy drives
+        the native ObjectRefGenerator and the client pulls item refs via
+        ``client_stream_next`` (reference: the ray client proxies
+        streaming generators)."""
+        import uuid as _uuid
+
+        s = self._session(session)
+        with serialization.uncounted_refs():
+            spec: TaskSpec = serialization.loads(spec_bytes)
+        spec.owner_addr = self._worker.serve_addr
+        gen = (self._worker.submit_actor_task(spec)
+               if spec.actor_id is not None
+               else self._worker.submit_task(spec))
+        stream_id = _uuid.uuid4().hex
+        s.streams[stream_id] = gen
+        return stream_id
+
+    async def handle_client_stream_next(self, session: str,
+                                        stream_id: str) -> Dict[str, Any]:
+        """Next item ref of a proxied stream: ``{"oid": ...}``, or
+        ``{"done": True}``, or ``{"error": <pickled exception>}``.  The
+        ref is retained in the session registry so the client's
+        follow-up ``client_get`` always resolves."""
+        s = self._session(session)
+        gen = s.streams.get(stream_id)
+        if gen is None:
+            raise exc.RayTpuError(f"unknown stream {stream_id!r}")
+
+        def _next():
+            try:
+                return next(gen)
+            except StopIteration:
+                return None
+
+        try:
+            ref = await asyncio.get_event_loop().run_in_executor(None, _next)
+        except Exception as e:  # noqa: BLE001 — the task's error, proxied
+            s.streams.pop(stream_id, None)
+            return {"error": serialization.dumps(e)}
+        if ref is None:
+            s.streams.pop(stream_id, None)
+            return {"done": True}
+        self._retain(s, ref)
+        return {"oid": ref.id.binary()}
 
     async def handle_client_cancel(self, session: str, oid: bytes,
                                    force: bool, recursive: bool) -> bool:
@@ -319,9 +369,10 @@ class ClientCoreWorker:
         from ray_tpu._private.streaming import STREAMING_RETURNS
 
         if spec.num_returns == STREAMING_RETURNS:
-            raise NotImplementedError(
-                "streaming generators are not supported over "
-                "ray_tpu:// client connections yet")
+            stream_id = self.run_coro(self._proxy.call(
+                "client_submit_stream", session=self._session,
+                spec_bytes=serialization.dumps(spec)))
+            return ClientObjectRefGenerator(self, stream_id)
         refs = [ObjectRef(oid, self.serve_addr) for oid in spec.return_ids()]
         self.run_coro(self._proxy.call(
             "client_submit", session=self._session,
@@ -364,6 +415,43 @@ class ClientCoreWorker:
             pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._loop_thread.join(timeout=2)
+
+
+class ClientObjectRefGenerator:
+    """Client-side iterator over a proxied streaming task's item refs.
+
+    The proxy drives the real ObjectRefGenerator; each ``__next__`` pulls
+    one item's ref id over the session channel (the proxy retains the
+    object, so a follow-up ``ray_tpu.get(ref)`` resolves through the
+    ordinary ``client_get`` path).  Supports sync and async iteration,
+    mirroring the native generator's surface."""
+
+    def __init__(self, client: "ClientCoreWorker", stream_id: str):
+        self._client = client
+        self._stream_id = stream_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        rep = self._client.run_coro(self._client._proxy.call(
+            "client_stream_next", session=self._client._session,
+            stream_id=self._stream_id, timeout=None))
+        if rep.get("done"):
+            raise StopIteration
+        if "error" in rep:
+            raise serialization.loads(rep["error"])
+        return ObjectRef(ObjectID(rep["oid"]), self._client.serve_addr)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        try:
+            return await asyncio.get_event_loop().run_in_executor(
+                None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
 
 
 def connect(address: str,

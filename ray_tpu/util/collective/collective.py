@@ -36,6 +36,24 @@ class GroupManager:
             )
 
             g = TcpGroup(world_size, rank, group_name)
+        elif backend == Backend.XLA_MESH:
+            # one PROCESS owning the whole device mesh: "ranks" are its
+            # devices, so the declared (actor) world size must be 1 and
+            # the group spans every visible device — a device-resident
+            # value crossing this group's ops never host-stages
+            import jax
+
+            from ray_tpu.util.collective.collective_group.xla_group import (
+                XlaMeshGroup,
+            )
+
+            if world_size != 1:
+                raise ValueError(
+                    "backend='xla_mesh' is the single-controller fast "
+                    "path: exactly one participating process owns the "
+                    f"mesh (got world_size={world_size}); use "
+                    "backend='xla' for rank-per-process meshes")
+            g = XlaMeshGroup(len(jax.devices()), 0, group_name)
         else:
             from ray_tpu.util.collective.collective_group.xla_group import (
                 XlaDistributedGroup,

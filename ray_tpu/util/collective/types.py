@@ -19,6 +19,10 @@ class Backend(str, enum.Enum):
 
     TCP = "tcp"
     XLA = "xla"
+    # single-controller fast path: ONE process owns the whole device mesh
+    # ("ranks" are its devices); ops are jitted shard_map collectives over
+    # ICI — values never host-stage
+    XLA_MESH = "xla_mesh"
 
     @staticmethod
     def parse(v) -> "Backend":
@@ -29,6 +33,8 @@ class Backend(str, enum.Enum):
             return Backend.TCP
         if v in ("xla", "ici", "tpu", "nccl"):
             return Backend.XLA
+        if v in ("xla_mesh", "mesh"):
+            return Backend.XLA_MESH
         raise ValueError(f"unknown collective backend {v!r}")
 
 

@@ -156,3 +156,97 @@ def test_session_refs_released_on_disconnect(ray_isolated):
         assert w.shared_store.get_buffer(oid) is None
     finally:
         w.run_coro(server.stop())
+
+
+STREAMING_CLIENT_PROGRAM = textwrap.dedent("""
+    import sys
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1])
+
+    # task streaming generator over the client proxy
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    items = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert items == [0, 10, 20, 30, 40], items
+
+    # error propagation mid-stream
+    @ray_tpu.remote(num_returns="streaming")
+    def bad(n):
+        yield 1
+        raise ValueError("stream exploded")
+
+    it = iter(bad.remote(2))
+    assert ray_tpu.get(next(it)) == 1
+    try:
+        while True:
+            ray_tpu.get(next(it))
+        raise SystemExit("expected stream error")
+    except ray_tpu.exceptions.TaskError as e:
+        assert "stream exploded" in str(e)
+    except StopIteration:
+        raise SystemExit("error was swallowed")
+
+    # actor streaming generator
+    @ray_tpu.remote
+    class Chunker:
+        def chunks(self, n):
+            for i in range(n):
+                yield f"c{i}"
+
+    a = Chunker.remote()
+    out = [ray_tpu.get(r) for r in
+           a.chunks.options(num_returns="streaming").remote(3)]
+    assert out == ["c0", "c1", "c2"], out
+
+    # serve token-stream end-to-end: a streaming deployment consumed
+    # through handle.remote_streaming from the REMOTE driver
+    from ray_tpu import serve
+
+    @serve.deployment
+    class SSE:
+        def stream(self, body):
+            for i in range(int(body["n"])):
+                yield {"tok": i}
+
+    handle = serve.run(SSE.bind())
+    chunks = list(handle.stream.remote_streaming({"n": 4}))
+    assert chunks == [{"tok": 0}, {"tok": 1}, {"tok": 2}, {"tok": 3}], chunks
+    serve.shutdown()
+
+    ray_tpu.shutdown()
+    print("STREAM_CLIENT_OK")
+""")
+
+
+def test_client_streaming_generators(ray_isolated):
+    """Streaming generators over ray_tpu:// — task, actor, and a serve
+    streaming deployment driven by the remote driver (closes the loud
+    reject previously at util/client.py:319)."""
+    from ray_tpu.util.client import ClientServer
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    server = ClientServer(w)
+    host, port = w.run_coro(server.start(host="127.0.0.1", port=0))
+    try:
+        script = os.path.join(os.path.dirname(__file__),
+                              "_client_stream_prog.py")
+        with open(script, "w") as f:
+            f.write(STREAMING_CLIENT_PROGRAM)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, script, f"ray_tpu://127.0.0.1:{port}"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=repo)
+        assert out.returncode == 0, out.stderr[-3000:]
+        assert "STREAM_CLIENT_OK" in out.stdout
+        os.unlink(script)
+    finally:
+        w.run_coro(server.stop())

@@ -281,3 +281,89 @@ def test_dag_backed_replica_overlapping_requests(serve_shutdown):
     # at least two requests were inside __call__ simultaneously,
     # overlapping DAG iterations
     assert handle.peak.remote(None).result(timeout=30) >= 2
+
+
+def test_multiplexed_loading_and_eviction(serve_shutdown):
+    """@serve.multiplexed LRU-caches models per replica and evicts past
+    max_num_models_per_replica (reference serve/multiplex.py)."""
+    import os
+
+    @serve.deployment(num_replicas=1)
+    class MuxServer:
+        def __init__(self):
+            self.load_count = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.load_count += 1
+            return f"model:{model_id}"
+
+        def __call__(self, _body):
+            mid = serve.get_multiplexed_model_id()
+            model = self.get_model(mid)
+            return {"model": model, "loads": self.load_count,
+                    "pid": os.getpid()}
+
+    handle = serve.run(MuxServer.bind())
+    h_a = handle.options(multiplexed_model_id="a")
+    h_b = handle.options(multiplexed_model_id="b")
+    h_c = handle.options(multiplexed_model_id="c")
+
+    r1 = h_a.remote(None).result(timeout=60)
+    assert r1["model"] == "model:a" and r1["loads"] == 1
+    # cache hit: no reload
+    r2 = h_a.remote(None).result(timeout=60)
+    assert r2["loads"] == 1
+    # second model fits (max 2)
+    r3 = h_b.remote(None).result(timeout=60)
+    assert r3["model"] == "model:b" and r3["loads"] == 2
+    # third evicts LRU ("a"); loading "a" again is a fresh load
+    h_c.remote(None).result(timeout=60)
+    r5 = h_a.remote(None).result(timeout=60)
+    assert r5["loads"] == 4  # a,b,c, then a again
+
+
+def test_multiplexed_routing_affinity(serve_shutdown):
+    """With 2 replicas x 3 models, repeated requests for one model id
+    stick to the replica that already has it loaded."""
+    import os
+
+    @serve.deployment(num_replicas=2)
+    class Affine:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        def get_model(self, model_id: str):
+            return model_id
+
+        def __call__(self, _body):
+            self.get_model(serve.get_multiplexed_model_id())
+            return os.getpid()
+
+    handle = serve.run(Affine.bind())
+    pids = {m: {handle.options(multiplexed_model_id=m).remote(None)
+                .result(timeout=60) for _ in range(6)}
+            for m in ("m1", "m2", "m3")}
+    # each model's requests landed on ONE replica (affinity held)
+    for m, s in pids.items():
+        assert len(s) == 1, f"model {m} bounced across replicas: {s}"
+
+
+def test_multiplexed_http_header(serve_shutdown):
+    """The serve_multiplexed_model_id HTTP header reaches
+    serve.get_multiplexed_model_id() (reference proxy behavior)."""
+    import json
+    import urllib.request
+
+    @serve.deployment
+    class Hdr:
+        def __call__(self, _body):
+            return {"mid": serve.get_multiplexed_model_id()}
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 18437})
+    serve.run(Hdr.bind(), route_prefix="/hdr")
+    req = urllib.request.Request(
+        "http://127.0.0.1:18437/hdr", data=b"{}", method="POST",
+        headers={"Content-Type": "application/json",
+                 "serve_multiplexed_model_id": "lora-7"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["mid"] == "lora-7"
