@@ -50,6 +50,10 @@ _FLAG_DEFS: Dict[str, Any] = {
     # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc)
     "scheduler_spread_threshold": 0.5,
     "worker_lease_timeout_s": 30.0,
+    # how long a PENDING placement group whose bundles fit no ALIVE node
+    # keeps retrying before failing as infeasible — long enough for the
+    # autoscaler to provision a larger node type
+    "pg_infeasible_timeout_s": 300.0,
     # concurrent leased workers per scheduling key (reference
     # NormalTaskSubmitter requests one worker per queued task)
     "max_leases_per_scheduling_key": 32,

@@ -106,8 +106,27 @@ class GcsServer:
         # never mutated, so identity lets the unconditional backstop tick
         # skip re-copying + re-hashing 100MB packages every 5 s
         self._blob_name_cache: Dict[Any, Tuple[Any, str]] = {}
+        # incremental journal (WAL) state: per-key change detection so a
+        # busy cluster journals DELTAS per dirty tick instead of
+        # re-pickling every table (the O(total state) scaling cliff).
+        # kv: identity cache (values are replaced, never mutated);
+        # other tables: per-entry pickle digests (entries are small).
+        self._wal_file = None
+        self._wal_bytes = 0
+        self._wal_records = 0  # records since the last compaction
+        # kv key -> the VALUE OBJECT last journaled (pinning it: a bare
+        # id() would false-negative when the allocator reuses a freed
+        # address for the replacement value)
+        self._wal_kv_seen: Dict[Any, Any] = {}
+        self._wal_digests: Dict[str, Dict[Any, bytes]] = {}
+        self._last_full_snapshot_t = 0.0
+        # generation marker pairing a WAL with the snapshot it extends: a
+        # crash between snapshot-write and WAL-truncate must not replay a
+        # stale journal on top of the newer snapshot
+        self._persist_gen = 0
         if self._persist_enabled:
             self._load_snapshot()
+            self._replay_wal()
 
         self.server.register_all(self)
         for name, h in list(self.server._handlers.items()):
@@ -186,6 +205,8 @@ class GcsServer:
         state["_events"] = self._events[-10_000:]
         state["_event_base"] = self._event_base + max(
             0, len(self._events) - 10_000)
+        # the NEXT journal extends this snapshot; an older WAL is stale
+        state["_persist_gen"] = self._persist_gen + 1
         return state
 
     def _write_snapshot(self):
@@ -242,18 +263,243 @@ class GcsServer:
                 except OSError:
                     pass
 
+    # -- incremental journal (WAL) ---------------------------------------
+    #
+    # Per dirty tick, only CHANGED entries are appended to
+    # ``{storage_path}.wal`` as framed pickle records; a full snapshot
+    # (which truncates the WAL) runs only when the WAL outgrows
+    # ``_WAL_COMPACT_BYTES`` or every ``_FULL_SNAPSHOT_INTERVAL_S`` as a
+    # compaction/backstop.  Restart = load snapshot + replay WAL.
+    # Reference capability: the GCS's Redis/external-store persistence
+    # (per-key writes, not whole-state dumps).
+
+    _WAL_COMPACT_BYTES = 16 * 1024 * 1024
+    _FULL_SNAPSHOT_INTERVAL_S = 30.0
+    _WAL_DEL = "__wal_del__"
+    _NODE_VOLATILE = ("last_heartbeat", "pending_demand", "stats")
+
+    def _wal_path(self) -> str:
+        return self._storage_path + ".wal"
+
+    def _wal_open(self):
+        if self._wal_file is None:
+            import os
+            import pickle
+            import struct
+
+            self._wal_file = open(self._wal_path(), "ab")
+            self._wal_bytes = self._wal_file.tell()
+            os.makedirs(self._blob_dir(), exist_ok=True)
+            if self._wal_bytes == 0:
+                # header pairs this journal with the snapshot generation
+                # it extends; replay skips a WAL whose gen mismatches
+                hdr = pickle.dumps(("__wal_hdr__", None, self._persist_gen))
+                self._wal_file.write(struct.pack("<I", len(hdr)) + hdr)
+                self._wal_file.flush()
+                self._wal_bytes += 4 + len(hdr)
+        return self._wal_file
+
+    def _wal_append(self, blobs) -> None:
+        import struct
+
+        f = self._wal_open()
+        out = bytearray()
+        for blob in blobs:
+            out += struct.pack("<I", len(blob)) + blob
+        f.write(out)
+        f.flush()
+        self._wal_bytes += len(out)
+        self._wal_records += len(blobs)
+
+    def _collect_deltas(self):
+        """Changed/deleted entries since the last journal tick, as
+        PRE-PICKLED record blobs plus the cache commits to apply only
+        after the append succeeds (a failed append must leave the entry
+        'unjournaled' so the next tick retries it).  kv uses value
+        identity (replace-only semantics, the value object pinned);
+        other tables hash each (small) entry's pickle, with volatile
+        heartbeat fields excluded so idle heartbeats don't churn the
+        journal."""
+        import hashlib
+        import pickle
+
+        blobs = []
+        commits = []  # (dict, key, value-or-DEL) applied post-append
+        warned = [False]
+
+        def emit(table, key, value, cache, cache_val):
+            try:
+                blobs.append(pickle.dumps((table, key, value)))
+            except Exception:  # noqa: BLE001 — unpicklable entry
+                if not warned[0]:
+                    warned[0] = True
+                    logger.warning(
+                        "gcs WAL: unpicklable %s entry %r skipped (the "
+                        "full-snapshot path reports these)", table, key)
+                return
+            commits.append((cache, key, cache_val))
+
+        # kv: identity-diff; big values ride the existing blob side files
+        seen = set()
+        for k, v in self.kv.items():
+            seen.add(k)
+            if self._wal_kv_seen.get(k) is v:
+                continue
+            if (isinstance(v, (bytes, bytearray, memoryview))
+                    and len(v) >= _KV_BLOB_MIN):
+                emit("kv", k, ("__kv_blob__", self._ensure_blob(bytes(v))),
+                     self._wal_kv_seen, v)
+            else:
+                emit("kv", k, v, self._wal_kv_seen, v)
+        for k in list(self._wal_kv_seen):
+            if k not in seen:
+                emit("kv", k, self._WAL_DEL, self._wal_kv_seen,
+                     self._WAL_DEL)
+        # other tables: per-entry digest diff
+        for t in self._SNAPSHOT_TABLES:
+            if t == "kv":
+                continue
+            table = getattr(self, t)
+            digests = self._wal_digests.setdefault(t, {})
+            seen = set()
+            for k, v in list(table.items()):
+                if t == "nodes":
+                    v = {kk: vv for kk, vv in v.items()
+                         if kk not in self._NODE_VOLATILE}
+                try:
+                    blob = pickle.dumps(v)
+                except Exception:  # noqa: BLE001 — unpicklable entry
+                    continue  # full-snapshot path reports these loudly
+                d = hashlib.blake2b(blob, digest_size=16).digest()
+                seen.add(k)
+                if digests.get(k) != d:
+                    try:
+                        blobs.append(pickle.dumps((t, k, v)))
+                        commits.append((digests, k, d))
+                    except Exception:  # noqa: BLE001
+                        pass
+            for k in list(digests):
+                if k not in seen:
+                    emit(t, k, self._WAL_DEL, digests, self._WAL_DEL)
+        return blobs, commits
+
+    @staticmethod
+    def _apply_commits(commits) -> None:
+        for cache, key, val in commits:
+            if isinstance(val, str) and val == GcsServer._WAL_DEL:
+                cache.pop(key, None)
+            else:
+                cache[key] = val
+
+    def _replay_wal(self):
+        import os
+        import pickle
+        import struct
+
+        path = self._wal_path()
+        if not os.path.exists(path):
+            return
+        n = 0
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            off = 0
+            first = True
+            while off + 4 <= len(data):
+                (ln,) = struct.unpack_from("<I", data, off)
+                off += 4
+                if off + ln > len(data):
+                    break  # torn tail record from a crash: stop here
+                table, key, value = pickle.loads(data[off:off + ln])
+                off += ln
+                if first:
+                    first = False
+                    if table == "__wal_hdr__":
+                        if value != self._persist_gen:
+                            # journal predates the loaded snapshot (crash
+                            # between snapshot write and WAL truncate):
+                            # replaying it would revert newer state
+                            logger.info(
+                                "gcs WAL gen %s != snapshot gen %s; "
+                                "discarding stale journal", value,
+                                self._persist_gen)
+                            return
+                        continue
+                    # headerless journal (pre-gen format): replay as-is
+                n += 1
+                tbl = getattr(self, table)
+                if isinstance(value, str) and value == self._WAL_DEL:
+                    tbl.pop(key, None)
+                    continue
+                if (table == "kv" and isinstance(value, tuple)
+                        and len(value) == 2 and value[0] == "__kv_blob__"):
+                    try:
+                        with open(os.path.join(self._blob_dir(), value[1]),
+                                  "rb") as bf:
+                            value = bf.read()
+                    except OSError:
+                        continue
+                tbl[key] = value
+        except Exception:  # noqa: BLE001 — corrupt WAL: snapshot stands
+            logger.warning("gcs WAL replay stopped after %d records",
+                           n, exc_info=True)
+            return
+        if n:
+            logger.info("gcs WAL replayed: %d records", n)
+
+    def _wal_truncate(self):
+        import os
+
+        if self._wal_file is not None:
+            try:
+                self._wal_file.close()
+            except OSError:
+                pass
+            self._wal_file = None
+        try:
+            os.unlink(self._wal_path())
+        except OSError:
+            pass
+        self._wal_bytes = 0
+        self._wal_records = 0
+
     async def _persist_loop(self):
         tick = 0
         while not self._stopping:
             await asyncio.sleep(0.25)
             tick += 1
             # dirty-gated: idle clusters pay nothing; every 20th tick (5 s)
-            # writes unconditionally to backstop any missed dirty mark
+            # journals unconditionally to backstop any missed dirty mark
             if not self._dirty and tick % 20:
                 continue
             try:
                 self._dirty = False
-                self._write_snapshot()
+                now = time.time()
+                full_due = (
+                    self._wal_bytes >= self._WAL_COMPACT_BYTES
+                    or now - self._last_full_snapshot_t
+                    >= self._FULL_SNAPSHOT_INTERVAL_S)
+                # compaction only has something to fold in when the WAL
+                # carries records (or no snapshot exists yet) — otherwise
+                # the gen bump would orphan a healthy journal
+                if full_due and (self._wal_records
+                                 or not self._last_snapshot):
+                    # compaction: one full snapshot, then a fresh WAL
+                    # under the bumped generation
+                    self._write_snapshot()
+                    self._wal_truncate()
+                    self._persist_gen += 1
+                    self._last_full_snapshot_t = now
+                elif full_due:
+                    self._last_full_snapshot_t = now  # nothing to fold
+                else:
+                    blobs, commits = self._collect_deltas()
+                    if blobs:
+                        self._wal_append(blobs)
+                        # caches only advance once the bytes are DOWN:
+                        # a failed append leaves entries unjournaled so
+                        # the next tick retries them
+                        self._apply_commits(commits)
                 self._snapshot_warned = False
             except Exception:  # noqa: BLE001
                 if not self._snapshot_warned:
@@ -293,6 +539,7 @@ class GcsServer:
         self._job_counter = state.get("_job_counter", 0)
         self._events = list(state.get("_events", []))
         self._event_base = state.get("_event_base", 0)
+        self._persist_gen = state.get("_persist_gen", 0)
         now = time.time()
         for node in self.nodes.values():
             # grace period: raylets re-attach via their next heartbeat —

@@ -135,8 +135,12 @@ class Raylet:
         # monotonic deadlines for lost spawns: each entry holds ONE
         # _starting slot until its child registers (entry popped there)
         # or the deadline expires (reaper decrements _starting) — never
-        # both, so the startup-concurrency cap stays accurate
+        # both, so the startup-concurrency cap stays accurate.  A late
+        # registration AFTER its entry expired is balanced via
+        # _expired_lost (the register-path decrement is compensated), so
+        # FIFO entry/registration mismatches can't leak slots either way.
         self._lost_spawn_deadlines: List[float] = []
+        self._expired_lost = 0
 
         self.server.register_all(self)
 
@@ -338,6 +342,7 @@ class Raylet:
             while (self._lost_spawn_deadlines
                    and self._lost_spawn_deadlines[0] < now_m):
                 self._lost_spawn_deadlines.pop(0)
+                self._expired_lost += 1
                 self._starting = max(0, self._starting - 1)
                 logger.warning(
                     "lost zygote spawn never registered; releasing its "
@@ -647,6 +652,12 @@ class Raylet:
             self._spawned_procs[pid] = proc
             if self._lost_spawn_deadlines:
                 self._lost_spawn_deadlines.pop(0)  # slot consumed here
+            elif self._expired_lost > 0:
+                # this spawn's slot was already released at expiry: the
+                # register-path decrement below would double-release, so
+                # pre-compensate (net zero for this registration)
+                self._expired_lost -= 1
+                self._starting += 1
             if self._lost_spawn_logs and pid not in self._worker_logs:
                 self._worker_logs[pid] = {
                     "path": self._lost_spawn_logs.pop(0), "off": 0,
@@ -721,14 +732,20 @@ class Raylet:
                     # A PG that places slower than the deadline (nodes
                     # joining, autoscaling) is NOT an error — tell the
                     # client to re-issue the lease call (reference ray
-                    # queues such tasks until the PG places).  But a PG
-                    # whose bundles can NEVER fit any alive node must
-                    # fail loudly, or the client retries forever with no
-                    # diagnostic.
-                    if self._pg_infeasible(pg):
+                    # queues such tasks until the PG places).  A PG whose
+                    # bundles fit no ALIVE node may still be satisfied by
+                    # a node the autoscaler is about to launch, so
+                    # infeasibility only fails the task after a grace
+                    # period long enough for provisioning.
+                    if (self._pg_infeasible(pg)
+                            and time.time() - pg.get("create_time",
+                                                     time.time())
+                            > config.pg_infeasible_timeout_s):
                         raise RuntimeError(
                             "placement group is infeasible: some bundle "
-                            "exceeds every alive node's total resources")
+                            "has exceeded every alive node's total "
+                            "resources for over "
+                            f"{config.pg_infeasible_timeout_s:.0f}s")
                     return {"retry_pg_pending": True}
                 await asyncio.sleep(0.25)
                 target = await self._pg_bundle_node(pg_id, bundle_index,

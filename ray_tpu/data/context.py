@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 
 @dataclass
@@ -46,6 +46,12 @@ class DataContext:
     # Fuse compatible map operators into one task (operator fusion rule).
     enable_operator_fusion: bool = True
     execution_options: ExecutionOptions = field(default_factory=ExecutionOptions)
+    # Optional operator-selection policy for the streaming executor's
+    # dispatch loop: fn(candidate_ops) -> ops in dispatch-priority order.
+    # None = default smallest-output-queue-first ranking (reference:
+    # streaming_executor_state.select_operator_to_run + the pluggable
+    # backpressure_policy/ seam).
+    select_operator_fn: Optional[Callable] = None
     # iter_batches defaults
     default_batch_format: str = "numpy"
     prefetch_batches: int = 2

@@ -136,14 +136,43 @@ class StreamingExecutor:
                             progressed = True
         # 2. early stop: a downstream Limit reached its target
         self._propagate_limit_stop()
-        # 3. dispatch work
-        for op in self._ops:
-            dispatch = getattr(op, "dispatch", None)
-            if dispatch is None:
-                continue
-            while dispatch():
-                progressed = True
+        # 3. dispatch work: ONE task per selection, priorities
+        #    re-evaluated after each dispatch (reference
+        #    streaming_executor_state.select_operator_to_run) — without
+        #    this, a cheap upstream map dispatched to its cap floods the
+        #    pipeline while an expensive actor-pool stage starves.
+        if self._dispatch_round():
+            progressed = True
         return progressed
+
+    def _dispatch_round(self) -> bool:
+        """Dispatch until no operator can make progress.  Selection
+        policy: the runnable operator with the smallest output-queue
+        footprint (then fewest in-flight tasks) goes first, equalizing
+        memory across stages.  ``DataContext.select_operator_fn`` (if
+        set) overrides the ranking — the reference's pluggable
+        backpressure-policy seam."""
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        select = getattr(ctx, "select_operator_fn", None)
+        progressed = False
+        while True:
+            candidates = [op for op in self._ops
+                          if getattr(op, "dispatch", None) is not None]
+            if select is not None:
+                candidates = select(candidates)
+            else:
+                candidates = sorted(
+                    candidates,
+                    key=lambda o: (o.output_queue_bytes(),
+                                   o.num_active_tasks()))
+            for op in candidates:
+                if op.dispatch():
+                    progressed = True
+                    break  # re-rank: this dispatch changed the picture
+            else:
+                return progressed
 
     def _route(self, parent: PhysicalOperator, child: PhysicalOperator,
                bundle: RefBundle):

@@ -110,9 +110,16 @@ class ElasticScalingPolicy(ScalingPolicy):
 
         res = self.resources_per_worker or scaling_config.worker_resources()
         deadline = time.monotonic() + self.settle_s
-        best = self._fit_now(res)
-        while best < self.max_workers and time.monotonic() < deadline:
+        fit = prev = self._fit_now(res)
+        while time.monotonic() < deadline:
+            # the LAST sample wins: it reflects both directions of flux
+            # (a dead node dropping out of the view corrects an
+            # over-count; a released lease corrects an under-count).
+            # Early exit only when two consecutive samples agree at the
+            # cap — nothing more can appear.
+            if fit >= self.max_workers and prev >= self.max_workers:
+                break
             time.sleep(0.25)
-            best = max(best, self._fit_now(res))
-        n = max(self.min_workers, min(self.max_workers, best))
+            prev, fit = fit, self._fit_now(res)
+        n = max(self.min_workers, min(self.max_workers, fit))
         return ResizeDecision(num_workers=n)

@@ -192,3 +192,61 @@ def test_same_key_tasks_run_concurrently(ray_start):
     ray_tpu.get([first] + rest, timeout=60)
     wall = time.time() - t0
     assert wall < 5.0, f"same-key tasks serialized: wall={wall:.1f}s"
+
+
+class TestWorkerZygote:
+    def test_spawn_protocol_and_pid_identity(self, tmp_path):
+        """Drive the fork-server protocol directly: spawn returns a live
+        pid + starttime identity; stale identities read as dead."""
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time as _time
+
+        from ray_tpu._private.worker_zygote import (_recv_msg, _send_msg,
+                                                    proc_starttime)
+
+        sock = str(tmp_path / "zyg.sock")
+        env = dict(os.environ)
+        env["RAY_TPU_ZYGOTE_SOCK"] = sock
+        # point the forked worker at nowhere: the protocol (fork + reply)
+        # is what's under test; the child exits after failing to register
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_zygote"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        try:
+            deadline = _time.time() + 120
+            while not os.path.exists(sock):
+                assert _time.time() < deadline, "zygote never published"
+                _time.sleep(0.2)
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as c:
+                c.settimeout(30)
+                c.connect(sock)
+                _send_msg(c, {"env": {
+                    "RAY_TPU_SESSION_DIR": str(tmp_path),
+                    "RAY_TPU_GCS_ADDR": "tcp:127.0.0.1:1",
+                    "RAY_TPU_RAYLET_ADDR": "tcp:127.0.0.1:1",
+                    "RAY_TPU_NODE_ID": "zygtest",
+                }, "log_path": str(tmp_path / "w.log")})
+                reply = _recv_msg(c)
+            pid = reply["pid"]
+            assert pid > 0
+            st = reply.get("starttime")
+            assert st is not None and st == proc_starttime(pid)
+            # identity: a bogus starttime must read as dead/recycled
+            from ray_tpu._private.raylet import _ZygoteChild
+
+            assert _ZygoteChild(pid, st).poll() is None  # alive, matches
+            assert _ZygoteChild(pid, st + 999).poll() == -1  # "recycled"
+            os.kill(pid, signal.SIGKILL)
+            deadline = _time.time() + 30
+            while proc_starttime(pid) is not None:
+                assert _time.time() < deadline
+                _time.sleep(0.2)  # zygote reaps it
+            assert _ZygoteChild(pid, st).poll() == -1
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)

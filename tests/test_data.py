@@ -377,3 +377,32 @@ def test_input_files_union_covers_both_branches(ray_start, tmp_path):
         rd.read_csv(os.path.join(b, "*.csv")))
     files = ds.input_files()
     assert any("/a/" in f for f in files) and any("/b/" in f for f in files)
+
+
+def test_streaming_executor_prioritizes_loaded_operator(ray_start):
+    """Dispatch selection prefers the operator with the smallest output
+    queue (select_operator_to_run semantics): a cheap upstream map must
+    not flood the pipeline while an expensive downstream stage starves.
+    Asserted via the pluggable policy seam recording selection order."""
+    from ray_tpu.data.context import DataContext
+
+    picked = []
+    ctx = DataContext.get_current()
+
+    def recording_policy(candidates):
+        ranked = sorted(candidates,
+                        key=lambda o: (o.output_queue_bytes(),
+                                       o.num_active_tasks()))
+        picked.extend(o.name for o in ranked[:1])
+        return ranked
+
+    ctx.select_operator_fn = recording_policy
+    try:
+        ds = rd.range(64, parallelism=8) \
+            .map_batches(lambda b: {"id": b["id"] + 1}) \
+            .map_batches(lambda b: {"id": b["id"] * 2}, batch_size=8)
+        out = sorted(r["id"] for r in ds.take_all())
+        assert out == sorted((i + 1) * 2 for i in range(64))
+        assert picked, "policy was never consulted"
+    finally:
+        ctx.select_operator_fn = None
