@@ -84,6 +84,10 @@ _FLAG_DEFS: Dict[str, Any] = {
     "health_check_period_s": 5.0,
     "health_check_timeout_s": 30.0,
     "num_heartbeats_timeout": 6,
+    # non-force cancel: grace period for the injected async-exception to
+    # take effect before the (disposable, fork-server-replaced) worker is
+    # terminated — a thread blocked in a C call never sees the injection
+    "cancel_escalation_s": 2.0,
     # --- task/actor fault tolerance ---
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,

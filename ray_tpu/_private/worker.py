@@ -1191,11 +1191,11 @@ class CoreWorker:
                 lease.client = None
                 lease.worker_addr = None
                 if spec.task_id in self._cancel_requested:
-                    # force-cancel kills the leased worker: that death is
-                    # the cancellation, not a crash to retry
+                    # cancel kills the leased worker (force-kill, or the
+                    # non-force escalation for a C-blocked thread): that
+                    # death IS the cancellation, not a crash to retry
                     self._fail_task(spec, exc.TaskCancelledError(
-                        f"task {spec.task_id.hex()[:8]} was cancelled "
-                        f"(force)"))
+                        f"task {spec.task_id.hex()[:8]} was cancelled"))
                     return
                 if spec.num_returns == STREAMING_RETURNS:
                     # no streaming replay: already-consumed items can't be
@@ -2128,6 +2128,7 @@ class CoreWorker:
         # behavior as the reference's KeyboardInterrupt injection for
         # non-force cancel).  The lock pairs with _run's deregistration so
         # the exception can never land in the NEXT task on the thread.
+        injected = False
         with self._inject_lock:
             tid_thread = self._running_task_threads.get(tid)
             if tid_thread is not None:
@@ -2137,6 +2138,42 @@ class CoreWorker:
                 if res > 1:  # per CPython docs: undo and give up
                     ctypes.pythonapi.PyThreadState_SetAsyncExc(
                         ctypes.c_ulong(tid_thread), None)
+                else:
+                    injected = res == 1
+        if injected and self.actor_instance is None:
+            # A thread blocked in a C call (time.sleep, a long syscall, a
+            # jit dispatch) only sees the async-exc at its NEXT bytecode
+            # boundary — potentially never within any deadline.  The
+            # reference stays timely because its cancel interrupts the
+            # worker's MAIN thread; here plain-task workers are
+            # disposable (fork-server spawns replace them in ms), so if
+            # the task is still running after a grace period, terminate
+            # the worker — the owner marked the task cancelled, so the
+            # death surfaces as TaskCancelledError, not a retry.  Actor
+            # workers are never escalated (killing one would destroy
+            # actor state; reference semantics likewise restrict actor-
+            # task cancel to interruption).
+            async def _escalate():
+                await asyncio.sleep(config.cancel_escalation_s)
+                if tid not in self._running_task_threads:
+                    return
+                self._drain_ref_events()
+                if self.ref_counter.stats().get("owned", 0) > 0:
+                    # this worker owns live objects from earlier tasks
+                    # (put() results live in its stores); killing it
+                    # would lose them — wait for the injection instead
+                    logger.info(
+                        "cancel of %s: async-exc undelivered but worker "
+                        "owns live objects; not escalating",
+                        tid.hex()[:8])
+                    return
+                logger.info(
+                    "cancel of %s: async-exc not delivered after %.1fs "
+                    "(thread blocked in C); terminating worker",
+                    tid.hex()[:8], config.cancel_escalation_s)
+                await self._terminate_self()
+
+            asyncio.ensure_future(_escalate())
         return True  # queued here: _exec paths check _cancel_requested
 
     # ---------------------------------------------------------------- shutdown
