@@ -63,7 +63,8 @@ class _ZygoteChild:
 
 
 class WorkerHandle:
-    __slots__ = ("worker_id", "addr", "pid", "proc", "client", "lease", "dedicated", "started_at")
+    __slots__ = ("worker_id", "addr", "pid", "proc", "client", "lease",
+                 "dedicated", "started_at", "idle_since")
 
     def __init__(self, worker_id: bytes, addr: str, pid: int, proc):
         self.worker_id = worker_id
@@ -74,6 +75,7 @@ class WorkerHandle:
         self.lease: Optional[Dict[str, Any]] = None
         self.dedicated = False
         self.started_at = time.time()
+        self.idle_since: Optional[float] = None
 
 
 class Raylet:
@@ -141,6 +143,11 @@ class Raylet:
         # FIFO entry/registration mismatches can't leak slots either way.
         self._lost_spawn_deadlines: List[float] = []
         self._expired_lost = 0
+        # killed-but-not-yet-exited Popen children awaiting wait() —
+        # (proc, escalation deadline) pairs polled (and thereby
+        # zombie-reaped) by the reaper loop; past the deadline a worker
+        # that acked exit_worker but wedged in teardown gets SIGKILLed
+        self._dying_procs: List[Any] = []
 
         self.server.register_all(self)
 
@@ -347,6 +354,57 @@ class Raylet:
                 logger.warning(
                     "lost zygote spawn never registered; releasing its "
                     "startup slot")
+            # zombie-reap killed Popen children (poll() waits them);
+            # escalate to SIGKILL if one acked exit_worker but wedged
+            # in teardown past its deadline — Popen pids are our own
+            # un-reaped children, so the kill cannot hit a recycled pid
+            still_dying = []
+            for proc, kill_at in self._dying_procs:
+                if proc.poll() is not None:
+                    continue
+                if time.monotonic() > kill_at:
+                    try:
+                        if isinstance(proc, subprocess.Popen):
+                            proc.kill()
+                        elif proc.poll() is None:
+                            # zygote child, identity verified by poll()
+                            # above — not a recycled pid
+                            os.kill(proc.pid, 9)
+                    except Exception:
+                        pass
+                    still_dying.append((proc, float("inf")))
+                else:
+                    still_dying.append((proc, kill_at))
+            self._dying_procs = still_dying
+            # idle-worker eviction (reference WorkerPool idle kill):
+            # after a burst (e.g. 1,000 actors) released workers would
+            # otherwise hold RSS forever; the fork-server makes respawn
+            # ~ms, so idle workers past the deadline are reclaimed,
+            # keeping num_prestart_workers warm
+            if config.idle_worker_kill_s > 0:
+                floor = int(config.num_prestart_workers)
+                now = time.monotonic()
+                victims = [h for h in list(self.idle)
+                           if h.idle_since is not None
+                           and now - h.idle_since
+                           > config.idle_worker_kill_s]
+                # cap at what the floor allows so a warm steady state
+                # (all prestart workers idle past the deadline) builds
+                # no gather at all; each eviction still re-checks
+                victims = victims[:max(0, len(self.idle) - floor)]
+                if victims:
+                    # concurrent: a serial loop would stall this cycle's
+                    # crashed-worker / lost-spawn sweeps by up to 1s per
+                    # wedged victim; each eviction re-checks eligibility
+                    # in its own synchronous prefix.  return_exceptions
+                    # so one failed eviction (e.g. PermissionError from
+                    # a recycled pid) can't kill the reaper loop
+                    results = await asyncio.gather(
+                        *(self._evict_idle_worker(h, floor)
+                          for h in victims), return_exceptions=True)
+                    for r in results:
+                        if isinstance(r, BaseException):
+                            logger.warning("idle eviction failed: %r", r)
             await asyncio.sleep(0.2)
 
     async def _memory_monitor_loop(self):
@@ -665,6 +723,7 @@ class Raylet:
         h = WorkerHandle(worker_id, addr, pid, proc)
         self.workers[worker_id] = h
         self._starting = max(0, self._starting - 1)
+        h.idle_since = time.monotonic()
         self.idle.append(h)
         self._pump_leases()
         return {"node_id": self.node_id, "session_dir": self.session_dir}
@@ -915,7 +974,12 @@ class Raylet:
                     self._lease_waiters.rotate(-1)
                     continue
                 self._lease_waiters.popleft()
-                worker = self.idle.popleft()
+                # LIFO: reuse the most-recently-idle worker so excess
+                # workers go cold and age out under a steady trickle
+                # (reference WorkerPool pops MRU for the same reason);
+                # eviction scans from the old end of the deque
+                worker = self.idle.pop()
+                worker.idle_since = None
                 pool.subtract(demand)
                 worker.lease = {
                     "demand": demand, "pg_id": pg_id, "bundle_index": resolved_index,
@@ -951,9 +1015,76 @@ class Raylet:
             # dedicated (actor) workers die with their lease
             await self._kill_worker(h)
         else:
+            h.idle_since = time.monotonic()
             self.idle.append(h)
         self._pump_leases()
         return True
+
+    async def _evict_idle_worker(self, h: WorkerHandle, floor: int):
+        """Idle eviction with an owner-state handshake: the worker
+        DECLINES if it still owns objects (their payloads live in its
+        in-process store — killing the owner would strand every
+        borrower; the reference gates idle exit the same way) or is
+        still executing.  The eligibility re-check plus the idle.remove
+        happen before the first await, so a lease can never be granted
+        mid-handshake and a stale snapshot can never kill a leased
+        worker."""
+        if (h not in self.idle or h.idle_since is None
+                or time.monotonic() - h.idle_since
+                <= config.idle_worker_kill_s
+                or len(self.idle) <= floor):
+            return
+        self.idle.remove(h)
+        h.idle_since = None
+        evictable = False
+        unreachable = False
+        client = RpcClient(h.addr)
+        try:
+            evictable = bool(await asyncio.wait_for(
+                client.call("idle_probe"), timeout=1.0))
+        except Exception:
+            unreachable = True
+        finally:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        if unreachable:
+            # the probe is side-effect free, so a slow-but-alive worker
+            # is simply deferred; a provably dead one — proc.poll()
+            # carries (pid, starttime) identity for zygote children,
+            # never a bare kill-0 — goes through the ordinary death
+            # path (GCS death record, lease release) rather than
+            # _kill_worker, whose SIGKILL fallback could hit a
+            # recycled pid
+            if h.proc is not None and h.proc.poll() is not None:
+                await self._on_worker_death(h)
+                return
+            evictable = False
+        if not evictable:
+            # still owns state (or too busy to answer): defer a full
+            # idle period, back in the pool — unless a concurrent death
+            # path already reaped the handle during the probe await, in
+            # which case re-adding it would lease out a dead address
+            if h.worker_id not in self.workers:
+                return
+            h.idle_since = time.monotonic()
+            if unreachable:
+                # wedged (probe timed out): park at the COLD end so the
+                # LIFO lease pop prefers responsive workers
+                self.idle.appendleft(h)
+            else:
+                self.idle.append(h)
+            self._pump_leases()
+            return
+        # same guard as the decline path: a concurrent death path may
+        # have reaped this handle during the probe await, and killing a
+        # freed handle would end in an identity-unchecked SIGKILL on a
+        # possibly recycled pid
+        if h.worker_id not in self.workers:
+            return
+        logger.info("reaping idle worker %s", h.worker_id.hex()[:8])
+        await self._kill_worker(h)
 
     async def _kill_worker(self, h: WorkerHandle):
         self.workers.pop(h.worker_id, None)
@@ -963,16 +1094,34 @@ class Raylet:
                 self.idle.remove(h)
             except ValueError:
                 pass
+        client = RpcClient(h.addr)
         try:
-            client = RpcClient(h.addr)
             await asyncio.wait_for(client.call("exit_worker"), timeout=1.0)
-            await client.close()
         except Exception:
-            if h.pid:
+            # a zygote child that already exited was reaped by the
+            # zygote, so its pid may be recycled — SIGKILLing it blind
+            # would hit an unrelated process (same staleness guard as
+            # the memory-monitor kill path)
+            stale = (isinstance(h.proc, _ZygoteChild)
+                     and h.proc.poll() is not None)
+            if h.pid and not stale:
                 try:
                     os.kill(h.pid, 9)
                 except ProcessLookupError:
                     pass
+        finally:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        # hand the child to the reaper loop: a Popen worker is OUR
+        # child, and with its handle already dropped from every table
+        # nothing else would ever reap it — it would linger as a zombie
+        # (whose /proc entry also fools kill-0 liveness probes).  Zygote
+        # children are reaped by the zygote, but still need the
+        # SIGKILL-past-deadline escalation in case teardown wedges.
+        if h.proc is not None and hasattr(h.proc, "poll"):
+            self._dying_procs.append((h.proc, time.monotonic() + 30.0))
 
     # ------------------------------------------------------- placement bundles
 

@@ -2093,6 +2093,26 @@ class CoreWorker:
         asyncio.ensure_future(self._terminate_self())
         return True
 
+    async def handle_idle_probe(self) -> bool:
+        """Idle-eviction probe (side-effect FREE): report whether this
+        worker is safe to evict — no running/queued tasks and no OWNED
+        objects, whose payloads live in this process's in-process store
+        and would be stranded for every borrower if the owner died (the
+        reference gates idle exit on owned objects the same way:
+        core_worker.cc Exit(IDLE_EXIT)).  Termination happens via the
+        ordinary exit_worker RPC afterwards, so a probe reply that
+        outlives the raylet's timeout can never leave a half-dead
+        worker in the idle pool."""
+        if self._running_task_threads or self._inflight_by_task:
+            return False
+        if not config.reference_counting_enabled:
+            # with ref counting off, owned records are never freed so
+            # the gate below would decline forever; owners are not
+            # tracked in that mode anyway, so evict on task-idleness
+            return True
+        self._drain_ref_events()
+        return self.ref_counter.stats().get("owned", 0) <= 0
+
     async def handle_cancel_task(self, task_id: bytes, force: bool = False,
                                  recursive: bool = False) -> bool:
         """Executing-side cancel: interrupt the running task (async-exc

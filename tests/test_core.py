@@ -250,3 +250,56 @@ class TestWorkerZygote:
         finally:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_idle_worker_reaped(no_cluster, monkeypatch):
+    """Idle (non-dedicated) workers past idle_worker_kill_s are reclaimed
+    — a released burst must not hold worker RSS forever (reference
+    WorkerPool idle eviction).  Respawn is cheap via the fork-server."""
+    import os
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_IDLE_WORKER_KILL_S", "1.5")
+    monkeypatch.setenv("RAY_TPU_NUM_PRESTART_WORKERS", "0")
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    @ray_tpu.remote
+    def pidof():
+        return os.getpid()
+
+    pid = ray_tpu.get(pidof.remote(), timeout=120)
+    # lease returned -> worker idles; past the deadline it is reaped
+    deadline = _time.time() + 30
+    while _time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        _time.sleep(0.5)
+    else:
+        raise AssertionError(f"idle worker {pid} never reaped")
+    # the pool still works: a fresh worker serves the next task
+    assert isinstance(ray_tpu.get(pidof.remote(), timeout=120), int)
+
+
+def test_idle_eviction_spares_object_owner(no_cluster, monkeypatch):
+    """A worker that still OWNS objects must decline idle eviction: its
+    in-process store holds the payloads, so killing the owner would
+    strand every borrower (reference gates idle exit on owned objects)."""
+    import os
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_IDLE_WORKER_KILL_S", "1.5")
+    monkeypatch.setenv("RAY_TPU_NUM_PRESTART_WORKERS", "0")
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+
+    @ray_tpu.remote
+    def make_owned():
+        return os.getpid(), [ray_tpu.put("owner-hosted payload")]
+
+    pid, (inner,) = ray_tpu.get(make_owned.remote(), timeout=120)
+    # well past the idle deadline the owner must still be alive
+    _time.sleep(5)
+    os.kill(pid, 0)  # raises ProcessLookupError if evicted
+    # and the owner-hosted payload must still be fetchable
+    assert ray_tpu.get(inner, timeout=60) == "owner-hosted payload"
