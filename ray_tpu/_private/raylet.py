@@ -365,7 +365,13 @@ class Raylet:
                 if time.monotonic() > kill_at:
                     try:
                         if isinstance(proc, subprocess.Popen):
-                            proc.kill()
+                            # session leader (start_new_session=True):
+                            # killpg reaps any children it spawned too,
+                            # matching the memory-monitor kill path
+                            try:
+                                os.killpg(proc.pid, 9)
+                            except ProcessLookupError:
+                                proc.kill()
                         elif proc.poll() is None:
                             # zygote child, identity verified by poll()
                             # above — not a recycled pid
@@ -381,7 +387,12 @@ class Raylet:
             # otherwise hold RSS forever; the fork-server makes respawn
             # ~ms, so idle workers past the deadline are reclaimed,
             # keeping num_prestart_workers warm
-            if config.idle_worker_kill_s > 0:
+            # eviction needs ownership tracking: with reference
+            # counting disabled ANY worker may hold refs that stay
+            # valid forever (lineage records are never freed), so no
+            # idle worker could ever prove itself safe to kill
+            if (config.idle_worker_kill_s > 0
+                    and config.reference_counting_enabled):
                 floor = int(config.num_prestart_workers)
                 now = time.monotonic()
                 victims = [h for h in list(self.idle)

@@ -2105,12 +2105,12 @@ class CoreWorker:
         worker in the idle pool."""
         if self._running_task_threads or self._inflight_by_task:
             return False
-        if not config.reference_counting_enabled:
-            # with ref counting off, owned records are never freed so
-            # the gate below would decline forever; owners are not
-            # tracked in that mode anyway, so evict on task-idleness
-            return True
         self._drain_ref_events()
+        # owned-records gate; borrow-cached memory_store entries are
+        # not owned records and never block (borrowers fetch from the
+        # owner's address, not from this cache).  With reference
+        # counting disabled the raylet never probes at all — records
+        # are never freed in that mode, so eviction is off wholesale.
         return self.ref_counter.stats().get("owned", 0) <= 0
 
     async def handle_cancel_task(self, task_id: bytes, force: bool = False,

@@ -181,7 +181,8 @@ class LLMEngine:
                  max_len: Optional[int] = None, block_size: int = 16,
                  num_blocks: Optional[int] = None, decode_window: int = 16,
                  seed: int = 0, mesh=None,
-                 kv_cache_dtype: Optional[str] = None):
+                 kv_cache_dtype: Optional[str] = None,
+                 spec_tokens: int = 0, spec_ngram: int = 2):
         import jax
         import jax.numpy as jnp
 
@@ -228,6 +229,31 @@ class LLMEngine:
             functools.partial(prefill_suffix, cfg=cfg),
             donate_argnums=(9,))  # the pool (avoid a full second copy)
         self._sample = jax.jit(sample_token_batch)
+        # prompt-lookup speculative decoding (vLLM's ngram method,
+        # TPU-native): host drafts from each request's own history, one
+        # batched paged_verify_step forward checks pending + G drafts,
+        # greedy acceptance keeps the longest matching prefix + a bonus
+        # token — up to G+1 tokens per host sync, token-EXACT vs plain
+        # greedy decode.  Only fully-greedy batches speculate.
+        self.G = max(0, int(spec_tokens))
+        if self.G and int(spec_ngram) < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        self.spec_ngram = int(spec_ngram)
+        self.spec_stats = {"proposed": 0, "accepted": 0, "verify_steps": 0,
+                           "backoffs": 0}
+        # dynamic disable (vLLM-style): a verify pass that mispredicts
+        # yields ~1 token per host sync vs decode_window per sync, so a
+        # low-acceptance workload must fall back to the plain window.
+        # EMA of per-verify acceptance; below the floor speculation rests
+        # for a growing number of steps
+        self._spec_ema = 1.0  # optimistic start
+        self._spec_backoff = 0
+        self._spec_backoff_len = 8
+        if self.G:
+            from ray_tpu.models.paged_generation import paged_verify_step
+            self._verify = jax.jit(
+                functools.partial(paged_verify_step, cfg=cfg),
+                donate_argnums=(4,))
 
         self._ids = itertools.count()
         self._queue: "collections.deque[Request]" = collections.deque()
@@ -337,6 +363,8 @@ class LLMEngine:
 
         active = [i for i in range(self.B) if self._slots[i] is not None
                   and not self._slots[i].done]
+        if active and self.G and self._try_speculate(active):
+            active = []  # tokens for this step came from the verify pass
         if active:
             # ensure every active slot has blocks for the whole window;
             # preempt the youngest request if the pool is exhausted
@@ -352,12 +380,10 @@ class LLMEngine:
                         - len(req.out_tokens))
                 rem = max(rem, r)
             window_k = max(1, min(self.K, rem))
-            if self._dev_dirty or self._dev is None:
+            self._refresh_device_mirrors()
+            if self._dev is None:
                 tok_d = jnp.asarray(self._next_token)
                 cur_d = jnp.asarray(self._cur_len)
-                self._tables_d = jnp.asarray(self._tables)
-                self._temps_d = jnp.asarray(self._temp_vec())
-                self._dev_dirty = False
             else:
                 tok_d, cur_d = self._dev
             key_d = self._key
@@ -565,6 +591,88 @@ class LLMEngine:
         self.blocks.stats["preemptions"] += 1
         return i
 
+    # -- speculative decoding ------------------------------------------------
+
+    def _try_speculate(self, active: List[int]) -> bool:
+        """Prompt-lookup speculative step: draft up to G tokens per active
+        slot from its own history, verify pending + drafts in ONE batched
+        ``paged_verify_step``, accept the longest greedy-matching prefix
+        plus the bonus token.  Returns False (caller falls back to the
+        plain decode window) when any active slot samples (temp > 0 —
+        greedy acceptance would skew its distribution) or when any slot
+        lacks a draft: a verify pass advances a draftless slot only 1
+        token per host sync, so speculating a partially-drafting batch
+        would starve those slots of the K-step window amortization."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.generation import _propose_ngram
+
+        if any(self._slots[i].sampling.temperature > 0.0 for i in active):
+            return False
+        if self._spec_backoff > 0:
+            self._spec_backoff -= 1
+            return False
+        drafts: Dict[int, List[int]] = {}
+        for i in active:
+            req = self._slots[i]
+            hist = req.prompt_tokens + req.out_tokens
+            drafts[i] = _propose_ngram(hist, self.G, self.spec_ngram)[:self.G]
+            if not drafts[i]:
+                return False
+        active = self._ensure_decode_blocks(active, horizon=self.G + 1)
+        if not active:
+            return True  # everything was preempted; step's retire handles it
+        tokens = np.zeros((self.B, self.G + 1), np.int32)
+        for i in active:
+            tokens[i, 0] = self._next_token[i]
+            d = drafts.get(i, [])
+            tokens[i, 1:1 + len(d)] = d
+        # reuse the resident tables mirror: _ensure_decode_blocks sets
+        # _dev_dirty whenever it actually grows a table
+        self._refresh_device_mirrors()
+        logits_d, self.pool = self._verify(
+            self.params, jnp.asarray(tokens), jnp.asarray(self._cur_len),
+            self._tables_d, self.pool)
+        preds = np.asarray(jnp.argmax(logits_d, -1))  # ONE sync: [B, G+1]
+        self.spec_stats["verify_steps"] += 1
+        accepted_last: Dict[int, int] = {}
+        for i in active:
+            req = self._slots[i]
+            if req is None or req.done:
+                continue
+            d = drafts.get(i, [])
+            a = 0
+            while a < len(d) and d[a] == int(preds[i, a]):
+                a += 1
+            accepted_last[i] = a
+            self.spec_stats["proposed"] += len(d)
+            self.spec_stats["accepted"] += a
+            # pending + a accepted drafts now hold valid cache positions;
+            # the bonus becomes the new pending token (not yet written)
+            self._cur_len[i] += 1 + a
+            for tok in d[:a]:
+                self._record_token(i, req, int(tok))
+                if req.done:
+                    break
+            if not req.done:
+                self._record_token(i, req, int(preds[i, a]))
+        self._dev = None  # cur/next advanced on host; tables unchanged
+        n_prop = sum(len(drafts.get(i, [])) for i in active)
+        n_acc = sum(accepted_last.get(i, 0) for i in active)
+        if n_prop:
+            self._spec_ema = 0.7 * self._spec_ema + 0.3 * (n_acc / n_prop)
+        if self._spec_ema < 0.35:
+            self.spec_stats["backoffs"] += 1
+            self._spec_backoff = self._spec_backoff_len
+            self._spec_backoff_len = min(self._spec_backoff_len * 2, 256)
+            # re-probe just above the floor: ONE more bad verify
+            # re-triggers with the doubled rest (escalation reachable),
+            # while a good one climbs the EMA back toward keeping on
+            self._spec_ema = 0.45
+        elif self._spec_ema > 0.6:
+            self._spec_backoff_len = 8  # healthy again: cheap re-probes
+        return True
+
     # -- internals ----------------------------------------------------------
 
     def _record_token(self, i: int, req: Request, tok: int):
@@ -583,6 +691,20 @@ class LLMEngine:
                 or len(req.prompt_tokens) + len(req.out_tokens)
                 >= self.max_len - 1):
             req.done = True
+
+    def _refresh_device_mirrors(self):
+        """Re-upload the tables/temps device mirrors iff a host-side slot
+        mutation (admit/retire/preempt/table growth) dirtied them — ONE
+        invariant for both the decode window and the verify path (temps
+        is B floats, noise next to the [B, MB] tables).  Dirty also
+        invalidates the tok/cur pair: the slot set changed."""
+        import jax.numpy as jnp
+
+        if self._dev_dirty or self._tables_d is None:
+            self._tables_d = jnp.asarray(self._tables)
+            self._temps_d = jnp.asarray(self._temp_vec())
+            self._dev = None
+            self._dev_dirty = False
 
     def _temp_vec(self, sl: slice = slice(None)) -> np.ndarray:
         temps = np.ones(self.B, np.float32)

@@ -210,6 +210,54 @@ def prefill_suffix(params, tokens, length, start_pos, prefix_k, prefix_v,
     return last, pool
 
 
+def paged_verify_step(params, tokens, cur_len, block_tables, pool,
+                      cfg: LlamaConfig):
+    """Speculative-decoding verify against block-table caches: feed S
+    tokens per slot in ONE forward (``tokens[:, 0]`` is the pending
+    last-accepted token, ``1..S-1`` the draft proposals).
+
+    ``logits[:, j]`` predicts the token at position ``cur_len+j+1``, so
+    greedy acceptance compares ``argmax(logits[:, j])`` with draft token
+    ``j+1`` — the paged counterpart of the dense ``verify_step``
+    (``models/generation.py``).  KV for all S positions is written at
+    ``cur_len..cur_len+S-1`` through the block tables (pad / overflow
+    lanes clamp into the scratch block); slots past the accepted prefix
+    hold draft-conditioned KV but stay invisible (masks are
+    ``<= position``) and are overwritten when those positions are
+    genuinely reached.  The reference reaches this via vLLM's
+    speculative/prompt-lookup decoding; here it is a first-class pool op.
+    """
+    b, S = tokens.shape
+    MB = block_tables.shape[1]
+    bs = pool["k"].shape[2]
+    ML = MB * bs
+    hd = cfg.resolved_head_dim
+    dt = cfg.dtype
+    cos, sin = rope_frequencies(hd, ML, cfg.rope_theta)
+    positions = cur_len[:, None] + jnp.arange(S)[None, :]  # [b, S]
+    safe_pos = jnp.minimum(positions, ML - 1)
+    x = params["embed"][tokens].astype(dt)
+    idx = jnp.arange(ML)
+    # query at global position p sees pool slots <= p (its own included);
+    # earlier same-chunk tokens are visible because each layer stores the
+    # whole chunk's KV before gathering
+    mask = idx[None, None, :] <= safe_pos[:, :, None]
+    rows = jnp.arange(b)[:, None]
+    blk = block_tables[rows, safe_pos // bs]  # [b, S]
+    off = safe_pos % bs
+
+    for i, lp in _stacked_layers(params):
+        def merge(k, v, i=i):
+            nonlocal pool
+            pool = _store_kv(pool, i, blk, off, k, v)  # k/v [b, S, KVH, hd]
+            g = _gather_kv(pool, i, block_tables, dt)
+            return tuple(a.reshape(b, ML, *a.shape[3:]) for a in g)
+
+        x, _ = _layer_with_cache(x, lp, merge, cfg=cfg, cos=cos, sin=sin,
+                                 mask=mask, positions=safe_pos)
+    return _lm_head(params, cfg, x), pool
+
+
 def paged_decode_sample(params, token, cur_len, block_tables, pool, key,
                         temps, cfg: LlamaConfig):
     """One decode step with ON-DEVICE sampling, shaped for host-free
