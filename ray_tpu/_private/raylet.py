@@ -363,15 +363,14 @@ class Raylet:
                 if proc.poll() is not None:
                     continue
                 if time.monotonic() > kill_at:
+                    from ray_tpu._private.process_utils import \
+                        sigkill_tree
                     try:
                         if isinstance(proc, subprocess.Popen):
                             # session leader (start_new_session=True):
-                            # killpg reaps any children it spawned too,
-                            # matching the memory-monitor kill path
-                            try:
-                                os.killpg(proc.pid, 9)
-                            except ProcessLookupError:
-                                proc.kill()
+                            # the shared helper kills the whole group
+                            # with the pid-alone fallback
+                            sigkill_tree(proc.pid)
                         elif proc.poll() is None:
                             # zygote child, identity verified by poll()
                             # above — not a recycled pid

@@ -79,6 +79,11 @@ class Request:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     blocks: List[int] = dataclasses.field(default_factory=list)
+    # chunked prefill: blocks already written for this prompt, refs HELD
+    # (pinned against ORDINARY pool pressure; forfeited by
+    # _yield_chunk_pins when a starved queue head needs the pool);
+    # transferred into ``blocks`` at final admission
+    chunk_blocks: List[int] = dataclasses.field(default_factory=list)
     cached_prefix_len: int = 0  # tokens served from the prefix cache
     # preemption folds generated tokens into prompt_tokens for re-prefill;
     # n_prompt remembers the ORIGINAL prompt length so outputs and the
@@ -182,7 +187,8 @@ class LLMEngine:
                  num_blocks: Optional[int] = None, decode_window: int = 16,
                  seed: int = 0, mesh=None,
                  kv_cache_dtype: Optional[str] = None,
-                 spec_tokens: int = 0, spec_ngram: int = 2):
+                 spec_tokens: int = 0, spec_ngram: int = 2,
+                 prefill_chunk: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -235,6 +241,14 @@ class LLMEngine:
         # greedy acceptance keeps the longest matching prefix + a bonus
         # token — up to G+1 tokens per host sync, token-EXACT vs plain
         # greedy decode.  Only fully-greedy batches speculate.
+        #
+        # Economics: a verify pass yields up to G+1 tokens per FORWARD
+        # (one weights read) where the decode window pays one forward
+        # per token — on a weights-bound chip speculation wins whenever
+        # acceptance is decent, even with G+1 < decode_window.  On a
+        # LATENCY-dominated link (tunnel'd chip, ~100ms/sync) the window
+        # amortizes syncs better: there, size spec_tokens so G+1 is
+        # comparable to decode_window, or leave speculation off.
         self.G = max(0, int(spec_tokens))
         if self.G and int(spec_ngram) < 1:
             raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
@@ -249,12 +263,24 @@ class LLMEngine:
         self._spec_ema = 1.0  # optimistic start
         self._spec_backoff = 0
         self._spec_backoff_len = 8
+        self._spec_dry = 0  # consecutive draftless attempts
         if self.G:
             from ray_tpu.models.paged_generation import paged_verify_step
             self._verify = jax.jit(
                 functools.partial(paged_verify_step, cfg=cfg),
                 donate_argnums=(4,))
 
+        # chunked prefill (vLLM's feature TPU-natively): cap the prompt
+        # tokens prefilled per step so a long prompt can't stall the
+        # decode batch.  Chunks are block-aligned; their full blocks
+        # register in the prefix cache and the NEXT admission resumes
+        # from them via ordinary prefix hits — no separate partial state.
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        if self.prefill_chunk and self.prefill_chunk < self.bs:
+            raise ValueError(
+                f"prefill_chunk ({prefill_chunk}) must be >= block_size "
+                f"({self.bs})")
+        self.prefill_stats = {"chunks": 0}
         self._ids = itertools.count()
         self._queue: "collections.deque[Request]" = collections.deque()
         self._failed: List[Request] = []  # per-request admission failures
@@ -346,12 +372,20 @@ class LLMEngine:
         # 1. admit — prefills dispatch back-to-back; the first tokens of
         # ALL admissions are sampled and fetched in ONE host sync
         admitted: List[Tuple[int, Any]] = []
+        budget = self.prefill_chunk or None  # tokens of prefill this step
         for i in range(self.B):
             if self._slots[i] is None and self._queue:
-                logits_d = self._admit(i)
-                if logits_d is None:
+                res = self._admit(i, budget)
+                if res is None:
                     break  # out of blocks: stop admitting this step
-                admitted.append((i, logits_d))
+                kind, payload, used = res
+                if budget is not None:
+                    budget -= used
+                if kind == "partial":
+                    break  # head request still prefilling; slot stays free
+                admitted.append((i, payload))
+                if budget is not None and budget <= 0:
+                    break  # spent: further walks would only defer
         if admitted:
             self._key, k = jax.random.split(self._key)
             lg = self._stack(*[d for _, d in admitted])[:, 0]
@@ -446,23 +480,26 @@ class LLMEngine:
             keys.append(parent)
         return keys
 
-    def _admit(self, i: int):
-        """Prefill the next queued request into slot i.  Returns the
-        last-position logits as a DEVICE array (the caller batch-samples
-        all admissions with one sync), or None when the pool can't hold
-        the suffix (queue left untouched)."""
-        import jax.numpy as jnp
+    def _admit(self, i: int, budget: Optional[int] = None):
+        """Prefill the next queued request into slot i.
 
-        from ray_tpu.models.paged_generation import gather_prefix
-
+        Returns ``("full", logits_device_array, tokens_prefilled)`` when
+        the request is admitted (the caller batch-samples all admissions
+        with one sync), ``("partial", None, tokens_prefilled)`` when only
+        a block-aligned CHUNK of a long prompt was prefilled this step
+        (the request stays queued holding refs on its chunk blocks), or
+        None when the pool can't hold the suffix (queue left untouched).
+        """
         req = self._queue[0]
         toks = req.prompt_tokens
         n = len(toks)
-        # prefix walk: reuse every leading full block already cached (but
-        # always leave >=1 token to prefill — its logits seed sampling)
+        # prefix walk: resume from this prompt's own pinned chunk blocks,
+        # then reuse every further cached block (but always leave >=1
+        # token to prefill — its logits seed sampling)
+        pinned = list(req.chunk_blocks)
         keys = self._prompt_chain_keys(toks)
-        hit_blocks: List[int] = []
-        for key in keys:
+        hit_blocks: List[int] = pinned[:]
+        for key in keys[len(pinned):]:
             if len(hit_blocks) * self.bs >= n - 1:
                 break
             bid = self.blocks.acquire_cached(key)
@@ -471,6 +508,8 @@ class LLMEngine:
             hit_blocks.append(bid)
         cached_len = len(hit_blocks) * self.bs
         if cached_len > n - 1:  # whole prompt cached: recompute last block
+            # only ever an ACQUIRED block: chunk takes are capped at
+            # (n-1)//bs blocks, so the pinned prefix can't cross n-1
             for bid in hit_blocks[-1:]:
                 self.blocks.release(bid)
             hit_blocks = hit_blocks[:-1]
@@ -489,47 +528,43 @@ class LLMEngine:
             # the whole batch; one oversized HTTP request must not kill
             # every other in-flight generation
             self._queue.popleft()
-            for bid in hit_blocks:
+            for bid in hit_blocks:  # includes any pinned chunk blocks
                 self.blocks.release(bid)
+            req.chunk_blocks = []
             req.done = True
             req.error = (
                 f"KV pool ({self.num_blocks} blocks of {self.bs}) cannot "
                 f"hold one sequence of up to {worst} blocks; raise "
                 f"num_blocks or lower max_tokens")
             self._failed.append(req)
-            return self._admit(i) if self._queue else None
+            return self._admit(i, budget) if self._queue else None
+        if budget is not None and len(suffix) > budget:
+            # long prompt: prefill one block-aligned chunk instead of
+            # stalling the decode batch on the whole suffix (checked
+            # AFTER the oversized fail-fast so impossible requests never
+            # chunk-prefill)
+            return self._admit_chunk(i, req, hit_blocks, len(pinned),
+                                      cached_len, budget, keys)
         if self.blocks.available() < need:
-            for bid in hit_blocks:
-                self.blocks.release(bid)
+            for bid in hit_blocks[len(pinned):]:
+                self.blocks.release(bid)  # pinned chunk progress stays
+            if self._yield_chunk_pins():
+                # freed capacity is usable NOW — retry instead of
+                # wasting a whole engine step (decode path does the same)
+                return self._admit(i, budget)
             return None
-        if hit_blocks:
+        if len(hit_blocks) > len(pinned):
             self.blocks.stats["prefix_hits"] += 1
 
         new_blocks = [self.blocks.alloc() for _ in range(need)]
         req.blocks = hit_blocks + new_blocks
+        req.chunk_blocks = []  # refs transferred into req.blocks
         req.cached_prefix_len = cached_len
         self._queue.popleft()
         self._slots[i] = req
 
-        # jit-bucketed shapes: suffix length and prefix block count
-        S = _bucket(len(suffix), self.max_len)
-        P = _bucket(len(hit_blocks), self.MB) if hit_blocks else 0
-        pad_tok = suffix + [0] * (S - len(suffix))
-        # pool coordinates for each padded suffix lane (pads -> scratch 0)
-        dst_b = np.zeros(S, np.int32)
-        dst_o = np.zeros(S, np.int32)
-        for j in range(len(suffix)):
-            p = cached_len + j
-            dst_b[j] = req.blocks[p // self.bs]
-            dst_o[j] = p % self.bs
-        prefix_ids = np.zeros(P, np.int32)
-        prefix_ids[:len(hit_blocks)] = hit_blocks
-        pk, pv = gather_prefix(self.pool, jnp.asarray(prefix_ids))
-        logits, self.pool = self._prefill(
-            self.params, jnp.asarray([pad_tok], jnp.int32),
-            jnp.int32(len(suffix)), jnp.int32(cached_len),
-            pk, pv, jnp.int32(cached_len),
-            jnp.asarray(dst_b), jnp.asarray(dst_o), self.pool)
+        logits = self._run_prefill(suffix, cached_len, req.blocks,
+                                   hit_blocks)
         # register freshly-computed full blocks for future prefix hits
         for b in range(len(hit_blocks), n // self.bs):
             if (b + 1) * self.bs <= n:
@@ -538,7 +573,101 @@ class LLMEngine:
         self._tables[i] = 0
         self._tables[i, :len(req.blocks)] = req.blocks
         self._dev_dirty = True
-        return logits  # device array; caller batch-samples all admissions
+        # device array; caller batch-samples all admissions in one sync
+        return ("full", logits, len(suffix))
+
+    def _yield_chunk_pins(self):
+        """Break the pinned-chunk livelock: when the queue HEAD stalls on
+        pool pressure while a LATER-queued prompt pins chunk progress,
+        one victim forfeits its pins — the registered blocks retire into
+        the LRU (contents may still re-hit; under real pressure they
+        evict and that chunk recomputes), so the pool can drain again.
+        Returns True when a victim forfeited pins."""
+        for other in list(self._queue)[1:]:
+            if other.chunk_blocks:
+                for bid in other.chunk_blocks:
+                    self.blocks.release(bid)
+                other.chunk_blocks = []
+                return True
+        return False
+
+    def _run_prefill(self, suffix: List[int], cached_len: int,
+                     blocks: List[int], hit_blocks: List[int]):
+        """ONE bucketed b=1 ``prefill_suffix`` dispatch shared by full
+        admissions and chunk prefills: pads the suffix to its jit bucket,
+        builds the scatter coordinates from ``blocks`` (position p ->
+        ``blocks[p // bs]``), gathers the cached prefix, and returns the
+        last-position logits as a device array."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.paged_generation import gather_prefix
+
+        S = _bucket(len(suffix), self.max_len)
+        pad_tok = list(suffix) + [0] * (S - len(suffix))
+        # pool coordinates for each padded suffix lane (pads -> scratch 0)
+        dst_b = np.zeros(S, np.int32)
+        dst_o = np.zeros(S, np.int32)
+        for j in range(len(suffix)):
+            p = cached_len + j
+            dst_b[j] = blocks[p // self.bs]
+            dst_o[j] = p % self.bs
+        P = _bucket(len(hit_blocks), self.MB) if hit_blocks else 0
+        prefix_ids = np.zeros(P, np.int32)
+        prefix_ids[:len(hit_blocks)] = hit_blocks
+        pk, pv = gather_prefix(self.pool, jnp.asarray(prefix_ids))
+        logits, self.pool = self._prefill(
+            self.params, jnp.asarray([pad_tok], jnp.int32),
+            jnp.int32(len(suffix)), jnp.int32(cached_len),
+            pk, pv, jnp.int32(cached_len),
+            jnp.asarray(dst_b), jnp.asarray(dst_o), self.pool)
+        return logits
+
+    def _admit_chunk(self, i: int, req: Request, hit_blocks: List[int],
+                     n_pinned: int, cached_len: int, budget: int,
+                     keys: List[Any]):
+        """Prefill one block-aligned chunk of a long prompt WITHOUT
+        occupying a slot: write the chunk's KV, register its (full)
+        blocks under the prefix hash chain, and PIN them on the request
+        (refs held in ``req.chunk_blocks``) so ordinary pool pressure
+        can't evict the prompt's own progress — the next admission
+        resumes from the pinned prefix directly.  Pins are forfeited
+        only by ``_yield_chunk_pins`` (starved queue head).  The request
+        stays at the queue head."""
+        toks = req.prompt_tokens
+        # chunk end: block-aligned, within budget, and NEVER the whole
+        # remaining suffix (the final partial admission must sample)
+        take = ((cached_len + budget) // self.bs) * self.bs - cached_len
+        take = min(take, ((len(toks) - 1 - cached_len) // self.bs)
+                   * self.bs)
+        if take < self.bs:
+            # budget tail can't cover one full block this step: defer
+            # (short prompts can still full-admit from the same tail)
+            for bid in hit_blocks[n_pinned:]:
+                self.blocks.release(bid)
+            return ("partial", None, 0)
+        n_need = take // self.bs
+        if self.blocks.available() < n_need:
+            for bid in hit_blocks[n_pinned:]:
+                self.blocks.release(bid)
+            if self._yield_chunk_pins():
+                return self._admit(i, budget)  # retry with freed blocks
+            return None  # pool pressure: try again later
+        chunk = toks[cached_len:cached_len + take]
+        new_blocks = [self.blocks.alloc() for _ in range(n_need)]
+        # each chunk re-gathers the whole pinned prefix (O(n^2/chunk)
+        # copy traffic over the prompt) — a constant factor of chunked
+        # attention's inherent O(n^2) KV reads and far below decode's
+        # per-token full-table gather, so a block-table-reading prefill
+        # kernel is a future optimization, not a scaling fix
+        self._run_prefill(chunk, cached_len, hit_blocks + new_blocks,
+                          hit_blocks)  # logits discarded: nothing samples
+        for j, bid in enumerate(new_blocks):
+            self.blocks.register(bid, keys[cached_len // self.bs + j])
+        # every block (prior pinned + newly acquired hits + new) is now
+        # pinned on the request; refs transfer to req.blocks at admission
+        req.chunk_blocks = hit_blocks + new_blocks
+        self.prefill_stats["chunks"] += 1
+        return ("partial", None, take)
 
     def _ensure_decode_blocks(self, active: List[int],
                               horizon: int = 1) -> List[int]:
@@ -559,6 +688,11 @@ class LLMEngine:
             while blk_idx >= len(req.blocks):
                 bid = self.blocks.alloc()
                 if bid is None:
+                    # cheapest relief first: a queued prompt's forfeited
+                    # chunk pins cost at most one chunk recompute, vs a
+                    # whole-request re-prefill for a preemption
+                    if self._yield_chunk_pins():
+                        continue
                     victim = self._preempt_youngest()
                     if victim is None or victim == i:
                         break  # self-preempted: slot is back in the queue
@@ -593,6 +727,14 @@ class LLMEngine:
 
     # -- speculative decoding ------------------------------------------------
 
+    def _spec_rest(self):
+        """Rest the drafter for a growing number of steps (ONE escalation
+        rule for both triggers: low acceptance and persistent draftless
+        scans)."""
+        self.spec_stats["backoffs"] += 1
+        self._spec_backoff = self._spec_backoff_len
+        self._spec_backoff_len = min(self._spec_backoff_len * 2, 256)
+
     def _try_speculate(self, active: List[int]) -> bool:
         """Prompt-lookup speculative step: draft up to G tokens per active
         slot from its own history, verify pending + drafts in ONE batched
@@ -615,10 +757,21 @@ class LLMEngine:
         drafts: Dict[int, List[int]] = {}
         for i in active:
             req = self._slots[i]
-            hist = req.prompt_tokens + req.out_tokens
+            # bounded lookup window: drafts are only proposals, so a cap
+            # keeps the per-step host scan O(1) in sequence length
+            # (slice BEFORE concatenating — the full lists are long)
+            hist = (req.prompt_tokens[-512:] + req.out_tokens[-512:])[-512:]
             drafts[i] = _propose_ngram(hist, self.G, self.spec_ngram)[:self.G]
             if not drafts[i]:
+                # a run of draftless steps rests the drafter like low
+                # acceptance does: never-drafting workloads must not pay
+                # the history scan every single step
+                self._spec_dry += 1
+                if self._spec_dry >= 4:
+                    self._spec_dry = 0
+                    self._spec_rest()
                 return False
+        self._spec_dry = 0
         active = self._ensure_decode_blocks(active, horizon=self.G + 1)
         if not active:
             return True  # everything was preempted; step's retire handles it
@@ -662,9 +815,7 @@ class LLMEngine:
         if n_prop:
             self._spec_ema = 0.7 * self._spec_ema + 0.3 * (n_acc / n_prop)
         if self._spec_ema < 0.35:
-            self.spec_stats["backoffs"] += 1
-            self._spec_backoff = self._spec_backoff_len
-            self._spec_backoff_len = min(self._spec_backoff_len * 2, 256)
+            self._spec_rest()
             # re-probe just above the floor: ONE more bad verify
             # re-triggers with the doubled rest (escalation reachable),
             # while a good one climbs the EMA back toward keeping on
