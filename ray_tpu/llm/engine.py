@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 import functools
 import itertools
 from typing import Any, Dict, List, Optional, Tuple
@@ -84,6 +85,9 @@ class Request:
     # _yield_chunk_pins when a starved queue head needs the pool);
     # transferred into ``blocks`` at final admission
     chunk_blocks: List[int] = dataclasses.field(default_factory=list)
+    # cached prompt hash-chain keys (prompt_tokens are immutable while
+    # queued; preemption rewrites them and must clear this)
+    chain_keys: Optional[List[Any]] = None
     cached_prefix_len: int = 0  # tokens served from the prefix cache
     # preemption folds generated tokens into prompt_tokens for re-prefill;
     # n_prompt remembers the ORIGINAL prompt length so outputs and the
@@ -188,7 +192,7 @@ class LLMEngine:
                  seed: int = 0, mesh=None,
                  kv_cache_dtype: Optional[str] = None,
                  spec_tokens: int = 0, spec_ngram: int = 2,
-                 prefill_chunk: int = 0):
+                 spec_lookup_window: int = 512, prefill_chunk: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -253,17 +257,25 @@ class LLMEngine:
         if self.G and int(spec_ngram) < 1:
             raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
         self.spec_ngram = int(spec_ngram)
+        # drafting scans the LAST spec_lookup_window history tokens per
+        # step (O(window) host work per slot per step).  Long-document
+        # extraction that copies from the EARLY body of a huge prompt
+        # needs a larger window — raise it and pay the linear scan
+        if self.G and int(spec_lookup_window) < 1:
+            raise ValueError("spec_lookup_window must be >= 1")
+        self.spec_lookup_window = int(spec_lookup_window)
         self.spec_stats = {"proposed": 0, "accepted": 0, "verify_steps": 0,
                            "backoffs": 0}
+        self._arm_seen: set = set()  # compiles persist across resets
         # dynamic disable (vLLM-style): a verify pass that mispredicts
         # yields ~1 token per host sync vs decode_window per sync, so a
-        # low-acceptance workload must fall back to the plain window.
-        # EMA of per-verify acceptance; below the floor speculation rests
-        # for a growing number of steps
-        self._spec_ema = 1.0  # optimistic start
-        self._spec_backoff = 0
-        self._spec_backoff_len = 8
-        self._spec_dry = 0  # consecutive draftless attempts
+        # low-acceptance workload must fall back to the plain window
+        # (acceptance EMA + rest), and a two-arm throughput bandit TIMES
+        # both paths (EMA host-observed PER-SLOT tokens/s) because
+        # acceptance alone can't tell whether a verify beats the window
+        # — that depends on link latency vs forward time.  All state
+        # initialized by reset_spec_state (the one place defaults live).
+        self.reset_spec_state()
         if self.G:
             from ray_tpu.models.paged_generation import paged_verify_step
             self._verify = jax.jit(
@@ -400,6 +412,10 @@ class LLMEngine:
         if active and self.G and self._try_speculate(active):
             active = []  # tokens for this step came from the verify pass
         if active:
+            # arm timing starts BEFORE block growth / mirror refresh /
+            # uploads so the window arm carries the same per-step host
+            # costs the verify arm does (symmetric bandit comparison)
+            t_arm = time.perf_counter()
             # ensure every active slot has blocks for the whole window;
             # preempt the youngest request if the pool is exhausted
             active = self._ensure_decode_blocks(active, horizon=self.K)
@@ -431,6 +447,14 @@ class LLMEngine:
             self._dev = (tok_d, cur_d)
             # ONE host sync for the whole window_k * B window
             window = np.asarray(self._stack(*toks))
+            if self.G:
+                self._spec_streak = 0
+                # observe ONLY steady-state full-K windows, per-slot:
+                # short end-of-batch windows (and their per-arity _stack
+                # compiles) would bias the spec-vs-window comparison
+                if window_k == self.K:
+                    self._observe_arm("window", window_k,
+                                      time.perf_counter() - t_arm)
             for step in range(window_k):
                 for i in active:
                     req = self._slots[i]
@@ -497,7 +521,9 @@ class LLMEngine:
         # then reuse every further cached block (but always leave >=1
         # token to prefill — its logits seed sampling)
         pinned = list(req.chunk_blocks)
-        keys = self._prompt_chain_keys(toks)
+        if req.chain_keys is None:
+            req.chain_keys = self._prompt_chain_keys(toks)
+        keys = req.chain_keys
         hit_blocks: List[int] = pinned[:]
         for key in keys[len(pinned):]:
             if len(hit_blocks) * self.bs >= n - 1:
@@ -576,14 +602,18 @@ class LLMEngine:
         # device array; caller batch-samples all admissions in one sync
         return ("full", logits, len(suffix))
 
-    def _yield_chunk_pins(self):
-        """Break the pinned-chunk livelock: when the queue HEAD stalls on
-        pool pressure while a LATER-queued prompt pins chunk progress,
-        one victim forfeits its pins — the registered blocks retire into
+    def _yield_chunk_pins(self, include_head: bool = False):
+        """Break the pinned-chunk livelock: when an allocation stalls on
+        pool pressure while a queued prompt pins chunk progress, one
+        victim forfeits its pins — the registered blocks retire into
         the LRU (contents may still re-hit; under real pressure they
         evict and that chunk recomputes), so the pool can drain again.
-        Returns True when a victim forfeited pins."""
-        for other in list(self._queue)[1:]:
+        Admission calls exclude the queue head (the head is the one
+        asking); the DECODE-pressure path passes include_head=True, a
+        chunk recompute being far cheaper than recompute-preempting a
+        live request.  Returns True when a victim forfeited pins."""
+        start = 0 if include_head else 1
+        for other in list(self._queue)[start:]:
             if other.chunk_blocks:
                 for bid in other.chunk_blocks:
                     self.blocks.release(bid)
@@ -691,7 +721,7 @@ class LLMEngine:
                     # cheapest relief first: a queued prompt's forfeited
                     # chunk pins cost at most one chunk recompute, vs a
                     # whole-request re-prefill for a preemption
-                    if self._yield_chunk_pins():
+                    if self._yield_chunk_pins(include_head=True):
                         continue
                     victim = self._preempt_youngest()
                     if victim is None or victim == i:
@@ -718,6 +748,7 @@ class LLMEngine:
         req.prompt_tokens = req.prompt_tokens + req.out_tokens
         req.out_tokens = []
         req.cached_prefix_len = 0
+        req.chain_keys = None  # prompt changed: recompute on re-admit
         self._queue.appendleft(req)
         self._slots[i] = None
         self._tables[i] = 0
@@ -726,6 +757,33 @@ class LLMEngine:
         return i
 
     # -- speculative decoding ------------------------------------------------
+
+    def _observe_arm(self, arm: str, tokens: float, elapsed: float):
+        if elapsed <= 0 or tokens <= 0:
+            return
+        if arm not in self._arm_seen:
+            # an arm's first dispatch includes its jit COMPILATION
+            # (tens of seconds through a remote-compile tunnel) — that
+            # is not throughput; judge from the second sample on
+            self._arm_seen.add(arm)
+            return
+        tps = tokens / elapsed
+        prev = self._arm_tps[arm]
+        self._arm_tps[arm] = tps if prev is None else (
+            0.7 * prev + 0.3 * tps)
+
+    def reset_spec_state(self):
+        """Reset every drafter/bandit state field to its initial value —
+        the ONE place the defaults live (benchmarks and tests use this
+        instead of poking private fields)."""
+        self._spec_ema = 1.0
+        self._spec_backoff = 0
+        self._spec_backoff_len = 8
+        self._spec_dry = 0
+        self._spec_streak = 0
+        self._arm_tps = {"window": None, "verify": None}
+        self.spec_stats.update(proposed=0, accepted=0, verify_steps=0,
+                               backoffs=0)
 
     def _spec_rest(self):
         """Rest the drafter for a growing number of steps (ONE escalation
@@ -754,13 +812,23 @@ class LLMEngine:
         if self._spec_backoff > 0:
             self._spec_backoff -= 1
             return False
+        if self._arm_tps["verify"] is not None and self._spec_streak >= 16:
+            # periodic window probe: an always-drafting, high-acceptance
+            # workload would otherwise NEVER sample the window arm and
+            # the bandit could lock into a slower verify path forever
+            self._spec_streak = 0
+            return False
+        # arm timing starts HERE: the drafting scan is a cost unique to
+        # the verify path, so it must count against that arm
+        t_arm = time.perf_counter()
         drafts: Dict[int, List[int]] = {}
         for i in active:
             req = self._slots[i]
             # bounded lookup window: drafts are only proposals, so a cap
-            # keeps the per-step host scan O(1) in sequence length
+            # keeps the per-step host scan O(window), not O(sequence)
             # (slice BEFORE concatenating — the full lists are long)
-            hist = (req.prompt_tokens[-512:] + req.out_tokens[-512:])[-512:]
+            W = self.spec_lookup_window
+            hist = (req.prompt_tokens[-W:] + req.out_tokens[-W:])[-W:]
             drafts[i] = _propose_ngram(hist, self.G, self.spec_ngram)[:self.G]
             if not drafts[i]:
                 # a run of draftless steps rests the drafter like low
@@ -787,6 +855,7 @@ class LLMEngine:
             self.params, jnp.asarray(tokens), jnp.asarray(self._cur_len),
             self._tables_d, self.pool)
         preds = np.asarray(jnp.argmax(logits_d, -1))  # ONE sync: [B, G+1]
+        arm_elapsed = time.perf_counter() - t_arm
         self.spec_stats["verify_steps"] += 1
         accepted_last: Dict[int, int] = {}
         for i in active:
@@ -812,6 +881,19 @@ class LLMEngine:
         self._dev = None  # cur/next advanced on host; tables unchanged
         n_prop = sum(len(drafts.get(i, [])) for i in active)
         n_acc = sum(accepted_last.get(i, 0) for i in active)
+        self._spec_streak += 1
+        self._observe_arm(
+            "verify",
+            sum(1 + a for a in accepted_last.values())
+            / max(1, len(accepted_last)),
+            arm_elapsed)
+        w, v = self._arm_tps["window"], self._arm_tps["verify"]
+        if w is not None and v is not None and v < 0.9 * w:
+            # the window arm is measurably faster on THIS link/hardware
+            # (e.g. sync-dominated tunnel where K tokens/sync beats
+            # G+1): rest regardless of acceptance
+            self._spec_rest()
+            return True
         if n_prop:
             self._spec_ema = 0.7 * self._spec_ema + 0.3 * (n_acc / n_prop)
         if self._spec_ema < 0.35:
