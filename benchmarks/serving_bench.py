@@ -101,6 +101,10 @@ def engine_bench(args) -> dict:
     long_toks = sum(len(o.token_ids) for o in outs2)
     decode_tps = long_toks / decode_wall
 
+    # snapshot BEFORE the spec phase: its repetitive prompts would
+    # pollute the main workload's prefix-cache hit stats
+    prefix_stats = dict(eng.blocks.stats)
+
     # speculative phase: REPETITIVE prompts (the extractive/templated
     # pattern prompt-lookup targets) decoded with the drafter off then
     # on, same engine + params — isolates the verify-pass speedup
@@ -145,7 +149,7 @@ def engine_bench(args) -> dict:
         "decode_window": eng.K,
         "spec_tokens": args.spec,
         "speculative": spec_block,
-        "prefix_cache": eng.blocks.stats,
+        "prefix_cache": prefix_stats,
     }
 
 

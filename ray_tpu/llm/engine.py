@@ -422,14 +422,7 @@ class LLMEngine:
         if active:
             # adaptive window: never decode past what the longest-running
             # active request can still accept
-            rem = 1
-            for i in active:
-                req = self._slots[i]
-                r = min(req.sampling.max_tokens - req.num_generated,
-                        self.max_len - 1 - len(req.prompt_tokens)
-                        - len(req.out_tokens))
-                rem = max(rem, r)
-            window_k = max(1, min(self.K, rem))
+            window_k = self._window_arity(active)
             self._refresh_device_mirrors()
             if self._dev is None:
                 tok_d = jnp.asarray(self._next_token)
@@ -449,12 +442,12 @@ class LLMEngine:
             window = np.asarray(self._stack(*toks))
             if self.G:
                 self._spec_streak = 0
-                # observe ONLY steady-state full-K windows, per-slot:
-                # short end-of-batch windows (and their per-arity _stack
-                # compiles) would bias the spec-vs-window comparison
-                if window_k == self.K:
-                    self._observe_arm("window", window_k,
-                                      time.perf_counter() - t_arm)
+                # per-ARITY EMA: short windows have different sync
+                # amortization (and their own _stack compiles), so each
+                # arity gets its own sample stream — the verify gate
+                # compares against the arity it would displace
+                self._observe_arm(("window", window_k), window_k,
+                                  time.perf_counter() - t_arm)
             for step in range(window_k):
                 for i in active:
                     req = self._slots[i]
@@ -758,18 +751,30 @@ class LLMEngine:
 
     # -- speculative decoding ------------------------------------------------
 
-    def _observe_arm(self, arm: str, tokens: float, elapsed: float):
+    def _window_arity(self, active: List[int]) -> int:
+        """The decode-window length step() would run for these slots:
+        min(K, longest remaining budget)."""
+        rem = 1
+        for i in active:
+            req = self._slots[i]
+            r = min(req.sampling.max_tokens - req.num_generated,
+                    self.max_len - 1 - len(req.prompt_tokens)
+                    - len(req.out_tokens))
+            rem = max(rem, r)
+        return max(1, min(self.K, rem))
+
+    def _observe_arm(self, key, tokens: float, elapsed: float):
+        """EMA per key ("verify" or ("window", arity)); a key's first
+        sample is discarded — it includes jit COMPILATION (tens of
+        seconds through a remote-compile tunnel), not throughput."""
         if elapsed <= 0 or tokens <= 0:
             return
-        if arm not in self._arm_seen:
-            # an arm's first dispatch includes its jit COMPILATION
-            # (tens of seconds through a remote-compile tunnel) — that
-            # is not throughput; judge from the second sample on
-            self._arm_seen.add(arm)
+        if key not in self._arm_seen:
+            self._arm_seen.add(key)
             return
         tps = tokens / elapsed
-        prev = self._arm_tps[arm]
-        self._arm_tps[arm] = tps if prev is None else (
+        prev = self._arm_tps.get(key)
+        self._arm_tps[key] = tps if prev is None else (
             0.7 * prev + 0.3 * tps)
 
     def reset_spec_state(self):
@@ -781,7 +786,8 @@ class LLMEngine:
         self._spec_backoff_len = 8
         self._spec_dry = 0
         self._spec_streak = 0
-        self._arm_tps = {"window": None, "verify": None}
+        # keyed "verify" and ("window", arity) — per-arity EMAs
+        self._arm_tps: Dict[Any, float] = {}
         self.spec_stats.update(proposed=0, accepted=0, verify_steps=0,
                                backoffs=0)
 
@@ -812,7 +818,7 @@ class LLMEngine:
         if self._spec_backoff > 0:
             self._spec_backoff -= 1
             return False
-        if self._arm_tps["verify"] is not None and self._spec_streak >= 16:
+        if self._arm_tps.get("verify") is not None and self._spec_streak >= 16:
             # periodic window probe: an always-drafting, high-acceptance
             # workload would otherwise NEVER sample the window arm and
             # the bandit could lock into a slower verify path forever
@@ -843,6 +849,9 @@ class LLMEngine:
         active = self._ensure_decode_blocks(active, horizon=self.G + 1)
         if not active:
             return True  # everything was preempted; step's retire handles it
+        # the window arity this verify DISPLACES — computed before
+        # acceptance mutates budgets, so the gate compares like-for-like
+        displaced_arity = self._window_arity(active)
         tokens = np.zeros((self.B, self.G + 1), np.int32)
         for i in active:
             tokens[i, 0] = self._next_token[i]
@@ -887,7 +896,8 @@ class LLMEngine:
             sum(1 + a for a in accepted_last.values())
             / max(1, len(accepted_last)),
             arm_elapsed)
-        w, v = self._arm_tps["window"], self._arm_tps["verify"]
+        w = self._arm_tps.get(("window", displaced_arity))
+        v = self._arm_tps.get("verify")
         if w is not None and v is not None and v < 0.9 * w:
             # the window arm is measurably faster on THIS link/hardware
             # (e.g. sync-dominated tunnel where K tokens/sync beats

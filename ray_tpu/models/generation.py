@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.attention import sliding_window_mask  # noqa: F401
 from ray_tpu.ops.layers import apply_rope, rms_norm, rope_frequencies, swiglu
 
 
@@ -146,6 +147,9 @@ def prefill(params, tokens, lengths, cache, cfg: LlamaConfig):
     idx = jnp.arange(S)
     mask = (idx[None, None, :] <= idx[None, :, None]) & (
         idx[None, None, :] < lengths[:, None, None])
+    if cfg.sliding_window is not None:
+        mask &= sliding_window_mask(idx[None, :, None], idx[None, None, :],
+                                    cfg.sliding_window)
     new_k = []
     new_v = []
     for i, lp in _stacked_layers(params):
@@ -183,6 +187,9 @@ def decode_step(params, token, cur_len, cache, cfg: LlamaConfig):
     # key slot j visible iff j <= cur_len (the new token's own slot included)
     idx = jnp.arange(max_len)
     mask = idx[None, None, :] <= cur_len[:, None, None]
+    if cfg.sliding_window is not None:
+        mask &= sliding_window_mask(cur_len[:, None, None],
+                                    idx[None, None, :], cfg.sliding_window)
 
     write = jax.vmap(
         lambda c, kv, pos: jax.lax.dynamic_update_slice(
@@ -228,6 +235,9 @@ def verify_step(params, tokens, cur_len, cache, cfg: LlamaConfig):
     idx = jnp.arange(max_len)
     # query at global position p sees key slots <= p (its own included)
     mask = idx[None, None, :] <= positions[:, :, None]
+    if cfg.sliding_window is not None:
+        mask &= sliding_window_mask(positions[:, :, None],
+                                    idx[None, None, :], cfg.sliding_window)
 
     write = jax.vmap(
         lambda c, kv, pos: jax.lax.dynamic_update_slice(

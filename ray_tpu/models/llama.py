@@ -46,6 +46,9 @@ class LlamaConfig:
     remat_policy: str = "save_attn"
     scan_layers: bool = True
     attention_impl: str = "auto"
+    # sliding-window (Mistral/Qwen2-style) causal attention: query p
+    # attends keys in (p - sliding_window, p].  None = full causal.
+    sliding_window: Optional[int] = None
     tie_embeddings: bool = False
     # Microbatches for pipeline parallelism (mesh "pp" axis); default 2*pp.
     pp_microbatches: Optional[int] = None
@@ -196,7 +199,8 @@ def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
     k = apply_rope(k, cos, sin)
     q = _constrain(q, mesh, "batch", "seq", "heads", None)
     attn = dot_product_attention(
-        q, k, v, causal=True, impl=cfg.attention_impl, mesh=mesh
+        q, k, v, causal=True, impl=cfg.attention_impl, mesh=mesh,
+        window=cfg.sliding_window
     )
     attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, cfg.num_heads * hd)

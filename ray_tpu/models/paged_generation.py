@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.models.llama import LlamaConfig
-from ray_tpu.models.generation import _layer_with_cache, _stacked_layers
+from ray_tpu.models.generation import (_layer_with_cache, _stacked_layers,
+                                        sliding_window_mask)
 from ray_tpu.ops.layers import rms_norm, rope_frequencies
 
 
@@ -142,6 +143,9 @@ def paged_decode_step(params, token, cur_len, block_tables, pool,
     # logical position j visible iff j <= cur_len (own slot included)
     idx = jnp.arange(MB * bs)
     mask = idx[None, None, :] <= cur_len[:, None, None]
+    if cfg.sliding_window is not None:
+        mask &= sliding_window_mask(cur_len[:, None, None],
+                                    idx[None, None, :], cfg.sliding_window)
     rows = jnp.arange(b)
     blk = block_tables[rows, cur_len // bs]  # [b] target block per seq
     off = cur_len % bs
@@ -187,6 +191,15 @@ def prefill_suffix(params, tokens, length, start_pos, prefix_k, prefix_v,
     pmask = (jnp.arange(P)[None, None, :] < prefix_len)  # [1, 1, P]
     smask = (sfx[None, None, :] <= sfx[None, :, None]) & (
         sfx[None, None, :] < length)  # [1, S, S]
+    if cfg.sliding_window is not None:
+        W = cfg.sliding_window
+        # absolute positions: prefix key j at j, suffix query i at
+        # start_pos + i (suffix keys share the start_pos offset, so the
+        # suffix-suffix clamp is index arithmetic)
+        pmask = pmask & sliding_window_mask(
+            positions[:, :, None], jnp.arange(P)[None, None, :], W)
+        smask = smask & sliding_window_mask(
+            sfx[None, :, None], sfx[None, None, :], W)
     mask = jnp.concatenate(
         [jnp.broadcast_to(pmask, (1, S, P)), smask], axis=-1)
 
@@ -242,6 +255,9 @@ def paged_verify_step(params, tokens, cur_len, block_tables, pool,
     # earlier same-chunk tokens are visible because each layer stores the
     # whole chunk's KV before gathering
     mask = idx[None, None, :] <= safe_pos[:, :, None]
+    if cfg.sliding_window is not None:
+        mask &= sliding_window_mask(safe_pos[:, :, None],
+                                    idx[None, None, :], cfg.sliding_window)
     rows = jnp.arange(b)[:, None]
     blk = block_tables[rows, safe_pos // bs]  # [b, S]
     off = safe_pos % bs

@@ -16,7 +16,16 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+_WARNED_WINDOW_NO_FLASH = False
 _NEG_INF = -1e30
+
+
+def sliding_window_mask(q_pos, k_pos, window):
+    """Sliding-window visibility clause: query at ``q_pos`` sees keys in
+    ``(q_pos - window, q_pos]`` — the SINGLE home of the off-by-one
+    convention, shared by the attention ops and every model cache path
+    (dense + paged).  Args broadcast."""
+    return q_pos - k_pos < window
 
 
 def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
@@ -37,16 +46,21 @@ def reference_attention(
     causal: bool = True,
     positions_q: Optional[jnp.ndarray] = None,
     positions_k: Optional[jnp.ndarray] = None,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Plain softmax attention, fp32 accumulation.
 
     q: [b, sq, h, d]; k, v: [b, sk, kv_h, d] with h % kv_h == 0.
+    ``window``: sliding-window (Mistral-style) — query p attends keys in
+    (p - window, p].  Requires causal.
     """
     b, sq, h, d = q.shape
     kv_h = k.shape[2]
     k = _repeat_kv(k, h // kv_h)
     v = _repeat_kv(v, h // kv_h)
     scale = d ** -0.5
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -56,6 +70,9 @@ def reference_attention(
         if positions_k is None:
             positions_k = jnp.arange(k.shape[1])
         mask = positions_q[:, None] >= positions_k[None, :]
+        if window is not None:
+            mask &= sliding_window_mask(positions_q[:, None],
+                                        positions_k[None, :], window)
         logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
@@ -65,7 +82,7 @@ def reference_attention(
     return out.astype(q.dtype)
 
 
-def _blockwise_step(q, k, v, m, l, o, *, qpos, kpos, scale):
+def _blockwise_step(q, k, v, m, l, o, *, qpos, kpos, scale, window=None):
     """One online-softmax accumulation step against a K/V block.
 
     q: [b, sq, h, d]; k, v: [b, sk, h, d] (kv already GQA-expanded);
@@ -75,6 +92,8 @@ def _blockwise_step(q, k, v, m, l, o, *, qpos, kpos, scale):
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     mask = qpos[:, None] >= kpos[None, :]
+    if window is not None:
+        mask &= sliding_window_mask(qpos[:, None], kpos[None, :], window)
     logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
     m_blk = jnp.max(logits, axis=-1)
     m_new = jnp.maximum(m, m_blk)
@@ -121,6 +140,7 @@ def ring_attention(
     causal: bool = True,
     batch_axes=("dp", "fsdp"),
     head_axis: Optional[str] = "tp",
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring attention over the ``sp`` mesh axis (global-view inputs).
 
@@ -128,9 +148,11 @@ def ring_attention(
     S over ``sp``, and K/V shards rotate around the ring with ppermute while
     each device accumulates blockwise output for its local Q shard.
     """
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     sp = mesh.shape[sp_axis]
     if sp == 1:
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(q, k, v, causal=causal, window=window)
     h, kv_h = q.shape[2], k.shape[2]
     batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
     if head_axis is not None and head_axis not in mesh.axis_names:
@@ -153,7 +175,8 @@ def ring_attention(
                 kpos = jnp.zeros((k_cur.shape[1],), jnp.int32)
                 qp = jnp.zeros((sq,), jnp.int32)
             return _blockwise_step(
-                q_loc, k_cur, v_cur, m, l, o, qpos=qp, kpos=kpos, scale=scale
+                q_loc, k_cur, v_cur, m, l, o, qpos=qp, kpos=kpos,
+                scale=scale, window=window
             )
 
         def body(t, carry):
@@ -205,12 +228,16 @@ def dot_product_attention(
     impl: str = "auto",
     mesh: Optional[Mesh] = None,
     sp_axis: str = "sp",
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Dispatching attention entry point used by the model layer.
 
     impl: 'auto' | 'ref' | 'flash' | 'ring'.  'auto' picks ring when the
     mesh shards sequence (sp>1), Pallas flash on TPU otherwise, and the
-    reference path on CPU test meshes.
+    reference path on CPU test meshes.  ``window`` (sliding-window /
+    Mistral-style) is supported by ref and ring; 'auto' avoids the flash
+    kernel when a window is set (the pallas kernel has no window mask
+    yet — a skipped-block windowed variant is the natural follow-up).
     """
     if impl == "auto":
         if (
@@ -220,13 +247,32 @@ def dot_product_attention(
         ):
             impl = "ring"
         elif jax.default_backend() == "tpu" and q.shape[1] >= 256:
-            impl = "flash"
+            if window is None:
+                impl = "flash"
+            else:
+                global _WARNED_WINDOW_NO_FLASH
+                if not _WARNED_WINDOW_NO_FLASH:
+                    _WARNED_WINDOW_NO_FLASH = True
+                    import warnings
+
+                    warnings.warn(
+                        "sliding_window forces reference attention on "
+                        "TPU (the pallas flash kernel has no window "
+                        "mask yet): full [b,h,S,S] logits materialize "
+                        "per layer — expect higher HBM use at long "
+                        "sequence lengths", stacklevel=2)
+                impl = "ref"
         else:
             impl = "ref"
+    if impl == "flash" and window is not None:
+        raise ValueError(
+            "impl='flash' does not support sliding windows; use 'ref', "
+            "'ring', or 'auto'")
     if impl == "ring":
         assert mesh is not None, "ring attention needs a mesh"
         return ring_attention(
-            q, k, v, mesh=mesh, sp_axis=sp_axis, causal=causal
+            q, k, v, mesh=mesh, sp_axis=sp_axis, causal=causal,
+            window=window
         )
     if impl == "flash":
         from ray_tpu.ops.pallas.flash_attention import flash_attention
@@ -249,4 +295,4 @@ def dot_product_attention(
             out_specs=qspec,
             check_vma=False,
         )(q, k, v)
-    return reference_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal, window=window)

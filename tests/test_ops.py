@@ -97,6 +97,41 @@ class TestRingAttention:
             np.testing.assert_allclose(a, b, atol=1e-4)
 
 
+class TestSlidingWindow:
+    def test_window_matches_manual_mask(self):
+        q, k, v = _qkv(s=32, h=4, kvh=4)
+        W = 8
+        out = reference_attention(q, k, v, causal=True, window=W)
+        # manual: causal AND within-window softmax
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+        i = jnp.arange(32)
+        m = (i[:, None] >= i[None, :]) & (i[:, None] - i[None, :] < W)
+        logits = jnp.where(m[None, None], logits.astype(jnp.float32), -1e30)
+        expect = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(logits, -1).astype(v.dtype), v)
+        np.testing.assert_allclose(out, expect, atol=1e-5)
+
+    def test_window_geq_seq_equals_full(self):
+        q, k, v = _qkv(s=16)
+        full = reference_attention(q, k, v, causal=True)
+        win = reference_attention(q, k, v, causal=True, window=64)
+        np.testing.assert_allclose(win, full, atol=1e-6)
+
+    def test_ring_window_matches_reference(self):
+        mesh = create_mesh(MeshConfig(dp=2, fsdp=1, tp=1, sp=4))
+        q, k, v = _qkv(b=2, s=64, h=4, kvh=2, d=32)
+        ref = reference_attention(q, k, v, causal=True, window=10)
+        out = ring_attention(q, k, v, mesh=mesh, causal=True, window=10)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_flash_rejects_window(self):
+        from ray_tpu.ops.attention import dot_product_attention
+
+        q, k, v = _qkv(s=16)
+        with pytest.raises(ValueError, match="flash"):
+            dot_product_attention(q, k, v, impl="flash", window=4)
+
+
 class TestDispatch:
     def test_auto_picks_ring_on_sp_mesh(self):
         mesh = create_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=8))
