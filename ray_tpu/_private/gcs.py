@@ -275,8 +275,14 @@ class GcsServer:
 
     _WAL_COMPACT_BYTES = 16 * 1024 * 1024
     _FULL_SNAPSHOT_INTERVAL_S = 30.0
-    _WAL_DEL = "__wal_del__"
+    # one-element tuple, matched by exact shape so a legitimate kv value
+    # equal to a bare marker string can never replay as a deletion
+    _WAL_DEL = ("__wal_del__",)
     _NODE_VOLATILE = ("last_heartbeat", "pending_demand", "stats")
+
+    @staticmethod
+    def _is_wal_del(value) -> bool:
+        return isinstance(value, tuple) and value == GcsServer._WAL_DEL
 
     def _wal_path(self) -> str:
         return self._storage_path + ".wal"
@@ -292,8 +298,11 @@ class GcsServer:
             os.makedirs(self._blob_dir(), exist_ok=True)
             if self._wal_bytes == 0:
                 # header pairs this journal with the snapshot generation
-                # it extends; replay skips a WAL whose gen mismatches
-                hdr = pickle.dumps(("__wal_hdr__", None, self._persist_gen))
+                # it extends; replay skips a WAL whose gen mismatches.
+                # The key slot carries the record-format version: "v2"
+                # journals use the tuple deletion sentinel; older ones
+                # used a bare string (accepted on replay for those only)
+                hdr = pickle.dumps(("__wal_hdr__", "v2", self._persist_gen))
                 self._wal_file.write(struct.pack("<I", len(hdr)) + hdr)
                 self._wal_file.flush()
                 self._wal_bytes += 4 + len(hdr)
@@ -386,7 +395,7 @@ class GcsServer:
     @staticmethod
     def _apply_commits(commits) -> None:
         for cache, key, val in commits:
-            if isinstance(val, str) and val == GcsServer._WAL_DEL:
+            if GcsServer._is_wal_del(val):
                 cache.pop(key, None)
             else:
                 cache[key] = val
@@ -405,6 +414,7 @@ class GcsServer:
                 data = f.read()
             off = 0
             first = True
+            legacy = True  # pre-"v2" journals delete via a bare string
             while off + 4 <= len(data):
                 (ln,) = struct.unpack_from("<I", data, off)
                 off += 4
@@ -424,11 +434,14 @@ class GcsServer:
                                 "discarding stale journal", value,
                                 self._persist_gen)
                             return
+                        legacy = key != "v2"
                         continue
                     # headerless journal (pre-gen format): replay as-is
                 n += 1
                 tbl = getattr(self, table)
-                if isinstance(value, str) and value == self._WAL_DEL:
+                if self._is_wal_del(value) or (
+                        legacy and isinstance(value, str)
+                        and value == "__wal_del__"):
                     tbl.pop(key, None)
                     continue
                 if (table == "kv" and isinstance(value, tuple)
@@ -443,9 +456,26 @@ class GcsServer:
         except Exception:  # noqa: BLE001 — corrupt WAL: snapshot stands
             logger.warning("gcs WAL replay stopped after %d records",
                            n, exc_info=True)
+            self._normalize_restored_nodes()
             return
         if n:
             logger.info("gcs WAL replayed: %d records", n)
+        # journaled node entries are stripped of _NODE_VOLATILE, so any
+        # replayed nodes record would otherwise lack last_heartbeat and
+        # crash the health-check loop on its first iteration
+        self._normalize_restored_nodes()
+
+    def _normalize_restored_nodes(self) -> None:
+        """(Re-)apply boot-time node normalization: grace-period heartbeat
+        plus defaults for the volatile fields that snapshot/WAL records
+        strip.  Safe to call multiple times during restore."""
+        now = time.time()
+        for node in self.nodes.values():
+            if not isinstance(node, dict):
+                continue  # corrupt record: never crash GCS boot over it
+            node.setdefault("last_heartbeat", now)
+            node.setdefault("pending_demand", [])
+            node.setdefault("available", dict(node.get("total", {})))
 
     def _wal_truncate(self):
         import os

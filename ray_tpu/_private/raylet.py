@@ -103,7 +103,6 @@ class Raylet:
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.idle: deque = deque()
         self._spawned_procs: Dict[int, Any] = {}
-        self._starting = 0
         self._register_waiters: deque = deque()  # futures for newly registered workers
         self._lease_waiters: deque = deque()  # (demand, pg, bundle, future)
         # pg_id -> {bundle_index -> available ResourceSet}
@@ -131,18 +130,18 @@ class Raylet:
         # prestart, src/ray/raylet/worker_pool.h)
         self._zygote_proc = None
         self._zygote_sock = ""
-        # log paths of spawns whose zygote reply was lost — adopted (in
-        # order) when the forked child registers
-        self._lost_spawn_logs: List[str] = []
-        # monotonic deadlines for lost spawns: each entry holds ONE
-        # _starting slot until its child registers (entry popped there)
-        # or the deadline expires (reaper decrements _starting) — never
-        # both, so the startup-concurrency cap stays accurate.  A late
-        # registration AFTER its entry expired is balanced via
-        # _expired_lost (the register-path decrement is compensated), so
-        # FIFO entry/registration mismatches can't leak slots either way.
-        self._lost_spawn_deadlines: List[float] = []
-        self._expired_lost = 0
+        # spawns whose zygote reply was lost, as {deadline, log} records
+        # (paired so a registration can never take one spawn's deadline
+        # and a different spawn's log file).  Each record holds ONE
+        # startup slot until its child registers (record popped there) or
+        # the deadline expires (reaper pops it).
+        self._lost_spawns: List[Dict[str, Any]] = []
+        # spawns initiated whose zygote reply has not been processed yet:
+        # while > 0, an unknown-pid registration is ambiguous (the child
+        # can start running — and register — before the fork reply is even
+        # read), so the adoption path must NOT consume a lost-spawn record
+        # that belongs to a different spawn
+        self._pending_spawn_replies = 0
         # killed-but-not-yet-exited Popen children awaiting wait() —
         # (proc, escalation deadline) pairs polled (and thereby
         # zombie-reaped) by the reaper loop; past the deadline a worker
@@ -340,20 +339,31 @@ class Raylet:
                     h.pid == pid for h in self.workers.values()
                 ):
                     self._spawned_procs.pop(pid, None)
-                    self._starting = max(0, self._starting - 1)
                     logger.warning("worker pid %s exited before registering (rc=%s)",
                                    pid, proc.returncode)
             # lost zygote spawns whose child never registered: release
             # their startup slots at the deadline
             now_m = time.monotonic()
-            while (self._lost_spawn_deadlines
-                   and self._lost_spawn_deadlines[0] < now_m):
-                self._lost_spawn_deadlines.pop(0)
-                self._expired_lost += 1
-                self._starting = max(0, self._starting - 1)
-                logger.warning(
-                    "lost zygote spawn never registered; releasing its "
-                    "startup slot")
+            while (self._lost_spawns
+                   and self._lost_spawns[0]["deadline"] < now_m):
+                rec = self._lost_spawns.pop(0)
+                # if the lost child DID register (adopted during the
+                # ambiguous in-flight-reply window, so no log was
+                # attached then), hand it this orphaned log file so its
+                # output gets tailed and rotated instead of growing
+                # untracked forever (best-effort FIFO pairing — lost
+                # spawns are anonymous by definition)
+                for h in self.workers.values():
+                    if h.pid not in self._worker_logs and \
+                            isinstance(h.proc, _ZygoteChild):
+                        self._worker_logs[h.pid] = {
+                            "path": rec["log"], "off": 0,
+                            "buf": b"", "gone_ticks": 0}
+                        break
+                else:
+                    logger.warning(
+                        "lost zygote spawn never registered; releasing "
+                        "its startup slot")
             # zombie-reap killed Popen children (poll() waits them);
             # escalate to SIGKILL if one acked exit_worker but wedged
             # in teardown past its deadline — Popen pids are our own
@@ -562,8 +572,23 @@ class Raylet:
             logger.debug("zygote unavailable, falling back to Popen: %s", e)
             return None
 
+    @property
+    def _starting(self) -> int:
+        """Spawns initiated but not yet registered — DERIVED from concrete
+        state (in-flight fork replies + unexpired lost-spawn records +
+        spawned-but-unregistered procs) instead of counted, so the
+        startup-concurrency budget can never drift from missed or doubled
+        increments (the failure mode of every racy pairing of spawn /
+        lost-reply / adoption / expiry events).  A lost spawn's child
+        registering while another reply is in flight over-counts by one
+        until its record expires — transient and conservative."""
+        registered = {h.pid for h in self.workers.values()}
+        return (self._pending_spawn_replies + len(self._lost_spawns)
+                + sum(1 for pid in self._spawned_procs
+                      if pid not in registered))
+
     def _start_worker(self):
-        self._starting += 1
+        self._pending_spawn_replies += 1
         worker_env = {
             "RAY_TPU_SESSION_DIR": self.session_dir,
             "RAY_TPU_GCS_ADDR": self.gcs_addr,
@@ -582,8 +607,12 @@ class Raylet:
         on an executor thread so a wedged zygote can never stall
         heartbeats/leases/pulls for the whole node."""
         loop = asyncio.get_event_loop()
-        got = await loop.run_in_executor(
-            None, self._zygote_spawn_blocking, worker_env, log_path)
+        try:
+            got = await loop.run_in_executor(
+                None, self._zygote_spawn_blocking, worker_env, log_path)
+        finally:
+            self._pending_spawn_replies = max(
+                0, self._pending_spawn_replies - 1)
         if self._stopping:
             # raced Raylet.stop(): the kill sweep already ran — never
             # create a worker nothing will reap; kill a forked one
@@ -604,9 +633,9 @@ class Raylet:
             # _starting slot stays held until the child registers or the
             # startup timeout expires (reaper) — decrementing here AND at
             # registration would under-count concurrent spawns.
-            self._lost_spawn_logs.append(log_path)
-            self._lost_spawn_deadlines.append(
-                time.monotonic() + config.worker_startup_timeout_s)
+            self._lost_spawns.append({
+                "deadline": time.monotonic() + config.worker_startup_timeout_s,
+                "log": log_path})
             return
         env = dict(os.environ)
         env.update(worker_env)
@@ -718,21 +747,20 @@ class Raylet:
 
             proc = _ZygoteChild(pid, proc_starttime(pid))
             self._spawned_procs[pid] = proc
-            if self._lost_spawn_deadlines:
-                self._lost_spawn_deadlines.pop(0)  # slot consumed here
-            elif self._expired_lost > 0:
-                # this spawn's slot was already released at expiry: the
-                # register-path decrement below would double-release, so
-                # pre-compensate (net zero for this registration)
-                self._expired_lost -= 1
-                self._starting += 1
-            if self._lost_spawn_logs and pid not in self._worker_logs:
-                self._worker_logs[pid] = {
-                    "path": self._lost_spawn_logs.pop(0), "off": 0,
-                    "buf": b"", "gone_ticks": 0}
+            if self._pending_spawn_replies == 0 and self._lost_spawns:
+                # no fork replies in flight, so an unknown pid must be a
+                # lost spawn's child — consume its (paired) record and
+                # log.  With a reply in flight the origin is ambiguous
+                # (a child can register before its own fork reply is
+                # read), so the record is left for the reaper's deadline
+                # instead of possibly stealing another spawn's slot/log.
+                rec = self._lost_spawns.pop(0)
+                if pid not in self._worker_logs:
+                    self._worker_logs[pid] = {
+                        "path": rec["log"], "off": 0,
+                        "buf": b"", "gone_ticks": 0}
         h = WorkerHandle(worker_id, addr, pid, proc)
         self.workers[worker_id] = h
-        self._starting = max(0, self._starting - 1)
         h.idle_since = time.monotonic()
         self.idle.append(h)
         self._pump_leases()
@@ -959,6 +987,9 @@ class Raylet:
         while made_progress and self._lease_waiters:
             made_progress = False
             n = len(self._lease_waiters)
+            # snapshot the derived count once per pass (the loop body is
+            # synchronous; only _start_worker below changes it)
+            starting = self._starting
             for _ in range(n):
                 demand, pg_id, bundle_index, dedicated, owner_addr, fut = self._lease_waiters[0]
                 if fut.done():
@@ -977,10 +1008,11 @@ class Raylet:
                     # by resource accounting instead — a CPU-derived cap
                     # would silently stall the 65th zero-cpu actor forever
                     can_start = dedicated or (
-                        (len(self.workers) + self._starting)
+                        (len(self.workers) + starting)
                         < self._max_workers())
-                    if self._starting < config.maximum_startup_concurrency and can_start:
+                    if starting < config.maximum_startup_concurrency and can_start:
                         self._start_worker()
+                        starting += 1
                     self._lease_waiters.rotate(-1)
                     continue
                 self._lease_waiters.popleft()

@@ -35,6 +35,12 @@ class Router:
     """Pow-2 replica chooser with a queue-length cache."""
 
     QUEUE_LEN_CACHE_S = 2.0
+    # dispatch-time affinity entries are provisional for this long: the
+    # replica only reports a model as loaded AFTER the load finishes, so
+    # a probe racing a cold load must not strip the entry (that flap sent
+    # concurrent same-model requests to different replicas, each paying a
+    # duplicate load — exactly what model-aware routing exists to avoid)
+    MODEL_LOAD_GRACE_S = 30.0
     # deployment-version polls ride the request path; uncapped they cost
     # one controller RPC PER REQUEST (measured: the largest serve-path
     # overhead after the replica call itself on a 1-vCPU box)
@@ -50,6 +56,9 @@ class Router:
         # model-aware routing (reference multiplex.py): model id ->
         # replica cache keys that recently served / reported that model
         self._mux_affinity: Dict[str, List[str]] = {}
+        # (model id, replica key) -> monotonic time of last dispatch;
+        # consulted by _sync_models to keep provisional entries alive
+        self._mux_dispatch_t: Dict[tuple, float] = {}
         self._lock = threading.Lock()
         self._rng = random.Random()
         self._last_version_check = 0.0
@@ -106,19 +115,33 @@ class Router:
     def _sync_models(self, key: str, models: List[str]) -> None:
         """Reconcile the affinity map with a replica's AUTHORITATIVE
         loaded-model report: models it evicted stop routing to it, and
-        the map is bounded (stale ids age out)."""
+        the map is bounded (stale ids age out).  Entries dispatched
+        within MODEL_LOAD_GRACE_S survive an "absent" report — the load
+        the dispatch triggered may simply not have finished yet."""
+        now = time.monotonic()
         with self._lock:
             loaded = set(models)
             for mid, lst in list(self._mux_affinity.items()):
                 if mid in loaded:
                     if key not in lst:
                         lst.append(key)
+                    self._mux_dispatch_t.pop((mid, key), None)
                 elif key in lst:
+                    t = self._mux_dispatch_t.get((mid, key))
+                    if t is not None and now - t < self.MODEL_LOAD_GRACE_S:
+                        continue  # provisional: cold load in progress
                     lst.remove(key)
+                    self._mux_dispatch_t.pop((mid, key), None)
                     if not lst:
                         del self._mux_affinity[mid]
             while len(self._mux_affinity) > 1024:
-                self._mux_affinity.pop(next(iter(self._mux_affinity)))
+                mid = next(iter(self._mux_affinity))
+                for k in self._mux_affinity.pop(mid):
+                    self._mux_dispatch_t.pop((mid, k), None)
+            if len(self._mux_dispatch_t) > 8192:
+                self._mux_dispatch_t = {
+                    k: t for k, t in self._mux_dispatch_t.items()
+                    if now - t < self.MODEL_LOAD_GRACE_S}
 
     def choose_replica(self, model_id: str = ""):
         # operate on a snapshot: a concurrent refresh() must not shift
@@ -133,9 +156,30 @@ class Router:
                 raise RuntimeError(
                     f"deployment {self._deployment!r} has no replicas")
         if model_id:
-            pick = self._choose_for_model(model_id, reps)
+            pick, has_holders = self._choose_for_model(model_id, reps)
             if pick is not None:
                 return pick
+            if not has_holders:
+                # cold model: pick a candidate, then atomically
+                # claim-or-adopt so CONCURRENT cold requests for the same
+                # model coalesce onto one replica instead of each paying
+                # a duplicate load (the race affinity-at-dispatch left
+                # open)
+                cand = self._pow2(reps)
+                with self._lock:
+                    keys = list(self._mux_affinity.get(model_id, ()))
+                    by_key = {self._cache_key(r): r for r in reps}
+                    for k in keys:
+                        if k in by_key:  # someone claimed first: adopt
+                            return by_key[k]
+                    key = self._cache_key(cand)
+                    lst = self._mux_affinity.setdefault(model_id, [])
+                    lst.insert(0, key)
+                    self._mux_dispatch_t[(model_id, key)] = time.monotonic()
+                return cand
+        return self._pow2(reps)
+
+    def _pow2(self, reps: List[Any]):
         if len(reps) == 1:
             return reps[0]
         i, j = self._rng.sample(range(len(reps)), 2)
@@ -145,8 +189,11 @@ class Router:
     def _choose_for_model(self, model_id: str, reps: List[Any]):
         """Prefer a replica that already holds ``model_id`` (avoids a
         load + possible LRU eviction elsewhere); fall back to pow-2 when
-        none does or the holder is saturated.  Reference:
-        ``multiplex.py`` model-aware routing in the pow-2 scheduler."""
+        none does or the holder is saturated.  Returns ``(pick,
+        has_holders)`` — ``has_holders`` distinguishes "saturated holder,
+        deliberately spill elsewhere" from "no holder at all" (only the
+        latter may claim-coalesce).  Reference: ``multiplex.py``
+        model-aware routing in the pow-2 scheduler."""
         with self._lock:
             keys = list(self._mux_affinity.get(model_id, ()))
         if keys:
@@ -155,8 +202,9 @@ class Router:
             if holders:
                 best = min(holders, key=self._probe)
                 if self._probe(best) < self._max_ongoing:
-                    return best
-        return None
+                    return best, True
+                return None, True
+        return None, False
 
     def note_model(self, model_id: str, replica) -> None:
         """Record that ``replica`` now holds ``model_id`` (front of the
@@ -170,7 +218,12 @@ class Router:
             if key in lst:
                 lst.remove(key)
             lst.insert(0, key)
+            for dropped in lst[4:]:
+                self._mux_dispatch_t.pop((model_id, dropped), None)
             del lst[4:]
+            # provisional until the replica's loaded-model report
+            # confirms it (cleared there)
+            self._mux_dispatch_t[(model_id, key)] = time.monotonic()
 
     def note_dispatch(self, replica):
         """Bump the cached queue length so back-to-back requests spread."""

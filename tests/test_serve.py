@@ -347,6 +347,51 @@ def test_multiplexed_routing_affinity(serve_shutdown):
         assert len(s) == 1, f"model {m} bounced across replicas: {s}"
 
 
+def test_affinity_survives_probe_during_cold_load():
+    """ADVICE r4 (low): note_model records affinity at dispatch time,
+    BEFORE the replica finishes loading; a probe landing inside the load
+    window reports the model absent and must NOT strip the provisional
+    entry (the flap fanned concurrent same-model requests across
+    replicas, each paying a duplicate load)."""
+    from ray_tpu.serve.router import Router
+
+    class _FakeActorId:
+        def __init__(self, h):
+            self._h = h
+
+        def hex(self):
+            return self._h
+
+    class _FakeReplica:
+        def __init__(self, h):
+            self._actor_id = _FakeActorId(h)
+
+    r = Router.__new__(Router)
+    r._mux_affinity = {}
+    r._mux_dispatch_t = {}
+    import threading
+    r._lock = threading.Lock()
+
+    rep = _FakeReplica("aa")
+    r.note_model("m1", rep)
+    # probe during the load: replica truthfully reports "no models yet"
+    r._sync_models("aa", [])
+    assert r._mux_affinity.get("m1") == ["aa"], \
+        "provisional affinity stripped by a probe racing the cold load"
+    # once the replica confirms the load, the entry is no longer
+    # provisional...
+    r._sync_models("aa", ["m1"])
+    assert ("m1", "aa") not in r._mux_dispatch_t
+    # ...so an authoritative eviction report does remove it
+    r._sync_models("aa", [])
+    assert "m1" not in r._mux_affinity
+    # and an expired provisional entry (grace elapsed) is removed too
+    r.note_model("m2", rep)
+    r._mux_dispatch_t[("m2", "aa")] -= Router.MODEL_LOAD_GRACE_S + 1
+    r._sync_models("aa", [])
+    assert "m2" not in r._mux_affinity
+
+
 def test_multiplexed_http_header(serve_shutdown):
     """The serve_multiplexed_model_id HTTP header reaches
     serve.get_multiplexed_model_id() (reference proxy behavior)."""
