@@ -114,14 +114,31 @@ def initialize_jax_distributed() -> None:
         return
     import jax
 
+    # CPU backend: cross-process collectives need the gloo implementation,
+    # selected BEFORE the backend is first touched (only the cpu client
+    # reads it, so this is harmless on TPU hosts)
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — older jaxlib without the knob
+        pass
+    expected = int(os.environ["JAX_NUM_PROCESSES"])
     try:
         jax.distributed.initialize(
             coordinator_address=addr,
-            num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+            num_processes=expected,
             process_id=int(os.environ["JAX_PROCESS_ID"]),
         )
     except RuntimeError as e:
         if "already" not in str(e):
             raise
+    # some PJRT plugins take the client's process count from the device
+    # topology and quietly ignore the coordination service — each worker
+    # would then train an INDEPENDENT copy with no gradient exchange, a
+    # silently-wrong result far worse than an error
+    if jax.process_count() != expected:
+        raise RuntimeError(
+            f"jax.distributed formed {jax.process_count()} process(es), "
+            f"expected {expected}: platform {jax.default_backend()!r} did "
+            "not honor multi-process initialization on this host")
 
 

@@ -164,7 +164,14 @@ class XlaDistributedGroup(BaseGroup):
     """Rank-per-process group over jax.distributed (multi-host TPU pods).
 
     Rendezvous: rank 0 reserves a TCP port and publishes
-    ``collective/{group}/coordinator`` in the internal KV.
+    ``collective/{group}/coordinator`` in the internal KV (parity with the
+    reference's ``NCCLUniqueIDStore`` named-actor rendezvous,
+    ``nccl_collective_group.py:29``).
+
+    The group's collective mesh takes ONE device per process, so mesh
+    axis "x" is exactly the rank axis regardless of how many local
+    devices each process holds (a v5e host has 4 chips; a CPU test
+    process has ``xla_force_host_platform_device_count``).
     """
 
     def __init__(
@@ -174,6 +181,9 @@ class XlaDistributedGroup(BaseGroup):
         super().__init__(world_size, rank, group_name)
         from ray_tpu.experimental import internal_kv
 
+        self._timeout_s = timeout_s
+        self._send_seq: dict = {}
+        self._recv_seq: dict = {}
         key = f"collective/{group_name}/coordinator"
         if rank == 0:
             import socket
@@ -199,15 +209,32 @@ class XlaDistributedGroup(BaseGroup):
                 time.sleep(0.05)
             if addr is None:
                 raise TimeoutError("coordinator address never published")
+        # CPU backend: cross-process collectives need the gloo
+        # implementation, selected BEFORE the backend is first touched
+        # (harmless if the platform is TPU — only the cpu client reads it)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jaxlib without the knob
+            pass
         jax.distributed.initialize(
             coordinator_address=addr, num_processes=world_size,
             process_id=rank,
         )
-        self.mesh = Mesh(np.asarray(jax.devices()), ("x",))
+        by_proc: dict = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        if len(by_proc) != world_size:
+            raise RuntimeError(
+                f"jax.distributed formed {len(by_proc)} processes, "
+                f"expected {world_size}")
+        self._proc_devices = [by_proc[p] for p in sorted(by_proc)]
+        self.mesh = Mesh(np.asarray(self._proc_devices), ("x",))
 
     def _global(self, tensor):
         from jax.experimental import multihost_utils
 
+        # the mesh holds one device per process, so this process's shard
+        # is exactly [1, ...] — its rank's row of the global [world, ...]
         return multihost_utils.host_local_array_to_global_array(
             np.asarray(tensor)[None], self.mesh, P("x")
         )
@@ -251,13 +278,75 @@ class XlaDistributedGroup(BaseGroup):
         chunk = out.shape[0] // self.world_size
         return out[self.rank * chunk:(self.rank + 1) * chunk]
 
+    # -- point-to-point ---------------------------------------------------
+    #
+    # XLA collectives are symmetric (every mesh participant runs the same
+    # program), but the BaseGroup send/recv contract is one-sided — only
+    # the source calls send, only the destination calls recv (reference
+    # ``collective.py:541,604``).  One-sided p2p is host-staged through
+    # the internal KV with per-(src,dst,tag) sequence numbers; in-graph
+    # transfers between ranks should use the mesh collectives (ppermute
+    # via the jitted program) instead — this path is for small control
+    # tensors, and its cost is measured in benchmarks/README.md.
+
+    def _p2p_key(self, src: int, dst: int, tag: int, seq: int) -> bytes:
+        return (f"collective/{self.group_name}/p2p/"
+                f"{src}>{dst}/{tag}/{seq}").encode()
+
     def send(self, tensor, dst_rank: int, tag: int = 0) -> None:
-        raise NotImplementedError("p2p over jax.distributed not supported")
+        import pickle
+
+        from ray_tpu.experimental import internal_kv
+
+        arr = np.asarray(tensor)
+        seq = self._send_seq.get((dst_rank, tag), 0)
+        self._send_seq[(dst_rank, tag)] = seq + 1
+        internal_kv._internal_kv_put(
+            self._p2p_key(self.rank, dst_rank, tag, seq),
+            pickle.dumps(arr, protocol=5), namespace="collective")
 
     def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
-        raise NotImplementedError("p2p over jax.distributed not supported")
+        import pickle
+
+        from ray_tpu.experimental import internal_kv
+
+        seq = self._recv_seq.get((src_rank, tag), 0)
+        key = self._p2p_key(src_rank, self.rank, tag, seq)
+        deadline = time.monotonic() + self._timeout_s
+        while time.monotonic() < deadline:
+            raw = internal_kv._internal_kv_get(key, namespace="collective")
+            if raw is not None:
+                # advance the cursor only on success: a timed-out recv
+                # that bumped it would permanently shift every later
+                # message on this (src, tag) stream
+                self._recv_seq[(src_rank, tag)] = seq + 1
+                internal_kv._internal_kv_del(key, namespace="collective")
+                arr = pickle.loads(raw)
+                if shape is not None and tuple(arr.shape) != tuple(shape):
+                    raise ValueError(
+                        f"recv shape mismatch: got {arr.shape}, "
+                        f"expected {tuple(shape)}")
+                return arr if dtype is None else arr.astype(dtype, copy=False)
+            time.sleep(0.002)
+        raise TimeoutError(
+            f"recv from rank {src_rank} (tag={tag}, seq={seq}) timed out")
 
     def destroy_group(self) -> None:
+        # purge this group's KV footprint (coordinator key + any
+        # unconsumed p2p payloads): a later group REUSING the name would
+        # otherwise pick up a previous incarnation's coordinator address
+        # or deliver its stale tensors as fresh data
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            prefix = f"collective/{self.group_name}/"
+            for k in internal_kv._internal_kv_list(
+                    prefix, namespace="collective"):
+                internal_kv._internal_kv_del(
+                    k.encode() if isinstance(k, str) else k,
+                    namespace="collective")
+        except Exception:  # noqa: BLE001 — cluster may already be down
+            pass
         try:
             jax.distributed.shutdown()
         except Exception:
