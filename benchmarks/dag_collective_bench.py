@@ -1,0 +1,81 @@
+"""A/B: multi-actor DAG allreduce — tcp host-stage vs xla device plane.
+
+VERDICT r4 weak #3 follow-through: with the rank-per-process
+``XlaDistributedGroup`` executable (jax.distributed + gloo on CPU, ICI on
+real TPU hosts), a DAG collective over DISTINCT actors can run on the
+device plane (``allreduce.bind([...], backend="xla")``) instead of the
+tcp ring that pickles through host sockets.  This measures both on the
+same 2-actor DAG.
+
+Reference analogue: per-edge NCCL channels vs shared-memory channels
+(``python/ray/experimental/channel/torch_tensor_nccl_channel.py:44``).
+
+Usage: python benchmarks/dag_collective_bench.py [size_kib] [iters]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def _bench(backend: str, size_kib: int, iters: int) -> float:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode, MultiOutputNode
+    from ray_tpu.dag.collective_node import allreduce
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, val):
+            self.val = float(val)
+            self.n = size_kib * 256  # f32s
+
+        def grad(self, _x):
+            import numpy as _np
+
+            return _np.full((self.n,), self.val, _np.float32)
+
+        def out(self, reduced):
+            return float(reduced[0])
+
+    a, b = Rank.remote(1), Rank.remote(2)
+    with InputNode() as inp:
+        r0, r1 = allreduce.bind([a.grad.bind(inp), b.grad.bind(inp)],
+                                backend=backend)
+        dag = MultiOutputNode([a.out.bind(r0), b.out.bind(r1)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(0).get(timeout=180) == [3.0, 3.0]  # warm
+        t0 = time.perf_counter()
+        for i in range(iters):
+            assert compiled.execute(i).get(timeout=180) == [3.0, 3.0]
+        dt = (time.perf_counter() - t0) / iters
+    finally:
+        compiled.teardown()
+    for w in (a, b):
+        ray_tpu.kill(w)
+    return dt
+
+
+def main():
+    size_kib = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    try:
+        tcp = _bench("tcp", size_kib, iters)
+        xla = _bench("xla", size_kib, iters)
+    finally:
+        ray_tpu.shutdown()
+    print(f"payload {size_kib} KiB x {iters} iters")
+    print(f"dag allreduce tcp (host-stage ring): {tcp * 1e3:.1f} ms/op")
+    print(f"dag allreduce xla (device plane):    {xla * 1e3:.1f} ms/op "
+          f"({tcp / xla:.2f}x vs tcp)")
+
+
+if __name__ == "__main__":
+    main()

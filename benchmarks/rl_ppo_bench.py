@@ -58,6 +58,33 @@ def run_mode(num_runners: int, iters: int, num_envs: int, frag: int):
     }
 
 
+def run_multi_agent(iters: int, num_envs: int, frag: int):
+    """2-agent zero-sum PursuitTag, independent PPO learners — the joint
+    rollout (both agents' sampling + env step) is one jitted scan."""
+    from ray_tpu.rl import MultiAgentPPO, PPOConfig, PursuitTagEnv
+
+    ma = MultiAgentPPO(PursuitTagEnv(), num_envs=num_envs,
+                       rollout_len=frag,
+                       config=PPOConfig(num_epochs=2, num_minibatches=4))
+    ma.train()  # compile excluded
+    t0 = time.perf_counter()
+    steps = 0
+    agent_steps = 0
+    for _ in range(iters):
+        m = ma.train()
+        steps += m["env_steps_this_iter"]
+        agent_steps += m["agent_steps_this_iter"]
+    dt = time.perf_counter() - t0
+    return {
+        "agents": len(PursuitTagEnv.agent_ids),
+        "policies": len(ma.policy_ids),
+        "env_steps_per_s": round(steps / dt, 1),
+        "agent_steps_per_s": round(agent_steps / dt, 1),
+        "env_steps_total": steps,
+        "wall_s": round(dt, 2),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=20)
@@ -79,6 +106,10 @@ def main():
                           "env": "CartPole-v1",
                           "num_env_runners": args.runners,
                           **dist}))
+        ma = run_multi_agent(args.iters, num_envs=512, frag=128)
+        print(json.dumps({"benchmark": "rl_ppo_multi_agent",
+                          "env": "PursuitTag (2-agent zero-sum, jax)",
+                          **ma}))
     finally:
         ray_tpu.shutdown()
 

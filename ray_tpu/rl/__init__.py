@@ -21,6 +21,11 @@ from ray_tpu.rl.env import (
 )
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.models import ActorCriticModule
+from ray_tpu.rl.multi_agent_env import JaxMultiAgentEnv, PursuitTagEnv
+from ray_tpu.rl.multi_agent_ppo import (
+    MultiAgentPPO,
+    make_multi_agent_rollout_fn,
+)
 from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
 
 __all__ = [
@@ -30,6 +35,7 @@ __all__ = [
     "ReplayBuffer", "PPO", "SAC", "SACConfig", "SACParams",
     "Algorithm", "AlgorithmConfig", "ActorCriticModule",
     "CartPoleEnv", "EnvRunner", "EnvRunnerGroup", "EnvSpec", "GymVectorEnv",
-    "JaxVectorEnv", "PPOConfig", "PPOLearner", "compute_gae", "make_env",
-    "register_env", "vtrace",
+    "JaxMultiAgentEnv", "JaxVectorEnv", "MultiAgentPPO", "PPOConfig",
+    "PPOLearner", "PursuitTagEnv", "compute_gae",
+    "make_multi_agent_rollout_fn", "make_env", "register_env", "vtrace",
 ]

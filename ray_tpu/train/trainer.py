@@ -114,13 +114,11 @@ def initialize_jax_distributed() -> None:
         return
     import jax
 
-    # CPU backend: cross-process collectives need the gloo implementation,
-    # selected BEFORE the backend is first touched (only the cpu client
-    # reads it, so this is harmless on TPU hosts)
-    try:
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    except Exception:  # noqa: BLE001 — older jaxlib without the knob
-        pass
+    from ray_tpu.util.collective.collective_group.xla_group import (
+        ensure_cpu_collectives_backend,
+    )
+
+    ensure_cpu_collectives_backend()
     expected = int(os.environ["JAX_NUM_PROCESSES"])
     try:
         jax.distributed.initialize(
