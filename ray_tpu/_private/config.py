@@ -97,8 +97,13 @@ _FLAG_DEFS: Dict[str, Any] = {
     # deadline at all)
     "actor_resolve_timeout_s": 300.0,
     # --- GCS ---
-    "gcs_storage": "memory",  # "memory" | "file" (persistence for FT)
+    # "memory" | "file" (head-disk persistence) | "external" (standalone
+    # store process — head-disk loss no longer loses the cluster)
+    "gcs_storage": "memory",
     "gcs_storage_path": "",
+    # host:port of a `python -m ray_tpu._private.gcs_store` process
+    # (required when gcs_storage == "external")
+    "gcs_external_store_addr": "",
     # --- logging ---
     # worker output files are truncated in place once they exceed this
     # (drained by the raylet log monitor first); 0 disables rotation

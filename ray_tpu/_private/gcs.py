@@ -84,16 +84,22 @@ class GcsServer:
 
         self.job_manager = JobManager(session_dir, lambda: self.addr)
 
-        # --- fault tolerance: file-backed table persistence --------------
-        # Reference: GcsTableStorage over RedisStoreClient
+        # --- fault tolerance: pluggable table persistence ----------------
+        # Reference: GcsTableStorage over a pluggable StoreClient
         # (src/ray/gcs/store_client/redis_store_client.h:111); here the
-        # pluggable store is "memory" (default) or "file" — a debounced
-        # whole-table snapshot, reloaded on restart so a GCS crash doesn't
-        # lose the cluster (nodes re-attach via heartbeats, actors stay
-        # resolvable, named actors / jobs / PGs / KV survive).
-        self._persist_enabled = config.gcs_storage == "file"
+        # store is "memory" (default), "file" (the head's disk), or
+        # "external" (a standalone store process — losing the head's disk
+        # no longer loses the cluster).  The snapshot/WAL/compaction
+        # engine below is backend-independent; the StoreClient only moves
+        # bytes (_private/gcs_store.py).
+        from ray_tpu._private.gcs_store import make_store_client
+
         self._storage_path = (config.gcs_storage_path
                               or f"{session_dir}/gcs_state.pkl")
+        self._store = make_store_client(
+            config.gcs_storage, self._storage_path,
+            config.gcs_external_store_addr)
+        self._persist_enabled = self._store is not None
         self._last_snapshot: bytes = b""
         # dirty flag gates the snapshot pickle: an idle cluster (heartbeats
         # only) pays zero serialization cost.  Set by every non-read RPC
@@ -111,9 +117,20 @@ class GcsServer:
         # re-pickling every table (the O(total state) scaling cliff).
         # kv: identity cache (values are replaced, never mutated);
         # other tables: per-entry pickle digests (entries are small).
-        self._wal_file = None
+        self._wal_synced = False  # _wal_bytes read from the store once
         self._wal_bytes = 0
         self._wal_records = 0  # records since the last compaction
+        # blob names known uploaded/queued this process + the upload queue
+        # (drained by _flush_pending_blobs before the referencing
+        # snapshot/WAL bytes land)
+        self._known_blob_names: set = set()
+        self._pending_blobs: list = []
+        # serializes blocking store I/O: the persist loop runs it on an
+        # executor thread, and stop()'s final snapshot (event-loop
+        # thread) must not interleave with a still-running job
+        import threading as _threading
+
+        self._persist_io_lock = _threading.Lock()
         # kv key -> the VALUE OBJECT last journaled (pinning it: a bare
         # id() would false-negative when the allocator reuses a freed
         # address for the replacement value)
@@ -158,20 +175,28 @@ class GcsServer:
         return self._storage_path + ".blobs"
 
     def _ensure_blob(self, value: bytes) -> str:
-        """Write a content-addressed side file for a large kv value (once —
-        content hash makes rewrites idempotent); returns the blob name."""
+        """Queue a content-addressed side blob for a large kv value;
+        returns the blob name.  The actual upload happens in
+        ``_flush_pending_blobs`` (a store round-trip must not run on the
+        event loop — an external store pushes it to an executor thread),
+        always BEFORE the snapshot/WAL record referencing the name is
+        committed.  Content addressing makes re-uploads idempotent."""
         import hashlib
-        import os
 
         name = hashlib.sha256(value).hexdigest()[:40]
-        path = os.path.join(self._blob_dir(), name)
-        if not os.path.exists(path):
-            os.makedirs(self._blob_dir(), exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(value)
-            os.replace(tmp, path)
+        if name not in self._known_blob_names:
+            self._known_blob_names.add(name)
+            self._pending_blobs.append((name, value))
         return name
+
+    def _flush_pending_blobs(self) -> None:
+        """Blocking: upload queued blobs (skipping ones the store already
+        holds).  Entries stay queued until their upload succeeds."""
+        while self._pending_blobs:
+            name, value = self._pending_blobs[0]
+            if not self._store.has_blob(name):
+                self._store.put_blob(name, bytes(value))
+            self._pending_blobs.pop(0)
 
     def _snapshot_state(self) -> Dict[str, Any]:
         state = {t: getattr(self, t) for t in self._SNAPSHOT_TABLES}
@@ -209,8 +234,9 @@ class GcsServer:
         state["_persist_gen"] = self._persist_gen + 1
         return state
 
-    def _write_snapshot(self):
-        import os
+    def _prepare_snapshot(self):
+        """Event-loop side of a snapshot: read the live tables and pickle
+        them.  -> (blob | None if unchanged, kv_state for blob GC)."""
         import pickle
 
         state = self._snapshot_state()
@@ -236,32 +262,35 @@ class GcsServer:
                            if k not in bad}
             blob = pickle.dumps(state)
         if blob == self._last_snapshot:
-            return
-        tmp = f"{self._storage_path}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, self._storage_path)  # atomic
+            return None, state["kv"]
+        return blob, state["kv"]
+
+    def _commit_snapshot(self, blob: bytes, kv_state) -> None:
+        """Blocking side: referenced blobs first, then the snapshot
+        (atomic in the backend), then side-blob GC."""
+        self._flush_pending_blobs()
+        self._store.write_snapshot(blob)
         self._last_snapshot = blob
-        self._gc_blobs(state["kv"])
+        self._gc_blobs(kv_state)
+
+    def _write_snapshot(self):
+        blob, kv_state = self._prepare_snapshot()
+        if blob is not None:
+            with self._persist_io_lock:
+                self._commit_snapshot(blob, kv_state)
 
     def _gc_blobs(self, kv_state: Dict[Any, Any]):
-        """Unlink side files no longer referenced by the snapshot just
+        """Drop side blobs no longer referenced by the snapshot just
         written (kv_del / overwritten packages)."""
-        import os
-
-        try:
-            names = os.listdir(self._blob_dir())
-        except OSError:
-            return
         live = {v[1] for v in kv_state.values()
                 if isinstance(v, tuple) and len(v) == 2
                 and v[0] == "__kv_blob__"}
-        for n in names:
-            if n not in live and ".tmp." not in n:
-                try:
-                    os.unlink(os.path.join(self._blob_dir(), n))
-                except OSError:
-                    pass
+        for n in self._store.list_blobs():
+            if n not in live:
+                self._store.del_blob(n)
+                # forget the name: if the same content is PUT again later,
+                # _ensure_blob must re-upload it or its reference dangles
+                self._known_blob_names.discard(n)
 
     # -- incremental journal (WAL) ---------------------------------------
     #
@@ -285,40 +314,53 @@ class GcsServer:
         return isinstance(value, tuple) and value == GcsServer._WAL_DEL
 
     def _wal_path(self) -> str:
+        # file-backend layout (kept for tests/tooling poking the disk)
         return self._storage_path + ".wal"
 
-    def _wal_open(self):
-        if self._wal_file is None:
-            import os
-            import pickle
-            import struct
-
-            self._wal_file = open(self._wal_path(), "ab")
-            self._wal_bytes = self._wal_file.tell()
-            os.makedirs(self._blob_dir(), exist_ok=True)
-            if self._wal_bytes == 0:
-                # header pairs this journal with the snapshot generation
-                # it extends; replay skips a WAL whose gen mismatches.
-                # The key slot carries the record-format version: "v2"
-                # journals use the tuple deletion sentinel; older ones
-                # used a bare string (accepted on replay for those only)
-                hdr = pickle.dumps(("__wal_hdr__", "v2", self._persist_gen))
-                self._wal_file.write(struct.pack("<I", len(hdr)) + hdr)
-                self._wal_file.flush()
-                self._wal_bytes += 4 + len(hdr)
-        return self._wal_file
-
-    def _wal_append(self, blobs) -> None:
+    def _wal_prepare(self) -> None:
+        """Sync the byte cursor with the backend once; write the header
+        record when this journal is fresh."""
+        import pickle
         import struct
 
-        f = self._wal_open()
-        out = bytearray()
-        for blob in blobs:
-            out += struct.pack("<I", len(blob)) + blob
-        f.write(out)
-        f.flush()
-        self._wal_bytes += len(out)
-        self._wal_records += len(blobs)
+        if not self._wal_synced:
+            self._wal_synced = True
+            self._wal_bytes = self._store.wal_size()
+        if self._wal_bytes == 0:
+            # header pairs this journal with the snapshot generation
+            # it extends; replay skips a WAL whose gen mismatches.
+            # The key slot carries the record-format version: "v2"
+            # journals use the tuple deletion sentinel; older ones
+            # used a bare string (accepted on replay for those only)
+            hdr = pickle.dumps(("__wal_hdr__", "v2", self._persist_gen))
+            data = struct.pack("<I", len(hdr)) + hdr
+            self._wal_append_at(data)
+
+    def _wal_append_at(self, data: bytes) -> None:
+        """Offset-checked append: the cursor makes retried appends
+        exactly-once server-side; any mismatch resyncs the cursor from
+        the store and surfaces to the persist loop (which retries the
+        whole unacked delta next tick)."""
+        try:
+            self._store.wal_append(data, at=self._wal_bytes)
+        except Exception:
+            self._wal_synced = False
+            raise
+        self._wal_bytes += len(data)
+
+    def _wal_append(self, blobs) -> None:
+        """Blocking (executor-side under the persist loop): referenced
+        side blobs first, then the framed records."""
+        import struct
+
+        with self._persist_io_lock:
+            self._flush_pending_blobs()
+            self._wal_prepare()
+            out = bytearray()
+            for blob in blobs:
+                out += struct.pack("<I", len(blob)) + blob
+            self._wal_append_at(bytes(out))
+            self._wal_records += len(blobs)
 
     def _collect_deltas(self):
         """Changed/deleted entries since the last journal tick, as
@@ -401,17 +443,14 @@ class GcsServer:
                 cache[key] = val
 
     def _replay_wal(self):
-        import os
         import pickle
         import struct
 
-        path = self._wal_path()
-        if not os.path.exists(path):
+        data = self._store.wal_read()
+        if not data:
             return
         n = 0
         try:
-            with open(path, "rb") as f:
-                data = f.read()
             off = 0
             first = True
             legacy = True  # pre-"v2" journals delete via a bare string
@@ -446,11 +485,8 @@ class GcsServer:
                     continue
                 if (table == "kv" and isinstance(value, tuple)
                         and len(value) == 2 and value[0] == "__kv_blob__"):
-                    try:
-                        with open(os.path.join(self._blob_dir(), value[1]),
-                                  "rb") as bf:
-                            value = bf.read()
-                    except OSError:
+                    value = self._store.get_blob(value[1])
+                    if value is None:
                         continue
                 tbl[key] = value
         except Exception:  # noqa: BLE001 — corrupt WAL: snapshot stands
@@ -478,22 +514,19 @@ class GcsServer:
             node.setdefault("available", dict(node.get("total", {})))
 
     def _wal_truncate(self):
-        import os
-
-        if self._wal_file is not None:
-            try:
-                self._wal_file.close()
-            except OSError:
-                pass
-            self._wal_file = None
-        try:
-            os.unlink(self._wal_path())
-        except OSError:
-            pass
+        self._store.wal_truncate()
         self._wal_bytes = 0
         self._wal_records = 0
+        self._wal_synced = True  # cursor is authoritative again (0)
 
     async def _persist_loop(self):
+        # Store round-trips run on an executor thread: an external store
+        # that stalls (or a large blob upload) must not freeze the event
+        # loop — heartbeats going unserviced would mark healthy raylets
+        # dead, turning a store hiccup into a cluster-wide outage.  Table
+        # reads/pickling stay ON the loop (a consistent view needs no
+        # concurrent mutation).
+        loop = asyncio.get_event_loop()
         tick = 0
         while not self._stopping:
             await asyncio.sleep(0.25)
@@ -516,8 +549,15 @@ class GcsServer:
                                  or not self._last_snapshot):
                     # compaction: one full snapshot, then a fresh WAL
                     # under the bumped generation
-                    self._write_snapshot()
-                    self._wal_truncate()
+                    blob, kv_state = self._prepare_snapshot()
+
+                    def _compact():
+                        with self._persist_io_lock:
+                            if blob is not None:
+                                self._commit_snapshot(blob, kv_state)
+                            self._wal_truncate()
+
+                    await loop.run_in_executor(None, _compact)
                     self._persist_gen += 1
                     self._last_full_snapshot_t = now
                 elif full_due:
@@ -525,7 +565,8 @@ class GcsServer:
                 else:
                     blobs, commits = self._collect_deltas()
                     if blobs:
-                        self._wal_append(blobs)
+                        await loop.run_in_executor(
+                            None, self._wal_append, blobs)
                         # caches only advance once the bytes are DOWN:
                         # a failed append leaves entries unjournaled so
                         # the next tick retries them
@@ -540,14 +581,13 @@ class GcsServer:
                     logger.debug("gcs snapshot failed", exc_info=True)
 
     def _load_snapshot(self):
-        import os
         import pickle
 
-        if not os.path.exists(self._storage_path):
+        blob = self._store.read_snapshot()
+        if blob is None:
             return
         try:
-            with open(self._storage_path, "rb") as f:
-                state = pickle.load(f)
+            state = pickle.loads(blob)
         except Exception:  # noqa: BLE001
             logger.warning("gcs snapshot unreadable; starting fresh",
                            exc_info=True)
@@ -556,14 +596,13 @@ class GcsServer:
         for k, v in list(kv_state.items()):
             if (isinstance(v, tuple) and len(v) == 2
                     and v[0] == "__kv_blob__"):
-                try:
-                    with open(os.path.join(self._blob_dir(), v[1]),
-                              "rb") as f:
-                        kv_state[k] = f.read()
-                except OSError:
+                data = self._store.get_blob(v[1])
+                if data is None:
                     logger.warning("gcs restore: kv blob %s missing for %r",
                                    v[1], k)
                     del kv_state[k]
+                else:
+                    kv_state[k] = data
         for t in self._SNAPSHOT_TABLES:
             getattr(self, t).update(state.get(t, {}))
         self._job_counter = state.get("_job_counter", 0)
@@ -1292,6 +1331,10 @@ class GcsServer:
                 self._write_snapshot()  # debounce window of mutations
             except Exception:  # noqa: BLE001
                 logger.debug("final gcs snapshot failed", exc_info=True)
+            try:
+                self._store.close()
+            except Exception:  # noqa: BLE001
+                pass
         await self.server.close()
 
     async def stop_cluster(self):

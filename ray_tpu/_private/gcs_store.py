@@ -1,0 +1,465 @@
+"""Pluggable GCS metadata store — the store-client seam.
+
+Reference: ``StoreClient`` (``src/ray/gcs/store_client/store_client.h``)
+with ``InMemoryStoreClient`` and ``RedisStoreClient``
+(``redis_store_client.h:111``) behind ``GcsTableStorage``: the GCS's
+tables persist through an interface, so head fault tolerance is a
+backend choice, not a code path.
+
+Here the seam carries the snapshot + WAL + blob engine of
+``_private/gcs.py`` (the journaling/compaction logic stays in the GCS —
+it is backend-independent; the store only moves bytes):
+
+- ``FileStoreClient`` — the head's local disk (the previous behavior).
+- ``ExternalStoreClient`` — a standalone KV process reached over the
+  framework's RPC frame protocol (``_private/rpc.py`` wire format, sync
+  client).  Losing the head's disk no longer loses the cluster: a
+  restarted GCS re-reads everything from the external store (the
+  Redis-for-GCS-FT role).
+
+Run the external store:  ``python -m ray_tpu._private.gcs_store --port N
+[--path /durable/file]`` (with ``--path`` the store itself snapshots to
+its own disk, a separate failure domain from the head's).
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+
+
+class StoreClient(abc.ABC):
+    """Byte-moving interface under the GCS persistence engine."""
+
+    # -- snapshot ---------------------------------------------------------
+    @abc.abstractmethod
+    def read_snapshot(self) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def write_snapshot(self, blob: bytes) -> None:
+        """Atomic replace."""
+
+    # -- WAL (raw framed byte stream; framing owned by the GCS) -----------
+    @abc.abstractmethod
+    def wal_size(self) -> int: ...
+
+    @abc.abstractmethod
+    def wal_append(self, data: bytes, at: Optional[int] = None) -> None:
+        """Append; when ``at`` is given, apply only if the journal is
+        exactly ``at`` bytes long (exactly-once under client retries —
+        a retried append whose first attempt landed is acked as a
+        duplicate, anything else raises so the caller resyncs)."""
+
+    @abc.abstractmethod
+    def wal_read(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def wal_truncate(self) -> None: ...
+
+    # -- content-addressed blobs (large kv values) ------------------------
+    @abc.abstractmethod
+    def has_blob(self, name: str) -> bool: ...
+
+    @abc.abstractmethod
+    def put_blob(self, name: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def get_blob(self, name: str) -> Optional[bytes]: ...
+
+    @abc.abstractmethod
+    def list_blobs(self) -> List[str]: ...
+
+    @abc.abstractmethod
+    def del_blob(self, name: str) -> None: ...
+
+    def close(self) -> None:
+        pass
+
+
+class FileStoreClient(StoreClient):
+    """Head-local disk store: ``{path}`` snapshot, ``{path}.wal`` journal,
+    ``{path}.blobs/`` side files — byte-compatible with the pre-seam
+    layout, so existing on-disk state loads unchanged."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._wal_file = None
+
+    # snapshot
+    def read_snapshot(self) -> Optional[bytes]:
+        try:
+            with open(self.path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def write_snapshot(self, blob: bytes) -> None:
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self.path)  # atomic
+
+    # WAL
+    def _wal_path(self) -> str:
+        return self.path + ".wal"
+
+    def wal_size(self) -> int:
+        if self._wal_file is not None:
+            return self._wal_file.tell()
+        try:
+            return os.path.getsize(self._wal_path())
+        except OSError:
+            return 0
+
+    def wal_append(self, data: bytes, at: Optional[int] = None) -> None:
+        if self._wal_file is None:
+            self._wal_file = open(self._wal_path(), "ab")
+        if at is not None:
+            size = self._wal_file.tell()
+            if size != at:
+                if size == at + len(data):
+                    return  # duplicate of an append that already landed
+                raise RuntimeError(
+                    f"wal cursor mismatch: store at {size}, caller at {at}")
+        self._wal_file.write(data)
+        self._wal_file.flush()
+
+    def wal_read(self) -> bytes:
+        try:
+            with open(self._wal_path(), "rb") as f:
+                return f.read()
+        except OSError:
+            return b""
+
+    def wal_truncate(self) -> None:
+        if self._wal_file is not None:
+            try:
+                self._wal_file.close()
+            except OSError:
+                pass
+            self._wal_file = None
+        try:
+            os.unlink(self._wal_path())
+        except OSError:
+            pass
+
+    # blobs
+    def _blob_dir(self) -> str:
+        return self.path + ".blobs"
+
+    def has_blob(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self._blob_dir(), name))
+
+    def put_blob(self, name: str, data: bytes) -> None:
+        os.makedirs(self._blob_dir(), exist_ok=True)
+        path = os.path.join(self._blob_dir(), name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get_blob(self, name: str) -> Optional[bytes]:
+        try:
+            with open(os.path.join(self._blob_dir(), name), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def list_blobs(self) -> List[str]:
+        try:
+            return [n for n in os.listdir(self._blob_dir())
+                    if ".tmp." not in n]
+        except OSError:
+            return []
+
+    def del_blob(self, name: str) -> None:
+        try:
+            os.unlink(os.path.join(self._blob_dir(), name))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._wal_file is not None:
+            try:
+                self._wal_file.close()
+            except OSError:
+                pass
+            self._wal_file = None
+
+
+class ExternalStoreClient(StoreClient):
+    """Synchronous client to a standalone store process.
+
+    Speaks the framework's RPC frame protocol (length-prefixed pickle,
+    ``{method, req_id, kwargs}`` → ``{req_id, ok, result|error}``) over a
+    plain blocking socket — the GCS persistence engine runs from both
+    sync (__init__ restore) and async (persist loop) contexts, and these
+    calls are small and head-local, so a dedicated event loop would buy
+    nothing.  Reconnects once per call on a broken connection."""
+
+    def __init__(self, addr: str, *, timeout_s: float = 30.0):
+        if addr.startswith("tcp:"):
+            addr = addr[4:]
+        host, port = addr.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._req_id = 0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self._host, self._port),
+                                     timeout=self._timeout_s)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _call(self, method: str, **kwargs) -> Any:
+        with self._lock:
+            last_err: Optional[Exception] = None
+            for attempt in range(2):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._req_id += 1
+                    payload = pickle.dumps(
+                        {"method": method, "req_id": self._req_id,
+                         "kwargs": kwargs}, protocol=5)
+                    self._sock.sendall(_LEN.pack(len(payload)) + payload)
+                    hdr = self._recvn(_LEN.size)
+                    (ln,) = _LEN.unpack(hdr)
+                    reply = pickle.loads(self._recvn(ln))
+                    if not reply.get("ok"):
+                        raise reply.get("error") or RuntimeError(
+                            f"store call {method} failed")
+                    return reply.get("result")
+                except (OSError, EOFError) as e:
+                    last_err = e
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+            raise ConnectionError(
+                f"gcs external store unreachable at "
+                f"{self._host}:{self._port}: {last_err!r}")
+
+    def _recvn(self, n: int) -> bytes:
+        assert self._sock is not None
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("store connection closed")
+            buf += chunk
+        return bytes(buf)
+
+    # snapshot
+    def read_snapshot(self) -> Optional[bytes]:
+        return self._call("store_read_snapshot")
+
+    def write_snapshot(self, blob: bytes) -> None:
+        self._call("store_write_snapshot", blob=blob)
+
+    # WAL
+    def wal_size(self) -> int:
+        return self._call("store_wal_size")
+
+    def wal_append(self, data: bytes, at: Optional[int] = None) -> None:
+        # the offset makes the server-side apply exactly-once even though
+        # _call re-sends after a lost reply
+        self._call("store_wal_append", data=data, at=at)
+
+    def wal_read(self) -> bytes:
+        return self._call("store_wal_read")
+
+    def wal_truncate(self) -> None:
+        self._call("store_wal_truncate")
+
+    # blobs
+    def has_blob(self, name: str) -> bool:
+        return self._call("store_has_blob", name=name)
+
+    def put_blob(self, name: str, data: bytes) -> None:
+        self._call("store_put_blob", name=name, data=data)
+
+    def get_blob(self, name: str) -> Optional[bytes]:
+        return self._call("store_get_blob", name=name)
+
+    def list_blobs(self) -> List[str]:
+        return self._call("store_list_blobs")
+
+    def del_blob(self, name: str) -> None:
+        self._call("store_del_blob", name=name)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+
+def make_store_client(storage: str, path: str,
+                      external_addr: str) -> Optional[StoreClient]:
+    """``gcs_storage`` → store client (None = memory-only, no persistence)."""
+    if storage == "file":
+        return FileStoreClient(path)
+    if storage == "external":
+        if not external_addr:
+            raise ValueError(
+                "gcs_storage='external' needs gcs_external_store_addr "
+                "(host:port of a `python -m ray_tpu._private.gcs_store` "
+                "process)")
+        return ExternalStoreClient(external_addr)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# standalone store server
+# ---------------------------------------------------------------------------
+
+
+class _MemStore(StoreClient):
+    """In-memory StoreClient (no --path): same semantics, no durability."""
+
+    def __init__(self):
+        self._snapshot: Optional[bytes] = None
+        self._wal = bytearray()
+        self._blobs: Dict[str, bytes] = {}
+
+    def read_snapshot(self):
+        return self._snapshot
+
+    def write_snapshot(self, blob: bytes):
+        self._snapshot = blob
+
+    def wal_size(self):
+        return len(self._wal)
+
+    def wal_append(self, data: bytes, at: Optional[int] = None):
+        if at is not None and len(self._wal) != at:
+            if len(self._wal) == at + len(data):
+                return
+            raise RuntimeError(
+                f"wal cursor mismatch: store at {len(self._wal)}, "
+                f"caller at {at}")
+        self._wal += data
+
+    def wal_read(self):
+        return bytes(self._wal)
+
+    def wal_truncate(self):
+        self._wal = bytearray()
+
+    def has_blob(self, name):
+        return name in self._blobs
+
+    def put_blob(self, name, data):
+        self._blobs[name] = data
+
+    def get_blob(self, name):
+        return self._blobs.get(name)
+
+    def list_blobs(self):
+        return list(self._blobs)
+
+    def del_blob(self, name):
+        self._blobs.pop(name, None)
+
+    def close(self):
+        pass
+
+
+class GcsStoreServer:
+    """The external store process: every mutation is DURABLE BEFORE it is
+    acked (delegating to a ``FileStoreClient`` on the store's own disk —
+    a failure domain separate from the head's), so a store crash at any
+    instant loses nothing the GCS believes journaled.  Blobs are
+    individual content-addressed files and the WAL is an append-only
+    file, so a dirty tick never re-writes O(total state) bytes.  Without
+    ``--path`` the store is memory-only (tests / ephemeral clusters)."""
+
+    def __init__(self, path: str = ""):
+        self._impl: StoreClient = FileStoreClient(path) if path \
+            else _MemStore()
+
+    # -- handlers (RpcServer.register_all picks up handle_*) --------------
+    async def handle_store_read_snapshot(self):
+        return self._impl.read_snapshot()
+
+    async def handle_store_write_snapshot(self, blob: bytes):
+        self._impl.write_snapshot(blob)
+
+    async def handle_store_wal_size(self):
+        return self._impl.wal_size()
+
+    async def handle_store_wal_append(self, data: bytes, at=None):
+        self._impl.wal_append(data, at)
+
+    async def handle_store_wal_read(self):
+        return self._impl.wal_read()
+
+    async def handle_store_wal_truncate(self):
+        self._impl.wal_truncate()
+
+    async def handle_store_has_blob(self, name: str):
+        return self._impl.has_blob(name)
+
+    async def handle_store_put_blob(self, name: str, data: bytes):
+        self._impl.put_blob(name, data)
+
+    async def handle_store_get_blob(self, name: str):
+        return self._impl.get_blob(name)
+
+    async def handle_store_list_blobs(self):
+        return self._impl.list_blobs()
+
+    async def handle_store_del_blob(self, name: str):
+        self._impl.del_blob(name)
+
+    async def handle_store_ping(self):
+        return "ok"
+
+
+def main() -> None:
+    import argparse
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcServer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--path", default="",
+                    help="durability file prefix for the store itself "
+                         "(omit for memory-only)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        store = GcsStoreServer(args.path)
+        server = RpcServer("gcs-store")
+        server.register_all(store)
+        host, port = await server.listen_tcp(args.host, args.port)
+        # parseable by launchers (same convention as head_proc)
+        print(f"GCS_STORE_ADDR tcp:{host}:{port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

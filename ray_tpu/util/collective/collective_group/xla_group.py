@@ -236,9 +236,18 @@ class XlaDistributedGroup(BaseGroup):
         except RuntimeError as e:
             # tolerate a runtime already formed by this process (e.g. a
             # JaxTrainer worker that ran initialize_jax_distributed);
-            # the process-count check below still validates the world
+            # the checks below still validate the world AND the rank
             if "already" not in str(e):
                 raise
+        if jax.process_index() != rank:
+            # an inherited runtime whose process id differs from this
+            # group's rank would silently permute every rank-indexed op
+            # (broadcast src, send/recv peers, the rank's global row)
+            raise RuntimeError(
+                f"jax.distributed process_index {jax.process_index()} != "
+                f"collective rank {rank} for group {group_name!r}: the "
+                "existing runtime's process id must match the rank the "
+                "group was created with")
         by_proc: dict = {}
         for d in jax.devices():
             by_proc.setdefault(d.process_index, d)
