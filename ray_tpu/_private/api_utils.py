@@ -23,7 +23,8 @@ _TASK_OPTIONS = {
 }
 _ACTOR_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
-    "max_concurrency", "name", "namespace", "lifetime", "get_if_exists",
+    "max_concurrency", "concurrency_groups", "name", "namespace",
+    "lifetime", "get_if_exists",
     "scheduling_strategy", "runtime_env", "memory", "label_selector", "max_pending_calls",
     "_metadata",
 }

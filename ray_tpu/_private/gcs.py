@@ -274,9 +274,14 @@ class GcsServer:
         self._gc_blobs(kv_state)
 
     def _write_snapshot(self):
-        blob, kv_state = self._prepare_snapshot()
-        if blob is not None:
-            with self._persist_io_lock:
+        # the lock spans PREPARE too: _ensure_blob consults
+        # _known_blob_names, which an in-flight executor job's blob GC
+        # mutates — preparing outside the lock could skip re-uploading a
+        # blob the concurrent GC is about to delete (snapshot would then
+        # reference a missing blob)
+        with self._persist_io_lock:
+            blob, kv_state = self._prepare_snapshot()
+            if blob is not None:
                 self._commit_snapshot(blob, kv_state)
 
     def _gc_blobs(self, kv_state: Dict[Any, Any]):

@@ -324,6 +324,12 @@ def make_store_client(storage: str, path: str,
                 "(host:port of a `python -m ray_tpu._private.gcs_store` "
                 "process)")
         return ExternalStoreClient(external_addr)
+    if storage != "memory":
+        # a typo must not silently run the cluster without the fault
+        # tolerance the operator configured
+        raise ValueError(
+            f"unknown gcs_storage {storage!r}: expected 'memory', "
+            "'file', or 'external'")
     return None
 
 

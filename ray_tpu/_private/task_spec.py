@@ -79,6 +79,12 @@ class TaskSpec:
     max_concurrency: int = 1
     max_restarts: int = 0
     is_async_actor: bool = False
+    # named concurrency groups (reference ConcurrencyGroupManager,
+    # src/ray/core_worker/transport/concurrency_group_manager.h): on the
+    # creation spec, {group: max concurrent}; on a method call, the
+    # group routing the task ("" = the default group)
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: str = ""
     actor_name: str = ""
     namespace: str = ""
     runtime_env: Optional[Dict[str, Any]] = None

@@ -209,6 +209,10 @@ class CoreWorker:
         self._fn_cache: Dict[bytes, Any] = {}
         self._task_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
         self._concurrency_sema: Optional[asyncio.Semaphore] = None
+        # named concurrency groups: group -> ThreadPoolExecutor (threaded
+        # actors) / asyncio.Semaphore on the user loop (async actors)
+        self._group_executors: Dict[str, ThreadPoolExecutor] = {}
+        self._group_semas: Dict[str, asyncio.Semaphore] = {}
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self._actor_spec: Optional[TaskSpec] = None
@@ -1536,7 +1540,8 @@ class CoreWorker:
         return entry
 
     async def _exec_streaming(self, spec: TaskSpec,
-                              bound_method: Any = None) -> Dict:
+                              bound_method: Any = None,
+                              executor: Any = None) -> Dict:
         """Run a generator task, streaming each yielded item to the owner
         as it is produced (reference: streaming generator execution in
         ``_raylet.pyx`` + ``task_manager`` generator item reports)."""
@@ -1602,7 +1607,7 @@ class CoreWorker:
                 self._record_task_event(spec, t0, time.time(), ok)
 
         count, error = await self.loop.run_in_executor(
-            self._task_executor, _run)
+            executor if executor is not None else self._task_executor, _run)
         # drain in-flight item sends before announcing the end
         for _ in range(8):
             await self.loop.run_in_executor(None, window.acquire)
@@ -1627,7 +1632,8 @@ class CoreWorker:
             self.loop.call_later(5.0, _hold_refs, borrows)
         return reply
 
-    async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None) -> Dict:
+    async def _exec_in_thread(self, spec: TaskSpec, bound_method: Any = None,
+                              executor: Any = None) -> Dict:
         if spec.task_id in self._cancel_requested:
             self._cancel_requested.discard(spec.task_id)
             return self._package_returns(spec, False, exc.TaskCancelledError(
@@ -1677,7 +1683,8 @@ class CoreWorker:
                 _exec_ctx.reset(token)
                 self._record_task_event(spec, t0, time.time(), ok)
 
-        ok, result = await self.loop.run_in_executor(self._task_executor, _run)
+        ok, result = await self.loop.run_in_executor(
+            executor if executor is not None else self._task_executor, _run)
         return self._package_returns(spec, ok, result)
 
     def _record_task_event(self, spec: TaskSpec, start: float, end: float,
@@ -1818,6 +1825,17 @@ class CoreWorker:
             self._task_executor = ThreadPoolExecutor(
                 max_workers=spec.max_concurrency, thread_name_prefix="rtpu-actor"
             )
+        # named concurrency groups (reference ConcurrencyGroupManager):
+        # each group gets its OWN thread executor, so a saturated group
+        # never starves another.  Built for async actors too — their
+        # plain-def and streaming methods run on threads, and without a
+        # per-group executor those would bypass the cap onto the wide
+        # default pool (async-def methods are capped by per-group
+        # semaphores instead).
+        for g, lim in (spec.concurrency_groups or {}).items():
+            self._group_executors[g] = ThreadPoolExecutor(
+                max_workers=max(1, int(lim)),
+                thread_name_prefix=f"rtpu-cg-{g}")
         if spec.is_async_actor:
             self._user_loop = asyncio.new_event_loop()
             threading.Thread(target=self._user_loop.run_forever, daemon=True,
@@ -1854,7 +1872,8 @@ class CoreWorker:
             raise exc.ActorUnavailableError(spec.actor_id, "actor not initialized on this worker")
         caller = spec.owner_addr.encode()
         own = self._actor_spec
-        if own is not None and (own.is_async_actor or own.max_concurrency > 1):
+        if own is not None and (own.is_async_actor or own.max_concurrency > 1
+                                or own.concurrency_groups):
             return await self._exec_actor_method(spec)
         # In-order scheduling queue per caller (reference ActorSchedulingQueue):
         # tasks are enqueued by sequence number and a single consumer coroutine
@@ -1967,27 +1986,49 @@ class CoreWorker:
             if streaming:
                 return self._streaming_error_reply(spec, err)
             return self._package_returns(spec, False, err)
+        group = spec.concurrency_group
+        declared = (self._actor_spec.concurrency_groups or {}) \
+            if self._actor_spec else {}
+        if group and group not in declared:
+            err = exc.TaskError.from_exception(ValueError(
+                f"unknown concurrency group {group!r}: actor declares "
+                f"{sorted(declared) or 'no groups'}"))
+            if streaming:
+                return self._streaming_error_reply(spec, err)
+            return self._package_returns(spec, False, err)
         if spec.num_returns == STREAMING_RETURNS:
             # streaming actor method (generator): items flow to the owner
             # as produced; the ordered queue holds until the stream ends
-            return await self._exec_streaming(spec, bound_method=method)
+            return await self._exec_streaming(
+                spec, bound_method=method,
+                executor=self._group_executors.get(group) if group
+                else None)
         if asyncio.iscoroutinefunction(method):
             args, kwargs = await self._resolve_args(spec)
 
             async def _run_coro():
                 # concurrency cap for async actors (reference: async actor
-                # max_concurrency, ConcurrencyGroupManager) — the semaphore
-                # lives on the user loop, created on first use
-                if self._concurrency_sema is None:
-                    limit = max(1, (self._actor_spec.max_concurrency
-                                    if self._actor_spec else 1000))
-                    self._concurrency_sema = asyncio.Semaphore(limit)
+                # max_concurrency, ConcurrencyGroupManager) — semaphores
+                # live on the user loop, created on first use; each named
+                # group gets its own so groups cannot starve each other
+                if group:
+                    sema = self._group_semas.get(group)
+                    if sema is None:
+                        sema = asyncio.Semaphore(
+                            max(1, int(declared[group])))
+                        self._group_semas[group] = sema
+                else:
+                    if self._concurrency_sema is None:
+                        limit = max(1, (self._actor_spec.max_concurrency
+                                        if self._actor_spec else 1000))
+                        self._concurrency_sema = asyncio.Semaphore(limit)
+                    sema = self._concurrency_sema
                 # register before the sema wait so a cancel arriving while
                 # queued on the semaphore still finds and cancels this task
                 self._running_async_tasks[spec.task_id] = (
                     asyncio.current_task())
                 try:
-                    async with self._concurrency_sema:
+                    async with sema:
                         token = _exec_ctx.set(
                             ExecutionContext(spec.task_id, spec.job_id,
                                              spec.actor_id))
@@ -2010,7 +2051,9 @@ class CoreWorker:
             cfut = asyncio.run_coroutine_threadsafe(_run_coro(), self._user_loop)
             ok, result = await asyncio.wrap_future(cfut)
             return self._package_returns(spec, ok, result)
-        return await self._exec_in_thread(spec, bound_method=method)
+        return await self._exec_in_thread(
+            spec, bound_method=method,
+            executor=self._group_executors.get(group) if group else None)
 
     async def _terminate_self(self):
         await asyncio.sleep(0.05)

@@ -111,6 +111,7 @@ class ActorHandle:
             actor_seq_no=seq_no,
             max_concurrency=self._max_concurrency,
             is_async_actor=self._is_async,
+            concurrency_group=options.get("concurrency_group", ""),
         )
         refs = worker.submit_actor_task(spec, nested_arg_refs=nested_refs)
         if spec.num_returns == 1:
@@ -210,6 +211,22 @@ class ActorClass:
             worker, args, kwargs)
         is_async = self._is_async_class()
         max_concurrency = opts.get("max_concurrency") or (1000 if is_async else 1)
+        groups = opts.get("concurrency_groups")
+        if groups is not None:
+            if not isinstance(groups, dict) or not groups or not all(
+                    isinstance(g, str) and g
+                    and isinstance(lim, int) and lim >= 1
+                    for g, lim in groups.items()):
+                raise ValueError(
+                    "concurrency_groups must be a non-empty "
+                    "{name: max_concurrency >= 1} dict")
+            if "default" in groups:
+                # the default group's cap IS max_concurrency (reference:
+                # unannotated methods run in the default group)
+                raise ValueError(
+                    "'default' is implicit: set max_concurrency for "
+                    "methods without a concurrency_group")
+            groups = dict(groups)
         spec = TaskSpec(
             task_id=api_utils.next_task_id(worker),
             job_id=worker.job_id,
@@ -229,6 +246,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=opts.get("max_restarts", config.actor_max_restarts_default),
             max_concurrency=max_concurrency,
+            concurrency_groups=groups,
             runtime_env=self._packaged_runtime_env(worker),
             is_async_actor=is_async,
             actor_name=name,

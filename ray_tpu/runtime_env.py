@@ -14,12 +14,21 @@ per-node agent.  Implemented fields here:
   URIs + ``working_dir`` plugin);
 - ``py_modules``: list of local paths or packaged URIs, prepended to
   ``sys.path`` after the same package/extract cycle;
+- ``pip``: OFFLINE per-env provisioning (reference ``PipProcessor``,
+  ``python/ray/_private/runtime_env/pip.py:45``): a venv is created with
+  ``--system-site-packages`` (jax and the sealed image stay visible) and
+  packages install with ``pip install --no-index --find-links`` from a
+  local wheel directory.  The wheel dir rides the same content-addressed
+  ``pkg://`` packaging as ``working_dir`` so any node can provision, and
+  the venv itself is cached by a digest of (packages, wheel content) —
+  the second task reusing an env pays zero provisioning cost;
 - plugins: extra fields validated/applied through ``register_plugin``
   (the reference's plugin protocol, ``runtime_env/plugin.py``).
 
-``pip``/``conda`` provisioning is intentionally absent this round: the
-execution substrate ships as a sealed image (SURVEY.md environment notes);
-the validation below rejects them loudly rather than pretending.
+``conda``/``uv``/``container`` provisioning is intentionally absent: the
+execution substrate ships as a sealed image with no network (SURVEY.md
+environment notes); the validation below rejects them loudly rather than
+pretending.
 """
 
 from __future__ import annotations
@@ -36,8 +45,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-_SUPPORTED = {"env_vars", "working_dir", "py_modules"}
-_UNSUPPORTED = {"pip", "conda", "uv", "container", "image_uri"}
+_SUPPORTED = {"env_vars", "working_dir", "py_modules", "pip"}
+_UNSUPPORTED = {"conda", "uv", "container", "image_uri"}
 
 # pooled task workers share a process: env mutations are exclusive
 _apply_lock = threading.Lock()
@@ -167,6 +176,17 @@ def package_local_dirs(env: Optional[Dict[str, Any]],
             else:
                 packed.append(m)
         out["py_modules"] = packed
+    pip = out.get("pip")
+    if pip:
+        # wheel dirs ride the same content-addressed packaging, so a
+        # worker on ANY node can provision the env
+        packed = []
+        for fl in pip["find_links"]:
+            if not fl.startswith(_PKG_PREFIX) and os.path.isdir(fl):
+                packed.append(_upload_dir(fl, worker))
+            else:
+                packed.append(fl)
+        out["pip"] = {"packages": pip["packages"], "find_links": packed}
     return out
 
 
@@ -215,12 +235,157 @@ def _resolve_uri(value: str) -> str:
     return dest
 
 
+# ------------------------------------------------------------ pip / venv
+
+
+def _normalize_pip(spec: Any) -> Dict[str, Any]:
+    """Accept ``["pkg==1", ...]`` or ``{"packages": [...], "find_links":
+    "dir" | ["dir", ...]}``; offline install needs at least one wheel
+    source."""
+    if isinstance(spec, (list, tuple)):
+        spec = {"packages": list(spec)}
+    if not isinstance(spec, dict):
+        raise TypeError(
+            f"runtime_env pip must be a list of requirements or a dict, "
+            f"got {type(spec).__name__}")
+    packages = spec.get("packages")
+    if not isinstance(packages, (list, tuple)) or not packages \
+            or not all(isinstance(p, str) for p in packages):
+        raise ValueError("runtime_env pip needs a non-empty LIST of "
+                         "requirement strings under 'packages' (a bare "
+                         "string would be iterated per character)")
+    fl = spec.get("find_links") or []
+    if isinstance(fl, str):
+        fl = [fl]
+    if not fl:
+        raise ValueError(
+            "runtime_env pip is OFFLINE on this substrate (no network): "
+            "provide find_links=<local wheel dir> holding the wheels "
+            "(reference PipProcessor resolves from PyPI instead)")
+    unknown = set(spec) - {"packages", "find_links"}
+    if unknown:
+        raise ValueError(f"unknown pip fields: {sorted(unknown)}")
+    return {"packages": [str(p) for p in packages],
+            "find_links": [str(p) for p in fl]}
+
+
+def _pip_env_digest(pip: Dict[str, Any]) -> str:
+    """Content-addressed venv identity: the requirement list plus the
+    wheel sources' content (a pkg:// URI IS a content hash; a local dir
+    contributes its wheel manifest)."""
+    h = hashlib.blake2b(digest_size=16)
+    for p in sorted(pip["packages"]):
+        h.update(p.encode())
+        h.update(b"\x00")
+    for fl in pip["find_links"]:
+        if fl.startswith(_PKG_PREFIX):
+            h.update(fl.encode())
+        else:
+            h.update(_manifest_digest(fl).encode())
+    return h.hexdigest()
+
+
+def _venv_site_packages(venv_dir: str) -> str:
+    import glob as _glob
+
+    hits = _glob.glob(os.path.join(venv_dir, "lib", "python*",
+                                   "site-packages"))
+    if not hits:
+        raise FileNotFoundError(
+            f"venv {venv_dir} has no site-packages directory")
+    return hits[0]
+
+
+def provision_pip_env(pip: Dict[str, Any], session_dir: str) -> str:
+    """Create (or reuse) the content-addressed venv for ``pip``; returns
+    its directory.  Concurrency-safe: built in a tmp dir and atomically
+    renamed, so racing workers both win and the loser's build is
+    discarded."""
+    import shutil
+    import subprocess
+
+    digest = _pip_env_digest(pip)
+    base = os.path.join(session_dir, "runtime_resources", "venvs")
+    dest = os.path.join(base, digest)
+    if os.path.isdir(dest):
+        return dest  # cache hit: second use pays nothing
+    os.makedirs(base, exist_ok=True)
+    find_links = [_resolve_uri(fl) for fl in pip["find_links"]]
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    t0 = __import__("time").perf_counter()
+    try:
+        # --system-site-packages: the sealed image's jax/numpy stay
+        # visible; the env only ADDS wheels (reference PipProcessor
+        # layers similarly on the base env).  --without-pip skips the
+        # ~5s ensurepip bootstrap — the parent's pip is reachable through
+        # system site-packages; fall back to a full venv if it is not.
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages",
+             "--without-pip", tmp],
+            check=True, capture_output=True)
+
+        def _install():
+            cmd = [os.path.join(tmp, "bin", "python"), "-m", "pip",
+                   "install", "--no-index", "--disable-pip-version-check",
+                   "--no-warn-script-location"]
+            for fl in find_links:
+                cmd += ["--find-links", fl]
+            cmd += pip["packages"]
+            return subprocess.run(cmd, check=False, capture_output=True,
+                                  text=True)
+
+        out = _install()
+        if out.returncode != 0 and "No module named pip" in (
+                out.stderr or ""):
+            shutil.rmtree(tmp, ignore_errors=True)
+            subprocess.run(
+                [sys.executable, "-m", "venv", "--system-site-packages",
+                 tmp],
+                check=True, capture_output=True)
+            out = _install()
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"offline pip install failed for {pip['packages']} "
+                f"(wheel dirs {find_links}):\n{out.stdout[-2000:]}"
+                f"\n{out.stderr[-2000:]}")
+        os.rename(tmp, dest)  # atomic: concurrent provisioners both win
+        logger.info("provisioned pip runtime env %s (%d pkgs, %.1fs)",
+                    digest[:12], len(pip["packages"]),
+                    __import__("time").perf_counter() - t0)
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        if os.path.isdir(dest):  # lost the race to a peer: theirs is fine
+            return dest
+        raise
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def _activate_pip_env(pip: Dict[str, Any]) -> None:
+    """Provision (cached) and activate in THIS process: site-packages at
+    the front of sys.path, VIRTUAL_ENV set, venv bin on PATH.  Callers
+    scope the mutations themselves (apply_permanent keeps them; applied()
+    restores sys.path and the saved env keys)."""
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    venv = provision_pip_env(pip, worker.session_dir)
+    site = _venv_site_packages(venv)
+    if site not in sys.path:
+        sys.path.insert(0, site)
+    os.environ["VIRTUAL_ENV"] = venv
+    os.environ["PATH"] = (os.path.join(venv, "bin") + os.pathsep
+                          + os.environ.get("PATH", ""))
+
+
 class RuntimeEnv(dict):
     """Validated runtime-env mapping (reference ``ray.runtime_env.RuntimeEnv``)."""
 
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
-                 py_modules: Optional[List[str]] = None, **extra):
+                 py_modules: Optional[List[str]] = None,
+                 pip: Optional[Any] = None, **extra):
         bad = set(extra) & _UNSUPPORTED
         if bad:
             raise ValueError(
@@ -240,6 +405,8 @@ class RuntimeEnv(dict):
             self["working_dir"] = str(working_dir)
         if py_modules:
             self["py_modules"] = [str(p) for p in py_modules]
+        if pip:
+            self["pip"] = _normalize_pip(pip)
         for name in set(extra) & set(_PLUGINS):
             validate_fn, apply_fn = _PLUGINS[name]
             value = validate_fn(extra[name])
@@ -279,6 +446,9 @@ def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
         p = _resolve_uri(p)
         if p not in sys.path:
             sys.path.insert(0, p)
+    pip = runtime_env.get("pip")
+    if pip:
+        _activate_pip_env(pip)
     # permanent application: context managers returned by plugins are
     # entered and never exited (the actor owns its process)
     for cm in _apply_plugins(runtime_env):
@@ -314,9 +484,11 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
     with _apply_lock:
         # snapshot BEFORE any mutation, and mutate inside the try: a failing
         # chdir (bad working_dir) must not leak env vars into the worker
+        saved_keys = set(runtime_env.get("env_vars") or {})
+        if runtime_env.get("pip"):
+            saved_keys |= {"VIRTUAL_ENV", "PATH"}
         saved_env: Dict[str, Optional[str]] = {
-            k: os.environ.get(k)
-            for k in (runtime_env.get("env_vars") or {})}
+            k: os.environ.get(k) for k in saved_keys}
         saved_cwd = os.getcwd()
         saved_path = list(sys.path)
         try:
@@ -329,6 +501,9 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
                 sys.path.insert(0, wd)
             for p in runtime_env.get("py_modules") or []:
                 sys.path.insert(0, _resolve_uri(p))
+            pip = runtime_env.get("pip")
+            if pip:
+                _activate_pip_env(pip)
             with contextlib.ExitStack() as stack:
                 for cm in _apply_plugins(runtime_env):
                     stack.enter_context(cm)  # scoped to this task

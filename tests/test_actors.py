@@ -218,3 +218,158 @@ def test_actor_ordering_with_ref_args(ray_start):
     r2 = log.record.remote(2)  # submitted later, must run later
     ray_tpu.get([r1, r2])
     assert ray_tpu.get(log.all.remote()) == [100, 2]
+
+
+# ------------------------------------------------------- concurrency groups
+
+
+class TestConcurrencyGroups:
+    """Named per-group concurrency limits routing methods to their own
+    executor (reference ConcurrencyGroupManager,
+    src/ray/core_worker/transport/concurrency_group_manager.h and the
+    actor concurrency_groups option)."""
+
+    def test_slow_group_does_not_starve_fast_group(self, ray_start):
+        import time
+
+        @ray_tpu.remote(concurrency_groups={"slow": 1, "fast": 2})
+        class Svc:
+            @ray_tpu.method(concurrency_group="slow")
+            def block(self, seconds):
+                time.sleep(seconds)
+                return "done"
+
+            @ray_tpu.method(concurrency_group="fast")
+            def ping(self):
+                return time.time()
+
+        s = Svc.remote()
+        ray_tpu.get(s.ping.remote(), timeout=30)  # actor up
+        blocker = s.block.remote(8.0)  # saturates the slow group
+        t0 = time.time()
+        # fast-group calls must complete WHILE the slow group is blocked
+        assert ray_tpu.get([s.ping.remote() for _ in range(4)],
+                           timeout=30)
+        assert time.time() - t0 < 5.0, \
+            "fast group starved behind the slow group"
+        assert ray_tpu.get(blocker, timeout=30) == "done"
+        ray_tpu.kill(s)
+
+    def test_group_limit_enforced(self, ray_start):
+        import time
+
+        @ray_tpu.remote(concurrency_groups={"g": 2})
+        class Counted:
+            def __init__(self):
+                self.now = 0
+                self.peak = 0
+                import threading
+                self.lock = threading.Lock()
+
+            @ray_tpu.method(concurrency_group="g")
+            def work(self):
+                with self.lock:
+                    self.now += 1
+                    self.peak = max(self.peak, self.now)
+                time.sleep(0.4)
+                with self.lock:
+                    self.now -= 1
+                return True
+
+            def peak_seen(self):
+                return self.peak
+
+        c = Counted.remote()
+        ray_tpu.get([c.work.remote() for _ in range(6)], timeout=60)
+        peak = ray_tpu.get(c.peak_seen.remote(), timeout=30)
+        assert peak == 2, f"group cap 2 violated or unused: peak={peak}"
+        ray_tpu.kill(c)
+
+    def test_async_actor_groups_isolated(self, ray_start):
+        import time
+
+        @ray_tpu.remote(concurrency_groups={"io": 1, "cpu": 4})
+        class Aio:
+            @ray_tpu.method(concurrency_group="io")
+            async def hog(self, seconds):
+                import asyncio
+                await asyncio.sleep(seconds)
+                return "hogged"
+
+            @ray_tpu.method(concurrency_group="cpu")
+            async def quick(self):
+                return "ok"
+
+        a = Aio.remote()
+        assert ray_tpu.get(a.quick.remote(), timeout=30) == "ok"
+        h1 = a.hog.remote(6.0)
+        h2 = a.hog.remote(0.1)  # queued behind h1 (io cap 1)
+        t0 = time.time()
+        assert ray_tpu.get([a.quick.remote() for _ in range(4)],
+                           timeout=30) == ["ok"] * 4
+        assert time.time() - t0 < 4.0, "cpu group starved behind io"
+        assert ray_tpu.get([h1, h2], timeout=30) == ["hogged"] * 2
+        ray_tpu.kill(a)
+
+    def test_async_actor_plain_def_methods_still_capped(self, ray_start):
+        """An actor with ANY coroutine method is classified async (wide
+        default executor) — its plain-def methods in a named group must
+        still honor that group's cap, not bypass onto the 1000-wide
+        pool."""
+        import time
+
+        @ray_tpu.remote(concurrency_groups={"g": 2})
+        class Mixed:
+            def __init__(self):
+                self.now = 0
+                self.peak = 0
+                import threading
+                self.lock = threading.Lock()
+
+            async def touch_async(self):
+                return True  # forces async classification
+
+            @ray_tpu.method(concurrency_group="g")
+            def work(self):
+                with self.lock:
+                    self.now += 1
+                    self.peak = max(self.peak, self.now)
+                time.sleep(0.4)
+                with self.lock:
+                    self.now -= 1
+                return True
+
+            def peak_seen(self):
+                return self.peak
+
+        m = Mixed.remote()
+        assert ray_tpu.get(m.touch_async.remote(), timeout=30)
+        ray_tpu.get([m.work.remote() for _ in range(6)], timeout=60)
+        peak = ray_tpu.get(m.peak_seen.remote(), timeout=30)
+        assert peak == 2, f"async actor bypassed the group cap: peak={peak}"
+        ray_tpu.kill(m)
+
+    def test_per_call_group_override_and_unknown_group(self, ray_start):
+        @ray_tpu.remote(concurrency_groups={"a": 1})
+        class Svc:
+            def m(self):
+                return "ran"
+
+        s = Svc.remote()
+        # per-call routing into a declared group
+        assert ray_tpu.get(
+            s.m.options(concurrency_group="a").remote(), timeout=30) == "ran"
+        # unknown group: loud error, actor stays alive
+        with pytest.raises(Exception, match="unknown concurrency group"):
+            ray_tpu.get(s.m.options(concurrency_group="nope").remote(),
+                        timeout=30)
+        assert ray_tpu.get(s.m.remote(), timeout=30) == "ran"
+        ray_tpu.kill(s)
+
+    def test_invalid_group_declarations_rejected(self, ray_start):
+        with pytest.raises(ValueError, match="concurrency_groups"):
+            ray_tpu.remote(concurrency_groups={"g": 0})(type(
+                "T", (), {})).remote()
+        with pytest.raises(ValueError, match="default"):
+            ray_tpu.remote(concurrency_groups={"default": 2})(type(
+                "T", (), {})).remote()

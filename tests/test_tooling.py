@@ -309,12 +309,111 @@ def test_runtime_env_plugin_protocol(ray_start):
 
 
 def test_runtime_env_rejects_unsupported(ray_start):
+    # conda/container stay loud rejects (sealed image, no network)
     with pytest.raises(Exception):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
         def bad():
             pass
 
         bad.remote()
+    # pip without an offline wheel source is rejected with guidance
+    with pytest.raises(ValueError, match="OFFLINE"):
+        from ray_tpu.runtime_env import RuntimeEnv
+        RuntimeEnv(pip=["requests"])
+
+
+def _make_wheel(wheel_dir, name="tinypkg_rt", version="0.1", value=42):
+    """A minimal valid wheel, built by hand (no network, no build deps)."""
+    import zipfile
+
+    os.makedirs(wheel_dir, exist_ok=True)
+    dist = f"{name}-{version}.dist-info"
+    path = os.path.join(wheel_dir, f"{name}-{version}-py3-none-any.whl")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        z.writestr(f"{dist}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name.replace('_', '-')}"
+                   f"\nVersion: {version}\n")
+        z.writestr(f"{dist}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\n"
+                   "Root-Is-Purelib: true\nTag: py3-none-any\n")
+        z.writestr(f"{dist}/RECORD",
+                   f"{name}/__init__.py,,\n{dist}/METADATA,,\n"
+                   f"{dist}/WHEEL,,\n{dist}/RECORD,,\n")
+    return path
+
+
+def test_runtime_env_offline_pip_venv(ray_start, tmp_path):
+    """VERDICT r4 missing #4 (reference PipProcessor,
+    python/ray/_private/runtime_env/pip.py:45): a task's pip runtime env
+    provisions an OFFLINE venv from a local wheel dir; the package is
+    importable only inside that env; the second use reuses the cached
+    venv (content-addressed — no second provision)."""
+    import glob
+
+    wheels = str(tmp_path / "wheels")
+    _make_wheel(wheels)
+
+    # not importable in the driver (proves the wheel isn't ambiently
+    # installed)
+    with pytest.raises(ImportError):
+        import tinypkg_rt  # noqa: F401
+
+    env = {"pip": {"packages": ["tinypkg-rt"], "find_links": wheels}}
+
+    @ray_tpu.remote(runtime_env=env)
+    def use():
+        import os as _os
+
+        import tinypkg_rt as t
+
+        return t.VALUE, t.__file__, _os.environ.get("VIRTUAL_ENV", "")
+
+    val, file, venv = ray_tpu.get(use.remote(), timeout=180)
+    assert val == 42
+    assert os.path.join("runtime_resources", "venvs") in file, file
+    assert venv and "venvs" in venv
+
+    # second use: same cached venv, and exactly ONE venv dir exists
+    val2, file2, _ = ray_tpu.get(use.remote(), timeout=180)
+    assert (val2, file2) == (val, file)
+    from ray_tpu._private.worker import get_global_worker
+
+    venv_base = os.path.join(get_global_worker().session_dir,
+                             "runtime_resources", "venvs")
+    dirs = [d for d in glob.glob(os.path.join(venv_base, "*"))
+            if ".tmp." not in d]
+    assert len(dirs) == 1, dirs
+
+    # an ACTOR provisions from the same cache (permanent application)
+    @ray_tpu.remote(runtime_env=env)
+    class User:
+        def val(self):
+            import tinypkg_rt as t
+
+            return t.VALUE
+
+    a = User.remote()
+    assert ray_tpu.get(a.val.remote(), timeout=180) == 42
+    dirs = [d for d in glob.glob(os.path.join(venv_base, "*"))
+            if ".tmp." not in d]
+    assert len(dirs) == 1, dirs  # still the one env
+    ray_tpu.kill(a)
+
+    # a DIFFERENT package set provisions a second, distinct env
+    _make_wheel(wheels, name="otherpkg_rt", value=7)
+    env2 = {"pip": {"packages": ["otherpkg-rt"], "find_links": wheels}}
+
+    @ray_tpu.remote(runtime_env=env2)
+    def other():
+        import otherpkg_rt as t
+
+        return t.VALUE
+
+    assert ray_tpu.get(other.remote(), timeout=180) == 7
+    dirs = [d for d in glob.glob(os.path.join(venv_base, "*"))
+            if ".tmp." not in d]
+    assert len(dirs) == 2, dirs
 
 
 def test_task_events_and_timeline(ray_start, tmp_path):
