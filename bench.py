@@ -64,7 +64,12 @@ def main() -> None:
         # b16/s1024, which trades quadratic attention FLOPs for dense ones
         # at the same token count; bigger models, b16/s2048, and the
         # save_dots remat policy are all rejected by the remote compile
-        # helper).
+        # helper).  Round-5 lever sweep (benchmarks/mfu_sweep.py) measured
+        # the remaining candidates: save_attn_mlp remat (+1.1 pts at b8
+        # but OOMs above, net below this b16 config), grad accumulation
+        # (persistent f32 accumulator +4.5 GB -> OOM at any accum>1 here),
+        # int8 embed gather (<=0.1 pts) — the 52.8% plateau is the proven
+        # ceiling for this rig (benchmarks/README.md round-5 MFU section).
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,
             num_kv_heads=12, mlp_dim=6144, max_seq_len=1024,

@@ -24,6 +24,7 @@ loop (reference: async actor event loop integration in ``_raylet.pyx``).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import contextvars
 import enum
 import heapq
@@ -209,8 +210,11 @@ class CoreWorker:
         self._fn_cache: Dict[bytes, Any] = {}
         self._task_executor = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
         self._concurrency_sema: Optional[asyncio.Semaphore] = None
-        # named concurrency groups: group -> ThreadPoolExecutor (threaded
-        # actors) / asyncio.Semaphore on the user loop (async actors)
+        # named concurrency groups: group -> ThreadPoolExecutor (thread
+        # dispatch) and group -> asyncio.Semaphore on the MAIN loop.  The
+        # semaphore gates BOTH dispatch kinds, so a group mixing async-def
+        # and plain-def methods shares ONE budget (two independent caps
+        # would let 2x the declared concurrency through).
         self._group_executors: Dict[str, ThreadPoolExecutor] = {}
         self._group_semas: Dict[str, asyncio.Semaphore] = {}
         self.actor_instance: Any = None
@@ -1996,7 +2000,25 @@ class CoreWorker:
             if streaming:
                 return self._streaming_error_reply(spec, err)
             return self._package_returns(spec, False, err)
-        if spec.num_returns == STREAMING_RETURNS:
+        if group:
+            # ONE budget per group, gating every dispatch kind (async-def,
+            # plain-def, streaming) from the MAIN loop: separate caps per
+            # kind would let a mixed group run 2x its declared limit.
+            # A call queued here is still cancellable — the cancel flag is
+            # re-checked when it finally dispatches.
+            sema = self._group_semas.get(group)
+            if sema is None:
+                sema = asyncio.Semaphore(max(1, int(declared[group])))
+                self._group_semas[group] = sema
+            async with sema:
+                return await self._dispatch_actor_method(
+                    spec, method, group, streaming)
+        return await self._dispatch_actor_method(spec, method, group,
+                                                 streaming)
+
+    async def _dispatch_actor_method(self, spec: TaskSpec, method,
+                                     group: str, streaming: bool) -> Dict:
+        if streaming:
             # streaming actor method (generator): items flow to the owner
             # as produced; the ordered queue holds until the stream ends
             return await self._exec_streaming(
@@ -2007,16 +2029,12 @@ class CoreWorker:
             args, kwargs = await self._resolve_args(spec)
 
             async def _run_coro():
-                # concurrency cap for async actors (reference: async actor
-                # max_concurrency, ConcurrencyGroupManager) — semaphores
-                # live on the user loop, created on first use; each named
-                # group gets its own so groups cannot starve each other
+                # concurrency cap for ungrouped async methods (reference:
+                # async actor max_concurrency) — the semaphore lives on
+                # the user loop, created on first use.  Grouped calls are
+                # already gated by their group's main-loop semaphore.
                 if group:
-                    sema = self._group_semas.get(group)
-                    if sema is None:
-                        sema = asyncio.Semaphore(
-                            max(1, int(declared[group])))
-                        self._group_semas[group] = sema
+                    sema = None
                 else:
                     if self._concurrency_sema is None:
                         limit = max(1, (self._actor_spec.max_concurrency
@@ -2028,7 +2046,8 @@ class CoreWorker:
                 self._running_async_tasks[spec.task_id] = (
                     asyncio.current_task())
                 try:
-                    async with sema:
+                    async with (sema if sema is not None
+                                else contextlib.nullcontext()):
                         token = _exec_ctx.set(
                             ExecutionContext(spec.task_id, spec.job_id,
                                              spec.actor_id))

@@ -373,6 +373,12 @@ class LLMEngine:
         return (bool(self._queue) or bool(self._failed)
                 or any(s is not None for s in self._slots))
 
+    def free_slot_count(self) -> int:
+        return sum(1 for s in self._slots if s is None)
+
+    def queued_count(self) -> int:
+        return len(self._queue)
+
     # -- continuous-batching step ------------------------------------------
 
     def step(self) -> List[GenerationOutput]:

@@ -213,7 +213,7 @@ def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
                       preferred_element_type=jnp.float32).astype(dt)
     up = jnp.einsum("bsh,hm->bsm", y, lp["w_up"].astype(dt),
                     preferred_element_type=jnp.float32).astype(dt)
-    act = swiglu(gate, up)
+    act = checkpoint_name(swiglu(gate, up), "mlp_act")
     x = x + jnp.einsum("bsm,mh->bsh", act, lp["w_down"].astype(dt),
                        preferred_element_type=jnp.float32).astype(dt)
     return _constrain(x, mesh, "batch", "seq", None)
@@ -241,6 +241,15 @@ def llama_apply(
             policy = jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "flash_out", "flash_lse"
             )
+        elif cfg.remat_policy == "save_attn_mlp":
+            # save_attn plus the swiglu activation: the backward replays
+            # only norms/rope/QKV projections instead of also re-running
+            # the gate/up matmuls (2 of the 3 MLP matmuls) — a middle
+            # point between save_attn and the (tunnel-rejected) save_dots,
+            # costing b*s*mlp_dim bf16 per layer of extra live memory
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "flash_out", "flash_lse", "mlp_act"
+            )
         elif cfg.remat_policy == "save_dots":
             # Save every matmul output (highest memory of the remat
             # policies, least recompute): the backward replays only the
@@ -250,7 +259,8 @@ def llama_apply(
             policy = jax.checkpoint_policies.nothing_saveable
         else:
             raise ValueError(
-                f"remat_policy must be 'full', 'save_attn' or 'save_dots', "
+                f"remat_policy must be 'full', 'save_attn', "
+                f"'save_attn_mlp' or 'save_dots', "
                 f"got {cfg.remat_policy!r}"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)

@@ -82,12 +82,21 @@ class ShardedTrainer:
         optimizer: Optional[optax.GradientTransformation] = None,
         rules: Optional[LogicalAxisRules] = None,
         batch_spec: Optional[Any] = None,
+        accum_steps: int = 1,
     ):
         self.mesh = mesh
         self.rules = rules or DEFAULT_RULES
         self.optimizer = optimizer or default_optimizer()
         self._init_fn = init_fn
         self._loss_fn = loss_fn
+        # gradient accumulation: the step takes the FULL effective batch
+        # and scans accum_steps microbatches, summing grads before ONE
+        # optimizer update — activation memory is per-microbatch, so the
+        # effective batch (and MXU occupancy) can exceed what fits in one
+        # forward (reference capability: torch grad accumulation inside
+        # the user loop; here it is a trainer feature so the whole
+        # accumulation compiles into one XLA program)
+        self.accum_steps = max(1, int(accum_steps))
 
         self.param_shardings = spec_tree_to_shardings(
             param_specs, mesh, self.rules
@@ -133,9 +142,35 @@ class ShardedTrainer:
         }
 
     def _train_step(self, state, batch):
-        loss, grads = jax.value_and_grad(self._loss_fn)(
-            state["params"], batch
-        )
+        if self.accum_steps > 1:
+            a = self.accum_steps
+            for x in jax.tree.leaves(batch):
+                if x.ndim == 0 or x.shape[0] % a:
+                    raise ValueError(
+                        f"batch leaf shape {getattr(x, 'shape', ())} is "
+                        f"not divisible into accum_steps={a} microbatches "
+                        "(every leaf needs a leading batch dim that is a "
+                        "multiple of accum_steps)")
+            micro = jax.tree.map(
+                lambda x: x.reshape((a, x.shape[0] // a) + x.shape[1:]),
+                batch)
+
+            def body(carry, mb):
+                gsum, lsum = carry
+                loss_i, g = jax.value_and_grad(self._loss_fn)(
+                    state["params"], mb)
+                return (jax.tree.map(jnp.add, gsum, g),
+                        lsum + loss_i), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state["params"])
+            (gsum, lsum), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32)), micro)
+            grads = jax.tree.map(lambda g: g / a, gsum)
+            loss = lsum / a
+        else:
+            loss, grads = jax.value_and_grad(self._loss_fn)(
+                state["params"], batch
+            )
         updates, opt_state = self.optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -172,7 +207,8 @@ class ShardedTrainer:
 
 
 def make_llama_trainer(
-    cfg, mesh: Mesh, *, optimizer=None, rules=None, seq_len=None
+    cfg, mesh: Mesh, *, optimizer=None, rules=None, seq_len=None,
+    accum_steps: int = 1
 ) -> ShardedTrainer:
     """Convenience: a ShardedTrainer for ``ray_tpu.models.llama``."""
     from ray_tpu.models.llama import llama_init, llama_loss, llama_param_specs
@@ -191,4 +227,5 @@ def make_llama_trainer(
         optimizer=optimizer,
         rules=rules,
         batch_spec=batch_spec,
+        accum_steps=accum_steps,
     )

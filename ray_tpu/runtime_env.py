@@ -436,6 +436,12 @@ def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
     if not runtime_env:
         return
     os.environ.update(runtime_env.get("env_vars") or {})
+    # pip first: working_dir/py_modules are inserted AFTER so the user's
+    # own modules shadow same-named wheel modules (the reference's
+    # precedence — the task's code wins over its dependencies)
+    pip = runtime_env.get("pip")
+    if pip:
+        _activate_pip_env(pip)
     wd = runtime_env.get("working_dir")
     if wd:
         wd = _resolve_uri(wd)
@@ -446,9 +452,6 @@ def apply_permanent(runtime_env: Optional[Dict[str, Any]]) -> None:
         p = _resolve_uri(p)
         if p not in sys.path:
             sys.path.insert(0, p)
-    pip = runtime_env.get("pip")
-    if pip:
-        _activate_pip_env(pip)
     # permanent application: context managers returned by plugins are
     # entered and never exited (the actor owns its process)
     for cm in _apply_plugins(runtime_env):
@@ -494,6 +497,11 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
         try:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 os.environ[k] = v
+            # pip before working_dir/py_modules: the user's own modules
+            # must shadow same-named wheel modules
+            pip = runtime_env.get("pip")
+            if pip:
+                _activate_pip_env(pip)
             wd = runtime_env.get("working_dir")
             if wd:
                 wd = _resolve_uri(wd)
@@ -501,9 +509,6 @@ def applied(runtime_env: Optional[Dict[str, Any]]):
                 sys.path.insert(0, wd)
             for p in runtime_env.get("py_modules") or []:
                 sys.path.insert(0, _resolve_uri(p))
-            pip = runtime_env.get("pip")
-            if pip:
-                _activate_pip_env(pip)
             with contextlib.ExitStack() as stack:
                 for cm in _apply_plugins(runtime_env):
                     stack.enter_context(cm)  # scoped to this task

@@ -349,6 +349,55 @@ class TestConcurrencyGroups:
         assert peak == 2, f"async actor bypassed the group cap: peak={peak}"
         ray_tpu.kill(m)
 
+    def test_mixed_kind_group_shares_one_budget(self, ray_start):
+        """async-def and plain-def methods in the SAME group must share
+        one concurrency budget — independent per-kind caps would let a
+        cap-1 group run two tasks at once."""
+        import time
+
+        @ray_tpu.remote(concurrency_groups={"g": 1})
+        class Mixed:
+            def __init__(self):
+                self.now = 0
+                self.peak = 0
+                import threading
+                self.lock = threading.Lock()
+
+            def _enter(self):
+                with self.lock:
+                    self.now += 1
+                    self.peak = max(self.peak, self.now)
+
+            def _exit(self):
+                with self.lock:
+                    self.now -= 1
+
+            @ray_tpu.method(concurrency_group="g")
+            async def a_work(self):
+                import asyncio
+                self._enter()
+                await asyncio.sleep(0.3)
+                self._exit()
+                return "a"
+
+            @ray_tpu.method(concurrency_group="g")
+            def t_work(self):
+                self._enter()
+                time.sleep(0.3)
+                self._exit()
+                return "t"
+
+            def peak_seen(self):
+                return self.peak
+
+        m = Mixed.remote()
+        refs = [m.a_work.remote(), m.t_work.remote(),
+                m.a_work.remote(), m.t_work.remote()]
+        assert sorted(ray_tpu.get(refs, timeout=60)) == ["a", "a", "t", "t"]
+        assert ray_tpu.get(m.peak_seen.remote(), timeout=30) == 1, \
+            "mixed-kind group exceeded its cap of 1"
+        ray_tpu.kill(m)
+
     def test_per_call_group_override_and_unknown_group(self, ray_start):
         @ray_tpu.remote(concurrency_groups={"a": 1})
         class Svc:

@@ -150,6 +150,55 @@ class TestShardedTraining:
         assert all(not x.sharding.is_fully_replicated for x in big)
 
 
+class TestTrainerLevers:
+    """Round-5 MFU levers: correctness on CPU (the chip measurements
+    live in benchmarks/mfu_sweep.py and benchmarks/README.md)."""
+
+    def test_grad_accumulation_matches_full_batch(self):
+        """accum_steps=k over the SAME effective batch must produce the
+        same loss and (numerically) the same update as one full step —
+        grads are summed across microbatches and averaged."""
+        import dataclasses
+
+        cfg = LlamaConfig.tiny(num_layers=2)
+        mesh = create_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab_size)
+        from ray_tpu.models.training import default_optimizer
+
+        losses = {}
+        params = {}
+        for accum in (1, 2, 4):
+            tr = make_llama_trainer(
+                cfg, mesh,
+                optimizer=default_optimizer(warmup=1, decay_steps=10),
+                accum_steps=accum)
+            st = tr.init_state(jax.random.PRNGKey(0))
+            st, m = tr.step(st, tr.shard_batch({"tokens": tok}))
+            losses[accum] = float(m["loss"])
+            params[accum] = jax.device_get(
+                jax.tree.leaves(st["params"])[0])
+        assert abs(losses[1] - losses[2]) < 1e-2, losses
+        assert abs(losses[1] - losses[4]) < 1e-2, losses
+        np.testing.assert_allclose(params[1], params[2], atol=1e-2)
+
+    def test_save_attn_mlp_remat_matches(self):
+        import dataclasses
+
+        cfg = LlamaConfig.tiny(num_layers=2)
+        cfg2 = dataclasses.replace(cfg, remat_policy="save_attn_mlp")
+        mesh = create_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab_size)
+        outs = []
+        for c in (cfg, cfg2):
+            tr = make_llama_trainer(c, mesh)
+            st = tr.init_state(jax.random.PRNGKey(0))
+            _, m = tr.step(st, tr.shard_batch({"tokens": tok}))
+            outs.append(float(m["loss"]))
+        assert abs(outs[0] - outs[1]) < 1e-4, outs
+
+
 class TestShardingRules:
     def test_logical_to_pspec_dedup(self):
         # "batch"→(dp,fsdp) then "embed"→fsdp conflicts; embed replicated.
