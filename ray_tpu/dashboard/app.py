@@ -1,297 +1,57 @@
-"""Dashboard HTTP app: cluster overview, entity lists, metrics.
+"""Dashboard head: assembles the per-subsystem modules.
 
-Reference: ``python/ray/dashboard/head.py:45`` + modules
-(``modules/{node,job,actor,metrics,...}``).  Served from the head process
-(same event loop as the GCS), so every endpoint is a direct read of GCS
-tables — no aggregation RPCs needed on a single head.
+Reference: ``python/ray/dashboard/head.py:45`` + per-subsystem modules
+(``dashboard/modules/{node,job,actor,serve,train,metrics,log,...}``).
+Served from the head process (same event loop as the GCS), so every
+endpoint is a direct read of GCS tables — no aggregation RPCs needed on
+a single head; node-scoped endpoints proxy through that node's raylet
+(the per-node agent role).
 """
 
 from __future__ import annotations
 
-import asyncio
 import json
-import time
-from typing import Any, Dict, Optional
-
-_INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
- h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
- table { border-collapse: collapse; margin-top: .5rem; }
- td, th { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
- th { background: #f4f4f4; text-align: left; }
- code { background: #f4f4f4; padding: 0 .3rem; }
-</style></head>
-<body>
-<h1>ray_tpu dashboard</h1>
-<div id="root">loading…</div>
-<script>
-async function j(p) { return (await fetch(p)).json(); }
-function table(rows, cols) {
-  if (!rows.length) return "<i>none</i>";
-  let h = "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
-  for (const r of rows)
-    h += "<tr>" + cols.map(c => `<td>${JSON.stringify(r[c] ?? "")}</td>`).join("") + "</tr>";
-  return h + "</table>";
-}
-async function render() {
-  const [cluster, actors, jobs, pgs, subjobs, tasks] = await Promise.all([
-    j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
-    j("/api/placement_groups"), j("/api/submitted_jobs"),
-    j("/api/tasks/summary")]);
-  const taskRows = Object.entries(tasks).map(([name, s]) =>
-    ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
-  document.getElementById("root").innerHTML =
-    '<p><a href="/api/timeline" download="timeline.json">download ' +
-    'chrome://tracing timeline</a> · <a href="/api/logs">logs</a> · ' +
-    '<a href="/metrics">prometheus</a></p>' +
-    "<h2>Nodes</h2>" + table(cluster.nodes, ["node_id","state","resources","available","stats"]) +
-    "<h2>Tasks</h2>" + table(taskRows, ["name","count","failed","mean_ms"]) +
-    "<h2>Actors</h2>" + table(actors, ["actor_id","class_name","state","name","node_id"]) +
-    "<h2>Driver jobs</h2>" + table(jobs, ["job_id","state","start_time"]) +
-    "<h2>Submitted jobs</h2>" + table(subjobs, ["submission_id","status","entrypoint","message"]) +
-    "<h2>Placement groups</h2>" + table(pgs, ["placement_group_id","state","strategy"]);
-}
-render(); setInterval(render, 5000);
-</script></body></html>
-"""
 
 
 def build_app(gcs) -> "object":
     from aiohttp import web
+
+    from ray_tpu.dashboard.modules import ALL_MODULES
+    from ray_tpu.dashboard.ui import INDEX_HTML
 
     def jresp(data) -> "web.Response":
         return web.Response(text=json.dumps(data, default=str),
                             content_type="application/json")
 
     async def index(_req):
-        return web.Response(text=_INDEX_HTML, content_type="text/html")
-
-    async def api_cluster(_req):
-        nodes = []
-        for nid, n in gcs.nodes.items():
-            nodes.append({"node_id": nid,
-                          "state": "ALIVE" if n.get("alive") else "DEAD",
-                          "addr": n.get("addr", ""),
-                          "resources": n.get("total", {}),
-                          "available": n.get("available", {}),
-                          # per-node runtime stats shipped in heartbeats
-                          # (the raylet IS the per-node agent here)
-                          "stats": n.get("stats", {})})
-        total = await gcs.handle_cluster_resources()
-        avail = await gcs.handle_available_resources()
-        return jresp({"nodes": nodes, "resources_total": total,
-                      "resources_available": avail, "ts": time.time()})
-
-    async def api_tasks(_req):
-        return jresp(gcs.task_events[-2000:])
-
-    async def api_tasks_summary(_req):
-        out: Dict[str, Any] = {}
-        for e in gcs.task_events:
-            s = out.setdefault(e["name"], {"count": 0, "failed": 0,
-                                           "total_s": 0.0})
-            s["count"] += 1
-            s["failed"] += 0 if e.get("ok") else 1
-            s["total_s"] += e["end"] - e["start"]
-        for s in out.values():
-            s["mean_s"] = s["total_s"] / max(s["count"], 1)
-        return jresp(out)
-
-    async def api_timeline(_req):
-        # chrome://tracing export, one track per worker (same shape as
-        # ray_tpu.timeline() / the reference's `ray timeline`)
-        events = []
-        for e in gcs.task_events:
-            events.append({
-                "name": e["name"], "cat": e.get("kind", "TASK"), "ph": "X",
-                "ts": e["start"] * 1e6,
-                "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
-                "pid": e.get("node_id", "node")[:8],
-                "tid": e.get("worker_id", "worker"),
-                "args": {"ok": e.get("ok"), "task_id": e.get("task_id")},
-            })
-        return web.Response(
-            text=json.dumps(events),
-            content_type="application/json",
-            headers={"Content-Disposition":
-                     'attachment; filename="timeline.json"'})
-
-    async def api_logs(req):
-        import os
-
-        log_dir = os.path.join(gcs.session_dir, "logs")
-        name = req.query.get("file")
-        if not name:
-            try:
-                files = sorted(os.listdir(log_dir))
-            except OSError:
-                files = []
-            return jresp([{"file": f, "href": f"/api/logs?file={f}"}
-                          for f in files])
-        # path-traversal guard: serve only plain files inside logs/
-        path = os.path.realpath(os.path.join(log_dir, name))
-        if not path.startswith(os.path.realpath(log_dir) + os.sep) or \
-                not os.path.isfile(path):
-            return web.Response(status=404, text="no such log")
-        try:
-            tail = int(req.query.get("tail", 10_000))
-        except ValueError:
-            return web.Response(status=400, text="tail must be an integer")
-        tail = max(0, min(tail, 4 * 1024 * 1024))  # bound the read
-
-        def _read_tail() -> bytes:
-            with open(path, "rb") as f:
-                f.seek(0, 2)
-                size = f.tell()
-                f.seek(max(0, size - tail))
-                return f.read()
-
-        # off the loop: this loop also serves GCS RPCs — a slow disk read
-        # must not stall heartbeats/scheduling
-        data = await asyncio.get_event_loop().run_in_executor(
-            None, _read_tail)
-        return web.Response(text=data.decode("utf-8", "replace"),
-                            content_type="text/plain")
-
-    async def api_actors(_req):
-        out = []
-        for aid, a in gcs.actors.items():
-            out.append({"actor_id": aid.hex(), "state": a.get("state"),
-                        "class_name": a.get("class_name", ""),
-                        "name": a.get("name", ""),
-                        "node_id": a.get("node_id", "")})
-        return jresp(out)
-
-    async def api_jobs(_req):
-        return jresp(await gcs.handle_list_jobs())
-
-    async def api_submitted_jobs(_req):
-        return jresp(gcs.job_manager.list_jobs())
-
-    async def api_pgs(_req):
-        out = []
-        for pid, pg in gcs.pgs.items():
-            out.append({"placement_group_id": pid.hex(),
-                        "state": pg.get("state"),
-                        "strategy": pg.get("strategy"),
-                        "bundles": pg.get("bundles")})
-        return jresp(out)
-
-    async def api_named_actors(_req):
-        return jresp(await gcs.handle_list_named_actors())
-
-    async def api_events(req):
-        try:
-            cursor = int(req.query.get("cursor", 0))
-        except ValueError:
-            cursor = 0
-        return jresp(gcs._events[cursor:cursor + 1000])
-
-    def _aggregate_metrics() -> Dict[str, Any]:
-        merged: Dict[str, Any] = {}
-        for (ns, _key), raw in list(gcs.kv.items()):
-            if ns != "metrics":
-                continue
-            try:
-                payload = json.loads(raw)
-            except (ValueError, TypeError):
-                continue
-            for name, entry in payload.get("metrics", {}).items():
-                if name not in merged:
-                    merged[name] = {"kind": entry["kind"],
-                                    "description": entry.get("description", ""),
-                                    "series": [], "histogram": [],
-                                    "boundaries": entry.get("boundaries", [])}
-                merged[name]["series"].extend(entry.get("series", []))
-                merged[name]["histogram"].extend(entry.get("histogram", []))
-        return merged
-
-    async def api_metrics(_req):
-        return jresp(_aggregate_metrics())
-
-    async def prometheus(_req):
-        from ray_tpu.util.metrics import prometheus_text
-
-        return web.Response(text=prometheus_text(_aggregate_metrics()),
-                            content_type="text/plain")
-
-    def _raylet_for(node_id: str):
-        node = gcs.nodes.get(node_id)
-        if node is None or not node.get("alive"):
-            return None
-        return gcs._raylet(node_id)
-
-    async def api_node_stats(req):
-        """Per-node agent stats (reference dashboard/agent.py): cpu%,
-        per-worker RSS, accelerators — proxied to that node's raylet."""
-        raylet = _raylet_for(req.match_info["node_id"])
-        if raylet is None:
-            return web.Response(status=404, text="no such live node")
-        try:
-            return jresp(await raylet.call("agent_stats", timeout=10.0))
-        except Exception as e:  # noqa: BLE001
-            return web.Response(status=502, text=repr(e))
-
-    async def api_memory(_req):
-        """Cluster object-ref debugging view (the ``raytpu memory``
-        data): every node's pool-worker refcount tables + store stats,
-        fanned through the per-node raylets in parallel."""
-        async def ask(nid):
-            raylet = _raylet_for(nid)
-            if raylet is None:
-                return None
-            try:
-                return await raylet.call("memory_report", timeout=12.0)
-            except Exception:  # noqa: BLE001 — dying node: best-effort
-                return None
-
-        reps = await asyncio.gather(*(ask(nid) for nid in list(gcs.nodes)))
-        return jresp({"nodes": [r for r in reps if r]})
-
-    async def api_node_logs(req):
-        """Node-local log access, proxied through the node's raylet."""
-        raylet = _raylet_for(req.match_info["node_id"])
-        if raylet is None:
-            return web.Response(status=404, text="no such live node")
-        name = req.query.get("file")
-        try:
-            if not name:
-                files = await raylet.call("agent_list_logs", timeout=10.0)
-                nid = req.match_info["node_id"]
-                return jresp([{"file": f,
-                               "href": f"/api/node/{nid}/logs?file={f}"}
-                              for f in files])
-            tail = int(req.query.get("tail", 65536))
-            text = await raylet.call("agent_read_log", name=name,
-                                     tail_bytes=tail, timeout=10.0)
-            return web.Response(text=text, content_type="text/plain")
-        except Exception as e:  # noqa: BLE001
-            return web.Response(status=502, text=repr(e))
+        return web.Response(text=INDEX_HTML, content_type="text/html")
 
     async def healthz(_req):
         return jresp({"status": "ok"})
 
     app = web.Application()
     app.router.add_get("/", index)
-    app.router.add_get("/api/cluster", api_cluster)
-    app.router.add_get("/api/actors", api_actors)
-    app.router.add_get("/api/jobs", api_jobs)
-    app.router.add_get("/api/submitted_jobs", api_submitted_jobs)
-    app.router.add_get("/api/placement_groups", api_pgs)
-    app.router.add_get("/api/named_actors", api_named_actors)
-    app.router.add_get("/api/events", api_events)
-    app.router.add_get("/api/tasks", api_tasks)
-    app.router.add_get("/api/tasks/summary", api_tasks_summary)
-    app.router.add_get("/api/timeline", api_timeline)
-    app.router.add_get("/api/logs", api_logs)
-    app.router.add_get("/api/memory", api_memory)
-    app.router.add_get("/api/node/{node_id}/stats", api_node_stats)
-    app.router.add_get("/api/node/{node_id}/logs", api_node_logs)
-    app.router.add_get("/api/metrics", api_metrics)
-    app.router.add_get("/metrics", prometheus)
     app.router.add_get("/-/healthz", healthz)
+    # modules may register background coroutines (e.g. the metrics
+    # history sampler); started with the app, cancelled at cleanup
+    background: list = []
+    helpers = {"jresp": jresp, "web": web, "background_tasks": background}
+    for module in ALL_MODULES:
+        for method, path, handler in module.routes(gcs, helpers):
+            app.router.add_route(method, path, handler)
+
+    async def _run_background(app_):
+        import asyncio
+
+        tasks = [asyncio.ensure_future(fn()) for fn in background]
+        yield
+        for t in tasks:
+            t.cancel()
+        # deliver the cancellations before teardown completes, or asyncio
+        # logs "Task was destroyed but it is pending!"
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    app.cleanup_ctx.append(_run_background)
     return app
 
 

@@ -1,0 +1,19 @@
+"""Per-subsystem dashboard modules (reference:
+``python/ray/dashboard/modules/{node,job,serve,train,reporter,...}``).
+
+Each module exposes ``routes(gcs, helpers) -> [(method, path, handler)]``;
+the head app (``dashboard/app.py``) assembles them.  ``helpers`` carries
+the shared ``jresp`` JSON responder so modules stay framework-thin.
+"""
+
+from ray_tpu.dashboard.modules import (  # noqa: F401
+    cluster,
+    entities,
+    logs,
+    metrics,
+    serve,
+    tasks,
+    train,
+)
+
+ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train)

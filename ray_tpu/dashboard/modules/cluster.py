@@ -1,0 +1,92 @@
+"""Cluster/node module: overview, per-node agent stats + logs, memory.
+
+Reference: ``dashboard/modules/node`` + ``modules/reporter`` (per-node
+agent) — here the raylet IS the per-node agent, so node endpoints proxy
+through it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+    web = helpers["web"]
+
+    def _raylet_for(node_id: str):
+        node = gcs.nodes.get(node_id)
+        if node is None or not node.get("alive"):
+            return None
+        return gcs._raylet(node_id)
+
+    async def api_cluster(_req):
+        nodes = []
+        for nid, n in gcs.nodes.items():
+            nodes.append({"node_id": nid,
+                          "state": "ALIVE" if n.get("alive") else "DEAD",
+                          "addr": n.get("addr", ""),
+                          "resources": n.get("total", {}),
+                          "available": n.get("available", {}),
+                          # per-node runtime stats shipped in heartbeats
+                          # (the raylet IS the per-node agent here)
+                          "stats": n.get("stats", {})})
+        total = await gcs.handle_cluster_resources()
+        avail = await gcs.handle_available_resources()
+        return jresp({"nodes": nodes, "resources_total": total,
+                      "resources_available": avail, "ts": time.time()})
+
+    async def api_node_stats(req):
+        """Per-node agent stats (reference dashboard/agent.py): cpu%,
+        per-worker RSS, accelerators — proxied to that node's raylet."""
+        raylet = _raylet_for(req.match_info["node_id"])
+        if raylet is None:
+            return web.Response(status=404, text="no such live node")
+        try:
+            return jresp(await raylet.call("agent_stats", timeout=10.0))
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=502, text=repr(e))
+
+    async def api_node_logs(req):
+        """Node-local log access, proxied through the node's raylet."""
+        raylet = _raylet_for(req.match_info["node_id"])
+        if raylet is None:
+            return web.Response(status=404, text="no such live node")
+        name = req.query.get("file")
+        try:
+            if not name:
+                files = await raylet.call("agent_list_logs", timeout=10.0)
+                nid = req.match_info["node_id"]
+                return jresp([{"file": f,
+                               "href": f"/api/node/{nid}/logs?file={f}"}
+                              for f in files])
+            tail = int(req.query.get("tail", 65536))
+            text = await raylet.call("agent_read_log", name=name,
+                                     tail_bytes=tail, timeout=10.0)
+            return web.Response(text=text, content_type="text/plain")
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=502, text=repr(e))
+
+    async def api_memory(_req):
+        """Cluster object-ref debugging view (the ``raytpu memory``
+        data): every node's pool-worker refcount tables + store stats,
+        fanned through the per-node raylets in parallel."""
+        async def ask(nid):
+            raylet = _raylet_for(nid)
+            if raylet is None:
+                return None
+            try:
+                return await raylet.call("memory_report", timeout=12.0)
+            except Exception:  # noqa: BLE001 — dying node: best-effort
+                return None
+
+        reps = await asyncio.gather(*(ask(nid) for nid in list(gcs.nodes)))
+        return jresp({"nodes": [r for r in reps if r]})
+
+    return [
+        ("GET", "/api/cluster", api_cluster),
+        ("GET", "/api/node/{node_id}/stats", api_node_stats),
+        ("GET", "/api/node/{node_id}/logs", api_node_logs),
+        ("GET", "/api/memory", api_memory),
+    ]

@@ -1,0 +1,54 @@
+"""Entity listings: actors, jobs, placement groups, events.
+
+Reference: ``dashboard/modules/actor`` + ``modules/job`` +
+``state_aggregator`` list endpoints.
+"""
+
+from __future__ import annotations
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_actors(_req):
+        out = []
+        for aid, a in gcs.actors.items():
+            out.append({"actor_id": aid.hex(), "state": a.get("state"),
+                        "class_name": a.get("class_name", ""),
+                        "name": a.get("name", ""),
+                        "node_id": a.get("node_id", "")})
+        return jresp(out)
+
+    async def api_jobs(_req):
+        return jresp(await gcs.handle_list_jobs())
+
+    async def api_submitted_jobs(_req):
+        return jresp(gcs.job_manager.list_jobs())
+
+    async def api_pgs(_req):
+        out = []
+        for pid, pg in gcs.pgs.items():
+            out.append({"placement_group_id": pid.hex(),
+                        "state": pg.get("state"),
+                        "strategy": pg.get("strategy"),
+                        "bundles": pg.get("bundles")})
+        return jresp(out)
+
+    async def api_named_actors(_req):
+        return jresp(await gcs.handle_list_named_actors())
+
+    async def api_events(req):
+        try:
+            cursor = int(req.query.get("cursor", 0))
+        except ValueError:
+            cursor = 0
+        return jresp(gcs._events[cursor:cursor + 1000])
+
+    return [
+        ("GET", "/api/actors", api_actors),
+        ("GET", "/api/jobs", api_jobs),
+        ("GET", "/api/submitted_jobs", api_submitted_jobs),
+        ("GET", "/api/placement_groups", api_pgs),
+        ("GET", "/api/named_actors", api_named_actors),
+        ("GET", "/api/events", api_events),
+    ]

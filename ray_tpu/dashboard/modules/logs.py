@@ -1,0 +1,51 @@
+"""Head log browsing (node-local logs live in cluster.py's proxy).
+
+Reference: ``dashboard/modules/log``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+    web = helpers["web"]
+
+    async def api_logs(req):
+        log_dir = os.path.join(gcs.session_dir, "logs")
+        name = req.query.get("file")
+        if not name:
+            try:
+                files = sorted(os.listdir(log_dir))
+            except OSError:
+                files = []
+            return jresp([{"file": f, "href": f"/api/logs?file={f}"}
+                          for f in files])
+        # path-traversal guard: serve only plain files inside logs/
+        path = os.path.realpath(os.path.join(log_dir, name))
+        if not path.startswith(os.path.realpath(log_dir) + os.sep) or \
+                not os.path.isfile(path):
+            return web.Response(status=404, text="no such log")
+        try:
+            tail = int(req.query.get("tail", 10_000))
+        except ValueError:
+            return web.Response(status=400, text="tail must be an integer")
+        tail = max(0, min(tail, 4 * 1024 * 1024))  # bound the read
+
+        def _read_tail() -> bytes:
+            with open(path, "rb") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                return f.read()
+
+        # off the loop: this loop also serves GCS RPCs — a slow disk read
+        # must not stall heartbeats/scheduling
+        data = await asyncio.get_event_loop().run_in_executor(
+            None, _read_tail)
+        return web.Response(text=data.decode("utf-8", "replace"),
+                            content_type="text/plain")
+
+    return [("GET", "/api/logs", api_logs)]

@@ -1,0 +1,113 @@
+"""Metrics module: merged series (for the UI's sparkline graphs) +
+Prometheus exposition.
+
+Reference: ``dashboard/modules/metrics`` + the metrics agent's
+``/metrics`` endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+
+def aggregate_metrics(gcs) -> Dict[str, Any]:
+    merged: Dict[str, Any] = {}
+    for (ns, _key), raw in list(gcs.kv.items()):
+        if ns != "metrics":
+            continue
+        try:
+            payload = json.loads(raw)
+        except (ValueError, TypeError):
+            continue
+        for name, entry in payload.get("metrics", {}).items():
+            if name not in merged:
+                merged[name] = {"kind": entry["kind"],
+                                "description": entry.get("description", ""),
+                                "series": [], "histogram": [],
+                                "boundaries": entry.get("boundaries", [])}
+            merged[name]["series"].extend(entry.get("series", []))
+            merged[name]["histogram"].extend(entry.get("histogram", []))
+    return merged
+
+
+class MetricsSampler:
+    """Head-side history: workers publish only their LATEST values, so
+    the dashboard samples the merged view on a cadence into per-metric
+    ring buffers — that history is what the UI's sparkline graphs plot
+    (reference: the metrics agent scraping into the time-series store)."""
+
+    WINDOW = 360  # samples kept (~30 min at the 5 s cadence)
+    PERIOD_S = 5.0
+
+    def __init__(self, gcs):
+        import collections
+
+        self._gcs = gcs
+        self._history = collections.defaultdict(
+            lambda: collections.deque(maxlen=self.WINDOW))
+        self._meta = {}
+
+    def sample_once(self) -> None:
+        import time as _t
+
+        now = _t.time()
+        for name, m in aggregate_metrics(self._gcs).items():
+            vals = [s["value"] for s in m.get("series", [])
+                    if isinstance(s, dict) and "value" in s]
+            if not vals:
+                continue
+            # counters sum across workers; gauges average
+            agg = (sum(vals) if m.get("kind") == "counter"
+                   else sum(vals) / len(vals))
+            self._history[name].append((now, agg))
+            self._meta[name] = {"kind": m.get("kind"),
+                                "description": m.get("description", "")}
+
+    async def run(self):
+        import asyncio
+
+        while True:
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 — sampling is best-effort
+                pass
+            await asyncio.sleep(self.PERIOD_S)
+
+    def snapshot(self):
+        return {name: {**self._meta.get(name, {}),
+                       "points": list(pts)}
+                for name, pts in self._history.items()}
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+    web = helpers["web"]
+    sampler = MetricsSampler(gcs)
+    helpers["background_tasks"].append(sampler.run)
+
+    async def api_metrics(_req):
+        return jresp(aggregate_metrics(gcs))
+
+    async def api_metrics_history(_req):
+        # freshen at most once per cadence: per-request sampling would
+        # let UI polling halve the history window and cluster timestamps
+        import time as _t
+
+        if _t.time() - getattr(sampler, "_last_t", 0.0) \
+                >= sampler.PERIOD_S:
+            sampler.sample_once()
+            sampler._last_t = _t.time()
+        return jresp(sampler.snapshot())
+
+    async def prometheus(_req):
+        from ray_tpu.util.metrics import prometheus_text
+
+        return web.Response(text=prometheus_text(aggregate_metrics(gcs)),
+                            content_type="text/plain")
+
+    return [
+        ("GET", "/api/metrics", api_metrics),
+        ("GET", "/api/metrics/history", api_metrics_history),
+        ("GET", "/metrics", prometheus),
+    ]

@@ -1,0 +1,29 @@
+"""Serve module: applications / deployments / replica state.
+
+Reference: ``dashboard/modules/serve`` (the serve controller's view in
+the dashboard head).  The controller actor publishes its status snapshot
+into the GCS KV (namespace "serve") each reconcile tick, so the head
+renders it with a plain table read — no actor RPC from the dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_serve(_req):
+        raw = gcs.kv.get(("serve", "status"))
+        if not raw:
+            return jresp({"running": False, "deployments": {},
+                          "routes": {}, "apps": {}})
+        try:
+            status = json.loads(raw)
+        except (ValueError, TypeError):
+            status = {}
+        status.setdefault("running", True)
+        return jresp(status)
+
+    return [("GET", "/api/serve", api_serve)]

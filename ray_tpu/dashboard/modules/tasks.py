@@ -1,0 +1,54 @@
+"""Task events module: raw feed, summary, chrome-trace timeline.
+
+Reference: ``dashboard/modules/job`` task views + `ray timeline`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+    web = helpers["web"]
+
+    async def api_tasks(_req):
+        return jresp(gcs.task_events[-2000:])
+
+    async def api_tasks_summary(_req):
+        out: Dict[str, Any] = {}
+        for e in gcs.task_events:
+            s = out.setdefault(e["name"], {"count": 0, "failed": 0,
+                                           "total_s": 0.0})
+            s["count"] += 1
+            s["failed"] += 0 if e.get("ok") else 1
+            s["total_s"] += e["end"] - e["start"]
+        for s in out.values():
+            s["mean_s"] = s["total_s"] / max(s["count"], 1)
+        return jresp(out)
+
+    async def api_timeline(_req):
+        # chrome://tracing export, one track per worker (same shape as
+        # ray_tpu.timeline() / the reference's `ray timeline`)
+        events = []
+        for e in gcs.task_events:
+            events.append({
+                "name": e["name"], "cat": e.get("kind", "TASK"), "ph": "X",
+                "ts": e["start"] * 1e6,
+                "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
+                "pid": e.get("node_id", "node")[:8],
+                "tid": e.get("worker_id", "worker"),
+                "args": {"ok": e.get("ok"), "task_id": e.get("task_id")},
+            })
+        return web.Response(
+            text=json.dumps(events),
+            content_type="application/json",
+            headers={"Content-Disposition":
+                     'attachment; filename="timeline.json"'})
+
+    return [
+        ("GET", "/api/tasks", api_tasks),
+        ("GET", "/api/tasks/summary", api_tasks_summary),
+        ("GET", "/api/timeline", api_timeline),
+    ]

@@ -1,0 +1,31 @@
+"""Train module: run status / progress view.
+
+Reference: ``dashboard/modules/train``.  Each TrainController publishes
+its run's status (world size, latest rank-0 metrics, restarts, state)
+into the GCS KV under namespace "train" while the run is live; the head
+lists all runs with plain table reads.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_train(_req):
+        runs = []
+        for (ns, key), raw in list(gcs.kv.items()):
+            if ns != "train":
+                continue
+            try:
+                run = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            run.setdefault("name", key)
+            runs.append(run)
+        runs.sort(key=lambda r: r.get("started_at", 0.0), reverse=True)
+        return jresp({"runs": runs})
+
+    return [("GET", "/api/train", api_train)]

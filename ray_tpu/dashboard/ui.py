@@ -1,0 +1,162 @@
+"""Dashboard UI: one dependency-free HTML page (zero-egress image — no
+CDN bundles), hash-routed.
+
+Views: overview (nodes/tasks/actors/jobs/PGs + serve & train sections),
+metric sparkline graphs (inline SVG from ``/api/metrics`` series), and
+per-node drill-down pages (``#node/<id>``: agent stats, per-worker RSS,
+log browser) — the reference dashboard's modules rendered the
+single-file way.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; margin-top: .5rem; }
+ td, th { border: 1px solid #ccc; padding: .3rem .6rem; font-size: .85rem; }
+ th { background: #f4f4f4; text-align: left; }
+ code { background: #f4f4f4; padding: 0 .3rem; }
+ a { color: #0a58ca; } .muted { color: #777; }
+ .spark { margin: .2rem 0; } .spark text { font-size: 10px; fill: #555; }
+ nav a { margin-right: 1rem; }
+ pre.log { background: #111; color: #ddd; padding: .6rem; font-size: .75rem;
+           max-height: 24rem; overflow: auto; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<nav><a href="#">overview</a> <a href="#metrics">metrics</a>
+ <a href="/api/timeline" download="timeline.json">timeline</a>
+ <a href="/api/logs">head logs</a> <a href="/metrics">prometheus</a></nav>
+<div id="root">loading…</div>
+<script>
+async function j(p) { return (await fetch(p)).json(); }
+function esc(s) { return String(s).replace(/[&<>"]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c])); }
+function table(rows, cols, linkFn) {
+  if (!rows.length) return "<i>none</i>";
+  let h = "<table><tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => {
+      let v = r[c] ?? "";
+      let cell = typeof v === "object" ? esc(JSON.stringify(v)) : esc(v);
+      if (linkFn) { const href = linkFn(r, c); if (href) cell = `<a href="${href}">${cell}</a>`; }
+      return `<td>${cell}</td>`;
+    }).join("") + "</tr>";
+  return h + "</table>";
+}
+function spark(points, w=220, h=36) {
+  // inline SVG sparkline for one metric series: [[ts, value], ...]
+  if (!points || points.length < 2) return "<span class=muted>–</span>";
+  const vs = points.map(p => p[1]);
+  const mn = Math.min(...vs), mx = Math.max(...vs), span = (mx - mn) || 1;
+  const step = w / (points.length - 1);
+  const path = points.map((p, i) =>
+    `${i ? "L" : "M"}${(i * step).toFixed(1)},` +
+    `${(h - 4 - (p[1] - mn) / span * (h - 8)).toFixed(1)}`).join(" ");
+  return `<svg class=spark width=${w + 70} height=${h}>` +
+    `<path d="${path}" fill="none" stroke="#0a58ca" stroke-width="1.5"/>` +
+    `<text x="${w + 4}" y="12">${mx.toPrecision(4)}</text>` +
+    `<text x="${w + 4}" y="${h - 2}">${mn.toPrecision(4)}</text></svg>`;
+}
+
+async function renderOverview(root) {
+  const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train] =
+    await Promise.all([
+      j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
+      j("/api/placement_groups"), j("/api/submitted_jobs"),
+      j("/api/tasks/summary"), j("/api/serve"), j("/api/train")]);
+  const taskRows = Object.entries(tasks).map(([name, s]) =>
+    ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
+  const depRows = Object.entries(serve.deployments || {}).map(
+    ([name, d]) => ({name, ...d}));
+  const routeRows = Object.entries(serve.routes || {}).map(
+    ([route, dep]) => ({route, deployment: dep}));
+  const trainRows = (train.runs || []).map(r => ({
+    name: r.name, status: r.status, world: r.world_size,
+    iteration: r.iteration, restarts: r.restarts,
+    metrics: r.latest_metrics}));
+  root.innerHTML =
+    "<h2>Nodes</h2>" + table(cluster.nodes,
+      ["node_id","state","resources","available","stats"],
+      (r, c) => c === "node_id" ? `#node/${r.node_id}` : null) +
+    "<h2>Tasks</h2>" + table(taskRows, ["name","count","failed","mean_ms"]) +
+    "<h2>Serve</h2>" + (serve.running
+      ? table(depRows, ["name","num_replicas","goal","version"]) +
+        table(routeRows, ["route","deployment"])
+      : "<i>serve not running</i>") +
+    "<h2>Train runs</h2>" + table(trainRows,
+      ["name","status","world","iteration","restarts","metrics"]) +
+    "<h2>Actors</h2>" + table(actors, ["actor_id","class_name","state","name","node_id"],
+      (r, c) => c === "node_id" && r.node_id ? `#node/${r.node_id}` : null) +
+    "<h2>Driver jobs</h2>" + table(jobs, ["job_id","state","start_time"]) +
+    "<h2>Submitted jobs</h2>" + table(subjobs, ["submission_id","status","entrypoint","message"]) +
+    "<h2>Placement groups</h2>" + table(pgs, ["placement_group_id","state","strategy"]);
+}
+
+async function renderMetrics(root) {
+  // head-sampled history: [(ts, aggregated value), ...] per metric
+  const metrics = await j("/api/metrics/history");
+  let h = "<h2>Metrics</h2>";
+  const names = Object.keys(metrics).sort();
+  if (!names.length) h += "<i>no metrics reported yet</i>";
+  for (const name of names) {
+    const m = metrics[name];
+    const pts = m.points || [];
+    const last = pts.length ? pts[pts.length - 1][1] : null;
+    h += `<div><code>${esc(name)}</code> ` +
+         `<span class=muted>${esc(m.kind || "")} ` +
+         `${esc(m.description || "")} ` +
+         `${last !== null ? "now=" + Number(last).toPrecision(5) : ""}` +
+         `</span><br>${spark(pts)}</div>`;
+  }
+  root.innerHTML = h;
+}
+
+async function renderNode(root, nodeId) {
+  root.innerHTML = `<h2>Node ${esc(nodeId)}</h2><p>loading…</p>`;
+  let stats = null, logs = [];
+  try { stats = await j(`/api/node/${nodeId}/stats`); } catch (e) {}
+  try { logs = await j(`/api/node/${nodeId}/logs`); } catch (e) {}
+  let h = `<h2>Node ${esc(nodeId)}</h2><p><a href="#">&larr; overview</a></p>`;
+  if (stats) {
+    const workers = (stats.workers || []).map(w => ({...w}));
+    h += "<h3>Stats</h3><table>" +
+      Object.entries(stats).filter(([k]) => k !== "workers").map(
+        ([k, v]) => `<tr><th>${esc(k)}</th><td>${esc(
+          typeof v === "object" ? JSON.stringify(v) : v)}</td></tr>`
+      ).join("") + "</table>" +
+      "<h3>Workers</h3>" + table(workers,
+        Object.keys(workers[0] || {pid: 1}));
+  } else h += "<p class=muted>stats unavailable</p>";
+  h += "<h3>Logs</h3>" + table(logs, ["file"],
+    r => `#node/${nodeId}/log/${encodeURIComponent(r.file)}`);
+  root.innerHTML = h;
+}
+
+async function renderNodeLog(root, nodeId, file) {
+  const text = await (await fetch(
+    `/api/node/${nodeId}/logs?file=${encodeURIComponent(file)}`)).text();
+  root.innerHTML = `<h2>${esc(file)} <span class=muted>on ${esc(nodeId)}` +
+    `</span></h2><p><a href="#node/${nodeId}">&larr; node</a></p>` +
+    `<pre class=log>${esc(text)}</pre>`;
+}
+
+async function render() {
+  const root = document.getElementById("root");
+  const hash = location.hash.slice(1);
+  try {
+    const nodeLog = hash.match(/^node\\/([^/]+)\\/log\\/(.+)$/);
+    const node = hash.match(/^node\\/([^/]+)$/);
+    if (nodeLog) await renderNodeLog(root, nodeLog[1],
+                                     decodeURIComponent(nodeLog[2]));
+    else if (node) await renderNode(root, node[1]);
+    else if (hash === "metrics") await renderMetrics(root);
+    else await renderOverview(root);
+  } catch (e) { root.innerHTML = `<p>error: ${esc(e)}</p>`; }
+}
+window.addEventListener("hashchange", render);
+render(); setInterval(() => { if (!location.hash.startsWith("#node"))
+  render(); }, 5000);
+</script></body></html>
+"""

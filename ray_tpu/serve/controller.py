@@ -89,6 +89,15 @@ class ServeController:
         for n in names:
             self.delete_deployment(n)
         self._stop.set()
+        # the reconcile thread re-checks _stop before any publish, so
+        # once it drains this delete is the final word on serve status
+        self._loop.join(timeout=5.0)
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            internal_kv._internal_kv_del(b"status", namespace="serve")
+        except Exception:  # noqa: BLE001 — cluster may be tearing down
+            pass
         return True
 
     # -- queries -------------------------------------------------------------
@@ -225,6 +234,39 @@ class ServeController:
                 except Exception:
                     pass
 
+    def _publish_status(self):
+        """Snapshot deployments/routes/apps into the GCS KV (namespace
+        "serve") so the dashboard head renders serve state with a plain
+        table read — no actor RPC on a dashboard refresh (reference:
+        dashboard/modules/serve reading controller state)."""
+        import json
+
+        from ray_tpu.experimental import internal_kv
+
+        with self._lock:
+            status = {
+                "running": True,
+                "deployments": {
+                    name: {"num_replicas": len(st["replicas"]),
+                           "goal": st.get("goal_replicas", 0),
+                           "version": st["version"]}
+                    for name, st in self._deployments.items()},
+                "routes": dict(self._routes),
+                "apps": dict(self._apps),
+            }
+        # dedup BEFORE stamping the time: an idle serve cluster must not
+        # re-write the KV (and re-dirty GCS persistence) every second
+        blob = json.dumps(status).encode()
+        if blob != getattr(self, "_last_status_blob", None):
+            if self._stop.is_set():
+                # racing shutdown(): its KV delete must be the LAST write,
+                # or a stale running=true entry survives the controller
+                return
+            self._last_status_blob = blob
+            status["ts"] = time.time()
+            internal_kv._internal_kv_put(
+                b"status", json.dumps(status).encode(), namespace="serve")
+
     def _reconcile_loop(self):
         n = 0
         while not self._stop.is_set():
@@ -233,6 +275,7 @@ class ServeController:
                 self._reconcile_once()
                 if n % 10 == 9:
                     self._health_check_once()
+                self._publish_status()
             except Exception:
                 pass
             n += 1

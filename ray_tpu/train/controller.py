@@ -149,14 +149,59 @@ class TrainController:
                     per_rank[r][name] = ds
         return per_rank
 
+    # -- dashboard status ---------------------------------------------------
+    def _publish_status(self, group, status: str) -> None:
+        """Best-effort run snapshot into the GCS KV (namespace "train")
+        for the dashboard's train view (reference:
+        ``dashboard/modules/train``).  Throttled to ~1/s and deduped so
+        an idle poll loop doesn't re-dirty GCS persistence."""
+        import json
+
+        now = time.time()
+        if status == "RUNNING" and \
+                now - getattr(self, "_last_status_t", 0.0) < 1.0:
+            return
+        latest = self.metrics_history[-1] if self.metrics_history else {}
+        # terminal publishes run after group.shutdown() emptied .workers:
+        # report the last LIVE world size, not 0
+        world = len(group.workers) if group and group.workers else \
+            getattr(self, "_last_world_size", 0)
+        snap = {
+            "name": self.name, "status": status,
+            "world_size": world,
+            "iteration": latest.get("training_iteration"),
+            "latest_metrics": {
+                k: v for k, v in latest.items()
+                if isinstance(v, (int, float, str))},
+            "restarts": self._ctx.errors_seen,
+            "started_at": getattr(self, "_started_at", 0.0),
+        }
+        blob = json.dumps(snap, default=str).encode()
+        if status == "RUNNING" and \
+                blob == getattr(self, "_last_status_blob", None):
+            return
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            internal_kv._internal_kv_put(
+                self.name.encode(), blob, namespace="train")
+            self._last_status_t = now
+            self._last_status_blob = blob
+        except Exception:  # noqa: BLE001 — dashboard view is best-effort
+            pass
+
     # -- control loop ------------------------------------------------------
     def run(self) -> Result:
+        self._started_at = time.time()
         group = self._start_group()
+        self._last_world_size = len(group.workers)
         error: Optional[BaseException] = None
         try:
             while True:
+                self._last_world_size = len(group.workers)
                 statuses = group.poll()
                 self._collect_results(statuses)
+                self._publish_status(group, "RUNNING")
 
                 errs = [s for s in statuses if s.error]
                 if errs:
@@ -179,8 +224,15 @@ class TrainController:
                 if all(s.finished for s in statuses):
                     break
                 time.sleep(self.poll_interval_s)
+        except BaseException as e:  # noqa: BLE001 — status must not lie
+            # an exception propagating out (e.g. restart retries
+            # exhausted) is a FAILED run even though no break set `error`
+            error = e
+            raise
         finally:
             group.shutdown()
+            self._publish_status(
+                group, "FAILED" if error is not None else "FINISHED")
 
         return Result(
             metrics=self.metrics_history[-1] if self.metrics_history else None,
