@@ -13,8 +13,6 @@ Run:
         python examples/multiprocess_distributed_train.py
 """
 
-import numpy as np
-
 import ray_tpu
 from ray_tpu import train
 
@@ -31,7 +29,7 @@ def loop(config):
     # join the multi-process jax runtime (no-op for 1-worker runs)
     train.initialize_jax_distributed()
     ctx = train.get_context()
-    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+    rank = ctx.get_world_rank()
     nloc = len(jax.local_devices())
     mesh = Mesh(np.asarray(jax.devices()), ("dp",))
 

@@ -887,6 +887,13 @@ class GcsServer:
             "node_id": None,
             "worker_id": None,
             "class_name": spec.function.qualname,
+            # composed handle metadata: reflection results from the meta
+            # dict, queueing flags from their first-class spec fields
+            "handle_meta": {
+                **(getattr(spec, "actor_handle_meta", None) or {}),
+                "is_async": spec.is_async_actor,
+                "max_concurrency": spec.max_concurrency,
+            },
             "start_time": time.time(),
         }
         self._publish("actors", {"event": "actor_registered", "actor_id": actor_id})

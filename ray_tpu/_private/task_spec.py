@@ -85,6 +85,11 @@ class TaskSpec:
     # group routing the task ("" = the default group)
     concurrency_groups: Optional[Dict[str, int]] = None
     concurrency_group: str = ""
+    # handle reconstruction metadata (method names/options, async flag):
+    # stored by the GCS at creation so get_actor(name) returns a FULLY
+    # functional handle, not a degraded default one (reference: named
+    # actor handles behave identically to the original)
+    actor_handle_meta: Optional[Dict[str, Any]] = None
     actor_name: str = ""
     namespace: str = ""
     runtime_env: Optional[Dict[str, Any]] = None

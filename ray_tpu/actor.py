@@ -247,6 +247,13 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", config.actor_max_restarts_default),
             max_concurrency=max_concurrency,
             concurrency_groups=groups,
+            # only the class-reflection results ride the meta dict —
+            # is_async/max_concurrency already live on the spec itself
+            # (one source of truth; the GCS composes the full handle meta)
+            actor_handle_meta={
+                "method_names": (method_names := self._method_names()),
+                "method_options": (method_options := self._method_options()),
+            },
             runtime_env=self._packaged_runtime_env(worker),
             is_async_actor=is_async,
             actor_name=name,
@@ -259,8 +266,8 @@ class ActorClass:
                          + list(nested_refs))
         worker.hold_actor_creation_refs(
             actor_id, creation_refs, until_dead=spec.max_restarts != 0)
-        return ActorHandle(actor_id, self._cls.__qualname__, is_async, max_concurrency,
-                           self._method_names(), self._method_options())
+        return ActorHandle(actor_id, self._cls.__qualname__, is_async,
+                           max_concurrency, method_names, method_options)
 
 
 def asyncio_iscoroutinefunction(fn) -> bool:
@@ -283,10 +290,16 @@ def get_actor_or_none(name: str, namespace: Optional[str] = None) -> Optional[Ac
     info = worker.run_coro(
         worker.gcs.call("get_actor_info", actor_id=actor_id_bytes)
     )
-    # async/max_concurrency flags affect only server-side queueing; the actor
-    # worker knows its own mode, so defaults here are safe for dispatch.
-    return ActorHandle(ActorID(actor_id_bytes), info.get("class_name", "Actor"),
-                       False, 1, ())
+    # reconstruct the FULL handle from creation-time metadata: method
+    # names/options (e.g. @method(concurrency_group=...) defaults) and
+    # the async/max_concurrency flags all survive a by-name lookup
+    meta = info.get("handle_meta") or {}
+    return ActorHandle(
+        ActorID(actor_id_bytes), info.get("class_name", "Actor"),
+        bool(meta.get("is_async", False)),
+        int(meta.get("max_concurrency", 1)),
+        tuple(meta.get("method_names", ())),
+        dict(meta.get("method_options") or {}))
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

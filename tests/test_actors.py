@@ -422,3 +422,33 @@ class TestConcurrencyGroups:
         with pytest.raises(ValueError, match="default"):
             ray_tpu.remote(concurrency_groups={"default": 2})(type(
                 "T", (), {})).remote()
+
+
+def test_get_actor_returns_full_handle(ray_start):
+    """Round 5: a by-name lookup reconstructs the FULL handle — method
+    names validate, @method defaults (e.g. concurrency_group) apply, and
+    the async flag survives (previously the lookup returned a degraded
+    default handle)."""
+
+    @ray_tpu.remote(concurrency_groups={"fast": 2}, name="full-handle")
+    class Svc:
+        @ray_tpu.method(concurrency_group="fast")
+        def ping(self):
+            return "pong"
+
+        async def aping(self):
+            return "apong"
+
+    orig = Svc.remote()
+    ray_tpu.get(orig.ping.remote(), timeout=30)
+
+    h = ray_tpu.get_actor("full-handle")
+    # method-name validation works (not an empty tuple anymore)
+    with pytest.raises(AttributeError):
+        h.no_such_method  # noqa: B018
+    # @method concurrency_group default rides the looked-up handle
+    assert h.ping._options.get("concurrency_group") == "fast"
+    assert ray_tpu.get(h.ping.remote(), timeout=30) == "pong"
+    assert ray_tpu.get(h.aping.remote(), timeout=30) == "apong"
+    assert h._is_async is True
+    ray_tpu.kill(orig)
