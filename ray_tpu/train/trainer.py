@@ -115,20 +115,21 @@ def initialize_jax_distributed() -> None:
     import jax
 
     from ray_tpu.util.collective.collective_group.xla_group import (
-        ensure_cpu_collectives_backend,
+        ensure_jax_distributed,
     )
 
-    ensure_cpu_collectives_backend()
     expected = int(os.environ["JAX_NUM_PROCESSES"])
-    try:
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=expected,
-            process_id=int(os.environ["JAX_PROCESS_ID"]),
-        )
-    except RuntimeError as e:
-        if "already" not in str(e):
-            raise
+    proc_id = int(os.environ["JAX_PROCESS_ID"])
+    ensure_jax_distributed(addr, expected, proc_id)
+    # an INHERITED runtime (tolerated above) must carry THIS worker's
+    # rank: a reused process whose earlier world gave it a different id
+    # would place this host's data at the wrong global rows — silently
+    # wrong training, not an error
+    if jax.process_index() != proc_id:
+        raise RuntimeError(
+            f"jax.distributed process_index {jax.process_index()} != "
+            f"assigned trainer rank {proc_id}: this worker process "
+            "inherited a runtime formed under a different rank")
     # some PJRT plugins take the client's process count from the device
     # topology and quietly ignore the coordination service — each worker
     # would then train an INDEPENDENT copy with no gradient exchange, a

@@ -50,6 +50,36 @@ def ensure_cpu_collectives_backend() -> None:
         pass
 
 
+def ensure_jax_distributed(coordinator_address: str, num_processes: int,
+                           process_id: int) -> None:
+    """``jax.distributed.initialize`` that tolerates a runtime this
+    process ALREADY formed (a JaxTrainer worker joining a collective
+    group, or a second group in the same actor).  jax raises two
+    different errors for that state — "already initialized" and, once
+    any computation touched the backend, "must be called before any JAX
+    calls" — both are acceptable ONLY when a distributed client is in
+    fact live; callers still validate world size and rank afterwards."""
+    ensure_cpu_collectives_backend()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        return
+    except RuntimeError as e:
+        msg = str(e)
+        if "already" in msg:
+            return
+        if "before any JAX" in msg:
+            try:
+                from jax._src import distributed as _dist
+
+                if _dist.global_state.client is not None:
+                    return
+            except Exception:  # noqa: BLE001 — private-API drift
+                pass
+        raise
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     from ray_tpu.ops.attention import _shard_map as sm
 
@@ -227,18 +257,10 @@ class XlaDistributedGroup(BaseGroup):
                 time.sleep(0.05)
             if addr is None:
                 raise TimeoutError("coordinator address never published")
-        ensure_cpu_collectives_backend()
-        try:
-            jax.distributed.initialize(
-                coordinator_address=addr, num_processes=world_size,
-                process_id=rank,
-            )
-        except RuntimeError as e:
-            # tolerate a runtime already formed by this process (e.g. a
-            # JaxTrainer worker that ran initialize_jax_distributed);
-            # the checks below still validate the world AND the rank
-            if "already" not in str(e):
-                raise
+        # tolerates a runtime already formed by this process (a JaxTrainer
+        # worker, or an earlier group); the checks below still validate
+        # the world AND the rank against this group's declaration
+        ensure_jax_distributed(addr, world_size, rank)
         if jax.process_index() != rank:
             # an inherited runtime whose process id differs from this
             # group's rank would silently permute every rank-indexed op

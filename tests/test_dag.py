@@ -796,6 +796,47 @@ class TestXlaMeshDagCollective:
         finally:
             compiled.teardown()
 
+    def test_multi_actor_device_plane_allgather_reducescatter(self):
+        from ray_tpu.dag.collective_node import allgather, reducescatter
+
+        @ray_tpu.remote
+        class Rank:
+            def __init__(self, val):
+                self.val = float(val)
+
+            def vec(self, _x):
+                import numpy as np
+
+                return np.full((2,), self.val, np.float32)
+
+            def arange(self, _x):
+                import numpy as np
+
+                return np.arange(4, dtype=np.float32)
+
+            def out(self, x):
+                import numpy as np
+
+                return np.asarray(x).reshape(-1).tolist()
+
+        a, b = Rank.remote(1), Rank.remote(2)
+        with InputNode() as inp:
+            g0, g1 = allgather.bind([a.vec.bind(inp), b.vec.bind(inp)],
+                                    backend="xla")
+            r0, r1 = reducescatter.bind(
+                [a.arange.bind(inp), b.arange.bind(inp)], backend="xla")
+            dag = MultiOutputNode([a.out.bind(g0), b.out.bind(g1),
+                                   a.out.bind(r0), b.out.bind(r1)])
+        compiled = dag.experimental_compile()
+        try:
+            ga, gb, ra, rb = compiled.execute(0).get(timeout=120)
+            # allgather: both ranks see [rank1 vec, rank2 vec]
+            assert ga == gb == [1.0, 1.0, 2.0, 2.0], (ga, gb)
+            # reducescatter of 2x arange(4): rank r gets its 2-chunk x2
+            assert ra == [0.0, 2.0] and rb == [4.0, 6.0], (ra, rb)
+        finally:
+            compiled.teardown()
+
     def test_xla_mesh_rejects_multi_actor(self):
         from ray_tpu.dag.collective_node import allreduce
 
