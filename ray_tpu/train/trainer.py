@@ -112,32 +112,15 @@ def initialize_jax_distributed() -> None:
     addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if not addr:
         return
-    import jax
-
     from ray_tpu.util.collective.collective_group.xla_group import (
         ensure_jax_distributed,
     )
 
-    expected = int(os.environ["JAX_NUM_PROCESSES"])
-    proc_id = int(os.environ["JAX_PROCESS_ID"])
-    ensure_jax_distributed(addr, expected, proc_id)
-    # an INHERITED runtime (tolerated above) must carry THIS worker's
-    # rank: a reused process whose earlier world gave it a different id
-    # would place this host's data at the wrong global rows — silently
-    # wrong training, not an error
-    if jax.process_index() != proc_id:
-        raise RuntimeError(
-            f"jax.distributed process_index {jax.process_index()} != "
-            f"assigned trainer rank {proc_id}: this worker process "
-            "inherited a runtime formed under a different rank")
-    # some PJRT plugins take the client's process count from the device
-    # topology and quietly ignore the coordination service — each worker
-    # would then train an INDEPENDENT copy with no gradient exchange, a
-    # silently-wrong result far worse than an error
-    if jax.process_count() != expected:
-        raise RuntimeError(
-            f"jax.distributed formed {jax.process_count()} process(es), "
-            f"expected {expected}: platform {jax.default_backend()!r} did "
-            "not honor multi-process initialization on this host")
+    # the helper validates the resulting world size AND this worker's
+    # rank (a PJRT plugin quietly ignoring multi-process init, or an
+    # inherited runtime under a different rank, both fail loudly here
+    # instead of training silently-wrong independent/permuted copies)
+    ensure_jax_distributed(addr, int(os.environ["JAX_NUM_PROCESSES"]),
+                           int(os.environ["JAX_PROCESS_ID"]))
 
 

@@ -58,26 +58,41 @@ def ensure_jax_distributed(coordinator_address: str, num_processes: int,
     different errors for that state — "already initialized" and, once
     any computation touched the backend, "must be called before any JAX
     calls" — both are acceptable ONLY when a distributed client is in
-    fact live; callers still validate world size and rank afterwards."""
+    fact live.  The tolerance is safe by construction: the live world
+    is validated against the requested (num_processes, process_id)
+    before returning — an inherited runtime under a different rank
+    would silently place this host's data at the wrong global rows."""
     ensure_cpu_collectives_backend()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
-        return
     except RuntimeError as e:
         msg = str(e)
-        if "already" in msg:
-            return
-        if "before any JAX" in msg:
+        tolerated = "already" in msg
+        if not tolerated and "before any JAX" in msg:
             try:
                 from jax._src import distributed as _dist
 
-                if _dist.global_state.client is not None:
-                    return
+                tolerated = _dist.global_state.client is not None
             except Exception:  # noqa: BLE001 — private-API drift
-                pass
-        raise
+                tolerated = False
+        if not tolerated:
+            raise
+    # some PJRT plugins take the client's process count from the device
+    # topology and quietly ignore the coordination service — each worker
+    # would then train an INDEPENDENT copy with no gradient exchange
+    if jax.process_count() != num_processes:
+        raise RuntimeError(
+            f"jax.distributed formed {jax.process_count()} process(es), "
+            f"expected {num_processes}: platform "
+            f"{jax.default_backend()!r} did not honor multi-process "
+            "initialization on this host")
+    if jax.process_index() != process_id:
+        raise RuntimeError(
+            f"jax.distributed process_index {jax.process_index()} != "
+            f"assigned rank {process_id}: this process inherited a "
+            "runtime formed under a different rank")
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -258,18 +273,9 @@ class XlaDistributedGroup(BaseGroup):
             if addr is None:
                 raise TimeoutError("coordinator address never published")
         # tolerates a runtime already formed by this process (a JaxTrainer
-        # worker, or an earlier group); the checks below still validate
-        # the world AND the rank against this group's declaration
+        # worker, or an earlier group); the helper validates the live
+        # world and rank against this group's declaration
         ensure_jax_distributed(addr, world_size, rank)
-        if jax.process_index() != rank:
-            # an inherited runtime whose process id differs from this
-            # group's rank would silently permute every rank-indexed op
-            # (broadcast src, send/recv peers, the rank's global row)
-            raise RuntimeError(
-                f"jax.distributed process_index {jax.process_index()} != "
-                f"collective rank {rank} for group {group_name!r}: the "
-                "existing runtime's process id must match the rank the "
-                "group was created with")
         by_proc: dict = {}
         for d in jax.devices():
             by_proc.setdefault(d.process_index, d)

@@ -198,7 +198,7 @@ def test_nested_ref_not_ttl_dependent(ray_isolated, monkeypatch):
     del got
     del outer
     gc.collect()
-    deadline = time.time() + 10
+    deadline = time.time() + 30  # generous: GC propagation under full-suite load
     while time.time() < deadline:
         w.run_coro(_drain_and_sweep(w))
         if w.shared_store.get_buffer(inner_oid) is None \
@@ -223,7 +223,7 @@ def test_dropping_refs_frees_store(ray_isolated):
     assert worker.shared_store.get_buffer(oid) is not None
     del ref
     gc.collect()
-    deadline = time.time() + 10
+    deadline = time.time() + 30  # generous: GC propagation under full-suite load
     while time.time() < deadline:
         if worker.shared_store.get_buffer(oid) is None:
             break
@@ -244,7 +244,7 @@ def test_task_return_freed_after_drop(ray_isolated):
     oid = ref.id
     del ref
     gc.collect()
-    deadline = time.time() + 10
+    deadline = time.time() + 30  # generous: GC propagation under full-suite load
     while time.time() < deadline:
         if worker.shared_store.get_buffer(oid) is None:
             break
