@@ -33,19 +33,65 @@ from typing import Any, Dict, List, Optional, Tuple
 _leaked_segments: List = []
 
 
-def _untrack(seg: shared_memory.SharedMemory):
-    """Stop multiprocessing.resource_tracker from auto-unlinking this segment.
+# ``SharedMemory(...)`` that never registers with the resource tracker.
+# register-then-unregister is NOT equivalent: sibling workers forked from
+# one zygote share a tracker daemon whose per-type cache is a SET, so two
+# attachers' registrations collapse to one entry and the second
+# unregister makes the daemon print ``KeyError: '/rtpu_...'`` at
+# teardown.  3.13+ has ``track=False``; on 3.12 the register/unregister
+# calls inside __init__/unlink are suppressed under a lock.  Known 3.12
+# tradeoff: the suppression patches the process-global tracker functions
+# for the constructor/unlink duration, so a THIRD-PARTY thread creating
+# its own shm/semaphore in exactly that window would lose tracking —
+# accepted as a narrow race with no cleaner seam before ``track=``.
 
-    The framework's raylet/session owns shm cleanup (reference: plasma store
-    teardown), not Python's per-process resource tracker — which would unlink
-    objects still in use by other workers and spam warnings at exit.
-    """
+_shm_track_lock = threading.Lock()
+
+
+def _shm_has_track_kwarg() -> bool:
+    import inspect
+
     try:
+        return "track" in inspect.signature(
+            shared_memory.SharedMemory.__init__).parameters
+    except (TypeError, ValueError):  # pragma: no cover — C signature
+        return False
+
+
+class _UntrackedSharedMemory(shared_memory.SharedMemory):
+    """Python <= 3.12 path: registration suppressed; ``unlink()``'s
+    unconditional unregister suppressed to match (class-level methods —
+    an instance-bound override would create a __dict__ cycle that defers
+    ``__del__`` cleanup of multi-GB mappings to the cyclic GC)."""
+
+    def __init__(self, *args, **kwargs):
         from multiprocessing import resource_tracker
 
-        resource_tracker.unregister(seg._name, "shared_memory")
-    except Exception:
-        pass
+        with _shm_track_lock:
+            orig = resource_tracker.register
+            resource_tracker.register = lambda *_a, **_k: None
+            try:
+                super().__init__(*args, **kwargs)
+            finally:
+                resource_tracker.register = orig
+
+    def unlink(self):
+        from multiprocessing import resource_tracker
+
+        with _shm_track_lock:
+            orig = resource_tracker.unregister
+            resource_tracker.unregister = lambda *_a, **_k: None
+            try:
+                super().unlink()
+            finally:
+                resource_tracker.unregister = orig
+
+
+if _shm_has_track_kwarg():
+    def open_shm(*args, **kwargs) -> shared_memory.SharedMemory:
+        return shared_memory.SharedMemory(*args, track=False, **kwargs)
+else:
+    open_shm = _UntrackedSharedMemory
 
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
@@ -80,14 +126,12 @@ class SharedObjectStore:
         """Create the segment and let ``write_fn(view)`` fill it in place."""
         name = shm_name_for(object_id)
         try:
-            seg = shared_memory.SharedMemory(name=name, create=True, size=max(1, nbytes))
-            _untrack(seg)
+            seg = open_shm(name=name, create=True, size=max(1, nbytes))
         except FileExistsError:
             # Object already stored (e.g. deterministic re-execution); reuse.
             with self._lock:
                 if object_id not in self._segments:
-                    seg = shared_memory.SharedMemory(name=name)
-                    _untrack(seg)
+                    seg = open_shm(name=name)
                     self._segments[object_id] = seg
             return name
         write_fn(seg.buf[:nbytes] if nbytes else seg.buf)
@@ -116,9 +160,7 @@ class SharedObjectStore:
         """
         final = shm_name_for(object_id)
         staging = f"{final}_stg{os.getpid()}"
-        seg = shared_memory.SharedMemory(name=staging, create=True,
-                                         size=max(1, nbytes))
-        _untrack(seg)
+        seg = open_shm(name=staging, create=True, size=max(1, nbytes))
         with self._lock:
             self._staging[object_id] = seg
 
@@ -152,8 +194,7 @@ class SharedObjectStore:
             seg = self._segments.get(object_id)
         if seg is None:
             try:
-                seg = shared_memory.SharedMemory(name=shm_name_for(object_id))
-                _untrack(seg)
+                seg = open_shm(name=shm_name_for(object_id))
             except FileNotFoundError:
                 return None
             with self._lock:
@@ -230,7 +271,7 @@ class SharedObjectStore:
                 pass
         try:
             if seg is None:
-                seg = shared_memory.SharedMemory(name=shm_name_for(object_id))
+                seg = open_shm(name=shm_name_for(object_id))
             seg.close()
             seg.unlink()
         except FileNotFoundError:
@@ -268,7 +309,7 @@ class SharedObjectStore:
         if unlink_created:
             for oid in created:
                 try:
-                    shared_memory.SharedMemory(name=shm_name_for(oid)).unlink()
+                    open_shm(name=shm_name_for(oid)).unlink()
                 except Exception:
                     pass
 

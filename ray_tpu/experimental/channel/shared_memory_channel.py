@@ -27,7 +27,6 @@ from __future__ import annotations
 import struct
 import time
 import uuid
-from multiprocessing import shared_memory
 from typing import Any, List, Optional
 
 _U64 = struct.Struct("<Q")
@@ -57,7 +56,7 @@ _TSO = platform.machine().lower() in ("x86_64", "amd64", "i686", "i386")
 
 # resource_tracker would unlink segments when *any* process exits; channel
 # lifetime is owned by the compiled DAG (same reasoning as the object store)
-from ray_tpu._private.object_store import _untrack  # noqa: E402
+from ray_tpu._private.object_store import open_shm  # noqa: E402
 
 
 def _native_lib():
@@ -120,9 +119,7 @@ class Channel:
         total = _HDR + 8 * num_readers + buffer_size
         lib = _native_lib()
         if _create:
-            self._seg = shared_memory.SharedMemory(
-                name=self.name, create=True, size=total)
-            _untrack(self._seg)
+            self._seg = open_shm(name=self.name, create=True, size=total)
             self._seg.buf[:_HDR + 8 * num_readers] = b"\x00" * (
                 _HDR + 8 * num_readers)
             # The creator fixes the channel's data-plane mode for all peers
@@ -131,8 +128,7 @@ class Channel:
             flags = num_readers | (_NATIVE_BIT if lib else 0)
             _U64.pack_into(self._seg.buf, 16, flags)
         else:
-            self._seg = shared_memory.SharedMemory(name=self.name)
-            _untrack(self._seg)
+            self._seg = open_shm(name=self.name)
         native_mode = bool(_U64.unpack_from(self._seg.buf, 16)[0]
                            & _NATIVE_BIT)
         # Native data plane (atomics + futex waits) over the same segment;
