@@ -45,7 +45,12 @@ _leaked_segments: List = []
 # its own shm/semaphore in exactly that window would lose tracking —
 # accepted as a narrow race with no cleaner seam before ``track=``.
 
-_shm_track_lock = threading.Lock()
+# RLock: CPython 3.12's SharedMemory.__init__ calls self.unlink() in its
+# own OSError handler (ENOSPC/ENOMEM on a full /dev/shm), so the patched
+# unlink re-enters while __init__ still holds the lock — a plain Lock
+# would self-deadlock the whole process's shm path exactly when the
+# store is out of memory
+_shm_track_lock = threading.RLock()
 
 
 def _shm_has_track_kwarg() -> bool:

@@ -325,6 +325,98 @@ def test_external_store_client_roundtrip(tmp_path):
         proc.wait(timeout=10)
 
 
+def test_external_store_killed_and_restarted_midrun(tmp_path):
+    """The store process is SIGKILLed while the GCS is live, then
+    restarted on the same port: the sync client reconnects, the WAL
+    cursor resyncs (offset-checked appends reject nothing), and
+    mutations made DURING the outage are journaled once the store is
+    back — a fresh GCS then restores them."""
+    import socket
+
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+
+    session = _mk_session(str(tmp_path))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    def start_store():
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.gcs_store",
+             "--port", str(port),
+             "--path", os.path.join(str(tmp_path), "store.pkl")],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        line = p.stdout.readline().decode().strip()
+        assert line.startswith("GCS_STORE_ADDR "), line
+        return p, line.split(" ", 1)[1]
+
+    store_proc, addr = start_store()
+    config.reload({"gcs_storage": "external",
+                   "gcs_external_store_addr": addr})
+    try:
+        loop = asyncio.new_event_loop()
+
+        async def run():
+            nonlocal store_proc
+            gcs = GcsServer(session)
+            await gcs.start(port=0)
+            await gcs.handle_kv_put(ns="t", key="before", value=b"1")
+            # wait until the pre-kill mutation is durable in the store
+            from ray_tpu._private.gcs_store import ExternalStoreClient
+
+            probe = ExternalStoreClient(addr)
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if probe.read_snapshot() or probe.wal_size() > 0:
+                    break
+                await asyncio.sleep(0.2)
+            probe.close()
+            # SIGKILL the store; the GCS must stay healthy (persistence
+            # retries quietly off the event loop)
+            store_proc.kill()
+            store_proc.wait(timeout=10)
+            await gcs.handle_kv_put(ns="t", key="during", value=b"2")
+            await asyncio.sleep(1.5)  # a few failed persist ticks
+            assert await gcs.handle_kv_get(ns="t", key="during") == b"2"
+            # restart the store on the SAME port (its own disk restores)
+            store_proc, addr2 = start_store()
+            assert addr2 == addr
+            # the outage-window mutation must become durable
+            probe = ExternalStoreClient(addr)
+            deadline = time.time() + 30
+            ok = False
+            while time.time() < deadline:
+                wal = probe.wal_read()
+                snap = probe.read_snapshot() or b""
+                if b"during" in wal or b"during" in snap:
+                    ok = True
+                    break
+                await asyncio.sleep(0.3)
+            probe.close()
+            assert ok, "outage-window mutation never reached the store"
+            await gcs.stop()
+
+        loop.run_until_complete(run())
+
+        async def verify():
+            gcs2 = GcsServer(session)  # restores via the external store
+            assert await gcs2.handle_kv_get(ns="t", key="before") == b"1"
+            assert await gcs2.handle_kv_get(ns="t", key="during") == b"2"
+
+        loop.run_until_complete(verify())
+        loop.close()
+    finally:
+        config.reload()
+        try:
+            store_proc.kill()
+            store_proc.wait(timeout=10)
+        except Exception:
+            pass
+
+
 def test_gcs_restart_from_external_store_head_disk_lost(no_cluster,
                                                         tmp_path):
     """The Redis-for-GCS-FT role (reference redis_store_client.h:111):
