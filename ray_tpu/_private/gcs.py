@@ -273,6 +273,21 @@ class GcsServer:
         self._last_snapshot = blob
         self._gc_blobs(kv_state)
 
+    def _compact_locked(self, blob, kv_state, prepared_against) -> bool:
+        """Blocking (executor) side of a compaction.  ``prepared_against``
+        is the ``_last_snapshot`` identity observed when the blob was
+        prepared: if another snapshot landed since (``stop()``'s final
+        ``_write_snapshot`` racing this executor job), committing ours
+        would roll state back and the truncate would orphan the journal
+        extending the newer snapshot — skip both."""
+        with self._persist_io_lock:
+            if self._last_snapshot is not prepared_against:
+                return False
+            if blob is not None:
+                self._commit_snapshot(blob, kv_state)
+            self._wal_truncate()
+            return True
+
     def _write_snapshot(self):
         # the lock spans PREPARE too: _ensure_blob consults
         # _known_blob_names, which an in-flight executor job's blob GC
@@ -555,16 +570,12 @@ class GcsServer:
                     # compaction: one full snapshot, then a fresh WAL
                     # under the bumped generation
                     blob, kv_state = self._prepare_snapshot()
-
-                    def _compact():
-                        with self._persist_io_lock:
-                            if blob is not None:
-                                self._commit_snapshot(blob, kv_state)
-                            self._wal_truncate()
-
-                    await loop.run_in_executor(None, _compact)
-                    self._persist_gen += 1
-                    self._last_full_snapshot_t = now
+                    prepared_against = self._last_snapshot
+                    if await loop.run_in_executor(
+                            None, self._compact_locked, blob, kv_state,
+                            prepared_against):
+                        self._persist_gen += 1
+                        self._last_full_snapshot_t = now
                 elif full_due:
                     self._last_full_snapshot_t = now  # nothing to fold
                 else:
@@ -1338,6 +1349,18 @@ class GcsServer:
         self._stopping = True
         for t in self._tasks:
             t.cancel()
+        # the persist loop's in-flight executor job (_compact/_wal_append)
+        # survives the cancel — settle the loop tasks first so the final
+        # snapshot below serializes AFTER it instead of racing it (the
+        # _compact_locked staleness guard is the backstop for the
+        # executor side); one bound for the whole settle, not per task
+        if self._tasks:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*self._tasks, return_exceptions=True),
+                    5.0)
+            except Exception:  # noqa: BLE001
+                pass
         if self._persist_enabled:
             try:  # final snapshot: a clean stop must not lose the last
                 self._write_snapshot()  # debounce window of mutations

@@ -33,9 +33,13 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional
 
+from ray_tpu._private import resilience
+from ray_tpu.util.fault_injection import fault_point
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
+_WAL_FRAME = struct.Struct("<I")
 
 
 class StoreClient(abc.ABC):
@@ -113,17 +117,53 @@ class FileStoreClient(StoreClient):
     def _wal_path(self) -> str:
         return self.path + ".wal"
 
-    def wal_size(self) -> int:
-        if self._wal_file is not None:
-            return self._wal_file.tell()
+    @staticmethod
+    def _scan_whole_frames(data: bytes) -> int:
+        """Byte length of the longest prefix of ``data`` made of whole
+        ``<I>``-framed records (the GCS journal framing).  Everything
+        past it is a torn tail from a writer killed mid-``write``."""
+        off = 0
+        while off + _WAL_FRAME.size <= len(data):
+            (ln,) = _WAL_FRAME.unpack_from(data, off)
+            if off + _WAL_FRAME.size + ln > len(data):
+                break
+            off += _WAL_FRAME.size + ln
+        return off
+
+    def _open_wal(self):
+        """Open the journal for append, first truncating any torn tail
+        record (writer SIGKILLed mid-frame): an acked append must only
+        ever land after WHOLE records, or the offset-checked cursor
+        would ack bytes that replay then discards — a silently lost
+        acked record."""
+        path = self._wal_path()
         try:
-            return os.path.getsize(self._wal_path())
+            with open(path, "rb") as f:
+                data = f.read()
         except OSError:
-            return 0
+            data = b""
+        good = self._scan_whole_frames(data)
+        if good != len(data):
+            logger.warning(
+                "wal torn tail: truncating %d -> %d bytes", len(data), good)
+            with open(path, "r+b") as f:
+                f.truncate(good)
+        self._wal_file = open(path, "ab")
+
+    def wal_size(self) -> int:
+        # the cursor must never account for a torn tail (dead bytes the
+        # open-for-append scan drops), so size queries go through the
+        # same open+repair path instead of a raw getsize
+        if self._wal_file is None:
+            if not os.path.exists(self._wal_path()):
+                return 0
+            self._open_wal()
+        return self._wal_file.tell()
 
     def wal_append(self, data: bytes, at: Optional[int] = None) -> None:
+        fault_point("gcs_store.wal_append")
         if self._wal_file is None:
-            self._wal_file = open(self._wal_path(), "ab")
+            self._open_wal()
         if at is not None:
             size = self._wal_file.tell()
             if size != at:
@@ -205,7 +245,14 @@ class ExternalStoreClient(StoreClient):
     plain blocking socket — the GCS persistence engine runs from both
     sync (__init__ restore) and async (persist loop) contexts, and these
     calls are small and head-local, so a dedicated event loop would buy
-    nothing.  Reconnects once per call on a broken connection."""
+    nothing.  Reconnects with bounded backoff on a broken connection
+    (``resilience.retry_call``); the reply is unpickled INSIDE the retry
+    scope but a server-reported error is raised OUTSIDE it, so a
+    server-side disk-full OSError surfaces as itself instead of being
+    retried into ``ConnectionError('store unreachable')``."""
+
+    RETRY_POLICY = resilience.RetryPolicy(
+        max_attempts=4, base_delay_s=0.05, max_delay_s=1.0)
 
     def __init__(self, addr: str, *, timeout_s: float = 30.0):
         if addr.startswith("tcp:"):
@@ -225,34 +272,47 @@ class ExternalStoreClient(StoreClient):
 
     def _call(self, method: str, **kwargs) -> Any:
         with self._lock:
-            last_err: Optional[Exception] = None
-            for attempt in range(2):
+            try:
+                reply = resilience.retry_call(
+                    self._transport_roundtrip, method, kwargs,
+                    policy=self.RETRY_POLICY, site="gcs_store.call")
+            except (OSError, EOFError) as e:
+                raise ConnectionError(
+                    f"gcs external store unreachable at "
+                    f"{self._host}:{self._port}: {e!r}") from e
+        # SERVER-reported errors raise outside the retry scope: the call
+        # reached the store and executed — a disk-full OSError from the
+        # store's own write is an application error, not transport loss,
+        # and re-sending it would double-apply non-idempotent mutations
+        if not reply.get("ok"):
+            err = reply.get("error")
+            raise err if isinstance(err, Exception) else RuntimeError(
+                f"store call {method} failed: {err!r}")
+        return reply.get("result")
+
+    def _transport_roundtrip(self, method: str, kwargs: Dict) -> Dict:
+        """One connect+send+recv+unpickle attempt; any failure in here is
+        transport loss (the socket is torn down so the retry reconnects)."""
+        fault_point("gcs_store.call")
+        try:
+            if self._sock is None:
+                self._sock = self._connect()
+            self._req_id += 1
+            payload = pickle.dumps(
+                {"method": method, "req_id": self._req_id,
+                 "kwargs": kwargs}, protocol=5)
+            self._sock.sendall(_LEN.pack(len(payload)) + payload)
+            hdr = self._recvn(_LEN.size)
+            (ln,) = _LEN.unpack(hdr)
+            return pickle.loads(self._recvn(ln))
+        except (OSError, EOFError):
+            if self._sock is not None:
                 try:
-                    if self._sock is None:
-                        self._sock = self._connect()
-                    self._req_id += 1
-                    payload = pickle.dumps(
-                        {"method": method, "req_id": self._req_id,
-                         "kwargs": kwargs}, protocol=5)
-                    self._sock.sendall(_LEN.pack(len(payload)) + payload)
-                    hdr = self._recvn(_LEN.size)
-                    (ln,) = _LEN.unpack(hdr)
-                    reply = pickle.loads(self._recvn(ln))
-                    if not reply.get("ok"):
-                        raise reply.get("error") or RuntimeError(
-                            f"store call {method} failed")
-                    return reply.get("result")
-                except (OSError, EOFError) as e:
-                    last_err = e
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-            raise ConnectionError(
-                f"gcs external store unreachable at "
-                f"{self._host}:{self._port}: {last_err!r}")
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            raise
 
     def _recvn(self, n: int) -> bytes:
         assert self._sock is not None

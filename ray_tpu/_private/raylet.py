@@ -102,6 +102,15 @@ class Raylet:
 
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.idle: deque = deque()
+        # lease_token -> leased WorkerHandle: lets an owner whose
+        # lease_worker reply was lost mid-socket release the grant it
+        # never received (release_lease_token) instead of stranding the
+        # worker's resources forever; entries drop with the lease
+        self._lease_tokens: Dict[str, "WorkerHandle"] = {}
+        # tokens released BEFORE their (still in-flight) grant landed —
+        # the pump refuses to grant a tombstoned token's waiter, closing
+        # the release-beats-delayed-grant race; bounded FIFO
+        self._released_tokens: Dict[str, float] = {}
         self._spawned_procs: Dict[int, Any] = {}
         self._register_waiters: deque = deque()  # futures for newly registered workers
         self._lease_waiters: deque = deque()  # (demand, pg, bundle, future)
@@ -805,6 +814,8 @@ class Raylet:
         label_selector: Optional[Dict[str, str]] = None,
         owner_addr: str = "",
         dedicated: bool = False,
+        avoid_node_ids: Optional[List[str]] = None,
+        lease_token: Optional[str] = None,
     ) -> Dict:
         demand = ResourceSet(resources)
         if pg_id is not None:
@@ -849,8 +860,8 @@ class Raylet:
                                                     demand)
             if target != self.node_id:
                 addr = self._addr_of(target) or (await self._gcs_node_addr(target))
-                return {"spillback": addr}
-            return await self._grant_local(demand, pg_id, bundle_index, dedicated, owner_addr)
+                return {"spillback": addr, "spillback_node": target}
+            return await self._grant_local(demand, pg_id, bundle_index, dedicated, owner_addr, lease_token)
 
         pick = scheduling.pick_node(
             self._node_views(),
@@ -861,6 +872,10 @@ class Raylet:
             soft=soft,
             label_selector=label_selector,
             spread_threshold=config.scheduler_spread_threshold,
+            # a retrying owner's just-saw-a-worker-die-there set: the
+            # node is likely mid-death (heartbeat not yet timed out), so
+            # soft-avoid it while alternatives exist
+            exclude_node_ids=avoid_node_ids,
         )
         if pick is None:
             # Infeasible right now. Queue or spill only to nodes that satisfy
@@ -874,10 +889,11 @@ class Raylet:
             local_view = NodeView(self.node_id, self.total.to_dict(),
                                   self.available.to_dict(), self.labels, True)
             if _hard_ok(local_view):
-                return await self._grant_local(demand, None, -1, dedicated, owner_addr)
+                return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token)
             for v in self._node_views():
                 if v.node_id != self.node_id and _hard_ok(v):
-                    return {"spillback": self._addr_of(v.node_id)}
+                    return {"spillback": self._addr_of(v.node_id),
+                            "spillback_node": v.node_id}
             # The heartbeat-cached cluster view can lag a just-registered
             # node by one sync period; consult the authoritative GCS node
             # table before declaring the request permanently infeasible.
@@ -889,15 +905,17 @@ class Raylet:
                                 n.get("available", n["total"]),
                                 n.get("labels"), True)
                 if _hard_ok(view):
-                    return {"spillback": n["addr"]}
+                    return {"spillback": n["addr"],
+                            "spillback_node": n["node_id"]}
             raise RuntimeError(
                 f"No node can ever satisfy resource request {resources} with "
                 f"strategy={strategy_kind} labels={label_selector}; cluster totals: "
                 f"{[(v.node_id[:8], v.total.to_dict()) for v in self._node_views()]}"
             )
         if pick != self.node_id:
-            return {"spillback": self._addr_of(pick)}
-        return await self._grant_local(demand, None, -1, dedicated, owner_addr)
+            return {"spillback": self._addr_of(pick),
+                    "spillback_node": pick}
+        return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token)
 
     async def _gcs_node_addr(self, node_id: str) -> Optional[str]:
         nodes = await self.gcs.call("get_all_nodes")
@@ -947,9 +965,10 @@ class Raylet:
         return placement[0] if placement else None
 
     async def _grant_local(self, demand: ResourceSet, pg_id, bundle_index, dedicated,
-                           owner_addr) -> Dict:
+                           owner_addr, lease_token=None) -> Dict:
         fut = asyncio.get_event_loop().create_future()
-        self._lease_waiters.append((demand, pg_id, bundle_index, dedicated, owner_addr, fut))
+        self._lease_waiters.append((demand, pg_id, bundle_index, dedicated, owner_addr,
+                                    lease_token, fut))
         self._pump_leases()
         return await fut
 
@@ -991,9 +1010,20 @@ class Raylet:
             # synchronous; only _start_worker below changes it)
             starting = self._starting
             for _ in range(n):
-                demand, pg_id, bundle_index, dedicated, owner_addr, fut = self._lease_waiters[0]
+                (demand, pg_id, bundle_index, dedicated, owner_addr,
+                 lease_token, fut) = self._lease_waiters[0]
                 if fut.done():
                     self._lease_waiters.popleft()
+                    made_progress = True
+                    continue
+                if (lease_token
+                        and self._released_tokens.pop(lease_token, None)
+                        is not None):
+                    # owner released this token before the waiter was
+                    # queued (release beat the delayed grant): abandon
+                    self._lease_waiters.popleft()
+                    fut.set_exception(RuntimeError(
+                        "lease abandoned: owner released token"))
                     made_progress = True
                     continue
                 pool, resolved_index = self._find_lease_pool(pg_id, bundle_index, demand)
@@ -1026,10 +1056,18 @@ class Raylet:
                 worker.lease = {
                     "demand": demand, "pg_id": pg_id, "bundle_index": resolved_index,
                     "owner": owner_addr, "granted_at": time.time(),
+                    "token": lease_token,
                 }
+                if lease_token:
+                    self._lease_tokens[lease_token] = worker
                 worker.dedicated = dedicated
                 if not fut.done():
-                    fut.set_result({"worker_addr": worker.addr, "worker_id": worker.worker_id})
+                    # node_id lets the owner avoid this node on a
+                    # worker-death retry (see handle_lease_worker's
+                    # avoid_node_ids)
+                    fut.set_result({"worker_addr": worker.addr,
+                                    "worker_id": worker.worker_id,
+                                    "node_id": self.node_id})
                 made_progress = True
 
     def _max_workers(self) -> int:
@@ -1037,6 +1075,9 @@ class Raylet:
         return max(int(cpus) * 4, 8)
 
     def _release_lease_resources(self, lease: Dict[str, Any]):
+        token = lease.get("token")
+        if token:
+            self._lease_tokens.pop(token, None)
         pg_id = lease.get("pg_id")
         idx = lease.get("bundle_index", -1)
         if pg_id is None:
@@ -1045,6 +1086,36 @@ class Raylet:
             pool = (self.bundles.get(pg_id) or {}).get(idx)
         if pool is not None:
             pool.add(lease["demand"])
+
+    async def handle_release_lease_token(self, lease_token: str) -> bool:
+        """Compensation path for a grant whose reply never reached the
+        owner (socket died mid-``lease_worker``): the owner re-leases
+        under a NEW token, so this grant is unreachable — return the
+        worker to the pool exactly like a normal lease return.  Safe by
+        construction: an owner only releases tokens of replies it never
+        received, so the worker cannot have a task.
+
+        The release can also BEAT the grant (the lease call was still
+        queued behind worker startup when the owner's socket died):
+        abandon the queued waiter, or tombstone the token if its waiter
+        has not even been queued yet, so the delayed grant cannot land
+        and strand the worker."""
+        h = self._lease_tokens.pop(lease_token, None)
+        if (h is not None and h.lease is not None
+                and h.lease.get("token") == lease_token):
+            return await self.handle_return_lease(h.worker_id)
+        # not granted yet: abandon the queued waiter carrying this token
+        # (the pump's fut.done() check discards it)
+        for w in self._lease_waiters:
+            if w[5] == lease_token and not w[6].done():
+                w[6].set_exception(
+                    RuntimeError("lease abandoned: owner released token"))
+                return True
+        # handler still in flight before queueing its waiter: tombstone
+        self._released_tokens[lease_token] = time.time()
+        while len(self._released_tokens) > 1024:
+            self._released_tokens.pop(next(iter(self._released_tokens)))
+        return False
 
     async def handle_return_lease(self, worker_id: bytes) -> bool:
         h = self.workers.get(worker_id)

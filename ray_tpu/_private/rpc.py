@@ -316,8 +316,14 @@ class RpcClient:
                     fut.set_exception(RpcDisconnectedError(f"connection to {self.addr} lost"))
             self._pending.clear()
 
-    async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
-        retries = config.rpc_max_retries
+    async def call(self, method: str, timeout: Optional[float] = None,
+                   rpc_max_retries: Optional[int] = None, **kwargs) -> Any:
+        # rpc_max_retries overrides the config default — callers that sit
+        # behind their OWN retry layer (resilience.retry_call_async) pass
+        # a small budget so the two layers don't multiply into minutes of
+        # connect attempts against a dead peer
+        retries = (config.rpc_max_retries if rpc_max_retries is None
+                   else rpc_max_retries)
         while True:
             try:
                 return await self._call_once(method, timeout, kwargs)

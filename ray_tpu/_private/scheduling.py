@@ -18,7 +18,7 @@ match on — replacing the reference's string-resource hack
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 GRANULARITY = 10000  # milli-resource fixed point, reference fixed_point.h
 
@@ -130,6 +130,7 @@ def pick_node(
     soft: bool = False,
     label_selector: Optional[Dict[str, str]] = None,
     spread_threshold: float = 0.5,
+    exclude_node_ids: Optional[Iterable[str]] = None,
 ) -> Optional[str]:
     """Select a node for a resource demand; None means infeasible right now.
 
@@ -137,9 +138,25 @@ def pick_node(
     utilization stays under ``spread_threshold``; then pack onto the
     lowest-utilization feasible remote node; reference
     ``hybrid_scheduling_policy.cc``.
+
+    ``exclude_node_ids`` is a SOFT avoidance set: nodes a retrying owner
+    just saw a worker die on (likely mid-death, heartbeat not yet timed
+    out).  They are skipped while alternatives exist, but a cluster whose
+    only feasible node is excluded still schedules there — avoidance must
+    never turn a flaky worker into a deadlock.  Hard NODE_AFFINITY wins
+    over avoidance (explicit user placement).
     """
     labels = label_selector or {}
     cands = [n for n in nodes if feasible(n, demand, labels) and available_now(n, demand)]
+    if exclude_node_ids:
+        excl = set(exclude_node_ids)
+        kept = [n for n in cands if n.node_id not in excl]
+        if kept:
+            cands = kept
+            if local_node_id in excl:
+                local_node_id = None
+            if affinity_node_id in excl and soft:
+                affinity_node_id = None
 
     if strategy_kind == "NODE_AFFINITY":
         for n in nodes:

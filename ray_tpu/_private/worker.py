@@ -37,7 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import serialization
+from ray_tpu._private import resilience, serialization
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import (
     ActorID,
@@ -90,7 +90,8 @@ _exec_ctx: contextvars.ContextVar[Optional[ExecutionContext]] = contextvars.Cont
 class _Lease:
     """One leased remote worker."""
 
-    __slots__ = ("worker_addr", "worker_id", "client", "granting_raylet")
+    __slots__ = ("worker_addr", "worker_id", "client", "granting_raylet",
+                 "node_id")
 
     def __init__(self):
         self.worker_addr: Optional[str] = None
@@ -100,6 +101,10 @@ class _Lease:
         # the local raylet, and the lease must be returned to the granter
         # or its node's resources leak.
         self.granting_raylet: Optional[RpcClient] = None
+        # node the leased worker lives on; a worker-death retry passes it
+        # back as avoid_node_ids so the dead node is not re-picked before
+        # its heartbeat times out
+        self.node_id: Optional[str] = None
 
 
 class _LeasePool:
@@ -1097,7 +1102,7 @@ class CoreWorker:
                     continue
                 if lease.client is None:
                     try:
-                        await self._acquire_lease(lease, spec)
+                        await self._acquire_lease_retrying(lease, spec)
                     except Exception as e:  # noqa: BLE001
                         if pool.pumps > 1:
                             # Hand the spec back and shrink the pool —
@@ -1135,24 +1140,102 @@ class CoreWorker:
                     pool.pumps = 1
                     asyncio.ensure_future(self._pump_lease(key, pool))
 
-    async def _acquire_lease(self, lease: _Lease, spec: TaskSpec):
+    # raylet-socket loss during lease acquisition (the granting raylet
+    # dying mid-call — exactly the node-death retry window) is transport
+    # loss, not task failure: re-issue from the local raylet with backoff
+    _LEASE_RETRY_POLICY = resilience.RetryPolicy(
+        max_attempts=4, base_delay_s=0.1, max_delay_s=1.0)
+
+    async def _acquire_lease_retrying(self, lease: _Lease, spec: TaskSpec,
+                                      avoid_node_ids: Optional[set] = None):
+        """``_acquire_lease`` behind the resilience classifier: retryable
+        transport errors (raylet socket lost mid-``lease_worker``, peer
+        connect refused during a node's death window) restart acquisition
+        from the local raylet; application errors (infeasible placement,
+        removed PG) surface on the first throw.  Root cause of the
+        ``test_node_death_retries_elsewhere`` flake: the spillback target
+        died between the GCS view refresh and the lease call, and the
+        resulting ``RpcDisconnectedError`` failed the task instead of
+        re-routing it."""
+
+        # a shared mutable set: _acquire_lease adds the node of a raylet
+        # whose socket it loses, so later attempts route around the
+        # (likely dying, heartbeat not yet expired) node instead of
+        # burning the whole retry budget against it
+        if avoid_node_ids is None:
+            avoid_node_ids = set()
+
+        async def _attempt():
+            await self._acquire_lease(lease, spec, avoid_node_ids)
+
+        await resilience.retry_call_async(
+            _attempt, policy=self._LEASE_RETRY_POLICY, site="worker.lease")
+
+    async def _release_lease_token(self, raylet: RpcClient, token: str):
+        """Best-effort compensation for a lease call whose reply was lost
+        mid-socket: the raylet may have granted just as the connection
+        died, and the owner can never use a grant it never received — so
+        releasing by token is unconditionally safe.  A dead raylet is
+        fine too (its node's leases die with it)."""
+        try:
+            await raylet.call("release_lease_token", lease_token=token,
+                              rpc_max_retries=0, timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+
+    async def _acquire_lease(self, lease: _Lease, spec: TaskSpec,
+                             avoid_node_ids: Optional[set] = None):
+        from ray_tpu._private.rpc import RpcDisconnectedError
+        from ray_tpu.util.fault_injection import fault_point
+
         raylet = self.raylet
+        raylet_node = None  # node of the raylet we're talking to (None = local)
         hops = 0
         while hops < 16:
             strategy = spec.scheduling_strategy
-            reply = await raylet.call(
-                "lease_worker",
-                resources=spec.resources,
-                strategy_kind=strategy.kind,
-                node_id=strategy.node_id,
-                soft=strategy.soft,
-                pg_id=strategy.placement_group_id.binary() if strategy.placement_group_id else None,
-                bundle_index=strategy.bundle_index,
-                label_selector=strategy.label_selector,
-                owner_addr=self.serve_addr,
-                dedicated=spec.task_type == TaskType.ACTOR_CREATION_TASK,
-                timeout=config.worker_lease_timeout_s * 4,
-            )
+            fault_point("worker.lease")
+            # fresh token per CALL: if the reply is lost mid-socket the
+            # possibly-landed grant is released by token (below), and a
+            # later attempt's grant can never be confused with it
+            lease_token = os.urandom(12).hex()
+            try:
+                reply = await raylet.call(
+                    "lease_worker",
+                    resources=spec.resources,
+                    strategy_kind=strategy.kind,
+                    node_id=strategy.node_id,
+                    soft=strategy.soft,
+                    pg_id=strategy.placement_group_id.binary() if strategy.placement_group_id else None,
+                    bundle_index=strategy.bundle_index,
+                    label_selector=strategy.label_selector,
+                    owner_addr=self.serve_addr,
+                    dedicated=spec.task_type == TaskType.ACTOR_CREATION_TASK,
+                    avoid_node_ids=sorted(avoid_node_ids) if avoid_node_ids else None,
+                    lease_token=lease_token,
+                    # the resilience wrapper above owns the retry budget;
+                    # a big inner reconnect loop on top would multiply
+                    # into minutes against a dead peer
+                    rpc_max_retries=1,
+                    timeout=config.worker_lease_timeout_s * 4,
+                )
+            except RpcDisconnectedError:
+                # the grant may have landed server-side as the socket
+                # died: compensate so it cannot strand a worker's
+                # resources on a live node, then let the resilience
+                # classifier drive the retry
+                asyncio.ensure_future(
+                    self._release_lease_token(raylet, lease_token))
+                if raylet_node is not None and avoid_node_ids is not None:
+                    # losing a SPILLBACK raylet's socket mid-call usually
+                    # means its node is dying: route the retry around it
+                    # (its heartbeat has not timed out yet, so the
+                    # scheduler would otherwise re-pick it)
+                    avoid_node_ids.add(raylet_node)
+                raise
+            except RpcConnectionError:
+                if raylet_node is not None and avoid_node_ids is not None:
+                    avoid_node_ids.add(raylet_node)
+                raise
             if reply.get("retry_pg_pending"):
                 # PG placing slower than the server's bounded poll — keep
                 # the task queued by re-issuing the lease call (does not
@@ -1163,10 +1246,12 @@ class CoreWorker:
                 continue
             if "spillback" in reply:
                 raylet = self._peer(reply["spillback"])
+                raylet_node = reply.get("spillback_node")
                 hops += 1
                 continue
             lease.worker_addr = reply["worker_addr"]
             lease.worker_id = reply["worker_id"]
+            lease.node_id = reply.get("node_id")
             lease.client = self._peer(lease.worker_addr)
             lease.granting_raylet = raylet
             return
@@ -1174,13 +1259,14 @@ class CoreWorker:
 
     async def _dispatch_one(self, lease: _Lease, spec: TaskSpec):
         attempt = 0
+        avoid_nodes: set = set()  # nodes this task just saw a worker die on
         while True:
             if spec.task_id in self._cancel_requested:
                 self._fail_task(spec, exc.TaskCancelledError(
                     f"task {spec.task_id.hex()[:8]} was cancelled"))
                 return
             if lease.client is None:
-                await self._acquire_lease(lease, spec)
+                await self._acquire_lease_retrying(lease, spec, avoid_nodes)
             if spec.task_id in self._cancel_requested:
                 # cancel landed during lease acquisition — the pre-loop
                 # check has already passed and no worker has the task yet
@@ -1195,7 +1281,12 @@ class CoreWorker:
                 self._apply_task_reply(spec, reply)
                 return
             except (RpcConnectionError, ConnectionResetError) as e:
-                # leased worker died
+                # leased worker died — likely with its whole node (the
+                # common chaos case): soft-avoid that node on the retry,
+                # since its heartbeat may not have timed out yet and the
+                # scheduler would otherwise re-pick it
+                if lease.node_id is not None:
+                    avoid_nodes.add(lease.node_id)
                 lease.client = None
                 lease.worker_addr = None
                 if spec.task_id in self._cancel_requested:

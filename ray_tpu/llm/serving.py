@@ -64,6 +64,7 @@ class LLMServer:
         self.engine.on_token = self._on_token
         self._stop = False
         self._last_submit = 0.0  # monotonic; admission-settle signal
+        self._last_step = 0.0    # monotonic; bounds settle deferral
         self._loop = threading.Thread(target=self._engine_loop, daemon=True)
         self._loop.start()
 
@@ -90,14 +91,28 @@ class LLMServer:
                 busy = self.engine.has_unfinished()
                 settle = False
                 outs = []
-                if busy:
+                now = time.monotonic()
+                if not busy:
+                    # idle: keep the deferral clock fresh so the bound
+                    # measures time-without-a-step only while decodes
+                    # are actually waiting
+                    self._last_step = now
+                else:
                     settle = (
                         self.engine.free_slot_count()
                         > self.engine.queued_count()
-                        and time.monotonic() - self._last_submit
-                        < self.ADMISSION_SETTLE_S)
+                        and now - self._last_submit
+                        < self.ADMISSION_SETTLE_S
+                        # deferral is BOUNDED: a steady sub-settle
+                        # trickle of submits must not starve running
+                        # decodes — force a step once 2x the settle
+                        # window has passed without one, no matter how
+                        # recent the last submit is
+                        and now - self._last_step
+                        <= 2 * self.ADMISSION_SETTLE_S)
                     if not settle:
                         outs = self.engine.step()
+                        self._last_step = time.monotonic()
                 for out in outs:
                     slot = self._waiters.pop(out.request_id, None)
                     if slot is not None:

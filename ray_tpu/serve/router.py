@@ -15,6 +15,17 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import resilience
+from ray_tpu.util.fault_injection import fault_point
+
+
+def _assign_retryable(err: BaseException) -> bool:
+    """Dispatch-time failures worth a refresh+retry: transport loss to a
+    replica (it died; the controller will repopulate the set) and the
+    empty-replica window during a rolling update.  Application errors
+    raised by the replica's own code surface through the returned ref,
+    not here, so anything else at dispatch time is fatal."""
+    return resilience.is_retryable(err) or "has no replicas" in str(err)
 
 
 class DeploymentResponse:
@@ -233,40 +244,51 @@ class Router:
             if hit:
                 self._qlen_cache[key] = (hit[0] + 1, hit[1])
 
-    def assign(self, method: str, args: tuple, kwargs: dict,
-               model_id: str = ""):
-        for attempt in range(3):
+    # replica dispatch: a dead replica refreshes the set and re-picks,
+    # with a short backoff so a controller mid-update has time to land
+    # the new replica list (the old bare 3x loop retried EVERY exception
+    # instantly, hammering a deployment that was failing for real)
+    ASSIGN_RETRY_POLICY = resilience.RetryPolicy(
+        max_attempts=3, base_delay_s=0.05, max_delay_s=0.5)
+
+    def _assign_with_retry(self, model_id: str, dispatch):
+        """Shared retry harness for unary/streaming dispatch: classified
+        errors refresh the replica set and retry with backoff; fatal
+        errors surface immediately."""
+
+        def _attempt():
+            fault_point("serve.router.assign")
             self._maybe_refresh()
             replica = self.choose_replica(model_id)
-            try:
-                ref = replica.handle_request.remote(
-                    method, args, kwargs, multiplexed_model_id=model_id)
-                self.note_dispatch(replica)
-                self.note_model(model_id, replica)
-                return ref
-            except Exception:
-                if attempt == 2:
-                    raise
-                self.refresh()
+            ref = dispatch(replica)
+            self.note_dispatch(replica)
+            self.note_model(model_id, replica)
+            return ref
+
+        def _on_retry(attempt, err, delay):
+            self.refresh()
+
+        return resilience.retry_call(
+            _attempt, policy=self.ASSIGN_RETRY_POLICY,
+            classify=_assign_retryable, site="serve.router.assign",
+            on_retry=_on_retry)
+
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               model_id: str = ""):
+        return self._assign_with_retry(
+            model_id,
+            lambda replica: replica.handle_request.remote(
+                method, args, kwargs, multiplexed_model_id=model_id))
 
     def assign_streaming(self, method: str, args: tuple, kwargs: dict,
                          model_id: str = ""):
         """Route one streaming request; returns an ObjectRefGenerator."""
-        for attempt in range(3):
-            self._maybe_refresh()
-            replica = self.choose_replica(model_id)
-            try:
-                gen = replica.handle_request_streaming.options(
-                    num_returns="streaming").remote(
-                        method, args, kwargs,
-                        multiplexed_model_id=model_id)
-                self.note_dispatch(replica)
-                self.note_model(model_id, replica)
-                return gen
-            except Exception:
-                if attempt == 2:
-                    raise
-                self.refresh()
+        return self._assign_with_retry(
+            model_id,
+            lambda replica: replica.handle_request_streaming.options(
+                num_returns="streaming").remote(
+                    method, args, kwargs,
+                    multiplexed_model_id=model_id))
 
 
 class DeploymentHandle:

@@ -1,0 +1,176 @@
+"""Process-wide deterministic fault-injection registry.
+
+Control paths hardened by ``ray_tpu._private.resilience`` declare named
+**sites** by calling :func:`fault_point("<site>")` on their hot edge
+(right before the fallible I/O).  Tests arm a site to fail on its Nth
+call — via the API::
+
+    from ray_tpu.util import fault_injection as fi
+    with fi.armed("gcs_store.call", nth=2, exc=ConnectionError("boom")):
+        ...  # the 2nd store RPC in this process raises
+
+or, for subprocesses (bench, spawned workers), via the environment::
+
+    RAY_TPU_FAULT_INJECT="bench.backend_init:1:2:unavailable"
+    #                      site              :nth:count:kind
+
+meaning: calls ``nth .. nth+count-1`` to the site raise the ``kind``
+exception (see ``_KINDS``).  Multiple specs join with ``;``.  Arming is
+deterministic — a site fires on exact call indices, never randomly — so
+chaos tests reproduce bit-for-bit.
+
+Sites currently wired (see docs/fault_tolerance.md):
+
+==========================  =================================================
+site                        guards
+==========================  =================================================
+``bench.backend_init``      ``jax.devices()`` in bench.py
+``gcs_store.call``          every ``ExternalStoreClient`` RPC attempt
+``gcs_store.wal_append``    the file-store WAL write (torn-write tests)
+``worker.lease``            the owner's ``lease_worker`` raylet RPC
+``serve.router.assign``     replica dispatch in the serve router
+==========================  =================================================
+
+When nothing is armed, :func:`fault_point` is a single dict lookup —
+cheap enough to leave in production paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Iterator, Optional, Union
+
+ENV_VAR = "RAY_TPU_FAULT_INJECT"
+
+
+def _unavailable(site: str) -> Exception:
+    # mirrors how a PJRT backend outage surfaces (absl status text inside
+    # a RuntimeError) — classified retryable by resilience.is_retryable
+    return RuntimeError(
+        f"UNAVAILABLE: fault injected at {site} "
+        "(simulated TPU backend outage)")
+
+
+_KINDS = {
+    "oserror": lambda site: OSError(f"fault injected at {site}"),
+    "connection": lambda site: ConnectionError(f"fault injected at {site}"),
+    "eof": lambda site: EOFError(f"fault injected at {site}"),
+    "runtime": lambda site: RuntimeError(f"fault injected at {site}"),
+    "unavailable": _unavailable,
+}
+
+
+class _Arm:
+    __slots__ = ("nth", "count", "make", "calls", "fired")
+
+    def __init__(self, nth: int, count: int, make):
+        self.nth = nth      # 1-based call index of the first failure
+        self.count = count  # how many consecutive calls fail
+        self.make = make    # site -> Exception
+        self.calls = 0      # total fault_point() hits at this site
+        self.fired = 0      # how many times the fault actually raised
+
+
+_lock = threading.Lock()
+_armed: Dict[str, _Arm] = {}
+
+
+def _load_env() -> None:
+    spec = os.environ.get(ENV_VAR, "")
+    if not spec:
+        return
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2:
+            raise ValueError(
+                f"{ENV_VAR}: bad spec {part!r} (want site:nth[:count[:kind]])")
+        site = fields[0]
+        nth = int(fields[1])
+        count = int(fields[2]) if len(fields) > 2 else 1
+        kind = fields[3] if len(fields) > 3 else "connection"
+        if kind not in _KINDS:
+            raise ValueError(
+                f"{ENV_VAR}: unknown kind {kind!r} "
+                f"(expected one of {sorted(_KINDS)})")
+        _armed[site] = _Arm(nth, count, _KINDS[kind])
+
+
+_load_env()
+
+
+def arm(site: str, *, nth: int = 1, count: int = 1,
+        exc: Union[BaseException, type, str, None] = None) -> None:
+    """Arm ``site`` so calls ``nth .. nth+count-1`` raise.
+
+    ``exc`` may be an exception instance (raised as-is, repeatedly), an
+    exception class (instantiated with a site message), a kind string
+    from the env-var vocabulary, or None (ConnectionError).
+    """
+    if exc is None:
+        make = _KINDS["connection"]
+    elif isinstance(exc, str):
+        make = _KINDS[exc]
+    elif isinstance(exc, BaseException):
+        make = lambda site, _e=exc: _e  # noqa: E731
+    else:
+        make = lambda site, _c=exc: _c(f"fault injected at {site}")  # noqa: E731
+    with _lock:
+        _armed[site] = _Arm(nth, count, make)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site (or all, when ``site`` is None)."""
+    with _lock:
+        if site is None:
+            _armed.clear()
+        else:
+            _armed.pop(site, None)
+
+
+@contextlib.contextmanager
+def armed(site: str, *, nth: int = 1, count: int = 1,
+          exc: Union[BaseException, type, str, None] = None) -> Iterator[None]:
+    """Context-managed :func:`arm` — always disarms on exit."""
+    arm(site, nth=nth, count=count, exc=exc)
+    try:
+        yield
+    finally:
+        disarm(site)
+
+
+def call_count(site: str) -> int:
+    """How many times ``fault_point(site)`` ran while the site was armed
+    (0 for never-armed sites) — lets tests assert a site was exercised."""
+    with _lock:
+        a = _armed.get(site)
+        return a.calls if a is not None else 0
+
+
+def fired_count(site: str) -> int:
+    """How many times the armed fault actually raised at ``site``."""
+    with _lock:
+        a = _armed.get(site)
+        return a.fired if a is not None else 0
+
+
+def fault_point(site: str) -> None:
+    """Declare an injection site.  No-op unless ``site`` is armed; armed
+    sites raise on their configured call indices (deterministic)."""
+    if not _armed:  # fast path: nothing armed anywhere in the process
+        return
+    with _lock:
+        a = _armed.get(site)
+        if a is None:
+            return
+        a.calls += 1
+        if a.nth <= a.calls < a.nth + a.count:
+            a.fired += 1
+            err = a.make(site)
+        else:
+            return
+    raise err

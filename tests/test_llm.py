@@ -718,3 +718,46 @@ def test_llm_server_coalesces_concurrent_requests():
         assert batched < 4 * lone, (lone, batched)
     finally:
         srv._stop = True
+
+
+def test_llm_server_settle_deferral_bounded():
+    """A steady sub-settle trickle of submits must not starve running
+    decodes: the loop forces an engine.step() once 2x ADMISSION_SETTLE_S
+    passes without one, no matter how recent the last submit is."""
+    import threading
+    import time as time_mod
+
+    from ray_tpu.llm.serving import LLMServer
+    from ray_tpu.models.generation import SamplingParams
+
+    cls = LLMServer._target  # undecorated class
+    srv = cls({"model": "tiny", "batch_slots": 8, "max_len": 128}, 1)
+    try:
+        srv.ADMISSION_SETTLE_S = 0.05  # widen the window so the trickle
+        # (every 10ms, well under it) would starve forever without the bound
+        stop = threading.Event()
+
+        def trickle():
+            while not stop.is_set():
+                with srv._lock:
+                    srv._last_submit = time_mod.monotonic()
+                time_mod.sleep(0.01)
+
+        t = threading.Thread(target=trickle, daemon=True)
+        t.start()
+        try:
+            sp = SamplingParams(temperature=0.0, max_tokens=8,
+                                stop_token_id=srv.engine.tokenizer.eos_id)
+            slot = {"event": threading.Event(), "output": None}
+            with srv._lock:
+                rid = srv.engine.submit("hello world", sp)
+                srv._waiters[rid] = slot
+                srv._last_submit = time_mod.monotonic()
+            assert slot["event"].wait(timeout=60), \
+                "decode starved by a sub-settle submit trickle"
+            assert slot["output"] is not None
+        finally:
+            stop.set()
+            t.join(timeout=10)
+    finally:
+        srv._stop = True

@@ -141,6 +141,105 @@ def test_node_death_retries_elsewhere(cluster):
     assert out  # completed on some node
 
 
+
+
+@pytest.mark.chaos
+def test_lease_acquisition_survives_injected_raylet_socket_loss(cluster):
+    """The classification fix in isolation, bit-for-bit deterministic:
+    every lease RPC a task issues first loses its raylet socket
+    (injected ``RpcDisconnectedError`` — what a raylet dying mid-call
+    looks like).  Before the resilience rewiring this failed the task
+    from ``_pump_lease``; now it is classified retryable transport loss
+    and the acquisition re-issues with backoff."""
+    import time
+
+    from ray_tpu._private.rpc import RpcDisconnectedError
+    from ray_tpu.util import fault_injection as fi
+
+    c, n1, n2 = cluster
+
+    @ray_tpu.remote
+    def quick():
+        return "ok"
+
+    with fi.armed("worker.lease", nth=1, count=2,
+                  exc=RpcDisconnectedError("connection to raylet lost")):
+        out = ray_tpu.get(quick.remote(), timeout=60)
+        fired = fi.fired_count("worker.lease")
+    assert out == "ok"
+    assert fired == 2  # both injected socket losses were absorbed
+
+
+@pytest.mark.chaos
+def test_node_death_retry_survives_raylet_socket_loss(cluster, tmp_path):
+    """Deterministic replay of the ``test_node_death_retries_elsewhere``
+    flake (previously only reproducible under CPU contention): the task
+    is running on the victim when the node dies, and the owner's retry
+    lease RPCs race raylet-socket teardown — the resulting
+    ``RpcDisconnectedError`` used to FAIL the task instead of being
+    classified as retryable transport loss.  Placement is pinned by a
+    custom resource (no timing luck): only the victim holds ``doomed2``
+    at dispatch, and a replacement holding it joins before the kill, so
+    the retry must both absorb the injected socket loss AND avoid the
+    dead node (whose heartbeat has not yet timed out)."""
+    import json
+    import signal
+    import time
+
+    from ray_tpu._private.rpc import RpcDisconnectedError
+    from ray_tpu.util import fault_injection as fi
+
+    c, n1, n2 = cluster
+    victim = c.add_node(num_cpus=2, resources={"doomed2": 1.0})
+    c.wait_for_nodes()
+    pid_file = str(tmp_path / "victim_task.json")
+
+    @ray_tpu.remote(max_retries=3, resources={"doomed2": 1.0})
+    def pinned_then_replacement(path):
+        import json
+        import os
+        import time
+
+        node = ray_tpu.get_runtime_context().get_node_id()
+        if not os.path.exists(path):
+            # first execution: publish where we run, then block until
+            # killed (the retried execution takes the fast path)
+            with open(path + ".tmp", "w") as f:
+                json.dump({"pid": os.getpid(), "node": node}, f)
+            os.replace(path + ".tmp", path)
+            time.sleep(30)
+        return node
+
+    ref = pinned_then_replacement.remote(pid_file)  # only the victim fits
+    deadline = time.time() + 30
+    info = None
+    while time.time() < deadline and info is None:
+        try:
+            with open(pid_file) as f:
+                info = json.load(f)
+        except OSError:
+            time.sleep(0.1)
+    assert info is not None, "task never started"
+    assert info["node"] == victim.node_id  # deterministic placement
+    replacement = c.add_node(num_cpus=2, resources={"doomed2": 1.0})
+    c.wait_for_nodes()
+    # the armed window covers exactly the node-death retry's lease
+    # calls, which now ALSO lose their socket mid-RPC
+    with fi.armed("worker.lease", nth=1, count=2,
+                  exc=RpcDisconnectedError("connection to raylet lost")):
+        # real node death: the raylet AND the worker running the task
+        c.remove_node(victim)
+        try:
+            os.kill(info["pid"], signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out = ray_tpu.get(ref, timeout=90)
+        fired = fi.fired_count("worker.lease")
+    assert out == replacement.node_id  # re-ran on the replacement
+    assert fired >= 1  # the injected socket loss was actually exercised
+    c.remove_node(replacement)
+
+
 def test_separate_session_get_uses_same_host_handoff():
     """A node with its OWN session dir (distinct arena — what a real
     second host looks like) serves a cross-node get via the same-host

@@ -412,3 +412,37 @@ def test_multiplexed_http_header(serve_shutdown):
     with urllib.request.urlopen(req, timeout=30) as r:
         out = json.loads(r.read())
     assert out["mid"] == "lora-7"
+
+
+@pytest.mark.chaos
+def test_router_retries_injected_dispatch_fault(serve_shutdown):
+    """Chaos at the ``serve.router.assign`` injection site: a dispatch
+    attempt dies with transport loss (a replica crashing between probe
+    and send); the router must refresh the replica set and re-route —
+    the caller sees a normal response, not a ConnectionError."""
+    from ray_tpu.util import fault_injection as fi
+
+    @serve.deployment(num_replicas=2)
+    class Stable:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Stable.bind())
+    assert handle.remote(3).result(timeout=30) == 6  # router warmed up
+    with fi.armed("serve.router.assign", nth=1, count=1,
+                  exc=ConnectionError("injected replica link loss")):
+        assert handle.remote(5).result(timeout=30) == 10
+        assert fi.fired_count("serve.router.assign") == 1
+
+
+@pytest.mark.chaos
+def test_router_fatal_dispatch_error_not_retried(serve_shutdown):
+    """The other half of the classification: an application error at
+    dispatch time must surface immediately instead of burning the
+    retry budget re-sending it."""
+    from ray_tpu.serve.router import _assign_retryable
+
+    assert _assign_retryable(ConnectionError("x"))
+    assert _assign_retryable(RuntimeError("deployment 'd' has no replicas"))
+    assert not _assign_retryable(TypeError("bad request payload"))
+    assert not _assign_retryable(RuntimeError("replica raised ValueError"))
