@@ -233,6 +233,8 @@ def nodes() -> List[Dict[str, Any]]:
         n["NodeID"] = n["node_id"]
         n["Alive"] = n["alive"]
         n["Resources"] = n["total"]
+        # drain state machine: ALIVE -> DRAINING -> DEAD
+        n.setdefault("state", "ALIVE" if n.get("alive") else "DEAD")
     return out
 
 

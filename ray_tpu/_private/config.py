@@ -79,6 +79,14 @@ _FLAG_DEFS: Dict[str, Any] = {
     # don't kill when our workers hold less than this share of used bytes
     # (pressure is then external to the raylet — shared-host tenants)
     "memory_kill_min_worker_share": 0.10,
+    # --- node drain / preemption ---
+    # default drain window when none is given (reference: DrainNode RPC's
+    # deadline; spot-TPU reclaim notices give ~30-60s of advance warning)
+    "node_drain_deadline_s": 30.0,
+    # how long the train controller waits for the post-drain-notice
+    # checkpoint before restarting the group anyway (always additionally
+    # capped by the drain deadline itself)
+    "train_drain_checkpoint_wait_s": 10.0,
     # --- health / failure detection ---
     # (reference gcs_health_check_manager.h:45 timings)
     "health_check_period_s": 5.0,

@@ -532,6 +532,8 @@ class GcsServer:
             node.setdefault("last_heartbeat", now)
             node.setdefault("pending_demand", [])
             node.setdefault("available", dict(node.get("total", {})))
+            node.setdefault("state",
+                            "ALIVE" if node.get("alive") else "DEAD")
 
     def _wal_truncate(self):
         self._store.wal_truncate()
@@ -632,6 +634,8 @@ class GcsServer:
             node["last_heartbeat"] = now
             node.setdefault("pending_demand", [])
             node.setdefault("available", dict(node.get("total", {})))
+            node.setdefault("state",
+                            "ALIVE" if node.get("alive") else "DEAD")
         # re-enqueue work that was mid-flight when the snapshot was taken:
         # the pending queues are process memory, so actors/PGs persisted in
         # non-terminal states must be rescheduled or their waiters hang
@@ -688,12 +692,75 @@ class GcsServer:
             "labels": labels,
             "node_name": node_name,
             "alive": True,
+            # ALIVE -> DRAINING -> DEAD (reference: DrainNode RPC + the
+            # autoscaler's drain-before-terminate path).  `alive` stays
+            # True while DRAINING: the node still heartbeats and hosts
+            # running leases; only NEW placement soft-avoids it.
+            "state": "ALIVE",
             "last_heartbeat": time.time(),
             "start_time": time.time(),
         }
         self._publish("nodes", {"event": "node_added", "node_id": node_id})
         self._kick_pending()
         return {"ok": True}
+
+    async def handle_drain_node(self, node_id: str, reason: str = "",
+                                deadline_s: Optional[float] = None) -> Dict:
+        """Begin a cluster-visible drain of ``node_id`` (reference:
+        ``gcs_node_manager`` DrainNode): mark the node DRAINING, broadcast
+        a ``node_draining`` event with the deadline, and tell the raylet
+        to stop granting leases (best-effort — a raylet that misses the
+        RPC adopts the drain from its next heartbeat reply).  Past the
+        deadline the node is treated as preempted: shut down and marked
+        DEAD.  Returns the deadline plus the raylet-reported remaining
+        lease holders so callers can see what must migrate."""
+        from ray_tpu.util.fault_injection import fault_point
+
+        node = self.nodes.get(node_id)
+        if node is None or not node.get("alive"):
+            return {"accepted": False,
+                    "rejection_reason": "node not found or not alive"}
+        fault_point("gcs.drain_broadcast")
+        if node.get("state") == "DRAINING":
+            # idempotent: a second notice only ever SHORTENS the window
+            # (a later, laxer notice must not extend a commitment already
+            # broadcast to consumers)
+            if deadline_s is not None:
+                node["drain_deadline"] = min(
+                    node["drain_deadline"], time.time() + deadline_s)
+            return {"accepted": True, "already_draining": True,
+                    "node_id": node_id,
+                    "deadline": node["drain_deadline"],
+                    "lease_holders": node.get("drain_lease_holders", [])}
+        if deadline_s is None:
+            deadline_s = config.node_drain_deadline_s
+        deadline = time.time() + deadline_s
+        node["state"] = "DRAINING"
+        node["drain_reason"] = reason
+        node["drain_deadline"] = deadline
+        logger.warning("node %s draining: %s (deadline in %.1fs)",
+                       node_id[:8], reason or "<no reason>", deadline_s)
+        self._publish("nodes", {"event": "node_draining", "node_id": node_id,
+                                "reason": reason, "deadline": deadline})
+        holders: List[Dict[str, Any]] = []
+        raylet = self._raylet(node_id)
+        if raylet is not None:
+            try:
+                ack = await asyncio.wait_for(
+                    raylet.call("drain_self", reason=reason,
+                                deadline=deadline), 5.0)
+                holders = ack.get("lease_holders", [])
+            except Exception:  # noqa: BLE001 — heartbeat reply delivers it
+                logger.info("drain_self RPC to %s failed; raylet will "
+                            "adopt the drain from its next heartbeat",
+                            node_id[:8])
+        node["drain_lease_holders"] = holders
+        return {"accepted": True, "node_id": node_id, "deadline": deadline,
+                "lease_holders": holders}
+
+    def _draining_node_ids(self) -> set:
+        return {nid for nid, n in self.nodes.items()
+                if n.get("state") == "DRAINING"}
 
     async def handle_unregister_node(self, node_id: str) -> bool:
         await self._mark_node_dead(node_id, reason="unregistered")
@@ -716,25 +783,54 @@ class GcsServer:
             node["stats"] = stats
         node["last_heartbeat"] = time.time()
         if not node["alive"]:
+            if str(node.get("death_reason", "")).startswith(
+                    "drain deadline expired"):
+                # dead ON PURPOSE: a drain-expired node must never
+                # heartbeat itself back to life (the resurrect below
+                # would race the best-effort shutdown and overwrite the
+                # drain death with a generic "unregistered") — order the
+                # still-running raylet to shut down instead
+                return {"nodes": self._cluster_view(), "shutdown": True}
+            drain_deadline = node.get("drain_deadline")
+            if drain_deadline and time.time() > drain_deadline:
+                # the drain window lapsed while the node was (wrongly)
+                # marked dead by a heartbeat timeout: the commitment
+                # stands — convert the death to the drain form and
+                # refuse resurrection
+                node["death_reason"] = ("drain deadline expired"
+                                        f" ({node.get('drain_reason', '')})")
+                return {"nodes": self._cluster_view(), "shutdown": True}
             # heartbeat from a node marked dead during a GCS outage window:
-            # it's alive after all — resurrect it
+            # it's alive after all — resurrect it.  A drain in progress
+            # survives the blip (resurrect to DRAINING, not ALIVE): the
+            # node_draining broadcast is a commitment consumers already
+            # acted on, and the provider will still reclaim the capacity.
             node["alive"] = True
+            node["state"] = "DRAINING" if drain_deadline else "ALIVE"
             self._publish("nodes", {"event": "node_added",
                                     "node_id": node_id})
             self._kick_pending()
         if freed:
             self._dirty = True  # `available` is snapshot-persisted
             self._kick_pending()
-        return {"nodes": self._cluster_view(),
-                # raylets tail+publish worker logs only while a driver is
-                # actually polling the feed (cost gate)
-                "logs_wanted": time.time() - self._last_log_poll < 60.0}
+        reply = {"nodes": self._cluster_view(),
+                 # raylets tail+publish worker logs only while a driver is
+                 # actually polling the feed (cost gate)
+                 "logs_wanted": time.time() - self._last_log_poll < 60.0}
+        if node.get("state") == "DRAINING":
+            # drain adoption fallback: a raylet whose drain_self RPC was
+            # lost (or that restarted mid-drain) learns of it here
+            reply["drain"] = {"reason": node.get("drain_reason", ""),
+                              "deadline": node.get("drain_deadline", 0.0)}
+        return reply
 
     def _cluster_view(self) -> List[Dict[str, Any]]:
         return [
             {"node_id": n["node_id"], "addr": n["addr"], "total": n["total"],
              "available": n["available"], "labels": n["labels"],
              "alive": n["alive"],
+             "state": n.get("state", "ALIVE" if n["alive"] else "DEAD"),
+             "drain_deadline": n.get("drain_deadline"),
              "pending_demand": n.get("pending_demand", [])}
             for n in self.nodes.values()
         ]
@@ -749,16 +845,50 @@ class GcsServer:
         while not self._stopping:
             now = time.time()
             for node_id, node in list(self.nodes.items()):
-                if node["alive"] and now - node["last_heartbeat"] > timeout:
+                if not node["alive"]:
+                    continue
+                if now - node["last_heartbeat"] > timeout:
                     logger.warning("node %s missed heartbeats; marking dead", node_id[:8])
                     await self._mark_node_dead(node_id, reason="heartbeat timeout")
+                elif (node.get("state") == "DRAINING"
+                        and now > node.get("drain_deadline", 0.0)):
+                    # drain window over: the capacity is gone (preemption
+                    # semantics).  Record the death FIRST — the raylet's
+                    # own unregister during shutdown must not race in a
+                    # generic "unregistered" reason over the drain one —
+                    # then tell it to shut down (best-effort; a really
+                    # preempted VM is already dead).
+                    addr = node["addr"]
+                    await self._mark_node_dead(
+                        node_id,
+                        reason="drain deadline expired"
+                               f" ({node.get('drain_reason', '')})")
+                    # best-effort kill as a DETACHED task (fresh client:
+                    # _mark_node_dead closed the cached one) — a batch of
+                    # genuinely-preempted corpses must not serialize 2s
+                    # connect timeouts inside the health loop and delay
+                    # missed-heartbeat detection for everyone else
+                    asyncio.ensure_future(self._shutdown_drained(addr))
             await asyncio.sleep(period)
+
+    async def _shutdown_drained(self, addr: str):
+        client = RpcClient(addr, "gcs-drain-kill")
+        try:
+            await asyncio.wait_for(client.call("shutdown_node"), 2.0)
+        except Exception:  # noqa: BLE001
+            pass
+        finally:
+            try:
+                await client.close()
+            except Exception:  # noqa: BLE001
+                pass
 
     async def _mark_node_dead(self, node_id: str, reason: str):
         node = self.nodes.get(node_id)
         if node is None or not node["alive"]:
             return
         node["alive"] = False
+        node["state"] = "DEAD"
         node["death_reason"] = reason
         self._publish("nodes", {"event": "node_dead", "node_id": node_id, "reason": reason})
         # fail the dead node's RPC client so UNTIMED calls parked on it
@@ -947,6 +1077,9 @@ class GcsServer:
                 soft=strategy.soft,
                 label_selector=strategy.label_selector,
                 spread_threshold=config.scheduler_spread_threshold,
+                # DRAINING nodes are about to disappear: placing a fresh
+                # actor there guarantees an immediate restart cycle
+                exclude_node_ids=self._draining_node_ids(),
             )
         if pick is None:
             if actor_id not in self._pending_actors:
@@ -1161,7 +1294,9 @@ class GcsServer:
             return
         views = [NodeView(n["node_id"], n["total"], n["available"], n["labels"], n["alive"])
                  for n in self.nodes.values() if n["alive"]]
-        placement = scheduling.pack_bundles(views, pg["bundles"], pg["strategy"])
+        placement = scheduling.pack_bundles(
+            views, pg["bundles"], pg["strategy"],
+            exclude_node_ids=self._draining_node_ids())
         if placement is None:
             if pg_id not in self._pending_pgs:
                 self._pending_pgs.append(pg_id)
@@ -1333,9 +1468,15 @@ class GcsServer:
         return total.to_dict()
 
     async def handle_available_resources(self) -> Dict[str, float]:
+        # "available" means available FOR NEW PLACEMENT: a DRAINING
+        # node's free resources are excluded — schedulers soft-avoid it
+        # and it disappears at its deadline, so consumers sizing new
+        # work against this aggregate (elastic train restarts, the
+        # autoscaler's demand math) must not count capacity that is
+        # already on its way out
         avail = ResourceSet({})
         for n in self.nodes.values():
-            if n["alive"]:
+            if n["alive"] and n.get("state") != "DRAINING":
                 avail.add(ResourceSet(n["available"]))
         return avail.to_dict()
 

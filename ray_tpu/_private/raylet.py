@@ -120,6 +120,14 @@ class Raylet:
         self.cluster_view: List[Dict[str, Any]] = []
         self._tasks: List[asyncio.Task] = []
         self._stopping = False
+        # drain state (ALIVE -> DRAINING -> DEAD): set by the GCS's
+        # drain_self RPC, by the heartbeat-reply fallback, or by SIGTERM
+        # (self-drain).  A draining raylet soft-avoids granting NEW
+        # leases locally (spillback while alternatives exist); running
+        # leases keep their workers until the deadline.
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_deadline = 0.0
         self._pull_store = None
         self._pull_store_lock = asyncio.Lock()
         from ray_tpu._private.object_transfer import PushLimiter
@@ -132,8 +140,12 @@ class Raylet:
         # standalone raylet procs set this to exit after shutdown_node
         self.on_shutdown = None
         # set from heartbeat replies: publish worker logs only while some
-        # driver is actually tailing the feed
-        self._logs_wanted = False
+        # driver is actually tailing the feed.  None = not yet known (no
+        # heartbeat reply seen): the monitor must neither publish nor
+        # jump its cursor, or a task's print in the first second of a
+        # session is discarded before the raylet learns a driver is
+        # tailing (the worker_prints startup race).
+        self._logs_wanted: Optional[bool] = None
         # worker zygote (fork-server): one process pays interpreter+jax
         # import, every worker is an os.fork() away (reference WorkerPool
         # prestart, src/ray/raylet/worker_pool.h)
@@ -188,8 +200,31 @@ class Raylet:
             self._start_zygote()
         for _ in range(config.num_prestart_workers):
             self._start_worker()
+        # deterministic preemption rehearsal: RAY_TPU_SIMULATE_PREEMPTION
+        # = "<delay_s>[:<deadline_s>]" makes this raylet behave as if the
+        # provider delivered an advance reclaim notice delay_s after boot
+        # — the full drain sequence (broadcast, lease avoidance, consumer
+        # checkpoints, deadline death) runs exactly as on real capacity
+        spec = os.environ.get("RAY_TPU_SIMULATE_PREEMPTION", "")
+        if spec:
+            self._tasks.append(
+                asyncio.ensure_future(self._simulate_preemption(spec)))
         logger.info("raylet %s up at %s resources=%s", self.node_id[:8], self.addr,
                     self.total.to_dict())
+
+    async def _simulate_preemption(self, spec: str):
+        try:
+            parts = spec.split(":")
+            delay = float(parts[0])
+            deadline_s = float(parts[1]) if len(parts) > 1 else None
+        except ValueError:
+            logger.warning("bad RAY_TPU_SIMULATE_PREEMPTION spec %r "
+                           "(want '<delay_s>[:<deadline_s>]')", spec)
+            return
+        await asyncio.sleep(delay)
+        logger.warning("simulated preemption notice for node %s",
+                       self.node_id[:8])
+        await self.self_drain("simulated preemption notice", deadline_s)
 
     async def _heartbeat_loop(self):
         # Resource broadcast: the role of the reference's RaySyncer
@@ -211,8 +246,25 @@ class Raylet:
                     stats=self._node_stats(),
                 )
                 hb_failures = 0
+                if reply.get("shutdown"):
+                    # the GCS declared this node dead for good (drain
+                    # deadline expired): stop instead of heartbeating a
+                    # corpse back to life
+                    logger.warning("gcs ordered shutdown (drain deadline "
+                                   "expired); stopping this node")
+                    await self.handle_shutdown_node()
+                    return
                 self._logs_wanted = bool(reply.get("logs_wanted"))
                 self.cluster_view = reply.get("nodes", [])
+                drain = reply.get("drain")
+                if drain:
+                    # adopt unconditionally: _begin_drain is idempotent
+                    # and only ever SHORTENS the window, so this both
+                    # covers a lost drain_self RPC (restart, socket
+                    # loss, injected fault) and propagates a tightened
+                    # deadline to an already-draining raylet
+                    self._begin_drain(drain.get("reason", ""),
+                                      drain.get("deadline", 0.0))
                 if reply.get("unknown"):
                     # GCS restarted without our registration: re-attach
                     logger.info("gcs forgot this node: re-registering")
@@ -286,6 +338,9 @@ class Raylet:
         except OSError:
             stats["accelerators"] = []
         stats["node_id"] = self.node_id
+        stats["logs_wanted"] = self._logs_wanted
+        stats["tailed_logs"] = len(self._worker_logs)
+        stats["draining"] = self.draining
         return stats
 
     def _cpu_percent(self) -> float:
@@ -686,13 +741,21 @@ class Raylet:
                     continue
                 lines: List[str] = []
                 if not self._logs_wanted:
-                    # nobody is tailing: skip the read and jump the cursor
-                    # so a late consumer starts at fresh output instead of
-                    # replaying a huge backlog — but FALL THROUGH to the
-                    # dead-worker cleanup below, or churned workers' file
-                    # entries would be stat()ed every tick forever
-                    st["off"] = size
-                    st["buf"] = b""
+                    # nobody is tailing (or no heartbeat reply yet): skip
+                    # the read, and jump the cursor only past backlog a
+                    # late consumer wouldn't want replayed.  The BOUNDED
+                    # jump is load-bearing: the `logs_wanted` flag lags a
+                    # driver's first tail_logs poll by one heartbeat, so
+                    # an unconditional jump discards a task's print from
+                    # the first seconds of a session (worker_prints
+                    # startup race) — recent small output must survive
+                    # the interest transition.  FALL THROUGH to the
+                    # dead-worker cleanup below either way, or churned
+                    # workers' file entries would be stat()ed every tick
+                    # forever
+                    if size - st["off"] > 65536:
+                        st["off"] = size - 65536
+                        st["buf"] = b""
                 elif size > st["off"]:
                     try:
                         with open(st["path"], "rb") as f:
@@ -716,9 +779,11 @@ class Raylet:
                             break
                 # rotate only once fully drained: truncating with unread
                 # backlog (a worker outpacing the 1 MiB/tick read cap)
-                # would silently discard it
+                # would silently discard it.  With no tailing driver the
+                # ≤64KB retained window is discardable — rotate anyway,
+                # or an untailed chatty worker's file grows unbounded.
                 if rotate_at > 0 and st["off"] >= rotate_at \
-                        and st["off"] >= size:
+                        and (st["off"] >= size or not self._logs_wanted):
                     try:
                         os.truncate(st["path"], 0)
                         st["off"] = 0
@@ -780,6 +845,69 @@ class Raylet:
             if h.pid == pid:
                 h.proc = proc
                 return
+
+    # ---------------------------------------------------------------- drain
+
+    def _begin_drain(self, reason: str, deadline: float):
+        """Enter DRAINING locally: stop steering new leases here (the
+        lease path soft-avoids this node from now on).  Idempotent; a
+        second notice only ever shortens the window."""
+        if self.draining:
+            if deadline and deadline < self.drain_deadline:
+                self.drain_deadline = deadline
+            return
+        self.draining = True
+        self.drain_reason = reason
+        self.drain_deadline = deadline or (
+            time.time() + config.node_drain_deadline_s)
+        logger.warning("raylet %s draining: %s (%.1fs to deadline)",
+                       self.node_id[:8], reason or "<no reason>",
+                       max(0.0, self.drain_deadline - time.time()))
+
+    def _lease_holders(self) -> List[Dict[str, Any]]:
+        return [{"worker_id": h.worker_id.hex(),
+                 "pid": h.pid,
+                 "owner": (h.lease or {}).get("owner", ""),
+                 "granted_at": (h.lease or {}).get("granted_at")}
+                for h in self.workers.values() if h.lease is not None]
+
+    async def handle_drain_self(self, reason: str = "",
+                                deadline: float = 0.0) -> Dict:
+        """GCS-pushed leg of the drain protocol: ack with the remaining
+        lease holders so the control plane (and the draining caller) can
+        see what still has to migrate before the deadline."""
+        from ray_tpu.util.fault_injection import fault_point
+
+        fault_point("raylet.drain_ack")
+        self._begin_drain(reason, deadline)
+        return {"accepted": True, "node_id": self.node_id,
+                "reason": self.drain_reason,
+                "deadline": self.drain_deadline,
+                "lease_holders": self._lease_holders()}
+
+    async def self_drain(self, reason: str = "",
+                         deadline_s: Optional[float] = None):
+        """Raylet-initiated drain (SIGTERM, simulated preemption notice):
+        enter DRAINING locally first — even with the GCS unreachable this
+        node stops taking new leases — then report it cluster-wide."""
+        if deadline_s is None:
+            deadline_s = config.node_drain_deadline_s
+        self._begin_drain(reason, time.time() + deadline_s)
+        try:
+            await self.gcs.call("drain_node", node_id=self.node_id,
+                                reason=reason, deadline_s=deadline_s,
+                                timeout=5.0)
+        except Exception as e:  # noqa: BLE001 — local drain still holds
+            logger.warning("could not report self-drain to gcs: %s", e)
+
+    def _draining_node_ids(self) -> set:
+        """Cluster-wide DRAINING set, from the heartbeat-cached view plus
+        this raylet's own (possibly fresher) local state."""
+        out = {n["node_id"] for n in self.cluster_view
+               if n.get("state") == "DRAINING"}
+        if self.draining:
+            out.add(self.node_id)
+        return out
 
     # ---------------------------------------------------------------- leasing
 
@@ -863,6 +991,11 @@ class Raylet:
                 return {"spillback": addr, "spillback_node": target}
             return await self._grant_local(demand, pg_id, bundle_index, dedicated, owner_addr, lease_token)
 
+        # soft-avoid set: a retrying owner's just-saw-a-worker-die-there
+        # nodes (likely mid-death, heartbeat not yet timed out) plus every
+        # DRAINING node (advance-notice preemption — placing new work
+        # there guarantees churn before the deadline)
+        avoid = set(avoid_node_ids or ()) | self._draining_node_ids()
         pick = scheduling.pick_node(
             self._node_views(),
             demand,
@@ -872,10 +1005,7 @@ class Raylet:
             soft=soft,
             label_selector=label_selector,
             spread_threshold=config.scheduler_spread_threshold,
-            # a retrying owner's just-saw-a-worker-die-there set: the
-            # node is likely mid-death (heartbeat not yet timed out), so
-            # soft-avoid it while alternatives exist
-            exclude_node_ids=avoid_node_ids,
+            exclude_node_ids=avoid or None,
         )
         if pick is None:
             # Infeasible right now. Queue or spill only to nodes that satisfy

@@ -49,20 +49,33 @@ def main():
         f.write(raylet.addr)
     print(json.dumps({"node_id": raylet.node_id, "addr": raylet.addr}), flush=True)
 
-    # graceful SIGTERM: unregister from the GCS before exiting so the node
-    # flips to dead immediately instead of after the heartbeat timeout
-    # (the autoscaler/slice-provider terminate path sends SIGTERM)
+    # SIGTERM maps to SELF-DRAIN (the autoscaler/slice-provider terminate
+    # path and spot/maintenance preemption notices both deliver SIGTERM):
+    # broadcast the drain so schedulers route around this node and
+    # consumers (train/serve) checkpoint/migrate, wait for leases to
+    # drain — bounded by the drain deadline — then stop gracefully so the
+    # node flips to dead immediately instead of after heartbeat timeout.
+    # An idle node (no lease holders) exits as fast as it used to.
     import signal
+    import time as _time
 
     def _term(_sig, _frm):
-        async def _stop_and_exit():
+        async def _drain_stop_and_exit():
+            try:
+                await raylet.self_drain("SIGTERM")
+                while (_time.time() < raylet.drain_deadline
+                       and any(h.lease is not None
+                               for h in raylet.workers.values())):
+                    await asyncio.sleep(0.2)
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 await asyncio.wait_for(raylet.stop(), timeout=8.0)
             except Exception:  # noqa: BLE001
                 pass
             loop.stop()
 
-        asyncio.ensure_future(_stop_and_exit())
+        asyncio.ensure_future(_drain_stop_and_exit())
 
     loop.add_signal_handler(signal.SIGTERM, _term, signal.SIGTERM, None)
     try:

@@ -191,6 +191,7 @@ def pack_bundles(
     nodes: List[NodeView],
     bundles: List[Dict[str, float]],
     strategy: str,
+    exclude_node_ids: Optional[Iterable[str]] = None,
 ) -> Optional[List[str]]:
     """Place placement-group bundles onto nodes.
 
@@ -198,7 +199,20 @@ def pack_bundles(
     ``python/ray/util/placement_group.py``): PACK (minimize nodes, best
     effort), STRICT_PACK (all on one node), SPREAD (best-effort one-per-node),
     STRICT_SPREAD (hard one-per-node).  Returns node_id per bundle or None.
+
+    ``exclude_node_ids`` is the same SOFT avoidance set as
+    :func:`pick_node`'s: DRAINING nodes (advance-notice preemption) are
+    skipped while a placement exists without them, but a group that fits
+    only with a draining node still places there — avoidance must never
+    turn a drain notice into an unplaceable gang.
     """
+    if exclude_node_ids:
+        excl = set(exclude_node_ids)
+        kept = [n for n in nodes if n.node_id not in excl]
+        if kept:
+            placement = pack_bundles(kept, bundles, strategy)
+            if placement is not None:
+                return placement
     demands = [ResourceSet(b) for b in bundles]
     avail = {n.node_id: n.available.copy() for n in nodes if n.alive}
     order = sorted(avail, key=lambda nid: -next(n for n in nodes if n.node_id == nid).utilization())

@@ -1119,6 +1119,23 @@ class CoreWorker:
                     await self._dispatch_one(lease, spec)
                 except Exception as e:  # noqa: BLE001
                     self._fail_task(spec, e)
+                if (spec.scheduling_strategy.kind == "SPREAD"
+                        and pool.queue and lease.client is not None):
+                    # SPREAD means a per-TASK placement decision, but the
+                    # pool reuses one lease for its whole queue — a fast
+                    # pump would drain every queued spec onto the single
+                    # node of its first grant (root cause of
+                    # test_tasks_spread_across_nodes converging on one
+                    # node).  Return the lease between specs so each one
+                    # re-runs the round-robin spread pick.
+                    try:
+                        await (lease.granting_raylet or self.raylet).call(
+                            "return_lease", worker_id=lease.worker_id)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    lease.client = None
+                    lease.worker_addr = None
+                    lease.granting_raylet = None
         finally:
             if lease.client is not None:
                 try:

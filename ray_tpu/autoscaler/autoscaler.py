@@ -50,7 +50,8 @@ class Autoscaler:
         self.gcs_addr = gcs_addr
         self.provider = provider
         self.config = config
-        self.instance_manager = InstanceManager(provider)
+        self.instance_manager = InstanceManager(
+            provider, drain_node_fn=self._drain_node)
         self._idle_since: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -66,6 +67,22 @@ class Autoscaler:
                 await c.close()
 
         return run_sync(go())
+
+    def _drain_node(self, node_id: str, reason: str,
+                    deadline_s: Optional[float]):
+        """Instance drains go through the cluster drain protocol: the GCS
+        broadcasts node_draining, schedulers soft-avoid the node, and
+        train/serve consumers checkpoint/migrate before the terminate."""
+        async def go():
+            c = RpcClient(self.gcs_addr)
+            try:
+                return await c.call("drain_node", node_id=node_id,
+                                    reason=reason, deadline_s=deadline_s,
+                                    timeout=5.0)
+            finally:
+                await c.close()
+
+        run_sync(go())
 
     def reconcile_once(self) -> Dict[str, Any]:
         """Returns a summary of the decisions taken this round."""
@@ -145,7 +162,13 @@ class Autoscaler:
             above_min = (cfg is None
                          or per_type.get(inst.node_type, 0) > cfg.min_workers)
             if now - first >= self.config.idle_timeout_s and above_min:
-                im.drain(inst)
+                # broadcast a deadline the terminate path actually
+                # honors: the provider SIGKILLs ~10s after SIGTERM, so
+                # advertising the 30s protocol default would promise
+                # consumers a window that does not exist.  The node is
+                # idle by precondition, so the short window is real
+                # slack, not lost work.
+                im.drain(inst, deadline_s=10.0)
                 self._idle_since.pop(inst.instance_id, None)
                 per_type[inst.node_type] = per_type.get(
                     inst.node_type, 1) - 1

@@ -69,7 +69,8 @@ class InstanceManager:
     _ids = itertools.count(1)
 
     def __init__(self, provider, launch_timeout_s: float = 120.0,
-                 dead_grace_s: float = 30.0, keep_terminal: int = 50):
+                 dead_grace_s: float = 30.0, keep_terminal: int = 50,
+                 drain_node_fn=None):
         self.provider = provider
         self.launch_timeout_s = launch_timeout_s
         # a transiently-dead node (missed heartbeats during a blip; the
@@ -77,6 +78,12 @@ class InstanceManager:
         # on the first reconcile that observes it
         self.dead_grace_s = dead_grace_s
         self.keep_terminal = keep_terminal
+        # (node_id, reason, deadline_s) -> None: routes instance drains
+        # through the cluster-wide drain protocol (GCS drain_node) so
+        # consumers see the same node_draining broadcast whether a drain
+        # came from the autoscaler, SIGTERM, or an operator.  None keeps
+        # the manager usable standalone (unit tests, dry runs).
+        self.drain_node_fn = drain_node_fn
         self.instances: Dict[str, Instance] = {}
 
     # -- intents ----------------------------------------------------------
@@ -91,11 +98,21 @@ class InstanceManager:
                     node_type)
         return inst
 
-    def drain(self, inst: Instance):
+    def drain(self, inst: Instance, reason: str = "autoscaler idle drain",
+              deadline_s: Optional[float] = None):
         if inst.state is InstanceState.RUNNING:
             inst.state = InstanceState.DRAINING
             inst.draining_at = time.time()
             logger.info("instance %s DRAINING", inst.instance_id)
+            if self.drain_node_fn is not None:
+                # broadcast before terminate: every member node of the
+                # slice gets the cluster-wide drain notice (gang drain)
+                for node_id in inst.node_ids:
+                    try:
+                        self.drain_node_fn(node_id, reason, deadline_s)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        logger.debug("drain broadcast for %s failed",
+                                     node_id[:8], exc_info=True)
 
     # -- views ------------------------------------------------------------
 

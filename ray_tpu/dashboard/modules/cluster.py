@@ -25,7 +25,11 @@ def routes(gcs, helpers):
         nodes = []
         for nid, n in gcs.nodes.items():
             nodes.append({"node_id": nid,
-                          "state": "ALIVE" if n.get("alive") else "DEAD",
+                          "state": n.get("state",
+                                         "ALIVE" if n.get("alive")
+                                         else "DEAD"),
+                          "drain_reason": n.get("drain_reason"),
+                          "drain_deadline": n.get("drain_deadline"),
                           "addr": n.get("addr", ""),
                           "resources": n.get("total", {}),
                           "available": n.get("available", {}),

@@ -76,11 +76,47 @@ def cmd_status(args):
     ray_tpu = _connect(args.address or _default_address())
     print("Nodes:")
     for n in ray_tpu.nodes():
-        mark = "alive" if n["alive"] else "DEAD"
-        print(f"  {n['node_id'][:12]} [{mark}] {n['addr']} total={n['total']}")
+        mark = n.get("state", "ALIVE" if n["alive"] else "DEAD")
+        extra = ""
+        if mark == "DRAINING":
+            left = (n.get("drain_deadline") or 0) - time.time()
+            extra = (f" draining: {n.get('drain_reason') or '<no reason>'}"
+                     f" ({max(0.0, left):.0f}s to deadline)")
+        elif mark == "DEAD" and n.get("death_reason"):
+            extra = f" ({n['death_reason']})"
+        print(f"  {n['node_id'][:12]} [{mark}] {n['addr']} "
+              f"total={n['total']}{extra}")
     print("Cluster resources:", ray_tpu.cluster_resources())
     print("Available:", ray_tpu.available_resources())
     ray_tpu.shutdown()
+
+
+def cmd_drain(args):
+    """Operator-initiated node drain (reference ``ray drain-node``)."""
+    ray_tpu = _connect(args.address or _default_address())
+    from ray_tpu.util.state import drain_node
+
+    # accept a node-id prefix, like the listings print
+    target = args.node_id
+    matches = [n["node_id"] for n in ray_tpu.nodes()
+               if n["node_id"].startswith(target)]
+    if len(matches) == 1:
+        target = matches[0]
+    elif len(matches) > 1:
+        print(f"ambiguous node id prefix {target!r} "
+              f"({len(matches)} matches)")
+        ray_tpu.shutdown()
+        sys.exit(1)
+    ack = drain_node(target, reason=args.reason,
+                     deadline_s=args.deadline_s)
+    if ack.get("accepted"):
+        left = ack["deadline"] - time.time()
+        print(f"draining {target[:12]} (deadline in {left:.0f}s, "
+              f"{len(ack.get('lease_holders', []))} lease holder(s))")
+    else:
+        print(f"drain rejected: {ack.get('rejection_reason')}")
+    ray_tpu.shutdown()
+    sys.exit(0 if ack.get("accepted") else 1)
 
 
 def cmd_memory(args):
@@ -265,6 +301,18 @@ def main(argv=None):
     p = sub.add_parser("status", help="show cluster nodes and resources")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("drain", help="drain a node (advance-notice "
+                                     "preemption: checkpoint/migrate, "
+                                     "then terminate at the deadline)")
+    p.add_argument("node_id", help="node id (or unique prefix)")
+    p.add_argument("--reason", default="operator drain")
+    p.add_argument("--deadline-s", dest="deadline_s", type=float,
+                   default=None,
+                   help="seconds until the node is terminated "
+                        "(default: node_drain_deadline_s config)")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("entity", choices=["actors", "nodes", "jobs", "placement-groups"])

@@ -25,6 +25,10 @@ class ServeController:
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment
         self._apps: Dict[str, str] = {}  # app name -> ingress deployment
         self._health_fails: Dict[str, int] = {}  # replica -> consecutive
+        # node ids whose drain has already been migrated-from: a
+        # replacement that could only land back on the draining node
+        # (nowhere else feasible) must not be kill-looped every tick
+        self._drains_migrated: set = set()
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._loop = threading.Thread(target=self._reconcile_loop, daemon=True)
@@ -204,6 +208,84 @@ class ServeController:
                         st["goal_replicas"] = max(goal - 1, asc["min_replicas"])
                         st["last_scale"] = now
 
+    def _drain_migrate_once(self):
+        """Migrate replicas off DRAINING nodes before the deadline kills
+        them (reference: deployment_state reacting to the autoscaler's
+        drain-before-terminate).  Start-then-kill per replica — the old
+        replica is killed only after its replacement answers a health
+        check (bounded by the drain deadline), so serving capacity never
+        dips below goal.  One migration pass per node-drain event: a
+        replacement that could only land back on the draining node
+        (nowhere else feasible) is left alone instead of kill-looped."""
+        try:
+            node_info = {n["node_id"]: n for n in ray_tpu.nodes()}
+        except Exception:  # noqa: BLE001 — control-plane hiccup
+            return
+        draining = {nid for nid, n in node_info.items()
+                    if n.get("state") == "DRAINING"}
+        # forget resolved drains (node back ALIVE, or DEAD and gone)
+        self._drains_migrated &= draining
+        fresh = draining - self._drains_migrated
+        if not fresh:
+            return
+        try:
+            from ray_tpu.util.state import list_actors
+
+            actor_nodes = {a["actor_id"]: a.get("node_id")
+                           for a in list_actors()}
+        except Exception:  # noqa: BLE001 — transient: retry next tick
+            return
+        # mark handled only once the actor map is in hand (a zero-work
+        # pass must retry); from here even a partial pass never repeats
+        self._drains_migrated |= fresh
+        with self._lock:
+            items = [(n, list(st["replicas"])) for n, st in
+                     self._deployments.items()]
+        # phase 1: start EVERY replacement first — the waits below then
+        # overlap all cold starts instead of serializing them against a
+        # ticking drain deadline
+        migrations = []  # (old replica, replacement, drain deadline)
+        for name, replicas in items:
+            for r in replicas:
+                node = actor_nodes.get(r._actor_id.hex())
+                if node not in fresh:
+                    continue
+                with self._lock:
+                    st = self._deployments.get(name)
+                    if st is None or r not in st["replicas"]:
+                        continue
+                    st["replicas"].remove(r)
+                    st["version"] += 1
+                    self._start_replica(name, st)
+                    replacement = st["replicas"][-1]
+                migrations.append(
+                    (r, replacement,
+                     node_info.get(node, {}).get("drain_deadline")
+                     or (time.time() + 10.0)))
+        if not migrations:
+            return
+        # phase 2: one bounded wait for all replacements to come up
+        # (health refs issued up front, so the gets overlap), then kill
+        # the old replicas — capacity never dips below goal, and the
+        # whole pass costs at most one deadline margin, not one per
+        # replica
+        wait_until = min(dl for _r, _repl, dl in migrations) - 2.0
+        refs = [repl.check_health.remote() for _r, repl, _dl in migrations]
+        for ref in refs:
+            wait_s = min(15.0, wait_until - time.time())
+            if wait_s <= 0:
+                break  # deadline looming: kill-and-hope beats losing both
+            try:
+                ray_tpu.get(ref, timeout=wait_s)
+            except Exception:  # noqa: BLE001 — kill anyway: the
+                pass  # deadline takes the old replica regardless
+        for r, _repl, _dl in migrations:
+            self._health_fails.pop(r._actor_id.hex(), None)
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+
     def _health_check_once(self):
         with self._lock:
             items = [(n, list(st["replicas"])) for n, st in
@@ -273,6 +355,7 @@ class ServeController:
             try:
                 self._autoscale_once()
                 self._reconcile_once()
+                self._drain_migrate_once()
                 if n % 10 == 9:
                     self._health_check_once()
                 self._publish_status()

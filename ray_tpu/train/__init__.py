@@ -6,6 +6,7 @@ Parity target: ``ray.train`` (v2 control-loop design,
 """
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import latest_committed_checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -41,5 +42,5 @@ __all__ = [
     "FailureDecision", "FailurePolicy", "FixedScalingPolicy", "ResizeDecision",
     "ScalingPolicy", "TrainContext", "get_context", "get_dataset_shard",
     "profile", "report", "DataParallelTrainer", "JaxTrainer",
-    "initialize_jax_distributed",
+    "initialize_jax_distributed", "latest_committed_checkpoint",
 ]

@@ -2,16 +2,31 @@
 
 Parity: ``python/ray/train/_internal/checkpoint_manager.py`` (keep top-K by
 score) and ``storage.py`` (persist to run storage dir).
+
+Persistence is CRASH-ATOMIC (the discipline of Orbax emergency
+checkpointing, and of the GCS WAL's torn-tail truncation): a checkpoint
+is staged into ``checkpoint_NNNNNN.tmp``, fsynced, and committed with a
+single ``os.rename`` — a process SIGKILLed mid-write (a preempted TPU
+host, the chief failure mode this exists for) can only ever leave a
+``*.tmp`` staging dir behind, never a half-written directory that
+restore would load.  ``latest_committed_checkpoint`` and the stale-tmp
+sweep ignore/remove such torn leftovers.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
+import re
 import shutil
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.train.checkpoint import Checkpoint
+
+logger = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^checkpoint_(\d{6,})$")
 
 
 @dataclasses.dataclass
@@ -19,6 +34,68 @@ class _Tracked:
     checkpoint: Checkpoint
     metrics: Dict[str, Any]
     index: int
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without dir fds: rename atomicity still holds
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root: str) -> None:
+    """fsync every file then every directory under ``root`` so the
+    rename-commit publishes fully-durable content (rename alone orders
+    the NAME, not the bytes, across a power cut)."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            try:
+                fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+            except OSError:
+                continue
+            try:
+                os.fsync(fd)
+            except OSError:
+                pass
+            finally:
+                os.close(fd)
+        _fsync_dir(dirpath)
+
+
+def committed_checkpoint_dirs(storage_dir: str) -> List[Tuple[int, str]]:
+    """(index, abspath) of every COMMITTED checkpoint under
+    ``storage_dir``, sorted by index.  Skips ``*.tmp`` staging dirs (a
+    crash mid-copy) and anything not matching the committed name pattern
+    — the restore-side half of the atomic-commit contract."""
+    out: List[Tuple[int, str]] = []
+    try:
+        entries = os.listdir(storage_dir)
+    except OSError:
+        return out
+    for name in entries:
+        m = _CKPT_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(storage_dir, name)
+        if os.path.isdir(path):
+            out.append((int(m.group(1)), os.path.abspath(path)))
+    out.sort()
+    return out
+
+
+def latest_committed_checkpoint(storage_dir: str) -> Optional[Checkpoint]:
+    """The newest checkpoint a crashed/preempted run durably committed
+    (None if there is none).  The resume entry point: pass it as
+    ``resume_from_checkpoint`` to continue from where the dead run left
+    off with zero risk of loading a torn directory."""
+    dirs = committed_checkpoint_dirs(storage_dir)
+    return Checkpoint(dirs[-1][1]) if dirs else None
 
 
 class CheckpointManager:
@@ -32,6 +109,19 @@ class CheckpointManager:
         self._index = 0
         if storage_dir:
             os.makedirs(storage_dir, exist_ok=True)
+            # sweep staging dirs a killed writer left behind, and resume
+            # indexing ABOVE existing commits so a restarted run can
+            # never overwrite a checkpoint the dead run durably owns
+            for name in os.listdir(storage_dir):
+                if name.endswith(".tmp") and _CKPT_RE.match(name[:-4]):
+                    logger.warning(
+                        "removing torn checkpoint staging dir %s "
+                        "(writer died mid-commit)", name)
+                    shutil.rmtree(os.path.join(storage_dir, name),
+                                  ignore_errors=True)
+            committed = committed_checkpoint_dirs(storage_dir)
+            if committed:
+                self._index = committed[-1][0]
 
     @property
     def latest(self) -> Optional[Checkpoint]:
@@ -56,12 +146,41 @@ class CheckpointManager:
         return (max if self.score_order == "max" else min)(scored, key=key)
 
     def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
-        """Persist (if storage configured) and track; evicts beyond top-K."""
+        """Persist (if storage configured) and track; evicts beyond top-K.
+
+        The persist is a two-phase commit: stage into ``<dest>.tmp``,
+        fsync, rename to ``<dest>``.  Dying anywhere before the rename
+        (the ``train.checkpoint.commit`` fault site sits exactly there)
+        leaves only a ``.tmp`` dir that restore ignores and the next
+        manager sweeps.
+        """
+        from ray_tpu.util.fault_injection import fault_point
+
         self._index += 1
         if self.storage_dir:
-            dest = os.path.join(self.storage_dir, f"checkpoint_{self._index:06d}")
+            dest = os.path.join(self.storage_dir,
+                                f"checkpoint_{self._index:06d}")
             if os.path.abspath(checkpoint.path) != dest:
-                shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                # index collision (another writer / a restart race):
+                # NEVER delete a committed checkpoint to make room —
+                # a crash between its removal and our rename would
+                # destroy durable state.  Skip to the next free slot.
+                while os.path.exists(dest):
+                    self._index += 1
+                    dest = os.path.join(
+                        self.storage_dir,
+                        f"checkpoint_{self._index:06d}")
+                tmp = dest + ".tmp"
+                shutil.rmtree(tmp, ignore_errors=True)
+                shutil.copytree(checkpoint.path, tmp)
+                _fsync_tree(tmp)
+                # the commit point: everything staged + durable, one
+                # rename publishes it.  A kill here (chaos tests arm
+                # this site, incl. with a real SIGKILL) must never
+                # yield a dir restore would load.
+                fault_point("train.checkpoint.commit")
+                os.rename(tmp, dest)
+                _fsync_dir(self.storage_dir)
             checkpoint = Checkpoint(dest)
         self._tracked.append(_Tracked(checkpoint, dict(metrics), self._index))
         self._evict()

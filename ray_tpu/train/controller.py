@@ -83,6 +83,13 @@ class TrainController:
         self._step_buffer: Dict[tuple, Dict[int, Dict[str, Any]]] = {}
         self._emitted: Dict[tuple, Dict[str, Any]] = {}
         self._ckpt_registered: set = set()
+        # drain (preemption-notice) watching: node ids whose drain this
+        # controller already reacted to — a restarted group that can only
+        # re-land on the draining node (single-node cluster) must not
+        # restart-loop on the same notice
+        self._drains_handled: set = set()
+        self._last_drain_check = 0.0
+        self._draining_cache: Dict[str, float] = {}
 
     # -- group lifecycle ---------------------------------------------------
     def _start_group(self) -> WorkerGroup:
@@ -190,6 +197,74 @@ class TrainController:
         except Exception:  # noqa: BLE001 — dashboard view is best-effort
             pass
 
+    # -- drain / preemption handling ---------------------------------------
+    def _poll_draining_nodes(self) -> Dict[str, float]:
+        """node_id -> drain deadline for every DRAINING node, polled from
+        the GCS node table at most twice a second (the drain event is
+        also on the pubsub feed; polling the table keeps this loop
+        single-threaded and restart-safe)."""
+        now = time.time()
+        if now - self._last_drain_check < 0.5:
+            return self._draining_cache
+        self._last_drain_check = now
+        try:
+            import ray_tpu
+
+            self._draining_cache = {
+                n["node_id"]: n.get("drain_deadline") or 0.0
+                for n in ray_tpu.nodes() if n.get("state") == "DRAINING"}
+        except Exception:  # noqa: BLE001 — control plane hiccup
+            pass
+        return self._draining_cache
+
+    def _maybe_handle_drain(self, group: WorkerGroup) -> bool:
+        """React to a drain notice covering any node hosting this group:
+        ask every rank for an immediate checkpoint, wait (bounded by the
+        drain deadline) for one to be reported and committed, and tell
+        the caller to restart the group — the scheduler soft-avoids
+        DRAINING nodes, so the replacement lands elsewhere whenever the
+        cluster has anywhere else to be.  This is the before-the-corpse
+        half of preemption recovery; the after-the-corpse half (worker
+        death -> FailurePolicy -> restore) stays as the fallback."""
+        from ray_tpu._private.config import config
+
+        draining = self._poll_draining_nodes()
+        if not draining:
+            return False
+        overlap = {nid: dl for nid, dl in draining.items()
+                   if nid in set(group.worker_node_ids())
+                   and nid not in self._drains_handled}
+        if not overlap:
+            return False
+        self._drains_handled.update(overlap)
+        deadline = min(overlap.values()) or (
+            time.time() + config.train_drain_checkpoint_wait_s)
+        logger.warning(
+            "train %s: drain notice for node(s) %s hosting workers "
+            "(%.1fs to deadline); requesting immediate checkpoint and "
+            "restarting off the draining node(s)",
+            self.name, [n[:8] for n in overlap],
+            max(0.0, deadline - time.time()))
+        pre_ckpts = len(self._ckpt_registered)
+        group.request_checkpoint()
+        # leave a margin before the deadline for group teardown + restart
+        wait_until = min(deadline - 1.0,
+                         time.time() + config.train_drain_checkpoint_wait_s)
+        while time.time() < wait_until:
+            statuses = group.poll()
+            self._collect_results(statuses)
+            # finished beats checkpointed: a run completing during the
+            # wait (its last step's checkpoint counts as "new") must not
+            # be torn down and pointlessly re-run from that checkpoint
+            if all(s.finished for s in statuses):
+                return False  # the run beat the drain: nothing to migrate
+            if len(self._ckpt_registered) > pre_ckpts:
+                break  # the pre-drain checkpoint is committed
+            if any(s.error for s in statuses):
+                break  # deadline beat us; restart from what we have
+            time.sleep(self.poll_interval_s)
+        return True
+
     # -- control loop ------------------------------------------------------
     def run(self) -> Result:
         self._started_at = time.time()
@@ -202,6 +277,15 @@ class TrainController:
                 statuses = group.poll()
                 self._collect_results(statuses)
                 self._publish_status(group, "RUNNING")
+
+                if not all(s.finished for s in statuses) and \
+                        self._maybe_handle_drain(group):
+                    # planned migration, not a failure: no failure-budget
+                    # charge; the restart re-runs the ScalingPolicy so an
+                    # elastic run resizes to the surviving capacity
+                    group.shutdown()
+                    group = self._restart_group()
+                    continue
 
                 errs = [s for s in statuses if s.error]
                 if errs:

@@ -38,6 +38,10 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
         self.error_tb: Optional[str] = None
         self.dataset_shard: Any = None
+        # set by the controller when the node hosting this worker got a
+        # drain (preemption) notice: the loop should checkpoint at its
+        # next step boundary; cleared when a checkpoint is reported
+        self.checkpoint_requested = threading.Event()
 
 
 def _start_session(**kw) -> _TrainSession:
@@ -62,6 +66,8 @@ def report(
 ) -> None:
     """Report metrics (and optionally a checkpoint) to the controller."""
     s = _get_session()
+    if checkpoint is not None:
+        s.checkpoint_requested.clear()
     s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
 
 
@@ -83,6 +89,14 @@ class TrainContext:
 
     def get_config(self) -> Dict[str, Any]:
         return _get_session().config
+
+    def drain_requested(self) -> bool:
+        """True when the node hosting this worker received a drain
+        (preemption) notice and the controller asked for an immediate
+        checkpoint: report one at the next step boundary — steps since
+        the last reported checkpoint will be re-run by the replacement
+        group.  Loops that checkpoint every step can ignore this."""
+        return _get_session().checkpoint_requested.is_set()
 
     def collective_group(self, backend: str = "tcp") -> str:
         """Join (once) the all-workers collective group; returns its name.

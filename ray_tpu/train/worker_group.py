@@ -112,6 +112,15 @@ class TrainWorker:
         self._thread = threading.Thread(target=_run, daemon=True, name="train-loop")
         self._thread.start()
 
+    def request_checkpoint(self) -> bool:
+        """Drain-notice leg: ask the loop to checkpoint at its next step
+        boundary (``get_context().drain_requested()`` flips true)."""
+        sess = self._session
+        if sess is None:
+            return False
+        sess.checkpoint_requested.set()
+        return True
+
     def poll(self) -> Dict[str, Any]:
         sess = self._session
         if sess is None:
@@ -218,6 +227,25 @@ class WorkerGroup:
         self.worker_metadata = ray_tpu.get(
             [w.get_metadata.remote() for w in self.workers], timeout=60)
         self._started = True
+
+    def worker_node_ids(self) -> List[str]:
+        """Node hosting each rank (the drain watcher intersects this
+        with the cluster's DRAINING set)."""
+        return [m.get("node_id", "") for m in self.worker_metadata]
+
+    def request_checkpoint(self) -> None:
+        """Best-effort fan-out of the drain notice to every rank."""
+        refs = []
+        for w in self.workers:
+            try:
+                refs.append(w.request_checkpoint.remote())
+            except Exception:  # noqa: BLE001 — dying worker
+                pass
+        for r in refs:
+            try:
+                ray_tpu.get(r, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
 
     def run_train_fn(
         self,

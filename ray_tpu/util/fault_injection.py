@@ -29,7 +29,16 @@ site                        guards
 ``gcs_store.wal_append``    the file-store WAL write (torn-write tests)
 ``worker.lease``            the owner's ``lease_worker`` raylet RPC
 ``serve.router.assign``     replica dispatch in the serve router
+``gcs.drain_broadcast``     the GCS ``drain_node`` handler's hot edge
+``raylet.drain_ack``        the raylet's ``drain_self`` ack (lost-RPC path)
+``train.checkpoint.commit``  between checkpoint staging and rename-commit
 ==========================  =================================================
+
+The ``sigkill`` kind is special: instead of raising, the armed call
+SIGKILLs the current process — a real mid-operation crash, for testing
+that on-disk state (checkpoint commits, WAL tails) survives a writer
+dying at the worst instruction.  Use it via the env var in a
+subprocess, never in-process in a test runner.
 
 When nothing is armed, :func:`fault_point` is a single dict lookup —
 cheap enough to leave in production paths.
@@ -53,12 +62,22 @@ def _unavailable(site: str) -> Exception:
         "(simulated TPU backend outage)")
 
 
+def _sigkill(site: str) -> Exception:
+    # a REAL crash, not an exception: the process dies mid-operation,
+    # exactly like a preempted host — never returns
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
+    return RuntimeError(f"unreachable: sigkill at {site}")  # pragma: no cover
+
+
 _KINDS = {
     "oserror": lambda site: OSError(f"fault injected at {site}"),
     "connection": lambda site: ConnectionError(f"fault injected at {site}"),
     "eof": lambda site: EOFError(f"fault injected at {site}"),
     "runtime": lambda site: RuntimeError(f"fault injected at {site}"),
     "unavailable": _unavailable,
+    "sigkill": _sigkill,
 }
 
 

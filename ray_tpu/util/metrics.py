@@ -182,12 +182,13 @@ def prometheus_text(all_metrics: Dict[str, Dict]) -> str:
                 cum = 0
                 for bound, count in zip(bounds, h["counts"]):
                     cum += count
+                    le = f'le="{bound}"'
                     lines.append(
-                        f"{safe}_bucket{labels(h['tags'], f'le=\"{bound}\"')}"
-                        f" {cum}")
+                        f"{safe}_bucket{labels(h['tags'], le)} {cum}")
                 cum += h["counts"][-1] if len(h["counts"]) > len(bounds) else 0
+                inf = 'le="+Inf"'
                 lines.append(
-                    f"{safe}_bucket{labels(h['tags'], 'le=\"+Inf\"')} {cum}")
+                    f"{safe}_bucket{labels(h['tags'], inf)} {cum}")
                 lines.append(f"{safe}_sum{labels(h['tags'])} {h['sum']}")
                 lines.append(f"{safe}_count{labels(h['tags'])} {cum}")
             continue

@@ -14,8 +14,26 @@ def _worker():
 
 
 def list_nodes() -> List[Dict[str, Any]]:
+    """Node table incl. the drain state machine: each node carries
+    ``state`` (ALIVE | DRAINING | DEAD) and, while DRAINING, the
+    ``drain_reason`` / ``drain_deadline`` of the advance notice."""
     w = _worker()
-    return w.run_coro(w.gcs.call("get_all_nodes"))
+    out = w.run_coro(w.gcs.call("get_all_nodes"))
+    for n in out:
+        n.setdefault("state", "ALIVE" if n.get("alive") else "DEAD")
+    return out
+
+
+def drain_node(node_id: str, reason: str = "",
+               deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """Begin a cluster-wide drain of ``node_id`` (reference: the GCS
+    ``DrainNode`` RPC): the node stops receiving new placements, train
+    runs checkpoint and restart elsewhere, serve migrates replicas, and
+    past ``deadline_s`` the node is shut down and marked DEAD.  Returns
+    the accept/reject ack incl. the remaining lease holders."""
+    w = _worker()
+    return w.run_coro(w.gcs.call("drain_node", node_id=node_id,
+                                 reason=reason, deadline_s=deadline_s))
 
 
 def list_actors() -> List[Dict[str, Any]]:

@@ -1,0 +1,623 @@
+"""Node drain protocol + preemption-aware recovery.
+
+The drain state machine (ALIVE -> DRAINING -> DEAD), its broadcast and
+raylet legs, scheduling soft-avoidance, the SIGTERM / simulated-preemption
+entry points, crash-atomic checkpoint commits, and the end-to-end
+"drain the node hosting train workers mid-run" recovery path.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from ray_tpu.util import fault_injection as fi
+
+
+# ---------------------------------------------------------------------------
+# scheduling: soft avoidance of draining nodes
+# ---------------------------------------------------------------------------
+
+
+def test_pack_bundles_soft_exclusion():
+    from ray_tpu._private.scheduling import NodeView, pack_bundles
+
+    nodes = [
+        NodeView("n1", {"CPU": 4}, {"CPU": 4}),
+        NodeView("n2", {"CPU": 4}, {"CPU": 4}),
+    ]
+    bundles = [{"CPU": 2}, {"CPU": 2}]
+    # excluded node avoided while the group fits elsewhere
+    placement = pack_bundles(nodes, bundles, "PACK",
+                             exclude_node_ids={"n1"})
+    assert set(placement) == {"n2"}
+    # soft: a group that fits ONLY with the excluded node still places
+    placement = pack_bundles(nodes, [{"CPU": 4}, {"CPU": 4}], "SPREAD",
+                             exclude_node_ids={"n1"})
+    assert placement is not None and set(placement) == {"n1", "n2"}
+    # excluding everything falls back to the full node set
+    placement = pack_bundles(nodes, bundles, "PACK",
+                             exclude_node_ids={"n1", "n2"})
+    assert placement is not None
+
+
+# ---------------------------------------------------------------------------
+# GCS + raylet protocol legs (in-process servers, real sockets)
+# ---------------------------------------------------------------------------
+
+
+def _gcs_raylet_env(test_body, flags=None):
+    """Run ``test_body(gcs, raylet1, raylet2)`` against in-process
+    servers on one event loop (the dbg topology of the resilience
+    tests), with config flags reloaded around it."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet
+
+    config.reload(dict({"health_check_period_s": 1.0}, **(flags or {})))
+
+    async def main():
+        sd = tempfile.mkdtemp()
+        os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+        g = GcsServer(sd)
+        await g.start()
+        r1 = Raylet(sd, g.addr, {"CPU": 2})
+        await r1.start()
+        r2 = Raylet(sd, g.addr, {"CPU": 2})
+        await r2.start()
+        try:
+            await test_body(g, r1, r2)
+        finally:
+            for r in (r1, r2):
+                try:
+                    await r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            await g.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        config.reload()
+
+
+def test_drain_node_state_machine_and_broadcast():
+    async def body(g, r1, r2):
+        ack = await g.handle_drain_node(node_id=r1.node_id,
+                                        reason="maintenance",
+                                        deadline_s=30.0)
+        assert ack["accepted"]
+        node = g.nodes[r1.node_id]
+        assert node["state"] == "DRAINING" and node["alive"]
+        assert node["drain_reason"] == "maintenance"
+        # raylet acked the drain_self RPC and entered DRAINING
+        assert r1.draining and r1.drain_reason == "maintenance"
+        # broadcast on the node channel
+        ev = await g.handle_subscribe(cursor=0, channel="nodes",
+                                      timeout=0.1)
+        kinds = [e["event"] for e in ev["events"]]
+        assert "node_draining" in kinds
+        # cluster view carries the state for raylet-side avoidance
+        states = {n["node_id"]: n["state"] for n in g._cluster_view()}
+        assert states[r1.node_id] == "DRAINING"
+        assert states[r2.node_id] == "ALIVE"
+        # idempotent: a re-notice only ever SHORTENS the deadline
+        ack2 = await g.handle_drain_node(node_id=r1.node_id,
+                                         reason="again", deadline_s=5.0)
+        assert ack2["already_draining"]
+        assert ack2["deadline"] < ack["deadline"]
+        ack3 = await g.handle_drain_node(node_id=r1.node_id,
+                                         reason="laxer", deadline_s=500.0)
+        assert ack3["deadline"] == ack2["deadline"]
+        # unknown / dead nodes are rejected
+        assert not (await g.handle_drain_node(node_id="nope"))["accepted"]
+
+    _gcs_raylet_env(body)
+
+
+def test_drain_deadline_expiry_marks_node_dead():
+    async def body(g, r1, r2):
+        await g.handle_drain_node(node_id=r1.node_id, reason="preempt",
+                                  deadline_s=0.4)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if g.nodes[r1.node_id]["state"] == "DEAD":
+                break
+            await asyncio.sleep(0.1)
+        node = g.nodes[r1.node_id]
+        assert node["state"] == "DEAD" and not node["alive"]
+        assert "drain deadline expired" in node["death_reason"]
+
+    _gcs_raylet_env(body)
+
+
+def test_gcs_drain_scheduling_avoids_draining_node():
+    async def body(g, r1, r2):
+        from ray_tpu._private import scheduling
+        from ray_tpu._private.scheduling import NodeView, ResourceSet
+
+        await g.handle_drain_node(node_id=r1.node_id, reason="x",
+                                  deadline_s=30.0)
+        assert g._draining_node_ids() == {r1.node_id}
+        views = [NodeView(n["node_id"], n["total"], n["available"],
+                          n["labels"], n["alive"])
+                 for n in g.nodes.values()]
+        # actor-scheduling leg: pick avoids the draining node
+        pick = scheduling.pick_node(
+            views, ResourceSet({"CPU": 1}),
+            exclude_node_ids=g._draining_node_ids())
+        assert pick == r2.node_id
+        # placement-group leg: bundles avoid it too while they fit
+        placement = scheduling.pack_bundles(
+            views, [{"CPU": 1}], "PACK",
+            exclude_node_ids=g._draining_node_ids())
+        assert placement == [r2.node_id]
+
+    _gcs_raylet_env(body)
+
+
+@pytest.mark.chaos
+def test_fault_gcs_drain_broadcast():
+    """Armed ``gcs.drain_broadcast``: the drain RPC fails BEFORE any state
+    mutation — the node stays ALIVE (no half-drained record), and the
+    caller's retry succeeds once the fault clears."""
+    async def body(g, r1, r2):
+        with fi.armed("gcs.drain_broadcast", nth=1, count=1,
+                      exc=ConnectionError("injected broadcast loss")):
+            with pytest.raises(ConnectionError):
+                await g.handle_drain_node(node_id=r1.node_id,
+                                          reason="x", deadline_s=30.0)
+            assert fi.fired_count("gcs.drain_broadcast") == 1
+            assert g.nodes[r1.node_id]["state"] == "ALIVE"
+            assert not r1.draining
+            # the retry (2nd call) rides past the armed window
+            ack = await g.handle_drain_node(node_id=r1.node_id,
+                                            reason="x", deadline_s=30.0)
+            assert ack["accepted"]
+        assert g.nodes[r1.node_id]["state"] == "DRAINING"
+
+    _gcs_raylet_env(body)
+
+
+@pytest.mark.chaos
+def test_fault_raylet_drain_ack_falls_back_to_heartbeat():
+    """Armed ``raylet.drain_ack``: the raylet's drain_self ack dies, the
+    GCS still commits the drain, and the raylet adopts the drain from its
+    next heartbeat reply — the lost-RPC path of the protocol."""
+    async def body(g, r1, r2):
+        with fi.armed("raylet.drain_ack", nth=1, count=1,
+                      exc=ConnectionError("injected ack loss")):
+            ack = await g.handle_drain_node(node_id=r1.node_id,
+                                            reason="preempt",
+                                            deadline_s=30.0)
+            # counters reset on disarm: read them inside the window
+            assert fi.fired_count("raylet.drain_ack") == 1
+        assert ack["accepted"]  # drain committed despite the lost ack
+        assert g.nodes[r1.node_id]["state"] == "DRAINING"
+        # heartbeat period is health_check_period_s/5 = 0.2s here
+        deadline = time.time() + 10
+        while time.time() < deadline and not r1.draining:
+            await asyncio.sleep(0.05)
+        assert r1.draining and r1.drain_reason == "preempt"
+
+    _gcs_raylet_env(body)
+
+
+def test_draining_raylet_spills_new_leases():
+    """A draining raylet steers new leases to healthy peers (soft-avoid:
+    its own node joins the exclusion set)."""
+    async def body(g, r1, r2):
+        # let both raylets learn the cluster view
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+                len(r1.cluster_view) < 2 or len(r2.cluster_view) < 2):
+            await asyncio.sleep(0.05)
+        await g.handle_drain_node(node_id=r1.node_id, reason="x",
+                                  deadline_s=30.0)
+        assert r1.draining
+        reply = await r1.handle_lease_worker(resources={"CPU": 1})
+        # the grant must not land on the draining node
+        assert reply.get("spillback_node") == r2.node_id
+
+    _gcs_raylet_env(body)
+
+
+# ---------------------------------------------------------------------------
+# crash-atomic checkpoint commit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fault_train_checkpoint_commit_leaves_no_committed_dir(tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.train.checkpoint_manager import (
+        CheckpointManager, committed_checkpoint_dirs,
+        latest_committed_checkpoint)
+
+    storage = str(tmp_path / "storage")
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    with open(os.path.join(src, "model.txt"), "w") as f:
+        f.write("v1")
+
+    m = CheckpointManager(storage, num_to_keep=None, score_attribute=None)
+    with fi.armed("train.checkpoint.commit", nth=1, count=1,
+                  exc=RuntimeError("killed mid-commit")):
+        with pytest.raises(RuntimeError):
+            m.register(Checkpoint(src), {"loss": 1.0})
+    # the staged dir is there, but nothing restore would load
+    assert committed_checkpoint_dirs(storage) == []
+    assert latest_committed_checkpoint(storage) is None
+    assert any(n.endswith(".tmp") for n in os.listdir(storage))
+
+    # a fresh manager (the restarted run) sweeps the torn staging dir
+    # and commits cleanly
+    m2 = CheckpointManager(storage, num_to_keep=None, score_attribute=None)
+    assert not any(n.endswith(".tmp") for n in os.listdir(storage))
+    ck = m2.register(Checkpoint(src), {"loss": 0.5})
+    assert latest_committed_checkpoint(storage).path == ck.path
+    with open(os.path.join(ck.path, "model.txt")) as f:
+        assert f.read() == "v1"
+    # and a third manager resumes indexing ABOVE the existing commit
+    m3 = CheckpointManager(storage, num_to_keep=None, score_attribute=None)
+    ck3 = m3.register(Checkpoint(src), {})
+    assert os.path.basename(ck3.path) > os.path.basename(ck.path)
+
+
+@pytest.mark.chaos
+def test_sigkill_inside_checkpoint_commit_is_atomic(tmp_path):
+    """A process SIGKILLed INSIDE the commit window (the real preemption
+    shape, via the ``sigkill`` fault kind in a subprocess) never leaves a
+    checkpoint that restore will load."""
+    storage = str(tmp_path / "storage")
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    with open(os.path.join(src, "model.txt"), "w") as f:
+        f.write("payload")
+
+    prog = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from ray_tpu.train.checkpoint import Checkpoint\n"
+        "from ray_tpu.train.checkpoint_manager import CheckpointManager\n"
+        f"m = CheckpointManager({storage!r}, None, None)\n"
+        f"m.register(Checkpoint({src!r}), {{}})\n"
+        "print('COMMITTED')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env[fi.ENV_VAR] = "train.checkpoint.commit:1:1:sigkill"
+    proc = subprocess.run([sys.executable, "-c", prog], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    assert "COMMITTED" not in proc.stdout
+
+    from ray_tpu.train.checkpoint_manager import (
+        committed_checkpoint_dirs, latest_committed_checkpoint)
+
+    assert committed_checkpoint_dirs(storage) == []
+    assert latest_committed_checkpoint(storage) is None
+
+    # the restarted writer (no injection) commits; restore sees exactly
+    # the committed checkpoint and nothing torn
+    env.pop(fi.ENV_VAR)
+    proc = subprocess.run([sys.executable, "-c", prog], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    dirs = committed_checkpoint_dirs(storage)
+    assert len(dirs) == 1
+    ck = latest_committed_checkpoint(storage)
+    with open(os.path.join(ck.path, "model.txt")) as f:
+        assert f.read() == "payload"
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM -> self-drain, and the simulated-preemption hook (real cluster)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_sigterm_self_drain_and_preemption_hook(no_cluster, monkeypatch):
+    """One cluster, both raylet-initiated drain entry points:
+
+    - SIGTERM on a raylet holding a lease -> node goes DRAINING (visible
+      in the state API with reason/deadline), new placement avoids it,
+      and the node is gone by its deadline.
+    - RAY_TPU_SIMULATE_PREEMPTION on a second node -> the advance-notice
+      sequence fires on its own after the configured delay.
+    """
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        monkeypatch.setenv("RAY_TPU_NODE_DRAIN_DEADLINE_S", "4.0")
+        n1 = cluster.add_node(num_cpus=2)
+        monkeypatch.setenv("RAY_TPU_SIMULATE_PREEMPTION", "2.0:6.0")
+        n2 = cluster.add_node(num_cpus=2)
+        monkeypatch.delenv("RAY_TPU_SIMULATE_PREEMPTION")
+        cluster.wait_for_nodes()
+
+        # pin an actor (a lease holder) to n1 so its SIGTERM drain has
+        # something to wait for
+        @ray_tpu.remote
+        class Holder:
+            def node(self):
+                return ray_tpu.get_runtime_context().get_node_id()
+
+        h = Holder.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=n1.node_id, soft=False)).remote()
+        assert ray_tpu.get(h.node.remote(), timeout=30) == n1.node_id
+
+        n1.proc.send_signal(signal.SIGTERM)
+
+        def node_state(nid):
+            for n in ray_tpu.nodes():
+                if n["node_id"] == nid:
+                    return n
+            return None
+
+        # n1 reports DRAINING with the SIGTERM reason
+        deadline = time.time() + 15
+        seen_draining = None
+        while time.time() < deadline:
+            st = node_state(n1.node_id)
+            if st and st["state"] == "DRAINING":
+                seen_draining = st
+                break
+            time.sleep(0.1)
+        assert seen_draining, "SIGTERM never produced a DRAINING state"
+        assert seen_draining["drain_reason"] == "SIGTERM"
+        assert seen_draining["drain_deadline"] > time.time() - 1
+
+        # while n1 drains, fresh SPREAD tasks avoid it
+        @ray_tpu.remote
+        def whereami():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        spots = ray_tpu.get([
+            whereami.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(6)], timeout=60)
+        assert n1.node_id not in spots, spots
+
+        # n2's simulated preemption notice fires on its own
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = node_state(n2.node_id)
+            if st and st["state"] != "ALIVE":
+                break
+            time.sleep(0.1)
+        st = node_state(n2.node_id)
+        assert st["state"] in ("DRAINING", "DEAD"), st["state"]
+        if st["state"] == "DRAINING":
+            assert "preemption" in st["drain_reason"]
+
+        # both nodes are DEAD by their deadlines (SIGTERM exit or the
+        # GCS's deadline enforcement)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            s1, s2 = node_state(n1.node_id), node_state(n2.node_id)
+            if s1["state"] == "DEAD" and s2["state"] == "DEAD":
+                break
+            time.sleep(0.2)
+        assert node_state(n1.node_id)["state"] == "DEAD"
+        assert node_state(n2.node_id)["state"] == "DEAD"
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end to end: drain the node hosting train workers mid-run
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_train_drain_migrates_before_deadline(no_cluster, tmp_path,
+                                              monkeypatch):
+    """Drain the node hosting a train worker mid-run: the controller
+    checkpoints before the deadline and restarts the group off the
+    draining node; the run completes from the pre-drain checkpoint with
+    zero lost committed checkpoints and no step executed twice after the
+    resume point."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.policies import ElasticScalingPolicy
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        n1 = cluster.add_node(num_cpus=2, resources={"trainer_slot": 1})
+        n2 = cluster.add_node(num_cpus=2, resources={"trainer_slot": 1})
+        cluster.wait_for_nodes()
+        side = str(tmp_path / "side")
+        os.makedirs(side, exist_ok=True)
+
+        def loop(config):
+            import json as _json
+            import os as _os
+            import tempfile as _tempfile
+            import time as _t
+
+            from ray_tpu import train as _train
+
+            ctx = _train.get_context()
+            rank = ctx.get_world_rank()
+            start = 0
+            ck = ctx.get_checkpoint()
+            if ck is not None:
+                with open(_os.path.join(ck.path, "state.json")) as f:
+                    start = _json.load(f)["step"] + 1
+            for step in range(start, config["steps"]):
+                with open(_os.path.join(
+                        config["side_dir"],
+                        f"r{rank}-step{step}-{_t.time_ns()}"), "w") as f:
+                    _json.dump({"step": step, "rank": rank,
+                                "world": ctx.get_world_size(),
+                                "node": _os.environ.get(
+                                    "RAY_TPU_NODE_ID", "")}, f)
+                _t.sleep(config["step_s"])
+                d = _tempfile.mkdtemp()
+                with open(_os.path.join(d, "state.json"), "w") as f:
+                    _json.dump({"step": step}, f)
+                _train.report({"step": step,
+                               "world": ctx.get_world_size()},
+                              checkpoint=_train.Checkpoint(d))
+
+        drained = {}
+
+        def drainer():
+            # wait for step-1 evidence from a 2-worker run, find the
+            # node hosting rank 1, then deliver the advance notice
+            from ray_tpu.util.state import drain_node
+
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                for name in os.listdir(side):
+                    if not name.startswith("r1-step1-"):
+                        continue
+                    with open(os.path.join(side, name)) as f:
+                        info = json.load(f)
+                    if info["world"] == 2 and info["node"]:
+                        ack = drain_node(info["node"],
+                                         reason="spot reclaim",
+                                         deadline_s=8.0)
+                        drained["node"] = info["node"]
+                        drained["ack"] = ack
+                        return
+                time.sleep(0.2)
+
+        t = threading.Thread(target=drainer, daemon=True)
+        t.start()
+
+        trainer = train.DataParallelTrainer(
+            loop,
+            train_loop_config={"side_dir": side, "steps": 6,
+                               "step_s": 0.5},
+            scaling_config=train.ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+            run_config=train.RunConfig(
+                name="drain-run", storage_path=str(tmp_path),
+                failure_config=train.FailureConfig(max_failures=2)),
+            scaling_policy=ElasticScalingPolicy(
+                min_workers=1, max_workers=2,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+        )
+        result = trainer.fit()
+        t.join(timeout=5)
+
+        assert "node" in drained, "drainer never fired"
+        assert drained["ack"]["accepted"], drained["ack"]
+        assert result.error is None, result.error
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps[-1] == 5, f"did not finish: {steps}"
+        # resumed from the pre-drain checkpoint: contiguous, no gap
+        for a, b in zip(steps, steps[1:]):
+            assert b == a + 1 or b <= a, f"step gap: {steps}"
+        # zero lost committed checkpoints: every registered checkpoint
+        # dir is a committed (non-torn) one and the latest belongs to
+        # the final step
+        from ray_tpu.train.checkpoint_manager import (
+            committed_checkpoint_dirs, latest_committed_checkpoint)
+
+        storage = os.path.join(str(tmp_path), "drain-run")
+        assert committed_checkpoint_dirs(storage), "no commits"
+        assert not any(n.endswith(".tmp") for n in os.listdir(storage))
+        latest = latest_committed_checkpoint(storage)
+        with open(os.path.join(latest.path, "state.json")) as f:
+            assert json.load(f)["step"] == 5
+        # the replacement group never landed on the draining node
+        post_drain_nodes = set()
+        resumed = False
+        for name in sorted(os.listdir(side),
+                           key=lambda n: int(n.rsplit("-", 1)[1])):
+            with open(os.path.join(side, name)) as f:
+                info = json.load(f)
+            if info["world"] == 1:
+                resumed = True
+                post_drain_nodes.add(info["node"])
+        assert resumed, "group never restarted at the surviving size"
+        assert drained["node"] not in post_drain_nodes, post_drain_nodes
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serve: replica migration off a draining node
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_serve_migrates_replicas_off_draining_node(no_cluster, monkeypatch):
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.state import drain_node, list_actors
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        n1 = cluster.add_node(num_cpus=2, resources={"replica_slot": 2})
+        n2 = cluster.add_node(num_cpus=2, resources={"replica_slot": 2})
+        cluster.wait_for_nodes()
+
+        @serve.deployment(num_replicas=2,
+                          ray_actor_options={"resources":
+                                             {"replica_slot": 1}})
+        class Echo:
+            def __call__(self, x):
+                return x * 2
+
+        handle = serve.run(Echo.bind(), name="echo-drain")
+        assert handle.remote(21).result(timeout=60) == 42
+
+        def replica_nodes():
+            out = {}
+            for a in list_actors():
+                if a.get("class_name", "").endswith("ReplicaActor") \
+                        and a.get("state") == "ALIVE":
+                    out[a["actor_id"]] = a.get("node_id")
+            return out
+
+        # find a node actually hosting a replica, then drain it
+        before = replica_nodes()
+        assert before, "no live replicas"
+        victim_node = next(n for n in before.values()
+                           if n in (n1.node_id, n2.node_id))
+        ack = drain_node(victim_node, reason="maintenance", deadline_s=20.0)
+        assert ack["accepted"]
+
+        # the controller migrates: within the window every ALIVE replica
+        # sits off the draining node and capacity is back at goal
+        deadline = time.time() + 60
+        good = False
+        while time.time() < deadline:
+            now = replica_nodes()
+            if len(now) >= 2 and victim_node not in now.values():
+                good = True
+                break
+            time.sleep(0.5)
+        assert good, f"replicas still on draining node: {replica_nodes()}"
+        # and the deployment still serves
+        assert handle.remote(5).result(timeout=60) == 10
+        serve.shutdown()
+    finally:
+        cluster.shutdown()
