@@ -31,7 +31,8 @@ logger = logging.getLogger(__name__)
 # writes): they skip the dirty mark so an idle cluster never re-pickles.
 # Heartbeats mark dirty themselves only when `available` changes.
 _READONLY_HANDLERS = frozenset({
-    "heartbeat", "get_all_nodes", "kv_get", "kv_keys", "kv_exists",
+    "heartbeat", "get_all_nodes", "kv_get", "kv_keys", "kv_get_prefix",
+    "kv_exists",
     "list_jobs", "get_task_events", "report_task_events", "job_status",
     "job_logs", "list_submitted_jobs", "wait_actor_ready", "get_actor_info",
     "get_named_actor", "list_named_actors", "list_actors",
@@ -926,6 +927,14 @@ class GcsServer:
 
     async def handle_kv_keys(self, ns: str, prefix: str = "") -> List[str]:
         return [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]
+
+    async def handle_kv_get_prefix(self, ns: str, prefix: str = ""
+                                   ) -> Dict[str, bytes]:
+        """Batched prefix read (key -> value): one round trip where a
+        kv_keys + per-key kv_get loop would be N+1 (e.g. the state API
+        reading every collective member's status record)."""
+        return {k: v for (n, k), v in self.kv.items()
+                if n == ns and k.startswith(prefix)}
 
     async def handle_kv_exists(self, ns: str, key: str) -> bool:
         return (ns, key) in self.kv

@@ -1020,14 +1020,26 @@ class Raylet:
                                   self.available.to_dict(), self.labels, True)
             if _hard_ok(local_view):
                 return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token)
-            for v in self._node_views():
-                if v.node_id != self.node_id and _hard_ok(v):
-                    return {"spillback": self._addr_of(v.node_id),
-                            "spillback_node": v.node_id}
+            # This fallback must honor the soft-avoid set too: a retrying
+            # owner whose lease RPC just died against a node would
+            # otherwise be spilled straight back to the corpse (its
+            # heartbeat has not expired) until the retry budget burns out.
+            # Prefer non-avoided candidates; an avoided node is still
+            # taken when NOTHING else fits (soft avoidance never
+            # deadlocks a feasible request).
+            stale_ok = [v for v in self._node_views()
+                        if v.node_id != self.node_id and _hard_ok(v)]
+            preferred = next((v for v in stale_ok
+                              if v.node_id not in avoid), None)
+            if preferred is not None:
+                return {"spillback": self._addr_of(preferred.node_id),
+                        "spillback_node": preferred.node_id}
             # The heartbeat-cached cluster view can lag a just-registered
             # node by one sync period; consult the authoritative GCS node
-            # table before declaring the request permanently infeasible.
+            # table before falling back to an avoided (likely dying) node
+            # or declaring the request permanently infeasible.
             fresh = await self.gcs.call("get_all_nodes")
+            fresh_ok = []
             for n in fresh:
                 if n["node_id"] == self.node_id or not n.get("alive", True):
                     continue
@@ -1035,8 +1047,26 @@ class Raylet:
                                 n.get("available", n["total"]),
                                 n.get("labels"), True)
                 if _hard_ok(view):
-                    return {"spillback": n["addr"],
-                            "spillback_node": n["node_id"]}
+                    fresh_ok.append(n)
+            chosen = next((n for n in fresh_ok
+                           if n["node_id"] not in avoid), None)
+            if chosen is not None:
+                return {"spillback": chosen["addr"],
+                        "spillback_node": chosen["node_id"]}
+            # only avoided candidates remain: prefer ones the
+            # authoritative table still believes in — a stale view's
+            # feasible node that the GCS already dropped is a corpse
+            if fresh_ok:
+                n = fresh_ok[0]
+                return {"spillback": n["addr"],
+                        "spillback_node": n["node_id"]}
+            if stale_ok:
+                fresh_alive = {n["node_id"] for n in fresh
+                               if n.get("alive", True)}
+                v = next((v for v in stale_ok
+                          if v.node_id in fresh_alive), stale_ok[0])
+                return {"spillback": self._addr_of(v.node_id),
+                        "spillback_node": v.node_id}
             raise RuntimeError(
                 f"No node can ever satisfy resource request {resources} with "
                 f"strategy={strategy_kind} labels={label_selector}; cluster totals: "

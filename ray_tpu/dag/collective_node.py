@@ -40,7 +40,7 @@ class _CollectiveGroup:
     """One joint operation over N actor-resident values."""
 
     def __init__(self, inputs: List[ClassMethodNode], op: str,
-                 backend: str):
+                 backend: str, timeout_s: Optional[float] = None):
         if not inputs:
             raise ValueError("collective bind() needs at least one node")
         for n in inputs:
@@ -56,6 +56,10 @@ class _CollectiveGroup:
         self.inputs = list(inputs)
         self.op = op
         self.backend = backend
+        # threaded into the supervised group at compile time: a rank
+        # whose upstream failed leaves its peers to fail THIS iteration
+        # via watchdog abort within timeout_s, not hang the exec loops
+        self.timeout_s = timeout_s
         self.group_name = f"dag_collective_{uuid.uuid4().hex[:12]}"
 
     @property
@@ -94,7 +98,7 @@ class _CollectiveBinder:
         self.kind = kind
 
     def bind(self, nodes: List[ClassMethodNode], *, op: str = "sum",
-             backend: str = "tcp",
+             backend: str = "tcp", timeout_s: Optional[float] = None,
              transport: Optional[Any] = None) -> List[CollectiveNode]:
         del transport  # custom Communicators select via backend string
         if self.kind == "allreduce":
@@ -105,7 +109,7 @@ class _CollectiveBinder:
             kind = f"allreduce_{op}"
         else:
             kind = self.kind
-        group = _CollectiveGroup(nodes, kind, backend)
+        group = _CollectiveGroup(nodes, kind, backend, timeout_s=timeout_s)
         return [CollectiveNode(group, i) for i in range(len(nodes))]
 
 

@@ -708,7 +708,8 @@ class CompiledDAG:
         for group in self._collective_groups:
             _coll.create_collective_group(
                 [inp.actor for inp in group.inputs], group.world_size,
-                backend=group.backend, group_name=group.group_name)
+                backend=group.backend, group_name=group.group_name,
+                timeout_s=getattr(group, "timeout_s", None))
 
         # start exec loops
         import ray_tpu

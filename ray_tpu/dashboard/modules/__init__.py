@@ -8,6 +8,7 @@ the shared ``jresp`` JSON responder so modules stay framework-thin.
 
 from ray_tpu.dashboard.modules import (  # noqa: F401
     cluster,
+    collective,
     entities,
     logs,
     metrics,
@@ -16,4 +17,5 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
     train,
 )
 
-ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train)
+ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train,
+               collective)

@@ -1,0 +1,36 @@
+"""Collective module: group health / flight-recorder summary panel.
+
+Each collective group member's watchdog heartbeats a status record into
+the GCS KV (``collective/<group>/status/<rank>``, namespace
+"collective"): supervision state, last completed seq, in-flight op, node
+and pid.  The head folds them per group with the SAME aggregator the
+state API and CLI use (``supervision.aggregate_status_records``) —
+READY/ABORTED at a glance, plus the abort diagnosis when a watchdog
+fired (reference: the flight-recorder surfacing around PyTorch's NCCL
+watchdog).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_collective(_req):
+        from ray_tpu.util.collective.supervision import (
+            aggregate_status_records,
+        )
+
+        records = []
+        for (ns, key), raw in list(gcs.kv.items()):
+            if ns != "collective" or "/status/" not in key:
+                continue
+            try:
+                records.append(json.loads(raw))
+            except (ValueError, TypeError):
+                continue
+        return jresp({"groups": aggregate_status_records(records)})
+
+    return [("GET", "/api/collective", api_collective)]

@@ -61,11 +61,12 @@ function spark(points, w=220, h=36) {
 }
 
 async function renderOverview(root) {
-  const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train] =
+  const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train, coll] =
     await Promise.all([
       j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
       j("/api/placement_groups"), j("/api/submitted_jobs"),
-      j("/api/tasks/summary"), j("/api/serve"), j("/api/train")]);
+      j("/api/tasks/summary"), j("/api/serve"), j("/api/train"),
+      j("/api/collective")]);
   const taskRows = Object.entries(tasks).map(([name, s]) =>
     ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   const depRows = Object.entries(serve.deployments || {}).map(
@@ -76,6 +77,13 @@ async function renderOverview(root) {
     name: r.name, status: r.status, world: r.world_size,
     iteration: r.iteration, restarts: r.restarts,
     metrics: r.latest_metrics}));
+  const collRows = (coll.groups || []).map(g => ({
+    group: g.group_name, state: g.state, backend: g.backend,
+    epoch: g.epoch, members: `${g.joined}/${g.world_size}`,
+    progress: g.members.map(m => m.inflight
+      ? `r${m.rank}:${m.inflight.op}#${m.inflight.seq}`
+      : `r${m.rank}:idle@${m.last_done_seq}`).join(" "),
+    abort: g.abort_reason || ""}));
   root.innerHTML =
     "<h2>Nodes</h2>" + table(cluster.nodes,
       ["node_id","state","resources","available","stats"],
@@ -87,6 +95,8 @@ async function renderOverview(root) {
       : "<i>serve not running</i>") +
     "<h2>Train runs</h2>" + table(trainRows,
       ["name","status","world","iteration","restarts","metrics"]) +
+    "<h2>Collective groups</h2>" + table(collRows,
+      ["group","state","backend","epoch","members","progress","abort"]) +
     "<h2>Actors</h2>" + table(actors, ["actor_id","class_name","state","name","node_id"],
       (r, c) => c === "node_id" && r.node_id ? `#node/${r.node_id}` : null) +
     "<h2>Driver jobs</h2>" + table(jobs, ["job_id","state","start_time"]) +

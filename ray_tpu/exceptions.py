@@ -73,6 +73,44 @@ class WorkerCrashedError(RayTpuError):
     """The worker executing the task died (reference: WorkerCrashedError)."""
 
 
+class CollectiveAbortError(RayTpuError):
+    """A collective group was aborted mid-operation.
+
+    Raised on every member of the group — for the op in flight when the
+    abort fired (the watchdog closed the transport under it) and for every
+    op attempted afterwards — until the group is torn down and re-formed
+    (``destroy_collective_group`` + ``init_collective_group``).
+
+    Carries the supervision layer's diagnosis of WHY: a leader-validated
+    desync names the diverging rank, a hang timeout names the lagging
+    rank/seq that never submitted, a GCS event names the dead or draining
+    node.  ``diagnosis`` additionally holds this process's flight-recorder
+    tail (reference: PyTorch's NCCL watchdog + ``TORCH_NCCL_TRACE_BUFFER``
+    flight recorder).
+    """
+
+    def __init__(self, group_name: str = "", rank: Optional[int] = None,
+                 seq: Optional[int] = None, reason: str = "",
+                 diagnosis: str = ""):
+        self.group_name = group_name
+        self.rank = rank
+        self.seq = seq
+        self.reason = reason
+        self.diagnosis = diagnosis
+        where = [f"rank {rank}"] if rank is not None else []
+        if seq is not None:
+            where.append(f"seq {seq}")
+        loc = f" ({', '.join(where)})" if where else ""
+        msg = f"collective group {group_name!r} aborted{loc}: {reason}"
+        if diagnosis:
+            msg += f"\n{diagnosis}"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (type(self), (self.group_name, self.rank, self.seq,
+                             self.reason, self.diagnosis))
+
+
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id=None, msg: str = ""):
         self.object_id = object_id

@@ -88,6 +88,29 @@ def cmd_status(args):
               f"total={n['total']}{extra}")
     print("Cluster resources:", ray_tpu.cluster_resources())
     print("Available:", ray_tpu.available_resources())
+    try:
+        from ray_tpu.util.state import list_collective_groups
+
+        groups = list_collective_groups()
+    except Exception:  # noqa: BLE001 — status must render without KV
+        groups = []
+    if groups:
+        print("Collective groups:")
+        for g in groups:
+            line = (f"  {g['group_name']} [{g['state']}] "
+                    f"backend={g['backend']} epoch={g['epoch']} "
+                    f"members={g['joined']}/{g['world_size']}")
+            if g.get("abort_reason"):
+                line += f" abort: {g['abort_reason']}"
+            print(line)
+            for m in g["members"]:
+                inflight = m.get("inflight")
+                prog = (f"in-flight {inflight['op']} seq={inflight['seq']}"
+                        if inflight else
+                        f"idle after seq={m.get('last_done_seq', 0)}")
+                print(f"    rank {m['rank']} [{m.get('state')}] "
+                      f"node={str(m.get('node_id', ''))[:12]} "
+                      f"pid={m.get('pid')} {prog}")
     ray_tpu.shutdown()
 
 

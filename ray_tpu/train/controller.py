@@ -33,6 +33,22 @@ from ray_tpu.train.worker_group import WorkerGroup, WorkerStatus
 logger = logging.getLogger(__name__)
 
 
+def _drain_caused_collective_abort(error: Optional[str]) -> bool:
+    """True when a worker's failure is the collective watchdog aborting
+    on a node DRAIN event.  Matched on the watchdog's exact abort
+    phrasing (supervision.Watchdog._check_membership), NOT a bare
+    "drain" substring — the error text embeds the group name (which
+    contains the run name), so a run literally named "drain-..." must
+    not turn every collective abort into a free restart.  Such a failure
+    is a planned migration, not a fault: restart from the latest
+    checkpoint with no failure-budget charge — the same contract as the
+    advance-notice drain path in ``_maybe_handle_drain``."""
+    if not error or "CollectiveAbortError" not in error:
+        return False
+    return ("lost to node drain" in error
+            or "drain deadline expired" in error)
+
+
 class TrainController:
     def __init__(
         self,
@@ -288,6 +304,17 @@ class TrainController:
                     continue
 
                 errs = [s for s in statuses if s.error]
+                if errs and any(_drain_caused_collective_abort(s.error)
+                                for s in errs):
+                    logger.warning(
+                        "train %s: collective group aborted by a node "
+                        "drain covering a worker; restarting from the "
+                        "latest checkpoint (planned migration, no "
+                        "failure-budget charge):\n%s",
+                        self.name, errs[0].error)
+                    group.shutdown()
+                    group = self._restart_group()
+                    continue
                 if errs:
                     self._ctx.errors_seen += 1
                     first = errs[0].error

@@ -98,11 +98,19 @@ class TrainContext:
         group.  Loops that checkpoint every step can ignore this."""
         return _get_session().checkpoint_requested.is_set()
 
-    def collective_group(self, backend: str = "tcp") -> str:
+    def collective_group(self, backend: str = "tcp",
+                         timeout_s: Optional[float] = None) -> str:
         """Join (once) the all-workers collective group; returns its name.
 
         The DP pattern over DCN-separated hosts: compute grads locally,
         ``col.allreduce(grads, ctx.collective_group())``, apply locally.
+        The group name is generation-scoped, so a restarted worker group
+        re-forms a FRESH group (new epoch) — a watchdog-aborted
+        generation's rendezvous state can never leak into its
+        replacement.  ``timeout_s`` bounds every op: a peer that dies or
+        hangs mid-allreduce surfaces as ``CollectiveAbortError`` (a
+        worker failure the controller restarts from the latest
+        checkpoint) instead of wedging this loop forever.
         """
         from ray_tpu.util import collective as col
 
@@ -110,7 +118,7 @@ class TrainContext:
         name = f"train::{s.group_name}"
         if not col.is_group_initialized(name):
             col.init_collective_group(
-                s.world_size, s.rank, backend, name
+                s.world_size, s.rank, backend, name, timeout_s=timeout_s
             )
         return name
 

@@ -14,6 +14,8 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     broadcast,
     create_collective_group,
     destroy_collective_group,
+    flight_recorder_dump,
+    get_group_state,
     get_rank,
     get_collective_group_size,
     init_collective_group,
@@ -23,4 +25,8 @@ from ray_tpu.util.collective.collective import (  # noqa: F401
     reducescatter,
     send,
 )
-from ray_tpu.util.collective.types import Backend, ReduceOp  # noqa: F401
+from ray_tpu.util.collective.types import (  # noqa: F401
+    Backend,
+    GroupState,
+    ReduceOp,
+)

@@ -5,6 +5,13 @@ Each participating process calls ``init_collective_group`` (typically from
 inside its actor/task), then the module-level ops.  ``create_collective_
 group`` does the same from the driver for a set of actors, using the
 generic ``_remote_call`` mechanism so user classes need no extra methods.
+
+Every group is wrapped in a :class:`~ray_tpu.util.collective.supervision.
+SupervisedGroup` — the watchdog/flight-recorder spine — so every public
+op carries a sequence number, lands in the flight recorder, and raises
+``CollectiveAbortError`` (instead of hanging) when the group aborts.
+``destroy_collective_group`` + ``init_collective_group`` is the supported
+re-init path after an abort.
 """
 
 from __future__ import annotations
@@ -13,6 +20,11 @@ import logging
 import threading
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.util.collective.supervision import (  # noqa: F401 — re-export
+    SupervisedGroup,
+    flight_recorder_dump,
+    resolve_timeout,
+)
 from ray_tpu.util.collective.types import Backend, ReduceOp
 
 logger = logging.getLogger(__name__)
@@ -23,7 +35,8 @@ class GroupManager:
         self._groups: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
-    def create(self, backend, world_size: int, rank: int, group_name: str):
+    def create(self, backend, world_size: int, rank: int, group_name: str,
+               timeout_s: Optional[float] = None):
         backend = Backend.parse(backend)
         with self._lock:
             if group_name in self._groups:
@@ -35,7 +48,8 @@ class GroupManager:
                 TcpGroup,
             )
 
-            g = TcpGroup(world_size, rank, group_name)
+            inner = TcpGroup(world_size, rank, group_name,
+                             timeout_s=timeout_s)
         elif backend == Backend.XLA_MESH:
             # one PROCESS owning the whole device mesh: "ranks" are its
             # devices, so the declared (actor) world size must be 1 and
@@ -53,13 +67,16 @@ class GroupManager:
                     "path: exactly one participating process owns the "
                     f"mesh (got world_size={world_size}); use "
                     "backend='xla' for rank-per-process meshes")
-            g = XlaMeshGroup(len(jax.devices()), 0, group_name)
+            inner = XlaMeshGroup(len(jax.devices()), 0, group_name)
         else:
             from ray_tpu.util.collective.collective_group.xla_group import (
                 XlaDistributedGroup,
             )
 
-            g = XlaDistributedGroup(world_size, rank, group_name)
+            inner = XlaDistributedGroup(world_size, rank, group_name,
+                                        timeout_s=timeout_s)
+        g = SupervisedGroup(inner, timeout_s=timeout_s,
+                            backend=backend.value)
         with self._lock:
             self._groups[group_name] = g
         return g
@@ -91,9 +108,22 @@ def init_collective_group(
     rank: int,
     backend: str = "tcp",
     group_name: str = "default",
+    timeout_s: Optional[float] = None,
 ) -> None:
-    """Initialize this process's membership in a collective group."""
-    _group_mgr.create(backend, world_size, rank, group_name)
+    """Initialize this process's membership in a collective group.
+
+    ``timeout_s`` bounds rendezvous AND every op on this member (watchdog
+    abort past it); default from ``RAY_TPU_COLLECTIVE_TIMEOUT`` env or the
+    ``collective_op_timeout_s`` config flag.
+    """
+    _group_mgr.create(backend, world_size, rank, group_name,
+                      timeout_s=timeout_s)
+
+
+def _drop_rendezvous_keys(group_name: str) -> None:
+    from ray_tpu.util.collective.supervision import drop_group_keys
+
+    drop_group_keys(group_name)
 
 
 def create_collective_group(
@@ -102,12 +132,16 @@ def create_collective_group(
     ranks: Optional[List[int]] = None,
     backend: str = "tcp",
     group_name: str = "default",
+    timeout_s: Optional[float] = None,
 ) -> None:
     """Driver-side setup: make ``actors`` a collective group.
 
     Dispatches ``init_collective_group`` into every actor (via the generic
     in-actor call, so user classes need no special methods) and blocks until
-    all ranks have joined.
+    all ranks have joined — bounded: an actor that dies (or never schedules)
+    before joining fails the call within the timeout instead of hanging the
+    driver forever, and the partially-formed group is torn down (joined
+    ranks destroyed, rendezvous keys dropped) so the name is reusable.
     """
     import ray_tpu
 
@@ -117,16 +151,47 @@ def create_collective_group(
         raise ValueError(
             f"{len(actors)} actors, {len(ranks)} ranks, world={world_size}"
         )
+    op_timeout = resolve_timeout(timeout_s)
 
-    def _join(_self, world_size, rank, backend, group_name):
-        init_collective_group(world_size, rank, backend, group_name)
+    def _join(_self, world_size, rank, backend, group_name, timeout_s):
+        init_collective_group(world_size, rank, backend, group_name,
+                              timeout_s=timeout_s)
         return rank
 
-    refs = [
-        a._remote_call.remote(_join, world_size, r, backend, group_name)
-        for a, r in zip(actors, ranks)
-    ]
-    ray_tpu.get(refs)
+    def _leave(_self, group_name):
+        try:
+            destroy_collective_group(group_name)
+        except Exception:  # noqa: BLE001 — never joined / already gone
+            pass
+        return True
+
+    try:
+        refs = [
+            a._remote_call.remote(_join, world_size, r, backend, group_name,
+                                  timeout_s)
+            for a, r in zip(actors, ranks)
+        ]
+        # margin above the rendezvous timeout: the join tasks themselves
+        # need to schedule and run
+        ray_tpu.get(refs, timeout=op_timeout + 30.0)
+    except Exception:
+        logger.warning(
+            "collective group %r: not all %d rank(s) joined — tearing "
+            "down the partial group", group_name, world_size)
+        leave_refs = []
+        for a in actors:
+            try:
+                leave_refs.append(a._remote_call.remote(_leave, group_name))
+            except Exception:  # noqa: BLE001 — dead actor
+                pass
+        try:
+            # ONE bounded wait for the whole teardown — a per-ref loop
+            # would multiply the bound by world size
+            ray_tpu.get(leave_refs, timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        _drop_rendezvous_keys(group_name)
+        raise
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -143,6 +208,13 @@ def get_rank(group_name: str = "default") -> int:
 
 def get_collective_group_size(group_name: str = "default") -> int:
     return _group_mgr.get(group_name).world_size
+
+
+def get_group_state(group_name: str = "default") -> str:
+    """Supervision state of this process's membership (READY | ABORTED).
+    A destroyed group is removed from the registry entirely, so querying
+    it raises RuntimeError like any other uninitialized name."""
+    return _group_mgr.get(group_name).state.value
 
 
 def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):

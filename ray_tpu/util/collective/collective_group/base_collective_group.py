@@ -27,6 +27,13 @@ class BaseGroup(abc.ABC):
     def group_name(self) -> str:
         return self._group_name
 
+    def abort(self, reason: str = "") -> None:
+        """Tear the transport out from under any blocked op so it raises
+        promptly (watchdog abort).  Default: nothing to close — backends
+        whose ops block in an interruptible transport (TCP sockets)
+        override this; in-runtime backends (XLA) rely on the supervision
+        wrapper poisoning future ops instead."""
+
     @abc.abstractmethod
     def destroy_group(self) -> None: ...
 

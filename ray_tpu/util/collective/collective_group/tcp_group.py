@@ -8,6 +8,25 @@ name; every rank (including 0) connects as a client.  Collectives are
 gather-compute-scatter at the leader; point-to-point send/recv is routed
 through the leader's mailbox keyed (src, dst, tag).
 
+Supervision (this file's half of the collective watchdog; the member-side
+half is ``util/collective/supervision.py``):
+
+- Rendezvous is **epoch-versioned**: rank 0 bumps ``collective/<group>/
+  epoch`` and publishes ``{"epoch", "addr"}``; joiners accept a leader
+  entry only when its epoch matches the counter AND the leader's hello-ack
+  confirms it — a re-formed group can never connect to a stale leader, and
+  a crashed leader's dangling entry is outgrown by the next epoch bump.
+- The leader **validates desync**: when a seq completes, submissions are
+  majority-voted on (op kind, reduce op, shape, dtype); divergers abort
+  the whole group with the diverging rank named.
+- A leader-side **monitor** aborts when the oldest pending seq waits
+  longer than ``timeout_s``, naming the lagging rank(s) that never
+  submitted — the authoritative hang diagnosis (the member watchdog is
+  the backstop for a dead leader).
+- ``abort()`` broadcasts ``{"abort": diagnosis}`` to every member and
+  closes all sockets, so every blocked op raises ``CollectiveAbortError``
+  promptly instead of waiting out its socket timeout.
+
 This is the correctness/portability backend (control-plane reductions, CPU
 smoke tests — the north-star "allreduce over 4 CPU workers" config); the
 bandwidth path on TPU is the XLA backend.
@@ -15,6 +34,7 @@ bandwidth path on TPU is the XLA backend.
 
 from __future__ import annotations
 
+import json
 import pickle
 import socket
 import struct
@@ -24,10 +44,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.exceptions import CollectiveAbortError
 from ray_tpu.util.collective.collective_group.base_collective_group import (
     BaseGroup,
 )
 from ray_tpu.util.collective.types import ReduceOp
+from ray_tpu.util.fault_injection import fault_point
 
 _REDUCE = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
@@ -35,6 +57,10 @@ _REDUCE = {
     ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
     ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
 }
+
+# ops whose per-rank submissions must agree on shape/dtype for the math
+# to mean anything; broadcast/allgather legitimately mix shapes
+_SHAPE_STRICT_OPS = ("allreduce", "reduce", "reducescatter")
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:
@@ -64,10 +90,18 @@ def _as_numpy(tensor) -> np.ndarray:
 
 
 class _LeaderServer:
-    """Rank-0 server: collects per-seq submissions, computes, replies."""
+    """Rank-0 server: collects per-seq submissions, computes, replies.
 
-    def __init__(self, world_size: int):
+    Also the group's authoritative failure detector: desync validation at
+    seq completion, a pending-age monitor for hangs, and conn-loss
+    detection — each aborts the group with the culprit rank named.
+    """
+
+    def __init__(self, world_size: int, epoch: int = 0,
+                 timeout_s: float = 60.0):
         self.world_size = world_size
+        self.epoch = epoch
+        self.timeout_s = timeout_s
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # Bind all interfaces and publish a routable IP so ranks on other
@@ -80,19 +114,31 @@ class _LeaderServer:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[int, Dict[int, Dict]] = {}
+        self._pending_t0: Dict[int, float] = {}
         self._results: Dict[int, Dict[int, Any]] = {}
         self._mailbox: Dict[Tuple[int, int, int], Any] = {}  # (src,dst,tag)
         self._conns: Dict[int, socket.socket] = {}
+        # per-connection send locks: the abort broadcast (monitor/other
+        # handler threads) and a handler's own reply would otherwise
+        # interleave inside sendall and corrupt the length-prefixed frame
+        # stream mid-message
+        self._send_locks: Dict[int, threading.Lock] = {}
         self._stop = False
+        self._abort: Optional[str] = None
         self._threads: List[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="coll-leader"
         )
         self._accept_thread.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="coll-leader-mon"
+        )
+        self._monitor_thread.start()
 
     def _accept_loop(self):
-        accepted = 0
-        while not self._stop and accepted < self.world_size:
+        # accept until shutdown (not a fixed count): a stale-epoch joiner
+        # must not consume a legitimate member's slot
+        while not self._stop:
             try:
                 conn, _ = self.sock.accept()
             except OSError:
@@ -103,15 +149,33 @@ class _LeaderServer:
             )
             t.start()
             self._threads.append(t)
-            accepted += 1
 
     def _serve_conn(self, conn: socket.socket):
+        rank: Optional[int] = None
         try:
+            # the accept loop is unbounded (stale-epoch joiners must not
+            # consume member slots), so a connection that never speaks —
+            # port probes, half-open sockets — must not pin this thread
+            # and its fd for the group's lifetime
+            conn.settimeout(self.timeout_s)
             hello = _recv_msg(conn)
+            if hello.get("epoch", self.epoch) != self.epoch:
+                # a joiner from another incarnation read a stale KV entry
+                _send_msg(conn, {"abort": (
+                    f"stale rendezvous: joiner epoch "
+                    f"{hello.get('epoch')} != leader epoch {self.epoch}")})
+                conn.close()
+                return
             rank = hello["rank"]
             with self._lock:
                 self._conns[rank] = conn
+                self._send_locks[rank] = threading.Lock()
+            # members legitimately idle between ops indefinitely: back to
+            # blocking reads once the member proved itself
+            conn.settimeout(None)
+            self._send_to(rank, conn, {"ok": True, "epoch": self.epoch})
             while not self._stop:
+                fault_point("collective.leader.recv")
                 msg = _recv_msg(conn)
                 kind = msg["kind"]
                 if kind == "collective":
@@ -124,55 +188,211 @@ class _LeaderServer:
                 elif kind == "recv":
                     key = (msg["src"], rank, msg.get("tag", 0))
                     with self._cv:
-                        while not self._mailbox.get(key) and not self._stop:
+                        while (not self._mailbox.get(key) and not self._stop
+                               and not self._abort):
                             self._cv.wait(timeout=1.0)
+                        if self._abort:
+                            break  # abort broadcast already reached them
                         q = self._mailbox.get(key)
                         data = q.pop(0) if q else None
-                    _send_msg(conn, {"data": data})
+                    self._send_to(rank, conn, {"data": data})
                 elif kind == "shutdown":
                     return
         except (ConnectionError, OSError, EOFError):
+            if rank is not None and not self._stop and self._abort is None:
+                self.abort(self._conn_loss_diag(rank))
             return
+
+    def _send_to(self, rank: Optional[int], conn: socket.socket,
+                 obj: Any) -> None:
+        """All post-hello sends to a member go through its send lock so
+        concurrent writers can never interleave a frame."""
+        lock = self._send_locks.get(rank) if rank is not None else None
+        if lock is None:
+            _send_msg(conn, obj)
+            return
+        with lock:
+            _send_msg(conn, obj)
+
+    def _conn_loss_diag(self, rank: int) -> str:
+        with self._lock:
+            if self._pending_t0:
+                seq = min(self._pending_t0)
+                bucket = self._pending.get(seq, {})
+                op = next(iter(bucket.values()))["op"] if bucket else "?"
+                missing = sorted(set(range(self.world_size)) - set(bucket))
+                return (f"rank {rank} connection lost while op={op} "
+                        f"seq={seq} in flight (waiting on rank(s) "
+                        f"{missing})")
+        return f"rank {rank} connection lost (member died or was killed)"
 
     def _handle_collective(self, conn, rank, msg):
         seq = msg["seq"]
+        abort_diag = None
+        notify_abort = False
+        reply = None
         with self._cv:
-            self._pending.setdefault(seq, {})[rank] = msg
-            if len(self._pending[seq]) == self.world_size:
-                self._results[seq] = self._compute(self._pending.pop(seq))
-                self._cv.notify_all()
+            if self._abort:
+                abort_diag = self._abort
             else:
-                while seq not in self._results and not self._stop:
-                    self._cv.wait(timeout=1.0)
-            reply = self._results[seq][rank]
-            # Last reader cleans up.
-            self._results[seq]["_reads"] = (
-                self._results[seq].get("_reads", 0) + 1
-            )
-            if self._results[seq]["_reads"] == self.world_size:
-                del self._results[seq]
-        _send_msg(conn, {"data": reply})
+                bucket = self._pending.setdefault(seq, {})
+                if not bucket:
+                    self._pending_t0[seq] = time.time()
+                bucket[rank] = msg
+                if len(bucket) == self.world_size:
+                    self._pending.pop(seq)
+                    self._pending_t0.pop(seq, None)
+                    diag = self._validate(seq, bucket)
+                    if diag is None:
+                        try:
+                            self._results[seq] = self._compute(bucket)
+                            self._cv.notify_all()
+                        except Exception as e:  # noqa: BLE001
+                            # a compute failure past validation must
+                            # abort loudly, not kill this serve thread
+                            # and strand every waiter
+                            abort_diag = (f"collective compute failed at "
+                                          f"seq={seq}: {e!r}")
+                            notify_abort = True
+                    else:
+                        abort_diag = diag
+                        notify_abort = True
+                else:
+                    while (seq not in self._results and not self._stop
+                           and not self._abort):
+                        self._cv.wait(timeout=1.0)
+                    if self._abort:
+                        # the abort broadcast already wrote to our socket
+                        return
+                    if seq not in self._results:
+                        abort_diag = "collective group shut down"
+            if abort_diag is None:
+                reply = self._results[seq][rank]
+                # Last reader cleans up.
+                self._results[seq]["_reads"] = (
+                    self._results[seq].get("_reads", 0) + 1
+                )
+                if self._results[seq]["_reads"] == self.world_size:
+                    del self._results[seq]
+        if abort_diag is not None:
+            if notify_abort:
+                self.abort(abort_diag)  # broadcasts to every conn
+            else:
+                try:
+                    self._send_to(rank, conn, {"abort": abort_diag})
+                except OSError:
+                    pass
+            return
+        self._send_to(rank, conn, {"data": reply})
+
+    def _validate(self, seq: int, msgs: Dict[int, Dict]) -> Optional[str]:
+        """Majority-vote the submissions for one seq; a diverger is a
+        desync — return the abort diagnosis naming it, else None."""
+
+        def key_of(m):
+            op = m["op"]
+            if op in _SHAPE_STRICT_OPS:
+                d = m.get("data")
+                return (op, m.get("rop"), np.shape(d),
+                        str(getattr(d, "dtype", None)))
+            return (op, m.get("rop"))
+
+        by_key: Dict[tuple, List[int]] = {}
+        for r, m in msgs.items():
+            by_key.setdefault(key_of(m), []).append(r)
+        if len(by_key) == 1:
+            return None
+        # majority wins; deterministic tie-break on the lowest rank
+        majority = max(by_key.items(),
+                       key=lambda kv: (len(kv[1]), -min(kv[1])))[0]
+        divergers = sorted(r for k, rs in by_key.items() if k != majority
+                           for r in rs)
+        det = "; ".join(
+            f"rank(s) {sorted(rs)} submitted op={k[0]} rop={k[1]}"
+            + (f" shape={k[2]} dtype={k[3]}" if len(k) > 2 else "")
+            for k, rs in sorted(by_key.items(), key=lambda kv: min(kv[1])))
+        return (f"collective desync at seq={seq}: diverging rank(s) "
+                f"{divergers} disagree with the majority — {det}")
+
+    def _monitor_loop(self):
+        """Abort when the oldest pending seq outlives timeout_s, naming
+        the lagging rank(s) that never submitted it."""
+        tick = max(0.1, min(0.5, self.timeout_s / 4.0))
+        while not self._stop and self._abort is None:
+            time.sleep(tick)
+            diag = None
+            with self._lock:
+                if self._stop or self._abort or not self._pending_t0:
+                    continue
+                seq = min(self._pending_t0)
+                age = time.time() - self._pending_t0[seq]
+                if age > self.timeout_s:
+                    bucket = self._pending.get(seq, {})
+                    op = (next(iter(bucket.values()))["op"]
+                          if bucket else "?")
+                    missing = sorted(
+                        set(range(self.world_size)) - set(bucket))
+                    diag = (f"collective hang: op={op} seq={seq} waited "
+                            f"{age:.1f}s > timeout {self.timeout_s:.1f}s; "
+                            f"lagging rank(s) {missing} never submitted "
+                            f"seq={seq} (submitted: {sorted(bucket)})")
+            if diag:
+                self.abort(diag)
+                return
+
+    def abort(self, diagnosis: str):
+        """Broadcast the abort to every member and tear the server down:
+        every blocked client op raises ``CollectiveAbortError`` now."""
+        with self._cv:
+            if self._abort is not None:
+                return
+            self._abort = diagnosis
+            self._cv.notify_all()
+            conns = list(self._conns.items())
+        for rank, conn in conns:
+            # bounded lock wait, not _send_to: a rank wedged mid-reply
+            # (its TCP buffer full, sendall blocked holding the lock)
+            # must not stall the whole broadcast and defer shutdown() —
+            # that rank's abort is delivered by the socket close instead
+            lock = self._send_locks.get(rank)
+            acquired = lock.acquire(timeout=0.5) if lock else True
+            try:
+                if acquired:
+                    _send_msg(conn, {"abort": diagnosis})
+            except OSError:
+                pass
+            finally:
+                if acquired and lock is not None:
+                    lock.release()
+        # grace before closing: a member BETWEEN ops may write its next
+        # request into this socket — an immediate close would turn that
+        # into an RST that discards the queued abort frame from the
+        # member's receive buffer, degrading its named diagnosis into a
+        # generic transport failure
+        time.sleep(0.2)
+        self.shutdown()
 
     def _compute(self, msgs: Dict[int, Dict]) -> Dict[int, Any]:
-        op = msgs[0]["op"]
+        op = msgs[0]["op"] if 0 in msgs else next(iter(msgs.values()))["op"]
         world = self.world_size
         if op == "barrier":
             return {r: None for r in range(world)}
         tensors = [msgs[r]["data"] for r in range(world)]
+        first = msgs[min(msgs)]
         if op == "allreduce":
-            out = _REDUCE[ReduceOp(msgs[0]["rop"])](tensors)
+            out = _REDUCE[ReduceOp(first["rop"])](tensors)
             return {r: out for r in range(world)}
         if op == "reduce":
-            out = _REDUCE[ReduceOp(msgs[0]["rop"])](tensors)
-            dst = msgs[0]["dst"]
+            out = _REDUCE[ReduceOp(first["rop"])](tensors)
+            dst = first["dst"]
             return {r: (out if r == dst else None) for r in range(world)}
         if op == "broadcast":
-            src = msgs[0]["src"]
+            src = first["src"]
             return {r: tensors[src] for r in range(world)}
         if op == "allgather":
             return {r: tensors for r in range(world)}
         if op == "reducescatter":
-            out = _REDUCE[ReduceOp(msgs[0]["rop"])](tensors)
+            out = _REDUCE[ReduceOp(first["rop"])](tensors)
             chunks = np.split(out, world, axis=0)
             return {r: chunks[r] for r in range(world)}
         raise ValueError(f"unknown collective op {op}")
@@ -185,6 +405,14 @@ class _LeaderServer:
             self.sock.close()
         except OSError:
             pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class TcpGroup(BaseGroup):
@@ -194,55 +422,136 @@ class TcpGroup(BaseGroup):
         rank: int,
         group_name: str,
         *,
-        timeout_s: float = 60.0,
+        timeout_s: Optional[float] = None,
     ):
         super().__init__(world_size, rank, group_name)
         from ray_tpu.experimental import internal_kv
+        from ray_tpu.util.collective.supervision import resolve_timeout
 
-        self._timeout = timeout_s
+        self._timeout = resolve_timeout(timeout_s)
         self._seq = 0
+        self._aborted: Optional[str] = None
         self._server: Optional[_LeaderServer] = None
-        key = f"collective/{group_name}/leader"
+        epoch_key = f"collective/{group_name}/epoch"
+        leader_key = f"collective/{group_name}/leader"
         if rank == 0:
-            self._server = _LeaderServer(world_size)
+            from ray_tpu.util.collective.supervision import (
+                drop_group_status_keys,
+            )
+
+            fault_point("collective.rendezvous")
+            raw = internal_kv._internal_kv_get(
+                epoch_key.encode(), namespace="collective")
+            self.epoch = int(raw or 0) + 1
+            # sweep ghost member records of ranks that died without
+            # cleanup in a previous incarnation — they must not haunt
+            # the new epoch's membership view
+            drop_group_status_keys(group_name)
+            self._server = _LeaderServer(
+                world_size, epoch=self.epoch, timeout_s=self._timeout)
             internal_kv._internal_kv_put(
-                key.encode(), self._server.addr.encode(),
+                epoch_key.encode(), str(self.epoch).encode(),
+                namespace="collective")
+            internal_kv._internal_kv_put(
+                leader_key.encode(),
+                json.dumps({"epoch": self.epoch,
+                            "addr": self._server.addr}).encode(),
                 namespace="collective",
             )
             addr = self._server.addr
+            self._sock = self._connect(addr, rank)
         else:
-            deadline = time.monotonic() + timeout_s
-            addr = None
-            while time.monotonic() < deadline:
-                raw = internal_kv._internal_kv_get(
-                    key.encode(), namespace="collective"
-                )
-                if raw:
-                    addr = raw.decode()
-                    break
+            deadline = time.monotonic() + self._timeout
+            self._sock = None
+            self.epoch = 0
+            last_err: Optional[BaseException] = None
+            while time.monotonic() < deadline and self._sock is None:
+                fault_point("collective.rendezvous")
+                raw_entry = internal_kv._internal_kv_get(
+                    leader_key.encode(), namespace="collective")
+                if raw_entry:
+                    entry = self._parse_leader_entry(raw_entry)
+                    raw_epoch = internal_kv._internal_kv_get(
+                        epoch_key.encode(), namespace="collective")
+                    current = int(raw_epoch or entry["epoch"])
+                    # reject entries from a previous incarnation: a
+                    # crashed leader's dangling address must never be
+                    # joined once a newer epoch exists
+                    if entry["epoch"] == current:
+                        try:
+                            self.epoch = entry["epoch"]
+                            self._sock = self._connect(entry["addr"], rank)
+                            break
+                        except (ConnectionError, OSError,
+                                CollectiveAbortError) as e:
+                            # dead (or stale-epoch-rejecting) leader:
+                            # keep polling for the next incarnation
+                            last_err = e
+                            self._sock = None
                 time.sleep(0.05)
-            if addr is None:
+            if self._sock is None:
                 raise TimeoutError(
-                    f"collective group {group_name!r}: leader never "
-                    f"published its address"
-                )
-        host, port = addr.rsplit(":", 1)
-        self._sock = socket.create_connection(
-            (host, int(port)), timeout=timeout_s
+                    f"collective group {group_name!r}: no live leader for "
+                    f"a current epoch within {self._timeout:.1f}s"
+                    + (f" (last error: {last_err!r})" if last_err else ""))
+
+    @staticmethod
+    def _parse_leader_entry(raw: bytes) -> Dict[str, Any]:
+        from ray_tpu.util.collective.supervision import (
+            parse_rendezvous_entry,
         )
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _send_msg(self._sock, {"rank": rank})
+
+        return parse_rendezvous_entry(raw)
+
+    def _connect(self, addr: str, rank: int) -> socket.socket:
+        host, port = addr.rsplit(":", 1)
+        sock = socket.create_connection(
+            (host, int(port)), timeout=self._timeout
+        )
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _send_msg(sock, {"rank": rank, "epoch": self.epoch})
+        # hello-ack: the leader confirms the epoch (or rejects a stale
+        # joiner) before any op can flow
+        sock.settimeout(self._timeout)
+        ack = _recv_msg(sock)
+        if "abort" in ack:
+            sock.close()
+            raise CollectiveAbortError(
+                group_name=self.group_name, rank=rank,
+                reason=ack["abort"])
+        return sock
 
     # ----------------------------------------------------------------- ops
+    def _raise_if_aborted(self, seq: Optional[int] = None) -> None:
+        if self._aborted is not None:
+            raise CollectiveAbortError(
+                group_name=self.group_name, rank=self.rank, seq=seq,
+                reason=self._aborted)
+
+    def _roundtrip(self, request: Dict[str, Any], seq: Optional[int]):
+        """Send one request and read its reply, mapping a leader abort
+        broadcast to ``CollectiveAbortError``."""
+        self._raise_if_aborted(seq)
+        _send_msg(self._sock, request)
+        # generous socket backstop: the watchdog/leader monitor own the
+        # real deadline and close this socket with a diagnosis attached —
+        # a bare socket.timeout would lose it
+        self._sock.settimeout(self._timeout * 2 + 5.0)
+        reply = _recv_msg(self._sock)
+        if "abort" in reply:
+            self._aborted = reply["abort"]
+            raise CollectiveAbortError(
+                group_name=self.group_name, rank=self.rank, seq=seq,
+                reason=reply["abort"])
+        return reply["data"]
+
     def _collective(self, op: str, data=None, **kw):
         self._seq += 1
-        _send_msg(
-            self._sock,
+        return self._roundtrip(
             {"kind": "collective", "op": op, "seq": self._seq, "data": data,
              **kw},
+            self._seq,
         )
-        self._sock.settimeout(self._timeout)
-        return _recv_msg(self._sock)["data"]
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         return self._collective(
@@ -276,6 +585,7 @@ class TcpGroup(BaseGroup):
         )
 
     def send(self, tensor, dst_rank: int, tag: int = 0) -> None:
+        self._raise_if_aborted()
         _send_msg(
             self._sock,
             {"kind": "send", "dst": dst_rank, "tag": tag,
@@ -283,9 +593,21 @@ class TcpGroup(BaseGroup):
         )
 
     def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
-        _send_msg(self._sock, {"kind": "recv", "src": src_rank, "tag": tag})
-        self._sock.settimeout(self._timeout)
-        return _recv_msg(self._sock)["data"]
+        return self._roundtrip(
+            {"kind": "recv", "src": src_rank, "tag": tag}, None)
+
+    # ----------------------------------------------------------- lifecycle
+    def abort(self, reason: str = "") -> None:
+        """Close the transport under any blocked op (it raises promptly)
+        and poison future ops.  Leader: broadcast to every member first."""
+        if self._aborted is None:
+            self._aborted = reason or "group aborted"
+        if self._server is not None:
+            self._server.abort(reason or "group aborted")
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
     def destroy_group(self) -> None:
         try:
@@ -295,8 +617,11 @@ class TcpGroup(BaseGroup):
             pass
         if self._server is not None:
             self._server.shutdown()
-            # drop the rendezvous key so a later group with the same name
-            # can't read this (now dead) leader's address
+            # drop the rendezvous entry so a later group with the same
+            # name can't read this (now dead) leader's address; the epoch
+            # counter is left behind on purpose — the next incarnation
+            # bumps above it, which is what invalidates any copy of this
+            # entry still cached anywhere
             try:
                 from ray_tpu.experimental import internal_kv
 

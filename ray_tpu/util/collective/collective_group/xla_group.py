@@ -234,12 +234,14 @@ class XlaDistributedGroup(BaseGroup):
 
     def __init__(
         self, world_size: int, rank: int, group_name: str,
-        *, timeout_s: float = 120.0,
+        *, timeout_s: Optional[float] = None,
     ):
         super().__init__(world_size, rank, group_name)
         from ray_tpu.experimental import internal_kv
+        from ray_tpu.util.collective.supervision import resolve_timeout
+        from ray_tpu.util.fault_injection import fault_point
 
-        self._timeout_s = timeout_s
+        self._timeout_s = resolve_timeout(timeout_s)
         self._send_seq: dict = {}
         self._recv_seq: dict = {}
         # jitted collective programs keyed by (op, shape, dtype): a fresh
@@ -247,31 +249,66 @@ class XlaDistributedGroup(BaseGroup):
         # identity) and RECOMPILE every op — ~150 ms of pure overhead
         # measured per 4 KiB allreduce on CPU
         self._fn_cache: dict = {}
+        # epoch-versioned rendezvous (same scheme as the TCP leader key):
+        # a re-formed group can never adopt a dead incarnation's
+        # coordinator address
+        epoch_key = f"collective/{group_name}/epoch"
         key = f"collective/{group_name}/coordinator"
         if rank == 0:
+            import json
             import socket
 
+            from ray_tpu.util.collective.supervision import (
+                drop_group_status_keys,
+            )
+
+            fault_point("collective.rendezvous")
+            raw = internal_kv._internal_kv_get(
+                epoch_key.encode(), namespace="collective")
+            self.epoch = int(raw or 0) + 1
+            # sweep ghost member records of a previous incarnation that
+            # died without cleanup (same hygiene as the TCP leader)
+            drop_group_status_keys(group_name)
             s = socket.socket()
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
             s.close()
             addr = f"127.0.0.1:{port}"
             internal_kv._internal_kv_put(
-                key.encode(), addr.encode(), namespace="collective"
+                epoch_key.encode(), str(self.epoch).encode(),
+                namespace="collective")
+            internal_kv._internal_kv_put(
+                key.encode(),
+                json.dumps({"epoch": self.epoch, "addr": addr}).encode(),
+                namespace="collective",
             )
         else:
-            deadline = time.monotonic() + timeout_s
+            from ray_tpu.util.collective.supervision import (
+                parse_rendezvous_entry,
+            )
+
+            deadline = time.monotonic() + self._timeout_s
             addr = None
+            self.epoch = 0
             while time.monotonic() < deadline:
+                fault_point("collective.rendezvous")
                 raw = internal_kv._internal_kv_get(
                     key.encode(), namespace="collective"
                 )
                 if raw:
-                    addr = raw.decode()
-                    break
+                    entry = parse_rendezvous_entry(raw)
+                    raw_epoch = internal_kv._internal_kv_get(
+                        epoch_key.encode(), namespace="collective")
+                    current = int(raw_epoch or entry["epoch"])
+                    if entry["epoch"] == current:
+                        addr = entry["addr"]
+                        self.epoch = entry["epoch"]
+                        break
                 time.sleep(0.05)
             if addr is None:
-                raise TimeoutError("coordinator address never published")
+                raise TimeoutError(
+                    "coordinator address never published for the current "
+                    "epoch")
         # tolerates a runtime already formed by this process (a JaxTrainer
         # worker, or an earlier group); the helper validates the live
         # world and rank against this group's declaration
@@ -394,18 +431,13 @@ class XlaDistributedGroup(BaseGroup):
         # purge this group's KV footprint (coordinator key + any
         # unconsumed p2p payloads): a later group REUSING the name would
         # otherwise pick up a previous incarnation's coordinator address
-        # or deliver its stale tensors as fresh data
-        try:
-            from ray_tpu.experimental import internal_kv
+        # or deliver its stale tensors as fresh data.  The epoch COUNTER
+        # survives (see drop_group_keys) so a straggler still polling
+        # with this incarnation's epoch can never pass the next one's
+        # epoch check
+        from ray_tpu.util.collective.supervision import drop_group_keys
 
-            prefix = f"collective/{self.group_name}/"
-            for k in internal_kv._internal_kv_list(
-                    prefix, namespace="collective"):
-                internal_kv._internal_kv_del(
-                    k.encode() if isinstance(k, str) else k,
-                    namespace="collective")
-        except Exception:  # noqa: BLE001 — cluster may already be down
-            pass
+        drop_group_keys(self.group_name)
         try:
             jax.distributed.shutdown()
         except Exception:

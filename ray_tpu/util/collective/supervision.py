@@ -1,0 +1,700 @@
+"""Collective supervision: flight recorder, watchdog threads, abort.
+
+The spine that turns a silent collective hang into an attributable,
+recoverable failure (reference: PyTorch distributed's NCCL watchdog +
+``TORCH_NCCL_TRACE_BUFFER`` flight recorder; MegaScale §hang detection):
+
+- every op on every member gets a monotonically increasing **sequence
+  number** and a bounded in-memory **flight recorder** entry
+  (seq, op, group, rank, shape/dtype, t_start, t_end, status);
+- a per-group **watchdog thread** aborts the group when an op exceeds the
+  configured ``timeout_s`` (group init option, ``RAY_TPU_COLLECTIVE_TIMEOUT``
+  env, or the ``collective_op_timeout_s`` config flag), when a GCS node or
+  actor **death** covers a member, or when a member's node **drain**
+  deadline expires with an op still in flight (a drain alone never aborts
+  an idle group — the train controller's graceful checkpoint leg runs
+  first, see docs/fault_tolerance.md);
+- ``abort()`` closes the transport under any blocked op, marks the group
+  ``ABORTED``, and makes current and future ops raise
+  :class:`~ray_tpu.exceptions.CollectiveAbortError` carrying the
+  diagnosis of which rank/seq is behind;
+- the watchdog heartbeats each member's progress (state, last completed
+  seq, in-flight op) into the GCS KV so ``util.state.
+  list_collective_groups``, ``raytpu status``, and the dashboard's
+  collective panel can show group health cluster-wide.
+
+``destroy_group`` + ``init_collective_group`` on an aborted group is the
+supported re-init path: rendezvous keys are epoch-versioned, so a
+re-formed group can never connect to a stale leader.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.exceptions import CollectiveAbortError
+from ray_tpu.util.collective.types import GroupState, ReduceOp
+from ray_tpu.util.fault_injection import fault_point
+
+logger = logging.getLogger(__name__)
+
+ENV_TIMEOUT = "RAY_TPU_COLLECTIVE_TIMEOUT"
+ENV_TRACE_BUFFER = "RAY_TPU_COLLECTIVE_TRACE_BUFFER"
+
+# errors meaning the transport under a collective died (peer/leader gone,
+# watchdog closed the socket, rendezvous KV vanished) — any of these
+# mid-op aborts the group; application errors (bad shapes caught before
+# dispatch, unknown ops) surface as themselves
+_TRANSPORT_ERRS = (ConnectionError, OSError, EOFError, TimeoutError)
+
+
+def resolve_timeout(timeout_s: Optional[float] = None) -> float:
+    """Effective per-op timeout: explicit arg > ``RAY_TPU_COLLECTIVE_TIMEOUT``
+    env > ``collective_op_timeout_s`` config flag."""
+    if timeout_s is not None:
+        return float(timeout_s)
+    env = os.environ.get(ENV_TIMEOUT)
+    if env:
+        return float(env)
+    from ray_tpu._private.config import config
+
+    return float(config.collective_op_timeout_s)
+
+
+def _shape_of(t) -> Optional[tuple]:
+    s = getattr(t, "shape", None)
+    if s is None:
+        return None
+    try:
+        return tuple(s)
+    except TypeError:
+        return None
+
+
+def _dtype_of(t) -> Optional[str]:
+    d = getattr(t, "dtype", None)
+    return str(d) if d is not None else None
+
+
+class FlightRecorder:
+    """Process-wide bounded per-group trace of collective ops."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._by_group: Dict[str, deque] = {}
+
+    def start(self, group: str, rank: int, op: str, seq: int,
+              shape, dtype) -> Dict[str, Any]:
+        entry = {
+            "group": group, "rank": rank, "op": op, "seq": seq,
+            "shape": shape, "dtype": dtype,
+            "t_start": time.time(), "t_end": None, "status": "in_flight",
+        }
+        with self._lock:
+            q = self._by_group.setdefault(group, deque(maxlen=self.capacity))
+            q.append(entry)
+        return entry
+
+    def finish(self, entry: Dict[str, Any], status: str) -> None:
+        entry["t_end"] = time.time()
+        entry["status"] = status
+
+    def dump(self, group_name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            if group_name is not None:
+                return [dict(e) for e in self._by_group.get(group_name, ())]
+            out: List[Dict[str, Any]] = []
+            for q in self._by_group.values():
+                out.extend(dict(e) for e in q)
+            return out
+
+    def drop(self, group_name: str) -> None:
+        with self._lock:
+            self._by_group.pop(group_name, None)
+
+
+_recorder = FlightRecorder(int(os.environ.get(ENV_TRACE_BUFFER, "256") or 256))
+
+
+def flight_recorder_dump(group_name: Optional[str] = None
+                         ) -> List[Dict[str, Any]]:
+    """This process's flight-recorder entries (all groups, or one)."""
+    return _recorder.dump(group_name)
+
+
+def format_flight_tail(group_name: str, n: int = 8) -> str:
+    """Human-readable tail of the recorder for abort diagnoses/logs."""
+    entries = _recorder.dump(group_name)[-n:]
+    if not entries:
+        return "  (flight recorder empty)"
+    lines = []
+    for e in entries:
+        dur = (f"{(e['t_end'] - e['t_start']) * 1000:.1f}ms"
+               if e["t_end"] else
+               f"in flight {time.time() - e['t_start']:.1f}s")
+        lines.append(
+            f"  seq={e['seq']} op={e['op']} rank={e['rank']} "
+            f"shape={e['shape']} dtype={e['dtype']} "
+            f"status={e['status']} ({dur})")
+    return "\n".join(lines)
+
+
+def _status_key(group_name: str, rank: int) -> bytes:
+    return f"collective/{group_name}/status/{rank}".encode()
+
+
+def parse_rendezvous_entry(raw: bytes) -> Dict[str, Any]:
+    """Decode an epoch-versioned rendezvous entry (``{"epoch", "addr"}``)
+    — the ONE parser behind the TCP leader key and the XLA coordinator
+    key, tolerating the pre-epoch bare-address format."""
+    try:
+        entry = json.loads(raw)
+        if isinstance(entry, dict) and "addr" in entry:
+            entry.setdefault("epoch", 0)
+            return entry
+    except ValueError:
+        pass
+    return {"epoch": 0, "addr": raw.decode()}
+
+
+def drop_group_status_keys(group_name: str) -> None:
+    """Sweep a group's member status records — a new incarnation's
+    leader calls this after bumping the epoch so ghosts of ranks that
+    died without cleanup (their keys linger forever otherwise) cannot
+    haunt the re-formed group's membership view or death checks."""
+    try:
+        from ray_tpu.experimental import internal_kv
+
+        prefix = f"collective/{group_name}/status/"
+        for k in internal_kv._internal_kv_list(prefix,
+                                               namespace="collective"):
+            key = k if isinstance(k, str) else k.decode()
+            internal_kv._internal_kv_del(key.encode(),
+                                         namespace="collective")
+    except Exception:  # noqa: BLE001 — best-effort hygiene
+        pass
+
+
+def drop_group_keys(group_name: str) -> None:
+    """Best-effort sweep of a group's KV footprint (leader/coordinator
+    entries, member status records, unconsumed p2p payloads).  The epoch
+    COUNTER is deliberately preserved: a straggler from a failed or
+    destroyed generation may still be polling rendezvous — if the counter
+    reset, the name's next incarnation would restart at epoch 1 and the
+    straggler would pass the epoch check and join it as a cross-
+    generation duplicate rank."""
+    try:
+        from ray_tpu.experimental import internal_kv
+
+        prefix = f"collective/{group_name}/"
+        epoch_key = f"{prefix}epoch"
+        for k in internal_kv._internal_kv_list(prefix,
+                                               namespace="collective"):
+            key = k if isinstance(k, str) else k.decode()
+            if key == epoch_key:
+                continue
+            internal_kv._internal_kv_del(key.encode(),
+                                         namespace="collective")
+    except Exception:  # noqa: BLE001 — cluster may already be down
+        pass
+
+
+def aggregate_status_records(records) -> List[Dict[str, Any]]:
+    """Fold per-member status records (the watchdog KV heartbeats) into
+    per-group summaries — the ONE aggregation behind
+    ``util.state.list_collective_groups``, ``raytpu status``, and the
+    dashboard's ``/api/collective`` panel, so the three surfaces can
+    never drift apart on schema or state-promotion rules."""
+    # ghosts first: records of a dead incarnation that escaped the
+    # leader's sweep must not merge into (or ABORT-promote) the current
+    # epoch's summary
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        if rec.get("group_name"):
+            by_name.setdefault(rec["group_name"], []).append(rec)
+    records = []
+    for recs in by_name.values():
+        top = max(r.get("epoch", 0) for r in recs)
+        records.extend(r for r in recs if r.get("epoch", 0) == top)
+    groups: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        name = rec.get("group_name")
+        if not name:
+            continue
+        g = groups.setdefault(name, {
+            "group_name": name,
+            "world_size": rec.get("world_size"),
+            "backend": rec.get("backend", ""),
+            "epoch": rec.get("epoch", 0),
+            "state": "READY",
+            "members": [],
+        })
+        g["members"].append(rec)
+        g["epoch"] = max(g["epoch"], rec.get("epoch", 0))
+        if rec.get("state") == "ABORTED":
+            g["state"] = "ABORTED"
+            if rec.get("abort_reason"):
+                g["abort_reason"] = rec["abort_reason"]
+    for g in groups.values():
+        g["members"].sort(key=lambda m: m.get("rank") or 0)
+        g["joined"] = len(g["members"])
+    return sorted(groups.values(), key=lambda g: g["group_name"])
+
+
+def _supervised(fn):
+    """Route a group op through the supervision spine (seq number, flight
+    recorder, ``collective.op`` fault site, abort-aware error mapping)."""
+
+    @functools.wraps(fn)
+    def wrapper(self: "SupervisedGroup", *args, **kwargs):
+        return self._execute(fn.__name__, fn, args, kwargs)
+
+    wrapper.__supervised__ = True
+    return wrapper
+
+
+class SupervisedGroup:
+    """Wraps a backend group (TCP/XLA) with the supervision spine.
+
+    Every op: sequence number + flight-recorder entry + the
+    ``collective.op`` fault site; transport failures and watchdog aborts
+    surface as ``CollectiveAbortError`` with a diagnosis.  A per-group
+    :class:`Watchdog` enforces the op timeout and reacts to GCS
+    node/actor death and drain events covering members.
+    """
+
+    def __init__(self, inner, *, timeout_s: Optional[float] = None,
+                 backend: str = ""):
+        self._inner = inner
+        self._timeout_s = resolve_timeout(timeout_s)
+        self._backend = str(backend)
+        self._state = GroupState.READY
+        self._abort_info: Optional[Dict[str, Any]] = None
+        self._seq = 0
+        self._last_done_seq = 0  # entry-stamped seq of the last success
+        self._lock = threading.Lock()
+        self._inflight: Optional[Dict[str, Any]] = None
+        # identity captured NOW, in the joining task's context — the
+        # watchdog thread has no execution context to read it from later
+        self._self_node_id = ""
+        self._self_actor_id = ""
+        try:
+            from ray_tpu.runtime_context import get_runtime_context
+
+            ctx = get_runtime_context()
+            self._self_node_id = ctx.get_node_id() or ""
+            self._self_actor_id = ctx.get_actor_id() or ""
+        except Exception:  # noqa: BLE001 — standalone (no cluster) use
+            pass
+        self._publish_status()
+        self._watchdog = Watchdog(self)
+        self._watchdog.start()
+
+    # -- delegated identity -------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._inner.rank
+
+    @property
+    def world_size(self) -> int:
+        return self._inner.world_size
+
+    @property
+    def group_name(self) -> str:
+        return self._inner.group_name
+
+    @property
+    def state(self) -> GroupState:
+        return self._state
+
+    @property
+    def timeout_s(self) -> float:
+        return self._timeout_s
+
+    def __getattr__(self, name):
+        # backend extras (XlaMeshGroup.permute, .mesh, ...) pass through
+        if name.startswith("__") or name == "_inner":
+            raise AttributeError(name)
+        return getattr(self.__dict__["_inner"], name)
+
+    # -- supervised ops -----------------------------------------------------
+    # every public collective op routes through _execute (seq + flight
+    # recorder + ``collective.op`` site + abort mapping); a tooling test
+    # asserts the full BaseGroup op surface carries the marker so a new
+    # op cannot silently skip supervision
+
+    @_supervised
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._inner.allreduce(tensor, op)
+
+    @_supervised
+    def barrier(self) -> None:
+        return self._inner.barrier()
+
+    @_supervised
+    def reduce(self, tensor, dst_rank: int = 0,
+               op: ReduceOp = ReduceOp.SUM):
+        return self._inner.reduce(tensor, dst_rank, op)
+
+    @_supervised
+    def broadcast(self, tensor, src_rank: int = 0):
+        return self._inner.broadcast(tensor, src_rank)
+
+    @_supervised
+    def allgather(self, tensor):
+        return self._inner.allgather(tensor)
+
+    @_supervised
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        return self._inner.reducescatter(tensor, op)
+
+    @_supervised
+    def send(self, tensor, dst_rank: int, tag: int = 0) -> None:
+        return self._inner.send(tensor, dst_rank, tag)
+
+    @_supervised
+    def recv(self, shape=None, dtype=None, src_rank: int = 0, tag: int = 0):
+        return self._inner.recv(shape, dtype, src_rank, tag)
+
+    # -- the spine ----------------------------------------------------------
+    def _execute(self, op: str, fn, args, kwargs):
+        with self._lock:
+            if self._state is not GroupState.READY:
+                raise self._abort_error(op, None)
+            self._seq += 1
+            seq = self._seq
+        # stamp collectives with the backend's WIRE seq when it has one
+        # (TCP): leader hang/desync diagnoses cite that number, and the
+        # two counters diverge once p2p ops (which consume a supervised
+        # seq but no wire seq) have run — attribution must match
+        if op not in ("send", "recv"):
+            proto = getattr(self._inner, "_seq", None)
+            if isinstance(proto, int):
+                seq = proto + 1
+        tensor = args[0] if args else None
+        entry = _recorder.start(self.group_name, self.rank, op, seq,
+                                _shape_of(tensor), _dtype_of(tensor))
+        self._inflight = entry
+        try:
+            fault_point("collective.op")
+            out = fn(self, *args, **kwargs)
+            if self._state is GroupState.ABORTED:
+                # the watchdog fired while this op was still running and
+                # the backend's abort() could not interrupt it (XLA): the
+                # group is poisoned cluster-wide, so a locally-completed
+                # result must not read as success on this rank only
+                _recorder.finish(entry, "aborted")
+                raise self._abort_error(op, seq)
+            _recorder.finish(entry, "done")
+            self._last_done_seq = seq
+            return out
+        except CollectiveAbortError as e:
+            # the backend itself diagnosed the abort (leader broadcast);
+            # adopt it so future ops raise too
+            _recorder.finish(entry, "aborted")
+            self._mark_aborted(e.reason or str(e), diagnosis=e.diagnosis)
+            raise
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if self._state is GroupState.ABORTED:
+                # the watchdog aborted while this op was blocked: the
+                # transport error is the abort surfacing, not the cause
+                _recorder.finish(entry, "aborted")
+                raise self._abort_error(op, seq) from e
+            if isinstance(e, _TRANSPORT_ERRS):
+                self.abort(f"transport failure during {op} seq={seq}: "
+                           f"{e!r}")
+                _recorder.finish(entry, "aborted")
+                raise self._abort_error(op, seq) from e
+            _recorder.finish(entry, "error")
+            raise
+        finally:
+            self._inflight = None
+
+    def _abort_error(self, op: str, seq: Optional[int]
+                     ) -> CollectiveAbortError:
+        info = self._abort_info or {}
+        return CollectiveAbortError(
+            group_name=self.group_name, rank=self.rank, seq=seq,
+            reason=info.get("reason", f"group aborted (op {op} rejected)"),
+            diagnosis=info.get("diagnosis", ""))
+
+    def _mark_aborted(self, reason: str, diagnosis: str = "") -> bool:
+        with self._lock:
+            if self._state is not GroupState.READY:
+                return False
+            self._state = GroupState.ABORTED
+            self._abort_info = {"reason": reason, "diagnosis": diagnosis,
+                                "t": time.time()}
+        return True
+
+    def abort(self, reason: str, diagnosis: str = "") -> None:
+        """Abort the group: close the transport (unblocking any op stuck
+        in it), mark ABORTED, dump the flight recorder to logs."""
+        if not diagnosis:
+            diagnosis = ("flight recorder (this rank):\n"
+                         + format_flight_tail(self.group_name))
+        if not self._mark_aborted(reason, diagnosis):
+            return
+        try:
+            self._inner.abort(reason)
+        except Exception:  # noqa: BLE001 — transport may already be gone
+            pass
+        logger.error(
+            "collective group %r rank %d ABORTED: %s\n%s",
+            self.group_name, self.rank, reason, diagnosis)
+        self._publish_status()
+
+    # -- lifecycle ----------------------------------------------------------
+    def destroy_group(self) -> None:
+        with self._lock:
+            self._state = GroupState.DESTROYED
+        self._watchdog.stop()
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            internal_kv._internal_kv_del(
+                _status_key(self.group_name, self.rank),
+                namespace="collective")
+        except Exception:  # noqa: BLE001 — cluster may be down
+            pass
+        _recorder.drop(self.group_name)
+        self._inner.destroy_group()
+
+    # -- cluster-visible status ---------------------------------------------
+    def _status_record(self) -> Dict[str, Any]:
+        inflight = self._inflight
+        rec = {
+            "group_name": self.group_name,
+            "rank": self.rank,
+            "world_size": self.world_size,
+            "backend": self._backend,
+            "epoch": getattr(self._inner, "epoch", 0),
+            "state": self._state.value,
+            "node_id": self._self_node_id,
+            "actor_id": self._self_actor_id,
+            "pid": os.getpid(),
+            # both numbers come from the SAME entry-stamped sequence the
+            # leader's diagnoses and the flight recorder use, so "idle
+            # after seq=N" and a peer's "in flight seq=M" are comparable
+            "last_done_seq": self._last_done_seq,
+            "op_count": self._seq,
+            "inflight": ({"op": inflight["op"], "seq": inflight["seq"],
+                          "t_start": inflight["t_start"]}
+                         if inflight else None),
+            "timeout_s": self._timeout_s,
+            "t": time.time(),
+        }
+        if self._abort_info:
+            rec["abort_reason"] = self._abort_info["reason"]
+        return rec
+
+    def _publish_status(self) -> None:
+        if self._state is GroupState.DESTROYED:
+            # destroy_group deleted our status key; a late watchdog tick
+            # must not resurrect it as a permanent ghost entry
+            return
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            internal_kv._internal_kv_put(
+                _status_key(self.group_name, self.rank),
+                json.dumps(self._status_record()).encode(),
+                namespace="collective")
+        except Exception:  # noqa: BLE001 — best-effort surfacing
+            pass
+
+
+class Watchdog(threading.Thread):
+    """Per-group supervisor: op-timeout abort, GCS death/drain abort,
+    progress heartbeats into the KV.
+
+    The leader's in-server monitor (TCP backend) usually diagnoses first
+    and names the lagging rank authoritatively; this thread is the
+    member-side backstop that fires even when the leader itself is the
+    thing that died — its threshold sits one tick past ``timeout_s`` so
+    the richer leader diagnosis wins the race when both are alive.
+    """
+
+    def __init__(self, group: SupervisedGroup):
+        self._group = group
+        self._interval = max(0.25, min(1.0, group.timeout_s / 4.0))
+        super().__init__(
+            daemon=True, name=f"coll-watchdog-{group.group_name}")
+        self._stop_evt = threading.Event()
+        self._members: Dict[int, Dict[str, Any]] = {}
+        self._members_refreshed = 0.0
+        self._last_membership_check = 0.0
+        self._last_published: Any = None
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def run(self) -> None:
+        g = self._group
+        while not self._stop_evt.wait(self._interval):
+            if g._state is not GroupState.READY:
+                self._heartbeat()
+                return
+            try:
+                entry = g._inflight
+                if entry is not None and entry["t_end"] is None:
+                    age = time.time() - entry["t_start"]
+                    if age > g.timeout_s + 2 * self._interval:
+                        g.abort(
+                            f"op {entry['op']} seq={entry['seq']} exceeded "
+                            f"timeout ({age:.1f}s > {g.timeout_s:.1f}s) "
+                            f"with no leader diagnosis — leader "
+                            f"unreachable or group desynced",
+                            diagnosis=self._peer_diagnosis())
+                        continue
+                # GCS queries every tick only once the in-flight op is
+                # actually SLOW (past half the timeout — attribution is
+                # only needed then); healthy back-to-back collectives and
+                # idle groups check for member death on a slow cadence so
+                # N groups don't stream node/actor-table RPCs at the
+                # control plane for the whole run
+                now = time.time()
+                inflight_slow = (
+                    entry is not None and entry["t_end"] is None
+                    and now - entry["t_start"] > g.timeout_s / 2.0)
+                if (inflight_slow
+                        or now - self._last_membership_check >= 5.0):
+                    self._last_membership_check = now
+                    self._check_membership()
+                self._heartbeat()
+            except Exception:  # noqa: BLE001 — supervisor must not die
+                logger.debug("collective watchdog tick failed",
+                             exc_info=True)
+
+    # -- KV heartbeat -------------------------------------------------------
+    def _heartbeat(self) -> None:
+        g = self._group
+        rec = g._status_record()
+        fingerprint = (rec["state"], rec["last_done_seq"],
+                       bool(rec["inflight"]))
+        # publish on change, and periodically while an op is in flight so
+        # peers can diagnose who is behind from a fresh record
+        if fingerprint != self._last_published or rec["inflight"]:
+            self._last_published = fingerprint
+            g._publish_status()
+
+    # -- GCS event watching -------------------------------------------------
+    def _refresh_members(self) -> None:
+        now = time.time()
+        if self._members and now - self._members_refreshed < 5.0:
+            return
+        g = self._group
+        try:
+            from ray_tpu.experimental import internal_kv
+
+            prefix = f"collective/{g.group_name}/status/"
+            for key in internal_kv._internal_kv_list(
+                    prefix, namespace="collective"):
+                raw = internal_kv._internal_kv_get(
+                    key.encode() if isinstance(key, str) else key,
+                    namespace="collective")
+                if not raw:
+                    continue
+                rec = json.loads(raw)
+                # a record from ANOTHER incarnation (a rank that died
+                # without cleanup, or a straggler) must not enter this
+                # group's membership view — its dead actor/node would
+                # abort a healthy re-formed group
+                if rec.get("epoch", 0) != getattr(g._inner, "epoch", 0):
+                    continue
+                self._members[int(rec["rank"])] = rec
+            self._members_refreshed = now
+        except Exception:  # noqa: BLE001 — no cluster / KV hiccup
+            pass
+
+    def _check_membership(self) -> None:
+        """Abort when a GCS node/actor death covers a member, or when a
+        member node's drain deadline expires with an op in flight."""
+        g = self._group
+        self._refresh_members()
+        if not self._members:
+            return
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            nodes = {n["node_id"]: n
+                     for n in w.run_coro(w.gcs.call("get_all_nodes"),
+                                         timeout=10)}
+        except Exception:  # noqa: BLE001 — control-plane hiccup
+            return
+        now = time.time()
+        inflight = g._inflight is not None
+        for rank, rec in sorted(self._members.items()):
+            if rank == g.rank:
+                continue
+            nid = rec.get("node_id") or ""
+            node = nodes.get(nid)
+            if node is None:
+                continue
+            state = node.get("state",
+                             "ALIVE" if node.get("alive") else "DEAD")
+            if state == "DEAD":
+                why = (node.get("death_reason")
+                       or node.get("drain_reason") or "node death")
+                g.abort(
+                    f"rank {rank} lost: node {nid[:8]} is DEAD ({why})",
+                    diagnosis=self._peer_diagnosis())
+                return
+            if state == "DRAINING" and inflight:
+                deadline = node.get("drain_deadline") or 0.0
+                if deadline and now >= deadline:
+                    g.abort(
+                        f"rank {rank} lost to node drain: node {nid[:8]} "
+                        f"drain deadline expired "
+                        f"({node.get('drain_reason') or 'drain'})",
+                        diagnosis=self._peer_diagnosis())
+                    return
+        # actor death on a still-alive node (SIGKILLed worker): checked
+        # less precisely — the TCP leader's conn-loss abort usually wins
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            dead = set()
+            for a in w.run_coro(w.gcs.call("list_actors"), timeout=10):
+                if a.get("state") == "DEAD" and a.get("actor_id"):
+                    aid = a["actor_id"]
+                    dead.add(aid.hex() if isinstance(aid, bytes) else
+                             str(aid))
+            for rank, rec in sorted(self._members.items()):
+                if rank == g.rank:
+                    continue
+                if rec.get("actor_id") and rec["actor_id"] in dead:
+                    g.abort(f"rank {rank} lost: its actor died",
+                            diagnosis=self._peer_diagnosis())
+                    return
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _peer_diagnosis(self) -> str:
+        """Who is behind, from the peers' last KV heartbeats + the local
+        flight recorder."""
+        g = self._group
+        lines = [f"flight recorder (rank {g.rank}):",
+                 format_flight_tail(g.group_name)]
+        self._refresh_members()
+        if self._members:
+            lines.append("peer progress (last heartbeat):")
+            for rank, rec in sorted(self._members.items()):
+                inflight = rec.get("inflight")
+                where = (f"in flight op={inflight['op']} "
+                         f"seq={inflight['seq']}" if inflight
+                         else f"idle after seq={rec.get('last_done_seq')}")
+                lines.append(
+                    f"  rank {rank}: {rec.get('state')} {where} "
+                    f"(heartbeat {time.time() - rec.get('t', 0):.1f}s ago)")
+        return "\n".join(lines)

@@ -45,4 +45,17 @@ class ReduceOp(str, enum.Enum):
     MAX = "max"
 
 
+class GroupState(str, enum.Enum):
+    """Supervised lifecycle of a collective group membership.
+
+    READY -> ABORTED (watchdog/leader/GCS-event abort: current and future
+    ops raise ``CollectiveAbortError``) -> DESTROYED (``destroy_group``;
+    the name may then be re-initialized under a new epoch).
+    """
+
+    READY = "READY"
+    ABORTED = "ABORTED"
+    DESTROYED = "DESTROYED"
+
+
 unset_timeout_ms = 30_000

@@ -12,12 +12,13 @@ call — via the API::
 or, for subprocesses (bench, spawned workers), via the environment::
 
     RAY_TPU_FAULT_INJECT="bench.backend_init:1:2:unavailable"
-    #                      site              :nth:count:kind
+    #                      site              :nth:count:kind[:arg]
 
-meaning: calls ``nth .. nth+count-1`` to the site raise the ``kind``
-exception (see ``_KINDS``).  Multiple specs join with ``;``.  Arming is
-deterministic — a site fires on exact call indices, never randomly — so
-chaos tests reproduce bit-for-bit.
+Spec grammar: ``site:nth[:count[:kind[:arg]]]`` — calls ``nth ..
+nth+count-1`` to the site trigger the ``kind`` (see ``_KINDS``); only
+``delay`` takes an ``arg`` (seconds).  Multiple specs join with ``;``.
+Arming is deterministic — a site fires on exact call indices, never
+randomly — so chaos tests reproduce bit-for-bit.
 
 Sites currently wired (see docs/fault_tolerance.md):
 
@@ -32,13 +33,23 @@ site                        guards
 ``gcs.drain_broadcast``     the GCS ``drain_node`` handler's hot edge
 ``raylet.drain_ack``        the raylet's ``drain_self`` ack (lost-RPC path)
 ``train.checkpoint.commit``  between checkpoint staging and rename-commit
+``collective.op``           every supervised collective op, before dispatch
+``collective.leader.recv``  the TCP leader's per-connection serve edge
+``collective.rendezvous``   the epoch/leader KV legs of group rendezvous
 ==========================  =================================================
 
-The ``sigkill`` kind is special: instead of raising, the armed call
-SIGKILLs the current process — a real mid-operation crash, for testing
-that on-disk state (checkpoint commits, WAL tails) survives a writer
-dying at the worst instruction.  Use it via the env var in a
-subprocess, never in-process in a test runner.
+Two kinds are special:
+
+- ``sigkill``: instead of raising, the armed call SIGKILLs the current
+  process — a real mid-operation crash, for testing that on-disk state
+  (checkpoint commits, WAL tails) survives a writer dying at the worst
+  instruction.  Use it via the env var in a subprocess, never in-process
+  in a test runner.
+- ``delay:<seconds>``: instead of raising, the armed call SLEEPS —
+  injecting a hang, not an error, so watchdog/timeout paths (the
+  collective supervision layer) are testable deterministically.  In the
+  env spec the seconds ride the 5th field
+  (``collective.op:1:1:delay:30``); via the API pass ``exc="delay:30"``.
 
 When nothing is armed, :func:`fault_point` is a single dict lookup —
 cheap enough to leave in production paths.
@@ -82,14 +93,15 @@ _KINDS = {
 
 
 class _Arm:
-    __slots__ = ("nth", "count", "make", "calls", "fired")
+    __slots__ = ("nth", "count", "make", "delay", "calls", "fired")
 
-    def __init__(self, nth: int, count: int, make):
+    def __init__(self, nth: int, count: int, make, delay=None):
         self.nth = nth      # 1-based call index of the first failure
         self.count = count  # how many consecutive calls fail
-        self.make = make    # site -> Exception
+        self.make = make    # site -> Exception (None for delay kind)
+        self.delay = delay  # seconds to sleep instead of raising
         self.calls = 0      # total fault_point() hits at this site
-        self.fired = 0      # how many times the fault actually raised
+        self.fired = 0      # how many times the fault actually fired
 
 
 _lock = threading.Lock()
@@ -112,10 +124,14 @@ def _load_env() -> None:
         nth = int(fields[1])
         count = int(fields[2]) if len(fields) > 2 else 1
         kind = fields[3] if len(fields) > 3 else "connection"
+        if kind == "delay":
+            seconds = float(fields[4]) if len(fields) > 4 else 30.0
+            _armed[site] = _Arm(nth, count, None, delay=seconds)
+            continue
         if kind not in _KINDS:
             raise ValueError(
                 f"{ENV_VAR}: unknown kind {kind!r} "
-                f"(expected one of {sorted(_KINDS)})")
+                f"(expected 'delay' or one of {sorted(_KINDS)})")
         _armed[site] = _Arm(nth, count, _KINDS[kind])
 
 
@@ -128,8 +144,17 @@ def arm(site: str, *, nth: int = 1, count: int = 1,
 
     ``exc`` may be an exception instance (raised as-is, repeatedly), an
     exception class (instantiated with a site message), a kind string
-    from the env-var vocabulary, or None (ConnectionError).
+    from the env-var vocabulary (incl. ``"delay:<seconds>"`` — the armed
+    calls SLEEP instead of raising, injecting a hang), or None
+    (ConnectionError).
     """
+    if isinstance(exc, str) and (exc == "delay"
+                                 or exc.startswith("delay:")):
+        _, _, arg = exc.partition(":")
+        with _lock:
+            _armed[site] = _Arm(nth, count, None,
+                                delay=float(arg) if arg else 30.0)
+        return
     if exc is None:
         make = _KINDS["connection"]
     elif isinstance(exc, str):
@@ -179,7 +204,8 @@ def fired_count(site: str) -> int:
 
 def fault_point(site: str) -> None:
     """Declare an injection site.  No-op unless ``site`` is armed; armed
-    sites raise on their configured call indices (deterministic)."""
+    sites raise — or, for the ``delay`` kind, sleep — on their configured
+    call indices (deterministic)."""
     if not _armed:  # fast path: nothing armed anywhere in the process
         return
     with _lock:
@@ -189,7 +215,15 @@ def fault_point(site: str) -> None:
         a.calls += 1
         if a.nth <= a.calls < a.nth + a.count:
             a.fired += 1
-            err = a.make(site)
+            if a.delay is not None:
+                delay, err = a.delay, None
+            else:
+                err = a.make(site)
         else:
             return
+    if err is None:
+        import time
+
+        time.sleep(delay)  # an injected hang, outside the lock
+        return
     raise err

@@ -36,6 +36,35 @@ def drain_node(node_id: str, reason: str = "",
                                  reason=reason, deadline_s=deadline_s))
 
 
+def list_collective_groups() -> List[Dict[str, Any]]:
+    """Cluster-wide collective-group health, from the per-member status
+    records each group's watchdog heartbeats into the GCS KV: members
+    (rank, node, pid), supervision state (READY | ABORTED | DESTROYED),
+    per-rank progress (last completed seq, in-flight op), and the abort
+    reason when a watchdog fired.  The cluster-visible face of the
+    flight recorder (``ray_tpu.util.collective.flight_recorder_dump`` is
+    the in-process one)."""
+    import json as _json
+
+    from ray_tpu.util.collective.supervision import aggregate_status_records
+
+    w = _worker()
+    try:
+        table = w.run_coro(w.gcs.call(
+            "kv_get_prefix", ns="collective", prefix="collective/"))
+    except Exception:  # noqa: BLE001 — no cluster
+        return []
+    records = []
+    for key, raw in (table or {}).items():
+        if "/status/" not in key:
+            continue
+        try:
+            records.append(_json.loads(raw))
+        except Exception:  # noqa: BLE001 — record mid-write
+            continue
+    return aggregate_status_records(records)
+
+
 def list_actors() -> List[Dict[str, Any]]:
     w = _worker()
     out = w.run_coro(w.gcs.call("list_actors"))

@@ -218,6 +218,334 @@ class TestXlaDistributedGroup:
         np.testing.assert_allclose(second, [9.0])
 
 
+@ray_tpu.remote
+class ChaosWorker:
+    """One rank of a supervised TCP group, with in-process fault arming."""
+
+    def __init__(self, rank: int, world: int):
+        self.rank = rank
+        self.world = world
+
+    def setup(self, group_name, timeout_s=None):
+        col.init_collective_group(self.world, self.rank, "tcp", group_name,
+                                  timeout_s=timeout_s)
+        return self.rank
+
+    def arm(self, site, nth=1, count=1, kind="connection"):
+        from ray_tpu.util import fault_injection as fi
+
+        fi.arm(site, nth=nth, count=count, exc=kind)
+        return True
+
+    def do_allreduce(self, group_name, dim=4):
+        x = np.full((dim,), float(self.rank + 1))
+        return col.allreduce(x, group_name)
+
+    def do_reduce(self, group_name, dim=4):
+        x = np.full((dim,), float(self.rank + 1))
+        return col.reduce(x, dst_rank=0, group_name=group_name)
+
+    def group_state(self, group_name):
+        return col.get_group_state(group_name)
+
+    def dump(self, group_name):
+        return col.flight_recorder_dump(group_name)
+
+    def destroy(self, group_name):
+        col.destroy_collective_group(group_name)
+        return True
+
+
+def _chaos_group(n, timeout_s=4.0):
+    import uuid
+
+    name = f"cg-{uuid.uuid4().hex[:8]}"
+    workers = [ChaosWorker.remote(i, n) for i in range(n)]
+    ray_tpu.get([w.setup.remote(name, timeout_s) for w in workers],
+                timeout=60)
+    return workers, name
+
+
+def _expect_abort(ref, timeout=60):
+    """get(ref) must raise with CollectiveAbortError in the remote trace;
+    returns the error text for diagnosis assertions."""
+    import time as _t
+
+    t0 = _t.monotonic()
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(ref, timeout=timeout)
+    text = str(ei.value)
+    assert "CollectiveAbortError" in text, text
+    return text, _t.monotonic() - t0
+
+
+@pytest.mark.chaos
+class TestCollectiveWatchdog:
+    """The collective supervision layer under deterministic chaos: hangs,
+    member/leader death, desync — surviving ranks must raise
+    ``CollectiveAbortError`` with the culprit named, never block forever.
+    """
+
+    def test_injected_hang_aborts_peers_within_timeout(self, ray_start):
+        """`delay` fault on one rank = a mid-collective hang: peers abort
+        within the watchdog timeout and the diagnosis names the lagging
+        rank/seq (acceptance: chaos proof, hang leg)."""
+        workers, name = _chaos_group(4, timeout_s=4.0)
+        try:
+            # rank 3 sleeps 30s inside its next collective op — far past
+            # the 4s group timeout
+            assert ray_tpu.get(
+                workers[3].arm.remote("collective.op", kind="delay:30"))
+            refs = [w.do_allreduce.remote(name) for w in workers[:3]]
+            for r in refs:
+                text, elapsed = _expect_abort(r)
+                assert elapsed < 25.0, "peer blocked past the watchdog"
+                assert "rank(s) [3]" in text or "rank 3" in text, text
+                assert "seq=1" in text, text
+            # the flight recorder on a surviving rank shows the aborted op
+            entries = ray_tpu.get(workers[0].dump.remote(name), timeout=30)
+            assert any(e["status"] == "aborted" and e["op"] == "allreduce"
+                       for e in entries), entries
+            assert ray_tpu.get(
+                workers[0].group_state.remote(name)) == "ABORTED"
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+
+    def test_member_sigkill_mid_allreduce(self, ray_isolated):
+        """A member dying mid-collective (real SIGKILL, the preempted-host
+        shape): the leader detects the closed connection and aborts every
+        peer promptly, naming the dead rank."""
+        workers, name = _chaos_group(4, timeout_s=8.0)
+        assert ray_tpu.get(
+            workers[2].arm.remote("collective.op", kind="sigkill"))
+        # rank 2 dies inside the op; don't wait on its ref
+        workers[2].do_allreduce.remote(name)
+        refs = [workers[i].do_allreduce.remote(name) for i in (0, 1, 3)]
+        for r in refs:
+            text, elapsed = _expect_abort(r)
+            assert elapsed < 30.0
+            assert "rank 2" in text, text
+
+    def test_leader_death_aborts_members(self, ray_isolated):
+        """The leader process dying mid-collective: members' sockets
+        collapse and every survivor raises CollectiveAbortError instead
+        of blocking on a dead server."""
+        workers, name = _chaos_group(3, timeout_s=8.0)
+        assert ray_tpu.get(
+            workers[0].arm.remote("collective.op", kind="sigkill"))
+        workers[0].do_allreduce.remote(name)
+        refs = [workers[i].do_allreduce.remote(name) for i in (1, 2)]
+        for r in refs:
+            text, elapsed = _expect_abort(r)
+            assert elapsed < 30.0
+
+    def test_shape_desync_aborts_naming_diverging_rank(self, ray_start):
+        """Mismatched shapes across ranks at one seq = desync: the leader
+        majority-votes and aborts the group naming the diverger."""
+        workers, name = _chaos_group(4, timeout_s=30.0)
+        try:
+            refs = [w.do_allreduce.remote(name, dim=(6 if i == 1 else 4))
+                    for i, w in enumerate(workers)]
+            for r in refs:
+                text, _ = _expect_abort(r)
+                assert "desync" in text, text
+                assert "rank(s) [1]" in text, text
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+
+    def test_reduce_shape_desync_aborts(self, ray_start):
+        """`reduce` is shape-strict too: a ragged reduce must abort with
+        the diverging rank named, not blow up the leader's compute."""
+        workers, name = _chaos_group(3, timeout_s=30.0)
+        try:
+            refs = [w.do_reduce.remote(name, dim=(5 if i == 2 else 4))
+                    for i, w in enumerate(workers)]
+            for r in refs:
+                text, _ = _expect_abort(r)
+                assert "desync" in text and "rank(s) [2]" in text, text
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+
+    def test_abort_destroy_reinit_allreduce(self, ray_start):
+        """destroy + init on an aborted group is the supported re-init
+        path: the re-formed group gets a fresh epoch and works."""
+        workers, name = _chaos_group(4, timeout_s=30.0)
+        try:
+            refs = [w.do_allreduce.remote(name, dim=(6 if i == 1 else 4))
+                    for i, w in enumerate(workers)]
+            for r in refs:
+                _expect_abort(r)
+            ray_tpu.get([w.destroy.remote(name) for w in workers],
+                        timeout=30)
+            ray_tpu.get([w.setup.remote(name, 30.0) for w in workers],
+                        timeout=60)
+            outs = ray_tpu.get(
+                [w.do_allreduce.remote(name) for w in workers], timeout=60)
+            for o in outs:
+                np.testing.assert_allclose(o, np.full((4,), 10.0))
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+
+    def test_stale_leader_rendezvous_rejected(self, ray_isolated):
+        """A crashed leader leaves its KV entry dangling; a re-formed
+        group under the same name must epoch past it, never adopt the
+        dead address (satellite: stale-leader rendezvous)."""
+        workers, name = _chaos_group(2, timeout_s=6.0)
+        assert ray_tpu.get(
+            workers[0].arm.remote("collective.op", kind="sigkill"))
+        workers[0].do_allreduce.remote(name)
+        _expect_abort(workers[1].do_allreduce.remote(name))
+        # the dead leader's entry is still in the KV (no destroy ran);
+        # fresh workers re-form the SAME group name
+        fresh = [ChaosWorker.remote(i, 2) for i in range(2)]
+        ray_tpu.get([w.setup.remote(name, 6.0) for w in fresh], timeout=60)
+        outs = ray_tpu.get([w.do_allreduce.remote(name) for w in fresh],
+                           timeout=60)
+        for o in outs:
+            np.testing.assert_allclose(o, np.full((4,), 3.0))
+
+    def test_create_collective_group_dead_actor_times_out(self,
+                                                          ray_isolated):
+        """Driver-side join must not hang when an actor dies before
+        joining: bounded get + partial-group teardown (satellite)."""
+        import time as _t
+        import uuid
+
+        name = f"cg-{uuid.uuid4().hex[:8]}"
+        workers = [ChaosWorker.remote(i, 2) for i in range(2)]
+        ray_tpu.kill(workers[1])
+        t0 = _t.monotonic()
+        with pytest.raises(Exception):
+            col.create_collective_group(workers, 2, group_name=name,
+                                        timeout_s=5.0)
+        assert _t.monotonic() - t0 < 60.0
+        # rendezvous keys were swept (only the epoch counter survives,
+        # so a straggler from the failed join can't chase the next
+        # incarnation), and the name is reusable
+        from ray_tpu.experimental import internal_kv
+
+        left = internal_kv._internal_kv_list(
+            f"collective/{name}/", namespace="collective")
+        assert set(left) <= {f"collective/{name}/epoch"}, left
+
+    def test_drain_abort_phrase_contract(self):
+        """The controller's drain-abort classifier string-matches the
+        watchdog's abort phrasing across a process boundary — this test
+        pins producer and matcher together so a reword can't silently
+        start charging planned migrations to the failure budget."""
+        import inspect
+
+        from ray_tpu.train.controller import _drain_caused_collective_abort
+        from ray_tpu.util.collective import supervision
+
+        producer_src = inspect.getsource(
+            supervision.Watchdog._check_membership)
+        for phrase in ("lost to node drain", "drain deadline expired"):
+            assert phrase in producer_src, phrase
+        assert _drain_caused_collective_abort(
+            "TaskError: CollectiveAbortError: collective group "
+            "'train::r/g1' aborted (rank 0, seq 3): rank 1 lost to node "
+            "drain: node ab12 drain deadline expired (spot reclaim)")
+        # a run NAMED "drain" must not classify, nor non-abort errors
+        assert not _drain_caused_collective_abort(
+            "TaskError: CollectiveAbortError: collective group "
+            "'train::drain-run/g1' aborted (rank 0, seq 3): op allreduce "
+            "seq=3 exceeded timeout")
+        assert not _drain_caused_collective_abort(
+            "ValueError: node drain something")
+        assert not _drain_caused_collective_abort(None)
+
+    def test_list_collective_groups_surfaces_members(self, ray_start):
+        """State-API surfacing: member records with progress appear while
+        a group is live (watchdog heartbeats into the KV)."""
+        from ray_tpu.util import state as state_api
+
+        workers, name = _chaos_group(2, timeout_s=30.0)
+        try:
+            ray_tpu.get([w.do_allreduce.remote(name) for w in workers],
+                        timeout=60)
+            groups = [g for g in state_api.list_collective_groups()
+                      if g["group_name"] == name]
+            assert groups and groups[0]["world_size"] == 2
+            assert groups[0]["epoch"] >= 1
+            assert {m["rank"] for m in groups[0]["members"]} == {0, 1}
+            # the dashboard panel serves the same aggregation
+            import json as json_mod
+            import urllib.request
+
+            url = ray_tpu.dashboard_url()
+            with urllib.request.urlopen(f"{url}/api/collective",
+                                        timeout=10) as resp:
+                dash = json_mod.loads(resp.read())
+            mine = [g for g in dash["groups"] if g["group_name"] == name]
+            assert mine and mine[0]["joined"] == 2, dash
+        finally:
+            for w in workers:
+                ray_tpu.kill(w)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestTrainCollectiveRecovery:
+    def test_train_recovers_from_collective_hang(self, ray_start,
+                                                 tmp_path):
+        """Acceptance e2e: a mid-allreduce hang in one rank aborts the
+        collective within the timeout, surfaces as a worker failure, and
+        the controller restarts the group from the latest checkpoint —
+        the re-formed generation gets a fresh group and finishes."""
+        from ray_tpu import train
+
+        def loop(config):
+            import os
+            import tempfile
+
+            import numpy as np
+
+            from ray_tpu import train
+            from ray_tpu.train.checkpoint import Checkpoint
+            from ray_tpu.util import collective as col
+            from ray_tpu.util import fault_injection as fi
+
+            ctx = train.get_context()
+            group = ctx.collective_group(timeout_s=4.0)
+            start = 0
+            ckpt = ctx.get_checkpoint()
+            if ckpt is not None:
+                with open(os.path.join(ckpt.path, "step.txt")) as f:
+                    start = int(f.read()) + 1
+            for step in range(start, 4):
+                if (step == 2 and ckpt is None
+                        and ctx.get_world_rank() == 1):
+                    # first generation only: rank 1 hangs inside the
+                    # step-2 allreduce, far past the 4s group timeout
+                    fi.arm("collective.op", nth=1, exc="delay:60")
+                out = col.allreduce(
+                    np.full((2,), float(step)), group)
+                d = tempfile.mkdtemp()
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step))
+                train.report({"step": step, "allreduce0": float(out[0])},
+                             checkpoint=Checkpoint(d))
+
+        res = train.DataParallelTrainer(
+            loop,
+            scaling_config=train.ScalingConfig(num_workers=2),
+            run_config=train.RunConfig(
+                name="coll-hang-run", storage_path=str(tmp_path),
+                failure_config=train.FailureConfig(max_failures=2)),
+        ).fit()
+        assert res.error is None, res.error
+        assert res.metrics["step"] == 3
+        # both generations contributed: the run recovered, it did not
+        # just succeed first try
+        steps = [m["step"] for m in res.metrics_history]
+        assert steps[-1] == 3 and 2 in steps, steps
+
+
 class TestXlaMeshGroup:
     def test_mesh_collectives(self):
         from ray_tpu.util.collective.collective_group.xla_group import (

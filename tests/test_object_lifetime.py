@@ -244,7 +244,13 @@ def test_task_return_freed_after_drop(ray_isolated):
     oid = ref.id
     del ref
     gc.collect()
-    deadline = time.time() + 30  # generous: GC propagation under full-suite load
+    # Bound past the transfer-pin TTL failsafe (transfer_pin_ttl_s, 60s):
+    # under heavy suite load the executor->submitter pin's reply-time
+    # retirement can lose its race, and the buffer is then legitimately
+    # held until the TTL expires — 30s polled FLAKY exactly there.  What
+    # this test asserts is that the buffer IS freed, not that the
+    # fast-path retirement won the race.
+    deadline = time.time() + 75
     while time.time() < deadline:
         if worker.shared_store.get_buffer(oid) is None:
             break

@@ -246,6 +246,43 @@ def test_fault_injection_exception_instance_and_kind():
             fi.fault_point("t.kind")
 
 
+def test_fault_injection_delay_kind_sleeps_instead_of_raising():
+    """``delay:<seconds>`` injects a HANG: the armed call sleeps (never
+    raises), on exactly its configured call indices — the knob the
+    collective watchdog chaos tests turn."""
+    import time
+
+    with fi.armed("t.delay", nth=2, exc="delay:0.3"):
+        t0 = time.monotonic()
+        fi.fault_point("t.delay")  # call 1: clean (nth=2)
+        assert time.monotonic() - t0 < 0.2
+        t0 = time.monotonic()
+        fi.fault_point("t.delay")  # call 2: sleeps, no exception
+        assert time.monotonic() - t0 >= 0.25
+        t0 = time.monotonic()
+        fi.fault_point("t.delay")  # call 3: clean again (count=1)
+        assert time.monotonic() - t0 < 0.2
+        assert fi.fired_count("t.delay") == 1
+
+
+def test_fault_injection_delay_env_spec():
+    """Env grammar leg: ``site:nth:count:delay:<seconds>``."""
+    code = (
+        "import time\n"
+        "from ray_tpu.util import fault_injection as fi\n"
+        "t0 = time.monotonic(); fi.fault_point('env.delay')\n"
+        "assert time.monotonic() - t0 >= 0.25, 'did not sleep'\n"
+        "t0 = time.monotonic(); fi.fault_point('env.delay')\n"
+        "assert time.monotonic() - t0 < 0.2, 'slept past count'\n"
+        "print('DELAY_OK')\n"
+    )
+    env = dict(os.environ, RAY_TPU_FAULT_INJECT="env.delay:1:1:delay:0.3")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "DELAY_OK" in out.stdout
+
+
 def test_fault_injection_env_arming_in_subprocess():
     code = (
         "from ray_tpu.util import fault_injection as fi\n"

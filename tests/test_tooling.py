@@ -660,3 +660,42 @@ def test_every_fault_injection_site_is_documented():
     missing = sorted(s for s in sites if s not in module_doc)
     assert not missing, (
         f"sites missing from fault_injection module docstring: {missing}")
+
+
+def test_every_collective_op_routes_through_supervision():
+    """Tooling guard: every public collective op — the module-level API
+    AND the full BaseGroup op surface — must route through the
+    watchdog-instrumented ``SupervisedGroup`` path (seq numbers, flight
+    recorder, ``collective.op`` fault site, abort mapping), so a newly
+    added op can't silently skip supervision."""
+    import inspect
+
+    from ray_tpu.util.collective import collective as coll_mod
+    from ray_tpu.util.collective.collective_group.base_collective_group \
+        import BaseGroup
+    from ray_tpu.util.collective.supervision import SupervisedGroup
+
+    public_ops = ("allreduce", "reduce", "broadcast", "allgather",
+                  "reducescatter", "barrier", "send", "recv")
+    # the abstract backend surface must be covered too — a new BaseGroup
+    # op without a supervised wrapper fails here before it ships
+    backend_ops = {n for n in BaseGroup.__abstractmethods__
+                   if n not in ("destroy_group", "abort")}
+    assert backend_ops <= set(public_ops), (
+        f"BaseGroup grew op(s) {backend_ops - set(public_ops)} that the "
+        f"public API / this guard don't know about")
+
+    for op in public_ops:
+        meth = inspect.getattr_static(SupervisedGroup, op)
+        assert getattr(meth, "__supervised__", False), (
+            f"SupervisedGroup.{op} is not routed through the supervision "
+            f"spine (missing @_supervised)")
+        # the module-level function dispatches to the registry's group
+        # object — which GroupManager.create always wraps
+        src = inspect.getsource(getattr(coll_mod, op))
+        assert "_group_mgr.get(group_name)" in src and f".{op}(" in src, (
+            f"collective.{op} does not dispatch via the group registry")
+
+    create_src = inspect.getsource(coll_mod.GroupManager.create)
+    assert "SupervisedGroup(" in create_src, (
+        "GroupManager.create no longer wraps backends in SupervisedGroup")
