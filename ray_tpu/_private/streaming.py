@@ -102,6 +102,22 @@ class ObjectRefGenerator:
         st = self._worker._streams.get(self._task_id)
         return st.produced if st else 0
 
+    def close(self):
+        """Deterministically abandon the stream: cancel the producer
+        task, unblock a backpressured producer, and release buffered
+        items — the same teardown ``__del__`` schedules, but without
+        waiting on GC timing (a disconnected streaming client must stop
+        the replica NOW, not whenever the wrapper is collected).
+        Idempotent; safe to call from any thread."""
+        try:
+            w = self._worker
+            if (w is not None and not w._shutdown
+                    and self._task_id in w._streams):
+                w.loop.call_soon_threadsafe(w._abandon_stream,
+                                            self._task_id)
+        except Exception:  # noqa: BLE001 — already torn down
+            pass
+
     def __del__(self):
         # dropping an undrained generator must not leak the stream state
         # or wedge a backpressured producer: cancel + clean up

@@ -70,7 +70,13 @@ async function renderOverview(root) {
   const taskRows = Object.entries(tasks).map(([name, s]) =>
     ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   const depRows = Object.entries(serve.deployments || {}).map(
-    ([name, d]) => ({name, ...d}));
+    ([name, d]) => ({name, ...d,
+      limits: `${d.max_ongoing_requests ?? "?"} ongoing / ` +
+              `${d.max_queued_requests ?? "?"} queued`,
+      overload: d.overload
+        ? `shed=${d.overload.shed} expired=${d.overload.expired} ` +
+          `cancelled=${d.overload.cancelled} queued=${d.overload.queued}`
+        : ""}));
   const routeRows = Object.entries(serve.routes || {}).map(
     ([route, dep]) => ({route, deployment: dep}));
   const trainRows = (train.runs || []).map(r => ({
@@ -90,7 +96,8 @@ async function renderOverview(root) {
       (r, c) => c === "node_id" ? `#node/${r.node_id}` : null) +
     "<h2>Tasks</h2>" + table(taskRows, ["name","count","failed","mean_ms"]) +
     "<h2>Serve</h2>" + (serve.running
-      ? table(depRows, ["name","num_replicas","goal","version"]) +
+      ? table(depRows, ["name","num_replicas","goal","version","limits",
+                        "overload"]) +
         table(routeRows, ["route","deployment"])
       : "<i>serve not running</i>") +
     "<h2>Train runs</h2>" + table(trainRows,

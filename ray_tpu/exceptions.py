@@ -134,6 +134,64 @@ class TaskCancelledError(RayTpuError):
         super().__init__(f"Task {task_id} was cancelled")
 
 
+class BackPressureError(RayTpuError):
+    """A serve deployment shed this request at admission: every replica is
+    at ``max_ongoing_requests`` AND the router's wait queue already holds
+    ``max_queued_requests`` requests.
+
+    Fail-fast by design (reference: Ray Serve's ``BackPressureError`` from
+    the queue-length-capped replica scheduler): the request never reaches a
+    replica, so the caller may safely retry after ``retry_after_s`` — the
+    proxies translate this to HTTP 503 + ``Retry-After`` and gRPC
+    ``RESOURCE_EXHAUSTED``.  The router itself never retries it (the shed
+    IS the answer; re-entering the same full queue would defeat it).
+    """
+
+    def __init__(self, deployment: str = "", queued: int = 0,
+                 limit: int = 0, retry_after_s: float = 1.0):
+        self.deployment = deployment
+        self.queued = queued
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"deployment {deployment!r} is overloaded: {queued} request(s) "
+            f"already queued (max_queued_requests={limit}); retry after "
+            f"~{retry_after_s:.1f}s")
+
+    def __reduce__(self):
+        return (type(self), (self.deployment, self.queued, self.limit,
+                             self.retry_after_s))
+
+
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """A serve request's end-to-end budget was spent before the work could
+    (or did) complete, so the request was rejected/abandoned at ``stage``
+    rather than executed for a client that stopped waiting.
+
+    Minted deadlines travel with the request (proxy → router → replica →
+    nested handles); every hop checks the remaining budget, so a request
+    that already missed its deadline is dropped at the cheapest possible
+    point — before dispatch at the router, before the user callable on the
+    replica — instead of burning replica (TPU) time on a discarded answer.
+    """
+
+    def __init__(self, request_id: str = "", deployment: str = "",
+                 stage: str = "", overrun_s: float = 0.0):
+        self.request_id = request_id
+        self.deployment = deployment
+        self.stage = stage
+        self.overrun_s = overrun_s
+        where = f" at {stage}" if stage else ""
+        super().__init__(
+            f"request {request_id or '<unknown>'} for deployment "
+            f"{deployment!r} exceeded its deadline{where} "
+            f"(over by {overrun_s:.2f}s)")
+
+    def __reduce__(self):
+        return (type(self), (self.request_id, self.deployment, self.stage,
+                             self.overrun_s))
+
+
 class PendingCallsLimitExceeded(RayTpuError):
     pass
 

@@ -369,6 +369,32 @@ class LLMEngine:
         self._queue.append(req)
         return req.request_id
 
+    def abort(self, request_id: int) -> bool:
+        """Drop a request whose client stopped waiting (budget expired or
+        the stream consumer disconnected).  A still-queued request is
+        removed outright — releasing any chunk-prefill block pins it
+        accumulated — and an active one is marked ``done`` so the next
+        ``step()`` retires it through the ordinary path (slot cleared,
+        blocks released, device mirrors refreshed).  Returns ``True``
+        when the request was found; the retire still emits its (partial)
+        ``GenerationOutput``, which an abandoning caller simply drops.
+
+        NOT thread-safe against a concurrent ``step()`` — callers hold
+        the same lock that serializes the engine loop."""
+        for qi, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[qi]
+                for bid in req.chunk_blocks:
+                    self.blocks.release(bid)
+                req.chunk_blocks = []
+                return True
+        for i in range(self.B):
+            req = self._slots[i]
+            if req is not None and req.request_id == request_id:
+                req.done = True
+                return True
+        return False
+
     def has_unfinished(self) -> bool:
         return (bool(self._queue) or bool(self._failed)
                 or any(s is not None for s in self._slots))

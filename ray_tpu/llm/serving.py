@@ -14,7 +14,8 @@ from typing import Any, Dict, Optional
 from ray_tpu import serve
 
 
-@serve.deployment(name="LLMServer", max_ongoing_requests=32)
+@serve.deployment(name="LLMServer", max_ongoing_requests=32,
+                  max_queued_requests=64)
 class LLMServer:
     """HTTP/handle API: {"prompt": str, "max_tokens"?, "temperature"?}
     -> {"generated_text": str, "num_generated_tokens": int}.
@@ -123,12 +124,44 @@ class LLMServer:
             elif not busy:
                 time.sleep(0.005)
 
+    # fallback generation budget when the request carries no deadline
+    # (direct handle use without a request scope)
+    DEFAULT_BUDGET_S = 600.0
+
+    def _budget_s(self) -> float:
+        """The request's remaining deadline budget (propagated from the
+        proxy / nesting handle via serve.context — the serve-wide
+        admission layer this deployment's old fixed 600s wait predated),
+        or DEFAULT_BUDGET_S without one."""
+        from ray_tpu.serve.context import current_context
+
+        ctx = current_context()
+        if ctx is None:
+            return self.DEFAULT_BUDGET_S
+        remaining = ctx.remaining_s()
+        return self.DEFAULT_BUDGET_S if remaining is None \
+            else max(0.0, remaining)
+
+    def _abort_abandoned(self, rid: int) -> None:
+        """Lock held.  Drop an abandoned request from the engine: the
+        client stopped waiting (budget expired / stream dropped), so
+        free the slot instead of decoding an answer nobody reads."""
+        self._waiters.pop(rid, None)
+        abort = getattr(self.engine, "abort", None)
+        if abort is not None:
+            try:
+                abort(rid)
+            except Exception:  # noqa: BLE001 — already finished
+                pass
+
     def __call__(self, body: Dict[str, Any]) -> Dict[str, Any]:
         import threading
         import time as time_mod
 
+        from ray_tpu.exceptions import DeadlineExceededError
         from ray_tpu.models.generation import SamplingParams
 
+        budget = self._budget_s()
         prompt = body["prompt"]
         sp = SamplingParams(
             temperature=float(body.get("temperature", 0.7)),
@@ -142,8 +175,13 @@ class LLMServer:
             rid = self.engine.submit(prompt, sp)
             self._waiters[rid] = slot
             self._last_submit = time_mod.monotonic()
-        if not slot["event"].wait(timeout=600):
-            raise TimeoutError("generation timed out")
+        if not slot["event"].wait(timeout=budget):
+            # budget spent: stop decoding for this client
+            with self._lock:
+                self._abort_abandoned(rid)
+            raise DeadlineExceededError(
+                deployment="LLMServer", stage="generation",
+                overrun_s=0.0)
         out = slot["output"]
         if out.error:
             raise RuntimeError(out.error)
@@ -170,6 +208,9 @@ class LLMServer:
             stop_token_id=self.engine.tokenizer.eos_id)
         import time as time_mod
 
+        from ray_tpu.exceptions import DeadlineExceededError
+
+        budget = self._budget_s()
         slot = {"event": threading.Event(), "output": None}
         tq: "queue_mod.Queue" = queue_mod.Queue()
         with self._lock:
@@ -177,7 +218,7 @@ class LLMServer:
             self._waiters[rid] = slot
             self._token_queues[rid] = tq
             self._last_submit = time_mod.monotonic()
-        deadline = time_mod.time() + 600.0
+        deadline = time_mod.time() + budget
         try:
             index = 0
             all_ids: list = []
@@ -186,7 +227,9 @@ class LLMServer:
                 if slot["event"].is_set() and tq.empty():
                     break
                 if time_mod.time() > deadline:
-                    raise TimeoutError("generation timed out")
+                    raise DeadlineExceededError(
+                        deployment="LLMServer", stage="generation-stream",
+                        overrun_s=time_mod.time() - deadline)
                 if not self._loop.is_alive():
                     raise RuntimeError("engine loop died mid-generation")
                 try:
@@ -216,7 +259,13 @@ class LLMServer:
             yield {"done": True, "generated_text": out.text,
                    "num_generated_tokens": len(out.token_ids)}
         finally:
-            self._token_queues.pop(rid, None)
+            with self._lock:
+                self._token_queues.pop(rid, None)
+                if not slot["event"].is_set():
+                    # generation unfinished and the consumer is gone —
+                    # deadline expiry, engine error, or the client
+                    # dropped the stream (GeneratorExit)
+                    self._abort_abandoned(rid)
 
     def __del__(self):
         self._stop = True

@@ -111,6 +111,28 @@ def cmd_status(args):
                 print(f"    rank {m['rank']} [{m.get('state')}] "
                       f"node={str(m.get('node_id', ''))[:12]} "
                       f"pid={m.get('pid')} {prog}")
+    try:
+        from ray_tpu.util.state import list_serve_deployments
+
+        deployments = list_serve_deployments()
+    except Exception:  # noqa: BLE001 — status must render without KV
+        deployments = []
+    if deployments:
+        print("Serve deployments:")
+        for d in deployments:
+            line = (f"  {d['name']} replicas={d.get('num_replicas')}"
+                    f"/{d.get('goal')} "
+                    f"max_ongoing={d.get('max_ongoing_requests')} "
+                    f"max_queued={d.get('max_queued_requests')}")
+            if d.get("route"):
+                line += f" route={d['route']}"
+            ov = d.get("overload") or {}
+            if any(ov.values()):
+                line += (f" overload: shed={ov.get('shed', 0)} "
+                         f"expired={ov.get('expired', 0)} "
+                         f"cancelled={ov.get('cancelled', 0)} "
+                         f"queued={ov.get('queued', 0)}")
+            print(line)
     ray_tpu.shutdown()
 
 

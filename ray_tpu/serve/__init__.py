@@ -11,6 +11,8 @@ import uuid
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve import context
+from ray_tpu.serve.context import RequestContext, request_scope
 from ray_tpu.serve.deployment import (
     Application,
     AutoscalingConfig,
@@ -24,9 +26,10 @@ from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "batch", "delete", "deployment",
+    "DeploymentHandle", "DeploymentResponse", "RequestContext", "batch",
+    "context", "delete", "deployment",
     "get_app_handle", "get_deployment_handle", "get_multiplexed_model_id",
-    "grpc_proxy_port", "multiplexed", "run",
+    "grpc_proxy_port", "multiplexed", "request_scope", "run",
     "shutdown", "start",
     "status",
 ]
@@ -52,7 +55,8 @@ def start(http_options: Optional[Dict[str, Any]] = None,
         host = http_options.get("host", "127.0.0.1")
         port = http_options.get("port", 8000)
         _proxy = ProxyActor.remote(
-            host, port, http_options.get("request_timeout_s", 120.0))
+            host, port, http_options.get("request_timeout_s", 120.0),
+            http_options.get("max_concurrent_requests", 256))
         ray_tpu.get(_proxy.ready.remote(), timeout=60)
     if grpc_options and _grpc_proxy is None:
         from ray_tpu.serve.grpc_proxy import GrpcProxyActor
@@ -100,6 +104,7 @@ def run(target: Application | Deployment, *, name: str = "default",
         config_dict = {
             "num_replicas": cfg.num_replicas,
             "max_ongoing_requests": cfg.max_ongoing_requests,
+            "max_queued_requests": cfg.max_queued_requests,
             "autoscaling_config": (
                 None if cfg.autoscaling_config is None else {
                     "min_replicas": cfg.autoscaling_config.min_replicas,
@@ -169,6 +174,9 @@ def shutdown():
     _proxy = None
     _grpc_proxy = None
     # drop cached per-deployment routers: they hold handles to the dead
-    # controller/replicas and would poison the next serve session
+    # controller/replicas and would poison the next serve session (stop
+    # settles each router's completion-watcher thread first)
     with DeploymentHandle._routers_lock:
+        for router in DeploymentHandle._routers.values():
+            router.stop()
         DeploymentHandle._routers.clear()

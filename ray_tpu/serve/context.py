@@ -1,0 +1,188 @@
+"""Serve request context: request id + absolute deadline, minted at the
+ingress and carried through every hop of the serving data plane.
+
+Reference: Ray Serve's ``_serve_request_context`` contextvar
+(``python/ray/serve/context.py``) plus the HTTP ``request_timeout_s`` /
+gRPC-deadline plumbing in ``serve/_private/proxy.py``.  The proxies mint
+one :class:`RequestContext` per route invocation (the tooling test
+``test_every_proxy_route_mints_request_context`` enforces this); the
+router checks the budget before dispatch, the replica checks it again
+before invoking the user callable, and nested ``DeploymentHandle`` calls
+made inside a replica inherit the REMAINING budget automatically through
+the contextvar — a composition chain shares one deadline instead of each
+hop resetting the clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestContext:
+    """One serving request's identity and end-to-end budget.
+
+    ``deadline_s`` is an ABSOLUTE ``time.time()`` instant (``None`` means
+    no budget — e.g. a driver calling a handle directly without opting
+    in).  Wall-clock is the right base despite NTP wobble: the deadline
+    must survive pickling across processes on (potentially) different
+    hosts, where a monotonic reading is meaningless.
+    """
+
+    request_id: str
+    deadline_s: Optional[float] = None
+
+    def remaining_s(self) -> Optional[float]:
+        if self.deadline_s is None:
+            return None
+        return self.deadline_s - time.time()
+
+    def expired(self) -> bool:
+        return self.deadline_s is not None and time.time() > self.deadline_s
+
+    def overrun_s(self) -> float:
+        if self.deadline_s is None:
+            return 0.0
+        return max(0.0, time.time() - self.deadline_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"request_id": self.request_id, "deadline_s": self.deadline_s}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["RequestContext"]:
+        if not d:
+            return None
+        return cls(request_id=d.get("request_id", ""),
+                   deadline_s=d.get("deadline_s"))
+
+
+_request_ctx: contextvars.ContextVar[Optional[RequestContext]] = \
+    contextvars.ContextVar("ray_tpu_serve_request_context", default=None)
+
+
+def current_context() -> Optional[RequestContext]:
+    """The in-flight request's context, or None outside a request scope."""
+    return _request_ctx.get()
+
+
+def new_request_context(*, timeout_s: Optional[float],
+                        request_id: Optional[str] = None) -> RequestContext:
+    """Mint an ingress context: ``timeout_s`` from now becomes the
+    request's ABSOLUTE deadline.  Every proxy route must call this (with a
+    real timeout) before touching a deployment handle."""
+    return RequestContext(
+        request_id=request_id or uuid.uuid4().hex[:16],
+        deadline_s=None if timeout_s is None else time.time() + timeout_s)
+
+
+@contextlib.contextmanager
+def scope(ctx: Optional[RequestContext]) -> Iterator[None]:
+    """Install ``ctx`` as the current request context for the duration.
+
+    Used by the proxies around dispatch (``run_in_executor`` does NOT
+    propagate contextvars, so the executor callable re-enters the scope
+    explicitly) and by the replica around the user callable so nested
+    handle calls inherit the remaining budget.
+    """
+    token = _request_ctx.set(ctx)
+    try:
+        yield
+    finally:
+        _request_ctx.reset(token)
+
+
+@contextlib.contextmanager
+def request_scope(*, timeout_s: Optional[float],
+                  request_id: Optional[str] = None) -> Iterator[RequestContext]:
+    """Mint-and-install in one step — the driver-side opt-in for handle
+    calls that want a budget without going through a proxy::
+
+        with serve.context.request_scope(timeout_s=2.0):
+            handle.remote(body).result()   # whole chain shares the 2s
+    """
+    ctx = new_request_context(timeout_s=timeout_s, request_id=request_id)
+    with scope(ctx):
+        yield ctx
+
+
+# ---------------------------------------------------------------------------
+# overload visibility: per-deployment shed/expired/cancelled counters
+# ---------------------------------------------------------------------------
+
+
+class OverloadStats:
+    """Per-deployment degradation counters, double-published: into the
+    process-local ``util.metrics`` registry (→ GCS KV → dashboard
+    ``/metrics``) and — via the router's throttled report — to the serve
+    controller, which aggregates across reporter processes for
+    ``serve.status()`` / ``util.state.list_serve_deployments()`` /
+    ``raytpu status`` / the dashboard serve panel."""
+
+    _metrics_lock = threading.Lock()
+    _metrics: Dict[str, Any] = {}
+
+    def __init__(self, deployment: str):
+        self._deployment = deployment
+        self._lock = threading.Lock()
+        self.shed = 0        # rejected at admission (BackPressureError)
+        self.expired = 0     # dropped with the deadline already spent
+        self.cancelled = 0   # abandoned by the client and cancelled
+        self.queued = 0      # currently waiting for replica capacity
+        self.peak_queued = 0
+
+    @classmethod
+    def _counter(cls, name: str, description: str):
+        # lazy so importing serve never spawns the metrics publisher; the
+        # first real overload event registers the counters
+        with cls._metrics_lock:
+            m = cls._metrics.get(name)
+            if m is None:
+                from ray_tpu.util.metrics import Counter
+
+                m = Counter(name, description, tag_keys=("deployment",))
+                cls._metrics[name] = m
+            return m
+
+    def _bump_metric(self, name: str, description: str):
+        try:
+            self._counter(name, description).inc(
+                tags={"deployment": self._deployment})
+        except Exception:  # noqa: BLE001 — visibility must never fail a request
+            pass
+
+    def note_shed(self):
+        with self._lock:
+            self.shed += 1
+        self._bump_metric("serve_requests_shed",
+                          "requests rejected at admission (backpressure)")
+
+    def note_expired(self, bump_metric: bool = True):
+        with self._lock:
+            self.expired += 1
+        if bump_metric:
+            self._bump_metric("serve_requests_expired",
+                              "requests dropped with their deadline spent")
+
+    def note_cancelled(self):
+        with self._lock:
+            self.cancelled += 1
+        self._bump_metric("serve_requests_cancelled",
+                          "in-flight requests cancelled after client abandon")
+
+    def note_queued(self, delta: int):
+        with self._lock:
+            self.queued += delta
+            self.peak_queued = max(self.peak_queued, self.queued)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"shed": self.shed, "expired": self.expired,
+                    "cancelled": self.cancelled, "queued": self.queued,
+                    "peak_queued": self.peak_queued}

@@ -114,7 +114,69 @@ class ServeController:
             return {"replicas": list(st["replicas"]),
                     "max_ongoing_requests":
                         st["config"]["max_ongoing_requests"],
+                    "max_queued_requests":
+                        st["config"].get("max_queued_requests", -1),
                     "version": st["version"]}
+
+    # a reporter whose last report is older than this no longer
+    # contributes its ``queued`` GAUGE to the aggregate (the process may
+    # have exited mid-burst and would otherwise pin phantom queued
+    # requests in the published status forever); its monotonic counters
+    # — events that really happened — are kept
+    OVERLOAD_REPORT_TTL_S = 15.0
+    # a reporter silent this long has exited (live routers re-push an
+    # unchanged snapshot every Router.REPORT_HEARTBEAT_S): its entry is
+    # dropped and its monotonic counters fold into the deployment's
+    # retired base, so a long-lived deployment hit by many short-lived
+    # driver/client processes doesn't grow the report dict without bound
+    OVERLOAD_RETIRE_S = 120.0
+
+    def report_overload(self, name: str, reporter_id: str,
+                        stats: Dict[str, int]) -> bool:
+        """One router process's shed/expired/cancelled/queued counters
+        (absolute, not deltas).  Keyed by reporter so every handle-owning
+        process (driver, proxies, composing replicas) aggregates without
+        double counting; summed into the published status."""
+        with self._lock:
+            st = self._deployments.get(name)
+            if st is None:
+                return False
+            reports = st.setdefault("overload_reports", {})
+            reports[reporter_id] = {"stats": dict(stats), "t": time.time()}
+            self._retire_silent_reporters(st)
+        return True
+
+    @classmethod
+    def _retire_silent_reporters(cls, st: Dict[str, Any]) -> None:
+        """Lock held.  Worst case a reporter frozen past OVERLOAD_RETIRE_S
+        that then resumes re-counts its pre-freeze events — bounded,
+        visibility-only, and preferred over tombstones that would defeat
+        the eviction."""
+        reports = st.get("overload_reports", {})
+        cutoff = time.time() - cls.OVERLOAD_RETIRE_S
+        dead = [rid for rid, rep in reports.items() if rep["t"] < cutoff]
+        if not dead:
+            return
+        base = st.setdefault(
+            "overload_retired", {"shed": 0, "expired": 0, "cancelled": 0})
+        for rid in dead:
+            stats = reports.pop(rid)["stats"]
+            for k in base:
+                base[k] += int(stats.get(k, 0))
+
+    @classmethod
+    def _overload_total(cls, st: Dict[str, Any]) -> Dict[str, int]:
+        total = {"shed": 0, "expired": 0, "cancelled": 0, "queued": 0}
+        for k, v in st.get("overload_retired", {}).items():
+            total[k] += v
+        now = time.time()
+        for rep in st.get("overload_reports", {}).values():
+            stats = rep["stats"]
+            for k in ("shed", "expired", "cancelled"):
+                total[k] += int(stats.get(k, 0))
+            if now - rep["t"] < cls.OVERLOAD_REPORT_TTL_S:
+                total["queued"] += int(stats.get("queued", 0))
+        return total
 
     def get_version(self, name: str) -> int:
         with self._lock:
@@ -125,7 +187,8 @@ class ServeController:
         with self._lock:
             return {name: {"num_replicas": len(st["replicas"]),
                            "goal": st.get("goal_replicas", 0),
-                           "version": st["version"]}
+                           "version": st["version"],
+                           "overload": self._overload_total(st)}
                     for name, st in self._deployments.items()}
 
     def get_routes(self) -> Dict[str, str]:
@@ -331,7 +394,12 @@ class ServeController:
                 "deployments": {
                     name: {"num_replicas": len(st["replicas"]),
                            "goal": st.get("goal_replicas", 0),
-                           "version": st["version"]}
+                           "version": st["version"],
+                           "max_ongoing_requests":
+                               st["config"]["max_ongoing_requests"],
+                           "max_queued_requests":
+                               st["config"].get("max_queued_requests", -1),
+                           "overload": self._overload_total(st)}
                     for name, st in self._deployments.items()},
                 "routes": dict(self._routes),
                 "apps": dict(self._apps),

@@ -25,6 +25,11 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # router-side admission bound: requests waiting for a replica slot
+    # beyond this are shed with BackPressureError (503 / RESOURCE_EXHAUSTED
+    # at the proxies) instead of queueing without limit behind a stalled
+    # replica; -1 disables the bound (reference: serve max_queued_requests)
+    max_queued_requests: int = 128
     autoscaling_config: Optional[AutoscalingConfig] = None
     user_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -45,6 +50,7 @@ class Deployment:
 
     def options(self, *, num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
                 autoscaling_config: Optional[AutoscalingConfig | dict] = None,
                 user_config: Optional[Dict[str, Any]] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
@@ -55,6 +61,8 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -104,6 +112,7 @@ class Application:
 
 def deployment(cls_or_fn: Any = None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
+               max_queued_requests: int = 128,
                autoscaling_config: Optional[AutoscalingConfig | dict] = None,
                user_config: Optional[Dict[str, Any]] = None,
                ray_actor_options: Optional[Dict[str, Any]] = None,
@@ -118,6 +127,7 @@ def deployment(cls_or_fn: Any = None, *, name: Optional[str] = None,
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             autoscaling_config=asc,
             user_config=user_config,
             ray_actor_options=ray_actor_options or {})

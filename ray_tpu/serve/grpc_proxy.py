@@ -10,18 +10,27 @@ bytes-in/bytes-out gRPC service routes by method path instead, so no
     tuple; the response is the cloudpickled return value.
 
 ``grpc_call`` is the matching client helper.  Errors surface as
-grpc.StatusCode.NOT_FOUND (unknown deployment), DEADLINE_EXCEEDED (the
-client's own deadline expired while waiting on the deployment), or
-INTERNAL (user-code exception or proxy-side timeout/outage, message
-carried in details).
+grpc.StatusCode.NOT_FOUND (unknown deployment), RESOURCE_EXHAUSTED (the
+deployment shed the request at admission — back off and retry),
+DEADLINE_EXCEEDED (the request's budget expired while queued or waiting
+on the deployment), or INTERNAL (user-code exception or proxy-side
+timeout/outage, message carried in details).
+
+Deadline propagation: the client's gRPC deadline becomes the request's
+end-to-end budget — minted into a :class:`RequestContext` per call (the
+``serve.proxy.admit`` fault site rides that edge) and carried through
+router → replica → nested handles.  A client that cancels its call gets
+the in-flight replica task ``ray_tpu.cancel``-ed.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.context import new_request_context, scope
+from ray_tpu.util.fault_injection import fault_point
 
 
 def _dumps(value: Any) -> bytes:
@@ -38,6 +47,8 @@ def _loads(data: bytes) -> Any:
 
 _NOT_FOUND = object()
 _DEADLINE = object()
+_SHED = object()
+_EXPIRED = object()
 
 
 @ray_tpu.remote
@@ -54,6 +65,12 @@ class GrpcProxyActor:
         # executor (shared with everything else in this process).
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="grpc-proxy-call")
+        # every in-flight call pins one pool thread; arrivals beyond the
+        # pool size shed with RESOURCE_EXHAUSTED at the event loop rather
+        # than queueing invisibly inside the executor (uncounted and
+        # deadline-unchecked — the HTTP proxy does the same)
+        self._max_concurrent = 64
+        self._active = 0  # event-loop-confined
         self._handles: dict = {}
         self._ready = threading.Event()
         self._error: Optional[str] = None
@@ -82,6 +99,23 @@ class GrpcProxyActor:
             self._handles[key] = DeploymentHandle(deployment, method)
         return self._handles[key]
 
+    def _note_degradation(self, deployment: str, method: str, kind: str,
+                          metric: bool = True):
+        try:
+            handle = self._handles.get((deployment, method)) \
+                or self._handles.get((deployment, "__call__"))
+            if handle is None:
+                return
+            router = handle._get_router()
+        except Exception:  # noqa: BLE001 — visibility never masks the error
+            return
+        if kind == "cancelled":
+            router.note_cancelled()
+        elif kind == "expired":
+            router.note_expired(bump_metric=metric)
+        elif kind == "shed":
+            router.note_shed()
+
     def _serve(self):
         try:
             self._serve_inner()
@@ -106,40 +140,132 @@ class GrpcProxyActor:
                 deployment, method = parts
 
                 async def handler(request: bytes, context):
-                    # honor the client's gRPC deadline: wait that long for
-                    # the deployment (capped: each in-flight call pins one
-                    # proxy pool thread, so an hour-long deadline must not
-                    # hold one that long)
+                    # honor the client's gRPC deadline: it becomes the
+                    # request's end-to-end budget (capped: each in-flight
+                    # call pins one proxy pool thread, so an hour-long
+                    # deadline must not hold one that long)
                     remaining = context.time_remaining()
                     wait = 60.0 if remaining is None else max(
                         0.0, min(remaining, 600.0))
+                    fault_point("serve.proxy.admit")
+                    ctx = new_request_context(timeout_s=wait)
+                    holder: Dict[str, Any] = {}
+                    # bind/abandon rendezvous (shared with the HTTP
+                    # proxy): a client cancel reaches the replica task
+                    # even when the dispatch is still waiting in the
+                    # router admission queue when it lands
+                    from ray_tpu.serve.proxy import AbandonTracker
+                    tracker = AbandonTracker(
+                        lambda: proxy._note_degradation(
+                            deployment, method, "cancelled"))
 
                     # the whole chain (handle lookup, router refresh,
                     # replica probe, result wait) does blocking ray_tpu
                     # RPCs — keep it off the grpc.aio event loop (the
                     # HTTP proxy does the same)
                     def call_sync():
+                        from ray_tpu.serve.proxy import (
+                            classify_request_error,
+                        )
+
                         handle = proxy._handle_for(deployment, method)
                         if handle is None:
                             return _NOT_FOUND
                         args, kwargs = _loads(request)
-                        resp = handle.remote(*args, **kwargs)
+                        # re-enter the request scope on the executor
+                        # thread (run_in_executor drops contextvars)
+                        try:
+                            with scope(ctx):
+                                resp = handle.remote(*args, **kwargs)
+                        except BaseException as e:  # noqa: BLE001
+                            kind = classify_request_error(e)
+                            if kind == "shed":
+                                holder["detail"] = repr(e)
+                                return _SHED
+                            if kind == "expired":
+                                holder["detail"] = repr(e)
+                                return _EXPIRED
+                            raise
+                        tracker.bind(resp)
                         # Only THIS wait maps to the client's deadline;
                         # timeouts inside the control-plane lookup above
                         # stay INTERNAL (they're our outage, not the
                         # client's budget expiring).
                         try:
-                            return _dumps(resp.result(timeout=wait))
+                            return _dumps(resp.result(
+                                timeout=ctx.remaining_s()))
                         except TimeoutError:
+                            # budget spent mid-wait: abandon the work too
+                            try:
+                                ray_tpu.cancel(resp.ref)
+                            except Exception:  # noqa: BLE001
+                                pass
+                            proxy._note_degradation(deployment, method,
+                                                    "expired")
                             return _DEADLINE
+                        except Exception as e:  # noqa: BLE001
+                            kind = classify_request_error(e)
+                            if kind == "shed":
+                                holder["detail"] = repr(e)
+                                return _SHED
+                            if kind == "expired":
+                                from ray_tpu.serve.proxy import (
+                                    replica_counted_expiry,
+                                )
+                                proxy._note_degradation(
+                                    deployment, method, "expired",
+                                    metric=not replica_counted_expiry(e))
+                                holder["detail"] = repr(e)
+                                return _EXPIRED
+                            raise
 
+                    if proxy._active >= proxy._max_concurrent:
+                        # pool fully pinned: shed at the event loop
+                        # instead of queueing invisibly in the executor
+                        asyncio.get_event_loop().run_in_executor(
+                            None, proxy._note_degradation,
+                            deployment, method, "shed")
+                        await context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED,
+                            f"proxy at max concurrent calls "
+                            f"({proxy._max_concurrent}); retry later")
+                    proxy._active += 1  # event-loop-confined
+                    from ray_tpu.serve.proxy import _PoolLease
+
+                    def _release():
+                        proxy._active -= 1
+                    lease = _PoolLease(_release, asyncio.get_event_loop())
+                    cf = proxy._pool.submit(call_sync)
                     try:
-                        out = await asyncio.get_event_loop().run_in_executor(
-                            proxy._pool, call_sync)
+                        out = await asyncio.wrap_future(cf)
+                    except asyncio.CancelledError:
+                        # client cancelled the RPC: cancel the in-flight
+                        # replica task instead of letting it finish for
+                        # nobody; the pool thread stays pinned until the
+                        # cancel lands, so it carries the concurrency
+                        # slot out with it
+                        tracker.abandon_async()
+                        lease.defer_to(cf)
+                        raise
                     except Exception as e:  # noqa: BLE001
                         await context.abort(
                             grpc.StatusCode.INTERNAL,
                             f"{type(e).__name__}: {e}")
+                    finally:
+                        lease.settle()
+                    if out is _SHED:
+                        # admission rejected the request without touching
+                        # a replica: the client should back off + retry
+                        await context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED,
+                            f"deployment {deployment!r} shed the request "
+                            f"(queue full): {holder.get('detail', '')}")
+                    if out is _EXPIRED:
+                        await context.abort(
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                            f"request budget expired before deployment "
+                            f"{deployment!r} could serve it: "
+                            f"{holder.get('detail', '')}")
                     if out is _DEADLINE:
                         # DEADLINE_EXCEEDED only when the CLIENT's budget
                         # actually expired (wait was bound by remaining);

@@ -7,6 +7,15 @@ the controller (long-poll analog: refreshed on miss and periodically).
 Request contract: ``GET/POST {route_prefix}[/suffix]`` → deployment's
 ``__call__`` receives the JSON body (POST) or query-param dict (GET);
 the JSON-serialized return value is the response body.
+
+Overload protection: every route mints a :class:`RequestContext` (the
+``serve.proxy.admit`` fault site rides that edge) whose deadline comes
+from the client's ``X-Request-Timeout-S`` header capped by the proxy's
+``request_timeout_s``; the budget travels with the request through the
+router and replica.  A shed (``BackPressureError``) maps to **503 +
+``Retry-After``**, a spent budget to **504**; and a client that
+disconnects mid-request gets its in-flight replica task
+``ray_tpu.cancel``-ed instead of running to completion for nobody.
 """
 
 from __future__ import annotations
@@ -18,18 +27,194 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.context import new_request_context, scope
+from ray_tpu.util.fault_injection import fault_point
+
+
+def _unwrap_cause(e: BaseException) -> BaseException:
+    """Peel TaskError wrappers (a replica- or composition-raised overload
+    verdict arrives wrapped with the remote traceback)."""
+    from ray_tpu.exceptions import TaskError
+
+    depth = 0
+    while isinstance(e, TaskError) and e.cause is not None and depth < 8:
+        e = e.cause
+        depth += 1
+    return e
+
+
+def classify_request_error(e: BaseException) -> str:
+    """Map a serving-path exception to a degradation kind:
+    ``"shed"`` (admission rejected — retryable by the CLIENT later),
+    ``"expired"`` (deadline spent), ``"cancelled"``, or ``"error"``."""
+    from ray_tpu.exceptions import (
+        BackPressureError,
+        DeadlineExceededError,
+        GetTimeoutError,
+        TaskCancelledError,
+        TaskError,
+    )
+
+    cause = _unwrap_cause(e)
+    if isinstance(cause, BackPressureError):
+        return "shed"
+    if isinstance(cause, (DeadlineExceededError, GetTimeoutError)):
+        return "expired"
+    if isinstance(cause, TaskCancelledError):
+        return "cancelled"
+    if isinstance(e, TaskError):
+        # unpicklable cause: fall back to the repr the wrapper carries
+        if "BackPressureError" in e.cause_repr:
+            return "shed"
+        if "DeadlineExceededError" in e.cause_repr:
+            return "expired"
+        if "TaskCancelledError" in e.cause_repr:
+            return "cancelled"
+    return "error"
+
+
+def replica_counted_expiry(e: BaseException) -> bool:
+    """True when an expiry verdict was raised replica-side (a drop in
+    ``ReplicaActor._admit``) and arrived TaskError-wrapped: the replica
+    process already bumped the ``serve_requests_expired`` registry
+    counter, so a proxy must count it toward the controller aggregate
+    only (``metric=False``) to keep /metrics 1:1 with actual drops.
+    Shared by the HTTP and gRPC proxies — the accounting rule must not
+    diverge between them."""
+    from ray_tpu.exceptions import DeadlineExceededError, TaskError
+
+    cause = _unwrap_cause(e)
+    if cause is not e and isinstance(cause, DeadlineExceededError):
+        return True
+    return isinstance(e, TaskError) and "DeadlineExceededError" in e.cause_repr
+
+
+class AbandonTracker:
+    """Cancellation rendezvous between a route handler and its executor
+    dispatch (shared by the HTTP and gRPC proxies).
+
+    The dispatch may be blocked in the router's admission queue when the
+    client walks away — a poll-for-N-seconds watcher would give up and
+    let the work run to completion once a slot finally freed.  Instead,
+    whichever of ``bind()`` (dispatch bound a response) / ``abandon()``
+    (client disconnected) happens SECOND performs the cancel, so the
+    abandon always reaches the in-flight task no matter how long
+    admission took."""
+
+    def __init__(self, note_cancelled, cancel_fn=None):
+        self._lock = threading.Lock()
+        self._note = note_cancelled
+        self._cancel_fn = cancel_fn  # e.g. close a streaming generator
+        self._resp = None
+        self._abandoned = False
+        self._cancelled = False
+
+    @property
+    def resp(self):
+        return self._resp
+
+    def bind(self, resp) -> None:
+        with self._lock:
+            self._resp = resp
+            do = self._abandoned and not self._cancelled
+            if do:
+                self._cancelled = True
+        if do:
+            self._cancel()
+
+    def abandon(self) -> None:
+        with self._lock:
+            self._abandoned = True
+            do = self._resp is not None and not self._cancelled
+            if do:
+                self._cancelled = True
+        if do:
+            self._cancel()
+
+    def abandon_async(self) -> None:
+        """Abandon from an event-loop thread: the cancel is a blocking
+        control-plane RPC, so hand it to a short-lived daemon thread."""
+        threading.Thread(target=self.abandon, daemon=True,
+                         name="serve-proxy-cancel").start()
+
+    def _cancel(self) -> None:
+        try:
+            if self._cancel_fn is not None:
+                self._cancel_fn(self._resp)
+            else:
+                ray_tpu.cancel(self._resp.ref)
+        except Exception:  # noqa: BLE001 — already finished
+            pass
+        try:
+            self._note()
+        except Exception:  # noqa: BLE001 — visibility never masks teardown
+            pass
+
+
+class _PoolLease:
+    """One admitted request's claim on a dispatch-pool thread (shared by
+    the HTTP and gRPC proxies).
+
+    ``_active`` must track pool OCCUPANCY, not handler liveness: when a
+    client disconnects while its dispatch is still blocked on a pool
+    thread (e.g. waiting in the router admission queue, or in a result
+    wait), the decrement is deferred to the moment that thread actually
+    returns.  Releasing eagerly on disconnect would let new arrivals
+    pass the ``max_concurrent`` check and park in the executor's
+    unbounded internal work queue — uncounted, deadline-unchecked, and
+    invisible to the admission bounds."""
+
+    def __init__(self, release, loop):
+        self._release = release  # runs on the event loop, exactly once
+        self._loop = loop
+        self._done = False
+        self._deferred = False
+
+    def _fire(self):
+        # event-loop-confined, like the counter it decrements
+        if not self._done:
+            self._done = True
+            self._release()
+
+    def defer_to(self, cf) -> None:
+        """Hand the release to the executor future still pinning the
+        thread (event-loop context; the callback may fire on the pool
+        thread, so it trampolines back through the loop)."""
+        self._deferred = True
+        cf.add_done_callback(
+            lambda _f: self._loop.call_soon_threadsafe(self._fire))
+
+    def settle(self) -> None:
+        """Release now unless a ``defer_to`` owns it (event-loop
+        context; the handler's ``finally``)."""
+        if not self._deferred:
+            self._fire()
 
 
 @ray_tpu.remote
 class ProxyActor:
     def __init__(self, host: str, port: int,
-                 request_timeout_s: float = 120.0):
+                 request_timeout_s: float = 120.0,
+                 max_concurrent_requests: int = 256):
+        import concurrent.futures
+
         self._host = host
         self._port = port
         # reference: serve HTTPOptions.request_timeout_s — a big model's
         # FIRST request includes jit compilation and can far exceed a
         # one-size-fits-all minute
         self._request_timeout_s = request_timeout_s
+        # Every in-flight request pins one dispatch-pool thread (that
+        # blocking wait IS its router admission-queue entry), so the pool
+        # is sized to the cap and arrivals beyond it shed with 503 at the
+        # event loop — an undersized shared executor would instead park
+        # them in its unbounded internal work queue: uncounted,
+        # deadline-unchecked, and invisible to the admission bounds.
+        self._max_concurrent = max_concurrent_requests
+        self._active = 0  # event-loop-confined: handler increments/decrements
+        self._dispatch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_concurrent_requests,
+            thread_name_prefix="serve-proxy-dispatch")
         self._routes: Dict[str, str] = {}
         self._routes_at = 0.0
         self._handles: Dict[str, Any] = {}
@@ -83,19 +268,109 @@ class ProxyActor:
             self._handles[key] = h
         return h
 
-    async def _stream_sse(self, request, handle, body, loop):
-        """Proxy a streaming deployment call as Server-Sent Events."""
-        import json
+    def _mint_context(self, request):
+        """One RequestContext per route invocation (enforced by the
+        ``test_every_proxy_route_mints_request_context`` tooling guard):
+        the client may SHORTEN the budget via ``X-Request-Timeout-S``,
+        never extend past the proxy's ``request_timeout_s`` cap."""
+        fault_point("serve.proxy.admit")
+        timeout_s = self._request_timeout_s
+        hdr = request.headers.get("X-Request-Timeout-S", "")
+        if hdr:
+            try:
+                timeout_s = max(0.0, min(float(hdr), timeout_s))
+            except ValueError:
+                pass
+        return new_request_context(
+            timeout_s=timeout_s,
+            request_id=request.headers.get("X-Request-Id") or None)
 
+    def _note_degradation(self, deployment: str, kind: str,
+                          metric: bool = True):
+        """Attribute a shed/expiry/cancel observed at the proxy to the
+        deployment's overload stats (the router owns the counters so
+        driver handles and proxies aggregate in one place).
+        ``metric=False`` counts toward the controller aggregate only —
+        used when the originating process already bumped the registry
+        counter (a replica-stage drop) so /metrics isn't double-counted."""
+        try:
+            router = self._handle_for(deployment)._get_router()
+        except Exception:  # noqa: BLE001 — visibility never masks the error
+            return
+        if kind == "cancelled":
+            router.note_cancelled()
+        elif kind == "expired":
+            router.note_expired(bump_metric=metric)
+        elif kind == "shed":
+            router.note_shed()
+
+    def _error_response(self, e: BaseException, deployment: str):
+        from aiohttp import web
+        from ray_tpu.exceptions import BackPressureError
+
+        kind = classify_request_error(e)
+        if kind == "shed":
+            cause = _unwrap_cause(e)
+            retry_after = cause.retry_after_s if isinstance(
+                cause, BackPressureError) else 1.0
+            # shed counter lives in the router (it raised); just map it
+            return web.json_response(
+                {"error": repr(e), "retry_after_s": retry_after},
+                status=503,
+                headers={"Retry-After": str(max(1, int(retry_after)))})
+        if kind == "expired":
+            from ray_tpu.exceptions import DeadlineExceededError
+
+            # a BARE DeadlineExceededError was raised (and counted) by
+            # this process's router; only count expiries the proxy itself
+            # observed.  A replica-stage drop (TaskError-wrapped
+            # DeadlineExceededError) already bumped the registry counter
+            # in the replica process — count it toward the controller
+            # aggregate only, so /metrics reports one expiry per drop.
+            if not isinstance(e, DeadlineExceededError):
+                self._note_degradation(
+                    deployment, "expired",
+                    metric=not replica_counted_expiry(e))
+            return web.json_response({"error": repr(e)}, status=504)
+        return web.json_response({"error": repr(e)}, status=500)
+
+    async def _stream_sse(self, request, handle, body, loop, ctx, lease):
+        """Proxy a streaming deployment call as Server-Sent Events."""
         from aiohttp import web
 
         _END = object()
+        dep = handle._deployment
+        # closing the ref generator releases the router's admission slot
+        # and cancels the replica-side producer task; the tracker makes
+        # that happen exactly once, whether the client drops the stream
+        # while the dispatch is still acquiring a slot or mid-write
+        tracker = AbandonTracker(
+            lambda: self._note_degradation(dep, "cancelled"),
+            cancel_fn=lambda resp: _close_stream(resp.ref_generator))
 
+        def _dispatch():
+            # a dispatch that raises never binds: abandon() then has
+            # nothing to cancel and stays a no-op
+            with scope(ctx):
+                resp = handle.remote_streaming(body)
+            it = iter(resp)
+            tracker.bind(resp)
+            return resp, it
+
+        cf = self._dispatch_pool.submit(_dispatch)
         try:
-            stream = await loop.run_in_executor(
-                None, lambda: iter(handle.remote_streaming(body)))
+            stream_resp, stream = await asyncio.wrap_future(cf)
+        except asyncio.CancelledError:
+            # client dropped the SSE request before the dispatch bound:
+            # the bind (whenever the admission queue frees it) closes the
+            # stream instead of letting the producer run for nobody; the
+            # pool thread is still pinned until then, so the concurrency
+            # slot follows it, not this handler
+            tracker.abandon_async()
+            lease.defer_to(cf)
+            raise
         except Exception as e:  # noqa: BLE001
-            return web.json_response({"error": repr(e)}, status=500)
+            return self._error_response(e, dep)
 
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream",
@@ -110,7 +385,12 @@ class ProxyActor:
 
         try:
             while True:
-                item = await loop.run_in_executor(None, _next)
+                cf = self._dispatch_pool.submit(_next)
+                try:
+                    item = await asyncio.wrap_future(cf)
+                except asyncio.CancelledError:
+                    lease.defer_to(cf)  # thread blocked in next(stream)
+                    raise
                 if item is _END:
                     break
                 try:
@@ -118,6 +398,10 @@ class ProxyActor:
                 except TypeError:
                     frame = json.dumps({"text": str(item)})
                 await resp.write(f"data: {frame}\n\n".encode())
+        except asyncio.CancelledError:
+            # client dropped the SSE stream mid-write
+            tracker.abandon_async()
+            raise
         except Exception as e:  # noqa: BLE001
             await resp.write(
                 f"event: error\ndata: {json.dumps(repr(e))}\n\n".encode())
@@ -137,6 +421,32 @@ class ProxyActor:
             if dep is None:
                 return web.json_response(
                     {"error": f"no deployment for {request.path}"}, status=404)
+            if self._active >= self._max_concurrent:
+                # dispatch pool fully pinned: shed HERE, at the event
+                # loop, instead of parking the request in an executor
+                # work queue where no bound, deadline check, or counter
+                # can see it
+                loop.run_in_executor(None, self._note_degradation,
+                                     dep, "shed")
+                return web.json_response(
+                    {"error": "proxy at max_concurrent_requests "
+                              f"({self._max_concurrent})",
+                     "retry_after_s": 1.0},
+                    status=503, headers={"Retry-After": "1"})
+            self._active += 1  # event-loop-confined: no lock needed
+
+            def _release():
+                self._active -= 1
+            lease = _PoolLease(_release, loop)
+            try:
+                return await routed(request, dep, lease)
+            finally:
+                # a disconnect mid-dispatch defers the release to the
+                # pool thread still pinned by this request
+                lease.settle()
+
+        async def routed(request: "web.Request", dep: str,
+                         lease: _PoolLease) -> "web.Response":
             if request.method == "POST":
                 try:
                     body = await request.json()
@@ -150,6 +460,9 @@ class ProxyActor:
             mux_id = request.headers.get("serve_multiplexed_model_id", "")
             if mux_id:
                 handle = handle.options(multiplexed_model_id=mux_id)
+            # the request's end-to-end budget + id, minted ONCE per route
+            # and carried through router → replica → nested handles
+            ctx = self._mint_context(request)
             # SSE streaming: the deployment method is a generator and the
             # client opted in (Accept: text/event-stream or ?stream=1);
             # each yielded item becomes one `data:` event the moment the
@@ -163,17 +476,53 @@ class ProxyActor:
                 method = request.query.get("method")
                 if method and not method.startswith("_"):
                     handle = self._handle_for(dep, method)
-                return await self._stream_sse(request, handle, body, loop)
+                return await self._stream_sse(request, handle, body, loop,
+                                              ctx, lease)
+            tracker = AbandonTracker(
+                lambda: self._note_degradation(dep, "cancelled"))
+
+            def _dispatch():
+                # run_in_executor does not propagate contextvars: re-enter
+                # the request scope explicitly on the executor thread
+                with scope(ctx):
+                    resp = handle.remote(body)
+                tracker.bind(resp)
+                return resp
+
+            cf = None
             try:
-                resp = await loop.run_in_executor(
-                    None, lambda: handle.remote(body).result(
-                        timeout=self._request_timeout_s))
+                cf = self._dispatch_pool.submit(_dispatch)
+                resp_obj = await asyncio.wrap_future(cf)
+                cf = self._dispatch_pool.submit(
+                    lambda: resp_obj.result(timeout=ctx.remaining_s()))
+                out = await asyncio.wrap_future(cf)
+            except asyncio.CancelledError:
+                # client disconnected mid-request (handler_cancellation):
+                # don't let the replica finish work nobody will read.
+                # bind/abandon rendezvous: even if the dispatch is still
+                # waiting in the router admission queue, the cancel lands
+                # the moment it binds — however long that takes.  The
+                # pool thread stays pinned until then, so the concurrency
+                # slot is released by it, not by this unwinding handler
+                tracker.abandon_async()
+                if cf is not None:
+                    lease.defer_to(cf)
+                raise
             except Exception as e:
-                return web.json_response({"error": repr(e)}, status=500)
+                kind = classify_request_error(e)
+                if kind == "expired" and tracker.resp is not None:
+                    # budget spent while we waited: the work is abandoned
+                    # — cancel it so a stalled replica doesn't keep a
+                    # slot pinned for a client that's gone
+                    try:
+                        ray_tpu.cancel(tracker.resp.ref)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return self._error_response(e, dep)
             try:
-                return web.json_response(resp)
+                return web.json_response(out)
             except TypeError:
-                return web.Response(text=str(resp))
+                return web.Response(text=str(out))
 
         async def health(_request):
             return web.json_response({"status": "ok"})
@@ -181,7 +530,13 @@ class ProxyActor:
         app = web.Application()
         app.router.add_route("GET", "/-/healthz", health)
         app.router.add_route("*", "/{tail:.*}", handler)
-        runner = web.AppRunner(app)
+        # handler_cancellation: a client disconnect must CANCEL the
+        # in-flight handler (and through it the replica task) — aiohttp
+        # 3.9+ made that opt-in
+        try:
+            runner = web.AppRunner(app, handler_cancellation=True)
+        except TypeError:  # older aiohttp: cancellation was the default
+            runner = web.AppRunner(app)
 
         async def start():
             await runner.setup()
@@ -196,3 +551,12 @@ class ProxyActor:
             return
         self._ready.set()
         loop.run_forever()
+
+
+def _close_stream(stream):
+    close = getattr(stream, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -117,6 +117,12 @@ class ReplicaActor:
         self._replica_id = replica_id
         self._ongoing = 0
         self._total = 0
+        # degradation counters: deadline-expired drops (the request sat
+        # queued past its budget — never executed) and client-abandon
+        # cancellations that landed mid-execution
+        self._expired = 0
+        self._cancelled = 0
+        self._overload = None  # lazy OverloadStats (metrics registry)
         self._lock = threading.Lock()
         if isinstance(target, type):
             self._callable = target(*init_args, **init_kwargs)
@@ -131,23 +137,62 @@ class ReplicaActor:
             self._callable.reconfigure(user_config)
         return True
 
+    def _admit(self, ctx):
+        """Pre-execution budget check (the ``serve.replica.call`` chaos
+        site rides this edge): a request whose deadline expired while it
+        sat queued behind the replica's concurrency limit is dropped
+        WITHOUT running — the client stopped waiting, so executing it
+        would burn replica (TPU) time on a discarded answer."""
+        from ray_tpu.exceptions import DeadlineExceededError
+        from ray_tpu.serve.context import OverloadStats
+        from ray_tpu.util.fault_injection import fault_point
+
+        fault_point("serve.replica.call")
+        if ctx is not None and ctx.expired():
+            with self._lock:
+                self._expired += 1
+                if self._overload is None:
+                    self._overload = OverloadStats(self._deployment)
+            try:
+                self._overload.note_expired()
+            except Exception:  # noqa: BLE001 — metrics must not fail requests
+                pass
+            raise DeadlineExceededError(
+                request_id=ctx.request_id, deployment=self._deployment,
+                stage="replica-queue", overrun_s=ctx.overrun_s())
+
     def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       multiplexed_model_id: str = ""):
+                       multiplexed_model_id: str = "",
+                       request_context: Optional[dict] = None):
+        from ray_tpu.exceptions import TaskCancelledError
+        from ray_tpu.serve.context import RequestContext, scope
         from ray_tpu.serve.multiplex import _mux_model_id
 
+        ctx = RequestContext.from_dict(request_context)
+        self._admit(ctx)
         with self._lock:
             self._ongoing += 1
             self._total += 1
         token = _mux_model_id.set(multiplexed_model_id)
         try:
-            fn = getattr(self._callable, method, None)
-            if fn is None:
-                raise AttributeError(
-                    f"deployment {self._deployment} has no method {method!r}")
-            result = fn(*args, **kwargs)
-            if asyncio.iscoroutine(result):
-                result = asyncio.run(result)  # creates AND closes the loop
-            return result
+            # scope(ctx): nested DeploymentHandle calls made by the user
+            # callable inherit the REMAINING budget through the contextvar
+            with scope(ctx):
+                fn = getattr(self._callable, method, None)
+                if fn is None:
+                    raise AttributeError(
+                        f"deployment {self._deployment} has no method "
+                        f"{method!r}")
+                result = fn(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = asyncio.run(result)  # creates AND closes the loop
+                return result
+        except TaskCancelledError:
+            # client abandoned the request and the proxy cancelled us
+            # mid-execution (injected at a bytecode boundary)
+            with self._lock:
+                self._cancelled += 1
+            raise
         finally:
             _mux_model_id.reset(token)
             with self._lock:
@@ -155,23 +200,34 @@ class ReplicaActor:
 
     def handle_request_streaming(self, method: str, args: tuple,
                                  kwargs: dict,
-                                 multiplexed_model_id: str = ""):
+                                 multiplexed_model_id: str = "",
+                                 request_context: Optional[dict] = None):
         """Generator twin of handle_request: invoked with
         ``num_returns="streaming"`` so each yielded item reaches the
         caller the moment the user generator produces it (reference:
         serve streaming responses over streaming generators)."""
+        from ray_tpu.exceptions import TaskCancelledError
+        from ray_tpu.serve.context import RequestContext, scope
         from ray_tpu.serve.multiplex import _mux_model_id
 
+        ctx = RequestContext.from_dict(request_context)
+        self._admit(ctx)
         with self._lock:
             self._ongoing += 1
             self._total += 1
         token = _mux_model_id.set(multiplexed_model_id)
         try:
-            fn = getattr(self._callable, method, None)
-            if fn is None:
-                raise AttributeError(
-                    f"deployment {self._deployment} has no method {method!r}")
-            yield from fn(*args, **kwargs)
+            with scope(ctx):
+                fn = getattr(self._callable, method, None)
+                if fn is None:
+                    raise AttributeError(
+                        f"deployment {self._deployment} has no method "
+                        f"{method!r}")
+                yield from fn(*args, **kwargs)
+        except TaskCancelledError:
+            with self._lock:
+                self._cancelled += 1
+            raise
         finally:
             _mux_model_id.reset(token)
             with self._lock:
@@ -194,7 +250,8 @@ class ReplicaActor:
         import os
 
         return {"replica_id": self._replica_id, "ongoing": self._ongoing,
-                "total": self._total, "pid": os.getpid()}
+                "total": self._total, "expired": self._expired,
+                "cancelled": self._cancelled, "pid": os.getpid()}
 
     def check_health(self) -> bool:
         if hasattr(self._callable, "check_health"):

@@ -5,17 +5,32 @@ and ``serve/_private/replica_scheduler/pow_2_scheduler.py``
 (``PowerOfTwoChoicesReplicaScheduler :52``, ``choose_replica_for_request
 :816``): sample two replicas, probe queue lengths (with a short-lived
 cache), send to the shorter queue.
+
+Overload protection (reference: ``serve/_private/router.py``
+queue-length-capped scheduling): the router is the serving path's
+admission valve.  It tracks its own dispatched-but-unfinished count per
+replica and never sends a replica more than ``max_ongoing_requests``;
+excess requests wait in a bounded router-side queue
+(``max_queued_requests``), and once THAT is full new arrivals fail fast
+with ``BackPressureError`` instead of piling up without limit behind a
+stalled replica.  Requests carry a deadline (``serve.context``): one
+whose budget is already spent is rejected before dispatch rather than
+executed for a client that stopped waiting.
 """
 
 from __future__ import annotations
 
+import collections
 import random
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import resilience
+from ray_tpu.exceptions import BackPressureError, DeadlineExceededError
+from ray_tpu.serve.context import OverloadStats, current_context
 from ray_tpu.util.fault_injection import fault_point
 
 
@@ -24,7 +39,14 @@ def _assign_retryable(err: BaseException) -> bool:
     replica (it died; the controller will repopulate the set) and the
     empty-replica window during a rolling update.  Application errors
     raised by the replica's own code surface through the returned ref,
-    not here, so anything else at dispatch time is fatal."""
+    not here, so anything else at dispatch time is fatal.  Overload
+    verdicts are explicitly NON-retryable: a shed (``BackPressureError``)
+    means the queue is full — re-entering it from inside the router would
+    defeat the bound (the PROXY owns the retry decision, via
+    ``Retry-After``) — and a spent deadline (``DeadlineExceededError``)
+    can only get more spent."""
+    if isinstance(err, (BackPressureError, DeadlineExceededError)):
+        return False
     return resilience.is_retryable(err) or "has no replicas" in str(err)
 
 
@@ -43,7 +65,8 @@ class DeploymentResponse:
 
 
 class Router:
-    """Pow-2 replica chooser with a queue-length cache."""
+    """Pow-2 replica chooser with a queue-length cache and a bounded
+    admission queue."""
 
     QUEUE_LEN_CACHE_S = 2.0
     # dispatch-time affinity entries are provisional for this long: the
@@ -56,12 +79,25 @@ class Router:
     # one controller RPC PER REQUEST (measured: the largest serve-path
     # overhead after the replica call itself on a 1-vCPU box)
     VERSION_CHECK_INTERVAL_S = 0.5
+    # how long a queued request sleeps between capacity re-checks (a
+    # completion notifies the condition immediately; this only bounds the
+    # staleness of the replica-set view while waiting)
+    QUEUE_POLL_S = 0.05
+    # an unchanged overload snapshot is still re-pushed this often so the
+    # controller can tell idle-but-alive reporters from exited ones
+    # (must stay well under Controller.OVERLOAD_RETIRE_S)
+    REPORT_HEARTBEAT_S = 5.0
 
     def __init__(self, deployment_name: str, controller):
         self._deployment = deployment_name
         self._controller = controller
         self._replicas: List[Any] = []
-        self._max_ongoing = 16
+        # concurrency knobs are SEEDED FROM THE DEPLOYMENT CONFIG by the
+        # refresh() below, never from a magic default: early traffic
+        # against a low-concurrency deployment must not over-dispatch
+        # during the pre-refresh window
+        self._max_ongoing: Optional[int] = None
+        self._max_queued: int = -1
         self._version = -1
         self._qlen_cache: Dict[str, tuple] = {}  # actor id -> (len, expiry)
         # model-aware routing (reference multiplex.py): model id ->
@@ -71,9 +107,38 @@ class Router:
         # consulted by _sync_models to keep provisional entries alive
         self._mux_dispatch_t: Dict[tuple, float] = {}
         self._lock = threading.Lock()
+        # admission state: replica key -> dispatched-but-unfinished count,
+        # resolved by the completion watcher; waiters block on the
+        # condition until a slot frees (or their deadline expires)
+        self._cond = threading.Condition(self._lock)
+        self._inflight: Dict[str, int] = {}
+        self._outstanding: Dict[Any, str] = {}  # ref -> replica key
+        self._queued = 0
+        # slot releases from _SlotReleasingStream.__del__: a GC finalizer
+        # must not take the router lock (it could fire while THIS thread
+        # holds it), so it appends here (deque.append is atomic) and the
+        # next assign / watcher pass drains it
+        self._orphan_releases: collections.deque = collections.deque()
+        self._stopped = threading.Event()
+        self._overload = OverloadStats(deployment_name)
+        self._reporter_id = uuid.uuid4().hex[:12]
+        self._last_reported: Optional[Dict[str, int]] = None
+        self._last_report_t = 0.0
         self._rng = random.Random()
         self._last_version_check = 0.0
         self.refresh()
+        # the completion watcher doubles as the overload-report
+        # heartbeat, so it starts eagerly: a router whose traffic was
+        # ALL shed (nothing ever dispatched) must still get its final
+        # counters to the controller after the burst ends
+        self._watcher = threading.Thread(
+            target=self._watch_loop, daemon=True,
+            name=f"serve-router-watch-{deployment_name}")
+        self._watcher.start()
+
+    @property
+    def overload_stats(self) -> OverloadStats:
+        return self._overload
 
     def refresh(self):
         info = ray_tpu.get(
@@ -83,8 +148,10 @@ class Router:
         with self._lock:
             self._replicas = info["replicas"]
             self._max_ongoing = info["max_ongoing_requests"]
+            self._max_queued = info.get("max_queued_requests", -1)
             self._version = info["version"]
             self._qlen_cache.clear()  # cache keys are replica ids; drop stale
+            self._cond.notify_all()  # new replicas may mean new capacity
 
     def _maybe_refresh(self):
         # long-poll analog: cheap version check piggybacked on the probe
@@ -102,6 +169,34 @@ class Router:
             return
         if v != self._version:
             self.refresh()
+        self._report_overload()
+
+    def _report_overload(self):
+        """Snapshot-deduped fire-and-forget push of this router's
+        shed/expired/cancelled/queued counters to the controller, which
+        aggregates across reporter processes into the published serve
+        status.  Called from the request path (rides _maybe_refresh) AND
+        from the completion watcher — the watcher's calls are what land
+        the final drained-to-zero ``queued`` gauge after traffic stops
+        (a request-path-only report would leave the last mid-burst
+        snapshot, with its phantom queued count, published forever)."""
+        snap = self._overload.snapshot()
+        now = time.monotonic()
+        # dedup unchanged snapshots, but never go silent longer than the
+        # heartbeat: the controller retires reporters it hasn't heard
+        # from (folding their counters into a base) — a live-but-idle
+        # router must keep proving it's alive or its eventual next
+        # report would double-count against the folded base
+        if (snap == self._last_reported
+                and now - self._last_report_t < self.REPORT_HEARTBEAT_S):
+            return
+        self._last_reported = snap
+        self._last_report_t = now
+        try:
+            self._controller.report_overload.remote(
+                self._deployment, self._reporter_id, snap)
+        except Exception:  # noqa: BLE001 — visibility never fails a request
+            pass
 
     def _cache_key(self, replica) -> str:
         return replica._actor_id.hex()
@@ -154,9 +249,9 @@ class Router:
                     k: t for k, t in self._mux_dispatch_t.items()
                     if now - t < self.MODEL_LOAD_GRACE_S}
 
-    def choose_replica(self, model_id: str = ""):
-        # operate on a snapshot: a concurrent refresh() must not shift
-        # indices under us
+    # ------------------------------------------------------------- admission
+
+    def _replicas_snapshot(self) -> List[Any]:
         with self._lock:
             reps = list(self._replicas)
         if not reps:
@@ -166,6 +261,150 @@ class Router:
             if not reps:
                 raise RuntimeError(
                     f"deployment {self._deployment!r} has no replicas")
+        return reps
+
+    def _capacity_candidates(self, reps: List[Any]) -> List[Any]:
+        """Replicas this router may still dispatch to (its own
+        dispatched-but-unfinished count is under max_ongoing)."""
+        with self._lock:
+            limit = self._max_ongoing or 1
+            return [r for r in reps
+                    if self._inflight.get(self._cache_key(r), 0) < limit]
+
+    def _acquire_replica(self, model_id: str, ctx):
+        """Admission valve: pick a replica with spare capacity and reserve
+        one slot on it.  When every replica is saturated the caller waits
+        in the bounded router queue; a full queue sheds the request with
+        ``BackPressureError`` and a spent deadline drops it with
+        ``DeadlineExceededError`` — both BEFORE any replica sees it."""
+        queued = False
+        try:
+            while True:
+                self._drain_orphans()
+                reps = self._replicas_snapshot()
+                candidates = self._capacity_candidates(reps)
+                if candidates:
+                    pick = self.choose_replica(model_id, candidates)
+                    with self._lock:
+                        key = self._cache_key(pick)
+                        if self._inflight.get(key, 0) < (self._max_ongoing
+                                                         or 1):
+                            self._inflight[key] = \
+                                self._inflight.get(key, 0) + 1
+                            return pick
+                    continue  # lost the reservation race: re-pick
+                # saturated: join (or keep) a bounded wait-queue slot
+                with self._cond:
+                    if not queued:
+                        if 0 <= self._max_queued <= self._queued:
+                            self._overload.note_shed()
+                            raise BackPressureError(
+                                deployment=self._deployment,
+                                queued=self._queued,
+                                limit=self._max_queued,
+                                retry_after_s=self._retry_after_hint())
+                        self._queued += 1
+                        self._overload.note_queued(+1)
+                        queued = True
+                    if ctx is not None and ctx.expired():
+                        self._overload.note_expired()
+                        raise DeadlineExceededError(
+                            request_id=ctx.request_id,
+                            deployment=self._deployment,
+                            stage="router-queue",
+                            overrun_s=ctx.overrun_s())
+                    wait_s = self.QUEUE_POLL_S
+                    if ctx is not None:
+                        remaining = ctx.remaining_s()
+                        if remaining is not None:
+                            wait_s = max(0.0, min(wait_s, remaining))
+                    self._cond.wait(timeout=wait_s)
+                self._maybe_refresh()  # autoscale may have added capacity
+        finally:
+            if queued:
+                with self._cond:
+                    self._queued -= 1
+                    self._overload.note_queued(-1)
+
+    def _retry_after_hint(self) -> float:
+        """Rough time for one queue position to free: assume the oldest
+        in-flight request completes within a second — intentionally a
+        HINT (HTTP Retry-After), not a promise."""
+        return 1.0
+
+    def _release(self, key: str):
+        with self._cond:
+            n = self._inflight.get(key, 0)
+            if n <= 1:
+                self._inflight.pop(key, None)
+            else:
+                self._inflight[key] = n - 1
+            self._cond.notify_all()
+
+    def _drain_orphans(self):
+        while True:
+            try:
+                key = self._orphan_releases.popleft()
+            except IndexError:
+                return
+            self._release(key)
+
+    def stop(self):
+        """Settle the watcher thread (serve.shutdown); the router object
+        is being dropped and must not pin a daemon thread forever."""
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def _track_completion(self, ref, key: str):
+        """Register a dispatched ref with the completion watcher, which
+        releases the replica slot when the task finishes (success, error,
+        cancellation, or replica death — ``wait`` resolves them all)."""
+        with self._cond:
+            self._outstanding[ref] = key
+            self._cond.notify_all()
+
+    def _watch_loop(self):
+        while not self._stopped.is_set():
+            self._drain_orphans()
+            self._report_overload()  # outside the lock: settles counters
+            with self._cond:
+                if not self._outstanding:
+                    self._cond.wait(timeout=5.0)
+                refs = list(self._outstanding)
+            if not refs:
+                continue  # idle tick: loop back (report) and wait again
+            try:
+                # num_returns=1: wake the moment the FIRST watched ref
+                # resolves (a batch drains through instant follow-up
+                # waits) instead of spinning at QUEUE_POLL_S granularity;
+                # the timeout only bounds how long a ref dispatched AFTER
+                # this wait started goes unwatched
+                ready, _ = ray_tpu.wait(
+                    refs, num_returns=1, timeout=0.1, fetch_local=False)
+            except Exception:  # noqa: BLE001 — worker tearing down
+                time.sleep(0.5)
+                continue
+            if not ready:
+                continue
+            with self._cond:
+                keys = [self._outstanding.pop(r) for r in ready
+                        if r in self._outstanding]
+            for key in keys:
+                self._release(key)
+
+    def inflight_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._inflight)
+
+    # -------------------------------------------------------------- choosing
+
+    def choose_replica(self, model_id: str = "",
+                       reps: Optional[List[Any]] = None):
+        # operate on a snapshot: a concurrent refresh() must not shift
+        # indices under us
+        if reps is None:
+            reps = self._replicas_snapshot()
         if model_id:
             pick, has_holders = self._choose_for_model(model_id, reps)
             if pick is not None:
@@ -207,12 +446,13 @@ class Router:
         model-aware routing in the pow-2 scheduler."""
         with self._lock:
             keys = list(self._mux_affinity.get(model_id, ()))
+            limit = self._max_ongoing or 1
         if keys:
             by_key = {self._cache_key(r): r for r in reps}
             holders = [by_key[k] for k in keys if k in by_key]
             if holders:
                 best = min(holders, key=self._probe)
-                if self._probe(best) < self._max_ongoing:
+                if self._probe(best) < limit:
                     return best, True
                 return None, True
         return None, False
@@ -244,26 +484,61 @@ class Router:
             if hit:
                 self._qlen_cache[key] = (hit[0] + 1, hit[1])
 
-    # replica dispatch: a dead replica refreshes the set and re-picks,
-    # with a short backoff so a controller mid-update has time to land
-    # the new replica list (the old bare 3x loop retried EVERY exception
-    # instantly, hammering a deployment that was failing for real)
+    def note_cancelled(self):
+        """Proxy-observed client abandon: count it against this
+        deployment (the proxy already issued ``ray_tpu.cancel``)."""
+        self._overload.note_cancelled()
+
+    def note_shed(self):
+        """Proxy-level shed (its dispatch pool was fully pinned — the
+        request never reached this router's queue)."""
+        self._overload.note_shed()
+
+    def note_expired(self, bump_metric: bool = True):
+        """Proxy/handle-observed deadline expiry past dispatch (e.g. the
+        replica reported the drop, or the result wait timed out).
+        ``bump_metric=False`` when the originating process (a replica
+        dropping a spent request) already bumped the registry counter."""
+        self._overload.note_expired(bump_metric=bump_metric)
+
+    # ------------------------------------------------------------- dispatch
+    #
+    # a dead replica refreshes the set and re-picks, with a short backoff
+    # so a controller mid-update has time to land the new replica list
+    # (the old bare 3x loop retried EVERY exception instantly, hammering
+    # a deployment that was failing for real)
     ASSIGN_RETRY_POLICY = resilience.RetryPolicy(
         max_attempts=3, base_delay_s=0.05, max_delay_s=0.5)
 
     def _assign_with_retry(self, model_id: str, dispatch):
         """Shared retry harness for unary/streaming dispatch: classified
         errors refresh the replica set and retry with backoff; fatal
-        errors surface immediately."""
+        errors (including overload verdicts) surface immediately.
+        Returns ``(ref_or_gen, replica_key)``."""
 
         def _attempt():
+            ctx = current_context()
+            if ctx is not None and ctx.expired():
+                # budget spent before we even touched a replica: reject
+                # at the cheapest point instead of executing a discarded
+                # answer
+                self._overload.note_expired()
+                raise DeadlineExceededError(
+                    request_id=ctx.request_id, deployment=self._deployment,
+                    stage="router", overrun_s=ctx.overrun_s())
             fault_point("serve.router.assign")
             self._maybe_refresh()
-            replica = self.choose_replica(model_id)
-            ref = dispatch(replica)
+            replica = self._acquire_replica(model_id, ctx)
+            key = self._cache_key(replica)
+            try:
+                ref = dispatch(replica,
+                               None if ctx is None else ctx.to_dict())
+            except BaseException:
+                self._release(key)
+                raise
             self.note_dispatch(replica)
             self.note_model(model_id, replica)
-            return ref
+            return ref, key
 
         def _on_retry(attempt, err, delay):
             self.refresh()
@@ -275,20 +550,73 @@ class Router:
 
     def assign(self, method: str, args: tuple, kwargs: dict,
                model_id: str = ""):
-        return self._assign_with_retry(
+        ref, key = self._assign_with_retry(
             model_id,
-            lambda replica: replica.handle_request.remote(
-                method, args, kwargs, multiplexed_model_id=model_id))
+            lambda replica, ctx_d: replica.handle_request.remote(
+                method, args, kwargs, multiplexed_model_id=model_id,
+                request_context=ctx_d))
+        self._track_completion(ref, key)
+        return ref
 
     def assign_streaming(self, method: str, args: tuple, kwargs: dict,
                          model_id: str = ""):
-        """Route one streaming request; returns an ObjectRefGenerator."""
-        return self._assign_with_retry(
+        """Route one streaming request; returns an ObjectRefGenerator
+        (wrapped so the replica slot is released when the stream ends,
+        errors, or is dropped)."""
+        gen, key = self._assign_with_retry(
             model_id,
-            lambda replica: replica.handle_request_streaming.options(
+            lambda replica, ctx_d: replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
                     method, args, kwargs,
-                    multiplexed_model_id=model_id))
+                    multiplexed_model_id=model_id, request_context=ctx_d))
+        return _SlotReleasingStream(gen, self, key)
+
+
+class _SlotReleasingStream:
+    """Iterator proxy over a streaming dispatch that gives the replica's
+    admission slot back exactly once — on exhaustion, error, explicit
+    close, or garbage collection (a client that dropped the stream
+    without draining it must not leak capacity forever)."""
+
+    def __init__(self, gen, router: Router, key: str):
+        self._gen = gen
+        self._router = router
+        self._key = key
+        self._released = False
+
+    def _release(self):
+        if not self._released:
+            self._released = True
+            self._router._release(self._key)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:
+            self._release()
+            raise
+
+    def close(self):
+        try:
+            close = getattr(self._gen, "close", None)
+            if close is not None:
+                close()
+        finally:
+            self._release()
+
+    def __del__(self):
+        # GC context: must not take the router lock (the collector can
+        # fire while the owning thread holds it) — hand the release to
+        # the router's orphan queue instead
+        if not self._released:
+            self._released = True
+            self._router._orphan_releases.append(self._key)
+
+    def __getattr__(self, name):
+        return getattr(self._gen, name)
 
 
 class DeploymentHandle:

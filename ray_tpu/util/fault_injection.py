@@ -30,6 +30,8 @@ site                        guards
 ``gcs_store.wal_append``    the file-store WAL write (torn-write tests)
 ``worker.lease``            the owner's ``lease_worker`` raylet RPC
 ``serve.router.assign``     replica dispatch in the serve router
+``serve.proxy.admit``       proxy-side request-context mint (HTTP + gRPC)
+``serve.replica.call``      the replica's pre-execution admission edge
 ``gcs.drain_broadcast``     the GCS ``drain_node`` handler's hot edge
 ``raylet.drain_ack``        the raylet's ``drain_self`` ack (lost-RPC path)
 ``train.checkpoint.commit``  between checkpoint staging and rename-commit

@@ -65,6 +65,37 @@ def list_collective_groups() -> List[Dict[str, Any]]:
     return aggregate_status_records(records)
 
 
+def list_serve_deployments() -> List[Dict[str, Any]]:
+    """Per-deployment serve state from the controller's published status
+    snapshot (GCS KV, namespace "serve"): replica counts, concurrency /
+    queue bounds, and the aggregated overload counters — ``shed``
+    (admission rejections), ``expired`` (deadline drops), ``cancelled``
+    (client-abandoned work cancelled mid-flight), ``queued`` (currently
+    waiting for replica capacity).  Empty when serve is not running."""
+    import json as _json
+
+    try:
+        from ray_tpu.experimental import internal_kv
+
+        raw = internal_kv._internal_kv_get(b"status", namespace="serve")
+    except Exception:  # noqa: BLE001 — no cluster
+        return []
+    if not raw:
+        return []
+    try:
+        status = _json.loads(raw)
+    except Exception:  # noqa: BLE001 — snapshot mid-write
+        return []
+    routes = {dep: route for route, dep in
+              (status.get("routes") or {}).items()}
+    out = []
+    for name, info in (status.get("deployments") or {}).items():
+        entry = {"name": name, "route": routes.get(name)}
+        entry.update(info)
+        out.append(entry)
+    return out
+
+
 def list_actors() -> List[Dict[str, Any]]:
     w = _worker()
     out = w.run_coro(w.gcs.call("list_actors"))

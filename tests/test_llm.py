@@ -424,6 +424,41 @@ def test_paged_pool_preemption_preserves_output(tiny_model):
     assert outs == ref
 
 
+def test_engine_abort_frees_slot_and_queue(tiny_model):
+    """``abort`` drops an abandoned request: a queued one never runs, an
+    active one is retired on the next step with its slot and blocks
+    freed — the overload layer's cancel path must actually stop the
+    decode, not just stop waiting for it."""
+    from ray_tpu.llm import LLMEngine
+
+    cfg, params = tiny_model
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+    # decode_window < max_tokens: the first step must NOT run the request
+    # to completion, or there is nothing left alive to abort
+    eng = LLMEngine(cfg, params, batch_slots=1, max_len=64, block_size=4,
+                    decode_window=4)
+    active = eng.submit([3, 4, 5, 6], sp)
+    queued = eng.submit([9, 8, 7, 6], sp)  # single slot: stays queued
+    eng.step()  # admits `active` only
+    assert eng.queued_count() == 1
+
+    assert eng.abort(queued)  # still queued: removed outright
+    assert eng.queued_count() == 0
+    assert eng.abort(active)  # active: marked done, retired next step
+    outs = eng.step()
+    assert any(o.request_id == active for o in outs)
+    assert not eng.has_unfinished()  # slot freed, nothing queued
+    assert eng.free_slot_count() == 1
+    assert not eng.abort(12345)  # unknown id: no-op
+
+    # the freed capacity is genuinely reusable
+    rid = eng.submit([1, 2, 3], sp)
+    while eng.has_unfinished():
+        done = eng.step()
+    assert done and done[-1].request_id == rid
+    assert len(done[-1].token_ids) == 12
+
+
 def test_bpe_tokenizer_roundtrip_and_engine_default():
     from ray_tpu.llm.bpe import BPETokenizer
     from ray_tpu.llm.engine import ByteTokenizer, default_tokenizer

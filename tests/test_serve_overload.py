@@ -1,0 +1,598 @@
+"""Overload-protection tier: bounded admission, deadline propagation,
+cancellation of abandoned work (reference: Ray Serve's
+``max_queued_requests`` + ``request_timeout_s`` + disconnect handling).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import (
+    BackPressureError,
+    DeadlineExceededError,
+    GetTimeoutError,
+    RayTpuError,
+)
+
+
+@pytest.fixture
+def serve_shutdown(ray_start):
+    yield
+    serve.shutdown()
+
+
+def _replicas(name):
+    from ray_tpu.serve.controller import get_controller
+
+    info = ray_tpu.get(get_controller().get_deployment_info.remote(name))
+    return info["replicas"]
+
+
+def _wait_overload(name, key, minimum=1, timeout=15.0, poke=None):
+    """Poll serve.status() until the aggregated overload counter ``key``
+    reaches ``minimum`` (router reports ride request traffic, so ``poke``
+    may issue a cheap request per poll to flush them)."""
+    deadline = time.time() + timeout
+    last = {}
+    while time.time() < deadline:
+        if poke is not None:
+            try:
+                poke()
+            except Exception:  # noqa: BLE001
+                pass
+        last = serve.status().get(name, {}).get("overload", {})
+        if last.get(key, 0) >= minimum:
+            return last
+        time.sleep(0.3)
+    raise AssertionError(f"overload[{key!r}] never reached {minimum}: {last}")
+
+
+def test_backpressure_sheds_when_queue_full(serve_shutdown):
+    """2 slots + 2 queue positions: the 5th concurrent request fails FAST
+    with BackPressureError; the bound holds; the queued ones complete."""
+
+    @serve.deployment(max_ongoing_requests=2, max_queued_requests=2)
+    class Sleepy:
+        def __call__(self, s):
+            time.sleep(s)
+            return "ok"
+
+    handle = serve.run(Sleepy.bind())
+    assert handle.remote(0).result(timeout=30) == "ok"  # router warmed
+    router = handle._get_router()
+
+    results = {}
+
+    def call(i):
+        try:
+            results[i] = handle.remote(1.5).result(timeout=30)
+        except Exception as e:  # noqa: BLE001
+            results[i] = e
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    [t.start() for t in threads]
+    # wait until 2 dispatched + 2 queued
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        snap = router.overload_stats.snapshot()
+        if snap["queued"] >= 2:
+            break
+        time.sleep(0.02)
+    assert router.overload_stats.snapshot()["queued"] == 2
+
+    # the queue is full: a 5th request sheds immediately (no retry burn)
+    t0 = time.time()
+    with pytest.raises(BackPressureError) as ei:
+        handle.remote(0).result(timeout=30)
+    elapsed = time.time() - t0
+    assert elapsed < 1.0, f"shed took {elapsed:.2f}s — was it retried?"
+    assert ei.value.deployment == "Sleepy"
+    assert ei.value.retry_after_s > 0
+
+    # the router never over-dispatched while the storm ran
+    assert all(v <= 2 for v in router.inflight_snapshot().values()), \
+        router.inflight_snapshot()
+    [t.join(40) for t in threads]
+    assert [results[i] for i in range(4)] == ["ok"] * 4
+    snap = router.overload_stats.snapshot()
+    assert snap["shed"] >= 1
+    assert snap["peak_queued"] <= 2
+    # aggregated into the controller-published status
+    _wait_overload("Sleepy", "shed", poke=lambda: handle.remote(0).result(
+        timeout=10))
+
+
+def test_deadline_expires_in_router_queue(serve_shutdown):
+    """A queued request whose budget runs out is dropped by the ROUTER
+    (DeadlineExceededError) — the replica never sees it."""
+    marker = {}
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=8)
+    class OneLane:
+        def __call__(self, tag):
+            if tag == "blocker":
+                time.sleep(2.0)
+            return tag
+
+    handle = serve.run(OneLane.bind())
+    assert handle.remote("warm").result(timeout=30) == "warm"
+
+    blocker = threading.Thread(
+        target=lambda: marker.setdefault(
+            "blocker", handle.remote("blocker").result(timeout=30)))
+    blocker.start()
+    time.sleep(0.4)  # blocker occupies the single slot
+    t0 = time.time()
+    with serve.request_scope(timeout_s=0.5):
+        with pytest.raises(DeadlineExceededError) as ei:
+            handle.remote("victim").result(timeout=30)
+    assert ei.value.stage == "router-queue"
+    assert time.time() - t0 < 1.6  # rejected at the deadline, not after
+    blocker.join(30)
+    assert marker["blocker"] == "blocker"
+
+
+def test_replica_drops_expired_queued_request(serve_shutdown):
+    """The replica-side backstop: a request arriving with its deadline
+    already spent is dropped before the user callable runs."""
+
+    @serve.deployment
+    class Tracker:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, x):
+            self.calls += 1
+            return "ran"
+
+        def count(self):
+            return self.calls
+
+    handle = serve.run(Tracker.bind())
+    assert handle.remote(1).result(timeout=30) == "ran"
+    rep = _replicas("Tracker")[0]
+    expired_ctx = {"request_id": "expired-req",
+                   "deadline_s": time.time() - 1.0}
+    with pytest.raises(RayTpuError) as ei:
+        ray_tpu.get(rep.handle_request.remote(
+            "__call__", (1,), {}, "", expired_ctx), timeout=30)
+    assert "DeadlineExceededError" in repr(ei.value)
+    assert "replica-queue" in repr(ei.value)
+    stats = ray_tpu.get(rep.stats.remote(), timeout=30)
+    assert stats["expired"] >= 1
+    # the user callable never ran for the expired request
+    assert ray_tpu.get(rep.handle_request.remote("count", (), {}),
+                       timeout=30) == 1
+
+
+def test_nested_handle_inherits_deadline(serve_shutdown):
+    """Composition: the inner deployment sees the SAME request id and
+    absolute deadline the ingress was minted with — nested calls inherit
+    the remaining budget instead of resetting the clock."""
+
+    @serve.deployment
+    class Inner:
+        def __call__(self, _x):
+            ctx = serve.context.current_context()
+            assert ctx is not None, "context did not propagate"
+            return {"rid": ctx.request_id, "deadline": ctx.deadline_s}
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __call__(self, x):
+            return self.inner.remote(x).result(timeout=30)
+
+    handle = serve.run(Outer.bind(Inner.bind()))
+    with serve.request_scope(timeout_s=25.0) as ctx:
+        out = handle.remote(1).result(timeout=30)
+    assert out["rid"] == ctx.request_id
+    assert abs(out["deadline"] - ctx.deadline_s) < 1e-6
+
+
+def test_router_seeds_concurrency_from_config(serve_shutdown):
+    """Satellite: a fresh Router must carry the deployment's configured
+    bounds from construction — no hardcoded default window during which
+    early traffic could over-dispatch."""
+    from ray_tpu.serve.controller import get_controller
+    from ray_tpu.serve.router import Router
+
+    @serve.deployment(max_ongoing_requests=3, max_queued_requests=5)
+    class Narrow:
+        def __call__(self, x):
+            return x
+
+    serve.run(Narrow.bind())
+    router = Router("Narrow", get_controller())
+    try:
+        assert router._max_ongoing == 3
+        assert router._max_queued == 5
+    finally:
+        router.stop()
+
+
+def test_overload_errors_not_retryable_at_router():
+    """Satellite: the router must never retry a shed or an expired
+    deadline — the proxy owns the retry decision (Retry-After)."""
+    from ray_tpu.serve.router import _assign_retryable
+
+    assert not _assign_retryable(BackPressureError("d", 1, 1))
+    assert not _assign_retryable(DeadlineExceededError("r", "d"))
+    assert _assign_retryable(ConnectionError("replica link lost"))
+    assert _assign_retryable(RuntimeError("deployment 'd' has no replicas"))
+    assert not _assign_retryable(TypeError("bad request payload"))
+
+
+def test_http_503_retry_after_and_504(serve_shutdown):
+    """Proxy mapping: a shed returns 503 + Retry-After; a request whose
+    client budget (X-Request-Timeout-S) expires returns 504."""
+    import urllib.error
+    import urllib.request
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class Busy:
+        def __call__(self, body):
+            time.sleep(float(body.get("sleep", 0)))
+            return {"ok": True}
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 18441})
+    handle = serve.run(Busy.bind(), route_prefix="/busy")
+
+    def post(payload, headers=None, timeout=30):
+        req = urllib.request.Request(
+            "http://127.0.0.1:18441/busy", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    deadline = time.time() + 30
+    while True:  # proxy route warm-up
+        try:
+            assert post({"sleep": 0})["ok"]
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+    # occupy the single slot THROUGH THE PROXY (admission is scoped per
+    # routing process, like the reference's per-handle max_queued), then
+    # hit it again: queue is 0 → shed → 503
+    blocker = threading.Thread(
+        target=lambda: post({"sleep": 2.5}, timeout=30))
+    blocker.start()
+    time.sleep(0.5)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post({"sleep": 0})
+    assert ei.value.code == 503
+    assert int(ei.value.headers["Retry-After"]) >= 1
+    body = json.loads(ei.value.read())
+    assert "BackPressureError" in body["error"]
+    blocker.join(30)
+
+    # client-shortened budget expires mid-execution → 504
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post({"sleep": 3}, headers={"X-Request-Timeout-S": "0.5"})
+    assert ei.value.code == 504
+    _wait_overload("Busy", "expired",
+                   poke=lambda: post({"sleep": 0}))
+
+
+def test_grpc_shed_maps_to_resource_exhausted(serve_shutdown):
+    """gRPC mapping: a shed surfaces as RESOURCE_EXHAUSTED (back off and
+    retry), a spent budget as DEADLINE_EXCEEDED."""
+    grpc_mod = pytest.importorskip("grpc")
+
+    from ray_tpu import serve as serve_mod
+    from ray_tpu.serve.grpc_proxy import grpc_call
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class GBusy:
+        def __call__(self, s=0):
+            time.sleep(s)
+            return "ok"
+
+    serve.run(GBusy.bind())
+    serve.start(grpc_options={"port": 0})
+    target = f"127.0.0.1:{serve_mod.grpc_proxy_port()}"
+    assert grpc_call(target, "GBusy", "__call__", 0) == "ok"
+
+    # block through the gRPC proxy so its router owns the busy slot
+    blocker = threading.Thread(
+        target=lambda: grpc_call(target, "GBusy", "__call__", 2.5,
+                                 timeout=30))
+    blocker.start()
+    time.sleep(0.5)
+    with pytest.raises(grpc_mod.RpcError) as ei:
+        grpc_call(target, "GBusy", "__call__", 0, timeout=10)
+    assert ei.value.code() == grpc_mod.StatusCode.RESOURCE_EXHAUSTED
+    blocker.join(30)
+
+    with pytest.raises(grpc_mod.RpcError) as ei:
+        grpc_call(target, "GBusy", "__call__", 3, timeout=0.8)
+    assert ei.value.code() == grpc_mod.StatusCode.DEADLINE_EXCEEDED
+
+
+@pytest.mark.chaos
+def test_http_client_disconnect_cancels_replica_work(serve_shutdown,
+                                                     tmp_path):
+    """Satellite: a client that disconnects mid-request must not have its
+    work run to completion — the proxy cancels the replica task and the
+    cancelled counters increment."""
+    import socket
+
+    flags = str(tmp_path)
+
+    @serve.deployment
+    class Marked:
+        def __init__(self, flag_dir):
+            self._flags = flag_dir
+
+        def __call__(self, _body):
+            open(os.path.join(self._flags, "started"), "w").write("1")
+            # sleep in small slices: the injected TaskCancelledError
+            # lands at a bytecode boundary between them
+            for _ in range(80):
+                time.sleep(0.05)
+            open(os.path.join(self._flags, "done"), "w").write("1")
+            return {"ok": True}
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 18443})
+    handle = serve.run(Marked.bind(flags), route_prefix="/dc")
+
+    deadline = time.time() + 30  # proxy route warm-up (cheap GET 404 ok)
+    while True:
+        try:
+            import urllib.request
+
+            urllib.request.urlopen(
+                "http://127.0.0.1:18443/-/healthz", timeout=5)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+    body = b"{}"
+    req = (b"POST /dc HTTP/1.1\r\nHost: t\r\n"
+           b"Content-Type: application/json\r\n"
+           b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
+    s = socket.create_connection(("127.0.0.1", 18443), timeout=10)
+    s.sendall(req)
+    # wait for the replica to actually start the work
+    deadline = time.time() + 30
+    while not os.path.exists(os.path.join(flags, "started")):
+        assert time.time() < deadline, "request never reached the replica"
+        time.sleep(0.05)
+    s.close()  # client walks away mid-request
+
+    # the replica task must be cancelled: the done flag never appears
+    rep = _replicas("Marked")[0]
+    deadline = time.time() + 20
+    cancelled = 0
+    while time.time() < deadline:
+        cancelled = ray_tpu.get(rep.stats.remote(), timeout=10)["cancelled"]
+        if cancelled >= 1:
+            break
+        time.sleep(0.25)
+    assert cancelled >= 1, "replica never observed the cancellation"
+    assert not os.path.exists(os.path.join(flags, "done")), \
+        "abandoned work ran to completion"
+    # and the degradation is visible in the aggregated status
+    import urllib.request
+
+    def poke():
+        req2 = urllib.request.Request(
+            "http://127.0.0.1:18443/dc", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Timeout-S": "1"})
+        try:
+            urllib.request.urlopen(req2, timeout=5)
+        except Exception:  # noqa: BLE001 — 504 is fine, we just need traffic
+            pass
+
+    _wait_overload("Marked", "cancelled", poke=poke)
+
+
+def test_disconnect_while_queued_still_cancels(serve_shutdown, tmp_path):
+    """Regression: a client that disconnects while its request is still
+    WAITING in the router admission queue (no replica task bound yet)
+    must still have the work cancelled when a slot finally frees — the
+    bind/abandon rendezvous means the cancel lands however long admission
+    takes, instead of a give-up-after-N-seconds watcher letting the work
+    run to completion for nobody."""
+    import socket
+    import urllib.request
+
+    flags = str(tmp_path)
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=2)
+    class Tagged:
+        def __init__(self, flag_dir):
+            self._flags = flag_dir
+
+        def __call__(self, body):
+            tag = body.get("tag", "?")
+            open(os.path.join(self._flags, f"started-{tag}"), "w").write("1")
+            for _ in range(int(float(body.get("sleep", 0)) / 0.05)):
+                time.sleep(0.05)  # slices: cancel lands between them
+            open(os.path.join(self._flags, f"done-{tag}"), "w").write("1")
+            return {"ok": True}
+
+    serve.start(http_options={"host": "127.0.0.1", "port": 18445})
+    serve.run(Tagged.bind(flags), route_prefix="/q")
+
+    deadline = time.time() + 30
+    while True:  # proxy warm-up
+        try:
+            urllib.request.urlopen(
+                "http://127.0.0.1:18445/-/healthz", timeout=5)
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+    def post(payload):
+        req = urllib.request.Request(
+            "http://127.0.0.1:18445/q", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    # occupy the deployment's single slot...
+    blocker = threading.Thread(
+        target=lambda: post({"tag": "a", "sleep": 2.5}), daemon=True)
+    blocker.start()
+    deadline = time.time() + 30
+    while not os.path.exists(os.path.join(flags, "started-a")):
+        assert time.time() < deadline, "blocker never reached the replica"
+        time.sleep(0.05)
+
+    # ...then queue a second request behind it and walk away while it is
+    # still waiting for admission (no replica task exists yet)
+    body = json.dumps({"tag": "b", "sleep": 2.5}).encode()
+    raw = (b"POST /q HTTP/1.1\r\nHost: t\r\n"
+           b"Content-Type: application/json\r\n"
+           b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body)
+    s = socket.create_connection(("127.0.0.1", 18445), timeout=10)
+    s.sendall(raw)
+    time.sleep(0.7)  # let it reach the admission queue (slot still busy)
+    assert not os.path.exists(os.path.join(flags, "started-b"))
+    s.close()  # abandon while queued
+
+    blocker.join(30)  # slot frees -> b binds -> the abandon cancels it
+    rep = _replicas("Tagged")[0]
+    deadline = time.time() + 20
+    cancelled = 0
+    while time.time() < deadline:
+        cancelled = ray_tpu.get(rep.stats.remote(), timeout=10)["cancelled"]
+        if cancelled >= 1:
+            break
+        time.sleep(0.25)
+    assert cancelled >= 1, \
+        "queued-then-abandoned request was never cancelled"
+    time.sleep(0.5)  # settle: a completing task would have written by now
+    assert not os.path.exists(os.path.join(flags, "done-b")), \
+        "work for a client that left while queued ran to completion"
+
+
+@pytest.mark.chaos
+def test_stalled_replica_bound_holds_and_healthy_serve(serve_shutdown):
+    """Chaos (the acceptance scenario): one replica stalled via the
+    ``serve.replica.call`` delay fault, offered load exceeding
+    max_ongoing + max_queued.  The queue bound holds, the healthy replica
+    keeps serving, shed requests fail fast with BackPressureError, and
+    nothing hangs past its deadline."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=2,
+                      max_queued_requests=2)
+    class Tracked:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._in = 0
+            self._peak = 0
+
+        def arm_stall(self):
+            from ray_tpu.util import fault_injection as fi
+
+            fi.arm("serve.replica.call", nth=1, count=1000, exc="delay:6")
+            return True
+
+        def disarm_stall(self):
+            from ray_tpu.util import fault_injection as fi
+
+            fi.disarm("serve.replica.call")
+            return True
+
+        def __call__(self, _x):
+            with self._lock:
+                self._in += 1
+                self._peak = max(self._peak, self._in)
+            try:
+                time.sleep(0.3)
+                return "ok"
+            finally:
+                with self._lock:
+                    self._in -= 1
+
+        def peak(self):
+            return self._peak
+
+    handle = serve.run(Tracked.bind())
+    router = handle._get_router()
+    # make sure both replicas exist, then stall exactly one of them
+    reps = _replicas("Tracked")
+    assert len(reps) == 2
+    victim = reps[0]
+    assert ray_tpu.get(victim.handle_request.remote("arm_stall", (), {}),
+                       timeout=30)
+
+    outcomes = {}
+    t_start = time.time()
+
+    def call(i):
+        t0 = time.time()
+        try:
+            with serve.request_scope(timeout_s=3.0):
+                out = handle.remote(i).result(timeout=3.5)
+        except Exception as e:  # noqa: BLE001
+            out = e
+        outcomes[i] = (out, time.time() - t0)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(10)]
+    [t.start() for t in threads]
+
+    # sample the router's accounting while the storm runs
+    peak_inflight: dict = {}
+    peak_queued = 0
+    while any(t.is_alive() for t in threads) and time.time() - t_start < 20:
+        for key, n in router.inflight_snapshot().items():
+            peak_inflight[key] = max(peak_inflight.get(key, 0), n)
+        peak_queued = max(peak_queued,
+                          router.overload_stats.snapshot()["queued"])
+        time.sleep(0.01)
+    [t.join(30) for t in threads]
+
+    kinds = {"ok": [], "shed": [], "expired": [], "other": []}
+    for i, (out, elapsed) in outcomes.items():
+        if out == "ok":
+            kinds["ok"].append((i, elapsed))
+        elif isinstance(out, BackPressureError):
+            kinds["shed"].append((i, elapsed))
+        elif isinstance(out, (DeadlineExceededError, GetTimeoutError)):
+            kinds["expired"].append((i, elapsed))
+        else:
+            kinds["other"].append((i, repr(out)))
+    assert not kinds["other"], kinds["other"]
+
+    # the bound held: per-replica in-flight never exceeded max_ongoing,
+    # queue never exceeded max_queued
+    assert all(v <= 2 for v in peak_inflight.values()), peak_inflight
+    assert peak_queued <= 2, peak_queued
+    # the healthy replica kept serving
+    assert len(kinds["ok"]) >= 2, kinds
+    # with 4 slots + 2 queue positions < 10 offered, someone was shed —
+    # and the shed was FAST (fail-fast, not a hang)
+    assert kinds["shed"], kinds
+    assert all(e < 2.0 for _i, e in kinds["shed"]), kinds["shed"]
+    # NOTHING outlived its budget: every request resolved within the
+    # 3.5s result timeout + margin, despite the 6s stall
+    assert all(e < 5.0 for _i, e in
+               kinds["ok"] + kinds["shed"] + kinds["expired"]), outcomes
+    # replica-side concurrency never exceeded the configured bound
+    for rep in reps:
+        peak = ray_tpu.get(
+            rep.handle_request.remote("peak", (), {}), timeout=30)
+        assert peak <= 2, peak
+    # cleanup: disarm the stalled replica so later tests see no faults
+    ray_tpu.get(victim.handle_request.remote("disarm_stall", (), {}),
+                timeout=60)

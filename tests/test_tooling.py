@@ -662,6 +662,90 @@ def test_every_fault_injection_site_is_documented():
         f"sites missing from fault_injection module docstring: {missing}")
 
 
+def test_every_proxy_route_mints_request_context():
+    """Tooling guard: every proxy route (HTTP and gRPC) must construct a
+    request context WITH A DEADLINE before touching a deployment handle,
+    so a future route can't silently opt out of the budget machinery.
+
+    Enforced structurally: (1) any function in a proxy module that
+    dispatches through a handle (``handle.remote`` /
+    ``handle.remote_streaming``) must re-enter a request ``scope(...)``
+    around the dispatch; (2) each proxy module mints contexts only via
+    ``new_request_context`` and always passes ``timeout_s``; (3) each
+    route-handler entry point calls the mint."""
+    import ast
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def call_name(node):
+        if isinstance(node.func, ast.Name):
+            return node.func.id
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr
+        return ""
+
+    for mod in ("proxy.py", "grpc_proxy.py"):
+        path = os.path.join(repo, "ray_tpu", "serve", mod)
+        tree = ast.parse(open(path).read())
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+        # (1) every handle dispatch sits inside a request scope
+        for fn in funcs:
+            dispatches = [
+                n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("remote", "remote_streaming")
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == "handle"]
+            if not dispatches:
+                continue
+            scopes = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                      and call_name(n) == "scope"]
+            assert scopes, (
+                f"{mod}:{fn.name} dispatches to a deployment handle "
+                f"without re-entering the request scope(...)")
+
+        # (2) every mint carries a deadline (timeout_s=...)
+        mints = [n for n in ast.walk(tree) if isinstance(n, ast.Call)
+                 and call_name(n) == "new_request_context"]
+        assert mints, f"{mod} never mints a RequestContext"
+        for call in mints:
+            assert any(kw.arg == "timeout_s" for kw in call.keywords), (
+                f"{mod}:{call.lineno} new_request_context(...) without an "
+                f"explicit timeout_s deadline")
+
+        # (3) each route-handler entry point performs the mint: the
+        # aiohttp/grpc `handler` coroutines reach a mint call either
+        # directly, via the module's _mint_context helper, or through a
+        # helper function defined in the same module (the reachability
+        # walk follows local calls so refactoring handler internals into
+        # helpers doesn't defeat the guard)
+        by_name = {f.name: f for f in funcs}
+
+        def reaches_mint(fn, seen):
+            if fn.name in seen:
+                return False
+            seen.add(fn.name)
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = call_name(n)
+                if name in ("new_request_context", "_mint_context"):
+                    return True
+                callee = by_name.get(name)
+                if callee is not None and reaches_mint(callee, seen):
+                    return True
+            return False
+
+        handler_fns = [f for f in funcs if f.name == "handler"]
+        assert handler_fns, f"{mod} has no route handler function"
+        for fn in handler_fns:
+            assert reaches_mint(fn, set()), (
+                f"{mod}:{fn.name} route handler never constructs a "
+                f"request context")
+
+
 def test_every_collective_op_routes_through_supervision():
     """Tooling guard: every public collective op — the module-level API
     AND the full BaseGroup op surface — must route through the
