@@ -93,8 +93,9 @@ def main():
         ds = ds.map_batches(
             ViTInfer, compute=ActorPoolStrategy(size=1), batch_size=None,
             num_tpus=1)
+        it = ds.iterator()
         t0 = time.time()
-        out = ds.take_all()
+        out = list(it.iter_rows())
         dt = time.time() - t0
         n_imgs = args.blocks * args.batch
         # steady state: the FIRST block pays actor start + 86M-param init
@@ -119,6 +120,10 @@ def main():
             "batch_size": args.batch,
             "blocks": args.blocks,
             "wall_s": round(dt, 2),
+            # the DataIterator ingest ledger (same block the dashboard's
+            # data panel and ingest_bench.py report) — BENCH rounds get
+            # ingest throughput/overlap alongside the inference rate
+            "ingest": it.ingest_stats.to_dict(),
         }))
     finally:
         ray_tpu.shutdown()

@@ -160,6 +160,11 @@ class CoreWorker:
         # locations for sealed objects this process knows about
         self._locations: Dict[ObjectID, Dict[str, Any]] = {}
         self._fetch_waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+        # wait(fetch_local=True) resolution tasks shared across calls: a
+        # wait() that times out must leave the underlying pull running so
+        # the next wait/get finds it warm (cancelling in-flight fetches on
+        # every 50ms poll restarted cross-node pulls from scratch)
+        self._wait_fetch_tasks: Dict[ObjectID, "asyncio.Task"] = {}
 
         self.gcs = RpcClient(gcs_addr, "gcs-client")
         self.raylet = RpcClient(raylet_addr, "raylet-client")
@@ -963,15 +968,51 @@ class CoreWorker:
             return True
         return await self._resolve_payload(ref)
 
+    def _payload_fetch_task(self, ref: ObjectRef) -> "asyncio.Task":
+        """Shared, persistent resolution task for wait(fetch_local=True).
+
+        One task per object regardless of how many wait() calls observe
+        it; survives a wait timeout so the pull keeps progressing.  The
+        entry self-removes on completion — a later wait re-resolves from
+        the (now local) payload cheaply, and failures don't pin state.
+        """
+        task = self._wait_fetch_tasks.get(ref.id)
+        if task is not None and not task.done():
+            return task
+
+        async def _fetch():
+            try:
+                await self._resolve_payload(ref)
+            except BaseException:  # noqa: BLE001 — "ready" includes errored
+                pass
+
+        task = asyncio.ensure_future(_fetch())
+        self._wait_fetch_tasks[ref.id] = task
+
+        def _retire(t, oid=ref.id):
+            # identity check: a late callback must not evict a NEWER task
+            # registered after this one completed (that would let a third
+            # wait() start a duplicate pull for the same object)
+            if self._wait_fetch_tasks.get(oid) is t:
+                del self._wait_fetch_tasks[oid]
+
+        task.add_done_callback(_retire)
+        return task
+
     def wait(self, refs: List[ObjectRef], num_returns: int = 1, timeout: Optional[float] = None,
              fetch_local: bool = True):
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
-        resolver = self._resolve_payload if fetch_local else \
-            self._resolve_ready
 
         async def _wait():
-            pending = {asyncio.ensure_future(resolver(r)): r for r in refs}
+            if fetch_local:
+                # shared tasks: shield so a timed-out wait leaves the
+                # in-flight pulls running for the next wait/get
+                pending = {asyncio.shield(self._payload_fetch_task(r)): r
+                           for r in refs}
+            else:
+                pending = {asyncio.ensure_future(self._resolve_ready(r)): r
+                           for r in refs}
             ready: List[ObjectRef] = []
             deadline = None if timeout is None else self.loop.time() + timeout
             while pending and len(ready) < num_returns:
@@ -982,9 +1023,14 @@ class CoreWorker:
                 if not done:
                     break
                 for d in done:
+                    if not d.cancelled():
+                        # errored objects count as ready (reference);
+                        # retrieve the exception so asyncio never logs
+                        # "exception was never retrieved" for them
+                        d.exception()
                     ready.append(pending.pop(d))
             for p in pending:
-                p.cancel()
+                p.cancel()  # cancels the shield, not the shared fetch
             not_ready = [r for r in refs if r not in ready]
             return ready, not_ready
 

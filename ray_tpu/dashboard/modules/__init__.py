@@ -9,6 +9,7 @@ the shared ``jresp`` JSON responder so modules stay framework-thin.
 from ray_tpu.dashboard.modules import (  # noqa: F401
     cluster,
     collective,
+    data,
     entities,
     logs,
     metrics,
@@ -18,4 +19,4 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
 )
 
 ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train,
-               collective)
+               collective, data)

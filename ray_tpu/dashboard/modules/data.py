@@ -1,0 +1,40 @@
+"""Data module: ingest-pipeline view.
+
+Reference: ``dashboard/modules/data``.  Each DataIterator publishes its
+:class:`~ray_tpu.data.iterator.IngestStats` snapshot (block-wait, batch
+formation, H2D, consumer-blocked time, locality hit/miss, cross-node
+bytes) into the GCS KV under namespace "data" (key ``iter/<id>``) while
+it runs; the head lists all iterators with plain table reads.  Records
+older than ``_STALE_S`` are dropped from the listing — an iterator that
+died without a final publish must not haunt the panel forever.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+_STALE_S = 600.0
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_data(_req):
+        iterators = []
+        now = time.time()
+        for (ns, key), raw in list(gcs.kv.items()):
+            if ns != "data" or not key.startswith("iter/"):
+                continue
+            try:
+                rec = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            if now - rec.get("ts", now) > _STALE_S:
+                continue
+            rec.setdefault("iterator", key[len("iter/"):])
+            iterators.append(rec)
+        iterators.sort(key=lambda r: r.get("ts", 0.0), reverse=True)
+        return jresp({"iterators": iterators})
+
+    return [("GET", "/api/data", api_data)]

@@ -61,12 +61,13 @@ function spark(points, w=220, h=36) {
 }
 
 async function renderOverview(root) {
-  const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train, coll] =
+  const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train, coll,
+         data] =
     await Promise.all([
       j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
       j("/api/placement_groups"), j("/api/submitted_jobs"),
       j("/api/tasks/summary"), j("/api/serve"), j("/api/train"),
-      j("/api/collective")]);
+      j("/api/collective"), j("/api/data")]);
   const taskRows = Object.entries(tasks).map(([name, s]) =>
     ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   const depRows = Object.entries(serve.deployments || {}).map(
@@ -83,6 +84,18 @@ async function renderOverview(root) {
     name: r.name, status: r.status, world: r.world_size,
     iteration: r.iteration, restarts: r.restarts,
     metrics: r.latest_metrics}));
+  const dataRows = (data.iterators || []).map(r => ({
+    iterator: r.iterator, state: r.done ? "done" : "running",
+    blocks: r.blocks, batches: r.batches,
+    "MB": (r.bytes_fetched / 1048576).toFixed(1),
+    "xnode MB": (r.bytes_cross_node / 1048576).toFixed(1),
+    "fetch s": Number(r.block_fetch_total_s).toFixed(2),
+    "blocked s": Number(r.consumer_blocked_s).toFixed(2),
+    "h2d s": Number(r.h2d_s).toFixed(2),
+    locality: (r.locality_hits || r.locality_misses)
+      ? `${r.locality_hits}/${r.locality_hits + r.locality_misses}` : "",
+    "dev buf": r.device_buffer_capacity
+      ? `${r.device_prefetch_depth}/${r.device_buffer_capacity}` : ""}));
   const collRows = (coll.groups || []).map(g => ({
     group: g.group_name, state: g.state, backend: g.backend,
     epoch: g.epoch, members: `${g.joined}/${g.world_size}`,
@@ -102,6 +115,9 @@ async function renderOverview(root) {
       : "<i>serve not running</i>") +
     "<h2>Train runs</h2>" + table(trainRows,
       ["name","status","world","iteration","restarts","metrics"]) +
+    "<h2>Data ingest</h2>" + table(dataRows,
+      ["iterator","state","blocks","batches","MB","xnode MB","fetch s",
+       "blocked s","h2d s","locality","dev buf"]) +
     "<h2>Collective groups</h2>" + table(collRows,
       ["group","state","backend","epoch","members","progress","abort"]) +
     "<h2>Actors</h2>" + table(actors, ["actor_id","class_name","state","name","node_id"],

@@ -27,6 +27,17 @@ Batch = Union[Dict[str, np.ndarray], "pa.Table", Any]
 TENSOR_COL_MARKER = b"__ray_tpu_tensor_shape__"
 
 
+def _local_node_id() -> Optional[str]:
+    """Node id of the current process, or None outside a cluster."""
+    try:
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker(required=False)
+        return w.node_id if w is not None else None
+    except Exception:  # noqa: BLE001 — metadata stays best-effort
+        return None
+
+
 @dataclass
 class BlockMetadata:
     """Out-of-band stats for one block (reference ``block.py:BlockMetadata``)."""
@@ -36,6 +47,10 @@ class BlockMetadata:
     schema: Optional[pa.Schema] = None
     input_files: List[str] = field(default_factory=list)
     exec_stats: Optional[Dict[str, float]] = None
+    # Node that produced (and therefore holds, in its shm store) this
+    # block — lets the streaming_split coordinator route bundles to their
+    # co-located consumer without a location RPC per bundle.
+    exec_node_id: Optional[str] = None
 
     @staticmethod
     def for_block(block: pa.Table, input_files: Optional[List[str]] = None,
@@ -49,6 +64,7 @@ class BlockMetadata:
             schema=block.schema,
             input_files=list(input_files or []),
             exec_stats=stats,
+            exec_node_id=_local_node_id(),
         )
 
 

@@ -55,6 +55,21 @@ class DataContext:
     # iter_batches defaults
     default_batch_format: str = "numpy"
     prefetch_batches: int = 2
+    # -- ingest pipeline (DataIterator) ---------------------------------------
+    # Block-prefetch lookahead: the iterator keeps a sliding window of
+    # upcoming block refs resolving concurrently (wait(fetch_local=True)
+    # semantics) so remote pulls + deserialization of blocks k+1..k+N
+    # overlap batching of block k.  Sized in bytes (reference:
+    # iter_batches prefetch is byte-budgeted), with a block-count cap so
+    # many tiny blocks can't run away; 0 bytes disables the lookahead
+    # (forced-serial: one blocking get per block — bench baseline only).
+    iterator_lookahead_bytes: int = 64 * 1024 * 1024
+    iterator_lookahead_max_blocks: int = 16
+    # Locality-aware streaming_split: prefer routing a bundle to the
+    # consumer co-located with the node that produced its blocks, unless
+    # that consumer is already ahead of the least-loaded one by more than
+    # this many rows (bounded skew, the reference's ``equal=`` handling).
+    locality_split_max_skew_rows: int = 8192
 
     _current: "DataContext" = None  # class-level singleton
     _lock = threading.Lock()

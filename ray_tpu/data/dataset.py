@@ -288,6 +288,8 @@ class Dataset:
             for ref, meta in bundle.blocks:
                 if meta.schema is not None and len(meta.schema.names):
                     return meta.schema
+                # one block of a limit(1) probe, returns immediately —
+                # allowed-blocking-get: not a per-block iteration stall
                 block = ray_tpu.get(ref)
                 return block.schema
         return None
@@ -382,6 +384,7 @@ class Dataset:
                 if take == meta.num_rows and off == 0:
                     cur.append((ref, meta))
                 else:
+                    # allowed-blocking-get: boundary-block slice metadata
                     refs, metas = ray_tpu.get(
                         T.slice_block.remote(ref, off, off + take))
                     cur.append((refs[0], metas[0]))
@@ -393,7 +396,8 @@ class Dataset:
         return out
 
     def streaming_split(self, n: int, *, equal: bool = False,
-                        locality_hints=None) -> List[DataIterator]:
+                        locality_hints: Optional[List[Optional[str]]] = None
+                        ) -> List[DataIterator]:
         """n single-pass iterators consuming a shared streaming execution
         (reference: ``Dataset.streaming_split`` feeding Train workers).
 
@@ -402,16 +406,37 @@ class Dataset:
         runs inside the actor, each rank's iterator pulls RefBundles from
         it — so the iterators are picklable and can be shipped to train
         workers in other processes.
+
+        ``locality_hints`` — one node id per output index (the node each
+        consuming rank runs on): bundles route to the consumer co-located
+        with the node that produced their blocks (bounded skew, see
+        ``DataContext.locality_split_max_skew_rows``), turning most
+        cross-node block pulls into local shm reads.
         """
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError(
+                f"locality_hints must have one entry per split ({n}), "
+                f"got {len(locality_hints)}")
+        # the skew budget is captured HERE, in the driver: DataContext is
+        # process-local and the splitter runs inside the coordinator actor
+        max_skew = DataContext.get_current().locality_split_max_skew_rows
         coord = _SplitCoordinator.options(
-            max_concurrency=n + 1).remote(self, n, equal)
+            max_concurrency=n + 1).remote(self, n, equal, locality_hints,
+                                          max_skew)
 
         def make_source(rank: int):
             def source():
+                # pipelined coordinator protocol: keep one next_bundle
+                # request in flight ahead of consumption, so the
+                # coordinator prepares bundle k+1 (and its blocks start
+                # pulling) while rank batches bundle k
+                pending = coord.next_bundle.remote(rank)
                 while True:
-                    bundle = ray_tpu.get(coord.next_bundle.remote(rank))
+                    # allowed-blocking-get: issued one iteration ahead
+                    bundle = ray_tpu.get(pending)
                     if bundle is None:
                         break
+                    pending = coord.next_bundle.remote(rank)
                     yield bundle
 
             return source
@@ -471,14 +496,23 @@ class _SplitCoordinator:
     must not accumulate coordinator processes.
     """
 
-    def __init__(self, ds: "Dataset", n: int, equal: bool):
+    def __init__(self, ds: "Dataset", n: int, equal: bool,
+                 locality_hints: Optional[List[Optional[str]]] = None,
+                 locality_max_skew_rows: Optional[int] = None):
         import threading
 
         optimized = L.optimize(ds._plan)
         sink = plan_physical(optimized.dag)
-        self._queues = execute_streaming_split(sink, n, equal)
+        self._queues, self._splitter = execute_streaming_split(
+            sink, n, equal, locality_hints=locality_hints,
+            locality_max_skew_rows=locality_max_skew_rows)
         self._done = [False] * n
         self._lock = threading.Lock()
+
+    def split_stats(self):
+        """Locality routing counters from the OutputSplitter (hits/misses
+        + per-output row balance) — folded into DataIterator.stats()."""
+        return self._splitter.split_stats()
 
     def next_bundle(self, rank: int):
         item = self._queues[rank].get()

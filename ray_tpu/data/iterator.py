@@ -1,22 +1,36 @@
-"""DataIterator: batch iteration with prefetch and TPU HBM staging.
+"""DataIterator: pipelined batch iteration with prefetch and TPU HBM staging.
 
 Reference: ``python/ray/data/iterator.py`` (``iter_batches :109`` with
 ``prefetch_batches``, ``iter_torch_batches``) and
 ``air/_internal/torch_utils.py`` device transfer.  TPU-first differences:
 
+* **Block-prefetch lookahead**: instead of one blocking ``get`` per block,
+  a sliding window of upcoming block refs (byte-budgeted, see
+  ``DataContext.iterator_lookahead_bytes``) resolves concurrently via
+  ``wait(fetch_local=True)``-driven persistent fetch tasks, so remote
+  pulls + deserialization of blocks k+1..k+N overlap batching of block k.
 * ``iter_jax_batches`` stages host batches into device HBM with
-  ``jax.device_put`` on a prefetch thread, overlapping transfer with step
-  compute — the jax equivalent of the reference's
-  ``.to(device, non_blocking=True)`` path (``torch_utils.py:454-465``).
+  ``jax.device_put`` on a dedicated transfer thread behind a depth-N
+  device-side buffer, overlapping H2D of batch i+1 with step compute on
+  batch i — the jax equivalent of the reference's
+  ``.to(device, non_blocking=True)`` path (``torch_utils.py:454-465``),
+  with per-key staging buffers reused across batches.
 * With a ``sharding=NamedSharding(mesh, spec)``, batches are placed as
   global sharded arrays (one host feeding its addressable shards), which is
   how the JaxTrainer consumes a ``streaming_split`` shard per worker.
+* Every iterator keeps an :class:`IngestStats` ledger (block-wait,
+  batch-format, H2D, consumer-blocked time; locality + cross-node bytes)
+  surfaced by :meth:`DataIterator.stats`, ``util.metrics`` gauges, and
+  the dashboard's data panel.
 """
 
 from __future__ import annotations
 
+import itertools
+import json
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -26,6 +40,258 @@ from ray_tpu.data.block import BlockAccessor, concat_blocks
 from ray_tpu.data.context import DataContext
 
 _SENTINEL = object()
+
+_iter_ids = itertools.count()
+
+
+class IngestStats:
+    """Per-iterator ingest-pipeline timings and locality counters.
+
+    Updated from both pipeline threads and the consumer; all mutation
+    goes through :meth:`add` / the typed helpers under one lock.  The
+    overlap proof for the bench: with the pipeline on,
+    ``consumer_blocked_s`` (time the consumer actually stalled) drops
+    strictly below ``block_fetch_total_s`` (source wait + payload fetch
+    work, wherever it ran) — serially they are the same number.
+    """
+
+    def __init__(self, iterator_id: Optional[str] = None):
+        import os
+
+        self.iterator_id = iterator_id or \
+            f"it-{os.getpid()}-{next(_iter_ids)}"
+        self._lock = threading.Lock()
+        self._t_start = time.perf_counter()
+        self._last_publish = 0.0
+        self._published = False
+        self._fields: Dict[str, float] = {
+            "source_wait_s": 0.0,      # waiting on the bundle source
+            "block_fetch_s": 0.0,      # waiting for block payloads (get)
+            "batch_format_s": 0.0,     # slicing/concat/format conversion
+            "h2d_s": 0.0,              # jax.device_put staging
+            "consumer_blocked_s": 0.0,  # consumer stalled on the pipeline
+            "blocks": 0,
+            "batches": 0,
+            "bytes_fetched": 0,
+            "bytes_cross_node": 0,     # payloads pulled from another node
+            "locality_hits": 0,
+            "locality_misses": 0,
+            "device_batches_in_flight": 0,
+            "device_prefetch_depth": 0,   # high-water mark
+            "device_buffer_capacity": 0,
+        }
+
+    def __getstate__(self):
+        # iterators ship to train workers (streaming_split shards) —
+        # carry the counters, re-create the lock on the far side
+        with self._lock:
+            state = dict(self.__dict__)
+            state["_fields"] = dict(self._fields)
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+        # perf_counter origins don't travel between processes: wall time
+        # and the publish throttle restart on the consuming side
+        self._t_start = time.perf_counter()
+        self._last_publish = 0.0
+
+    def add(self, field: str, value: float) -> None:
+        with self._lock:
+            self._fields[field] += value
+
+    def set_max(self, field: str, value: float) -> None:
+        with self._lock:
+            if value > self._fields[field]:
+                self._fields[field] = value
+
+    def set(self, field: str, value: float) -> None:
+        with self._lock:
+            self._fields[field] = value
+
+    def on_block(self, meta, *, source_wait_s: float = 0.0,
+                 fetch_s: float = 0.0, ref=None) -> None:
+        with self._lock:
+            self._fields["blocks"] += 1
+            self._fields["source_wait_s"] += source_wait_s
+            self._fields["block_fetch_s"] += fetch_s
+            self._fields["bytes_fetched"] += meta.size_bytes
+        if ref is not None:
+            self._note_cross_node(ref, meta.size_bytes)
+
+    def _note_cross_node(self, ref, size_bytes: int) -> None:
+        """After a get, charge the block to cross-node pull bytes when its
+        sealed location is another node's store (no RPC: local table)."""
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker(required=False)
+            if w is None:
+                return
+            loc = w._locations.get(ref.id)
+            node = None if loc is None or loc.get("inline") else \
+                loc.get("node")
+            if node is not None and node != w.node_id:
+                with self._lock:
+                    self._fields["bytes_cross_node"] += size_bytes
+        except Exception:  # noqa: BLE001 — accounting stays best-effort
+            pass
+
+    def merge_split_stats(self, split: Dict[str, Any]) -> None:
+        # the coordinator's counters are cumulative totals: replace, so
+        # repeated stats()/publish calls don't multiply them
+        with self._lock:
+            self._fields["locality_hits"] = split.get("locality_hits", 0)
+            self._fields["locality_misses"] = split.get(
+                "locality_misses", 0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._fields)
+        out["wall_s"] = time.perf_counter() - self._t_start
+        out["block_fetch_total_s"] = (
+            out["source_wait_s"] + out["block_fetch_s"])
+        out["iterator"] = self.iterator_id
+        return out
+
+    def report(self) -> str:
+        d = self.to_dict()
+        lines = [
+            f"Ingest pipeline stats [{d['iterator']}]",
+            f"  blocks: {d['blocks']}  batches: {d['batches']}  "
+            f"bytes: {d['bytes_fetched']}  "
+            f"cross-node bytes: {d['bytes_cross_node']}",
+            f"  source wait: {d['source_wait_s']:.3f}s  "
+            f"block fetch: {d['block_fetch_s']:.3f}s  "
+            f"(total fetch: {d['block_fetch_total_s']:.3f}s)",
+            f"  batch format: {d['batch_format_s']:.3f}s  "
+            f"h2d: {d['h2d_s']:.3f}s",
+            f"  consumer blocked: {d['consumer_blocked_s']:.3f}s  "
+            f"of wall {d['wall_s']:.3f}s",
+        ]
+        if d["locality_hits"] or d["locality_misses"]:
+            total = d["locality_hits"] + d["locality_misses"]
+            lines.append(
+                f"  split locality: {d['locality_hits']}/{total} bundles "
+                f"co-located")
+        if d["device_buffer_capacity"]:
+            lines.append(
+                f"  device buffer: depth {d['device_prefetch_depth']}"
+                f"/{d['device_buffer_capacity']} "
+                f"(in flight now: {d['device_batches_in_flight']})")
+        return "\n".join(lines)
+
+    # -- surfacing ------------------------------------------------------------
+
+    def maybe_publish(self, final: bool = False,
+                      enrich: Optional[Callable[[], None]] = None) -> None:
+        """Throttled export to util.metrics gauges + the GCS KV (namespace
+        "data") feeding the dashboard's data panel.  Short-lived iterators
+        (unit tests) that never crossed the throttle stay silent.
+        ``enrich`` runs after the throttle passes, before the snapshot —
+        the DataIterator uses it to fold in the split coordinator's
+        locality counters without paying the RPC on every batch."""
+        now = time.perf_counter()
+        if not final and now - self._last_publish < 2.0:
+            return
+        if final and not self._published and now - self._t_start < 1.0:
+            return
+        self._last_publish = now
+        self._published = True
+        if enrich is not None:
+            try:
+                enrich()
+            except Exception:  # noqa: BLE001 — telemetry must not fail us
+                pass
+        d = self.to_dict()
+        try:
+            if final:
+                # the KV record carries the final numbers for the panel;
+                # the per-iterator gauge series retires with the iterator
+                # so a long-lived process doesn't accumulate label sets
+                self._retire_metrics()
+            else:
+                self._publish_metrics(d)
+            self._publish_kv(d, final)
+        except Exception:  # noqa: BLE001 — never fail iteration on telemetry
+            pass
+
+    def _publish_metrics(self, d: Dict[str, Any]) -> None:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            return
+        tags = {"iterator": d["iterator"]}
+        for name, field in (
+                ("data_ingest_block_wait_s", "block_fetch_total_s"),
+                ("data_ingest_batch_format_s", "batch_format_s"),
+                ("data_ingest_h2d_s", "h2d_s"),
+                ("data_ingest_consumer_blocked_s", "consumer_blocked_s"),
+                ("data_ingest_bytes_cross_node", "bytes_cross_node"),
+                ("data_ingest_locality_hits", "locality_hits"),
+                ("data_ingest_locality_misses", "locality_misses")):
+            _gauge(name).set(float(d[field]), tags=tags)
+
+    def _retire_metrics(self) -> None:
+        tags = {"iterator": self.iterator_id}
+        for name in ("data_ingest_block_wait_s", "data_ingest_batch_format_s",
+                     "data_ingest_h2d_s", "data_ingest_consumer_blocked_s",
+                     "data_ingest_bytes_cross_node",
+                     "data_ingest_locality_hits",
+                     "data_ingest_locality_misses"):
+            g = _gauges.get(name)
+            if g is not None:
+                g.remove(tags=tags)
+
+    _KV_STALE_S = 600.0  # matches the dashboard data panel's cutoff
+
+    def _publish_kv(self, d: Dict[str, Any], final: bool) -> None:
+        import ray_tpu
+        from ray_tpu.experimental.internal_kv import _internal_kv_put
+
+        if not ray_tpu.is_initialized():
+            return
+        d["ts"] = time.time()
+        d["done"] = final
+        _internal_kv_put(f"iter/{d['iterator']}".encode(),
+                         json.dumps(d).encode(), namespace="data")
+        if final:
+            self._sweep_stale_kv(d["ts"])
+
+    def _sweep_stale_kv(self, now: float) -> None:
+        """Each finishing iterator sweeps records past the panel's stale
+        window (including ones from iterators that died without a final
+        publish), so the "data" namespace stays bounded on a long-running
+        cluster instead of accumulating one record per iterator forever."""
+        from ray_tpu.experimental.internal_kv import (_internal_kv_del,
+                                                      _internal_kv_get_prefix)
+
+        for key, raw in _internal_kv_get_prefix("iter/",
+                                                namespace="data").items():
+            try:
+                ts = json.loads(raw).get("ts", 0.0)
+            except (ValueError, TypeError):
+                ts = 0.0
+            if now - ts > self._KV_STALE_S:
+                _internal_kv_del(key.encode(), namespace="data")
+
+
+_gauges: Dict[str, Any] = {}
+_gauges_lock = threading.Lock()
+
+
+def _gauge(name: str):
+    with _gauges_lock:
+        g = _gauges.get(name)
+        if g is None:
+            from ray_tpu.util.metrics import Gauge
+
+            g = _gauges[name] = Gauge(
+                name, description=f"ingest pipeline: {name}",
+                tag_keys=("iterator",))
+        return g
 
 
 class _Batcher:
@@ -68,29 +334,176 @@ class _Batcher:
 
 class _ShuffleBuffer:
     """Local shuffle buffer applied upstream of batching
-    (reference: ``iter_batches(local_shuffle_buffer_size=...)``)."""
+    (reference: ``iter_batches(local_shuffle_buffer_size=...)``).
 
-    def __init__(self, min_rows: int, seed: Optional[int]):
+    Samples ``chunk`` rows out whenever the buffer holds at least
+    ``min_rows + chunk`` rows — keeping it topped up to ``min_rows`` like
+    the reference's shuffling batcher — instead of draining everything at
+    the threshold (which weakened the shuffle to permuted windows and
+    paid a full concat+permute latency spike every cycle).
+    """
+
+    def __init__(self, min_rows: int, seed: Optional[int],
+                 chunk_rows: Optional[int] = None):
         self._min = min_rows
+        self._chunk = max(1, chunk_rows or max(1, min_rows // 8))
         self._rng = np.random.default_rng(seed)
-        self._buf: List[pa.Table] = []
+        self._pending: List[pa.Table] = []
+        # already-permuted rows, consumed by zero-copy slices from _cursor
+        self._permuted: Optional[pa.Table] = None
+        self._cursor = 0
         self._rows = 0
 
     def add(self, block: pa.Table) -> Iterator[pa.Table]:
-        self._buf.append(block)
-        self._rows += block.num_rows
-        if self._rows >= self._min:
-            yield self._drain()
+        if block.num_rows:
+            self._pending.append(block)
+            self._rows += block.num_rows
+        while self._rows >= self._min + self._chunk:
+            yield self._sample(self._chunk)
 
     def flush(self) -> Iterator[pa.Table]:
-        if self._buf:
-            yield self._drain()
+        while self._rows:
+            yield self._sample(min(self._chunk, self._rows))
 
-    def _drain(self) -> pa.Table:
-        merged = concat_blocks(self._buf)
-        self._buf, self._rows = [], 0
-        return BlockAccessor(merged).take_rows(
-            self._rng.permutation(merged.num_rows))
+    def _sample(self, k: int) -> pa.Table:
+        # amortized O(1) per row: the buffer is materialized in permuted
+        # order once per refill; each chunk is then a zero-copy slice —
+        # not a full concat+permute per chunk
+        avail = 0 if self._permuted is None \
+            else self._permuted.num_rows - self._cursor
+        if avail < k:
+            parts = list(self._pending)
+            if avail:
+                parts.insert(0, BlockAccessor(self._permuted).slice(
+                    self._cursor, self._permuted.num_rows))
+            self._pending = []
+            merged = concat_blocks(parts)
+            acc = BlockAccessor(merged)
+            self._permuted = acc.take_rows(
+                self._rng.permutation(merged.num_rows))
+            self._cursor = 0
+        out = BlockAccessor(self._permuted).slice(self._cursor,
+                                                  self._cursor + k)
+        self._cursor += k
+        self._rows -= k
+        return out
+
+
+class _BlockPrefetcher:
+    """Sliding-window concurrent block fetch (the lookahead stage).
+
+    A source thread walks the bundle stream, admits upcoming block refs
+    into a byte-budgeted window, and kicks each payload pull via
+    ``wait(fetch_local=True, timeout=0)`` — persistent fetch tasks (see
+    ``CoreWorker._payload_fetch_task``) keep resolving in the background
+    — so remote pulls and deserialization of blocks k+1..k+N proceed
+    while block k is being batched.  Blocks surface strictly in stream
+    order; a source error surfaces at its position; closing the returned
+    generator stops the thread promptly and drops the window's refs.
+    """
+
+    def __init__(self, source: Callable[[], Iterator], stats: IngestStats,
+                 window_bytes: int, max_blocks: int,
+                 count_blocked: bool = True):
+        self._source = source
+        self._stats = stats
+        # whether this stage faces the end consumer directly (no
+        # downstream _prefetch buffer): only then do its waits count as
+        # consumer-blocked time — otherwise stalls would double-count
+        # across stages and overstate the blocked total
+        self._count_blocked = count_blocked
+        self._window_bytes = max(1, window_bytes)
+        self._max_blocks = max(2, max_blocks)
+        # unbounded: admission is gated by the byte window below, and an
+        # unbounded queue means the producer can always make progress to
+        # its stop-check
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._admit = threading.Condition()
+        self._inflight_bytes = 0
+        self._inflight_blocks = 0
+
+    def _room(self) -> bool:
+        # always keep >= 2 admitted (the head + one ahead), otherwise
+        # honor the byte budget and the block cap
+        return (self._inflight_blocks < 2
+                or (self._inflight_bytes < self._window_bytes
+                    and self._inflight_blocks < self._max_blocks))
+
+    def _run(self):
+        import ray_tpu
+
+        src = self._source()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                bundle = next(src, _SENTINEL)
+                self._stats.add("source_wait_s",
+                                time.perf_counter() - t0)
+                if bundle is _SENTINEL or self._stop.is_set():
+                    return
+                for ref, meta in bundle.blocks:
+                    with self._admit:
+                        while not self._room() and not self._stop.is_set():
+                            self._admit.wait(0.05)
+                        if self._stop.is_set():
+                            return
+                        self._inflight_bytes += meta.size_bytes
+                        self._inflight_blocks += 1
+                    try:
+                        # start the pull; returns immediately, the fetch
+                        # task persists past this call
+                        ray_tpu.wait([ref], num_returns=1, timeout=0,
+                                     fetch_local=True)
+                    except Exception:  # noqa: BLE001
+                        pass  # the ordered get below surfaces real errors
+                    self._q.put((ref, meta))
+        except BaseException as e:  # noqa: BLE001 — in-order propagation
+            self._q.put(e)
+        finally:
+            try:
+                close = getattr(src, "close", None)
+                if close is not None:
+                    close()  # this thread owns src: safe, runs finallys
+            except BaseException:  # noqa: BLE001
+                pass
+            self._q.put(_SENTINEL)
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        import ray_tpu
+
+        threading.Thread(target=self._run, daemon=True,
+                         name="rtpu-data-lookahead").start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = self._q.get()
+                if self._count_blocked:
+                    self._stats.add("consumer_blocked_s",
+                                    time.perf_counter() - t0)
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                ref, meta = item
+                t1 = time.perf_counter()
+                # ordered surface of a window-prefetched payload: the pull
+                # started at admission, so this get is (usually) a local
+                # lookup, not a serial cross-node fetch
+                block = ray_tpu.get(ref)  # allowed-blocking-get: prefetched
+                fetch_s = time.perf_counter() - t1
+                if self._count_blocked:
+                    self._stats.add("consumer_blocked_s", fetch_s)
+                self._stats.on_block(meta, fetch_s=fetch_s, ref=ref)
+                with self._admit:
+                    self._inflight_bytes -= meta.size_bytes
+                    self._inflight_blocks -= 1
+                    self._admit.notify_all()
+                yield block
+        finally:
+            self._stop.set()
+            with self._admit:
+                self._admit.notify_all()
 
 
 class DataIterator:
@@ -99,13 +512,77 @@ class DataIterator:
     def __init__(self, bundle_source: Callable[[], Iterator], owner=None):
         self._source = bundle_source
         self._owner = owner  # keeps Dataset (and its executor) alive
+        self._stats = IngestStats()
+        # lookahead knobs snapshot at CREATION time, in the creating
+        # process: DataContext is process-local, and split iterators ship
+        # to train workers — driver-side tuning must travel with them
+        ctx = DataContext.get_current()
+        self._lookahead_bytes = ctx.iterator_lookahead_bytes
+        self._lookahead_max_blocks = ctx.iterator_lookahead_max_blocks
 
-    def _iter_blocks(self) -> Iterator[pa.Table]:
+    @property
+    def ingest_stats(self) -> IngestStats:
+        return self._stats
+
+    def stats(self) -> str:
+        """Human-readable ingest pipeline report (block-wait, batch
+        formation, H2D, consumer-blocked time, locality hit rate)."""
+        self._merge_owner_split_stats()
+        return self._stats.report()
+
+    def _merge_owner_split_stats(self, timeout: float = 5.0) -> None:
+        """Fold the split coordinator's locality counters (if this
+        iterator came from ``streaming_split``) into the report."""
+        split_stats = getattr(self._owner, "split_stats", None)
+        if split_stats is None:
+            return
+        try:
+            import ray_tpu
+
+            self._stats.merge_split_stats(
+                ray_tpu.get(split_stats.remote(), timeout=timeout))
+        except Exception:  # noqa: BLE001 — coordinator may already be gone
+            pass
+
+    def _enrich_publish(self) -> None:
+        # periodic-publish path: keep the coordinator RPC short so a
+        # slow/dead coordinator can't stall the pipeline thread
+        self._merge_owner_split_stats(timeout=2.0)
+
+    def _iter_blocks(self, count_blocked: bool = True) -> Iterator[pa.Table]:
+        if self._lookahead_bytes and self._lookahead_bytes > 0:
+            return iter(_BlockPrefetcher(
+                self._source, self._stats,
+                self._lookahead_bytes,
+                self._lookahead_max_blocks,
+                count_blocked=count_blocked))
+        return self._iter_blocks_serial(count_blocked=count_blocked)
+
+    def _iter_blocks_serial(self, count_blocked: bool = True
+                            ) -> Iterator[pa.Table]:
+        """Forced-serial baseline (lookahead disabled): one blocking get
+        per block — kept for A/B benching only; the pipelined path above
+        is the default."""
         import ray_tpu
 
-        for bundle in self._source():
-            for ref, _meta in bundle.blocks:
-                yield ray_tpu.get(ref)
+        src = self._source()
+        while True:
+            t0 = time.perf_counter()
+            bundle = next(src, _SENTINEL)
+            dt = time.perf_counter() - t0
+            self._stats.add("source_wait_s", dt)
+            if count_blocked:
+                self._stats.add("consumer_blocked_s", dt)
+            if bundle is _SENTINEL:
+                return
+            for ref, meta in bundle.blocks:
+                t1 = time.perf_counter()
+                block = ray_tpu.get(ref)  # allowed-blocking-get: A/B baseline
+                fetch_s = time.perf_counter() - t1
+                if count_blocked:
+                    self._stats.add("consumer_blocked_s", fetch_s)
+                self._stats.on_block(meta, fetch_s=fetch_s, ref=ref)
+                yield block
 
     def iter_batches(
         self,
@@ -116,30 +593,59 @@ class DataIterator:
         local_shuffle_buffer_size: Optional[int] = None,
         local_shuffle_seed: Optional[int] = None,
         prefetch_batches: Optional[int] = None,
+        _count_blocked: Optional[bool] = None,
     ) -> Iterator[Any]:
         ctx = DataContext.get_current()
         batch_format = batch_format or ctx.default_batch_format
         if prefetch_batches is None:
             prefetch_batches = ctx.prefetch_batches
+        stats = self._stats
+        # consumer-blocked time is only charged at the outermost
+        # consumer-facing stage (the _prefetch buffer when present, else
+        # the block stage) — inner stages stalling would double-count
+        outermost = not prefetch_batches or prefetch_batches <= 0
+        if _count_blocked is not None:
+            outermost = _count_blocked and outermost
 
         def producer() -> Iterator[Any]:
             batcher = _Batcher(batch_size, batch_format)
             shuffler = (_ShuffleBuffer(local_shuffle_buffer_size,
-                                       local_shuffle_seed)
+                                       local_shuffle_seed,
+                                       chunk_rows=batch_size)
                         if local_shuffle_buffer_size else None)
-            for block in self._iter_blocks():
+
+            def form(block) -> List[Any]:
+                t0 = time.perf_counter()
                 if shuffler is not None:
-                    for shuffled in shuffler.add(block):
-                        yield from batcher.add(shuffled)
+                    out = [b for shuffled in shuffler.add(block)
+                           for b in batcher.add(shuffled)]
                 else:
-                    yield from batcher.add(block)
-            if shuffler is not None:
-                for shuffled in shuffler.flush():
-                    yield from batcher.add(shuffled)
-            yield from batcher.flush(drop_last)
+                    out = list(batcher.add(block))
+                stats.add("batch_format_s", time.perf_counter() - t0)
+                return out
+
+            try:
+                for block in self._iter_blocks(count_blocked=outermost):
+                    for b in form(block):
+                        stats.add("batches", 1)
+                        yield b
+                        stats.maybe_publish(enrich=self._enrich_publish)
+                t0 = time.perf_counter()
+                tail: List[Any] = []
+                if shuffler is not None:
+                    for shuffled in shuffler.flush():
+                        tail.extend(batcher.add(shuffled))
+                tail.extend(batcher.flush(drop_last))
+                stats.add("batch_format_s", time.perf_counter() - t0)
+                for b in tail:
+                    stats.add("batches", 1)
+                    yield b
+            finally:
+                stats.maybe_publish(final=True,
+                                    enrich=self._enrich_publish)
 
         if prefetch_batches and prefetch_batches > 0:
-            return _prefetch(producer(), prefetch_batches)
+            return _prefetch(producer(), prefetch_batches, stats=stats)
         return producer()
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
@@ -159,30 +665,37 @@ class DataIterator:
         local_shuffle_seed: Optional[int] = None,
         prefetch_batches: Optional[int] = None,
     ) -> Iterator[Dict[str, Any]]:
-        """Yield batches as jax arrays already staged in device HBM."""
-        import jax
+        """Yield batches as jax arrays already staged in device HBM.
 
-        def to_device(batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
-            out = {}
-            for k, v in batch.items():
-                if dtypes and k in dtypes:
-                    # copy=False: blocks deserialize as zero-copy views
-                    # over the 64B-aligned shm arena; a matching dtype
-                    # must DMA straight from that mapping, not via a
-                    # silent astype copy
-                    v = v.astype(dtypes[k], copy=False)
-                out[k] = jax.device_put(v, sharding) if sharding is not None \
-                    else jax.device_put(v)
-            return out
+        Two pipeline stages behind the consumer: host batch formation on
+        one thread, ``jax.device_put`` on another feeding a
+        depth-``prefetch_batches`` device-side buffer — H2D of batch i+1
+        overlaps consumer compute on batch i even when batch formation
+        is the slow stage.
+        """
+        n_prefetch = (DataContext.get_current().prefetch_batches
+                      if prefetch_batches is None else prefetch_batches)
+        n_prefetch = max(1, n_prefetch)
+        stats = self._stats
+        stats.set("device_buffer_capacity", n_prefetch)
 
         host_iter = self.iter_batches(
             batch_size=batch_size, batch_format="numpy", drop_last=drop_last,
             local_shuffle_buffer_size=local_shuffle_buffer_size,
-            local_shuffle_seed=local_shuffle_seed, prefetch_batches=0)
-        # device_put on the prefetch thread overlaps H2D with consumer compute
-        n_prefetch = (DataContext.get_current().prefetch_batches
-                      if prefetch_batches is None else prefetch_batches)
-        return _prefetch(map(to_device, host_iter), max(1, n_prefetch))
+            local_shuffle_seed=local_shuffle_seed, prefetch_batches=0,
+            _count_blocked=False)  # the device-side buffer below is outermost
+        # stage 1: host batching decoupled from H2D, so slow batch
+        # formation can't starve the transfer thread of its lookahead
+        staged_host = _prefetch(host_iter, n_prefetch)
+        stager = _H2DStager(dtypes, sharding, stats)
+
+        def put_stage() -> Iterator[Dict[str, Any]]:
+            for host_batch in staged_host:
+                yield stager.to_device(host_batch)
+
+        # stage 2: the depth-n device-side buffer the consumer drains
+        return _prefetch(put_stage(), n_prefetch, stats=stats,
+                         device_depth=True)
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            device: str = "cpu", **kw) -> Iterator[Dict[str, Any]]:
@@ -194,26 +707,141 @@ class DataIterator:
                    for k, v in batch.items()}
 
 
-def _prefetch(it: Iterator[Any], n: int) -> Iterator[Any]:
-    """Run ``it`` on a background thread, buffering up to n items."""
-    q: "queue.Queue" = queue.Queue(maxsize=n)
+class _H2DStager:
+    """Casts + ``jax.device_put``s one host batch, reusing per-key staging
+    buffers.
+
+    Dtype-cast columns land in one of two per-key staging buffers
+    (double-buffered): buffer reuse waits on the device array staged from
+    it two batches ago via ``block_until_ready`` — by then the transfer
+    has long completed, so the wait is ~free but mutation-under-transfer
+    is impossible.  Matching-dtype columns skip staging entirely: blocks
+    deserialize as zero-copy views over the 64B-aligned shm arena, and
+    must DMA straight from that mapping, not via a silent astype copy.
+    """
+
+    def __init__(self, dtypes: Optional[Dict[str, Any]], sharding: Any,
+                 stats: IngestStats):
+        self._dtypes = dtypes
+        self._sharding = sharding
+        self._stats = stats
+        self._bufs: Dict[Any, List[Any]] = {}  # (key, slot) -> [buf, dev]
+        self._tick = 0
+
+    def to_device(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.perf_counter()
+        slot = self._tick % 2
+        self._tick += 1
+        out = {}
+        for k, v in batch.items():
+            if self._dtypes and k in self._dtypes:
+                tgt = np.dtype(self._dtypes[k])
+                if v.dtype != tgt:
+                    v = self._stage_cast(k, slot, v, tgt)
+            dev = jax.device_put(v, self._sharding) \
+                if self._sharding is not None else jax.device_put(v)
+            pair = self._bufs.get((k, slot))
+            if pair is not None:
+                pair[1] = dev
+            out[k] = dev
+        self._stats.add("h2d_s", time.perf_counter() - t0)
+        return out
+
+    def _stage_cast(self, k: str, slot: int, v: np.ndarray,
+                    tgt: np.dtype) -> np.ndarray:
+        pair = self._bufs.setdefault((k, slot), [None, None])
+        buf = pair[0]
+        if buf is None or buf.shape != v.shape or buf.dtype != tgt:
+            buf = pair[0] = np.empty(v.shape, tgt)
+        elif pair[1] is not None:
+            if self._alias_risk(pair[1]):
+                # zero-copy backend: the array staged from this buffer 2
+                # batches ago is a VIEW of it, not a DMA copy —
+                # overwriting would corrupt a batch still in the
+                # pipeline, so that batch keeps the memory
+                buf = pair[0] = np.empty(v.shape, tgt)
+            else:
+                # the transfer staged from this buffer 2 batches ago
+                # must be done before we overwrite it
+                pair[1].block_until_ready()
+        np.copyto(buf, v, casting="unsafe")
+        return buf
+
+    @staticmethod
+    def _alias_risk(dev) -> bool:
+        """Whether ``jax.device_put`` may have returned a zero-copy view
+        of the host staging buffer instead of a DMA copy.  On the CPU
+        backend it does (host array == "device" array); on accelerators
+        the result lives in HBM, so post-transfer buffer reuse is safe.
+        """
+        try:
+            return any(d.platform == "cpu" for d in dev.devices())
+        except Exception:  # noqa: BLE001 — can't prove safety: don't reuse
+            return True
+
+
+def _prefetch(it: Iterator[Any], n: int, stats: Optional[IngestStats] = None,
+              device_depth: bool = False) -> Iterator[Any]:
+    """Run ``it`` on a background thread, buffering up to n items.
+
+    Abandonment-safe: the consumer closing the returned generator
+    (``break``, GC, a train failure) sets a stop event — the producer
+    thread exits its bounded put within ~0.1s, closes the underlying
+    iterator (releasing its lookahead window's block refs), and dies.  No
+    producer thread ever outlives its consumer.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, n))
+    stop = threading.Event()
     err: List[BaseException] = []
+
+    def put_checked(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                if stats is not None and device_depth:
+                    stats.set_max("device_prefetch_depth", q.qsize())
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def work():
         try:
             for item in it:
-                q.put(item)
-        except BaseException as e:
+                if not put_checked(item):
+                    break
+        except BaseException as e:  # noqa: BLE001
             err.append(e)
         finally:
-            q.put(_SENTINEL)
+            try:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()  # drops inner stages/window refs on abandon
+            except BaseException:  # noqa: BLE001
+                pass
+            put_checked(_SENTINEL)
 
     t = threading.Thread(target=work, daemon=True, name="rtpu-data-prefetch")
     t.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            break
-        yield item
-    if err:
-        raise err[0]
+
+    def gen():
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                if stats is not None:
+                    stats.add("consumer_blocked_s",
+                              time.perf_counter() - t0)
+                if item is _SENTINEL:
+                    break
+                if stats is not None and device_depth:
+                    stats.set("device_batches_in_flight", q.qsize())
+                yield item
+        finally:
+            stop.set()
+        if err:
+            raise err[0]
+
+    return gen()

@@ -599,25 +599,79 @@ class JoinOperator(PhysicalOperator):
 
 
 class OutputSplitter(PhysicalOperator):
-    """Split the stream into n round-robin sub-streams (streaming_split).
+    """Split the stream into n consumer sub-streams (streaming_split).
 
-    Reference: ``execution/operators/output_splitter.py`` (equalize by rows).
+    Reference: ``execution/operators/output_splitter.py``.  With
+    ``locality_hints`` (one node id per output index), a bundle prefers
+    the consumer co-located with the node that produced its blocks
+    (``BlockMetadata.exec_node_id``, majority by bytes) — every avoided
+    misroute is a cross-node DCN pull saved.  Balance stays bounded: the
+    preferred consumer is skipped when it is already ahead of the
+    least-loaded one by more than ``DataContext.
+    locality_split_max_skew_rows`` rows (halved under ``equal=``, the
+    reference's equalization mode); the fallback is fewest-rows.
     """
 
-    def __init__(self, input_op: PhysicalOperator, n: int, equal: bool = False):
+    def __init__(self, input_op: PhysicalOperator, n: int, equal: bool = False,
+                 locality_hints: Optional[List[Optional[str]]] = None,
+                 max_skew_rows: Optional[int] = None):
         super().__init__(f"OutputSplitter({n})", [input_op])
         self.n = n
         self._equal = equal
+        if locality_hints is not None and len(locality_hints) != n:
+            raise ValueError(
+                f"locality_hints must have one entry per output "
+                f"({n}), got {len(locality_hints)}")
+        self._hints = list(locality_hints) if locality_hints else None
+        # captured in the DRIVER by streaming_split (DataContext is
+        # process-local; this operator runs inside the coordinator actor)
+        self._max_skew_rows = max_skew_rows
         self.queues: List[Deque[RefBundle]] = [collections.deque() for _ in range(n)]
         self._rows: List[int] = [0] * n
+        self.locality_hits = 0
+        self.locality_misses = 0
+
+    def _preferred_output(self, bundle: RefBundle) -> Optional[int]:
+        """Output index co-located with the bundle's producing node, or
+        None when unknown / no consumer sits there."""
+        by_node: Dict[str, int] = {}
+        for _, meta in bundle.blocks:
+            node = getattr(meta, "exec_node_id", None)
+            if node:
+                by_node[node] = by_node.get(node, 0) + max(1, meta.size_bytes)
+        if not by_node:
+            return None
+        node = max(by_node, key=by_node.get)
+        ranks = [i for i, h in enumerate(self._hints) if h == node]
+        if not ranks:
+            return None
+        return min(ranks, key=lambda i: self._rows[i])
 
     def add_input(self, bundle: RefBundle):
-        # send to the consumer with the fewest rows so far (locality-free
-        # equalization heuristic)
-        i = int(np.argmin(self._rows))
-        self.queues[i].append(bundle)
-        self._rows[i] += bundle.num_rows()
+        target: Optional[int] = None
+        if self._hints is not None:
+            pref = self._preferred_output(bundle)
+            max_skew = self._max_skew_rows if self._max_skew_rows is not None \
+                else DataContext.get_current().locality_split_max_skew_rows
+            if self._equal:
+                max_skew //= 2
+            if pref is not None and \
+                    self._rows[pref] - min(self._rows) <= max_skew:
+                target = pref
+                self.locality_hits += 1
+            else:
+                self.locality_misses += 1
+        if target is None:
+            # fewest rows so far (the locality-free equalization heuristic)
+            target = int(np.argmin(self._rows))
+        self.queues[target].append(bundle)
+        self._rows[target] += bundle.num_rows()
         self.rows_out += bundle.num_rows()
+
+    def split_stats(self) -> Dict[str, int]:
+        return {"locality_hits": self.locality_hits,
+                "locality_misses": self.locality_misses,
+                "rows_per_output": list(self._rows)}
 
     def has_output(self) -> bool:
         return False

@@ -106,7 +106,15 @@ class StreamingExecutor:
         except BaseException as e:  # propagate to consumer
             self._error = e
         finally:
-            self._outq.put(_SENTINEL)
+            # bounded: an abandoned consumer leaves the queue full and
+            # never drains it — a blocking put would leak this thread
+            while True:
+                try:
+                    self._outq.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    if self._stop.is_set():
+                        break  # consumer gone; nobody reads the sentinel
 
     def _step(self) -> bool:
         progressed = False
@@ -216,10 +224,17 @@ def execute_to_bundles(sink: PhysicalOperator) -> List[RefBundle]:
     return list(StreamingExecutor(sink).run())
 
 
-def execute_streaming_split(sink: PhysicalOperator, n: int,
-                            equal: bool = False) -> List["queue.Queue"]:
-    """Run with an OutputSplitter sink feeding n consumer queues."""
-    splitter = OutputSplitter(sink, n, equal)
+def execute_streaming_split(
+        sink: PhysicalOperator, n: int, equal: bool = False,
+        locality_hints: Optional[List[Optional[str]]] = None,
+        locality_max_skew_rows: Optional[int] = None,
+) -> "tuple[List[queue.Queue], OutputSplitter]":
+    """Run with an OutputSplitter sink feeding n consumer queues.
+
+    Returns the queues plus the splitter itself so the coordinator can
+    surface its locality hit/miss counters (``split_stats``)."""
+    splitter = OutputSplitter(sink, n, equal, locality_hints=locality_hints,
+                              max_skew_rows=locality_max_skew_rows)
     ex = StreamingExecutor(splitter)
     queues: List[queue.Queue] = [queue.Queue() for _ in range(n)]
 
@@ -249,4 +264,4 @@ def execute_streaming_split(sink: PhysicalOperator, n: int,
                 q.put(_SENTINEL)
 
     threading.Thread(target=pump, daemon=True, name="rtpu-data-split").start()
-    return queues
+    return queues, splitter

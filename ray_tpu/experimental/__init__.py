@@ -1,1 +1,24 @@
 """Experimental APIs (internal KV, compiled-graph channels)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def get_local_object_locations(refs: List) -> Dict:
+    """Best-effort node placement for objects, from this process's own
+    location table — no RPCs (parity: ``ray.experimental.
+    get_local_object_locations``).  Returns ``{ref: node_id_or_None}``;
+    ``None`` when the object is inline, not yet sealed, or this process
+    has never observed a location for it (e.g. a borrowed ref before the
+    first fetch).
+    """
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    out = {}
+    for ref in refs:
+        loc = w._locations.get(ref.id)
+        out[ref] = None if loc is None or loc.get("inline") \
+            else loc.get("node")
+    return out

@@ -8,7 +8,7 @@ rendezvous (``NCCLUniqueIDStore``, and GLOO's ``ray_internal_kv`` store at
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 def _worker():
@@ -60,6 +60,14 @@ def _internal_kv_del(key: bytes, namespace: str = "kv") -> bool:
 def _internal_kv_list(prefix: str = "", namespace: str = "kv") -> List[str]:
     w = _worker()
     return w.run_coro(w.gcs.call("kv_keys", ns=namespace, prefix=prefix))
+
+
+def _internal_kv_get_prefix(prefix: str = "",
+                            namespace: str = "kv") -> Dict[str, bytes]:
+    """Batched prefix read (key -> value) in one round trip."""
+    w = _worker()
+    return w.run_coro(w.gcs.call("kv_get_prefix", ns=namespace,
+                                 prefix=prefix))
 
 
 def _internal_kv_exists(key: bytes, namespace: str = "kv") -> bool:

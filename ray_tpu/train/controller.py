@@ -127,7 +127,7 @@ class TrainController:
         group = WorkerGroup(sc, f"{self.name}/g{self._generation}")
         group.start()
 
-        shards = self._split_datasets(sc.num_workers)
+        shards = self._split_datasets(sc.num_workers, group)
         dist_env = (self.dist_env_fn(group) if self.dist_env_fn else None)
         group.run_train_fn(
             self.fn_payload, self.train_loop_config,
@@ -155,16 +155,30 @@ class TrainController:
                     self.name, self._ctx.errors_seen, e)
                 time.sleep(1.0)
 
-    def _split_datasets(self, n: int) -> Optional[List[Any]]:
+    def _split_datasets(self, n: int,
+                        group: Optional[WorkerGroup] = None
+                        ) -> Optional[List[Any]]:
         if not self.datasets:
             return None
+        # locality hints: the node each rank runs on, so the split
+        # coordinator routes bundles to the co-located consumer instead of
+        # forcing a cross-node pull per misrouted block
+        hints: Optional[List[Optional[str]]] = None
+        if group is not None:
+            try:
+                ids = group.worker_node_ids()
+                if len(ids) == n and any(ids):
+                    hints = [i or None for i in ids]
+            except Exception:  # noqa: BLE001 — hints are an optimization
+                pass
         # one shard dict per rank; Dataset objects are streaming_split,
         # plain iterables replicated
         per_rank: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             splitter = getattr(ds, "streaming_split", None)
             if callable(splitter):
-                parts = splitter(n, equal=True)
+                kw = {"locality_hints": hints} if hints else {}
+                parts = splitter(n, equal=True, **kw)
                 for r in range(n):
                     per_rank[r][name] = parts[r]
             else:

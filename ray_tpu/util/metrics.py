@@ -48,6 +48,14 @@ class Metric:
         out.update(tags or {})
         return out
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Retire the series for one tag combination.  Per-entity label
+        sets (an iterator id, a replica id) must be dropped when the
+        entity finishes, or a long-lived registry grows without bound."""
+        key = _tag_key(self._resolve_tags(tags))
+        with self._lock:
+            self._values.pop(key, None)
+
     def snapshot(self) -> List[Tuple[Dict[str, str], float]]:
         with self._lock:
             return [(dict(k), v) for k, v in self._values.items()]

@@ -783,3 +783,44 @@ def test_every_collective_op_routes_through_supervision():
     create_src = inspect.getsource(coll_mod.GroupManager.create)
     assert "SupervisedGroup(" in create_src, (
         "GroupManager.create no longer wraps backends in SupervisedGroup")
+
+
+def test_no_serial_blocking_get_in_data_iteration_loops():
+    """Tooling guard: the ingest hot path must never regress to one
+    blocking ``ray_tpu.get`` per block inside an iteration loop — the
+    serial anti-pattern the pipelined lookahead replaced (see
+    docs/data_performance.md).  Any single-ref ``ray_tpu.get`` inside a
+    for/while loop in iterator.py or dataset.py must carry an explicit
+    ``allowed-blocking-get`` annotation (same line or the line above)
+    explaining why it is not a serial stall — e.g. the lookahead's
+    in-order surface of an already-prefetched payload, or the split
+    protocol's get on a request issued one iteration ahead."""
+    import ast
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for mod in ("iterator.py", "dataset.py"):
+        path = os.path.join(repo, "ray_tpu", "data", mod)
+        src = open(path).read()
+        lines = src.splitlines()
+        tree = ast.parse(src)
+        loops = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        for loop in loops:
+            for n in ast.walk(loop):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "get"
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "ray_tpu"):
+                    continue
+                # lists of refs are a batched get, not the serial pattern
+                if n.args and isinstance(n.args[0], (ast.List, ast.ListComp)):
+                    continue
+                context = "\n".join(
+                    lines[max(0, n.lineno - 2):n.lineno])
+                assert "allowed-blocking-get" in context, (
+                    f"{mod}:{n.lineno} blocking ray_tpu.get on a single "
+                    f"ref inside an iteration loop — use the lookahead "
+                    f"path, or annotate the line with "
+                    f"'# allowed-blocking-get: <why>' if the pull "
+                    f"provably started earlier")
