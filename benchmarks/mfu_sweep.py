@@ -115,6 +115,28 @@ def int8_gather_micro(steps=20):
     }), flush=True)
 
 
+def multichip_sweep():
+    """Sweep every ScalingConfig mesh preset over all visible devices
+    through the trainer path (bench.run_multichip): one JSON line per
+    preset with the mesh it resolved to and MFU / tokens/s."""
+    import sys
+
+    sys.path.insert(0, ".")
+    from bench import run_multichip
+    from ray_tpu.parallel import MESH_PRESETS
+
+    for preset in sorted(MESH_PRESETS):
+        rec = run_multichip(preset=preset)
+        print(json.dumps({
+            "config": f"multichip_{preset}",
+            "metric": rec["metric"], "value": rec["value"],
+            "unit": rec["unit"],
+            "mesh": rec["detail"].get("mesh"),
+            "tokens_per_s": rec["detail"].get("tokens_per_s"),
+            "step_ms": rec["detail"].get("step_ms"),
+        }), flush=True)
+
+
 def main():
     import dataclasses
 
@@ -122,7 +144,15 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument(
+        "--multichip", action="store_true",
+        help="sweep mesh presets over all visible devices via the "
+             "sharded trainer path instead of the single-chip levers")
     args = ap.parse_args()
+
+    if args.multichip:
+        multichip_sweep()
+        return
 
     base = LlamaConfig(
         vocab_size=32000, hidden_size=1536, num_layers=16, num_heads=12,

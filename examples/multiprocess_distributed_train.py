@@ -6,6 +6,12 @@ devices form ONE global mesh (`jax.process_count() == num_workers`), and
 the jitted train step's gradient reduction crosses process boundaries —
 the same path that spans hosts on a TPU pod slice.
 
+The sharded ScalingConfig API does the jax plumbing: ``mesh="dp"``
+declares the mesh, ``ctx.get_mesh()`` joins the multi-process runtime
+and resolves it, and ``ctx.shard_inputs`` turns each process's local
+batch rows into one global sharded array — no ``multihost_utils`` in
+user code.
+
 Laptop demo: force CPU with a couple of virtual devices per worker.
 
 Run:
@@ -21,17 +27,16 @@ def loop(config):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.experimental import multihost_utils
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ray_tpu import train
 
-    # join the multi-process jax runtime (no-op for 1-worker runs)
-    train.initialize_jax_distributed()
     ctx = train.get_context()
     rank = ctx.get_world_rank()
+    # joins the multi-process jax runtime (no-op for 1-worker runs) and
+    # resolves the requested mesh over the GLOBAL device view
+    mesh = ctx.get_mesh()
     nloc = len(jax.local_devices())
-    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
 
     d = 16
     W = jax.device_put(jnp.zeros((d, 1), jnp.float32),
@@ -44,22 +49,20 @@ def loop(config):
         l, g = jax.value_and_grad(loss)(W)
         return W - 0.1 * g, l
 
-    jitted = jax.jit(step, in_shardings=(
-        NamedSharding(mesh, P()), NamedSharding(mesh, P("dp")),
-        NamedSharding(mesh, P("dp"))))
+    jitted = jax.jit(step, out_shardings=(NamedSharding(mesh, P()),
+                                          NamedSharding(mesh, P())))
 
     rng = np.random.default_rng(rank)
     true_w = np.arange(d, dtype=np.float32)[:, None] / d
     for it in range(config["iters"]):
-        # each process contributes ITS shard of the global batch
+        # each process contributes ITS local rows of the global batch;
+        # shard_inputs concatenates them in rank order over dp
         x_local = rng.normal(size=(nloc * 8, d)).astype(np.float32)
         y_local = x_local @ true_w
-        x = multihost_utils.host_local_array_to_global_array(
-            x_local, mesh, P("dp"))
-        y = multihost_utils.host_local_array_to_global_array(
-            y_local, mesh, P("dp"))
-        W, l = jitted(W, x, y)
-        train.report({"iter": it, "loss": float(l),
+        batch = ctx.shard_inputs({"x": x_local, "y": y_local})
+        W, l = jitted(W, batch["x"], batch["y"])
+        loss = float(np.asarray(jax.device_get(l.addressable_data(0))))
+        train.report({"iter": it, "loss": loss,
                       "procs": jax.process_count(),
                       "mesh_devices": mesh.size})
 
@@ -69,7 +72,7 @@ def main():
     result = train.JaxTrainer(
         loop,
         train_loop_config={"iters": 8},
-        scaling_config=train.ScalingConfig(num_workers=2),
+        scaling_config=train.ScalingConfig(num_workers=2, mesh="dp"),
     ).fit()
     assert result.error is None, result.error
     m = result.metrics

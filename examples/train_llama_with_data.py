@@ -1,10 +1,14 @@
 """End-to-end: DataParallelTrainer + ray_tpu.data feeding a sharded Llama.
 
+The mesh is declared on the ScalingConfig (a preset name or a
+``parallel.MeshConfig``); the worker loop gets it back — resolved
+against whatever devices the generation actually has — via
+``train.get_context().get_mesh()``.
+
 Run: python examples/train_llama_with_data.py
 (CPU-mesh friendly; on a TPU host the same code uses the chips.)
 """
 
-import jax
 import numpy as np
 
 import ray_tpu
@@ -18,13 +22,15 @@ def train_loop(config):
 
     from ray_tpu.models.llama import LlamaConfig
     from ray_tpu.models.training import default_optimizer, make_llama_trainer
-    from ray_tpu.parallel import MeshConfig, create_mesh
 
+    ctx = train.get_context()
+    # the ScalingConfig's requested mesh, resolved over this worker's
+    # device view (clamped if an elastic restart shrank the hardware)
+    mesh = ctx.get_mesh()
     cfg = LlamaConfig.tiny()
-    mesh = create_mesh(MeshConfig(dp=-1))
     tr = make_llama_trainer(cfg, mesh, optimizer=default_optimizer(
         lr=1e-3, warmup=2, decay_steps=100))
-    state = tr.init_state(jax.random.PRNGKey(0))
+    state = tr.init_state(jax.random.PRNGKey(0))  # born sharded on the mesh
 
     shard = train.get_dataset_shard("train")
     step = 0
@@ -32,7 +38,9 @@ def train_loop(config):
         tokens = batch["tokens"].astype("int32")
         state, metrics = tr.step(state, tr.shard_batch({"tokens": tokens}))
         step += 1
-        train.report({"loss": float(metrics["loss"]), "step": step})
+        train.report({"loss": float(metrics["loss"]), "step": step,
+                      "mesh": {a: int(s) for a, s in mesh.shape.items()
+                               if int(s) > 1}})
 
 
 def main():
@@ -42,7 +50,8 @@ def main():
     ds = rd.from_numpy(
         rng.integers(0, 256, (64, 33)).astype(np.int32), column="tokens")
     trainer = DataParallelTrainer(
-        train_loop, scaling_config=ScalingConfig(num_workers=2),
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2, mesh="fsdp"),
         datasets={"train": ds})
     result = trainer.fit()
     print("final:", result.metrics)

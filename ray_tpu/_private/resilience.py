@@ -121,6 +121,14 @@ def is_degradable(err: BaseException) -> bool:
     reject, HBM OOM) — retrying the same config is futile, but a staged
     fallback to a smaller config may succeed."""
     msg = str(err)
+    if "unable to initialize backend" in msg.lower():
+        # backend-INIT failure: there is no config to degrade — nothing
+        # compiled yet.  The production shape is BENCH_r05's exact text,
+        # "Unable to initialize backend 'axon': ... setup/compile error
+        # (Unavailable)", whose "compile" substring would otherwise
+        # misclassify an outage as a config rejection (and, via the
+        # degrade veto in is_retryable, block its retry).
+        return False
     return any(m in msg for m in _DEGRADE_MARKERS)
 
 

@@ -189,6 +189,20 @@ class ShardedTrainer:
             return self._jit_init(key)
 
     def shard_batch(self, batch):
+        if jax.process_count() > 1:
+            # multi-host SPMD: each process passes its LOCAL rows; they
+            # concatenate in rank order into one global array (same
+            # contract as jax.distributed data loading)
+            from jax.experimental import multihost_utils
+
+            def _globalize(x, sh):
+                return multihost_utils.host_local_array_to_global_array(
+                    x, self.mesh, sh.spec)
+
+            if isinstance(self.batch_sharding, NamedSharding):
+                return jax.tree.map(
+                    lambda x: _globalize(x, self.batch_sharding), batch)
+            return jax.tree.map(_globalize, batch, self.batch_sharding)
         if isinstance(self.batch_sharding, NamedSharding):
             return jax.tree.map(
                 lambda x: jax.device_put(x, self.batch_sharding), batch

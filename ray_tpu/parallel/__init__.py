@@ -10,11 +10,13 @@ reduce_scatter/ppermute) over ICI/DCN.
 """
 
 from ray_tpu.parallel.mesh import (  # noqa: F401
+    MESH_PRESETS,
     MeshConfig,
     create_mesh,
     create_hybrid_mesh,
     mesh_shape_for,
     local_mesh,
+    resolve_mesh_config,
 )
 from ray_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -46,23 +46,120 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
 
+    def _sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "pp": self.pp,
+                "tp": self.tp, "sp": self.sp}
+
+    def _named(self, only_fixed: bool = False) -> str:
+        """Human-readable axis sizes, e.g. "dp=2, tp=4"."""
+        items = [(a, s) for a, s in self._sizes().items()
+                 if not (only_fixed and s in (1, -1))]
+        return ", ".join(f"{a}={s}" for a, s in items) or "all axes = 1"
+
     def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
-        sizes = [self.dp, self.fsdp, self.pp, self.tp, self.sp]
-        n_infer = sum(1 for s in sizes if s == -1)
-        if n_infer > 1:
-            raise ValueError(f"At most one axis may be -1, got {sizes}")
-        fixed = math.prod(s for s in sizes if s != -1)
-        if n_infer == 1:
+        sizes = self._sizes()
+        for axis, s in sizes.items():
+            if s != -1 and s < 1:
+                raise ValueError(
+                    f"mesh axis {axis!r}={s} is invalid: sizes must be a "
+                    "positive int, or -1 on at most one axis to infer it")
+        infer = [a for a, s in sizes.items() if s == -1]
+        if len(infer) > 1:
+            raise ValueError(
+                "at most one mesh axis may be -1 (inferred), got "
+                + ", ".join(f"{a}=-1" for a in infer))
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if infer:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fixed axes {fixed}"
-                )
-            sizes = [n_devices // fixed if s == -1 else s for s in sizes]
+                    f"cannot infer mesh axis {infer[0]!r}: {n_devices} "
+                    f"devices not divisible by the fixed axes "
+                    f"({self._named(only_fixed=True)}; product {fixed}); "
+                    f"use MeshConfig.clamp_to({n_devices}) to degrade "
+                    "gracefully")
+            sizes[infer[0]] = n_devices // fixed
         elif fixed != n_devices:
             raise ValueError(
-                f"Mesh {sizes} needs {fixed} devices, have {n_devices}"
-            )
-        return tuple(sizes)  # type: ignore[return-value]
+                f"mesh ({self._named()}) needs {fixed} devices, have "
+                f"{n_devices}; use MeshConfig.clamp_to({n_devices}) to "
+                "degrade gracefully")
+        return tuple(sizes[a] for a in MESH_AXES)  # type: ignore[return-value]
+
+    def clamp_to(self, n_devices: int) -> "MeshConfig":
+        """Degrade this mesh request to fit ``n_devices``, never raising
+        on divisibility: the concrete config it returns always resolves.
+
+        Model axes keep their requested size preferentially (clamp order
+        tp → sp → pp → fsdp → dp, innermost first — the axes that ride
+        ICI shrink last); each fixed axis is reduced to the largest size
+        ≤ its request that divides the remaining device budget.  An
+        inferred (-1) axis absorbs whatever remains; with no inferred
+        axis, leftover devices fold into ``dp`` (data parallelism is the
+        one axis that scales a training run without resharding params).
+
+        This is what elastic re-mesh uses: a drain that shrinks the
+        worker group re-forms a valid smaller mesh from the same
+        *requested* config instead of dying on an axis-divisibility
+        error.
+        """
+        if n_devices < 1:
+            raise ValueError(f"clamp_to needs >= 1 device, got {n_devices}")
+        sizes = self._sizes()
+        infer = [a for a, s in sizes.items() if s == -1]
+        if len(infer) > 1:
+            raise ValueError(
+                "at most one mesh axis may be -1 (inferred), got "
+                + ", ".join(f"{a}=-1" for a in infer))
+        budget = n_devices
+        for axis in ("tp", "sp", "pp", "fsdp", "dp"):
+            s = sizes[axis]
+            if s == -1:
+                continue
+            s = max(1, min(s, budget))
+            while budget % s:
+                s -= 1
+            sizes[axis] = s
+            budget //= s
+        if infer:
+            sizes[infer[0]] = budget
+        elif budget > 1:
+            sizes["dp"] *= budget
+        return MeshConfig(**sizes)
+
+
+# Named mesh presets for ``train.ScalingConfig(mesh=...)``.  Fixed axes
+# (e.g. tp=2) are degraded by ``clamp_to`` on smaller hardware, so every
+# preset forms a valid mesh on any device count (guard-tested on
+# 1/2/4/8 devices in tests/test_sharded_train.py).
+MESH_PRESETS: Dict[str, MeshConfig] = {
+    # pure data parallelism: params replicated, batch sharded
+    "dp": MeshConfig(dp=-1),
+    # ZeRO-style sharded data parallelism: params/opt-state sharded over
+    # every chip, all-gathered for compute
+    "fsdp": MeshConfig(dp=1, fsdp=-1),
+    # FSDP across hosts/outer axis + Megatron tensor parallelism on the
+    # 2 ICI-adjacent chips
+    "fsdp_tp": MeshConfig(dp=1, fsdp=-1, tp=2),
+}
+
+
+def resolve_mesh_config(
+    mesh: Union[str, MeshConfig, None]) -> Optional[MeshConfig]:
+    """Normalize a ``ScalingConfig.mesh`` value: a preset name from
+    :data:`MESH_PRESETS`, a :class:`MeshConfig`, or None (caller's
+    default)."""
+    if mesh is None or isinstance(mesh, MeshConfig):
+        return mesh
+    if isinstance(mesh, str):
+        try:
+            return MESH_PRESETS[mesh]
+        except KeyError:
+            raise ValueError(
+                f"unknown mesh preset {mesh!r}; valid presets: "
+                f"{sorted(MESH_PRESETS)} (or pass a MeshConfig)") from None
+    raise TypeError(
+        f"mesh must be a preset name, MeshConfig, or None; got "
+        f"{type(mesh).__name__}")
 
 
 def mesh_shape_for(n_devices: int, config: Optional[MeshConfig] = None):

@@ -22,6 +22,10 @@ LogicalAxisRules = Dict[str, Union[str, Tuple[str, ...], None]]
 
 # Default rules: batch over (dp, fsdp); weights sharded over fsdp on their
 # largest dim and over tp Megatron-style; sequence over sp for ring attention.
+# Every logical axis any models/ spec tree uses MUST appear here — an
+# explicit None records a deliberate replication decision; a *missing*
+# name would replicate silently, which the tooling guard
+# (tests/test_sharded_train.py) rejects.
 DEFAULT_RULES: LogicalAxisRules = {
     "batch": ("dp", "fsdp"),
     "seq": "sp",
@@ -34,6 +38,9 @@ DEFAULT_RULES: LogicalAxisRules = {
     "vocab": "tp",
     "expert": "tp",
     "layers": "pp",
+    # norm scales / biases / cls tokens: O(hidden) vectors — sharding
+    # them saves nothing and costs an all-gather per use
+    "norm": None,
 }
 
 # Rules for inference-style TP-only sharding (no fsdp axis in use).

@@ -27,8 +27,11 @@ from ray_tpu.train.session import (
     TrainContext,
     get_context,
     get_dataset_shard,
+    get_mesh,
     profile,
     report,
+    shard_inputs,
+    shard_params,
 )
 from ray_tpu.train.trainer import (
     DataParallelTrainer,
@@ -41,6 +44,7 @@ __all__ = [
     "ScalingConfig", "DefaultFailurePolicy", "ElasticScalingPolicy",
     "FailureDecision", "FailurePolicy", "FixedScalingPolicy", "ResizeDecision",
     "ScalingPolicy", "TrainContext", "get_context", "get_dataset_shard",
+    "get_mesh", "shard_inputs", "shard_params",
     "profile", "report", "DataParallelTrainer", "JaxTrainer",
     "initialize_jax_distributed", "latest_committed_checkpoint",
 ]

@@ -8,7 +8,11 @@ scaling unit is a TPU topology (chips / pod-slice), not GPU counts.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import TYPE_CHECKING, Any, Dict, Optional, Union
+
+if TYPE_CHECKING:  # jax-importing types only for annotations
+    from ray_tpu.parallel.mesh import MeshConfig
+    from ray_tpu.parallel.sharding import LogicalAxisRules
 
 
 @dataclasses.dataclass
@@ -22,6 +26,16 @@ class ScalingConfig:
     topology: optional slice topology string (e.g. "v5e-64") — workers are
     gang-scheduled onto one slice via a placement group when set.
     resources_per_worker: extra custom resources.
+    mesh: the GSPMD mesh the worker group should form over its (global)
+    device view — a ``parallel.MeshConfig`` or a preset name ("dp",
+    "fsdp", "fsdp_tp").  This is the *requested* shape: each worker
+    generation re-resolves it against the devices actually present
+    (``MeshConfig.clamp_to``), so an elastic restart that shrinks the
+    group re-forms a valid smaller mesh.  ``train.get_mesh()`` inside
+    the loop returns the resolved ``jax.sharding.Mesh``.
+    logical_axis_rules: override for the logical-axis → mesh-axis rule
+    table (default ``parallel.sharding.DEFAULT_RULES``) used by
+    ``train.shard_params`` / ``train.shard_inputs``.
     """
 
     num_workers: int = 1
@@ -29,6 +43,8 @@ class ScalingConfig:
     chips_per_worker: float = 0.0
     topology: Optional[str] = None
     resources_per_worker: Optional[Dict[str, float]] = None
+    mesh: Union[str, "MeshConfig", None] = None
+    logical_axis_rules: Optional["LogicalAxisRules"] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -36,6 +52,17 @@ class ScalingConfig:
         if self.use_tpu and self.chips_per_worker:
             res["TPU"] = self.chips_per_worker
         return res
+
+    def mesh_config(self) -> Optional["MeshConfig"]:
+        """The requested mesh as a concrete MeshConfig (preset names
+        resolved; None when no mesh was requested).  Raises ValueError
+        on an unknown preset — callers validate at trainer construction
+        so a typo fails before any worker is scheduled."""
+        if self.mesh is None:
+            return None  # keep jax off mesh-less drivers
+        from ray_tpu.parallel.mesh import resolve_mesh_config
+
+        return resolve_mesh_config(self.mesh)
 
 
 @dataclasses.dataclass

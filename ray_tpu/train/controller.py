@@ -129,9 +129,15 @@ class TrainController:
 
         shards = self._split_datasets(sc.num_workers, group)
         dist_env = (self.dist_env_fn(group) if self.dist_env_fn else None)
+        # the REQUESTED mesh ships to every generation unchanged; workers
+        # resolve it against the devices they actually see (clamp_to), so
+        # mesh shape is a runtime decision — an elastic restart onto
+        # fewer chips re-forms a valid smaller mesh from the same request
         group.run_train_fn(
             self.fn_payload, self.train_loop_config,
-            self.checkpoint_manager.latest, shards, dist_env)
+            self.checkpoint_manager.latest, shards, dist_env,
+            mesh_config=sc.mesh_config(),
+            axis_rules=sc.logical_axis_rules)
         return group
 
     def _restart_group(self) -> WorkerGroup:

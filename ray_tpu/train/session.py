@@ -27,6 +27,8 @@ class _TrainSession:
         group_name: str,
         config: Dict[str, Any],
         checkpoint: Optional[Checkpoint],
+        mesh_config: Any = None,
+        axis_rules: Optional[Dict[str, Any]] = None,
     ):
         self.rank = rank
         self.world_size = world_size
@@ -38,6 +40,13 @@ class _TrainSession:
         self.error: Optional[BaseException] = None
         self.error_tb: Optional[str] = None
         self.dataset_shard: Any = None
+        # the REQUESTED mesh (parallel.MeshConfig or None) + rule-table
+        # override from ScalingConfig; get_mesh() resolves it against the
+        # devices this generation actually sees, so every elastic restart
+        # re-forms a mesh that fits the surviving hardware
+        self.mesh_config = mesh_config
+        self.axis_rules = axis_rules
+        self._mesh = None  # resolved jax Mesh, built lazily once
         # set by the controller when the node hosting this worker got a
         # drain (preemption) notice: the loop should checkpoint at its
         # next step boundary; cleared when a checkpoint is reported
@@ -71,6 +80,108 @@ def report(
     s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
 
 
+# -- GSPMD mesh + sharding (worker-side face of ScalingConfig.mesh) ----------
+
+
+def get_mesh():
+    """The resolved ``jax.sharding.Mesh`` for this worker generation.
+
+    Joins the multi-process jax runtime first (no-op single-process),
+    then resolves the *requested* ``ScalingConfig.mesh`` against the
+    devices actually visible — ``MeshConfig.clamp_to`` degrades fixed
+    axes that no longer fit, so a restart after a drain shrank the group
+    re-forms a valid smaller mesh instead of dying on a divisibility
+    error.  No mesh request means pure data parallelism over every
+    device.  Built once per session and cached.
+    """
+    s = _get_session()
+    if s._mesh is not None:
+        return s._mesh
+    from ray_tpu.train.trainer import initialize_jax_distributed
+
+    initialize_jax_distributed()
+    import logging
+
+    import jax
+
+    from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    requested = s.mesh_config or MeshConfig(dp=-1)
+    n = len(jax.devices())
+    concrete = requested.clamp_to(n)
+    try:
+        fits = requested.resolve(n) == concrete.resolve(n)
+    except ValueError:
+        fits = False
+    if not fits:
+        logging.getLogger(__name__).warning(
+            "train %s: requested mesh (%s) does not fit %d devices; "
+            "clamped to (%s)", s.group_name, requested._named(), n,
+            concrete._named())
+    s._mesh = create_mesh(concrete)
+    return s._mesh
+
+
+def shard_params(params: Any, spec_tree: Any, rules=None):
+    """Place a host-materialized param pytree on the session mesh as
+    ``NamedSharding`` arrays, per its logical-axis ``spec_tree`` (e.g.
+    ``llama_param_specs(cfg)``) and the session's rule table.
+
+    Works single- and multi-process: every process passes the same full
+    host tree and contributes the shards its local devices own.  (For
+    models too big to materialize on one host, init inside ``jit`` with
+    sharded ``out_shardings`` instead — ``ShardedTrainer.init_state``
+    does exactly that.)
+    """
+    import numpy as np
+
+    import jax
+
+    from ray_tpu.parallel.sharding import spec_tree_to_shardings
+
+    s = _get_session()
+    mesh = get_mesh()
+    shardings = spec_tree_to_shardings(
+        spec_tree, mesh, rules or s.axis_rules)
+
+    def _put(x, sh):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sh, lambda idx: arr[idx])
+
+    return jax.tree.map(_put, params, shardings)
+
+
+def shard_inputs(batch: Any, logical_axes=("batch",), rules=None):
+    """Shard per-step input arrays over the session mesh's data axes.
+
+    ``logical_axes`` names each array dimension (default: leading
+    "batch" dim sharded over dp×fsdp, rest replicated).  Single-process:
+    a plain sharded ``device_put``.  Multi-process: each process passes
+    its *local* rows and they concatenate, in rank order, into one
+    global array — the multi-host batch contract of
+    ``jax.distributed`` — without the loop touching
+    ``multihost_utils``.
+    """
+    import jax
+
+    from ray_tpu.parallel.sharding import logical_to_pspec
+
+    s = _get_session()
+    mesh = get_mesh()
+    spec = logical_to_pspec(logical_axes, rules or s.axis_rules, mesh=mesh)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return jax.tree.map(
+            lambda x: multihost_utils.host_local_array_to_global_array(
+                x, mesh, spec), batch)
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
 class TrainContext:
     def get_world_size(self) -> int:
         return _get_session().world_size
@@ -89,6 +200,21 @@ class TrainContext:
 
     def get_config(self) -> Dict[str, Any]:
         return _get_session().config
+
+    def get_mesh(self):
+        """The resolved GSPMD mesh for this generation (see
+        :func:`get_mesh`)."""
+        return get_mesh()
+
+    def shard_params(self, params: Any, spec_tree: Any, rules=None):
+        """Place params on the mesh per a logical-axis spec tree (see
+        :func:`shard_params`)."""
+        return shard_params(params, spec_tree, rules=rules)
+
+    def shard_inputs(self, batch: Any, logical_axes=("batch",), rules=None):
+        """Shard input arrays over the mesh's data axes (see
+        :func:`shard_inputs`)."""
+        return shard_inputs(batch, logical_axes=logical_axes, rules=rules)
 
     def drain_requested(self) -> bool:
         """True when the node hosting this worker received a drain

@@ -41,6 +41,8 @@ class DataParallelTrainer:
         self._fn_payload = serialization.dumps(train_loop_per_worker)
         self.train_loop_config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
+        # a bad mesh preset must fail HERE, not after workers scheduled
+        self.scaling_config.mesh_config()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint

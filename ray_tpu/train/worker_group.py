@@ -81,6 +81,8 @@ class TrainWorker:
         group_name: str,
         checkpoint_path: Optional[str],
         dataset_shard: Any = None,
+        mesh_config: Any = None,
+        axis_rules: Any = None,
     ) -> None:
         from ray_tpu._private import serialization
         from ray_tpu.train import session as session_mod
@@ -93,6 +95,8 @@ class TrainWorker:
             group_name=group_name,
             config=config,
             checkpoint=ckpt,
+            mesh_config=mesh_config,
+            axis_rules=axis_rules,
         )
         sess.dataset_shard = dataset_shard
         self._session = sess
@@ -254,6 +258,8 @@ class WorkerGroup:
         checkpoint: Optional[Checkpoint],
         dataset_shards: Optional[List[Any]] = None,
         dist_env: Optional[List[Dict[str, str]]] = None,
+        mesh_config: Any = None,
+        axis_rules: Any = None,
     ) -> None:
         n = len(self.workers)
         if dist_env is not None:
@@ -267,6 +273,7 @@ class WorkerGroup:
             refs.append(w.start_loop.remote(
                 fn_payload, config, rank, n, self.group_name,
                 checkpoint.path if checkpoint else None, shard,
+                mesh_config, axis_rules,
             ))
         ray_tpu.get(refs, timeout=60)
 

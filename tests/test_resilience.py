@@ -328,6 +328,83 @@ def test_bench_survives_injected_backend_init_failures():
     assert rec["detail"]["backend_init_retries"] == 2
 
 
+# BENCH_r05's literal failure text (ROADMAP housekeeping item): the axon
+# backend refusing to initialize.  Armed verbatim so the classification
+# path is tested against what production actually throws.
+_R05_BACKEND_ERROR = (
+    "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend "
+    "setup/compile error (Unavailable). (set JAX_PLATFORMS='' to "
+    "automatically choose an available backend)"
+)
+
+
+def test_round5_backend_error_classified_retryable():
+    from ray_tpu._private import resilience
+
+    assert resilience.is_retryable(RuntimeError(_R05_BACKEND_ERROR))
+    assert not resilience.is_degradable(RuntimeError(_R05_BACKEND_ERROR))
+
+
+@pytest.mark.chaos
+def test_bench_survives_exact_round5_backend_error_string():
+    """``bench.backend_init`` armed with BENCH_r05's exact error string
+    (not the canned 'unavailable' kind): two probes fail, the ladder
+    retries through, and with >1 device visible the round emits BOTH the
+    multichip trainer-path record and the single-chip headline."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import ray_tpu.util.fault_injection as fi\n"
+        f"fi.arm('bench.backend_init', nth=1, count=2, "
+        f"exc=RuntimeError({_R05_BACKEND_ERROR!r}))\n"
+        "from ray_tpu._private import resilience\n"
+        "import bench\n"
+        # keep tier-1 wall-clock flat: same retry count, tiny backoff
+        "bench.BACKEND_INIT_POLICY = resilience.RetryPolicy(\n"
+        "    max_attempts=5, base_delay_s=0.01, max_delay_s=0.05)\n"
+        "bench.main()\n"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    head = json.loads(lines[-1])
+    assert head["metric"] == "llama_train_mfu_cpu"
+    assert head["value"] > 0
+    assert head["detail"]["backend_init_retries"] == 2
+    multi = json.loads(lines[-2])  # the multichip mode fired too
+    assert multi["metric"] == "llama_train_multichip_tokens_per_s"
+    assert multi["value"] > 0
+    assert multi["detail"]["mesh"] == {"tp": 2}
+
+
+@pytest.mark.chaos
+def test_bench_total_backend_outage_emits_structured_rc0_record():
+    """Every retry exhausted: bench must still exit 0 with a structured
+    zero-value record (never a traceback) — the contract that kept
+    round 5 from being a silent hole."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "from ray_tpu._private import resilience\n"
+        "import bench\n"
+        "bench.BACKEND_INIT_POLICY = resilience.RetryPolicy(\n"
+        "    max_attempts=5, base_delay_s=0.01, max_delay_s=0.05)\n"
+        "bench.main()\n"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RAY_TPU_FAULT_INJECT="bench.backend_init:1:9:unavailable")
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-2000:])
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 0.0
+    assert "backend init failed" in rec["detail"]["error"]
+
+
 # ---------------------------------------------------------------------------
 # chaos: external store client
 # ---------------------------------------------------------------------------

@@ -377,11 +377,13 @@ class TestElasticEndToEnd:
             side = config["side_dir"]
             # the GSPMD mesh RE-FORMS over ALL processes' devices at the
             # new world size each restart (virtual cpu devices stand in
-            # for per-worker chips)
+            # for per-worker chips) — via the session mesh API, so the
+            # requested ScalingConfig.mesh is what re-resolves against
+            # the surviving device count (elastic re-mesh under test)
             assert jax.process_count() == world
             nloc = len(jax.local_devices())
-            from jax.sharding import Mesh, PartitionSpec as P
-            mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+            from jax.sharding import PartitionSpec as P
+            mesh = ctx.get_mesh()
             assert mesh.size == world * nloc
 
             # a jitted global psum so every step actually RUNS on the
@@ -482,7 +484,7 @@ class TestElasticEndToEnd:
                 train_loop_config={"side_dir": side, "steps": 6,
                                    "step_s": 0.6},
                 scaling_config=train.ScalingConfig(
-                    num_workers=2,
+                    num_workers=2, mesh="dp",
                     resources_per_worker={"CPU": 1, "trainer_slot": 1}),
                 run_config=train.RunConfig(
                     name="elastic-down", storage_path=str(tmp_path),
@@ -576,7 +578,7 @@ class TestElasticEndToEnd:
                 train_loop_config={"side_dir": side, "steps": 20,
                                    "step_s": 1.0},
                 scaling_config=train.ScalingConfig(
-                    num_workers=1,
+                    num_workers=1, mesh="dp",
                     resources_per_worker={"CPU": 1, "trainer_slot": 1}),
                 run_config=train.RunConfig(
                     name="elastic-up", storage_path=str(tmp_path),
