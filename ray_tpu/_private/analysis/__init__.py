@@ -1,0 +1,39 @@
+"""raylint — the repo's pluggable AST static-analysis suite.
+
+One engine, many checkers.  Each checker encodes a bug *class* that a
+past PR fixed by hand (thread leaks, unbounded queue puts, blocking
+calls on the event loop, cross-process config reads, …) so the class
+can never regress silently.  See ``docs/static_analysis.md`` for the
+rule catalog and ``raytpu lint`` for the CLI.
+
+Public surface::
+
+    from ray_tpu._private.analysis import run_lint, all_rules
+    result = run_lint(repo_root)            # every registered rule
+    result = run_lint(root, rules=["thread-lifecycle"], paths=["ray_tpu"])
+    result.findings      # unsuppressed — the repo must keep this empty
+    result.suppressed    # carry-a-reason inline waivers
+
+Suppression grammar (same line or the line above)::
+
+    risky_call()  # raylint: disable=<rule>[,<rule>] -- <reason>
+
+A reason is mandatory; a bare ``disable=`` is itself reported under the
+always-on ``suppression-hygiene`` pseudo-rule.
+"""
+
+from ray_tpu._private.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    LintResult,
+    ParsedFile,
+    Project,
+    ProjectChecker,
+    all_rules,
+    get_checkers,
+    register,
+    run_lint,
+)
+
+# importing the package registers every built-in checker
+from ray_tpu._private.analysis import checkers as _checkers  # noqa: E402,F401

@@ -1,0 +1,16 @@
+"""Built-in raylint checkers.  Importing this package registers all of
+them; a new checker only needs a module here with a ``@register`` class
+(see docs/static_analysis.md, "writing a new checker")."""
+
+from ray_tpu._private.analysis.checkers import (  # noqa: F401
+    async_purity,
+    bounded_blocking,
+    collective_supervision,
+    context_capture,
+    fault_sites,
+    lock_discipline,
+    proxy_context,
+    serial_blocking_get,
+    test_hygiene,
+    thread_lifecycle,
+)

@@ -1,0 +1,89 @@
+"""async-purity: no blocking calls inside ``async def`` bodies.
+
+Historical bug class (PR 4 review rounds): a blocking
+``ray_tpu.get``/``time.sleep``/sync socket read inside a serve proxy
+coroutine stalls the whole event loop — every in-flight request on
+that proxy freezes, deadlines expire in bulk, and the admission
+controller sheds traffic the replica could have served.  Scope is the
+event-loop-hosted packages: ``serve/``, ``dashboard/``, ``dag/``.
+
+Flagged inside an ``async def`` (but not inside a nested sync ``def``,
+which runs wherever it is later called — typically an executor):
+
+- ``ray_tpu.get(...)`` — blocks the loop on object-store transfer
+- ``ray_tpu.wait(..., fetch_local=True)`` — same, via payload pulls
+- ``time.sleep(...)`` — use ``await asyncio.sleep``
+- sync socket IO: ``.recv/.recv_into/.sendall/.accept/.connect`` on a
+  receiver whose name mentions sock/conn
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, dotted_name, is_const, keyword_arg,
+    register)
+
+_SOCK_OPS = {"recv", "recv_into", "sendall", "accept", "connect"}
+
+
+def _async_body_calls(fn: ast.AsyncFunctionDef):
+    """Calls in the coroutine itself, skipping nested sync functions."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested defs/lambdas are their own execution context
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class AsyncPurityChecker(Checker):
+    rule = "async-purity"
+    description = ("no blocking ray_tpu.get/wait(fetch_local)/time.sleep/"
+                   "sync socket IO inside async def (event-loop stall "
+                   "guard)")
+    hint = ("await the async variant, or push the blocking call through "
+            "loop.run_in_executor / asyncio.to_thread")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith(
+            ("ray_tpu/serve/", "ray_tpu/dashboard/", "ray_tpu/dag/"))
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(pf.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for call in _async_body_calls(fn):
+                name = dotted_name(call.func)
+                if name == "ray_tpu.get":
+                    out.append(self.finding(
+                        pf, call,
+                        f"blocking ray_tpu.get inside async def "
+                        f"{fn.name} stalls the event loop"))
+                elif name in ("ray_tpu.wait", "wait") and \
+                        is_const(keyword_arg(call, "fetch_local"), True):
+                    out.append(self.finding(
+                        pf, call,
+                        f"ray_tpu.wait(fetch_local=True) inside async def "
+                        f"{fn.name} pulls payloads on the event loop"))
+                elif name == "time.sleep":
+                    out.append(self.finding(
+                        pf, call,
+                        f"time.sleep inside async def {fn.name} — use "
+                        f"await asyncio.sleep"))
+                elif isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in _SOCK_OPS:
+                    recv = dotted_name(call.func.value).lower()
+                    if "sock" in recv or "conn" in recv:
+                        out.append(self.finding(
+                            pf, call,
+                            f"sync socket .{call.func.attr} on {recv!r} "
+                            f"inside async def {fn.name} blocks the loop"))
+        return out

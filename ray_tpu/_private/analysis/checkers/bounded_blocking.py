@@ -1,0 +1,149 @@
+"""bounded-blocking: queue/socket blocking ops must carry a bound.
+
+Historical bug (PR 5): a producer's sentinel ``queue.put(None)`` on a
+bounded queue blocked forever once the consumer was gone, wedging a
+control thread.  The convention since: every ``Queue.put``/``Queue.get``
+on a ``queue.Queue``-family object either passes ``timeout=``, passes
+``block=False`` (or the positional equivalent), or uses the
+``*_nowait`` variant — so no control path can wedge on a peer that died.
+``socket.create_connection`` similarly must carry a timeout.
+
+Detection is type-anchored, not name-anchored: the checker first
+collects every name/attribute the file assigns from a
+``queue.Queue``-family constructor, then flags unbounded ``put``/``get``
+on *those* receivers only.  ``asyncio.Queue`` assignments are excluded —
+awaiting an async queue parks a coroutine, not a thread.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, dotted_name, is_const, keyword_arg,
+    register)
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+
+def _ctor_is_bounded(call: ast.Call) -> bool:
+    """True if the queue was built with a nonzero maxsize — only those
+    can block on ``put``; ``get`` can block on any queue."""
+    size = keyword_arg(call, "maxsize")
+    if size is None and call.args:
+        size = call.args[0]
+    if size is None:
+        return False
+    return not is_const(size, 0)  # dynamic expressions count as bounded
+
+
+def _queue_targets(pf: ParsedFile) -> Dict[Tuple[str, str], bool]:
+    """("self", attr) / ("local", name) -> ctor-was-bounded, for every
+    name the file assigns from a sync queue constructor."""
+    targets: Dict[Tuple[str, str], bool] = {}
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign):
+            value, tgts = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, tgts = node.value, [node.target]
+        else:
+            continue
+        if not isinstance(value, ast.Call):
+            continue
+        f = value.func
+        if isinstance(f, ast.Name):
+            ctor, owner = f.id, ""
+        elif isinstance(f, ast.Attribute):
+            ctor, owner = f.attr, dotted_name(f.value)
+        else:
+            continue
+        if ctor not in _QUEUE_CTORS or owner.startswith("asyncio"):
+            continue
+        bounded = _ctor_is_bounded(value)
+        for tgt in tgts:
+            if isinstance(tgt, ast.Name):
+                key = ("local", tgt.id)
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                key = ("self", tgt.attr)
+            else:
+                continue
+            # a name bound to a bounded queue anywhere stays suspect
+            targets[key] = targets.get(key, False) or bounded
+    return targets
+
+
+def _receiver(call: ast.Call) -> Optional[Tuple[str, str]]:
+    v = call.func.value  # type: ignore[union-attr]
+    if isinstance(v, ast.Name):
+        return ("local", v.id)
+    if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+            and v.value.id == "self":
+        return ("self", v.attr)
+    return None
+
+
+def _is_bounded(call: ast.Call, op: str) -> bool:
+    if keyword_arg(call, "timeout") is not None:
+        return True
+    if is_const(keyword_arg(call, "block"), False):
+        return True
+    # positional block flag: get(block) / put(item, block)
+    block_pos = 0 if op == "get" else 1
+    if len(call.args) > block_pos and is_const(call.args[block_pos], False):
+        return True
+    return False
+
+
+@register
+class BoundedBlockingChecker(Checker):
+    rule = "bounded-blocking"
+    description = ("Queue.put/get and socket.create_connection must pass "
+                   "timeout=/block=False or use *_nowait (hang guard)")
+    hint = ("pass timeout= (then handle queue.Full/Empty), use put_nowait/"
+            "get_nowait, or suppress with the reason the peer provably "
+            "outlives this call")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        queues = _queue_targets(pf)
+        serve_plane = pf.relpath.startswith("ray_tpu/serve/")
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            op = node.func.attr
+            # serve is the latency-critical control plane: every blocking
+            # object-store get there needs a deadline, or a dead
+            # controller wedges router/proxy control threads forever
+            if serve_plane and dotted_name(node.func) == "ray_tpu.get" \
+                    and keyword_arg(node, "timeout") is None:
+                out.append(self.finding(
+                    pf, node,
+                    "control-plane ray_tpu.get without timeout= in serve/ "
+                    "— a dead peer blocks this control thread forever"))
+                continue
+            if op in ("put", "get"):
+                recv = _receiver(node)
+                if recv is None or recv not in queues:
+                    continue
+                # put can only block on a maxsize queue; get on any
+                if op == "put" and not queues[recv]:
+                    continue
+                if not _is_bounded(node, op):
+                    kind, name = recv
+                    out.append(self.finding(
+                        pf, node,
+                        f"unbounded Queue.{op} on "
+                        f"{'self.' if kind == 'self' else ''}{name} — blocks "
+                        f"its thread forever if the peer is gone"))
+            elif op == "create_connection":
+                if keyword_arg(node, "timeout") is None and \
+                        len(node.args) < 2:
+                    out.append(self.finding(
+                        pf, node,
+                        "socket.create_connection without a timeout — a "
+                        "black-holed peer wedges the caller"))
+        return out

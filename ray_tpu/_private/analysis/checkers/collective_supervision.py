@@ -1,0 +1,152 @@
+"""collective-supervision: every collective op routes through the
+watchdog-instrumented ``SupervisedGroup`` spine.
+
+Migrated from ``tests/test_tooling.py::
+test_every_collective_op_routes_through_supervision`` (PR 3's guard),
+re-expressed over the AST so the linter never imports runtime code.
+A newly added op that skips supervision loses seq numbers, the flight
+recorder, the ``collective.op`` fault site, and abort mapping — i.e. it
+can hang a training job silently, which is the exact failure PR 3
+closed.
+
+Checked invariants:
+
+1. ``SupervisedGroup.<op>`` carries the ``@_supervised`` decorator for
+   every public op;
+2. every ``@abstractmethod`` op on ``BaseGroup`` (minus lifecycle
+   methods) is in the known public-op set — a new backend op must be
+   added to the supervised surface first;
+3. each module-level ``collective.<op>`` dispatches via
+   ``_group_mgr.get(group_name)`` and calls ``.<op>(...)`` on the
+   result;
+4. ``GroupManager.create`` wraps every backend in ``SupervisedGroup``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ray_tpu._private.analysis.core import (
+    Finding, Project, ProjectChecker, call_name, dotted_name, register)
+
+PUBLIC_OPS = ("allreduce", "reduce", "broadcast", "allgather",
+              "reducescatter", "barrier", "send", "recv")
+_LIFECYCLE = {"destroy_group", "abort"}
+
+_SUP = "ray_tpu/util/collective/supervision.py"
+_COLL = "ray_tpu/util/collective/collective.py"
+_BASE = "ray_tpu/util/collective/collective_group/base_collective_group.py"
+
+
+def _class(tree: ast.AST, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _has_decorator(fn, name: str) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(target, ast.Name) and target.id == name:
+            return True
+        if isinstance(target, ast.Attribute) and target.attr == name:
+            return True
+    return False
+
+
+@register
+class CollectiveSupervisionChecker(ProjectChecker):
+    rule = "collective-supervision"
+    description = ("every collective op (public API + BaseGroup surface) "
+                   "must route through SupervisedGroup (watchdog guard)")
+    hint = ("add the op to SupervisedGroup with @_supervised and dispatch "
+            "it via _group_mgr.get(group_name) in collective.py")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        sup, coll, base = (project.file(p) for p in (_SUP, _COLL, _BASE))
+        if sup is None and coll is None and base is None:
+            return []  # collective layer not in the scanned set
+        out: List[Finding] = []
+        for rel, pf in ((_SUP, sup), (_COLL, coll), (_BASE, base)):
+            if pf is None:
+                out.append(self.finding(
+                    rel, 1, "expected collective-layer file is missing "
+                    "from the scanned tree"))
+            elif pf.tree is None:
+                return out  # syntax-error finding already reported
+
+        if sup is not None and sup.tree is not None:
+            cls = _class(sup.tree, "SupervisedGroup")
+            if cls is None:
+                out.append(self.finding(
+                    sup, 1, "SupervisedGroup class is gone — the "
+                    "supervision spine has no wrapper"))
+            else:
+                methods = {n.name: n for n in cls.body if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+                for op in PUBLIC_OPS:
+                    fn = methods.get(op)
+                    if fn is None:
+                        out.append(self.finding(
+                            sup, cls, f"SupervisedGroup.{op} is missing — "
+                            f"the op bypasses supervision"))
+                    elif not _has_decorator(fn, "_supervised"):
+                        out.append(self.finding(
+                            sup, fn, f"SupervisedGroup.{op} lacks "
+                            f"@_supervised (no seq/flight-record/abort "
+                            f"mapping)"))
+
+        if base is not None and base.tree is not None:
+            cls = _class(base.tree, "BaseGroup")
+            if cls is not None:
+                for fn in cls.body:
+                    if not isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    if not _has_decorator(fn, "abstractmethod"):
+                        continue
+                    if fn.name in _LIFECYCLE or fn.name in PUBLIC_OPS:
+                        continue
+                    out.append(self.finding(
+                        base, fn,
+                        f"BaseGroup grew abstract op {fn.name}() that the "
+                        f"supervised surface does not know about"))
+
+        if coll is not None and coll.tree is not None:
+            funcs = {n.name: n for n in coll.tree.body
+                     if isinstance(n, ast.FunctionDef)}
+            for op in PUBLIC_OPS:
+                fn = funcs.get(op)
+                if fn is None:
+                    continue  # not every op needs a module-level alias
+                calls = [n for n in ast.walk(fn)
+                         if isinstance(n, ast.Call)]
+                via_registry = any(
+                    dotted_name(n.func) == "_group_mgr.get" for n in calls)
+                dispatches = any(
+                    isinstance(n.func, ast.Attribute) and n.func.attr == op
+                    for n in calls)
+                if not (via_registry and dispatches):
+                    out.append(self.finding(
+                        coll, fn,
+                        f"collective.{op} does not dispatch via "
+                        f"_group_mgr.get(group_name).{op}(...) — it can "
+                        f"reach an unsupervised backend"))
+            mgr = _class(coll.tree, "GroupManager")
+            create = None
+            if mgr is not None:
+                create = next((n for n in mgr.body if isinstance(
+                    n, ast.FunctionDef) and n.name == "create"), None)
+            if create is None:
+                out.append(self.finding(
+                    coll, 1, "GroupManager.create not found — cannot prove "
+                    "backends are wrapped in SupervisedGroup"))
+            elif not any(isinstance(n, ast.Call)
+                         and call_name(n) == "SupervisedGroup"
+                         for n in ast.walk(create)):
+                out.append(self.finding(
+                    coll, create, "GroupManager.create no longer wraps "
+                    "backends in SupervisedGroup"))
+        return out

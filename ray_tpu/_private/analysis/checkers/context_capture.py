@@ -1,0 +1,89 @@
+"""context-capture: process-local config must be captured at
+construction, not read at use, in code that ships cross-process.
+
+Historical bug (PR 5 review round): ``DataContext`` is process-local —
+an iterator created in the driver but iterated inside a train worker
+read ``DataContext.get_current().lookahead`` *in the worker*, silently
+ignoring the knob the user set in the driver.  The fix pattern: snapshot
+the knob in ``__init__`` (driver side) and carry it with the object.
+
+The checker flags, inside ``ray_tpu/data/`` (excluding ``context.py``,
+which *is* the capture mechanism):
+
+- ``DataContext.get_current()`` inside an instance method other than
+  ``__init__`` — instances are what travel cross-process;
+- ``os.getenv`` / ``os.environ`` reads in the same position.
+
+Module-level functions are driver-side planning code and are exempt.
+Sites that are genuinely driver-side capture points (e.g. a public
+``Dataset`` method that snapshots a knob and hands it to workers) keep
+a suppression whose reason states exactly that — the assumption is
+then written down where it can be reviewed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, dotted_name, register)
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__init_subclass__"}
+
+
+def _enclosing_instance_method(pf: ParsedFile, node: ast.AST):
+    """The method whose body holds ``node``, if that is an instance
+    method of a class (first arg self) and not an exempt constructor."""
+    fn = pf.enclosing_function(node)
+    if fn is None or fn.name in _EXEMPT_METHODS:
+        return None
+    parent = pf.parent(fn)
+    if not isinstance(parent, ast.ClassDef):
+        return None
+    args = fn.args.posonlyargs + fn.args.args
+    if not args or args[0].arg != "self":
+        return None
+    return fn
+
+
+@register
+class ContextCaptureChecker(Checker):
+    rule = "context-capture"
+    description = ("DataContext/env knobs read at use inside data-plane "
+                   "instance methods — capture in __init__ instead "
+                   "(wrong-process-knob guard)")
+    hint = ("snapshot the knob in __init__ (driver side) and read the "
+            "instance attribute here; or suppress with the reason this "
+            "method provably runs in the process that set the knob")
+
+    def applies_to(self, relpath: str) -> bool:
+        return (relpath.startswith("ray_tpu/data/")
+                and relpath != "ray_tpu/data/context.py")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.Call, ast.Subscript)):
+                continue
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name == "DataContext.get_current":
+                    what = "DataContext.get_current()"
+                elif name in ("os.getenv", "os.environ.get"):
+                    what = name
+                else:
+                    continue
+            else:
+                if dotted_name(node.value) != "os.environ":
+                    continue
+                what = "os.environ[...]"
+            fn = _enclosing_instance_method(pf, node)
+            if fn is None:
+                continue
+            out.append(self.finding(
+                pf, node,
+                f"{what} read at use inside instance method {fn.name}() — "
+                f"if this instance ships cross-process the knob is read in "
+                f"the wrong process"))
+        return out

@@ -1,0 +1,87 @@
+"""fault-site-coverage: every ``fault_point("<site>")`` is documented.
+
+Migrated from ``tests/test_tooling.py::
+test_every_fault_injection_site_is_documented`` (PR 1's guard).  The
+fault-injection registry only earns its keep if every site is
+discoverable: each site wired anywhere in the runtime must appear in
+``docs/fault_tolerance.md`` *and* in the site table of
+``ray_tpu/util/fault_injection.py``'s module docstring.
+
+The scan is AST-based (a ``fault_point`` call with a constant-string
+first argument), so string mentions in comments or checker code don't
+count as sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from ray_tpu._private.analysis.core import (
+    Finding, ParsedFile, Project, ProjectChecker, call_name, register)
+
+_FI_MODULE = "ray_tpu/util/fault_injection.py"
+_DOC = "docs/fault_tolerance.md"
+
+
+def _sites(project: Project) -> Dict[str, Tuple[ParsedFile, ast.Call]]:
+    found: Dict[str, Tuple[ParsedFile, ast.Call]] = {}
+    for rel, pf in sorted(project.files.items()):
+        if pf.tree is None or rel.startswith("tests/"):
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) == "fault_point" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                found.setdefault(node.args[0].value, (pf, node))
+    return found
+
+
+@register
+class FaultSiteCoverageChecker(ProjectChecker):
+    rule = "fault-site-coverage"
+    description = ("every fault_point(<site>) must be documented in "
+                   "docs/fault_tolerance.md and the fault_injection "
+                   "docstring site table")
+    hint = ("add the site to the table in docs/fault_tolerance.md and to "
+            "the module docstring of ray_tpu/util/fault_injection.py")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        fi = project.file(_FI_MODULE)
+        out: List[Finding] = []
+        sites = _sites(project)
+        if not sites:
+            if fi is not None:
+                out.append(self.finding(
+                    fi, 1, "no fault_point(...) sites found anywhere — "
+                    "the site scan is broken"))
+            return out  # no sites, no registry: rule inapplicable
+
+        # sites exist: the registry module and the docs page are both
+        # required — a moved/renamed registry must not silently disable
+        # the whole rule (the old test_tooling guard failed loudly)
+        docstring = None
+        if fi is None:
+            out.append(self.finding(
+                _FI_MODULE, 1, "fault_point sites exist but the "
+                "fault-injection registry module is missing from the "
+                "scanned tree"))
+        elif fi.tree is not None:
+            docstring = ast.get_docstring(fi.tree) or ""
+        doc = project.read_text(_DOC)
+        if doc is None:
+            out.append(self.finding(
+                _DOC, 1, "docs/fault_tolerance.md is missing — fault "
+                "sites have nowhere to be documented"))
+        for site in sorted(sites):
+            pf, node = sites[site]
+            if doc is not None and site not in doc:
+                out.append(self.finding(
+                    pf, node, f"fault site {site!r} is not documented in "
+                    f"{_DOC}"))
+            if docstring is not None and site not in docstring:
+                out.append(self.finding(
+                    pf, node, f"fault site {site!r} is missing from the "
+                    f"{_FI_MODULE} module docstring site table"))
+        return out

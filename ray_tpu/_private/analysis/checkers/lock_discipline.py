@@ -1,0 +1,174 @@
+"""lock-discipline: state shared between a thread loop and public
+methods must be mutated under a common lock.
+
+Historical bug class (PRs 3–5): router/watchdog/prefetcher-shaped
+classes — an instance that starts a background thread and also exposes
+public methods — raced plain attribute writes between the two sides
+(e.g. a watcher loop updating replica tables while ``refresh()`` swaps
+them).  This is a lightweight static race detector for exactly that
+shape:
+
+- a class is a *candidate* only if it constructs a ``threading.Thread``
+  itself (classes that never start threads are skipped);
+- *thread-side* methods are the thread targets (``target=self._m``)
+  plus everything they reach through ``self.<m>()`` calls, plus
+  ``run``;
+- *external-side* methods are the public (non-underscore) methods;
+- an instance attribute mutated on both sides (outside ``__init__``)
+  must have **every** mutation site inside a ``with self.<lock>:``
+  block, where ``<lock>`` was assigned from
+  ``threading.Lock/RLock/Condition``.
+
+Single-word/GIL-atomic flags that are deliberately lock-free get a
+suppression with that reason — the point is that the assumption is
+written down at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, call_name, dotted_name, register)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'attr' for a ``self.attr`` node, else ''."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+class _ClassModel:
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs: Set[str] = set()
+        self.thread_entries: Set[str] = set()
+        self.starts_thread = False
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "Thread":
+                self.starts_thread = True
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        attr = _self_attr(kw.value)
+                        if attr:
+                            self.thread_entries.add(attr)
+            elif name in _LOCK_CTORS:
+                parent = ParsedFile.parent(node)
+                if isinstance(parent, ast.Assign):
+                    for tgt in parent.targets:
+                        attr = _self_attr(tgt)
+                        if attr:
+                            self.lock_attrs.add(attr)
+        # `run` is a thread entry only for Thread *subclasses* — on a
+        # plain class it's just a public method name
+        subclasses_thread = any(
+            (isinstance(b, ast.Name) and b.id == "Thread")
+            or (isinstance(b, ast.Attribute) and b.attr == "Thread")
+            for b in cls.bases)
+        if subclasses_thread:
+            self.starts_thread = True
+            if "run" in self.methods:
+                self.thread_entries.add("run")
+
+    def thread_side_methods(self) -> Set[str]:
+        """Entry methods plus their self-call closure within the class."""
+        seen: Set[str] = set()
+        work = [m for m in self.thread_entries if m in self.methods]
+        while work:
+            m = work.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            for node in ast.walk(self.methods[m]):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee in self.methods and callee not in seen:
+                        work.append(callee)
+        return seen
+
+
+def _is_locked(pf: ParsedFile, node: ast.AST, locks: Set[str]) -> bool:
+    for anc in pf.ancestors(node):
+        if not isinstance(anc, ast.With):
+            continue
+        for item in anc.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            if _self_attr(expr) in locks:
+                return True
+    return False
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    description = ("attrs mutated by both a background thread and public "
+                   "methods of the same class need a common lock (race "
+                   "guard)")
+    hint = ("wrap both mutation sites in `with self._lock:`, or suppress "
+            "with the reason the write is safe (e.g. GIL-atomic flag, "
+            "happens-before via join)")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(pf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            model = _ClassModel(cls)
+            if not model.starts_thread:
+                continue
+            thread_side = model.thread_side_methods()
+            # attr -> [(method, node, locked)]
+            sites: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+            for mname, meth in model.methods.items():
+                if mname in ("__init__", "__del__"):
+                    continue
+                for node in ast.walk(meth):
+                    targets: List[ast.AST] = []
+                    if isinstance(node, ast.Assign):
+                        targets = node.targets
+                    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [node.target]
+                    elif isinstance(node, ast.Delete):
+                        targets = node.targets
+                    for tgt in targets:
+                        # self.x = v, and container stores self.x[k] = v /
+                        # del self.x[k] — the dominant shared-state shape
+                        store = tgt
+                        if isinstance(store, ast.Subscript):
+                            store = store.value
+                        attr = _self_attr(store)
+                        if not attr or attr in model.lock_attrs:
+                            continue
+                        sites.setdefault(attr, []).append(
+                            (mname, tgt,
+                             _is_locked(pf, tgt, model.lock_attrs)))
+            for attr, entries in sites.items():
+                on_thread = any(m in thread_side for m, _, _ in entries)
+                # a thread *entry* (run, the Thread target) is only ever
+                # called by the thread itself — public name or not
+                on_public = any(
+                    not m.startswith("_") and m not in model.thread_entries
+                    for m, _, _ in entries)
+                if not (on_thread and on_public):
+                    continue
+                for mname, node, locked in entries:
+                    if not locked:
+                        out.append(self.finding(
+                            pf, node,
+                            f"{cls.name}.{attr} is written by both the "
+                            f"background thread and public methods, but "
+                            f"the write in {mname}() holds no lock"))
+        return out

@@ -1,0 +1,121 @@
+"""proxy-request-context: every serve proxy route mints a deadline-
+carrying request context before touching a deployment handle.
+
+Migrated from ``tests/test_tooling.py::
+test_every_proxy_route_mints_request_context`` (PR 4's guard).  A route
+that skips the mint opts out of the whole budget machinery — admission
+control, deadline propagation, cancellation — which is how abandoned
+requests used to pin replicas.
+
+Checked, for each of ``serve/proxy.py`` and ``serve/grpc_proxy.py``:
+
+1. any function that dispatches through a deployment handle
+   (``handle.remote`` / ``handle.remote_streaming``) re-enters a
+   request ``scope(...)`` around the dispatch;
+2. every ``new_request_context(...)`` call passes an explicit
+   ``timeout_s=`` deadline (and each module mints at least once);
+3. each ``handler`` entry point reaches a mint — directly, via
+   ``_mint_context``, or through helpers defined in the same module
+   (the reachability walk follows local calls, so refactoring handler
+   internals into helpers does not defeat the guard).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.analysis.core import (
+    Finding, Project, ProjectChecker, call_name, keyword_arg, register)
+
+_MODULES = ("ray_tpu/serve/proxy.py", "ray_tpu/serve/grpc_proxy.py")
+
+
+@register
+class ProxyRequestContextChecker(ProjectChecker):
+    rule = "proxy-request-context"
+    description = ("serve proxy routes must mint a request context with a "
+                   "deadline before dispatching to a handle (budget guard)")
+    hint = ("mint via new_request_context(..., timeout_s=...) at the route "
+            "entry and wrap handle dispatches in the request scope(...)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        present = [rel for rel in _MODULES if project.file(rel) is not None]
+        if not present:
+            return out  # serve proxy layer not in the scanned set
+        # both proxies ship together: a renamed/deleted sibling must not
+        # silently drop its deadline-mint coverage (the old test_tooling
+        # guard hard-failed on a missing file)
+        for rel in _MODULES:
+            if rel not in present:
+                out.append(self.finding(
+                    rel, 1, "expected proxy module is missing from the "
+                    "scanned tree — its routes have no deadline-mint "
+                    "coverage"))
+        for rel in present:
+            pf = project.file(rel)
+            if pf.tree is None:
+                continue  # syntax-error finding already reported
+            funcs = [n for n in ast.walk(pf.tree) if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            by_name = {f.name: f for f in funcs}
+
+            # (1) handle dispatch only inside a request scope
+            for fn in funcs:
+                dispatches = [
+                    n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in ("remote", "remote_streaming")
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "handle"]
+                if not dispatches:
+                    continue
+                if not any(isinstance(n, ast.Call)
+                           and call_name(n) == "scope"
+                           for n in ast.walk(fn)):
+                    out.append(self.finding(
+                        pf, dispatches[0],
+                        f"{fn.name}() dispatches to a deployment handle "
+                        f"without re-entering the request scope(...)"))
+
+            # (2) every mint carries an explicit deadline
+            mints = [n for n in ast.walk(pf.tree) if isinstance(n, ast.Call)
+                     and call_name(n) == "new_request_context"]
+            if not mints:
+                out.append(self.finding(
+                    pf, 1, "module never mints a RequestContext — its "
+                    "routes run without budgets"))
+            for call in mints:
+                if keyword_arg(call, "timeout_s") is None:
+                    out.append(self.finding(
+                        pf, call, "new_request_context(...) without an "
+                        "explicit timeout_s deadline"))
+
+            # (3) each `handler` entry point reaches a mint
+            def reaches_mint(fn, seen):
+                if fn.name in seen:
+                    return False
+                seen.add(fn.name)
+                for n in ast.walk(fn):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    name = call_name(n)
+                    if name in ("new_request_context", "_mint_context"):
+                        return True
+                    callee = by_name.get(name)
+                    if callee is not None and reaches_mint(callee, seen):
+                        return True
+                return False
+
+            handlers = [f for f in funcs if f.name == "handler"]
+            if not handlers:
+                out.append(self.finding(
+                    pf, 1, "no route handler function found — the route "
+                    "surface moved without updating this rule"))
+            for fn in handlers:
+                if not reaches_mint(fn, set()):
+                    out.append(self.finding(
+                        pf, fn, "route handler never constructs a request "
+                        "context"))
+        return out

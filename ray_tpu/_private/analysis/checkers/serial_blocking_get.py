@@ -1,0 +1,64 @@
+"""serial-blocking-get: the ingest hot path must not regress to one
+blocking ``ray_tpu.get`` per block inside an iteration loop.
+
+Migrated from ``tests/test_tooling.py::
+test_no_serial_blocking_get_in_data_iteration_loops`` (PR 5's guard),
+whose bespoke ``# allowed-blocking-get: <why>`` annotation this rule's
+standard suppression grammar replaces::
+
+    block = ray_tpu.get(ref)  # raylint: disable=serial-blocking-get -- prefetched
+
+Any single-ref ``ray_tpu.get`` inside a for/while loop in
+``data/iterator.py`` or ``data/dataset.py`` is the serial anti-pattern
+the pipelined lookahead replaced (see docs/data_performance.md) unless
+the suppression reason explains why the pull provably started earlier
+(lookahead surface, split request issued one iteration ahead, …).
+Batched gets on a list of refs are fine — that's one round trip.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, dotted_name, register)
+
+_HOT_FILES = ("ray_tpu/data/iterator.py", "ray_tpu/data/dataset.py")
+
+
+@register
+class SerialBlockingGetChecker(Checker):
+    rule = "serial-blocking-get"
+    description = ("no per-block blocking ray_tpu.get inside data "
+                   "iteration loops (serial ingest-stall guard)")
+    hint = ("route the pull through the prefetch lookahead, batch the "
+            "refs, or suppress with the reason the pull provably started "
+            "earlier")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath in _HOT_FILES
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        loops = [n for n in ast.walk(pf.tree)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        seen = set()
+        for loop in loops:
+            for n in ast.walk(loop):
+                if id(n) in seen:
+                    continue
+                if not (isinstance(n, ast.Call)
+                        and dotted_name(n.func) == "ray_tpu.get"):
+                    continue
+                seen.add(id(n))
+                # a list of refs is a batched get, not the serial pattern
+                if n.args and isinstance(n.args[0],
+                                         (ast.List, ast.ListComp)):
+                    continue
+                out.append(self.finding(
+                    pf, n,
+                    "blocking ray_tpu.get on a single ref inside an "
+                    "iteration loop — a per-block serial stall unless the "
+                    "pull started earlier"))
+        return out

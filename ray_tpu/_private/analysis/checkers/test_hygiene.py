@@ -1,0 +1,70 @@
+"""test-hygiene: known test-suite footguns, scoped to ``tests/``.
+
+Two patterns that have each burned a past session:
+
+- **module-level ``@ray_tpu.remote`` functions** — a remote function
+  defined at module import time is pickled against the importing
+  process's module state; under the shared-cluster test fixtures this
+  deadlocks collection-ordered runs (the function resolves against a
+  cluster that isn't the one the test started).  Define remote
+  functions *inside* the test body.
+- **self-matching process kills** — ``pkill -f <pattern>`` style
+  helpers where the pattern can match the test runner itself (pytest's
+  own command line contains the test file's name), killing the suite
+  from inside.  Kill by exact pid instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, dotted_name, register)
+
+_KILL_CMDS = ("pkill", "killall")
+
+
+def _is_remote_decorator(dec: ast.AST) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    return dotted_name(target) == "ray_tpu.remote"
+
+
+@register
+class TestHygieneChecker(Checker):
+    rule = "test-hygiene"
+    description = ("tests must not define module-level @ray_tpu.remote "
+                   "functions (cluster-test hangs) or use self-matching "
+                   "pkill/killall process kills")
+    hint = ("move the remote function inside the test body; kill processes "
+            "by exact pid (os.kill / Popen.kill), never by name pattern")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("tests/")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in pf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_is_remote_decorator(d)
+                            for d in node.decorator_list):
+                out.append(self.finding(
+                    pf, node,
+                    f"module-level @ray_tpu.remote function {node.name} — "
+                    f"resolves against whichever cluster imports it first "
+                    f"and hangs collection-ordered runs"))
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Constant) or \
+                    not isinstance(node.value, str):
+                continue
+            v = node.value
+            if not (v in _KILL_CMDS
+                    or any(v.startswith(c + " ") for c in _KILL_CMDS)):
+                continue
+            if isinstance(pf.parent(node),
+                          (ast.Call, ast.List, ast.Tuple, ast.JoinedStr)):
+                out.append(self.finding(
+                    pf, node,
+                    f"{v.split()[0]} process kill in a test — the pattern "
+                    f"can match the test runner itself"))
+        return out

@@ -1,0 +1,105 @@
+"""thread-lifecycle: every started thread must be daemonized or joined.
+
+Historical bug (PR 5): a prefetch producer thread outlived its consumer
+— the iterator was dropped, the non-daemon thread kept the process (and
+its queue memory) alive forever.  The repo's convention since: a
+``threading.Thread`` is either ``daemon=True`` at construction, later
+marked ``<t>.daemon = True``, or provably ``<t>.join()``-ed from the
+same scope/class that created it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, is_const, keyword_arg, register)
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" \
+            and isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def _assign_target(pf: ParsedFile,
+                   call: ast.Call) -> Optional[Tuple[str, str]]:
+    """("self", attr) / ("local", name) the Thread object is bound to.
+
+    Follows one level of ``t = Thread(...)`` / ``self._t = Thread(...)``;
+    anything fancier (tuple unpack, dict slot) counts as unbound.
+    """
+    parent = pf.parent(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Name):
+            return ("local", tgt.id)
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            return ("self", tgt.attr)
+    return None
+
+
+def _scope_mentions_lifecycle(scope: ast.AST, kind: str, name: str) -> bool:
+    """True if the scope joins the thread or flips it to daemon later."""
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "join":
+            v = n.func.value
+            if kind == "local" and isinstance(v, ast.Name) and v.id == name:
+                return True
+            if kind == "self" and isinstance(v, ast.Attribute) \
+                    and v.attr == name and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                return True
+        if isinstance(n, ast.Assign):
+            for tgt in n.targets:
+                if not (isinstance(tgt, ast.Attribute)
+                        and tgt.attr == "daemon"):
+                    continue
+                v = tgt.value
+                if kind == "local" and isinstance(v, ast.Name) \
+                        and v.id == name and is_const(n.value, True):
+                    return True
+                if kind == "self" and isinstance(v, ast.Attribute) \
+                        and v.attr == name and is_const(n.value, True):
+                    return True
+    return False
+
+
+@register
+class ThreadLifecycleChecker(Checker):
+    rule = "thread-lifecycle"
+    description = ("threading.Thread must be daemon=True or joined/"
+                   "daemon-flipped in the creating scope (leak guard)")
+    hint = ("pass daemon=True, or join the thread from a stop()/close() "
+            "path in the same class")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+                continue
+            if is_const(keyword_arg(node, "daemon"), True):
+                continue
+            bound = _assign_target(pf, node)
+            if bound is None:
+                out.append(self.finding(
+                    pf, node,
+                    "non-daemon Thread started without a handle — it can "
+                    "never be joined and will outlive its owner"))
+                continue
+            kind, name = bound
+            scope = (pf.enclosing_class(node) if kind == "self"
+                     else pf.enclosing_function(node)) or pf.tree
+            if not _scope_mentions_lifecycle(scope, kind, name):
+                where = ("class" if kind == "self" else "function")
+                out.append(self.finding(
+                    pf, node,
+                    f"non-daemon Thread bound to "
+                    f"{'self.' if kind == 'self' else ''}{name} is never "
+                    f"joined or daemonized in the enclosing {where}"))
+        return out

@@ -288,9 +288,8 @@ class Dataset:
             for ref, meta in bundle.blocks:
                 if meta.schema is not None and len(meta.schema.names):
                     return meta.schema
-                # one block of a limit(1) probe, returns immediately —
-                # allowed-blocking-get: not a per-block iteration stall
-                block = ray_tpu.get(ref)
+                # one block of a limit(1) probe, returns immediately
+                block = ray_tpu.get(ref)  # raylint: disable=serial-blocking-get -- limit(1) schema probe, not a per-block iteration stall
                 return block.schema
         return None
 
@@ -384,7 +383,7 @@ class Dataset:
                 if take == meta.num_rows and off == 0:
                     cur.append((ref, meta))
                 else:
-                    # allowed-blocking-get: boundary-block slice metadata
+                    # raylint: disable=serial-blocking-get -- boundary-block slice metadata, at most one per split boundary
                     refs, metas = ray_tpu.get(
                         T.slice_block.remote(ref, off, off + take))
                     cur.append((refs[0], metas[0]))
@@ -419,7 +418,7 @@ class Dataset:
                 f"got {len(locality_hints)}")
         # the skew budget is captured HERE, in the driver: DataContext is
         # process-local and the splitter runs inside the coordinator actor
-        max_skew = DataContext.get_current().locality_split_max_skew_rows
+        max_skew = DataContext.get_current().locality_split_max_skew_rows  # raylint: disable=context-capture -- this IS the driver-side capture point; the snapshot ships to the coordinator actor
         coord = _SplitCoordinator.options(
             max_concurrency=n + 1).remote(self, n, equal, locality_hints,
                                           max_skew)
@@ -432,7 +431,7 @@ class Dataset:
                 # pulling) while rank batches bundle k
                 pending = coord.next_bundle.remote(rank)
                 while True:
-                    # allowed-blocking-get: issued one iteration ahead
+                    # raylint: disable=serial-blocking-get -- split-protocol get on a request issued one iteration ahead
                     bundle = ray_tpu.get(pending)
                     if bundle is None:
                         break

@@ -36,6 +36,11 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 import numpy as np
 import pyarrow as pa
 
+from ray_tpu._private.concurrency import (
+    ProducerDiedError,
+    get_live,
+    put_unless_stopped,
+)
 from ray_tpu.data.block import BlockAccessor, concat_blocks
 from ray_tpu.data.context import DataContext
 
@@ -472,12 +477,16 @@ class _BlockPrefetcher:
     def __iter__(self) -> Iterator[pa.Table]:
         import ray_tpu
 
-        threading.Thread(target=self._run, daemon=True,
-                         name="rtpu-data-lookahead").start()
+        producer = threading.Thread(target=self._run, daemon=True,
+                                    name="rtpu-data-lookahead")
+        producer.start()
         try:
             while True:
                 t0 = time.perf_counter()
-                item = self._q.get()
+                # liveness-checked: a producer that died without its
+                # sentinel surfaces as an error, not a permanent hang
+                item = get_live(self._q, producer,
+                                what="block-prefetch producer")
                 if self._count_blocked:
                     self._stats.add("consumer_blocked_s",
                                     time.perf_counter() - t0)
@@ -490,7 +499,7 @@ class _BlockPrefetcher:
                 # ordered surface of a window-prefetched payload: the pull
                 # started at admission, so this get is (usually) a local
                 # lookup, not a serial cross-node fetch
-                block = ray_tpu.get(ref)  # allowed-blocking-get: prefetched
+                block = ray_tpu.get(ref)  # raylint: disable=serial-blocking-get -- in-order surface of a window-prefetched payload; the pull started at admission
                 fetch_s = time.perf_counter() - t1
                 if self._count_blocked:
                     self._stats.add("consumer_blocked_s", fetch_s)
@@ -519,6 +528,10 @@ class DataIterator:
         ctx = DataContext.get_current()
         self._lookahead_bytes = ctx.iterator_lookahead_bytes
         self._lookahead_max_blocks = ctx.iterator_lookahead_max_blocks
+        # batching knobs travel the same way: iter_batches runs wherever
+        # the consumer lives, and must honor the creating process's tuning
+        self._default_batch_format = ctx.default_batch_format
+        self._prefetch_batches = ctx.prefetch_batches
 
     @property
     def ingest_stats(self) -> IngestStats:
@@ -577,7 +590,7 @@ class DataIterator:
                 return
             for ref, meta in bundle.blocks:
                 t1 = time.perf_counter()
-                block = ray_tpu.get(ref)  # allowed-blocking-get: A/B baseline
+                block = ray_tpu.get(ref)  # raylint: disable=serial-blocking-get -- deliberate serial A/B baseline (lookahead disabled)
                 fetch_s = time.perf_counter() - t1
                 if count_blocked:
                     self._stats.add("consumer_blocked_s", fetch_s)
@@ -595,10 +608,9 @@ class DataIterator:
         prefetch_batches: Optional[int] = None,
         _count_blocked: Optional[bool] = None,
     ) -> Iterator[Any]:
-        ctx = DataContext.get_current()
-        batch_format = batch_format or ctx.default_batch_format
+        batch_format = batch_format or self._default_batch_format
         if prefetch_batches is None:
-            prefetch_batches = ctx.prefetch_batches
+            prefetch_batches = self._prefetch_batches
         stats = self._stats
         # consumer-blocked time is only charged at the outermost
         # consumer-facing stage (the _prefetch buffer when present, else
@@ -673,7 +685,7 @@ class DataIterator:
         overlaps consumer compute on batch i even when batch formation
         is the slow stage.
         """
-        n_prefetch = (DataContext.get_current().prefetch_batches
+        n_prefetch = (self._prefetch_batches
                       if prefetch_batches is None else prefetch_batches)
         n_prefetch = max(1, n_prefetch)
         stats = self._stats
@@ -797,15 +809,11 @@ def _prefetch(it: Iterator[Any], n: int, stats: Optional[IngestStats] = None,
     err: List[BaseException] = []
 
     def put_checked(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                if stats is not None and device_depth:
-                    stats.set_max("device_prefetch_depth", q.qsize())
-                return True
-            except queue.Full:
-                continue
-        return False
+        if not put_unless_stopped(q, item, stop):
+            return False
+        if stats is not None and device_depth:
+            stats.set_max("device_prefetch_depth", q.qsize())
+        return True
 
     def work():
         try:
@@ -830,7 +838,12 @@ def _prefetch(it: Iterator[Any], n: int, stats: Optional[IngestStats] = None,
         try:
             while True:
                 t0 = time.perf_counter()
-                item = q.get()
+                try:
+                    item = get_live(q, t, what="prefetch producer")
+                except ProducerDiedError:
+                    if err:
+                        raise err[0]  # the producer's own failure wins
+                    raise
                 if stats is not None:
                     stats.add("consumer_blocked_s",
                               time.perf_counter() - t0)

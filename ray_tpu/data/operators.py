@@ -98,7 +98,7 @@ class PhysicalOperator:
         return self._out_bytes
 
     def can_accept_input(self) -> bool:
-        ctx = DataContext.get_current()
+        ctx = DataContext.get_current()  # raylint: disable=context-capture -- operators run in the driver's streaming-executor loop, the process that set the knob
         return (self.num_active_tasks() < ctx.max_tasks_in_flight_per_op
                 and self._out_bytes < ctx.max_op_output_queue_bytes)
 
@@ -652,7 +652,7 @@ class OutputSplitter(PhysicalOperator):
         if self._hints is not None:
             pref = self._preferred_output(bundle)
             max_skew = self._max_skew_rows if self._max_skew_rows is not None \
-                else DataContext.get_current().locality_split_max_skew_rows
+                else DataContext.get_current().locality_split_max_skew_rows  # raylint: disable=context-capture -- fallback only; the driver-captured value arrives via _max_skew_rows
             if self._equal:
                 max_skew //= 2
             if pref is not None and \

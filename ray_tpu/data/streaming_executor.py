@@ -23,6 +23,11 @@ import threading
 from typing import Dict, Iterator, List, Optional
 
 import ray_tpu
+from ray_tpu._private.concurrency import (
+    ProducerDiedError,
+    get_live,
+    put_unless_stopped,
+)
 from ray_tpu.data.operators import (
     LimitOperator,
     OutputSplitter,
@@ -73,9 +78,18 @@ class StreamingExecutor:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="rtpu-data-exec")
         self._thread.start()
+        truncated = False
         try:
             while True:
-                item = self._outq.get()
+                try:
+                    # liveness-checked: a control loop that died without
+                    # its sentinel must not hang the consumer (_error
+                    # stays single-writer: only _loop assigns it)
+                    item = get_live(self._outq, self._thread,
+                                    what="streaming-executor control loop")
+                except ProducerDiedError:
+                    truncated = True
+                    break
                 if item is _SENTINEL:
                     break
                 yield item
@@ -83,6 +97,9 @@ class StreamingExecutor:
             self.shutdown()
         if self._error is not None:
             raise self._error
+        if truncated:
+            raise RuntimeError("streaming-executor control loop died "
+                               "without its sentinel; output truncated")
 
     def shutdown(self):
         self._stop.set()
@@ -108,13 +125,7 @@ class StreamingExecutor:
         finally:
             # bounded: an abandoned consumer leaves the queue full and
             # never drains it — a blocking put would leak this thread
-            while True:
-                try:
-                    self._outq.put(_SENTINEL, timeout=0.1)
-                    break
-                except queue.Full:
-                    if self._stop.is_set():
-                        break  # consumer gone; nobody reads the sentinel
+            put_unless_stopped(self._outq, _SENTINEL, self._stop)
 
     def _step(self) -> bool:
         progressed = False
@@ -162,7 +173,7 @@ class StreamingExecutor:
         backpressure-policy seam."""
         from ray_tpu.data.context import DataContext
 
-        ctx = DataContext.get_current()
+        ctx = DataContext.get_current()  # raylint: disable=context-capture -- executor loop runs in the driver; the policy seam is meant to be read here
         select = getattr(ctx, "select_operator_fn", None)
         progressed = False
         while True:

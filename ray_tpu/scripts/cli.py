@@ -308,6 +308,36 @@ def cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def cmd_lint(args):
+    """raylint: AST static analysis over the repo (docs/static_analysis.md).
+
+    Exit-code contract: 0 clean, 1 unsuppressed findings, 2 internal
+    error (unknown rule, unreadable tree, checker crash).
+    """
+    try:
+        from ray_tpu._private.analysis import run_lint
+
+        root = args.root
+        if root is None:
+            # default: the tree containing the installed ray_tpu package
+            import ray_tpu
+
+            root = os.path.dirname(os.path.dirname(
+                os.path.abspath(ray_tpu.__file__)))
+        result = run_lint(root, paths=args.paths or None,
+                          rules=args.rules.split(",") if args.rules
+                          else None)
+    except Exception as e:  # noqa: BLE001 — contract: internal error -> 2
+        print(f"raylint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        sys.exit(2)
+    if args.format == "json":
+        print(result.to_json())
+    else:
+        print(result.render_human())
+    sys.exit(0 if result.clean else 1)
+
+
 def _default_address() -> str:
     if os.environ.get("RAY_TPU_ADDRESS"):
         return os.environ["RAY_TPU_ADDRESS"]
@@ -391,6 +421,20 @@ def main(argv=None):
 
     p = sub.add_parser("dashboard", help="print the dashboard URL")
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("lint", help="run the raylint static-analysis "
+                                    "suite (0 clean / 1 findings / "
+                                    "2 internal error)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan, relative to --root "
+                        "(default: ray_tpu tests bench.py)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the tree containing the "
+                        "ray_tpu package)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--format", choices=["human", "json"], default="human")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("timeline", help="export chrome://tracing timeline")
     p.add_argument("--output", default="timeline.json")

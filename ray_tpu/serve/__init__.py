@@ -135,7 +135,8 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default"
 def get_app_handle(name: str = "default") -> DeploymentHandle:
     from ray_tpu.serve.controller import get_controller
 
-    ingress = ray_tpu.get(get_controller().get_app_ingress.remote(name))
+    ingress = ray_tpu.get(get_controller().get_app_ingress.remote(name),
+                          timeout=30)
     if ingress is None:
         raise RuntimeError(f"no application named {name!r}")
     return DeploymentHandle(ingress)
@@ -144,13 +145,15 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
 def status() -> Dict[str, Any]:
     from ray_tpu.serve.controller import get_controller
 
-    return ray_tpu.get(get_controller().list_deployments.remote())
+    return ray_tpu.get(get_controller().list_deployments.remote(),
+                       timeout=30)
 
 
 def delete(deployment_name: str):
     from ray_tpu.serve.controller import get_controller
 
-    ray_tpu.get(get_controller().delete_deployment.remote(deployment_name))
+    ray_tpu.get(get_controller().delete_deployment.remote(deployment_name),
+                timeout=60)
 
 
 def shutdown():

@@ -206,7 +206,10 @@ class ServeController:
                 return False
             st["config"]["user_config"] = user_config
             replicas = list(st["replicas"])
-        ray_tpu.get([r.reconfigure.remote(user_config) for r in replicas])
+        # bounded: a wedged replica must not hang the controller's RPC
+        # thread — it will be replaced by the health checker instead
+        ray_tpu.get([r.reconfigure.remote(user_config) for r in replicas],
+                    timeout=30)
         return True
 
     # -- reconciliation ------------------------------------------------------

@@ -235,7 +235,14 @@ class ProxyActor:
             return
         from ray_tpu.serve.controller import get_controller
 
-        self._routes = ray_tpu.get(get_controller().get_routes.remote())
+        # bounded + degrade-to-stale: a hung controller must cost at most
+        # one short stall per refresh window, not wedge route resolution
+        # (and the executor thread running it) forever
+        try:
+            self._routes = ray_tpu.get(
+                get_controller().get_routes.remote(), timeout=5)
+        except Exception:  # noqa: BLE001 — keep serving the stale table
+            pass
         self._routes_at = now
 
     def _resolve(self, path: str) -> Optional[str]:

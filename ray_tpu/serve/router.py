@@ -141,8 +141,11 @@ class Router:
         return self._overload
 
     def refresh(self):
+        # bounded: refresh runs on dispatch/watcher control threads — a
+        # dead controller must surface as an error, not a permanent hang
         info = ray_tpu.get(
-            self._controller.get_deployment_info.remote(self._deployment))
+            self._controller.get_deployment_info.remote(self._deployment),
+            timeout=30)
         if info is None:
             raise KeyError(f"no deployment {self._deployment!r}")
         with self._lock:
@@ -164,11 +167,16 @@ class Router:
             self._last_version_check = now
         try:
             v = ray_tpu.get(
-                self._controller.get_version.remote(self._deployment))
+                self._controller.get_version.remote(self._deployment),
+                timeout=5)
         except Exception:
             return
         if v != self._version:
-            self.refresh()
+            try:
+                self.refresh()
+            except TimeoutError:
+                return  # opportunistic refresh: the next interval retries
+
         self._report_overload()
 
     def _report_overload(self):
@@ -687,7 +695,9 @@ class DeploymentStreamingResponse:
         import ray_tpu
 
         for ref in self._gen:
-            yield ray_tpu.get(ref)
+            # consumer-facing streaming iterator: blocking for the next
+            # yielded value on the caller's own thread IS the API
+            yield ray_tpu.get(ref)  # raylint: disable=bounded-blocking -- caller-thread streaming consumption, not a control thread; replica death resolves the ref with an error
 
     @property
     def ref_generator(self):

@@ -12,7 +12,20 @@ import queue
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu._private.concurrency import (
+    ProducerDiedError,
+    get_live,
+    put_unless_stopped,
+)
+
 _session = threading.local()
+
+
+class _TrialAbandoned(BaseException):
+    """Unwinds a function trainable whose trial was cleaned up mid-report.
+
+    BaseException so a user fn's ``except Exception`` can't swallow the
+    unwind and keep computing into an abandoned rendezvous."""
 
 
 def report(metrics: Optional[Dict[str, Any]] = None, *,
@@ -30,7 +43,15 @@ def report(metrics: Optional[Dict[str, Any]] = None, *,
             return
         raise RuntimeError("tune.report() called outside a trial")
     metrics = dict(metrics or {}, **kw)
-    q.put(("report", metrics, checkpoint))
+    # the session always wires an abandonment event next to the queue;
+    # the fallback Event keeps a mis-wired session on the bounded-poll
+    # path rather than reintroducing an unbounded rendezvous put
+    abandoned = getattr(_session, "abandoned", None) or threading.Event()
+    if not put_unless_stopped(q, ("report", metrics, checkpoint),
+                              abandoned):
+        # the maxsize-1 rendezvous was abandoned (nobody steps again):
+        # unwind the fn instead of wedging its thread forever
+        raise _TrialAbandoned("trial cleaned up; stop reporting")
 
 
 def get_checkpoint() -> Optional[Dict[str, Any]]:
@@ -80,6 +101,7 @@ class FunctionTrainable(Trainable):
                  checkpoint: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._abandoned = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._restored = checkpoint
@@ -90,19 +112,36 @@ class FunctionTrainable(Trainable):
         def run():
             _session.queue = self._q
             _session.checkpoint = self._restored
+            _session.abandoned = self._abandoned
             try:
                 self._fn(config)
+            except _TrialAbandoned:
+                pass  # cleanup() unwound a mid-report fn; not an error
             except BaseException as e:  # noqa: BLE001
                 self._error = e
             finally:
-                self._q.put(FunctionTrainable._DONE)
+                # bounded: the rendezvous queue holds one item — if the
+                # trial was abandoned (nobody steps again), a blocking
+                # put would wedge this thread forever holding the fn's
+                # frame alive (the PR 5 sentinel-put hang class)
+                put_unless_stopped(self._q, FunctionTrainable._DONE,
+                                   self._abandoned)
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="tune-fn-trainable")
         self._thread.start()
 
     def step(self) -> Dict[str, Any]:
-        item = self._q.get()
+        try:
+            # liveness-checked: the fn thread's finally always posts
+            # _DONE, so truncation means it was killed hard — surface
+            # that instead of hanging
+            item = get_live(self._q, self._thread, what="tune function")
+        except ProducerDiedError:
+            if self._error is not None:
+                raise self._error
+            raise RuntimeError(
+                "tune function thread died without reporting")
         if item is FunctionTrainable._DONE:
             if self._error is not None:
                 raise self._error
@@ -121,4 +160,5 @@ class FunctionTrainable(Trainable):
         return self.latest_checkpoint
 
     def cleanup(self):
-        pass
+        # unblocks a fn thread parked in its sentinel-put retry loop
+        self._abandoned.set()

@@ -123,7 +123,9 @@ class _LeaderServer:
         # interleave inside sendall and corrupt the length-prefixed frame
         # stream mid-message
         self._send_locks: Dict[int, threading.Lock] = {}
-        self._stop = False
+        # Event, not a bare bool: the cross-thread stop signal gets
+        # explicit memory-visibility semantics (lock-discipline rule)
+        self._stop = threading.Event()
         self._abort: Optional[str] = None
         self._threads: List[threading.Thread] = []
         self._accept_thread = threading.Thread(
@@ -138,7 +140,7 @@ class _LeaderServer:
     def _accept_loop(self):
         # accept until shutdown (not a fixed count): a stale-epoch joiner
         # must not consume a legitimate member's slot
-        while not self._stop:
+        while not self._stop.is_set():
             try:
                 conn, _ = self.sock.accept()
             except OSError:
@@ -174,7 +176,7 @@ class _LeaderServer:
             # blocking reads once the member proved itself
             conn.settimeout(None)
             self._send_to(rank, conn, {"ok": True, "epoch": self.epoch})
-            while not self._stop:
+            while not self._stop.is_set():
                 fault_point("collective.leader.recv")
                 msg = _recv_msg(conn)
                 kind = msg["kind"]
@@ -188,7 +190,7 @@ class _LeaderServer:
                 elif kind == "recv":
                     key = (msg["src"], rank, msg.get("tag", 0))
                     with self._cv:
-                        while (not self._mailbox.get(key) and not self._stop
+                        while (not self._mailbox.get(key) and not self._stop.is_set()
                                and not self._abort):
                             self._cv.wait(timeout=1.0)
                         if self._abort:
@@ -199,7 +201,7 @@ class _LeaderServer:
                 elif kind == "shutdown":
                     return
         except (ConnectionError, OSError, EOFError):
-            if rank is not None and not self._stop and self._abort is None:
+            if rank is not None and not self._stop.is_set() and self._abort is None:
                 self.abort(self._conn_loss_diag(rank))
             return
 
@@ -258,7 +260,7 @@ class _LeaderServer:
                         abort_diag = diag
                         notify_abort = True
                 else:
-                    while (seq not in self._results and not self._stop
+                    while (seq not in self._results and not self._stop.is_set()
                            and not self._abort):
                         self._cv.wait(timeout=1.0)
                     if self._abort:
@@ -318,11 +320,11 @@ class _LeaderServer:
         """Abort when the oldest pending seq outlives timeout_s, naming
         the lagging rank(s) that never submitted it."""
         tick = max(0.1, min(0.5, self.timeout_s / 4.0))
-        while not self._stop and self._abort is None:
+        while not self._stop.is_set() and self._abort is None:
             time.sleep(tick)
             diag = None
             with self._lock:
-                if self._stop or self._abort or not self._pending_t0:
+                if self._stop.is_set() or self._abort or not self._pending_t0:
                     continue
                 seq = min(self._pending_t0)
                 age = time.time() - self._pending_t0[seq]
@@ -398,7 +400,7 @@ class _LeaderServer:
         raise ValueError(f"unknown collective op {op}")
 
     def shutdown(self):
-        self._stop = True
+        self._stop.set()
         with self._cv:
             self._cv.notify_all()
         try:

@@ -34,24 +34,36 @@ def cluster():
         ray_tpu.init(num_cpus=16, num_tpus=0)
 
 
-@ray_tpu.remote
-def _whereami():
-    return ray_tpu.get_runtime_context().get_node_id()
+# remote functions are built INSIDE each test (raylint: test-hygiene):
+# a module-level @ray_tpu.remote def binds to whichever cluster imports
+# it first and hangs collection-ordered runs; the factories below close
+# over local defs so cloudpickle ships them by value, not by reference
+# to this (worker-unimportable) test module
+def _whereami_fn():
+    def _whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    return ray_tpu.remote(_whereami)
 
 
-@ray_tpu.remote
-def _make_blob(mb):
-    # > inline threshold: forces the plasma / shared-memory object path
-    return np.ones((mb * 1024 * 1024 // 8,), np.float64)
+def _make_blob_fn():
+    def _make_blob(mb):
+        # > inline threshold: forces the plasma / shared-memory path
+        return np.ones((mb * 1024 * 1024 // 8,), np.float64)
+
+    return ray_tpu.remote(_make_blob)
 
 
-@ray_tpu.remote
-def _checksum(arr):
-    return float(arr.sum())
+def _checksum_fn():
+    def _checksum(arr):
+        return float(arr.sum())
+
+    return ray_tpu.remote(_checksum)
 
 
 def test_tasks_spread_across_nodes(cluster):
     c, n1, n2 = cluster
+    _whereami = _whereami_fn()
     nodes = {n["node_id"] for n in ray_tpu.nodes() if n["alive"]}
     assert len(nodes) == 3
     seen = set(ray_tpu.get([
@@ -63,6 +75,7 @@ def test_tasks_spread_across_nodes(cluster):
 
 def test_node_affinity_pins_task(cluster):
     c, n1, n2 = cluster
+    _whereami = _whereami_fn()
     out = ray_tpu.get(_whereami.options(
         scheduling_strategy=NodeAffinitySchedulingStrategy(
             node_id=n1.node_id, soft=False)).remote())
@@ -71,6 +84,7 @@ def test_node_affinity_pins_task(cluster):
 
 def test_custom_resource_routes_to_owning_node(cluster):
     c, n1, n2 = cluster
+    _whereami = _whereami_fn()
     outs = ray_tpu.get([
         _whereami.options(resources={"special": 1.0}).remote()
         for _ in range(4)
@@ -83,6 +97,8 @@ def test_cross_node_object_transfer(cluster):
     pull the plasma object across the node boundary; the driver then pulls
     the (small) checksum and the large blob itself."""
     c, n1, n2 = cluster
+    _make_blob = _make_blob_fn()
+    _checksum = _checksum_fn()
     blob = _make_blob.options(
         scheduling_strategy=NodeAffinitySchedulingStrategy(
             node_id=n1.node_id, soft=False)).remote(4)
@@ -255,6 +271,7 @@ def test_separate_session_get_uses_same_host_handoff():
                    separate_session=True)
         c.wait_for_nodes()
 
+        _make_blob = _make_blob_fn()
         blob = _make_blob.options(resources={"side": 1.0}).remote(4)
         arr = ray_tpu.get(blob, timeout=120)
         assert arr.shape[0] == 4 * 1024 * 1024 // 8

@@ -627,200 +627,8 @@ def test_memory_summary(ray_start):
     ray_tpu.kill(h)
 
 
-def test_every_fault_injection_site_is_documented():
-    """Tooling guard: every ``fault_point("<site>")`` wired anywhere in
-    the codebase must appear in docs/fault_tolerance.md (and in the
-    fault_injection module's own site table), so injection sites cannot
-    silently go undocumented."""
-    import re
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pat = re.compile(r"""fault_point\(\s*["']([^"']+)["']\s*\)""")
-    sites = set()
-    roots = [os.path.join(repo, "ray_tpu"), os.path.join(repo, "bench.py")]
-    for root in roots:
-        if os.path.isfile(root):
-            sites.update(pat.findall(open(root).read()))
-            continue
-        for dirpath, _dirs, files in os.walk(root):
-            for name in files:
-                if name.endswith(".py"):
-                    with open(os.path.join(dirpath, name)) as f:
-                        sites.update(pat.findall(f.read()))
-    assert sites, "no fault_point sites found — the scan is broken"
-
-    docs = open(os.path.join(repo, "docs", "fault_tolerance.md")).read()
-    undocumented = sorted(s for s in sites if s not in docs)
-    assert not undocumented, (
-        f"fault injection sites missing from docs/fault_tolerance.md: "
-        f"{undocumented}")
-
-    module_doc = __import__("ray_tpu.util.fault_injection",
-                            fromlist=["x"]).__doc__
-    missing = sorted(s for s in sites if s not in module_doc)
-    assert not missing, (
-        f"sites missing from fault_injection module docstring: {missing}")
-
-
-def test_every_proxy_route_mints_request_context():
-    """Tooling guard: every proxy route (HTTP and gRPC) must construct a
-    request context WITH A DEADLINE before touching a deployment handle,
-    so a future route can't silently opt out of the budget machinery.
-
-    Enforced structurally: (1) any function in a proxy module that
-    dispatches through a handle (``handle.remote`` /
-    ``handle.remote_streaming``) must re-enter a request ``scope(...)``
-    around the dispatch; (2) each proxy module mints contexts only via
-    ``new_request_context`` and always passes ``timeout_s``; (3) each
-    route-handler entry point calls the mint."""
-    import ast
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-    def call_name(node):
-        if isinstance(node.func, ast.Name):
-            return node.func.id
-        if isinstance(node.func, ast.Attribute):
-            return node.func.attr
-        return ""
-
-    for mod in ("proxy.py", "grpc_proxy.py"):
-        path = os.path.join(repo, "ray_tpu", "serve", mod)
-        tree = ast.parse(open(path).read())
-        funcs = [n for n in ast.walk(tree)
-                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-
-        # (1) every handle dispatch sits inside a request scope
-        for fn in funcs:
-            dispatches = [
-                n for n in ast.walk(fn) if isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)
-                and n.func.attr in ("remote", "remote_streaming")
-                and isinstance(n.func.value, ast.Name)
-                and n.func.value.id == "handle"]
-            if not dispatches:
-                continue
-            scopes = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
-                      and call_name(n) == "scope"]
-            assert scopes, (
-                f"{mod}:{fn.name} dispatches to a deployment handle "
-                f"without re-entering the request scope(...)")
-
-        # (2) every mint carries a deadline (timeout_s=...)
-        mints = [n for n in ast.walk(tree) if isinstance(n, ast.Call)
-                 and call_name(n) == "new_request_context"]
-        assert mints, f"{mod} never mints a RequestContext"
-        for call in mints:
-            assert any(kw.arg == "timeout_s" for kw in call.keywords), (
-                f"{mod}:{call.lineno} new_request_context(...) without an "
-                f"explicit timeout_s deadline")
-
-        # (3) each route-handler entry point performs the mint: the
-        # aiohttp/grpc `handler` coroutines reach a mint call either
-        # directly, via the module's _mint_context helper, or through a
-        # helper function defined in the same module (the reachability
-        # walk follows local calls so refactoring handler internals into
-        # helpers doesn't defeat the guard)
-        by_name = {f.name: f for f in funcs}
-
-        def reaches_mint(fn, seen):
-            if fn.name in seen:
-                return False
-            seen.add(fn.name)
-            for n in ast.walk(fn):
-                if not isinstance(n, ast.Call):
-                    continue
-                name = call_name(n)
-                if name in ("new_request_context", "_mint_context"):
-                    return True
-                callee = by_name.get(name)
-                if callee is not None and reaches_mint(callee, seen):
-                    return True
-            return False
-
-        handler_fns = [f for f in funcs if f.name == "handler"]
-        assert handler_fns, f"{mod} has no route handler function"
-        for fn in handler_fns:
-            assert reaches_mint(fn, set()), (
-                f"{mod}:{fn.name} route handler never constructs a "
-                f"request context")
-
-
-def test_every_collective_op_routes_through_supervision():
-    """Tooling guard: every public collective op — the module-level API
-    AND the full BaseGroup op surface — must route through the
-    watchdog-instrumented ``SupervisedGroup`` path (seq numbers, flight
-    recorder, ``collective.op`` fault site, abort mapping), so a newly
-    added op can't silently skip supervision."""
-    import inspect
-
-    from ray_tpu.util.collective import collective as coll_mod
-    from ray_tpu.util.collective.collective_group.base_collective_group \
-        import BaseGroup
-    from ray_tpu.util.collective.supervision import SupervisedGroup
-
-    public_ops = ("allreduce", "reduce", "broadcast", "allgather",
-                  "reducescatter", "barrier", "send", "recv")
-    # the abstract backend surface must be covered too — a new BaseGroup
-    # op without a supervised wrapper fails here before it ships
-    backend_ops = {n for n in BaseGroup.__abstractmethods__
-                   if n not in ("destroy_group", "abort")}
-    assert backend_ops <= set(public_ops), (
-        f"BaseGroup grew op(s) {backend_ops - set(public_ops)} that the "
-        f"public API / this guard don't know about")
-
-    for op in public_ops:
-        meth = inspect.getattr_static(SupervisedGroup, op)
-        assert getattr(meth, "__supervised__", False), (
-            f"SupervisedGroup.{op} is not routed through the supervision "
-            f"spine (missing @_supervised)")
-        # the module-level function dispatches to the registry's group
-        # object — which GroupManager.create always wraps
-        src = inspect.getsource(getattr(coll_mod, op))
-        assert "_group_mgr.get(group_name)" in src and f".{op}(" in src, (
-            f"collective.{op} does not dispatch via the group registry")
-
-    create_src = inspect.getsource(coll_mod.GroupManager.create)
-    assert "SupervisedGroup(" in create_src, (
-        "GroupManager.create no longer wraps backends in SupervisedGroup")
-
-
-def test_no_serial_blocking_get_in_data_iteration_loops():
-    """Tooling guard: the ingest hot path must never regress to one
-    blocking ``ray_tpu.get`` per block inside an iteration loop — the
-    serial anti-pattern the pipelined lookahead replaced (see
-    docs/data_performance.md).  Any single-ref ``ray_tpu.get`` inside a
-    for/while loop in iterator.py or dataset.py must carry an explicit
-    ``allowed-blocking-get`` annotation (same line or the line above)
-    explaining why it is not a serial stall — e.g. the lookahead's
-    in-order surface of an already-prefetched payload, or the split
-    protocol's get on a request issued one iteration ahead."""
-    import ast
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for mod in ("iterator.py", "dataset.py"):
-        path = os.path.join(repo, "ray_tpu", "data", mod)
-        src = open(path).read()
-        lines = src.splitlines()
-        tree = ast.parse(src)
-        loops = [n for n in ast.walk(tree)
-                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
-        for loop in loops:
-            for n in ast.walk(loop):
-                if not (isinstance(n, ast.Call)
-                        and isinstance(n.func, ast.Attribute)
-                        and n.func.attr == "get"
-                        and isinstance(n.func.value, ast.Name)
-                        and n.func.value.id == "ray_tpu"):
-                    continue
-                # lists of refs are a batched get, not the serial pattern
-                if n.args and isinstance(n.args[0], (ast.List, ast.ListComp)):
-                    continue
-                context = "\n".join(
-                    lines[max(0, n.lineno - 2):n.lineno])
-                assert "allowed-blocking-get" in context, (
-                    f"{mod}:{n.lineno} blocking ray_tpu.get on a single "
-                    f"ref inside an iteration loop — use the lookahead "
-                    f"path, or annotate the line with "
-                    f"'# allowed-blocking-get: <why>' if the pull "
-                    f"provably started earlier")
+# The ad-hoc AST guards that used to live here — fault-site docs
+# coverage, proxy request-context minting, collective-op supervision,
+# serial blocking gets in data iteration loops — are now raylint
+# checkers (ray_tpu/_private/analysis/, enforced rule-by-rule in
+# tests/test_raylint.py with fixture self-tests each).

@@ -10,14 +10,18 @@ from ray_tpu import workflow
 from ray_tpu.dag import InputNode
 
 
-@ray_tpu.remote
-def _add(x, y):
-    return x + y
+# remote functions are defined INSIDE each test (raylint: test-hygiene):
+# module-level remote defs bind to whichever cluster imports them first,
+# and a module-level plain impl would cloudpickle by reference to this
+# test module, which workers cannot import
+def _dag_fns():
+    def _add(x, y):
+        return x + y
 
+    def _mul(x, k):
+        return x * k
 
-@ray_tpu.remote
-def _mul(x, k):
-    return x * k
+    return ray_tpu.remote(_add), ray_tpu.remote(_mul)
 
 
 @pytest.fixture
@@ -26,6 +30,7 @@ def wf_storage(tmp_path):
 
 
 def test_workflow_run_and_output(ray_start, wf_storage):
+    _add, _mul = _dag_fns()
     with InputNode() as inp:
         dag = _add.bind(_mul.bind(inp, 3), 10)
     out = workflow.run(dag, 5, workflow_id="w1", storage=wf_storage)
@@ -78,6 +83,7 @@ def test_workflow_resume_skips_completed_steps(ray_start, wf_storage):
 
 
 def test_workflow_metadata_counts(ray_start, wf_storage):
+    _add, _mul = _dag_fns()
     with InputNode() as inp:
         dag = _add.bind(inp, 1)
     workflow.run(dag, 1, workflow_id="w3", storage=wf_storage)
