@@ -1,0 +1,449 @@
+"""RLHF chaos crucible: the standing integration scenario under faults.
+
+Drives the end-to-end rollout → reward → update loop
+(``ray_tpu/rl/rlhf.py``) with one deterministic fault per scenario and
+asserts the loop's invariants survived:
+
+- the loop completes every configured iteration;
+- no trajectory batch is ever double-counted
+  (``duplicates_rejected == 0`` and ``consumed + dropped == expected``);
+- consumed weight versions are monotonically non-decreasing;
+- (where armed) the fault actually fired.
+
+Scenarios (``--scenario``; default runs the fast set):
+
+==================  =======================================================
+name                fault
+==================  =======================================================
+``baseline``        none — the loop itself
+``publish_fault``   retryable fault at ``rl.weight_sync.publish`` (the
+                    torn-publish seam: version commits only after payload)
+``reward_fault``    retryable fault at ``rl.reward.score``
+``rollout_kill``    SIGKILL one rollout actor with its sample in flight
+                    (drop accounting + bounded respawn)
+``rollout_hang``    ``delay`` kind at ``rl.rollout.sample`` — a hung
+                    generator is cancelled at the sample deadline
+``rollout_sigkill`` ``sigkill`` kind at ``rl.rollout.sample`` — a real
+                    mid-sample process death in every rollout actor
+``gcs_flake``       retryable faults at the existing ``gcs_store.call``
+                    site while the loop runs (control-plane chaos)
+``serve_reward``    reward model hosted behind serve; a fault at the
+                    existing ``serve.router.assign`` site is absorbed by
+                    the serving layer's own retry
+``drain``           drain the node hosting the train worker mid-epoch:
+                    checkpoint → elastic restart → publication resumes
+                    above the committed version (multi-node; slow)
+``collective``      2 train workers; ``delay`` at the existing
+                    ``collective.op`` site aborts the supervised group →
+                    controller restarts from the checkpoint (slow)
+==================  =======================================================
+
+Usage::
+
+    python benchmarks/rlhf_chaos.py                 # fast set
+    python benchmarks/rlhf_chaos.py --scenario drain
+    python benchmarks/rlhf_chaos.py --all           # everything (slow)
+
+Each scenario emits one structured JSON record; the driver exits nonzero
+if any invariant failed.  The slow-marked tests in ``tests/test_rlhf.py``
+call :func:`run_scenario` directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FAST_SCENARIOS = ["baseline", "publish_fault", "reward_fault",
+                  "rollout_kill", "rollout_hang", "gcs_flake"]
+SLOW_SCENARIOS = ["rollout_sigkill", "serve_reward", "drain", "collective"]
+
+
+def _base_config(name: str, **overrides) -> "Any":
+    from ray_tpu.rl.rlhf import RLHFConfig
+
+    kw: Dict[str, Any] = dict(
+        iterations=4, num_rollout_actors=2, rollout_batch=32,
+        learner_batch_size=32, name=name, mesh="dp",
+        sample_timeout_s=20.0, stale_timeout_s=20.0,
+        verify_weights_on_read=True,
+    )
+    kw.update(overrides)
+    return RLHFConfig(**kw)
+
+
+def _check_invariants(result, *, expect_drops: bool = False,
+                      expect_fired: Optional[str] = None,
+                      min_iterations: Optional[int] = None) -> List[str]:
+    """The crucible's acceptance gates; returns human-readable failures."""
+    problems: List[str] = []
+    if result.error is not None:
+        return [f"loop failed: {result.error}"]
+    m = result.metrics or {}
+    want_iters = min_iterations or 0
+    if m.get("training_iteration", 0) < want_iters:
+        problems.append(
+            f"only {m.get('training_iteration')} iterations completed "
+            f"(wanted {want_iters})")
+    if m.get("duplicates_rejected", 0) != 0:
+        problems.append(
+            f"trajectories double-counted: duplicates_rejected="
+            f"{m['duplicates_rejected']}")
+    cv = m.get("consumed_versions", [])
+    if any(a > b for a, b in zip(cv, cv[1:])):
+        problems.append(f"consumed weight versions regressed: {cv}")
+    if m.get("trajectories_consumed", 0) > m.get("trajectories_produced", 0):
+        problems.append("consumed more trajectories than produced")
+    if expect_drops and m.get("trajectories_dropped", 0) < 1:
+        problems.append("expected dropped trajectories, saw none")
+    if expect_fired and m.get(expect_fired, 0) < 1:
+        problems.append(f"fault never fired ({expect_fired}=0)")
+    return problems
+
+
+def _run_loop(cfg, *, max_failures: int = 0):
+    from ray_tpu.rl.rlhf import RLHFLoop
+
+    return RLHFLoop(cfg).run()
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def _scenario_baseline() -> Dict[str, Any]:
+    cfg = _base_config("chaos-baseline")
+    result = _run_loop(cfg)
+    return {"result": result,
+            "problems": _check_invariants(result, min_iterations=4)}
+
+
+def _scenario_publish_fault() -> Dict[str, Any]:
+    cfg = _base_config("chaos-publish", chaos={"publish_fault_at": 2})
+    result = _run_loop(cfg)
+    return {"result": result, "problems": _check_invariants(
+        result, expect_fired="publish_faults_fired", min_iterations=4)}
+
+
+def _scenario_reward_fault() -> Dict[str, Any]:
+    cfg = _base_config("chaos-reward", chaos={"reward_fault_at": 2})
+    result = _run_loop(cfg)
+    return {"result": result, "problems": _check_invariants(
+        result, expect_fired="reward_faults_fired", min_iterations=4)}
+
+
+def _scenario_rollout_kill() -> Dict[str, Any]:
+    cfg = _base_config("chaos-kill", chaos={"kill_rollout_at_iter": 2})
+    result = _run_loop(cfg)
+    return {"result": result, "problems": _check_invariants(
+        result, expect_drops=True, min_iterations=4)}
+
+
+def _env_armed(spec: str):
+    """Context manager: arm the registry via the env for every process
+    the cluster spawns while the scenario runs."""
+    import contextlib
+
+    from ray_tpu.util import fault_injection as fi
+
+    @contextlib.contextmanager
+    def armed():
+        old = os.environ.get(fi.ENV_VAR)
+        os.environ[fi.ENV_VAR] = spec
+        try:
+            yield
+        finally:
+            if old is None:
+                os.environ.pop(fi.ENV_VAR, None)
+            else:
+                os.environ[fi.ENV_VAR] = old
+
+    return armed()
+
+
+def _run_loop_with_armed_cluster(spec: str, cfg):
+    """Env-armed scenarios need the spec in the environment BEFORE the
+    cluster starts: raylet-spawned worker processes inherit the
+    raylet's env, not the driver's, so arming after init never reaches
+    the rollout actors."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    with _env_armed(spec):
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        try:
+            return _run_loop(cfg)
+        finally:
+            ray_tpu.shutdown()
+
+
+def _scenario_rollout_hang() -> Dict[str, Any]:
+    # every rollout actor's 2nd sample hangs for 60s; the 5s sample
+    # deadline cancels it and the iteration proceeds on drop accounting
+    cfg = _base_config("chaos-hang", sample_timeout_s=5.0,
+                      respawn_budget=0, iterations=3)
+    result = _run_loop_with_armed_cluster(
+        "rl.rollout.sample:2:1:delay:60", cfg)
+    return {"result": result, "problems": _check_invariants(
+        result, expect_drops=True, min_iterations=3)}
+
+
+def _scenario_rollout_sigkill() -> Dict[str, Any]:
+    # a REAL mid-sample crash in each actor's 2nd sample
+    cfg = _base_config("chaos-sigkill", iterations=3,
+                      respawn_budget=4)
+    result = _run_loop_with_armed_cluster(
+        "rl.rollout.sample:2:1:sigkill", cfg)
+    return {"result": result, "problems": _check_invariants(
+        result, expect_drops=True, min_iterations=3)}
+
+
+def _scenario_gcs_flake() -> Dict[str, Any]:
+    # control-plane chaos at the existing gcs_store.call site while the
+    # loop runs; the resilience layer's retries absorb it
+    cfg = _base_config("chaos-gcs", iterations=3)
+    result = _run_loop_with_armed_cluster(
+        "gcs_store.call:10:2:connection", cfg)
+    return {"result": result,
+            "problems": _check_invariants(result, min_iterations=3)}
+
+
+def _serve_reward_fn(obs, actions, cfg):
+    """Reward routed through a serve deployment (picklable module-level
+    fn; the handle is resolved inside the train worker)."""
+    from ray_tpu import serve
+
+    handle = serve.get_deployment_handle("rlhf-reward")
+    return handle.remote(obs.tolist(), actions.tolist()).result(timeout=30)
+
+
+def _scenario_serve_reward() -> Dict[str, Any]:
+    import numpy as np
+
+    from ray_tpu import serve
+    from ray_tpu.rl.rlhf import _gold_matrix
+
+    base = _base_config("chaos-serve")
+
+    @serve.deployment(name="rlhf-reward", num_replicas=1)
+    class RewardModel:
+        def __init__(self, gold):
+            self.gold = np.asarray(gold, np.float32)
+
+        def __call__(self, obs, actions):
+            obs = np.asarray(obs, np.float32)
+            actions = np.asarray(actions)
+            gold = np.argmax(obs @ self.gold, axis=-1)
+            return (actions == gold).astype(np.float32)
+
+    serve.run(RewardModel.bind(_gold_matrix(base).tolist()))
+    try:
+        cfg = _base_config("chaos-serve", iterations=3,
+                          reward_fn=_serve_reward_fn)
+        with _env_armed("serve.router.assign:2:1:connection"):
+            result = _run_loop(cfg)
+        return {"result": result,
+                "problems": _check_invariants(result, min_iterations=3)}
+    finally:
+        serve.shutdown()
+
+
+def _scenario_drain(tmp_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Multi-node: drain the node hosting the train worker mid-epoch.
+    The controller checkpoints, restarts the worker off the draining
+    node, and weight publication resumes above the committed version."""
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.state import drain_node, list_actors
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        cluster.add_node(num_cpus=4, resources={"trainer_slot": 2})
+        cluster.add_node(num_cpus=4, resources={"trainer_slot": 2})
+        cluster.wait_for_nodes()
+
+        drained: Dict[str, Any] = {}
+
+        def drainer():
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    actors = list_actors()
+                except Exception:  # noqa: BLE001 — control plane busy
+                    time.sleep(0.3)
+                    continue
+                for a in actors:
+                    if a.get("state") == "ALIVE" and \
+                            "TrainWorker" in (a.get("class_name") or "") \
+                            and a.get("node_id"):
+                        # let it get through iteration ~1 first
+                        time.sleep(3.0)
+                        drained["ack"] = drain_node(
+                            a["node_id"], reason="chaos: spot reclaim",
+                            deadline_s=15.0)
+                        drained["node"] = a["node_id"]
+                        return
+                time.sleep(0.3)
+
+        t = threading.Thread(target=drainer, daemon=True)
+        t.start()
+        storage = tmp_dir or tempfile.mkdtemp(prefix="rlhf-chaos-drain-")
+        from ray_tpu import train
+
+        cfg = _base_config(
+            "chaos-drain", iterations=6, use_channel=False,
+            storage_path=storage, max_failures=2)
+        from ray_tpu.rl.rlhf import RLHFLoop
+
+        run_config = train.RunConfig(
+            name="rlhf-chaos-drain", storage_path=storage,
+            failure_config=train.FailureConfig(max_failures=2))
+        # pin the worker off the head so the drained node never hosts
+        # the driver
+        trainer = train.JaxTrainer(
+            _drain_loop_entry,
+            train_loop_config={"rlhf": _cfg_dict(cfg)},
+            scaling_config=train.ScalingConfig(
+                num_workers=1, mesh=cfg.mesh,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+            run_config=run_config,
+        )
+        result = trainer.fit()
+        t.join(timeout=5)
+        problems = _check_invariants(result, min_iterations=6)
+        if "node" not in drained:
+            problems.append("drainer never found the train worker")
+        elif not drained["ack"].get("accepted"):
+            problems.append(f"drain not accepted: {drained['ack']}")
+        m = result.metrics or {}
+        if not problems and m.get("publisher_epoch", 0) < 1:
+            problems.append(
+                "loop never restarted (publisher epoch still 0) — the "
+                "drain did not exercise the elastic-restart path")
+        return {"result": result, "problems": problems, "drained": drained}
+    finally:
+        cluster.shutdown()
+
+
+def _cfg_dict(cfg) -> Dict[str, Any]:
+    import dataclasses
+
+    return dataclasses.asdict(cfg)
+
+
+def _drain_loop_entry(config):
+    from ray_tpu.rl.rlhf import _rlhf_train_loop
+
+    return _rlhf_train_loop(config)
+
+
+def _scenario_collective() -> Dict[str, Any]:
+    """2 train workers form the supervised collective group; an injected
+    ``delay`` at the existing ``collective.op`` site hangs one allreduce
+    past the watchdog timeout → CollectiveAbortError → controller
+    restart from the checkpoint.  Armed in-process by the last rank's
+    FIRST incarnation only (see RLHFConfig.chaos), so the sequence
+    terminates instead of re-injecting every generation."""
+    cfg = _base_config(
+        "chaos-collective", iterations=4, num_workers=2,
+        num_rollout_actors=1, use_channel=False, max_failures=2,
+        sample_timeout_s=15.0,
+        # op ~20 lands inside iteration 2's allreduce round, after
+        # iteration 1's checkpoint committed
+        chaos={"collective_fault_op": 20})
+    result = _run_loop(cfg)
+    problems = _check_invariants(result, min_iterations=4)
+    m = result.metrics or {}
+    if not problems and m.get("publisher_epoch", 0) < 1:
+        problems.append(
+            "collective abort never restarted the loop (epoch still 0)")
+    return {"result": result, "problems": problems}
+
+
+SCENARIOS = {
+    "baseline": _scenario_baseline,
+    "publish_fault": _scenario_publish_fault,
+    "reward_fault": _scenario_reward_fault,
+    "rollout_kill": _scenario_rollout_kill,
+    "rollout_hang": _scenario_rollout_hang,
+    "rollout_sigkill": _scenario_rollout_sigkill,
+    "gcs_flake": _scenario_gcs_flake,
+    "serve_reward": _scenario_serve_reward,
+    "drain": _scenario_drain,
+    "collective": _scenario_collective,
+}
+
+
+def run_scenario(name: str) -> Dict[str, Any]:
+    """Run one scenario; returns ``{"scenario", "ok", "problems",
+    "metrics", "seconds"}``.  Importable by the slow chaos tests."""
+    import ray_tpu
+
+    t0 = time.perf_counter()
+    # these scenarios manage their own cluster (env-armed specs must be
+    # in the environment before any raylet spawns; drain is multi-node)
+    needs_own_cluster = name in (
+        "drain", "rollout_hang", "rollout_sigkill", "gcs_flake")
+    started_here = False
+    if not needs_own_cluster and not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=8, num_tpus=0)
+        started_here = True
+    try:
+        out = SCENARIOS[name]()
+    finally:
+        if started_here and ray_tpu.is_initialized():
+            ray_tpu.shutdown()
+    result = out["result"]
+    metrics = {k: v for k, v in (result.metrics or {}).items()
+               if isinstance(v, (int, float, str))}
+    return {
+        "scenario": name,
+        "ok": not out["problems"],
+        "problems": out["problems"],
+        "metrics": metrics,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", action="append",
+                    choices=sorted(SCENARIOS), default=None)
+    ap.add_argument("--all", action="store_true",
+                    help="run fast + slow scenarios")
+    args = ap.parse_args()
+    names = (args.scenario or
+             (FAST_SCENARIOS + SLOW_SCENARIOS if args.all
+              else FAST_SCENARIOS))
+    records = []
+    failed = False
+    for name in names:
+        rec = run_scenario(name)
+        records.append(rec)
+        failed = failed or not rec["ok"]
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({
+        "suite": "rlhf_chaos",
+        "scenarios": len(records),
+        "passed": sum(1 for r in records if r["ok"]),
+        "failed": sum(1 for r in records if not r["ok"]),
+    }))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

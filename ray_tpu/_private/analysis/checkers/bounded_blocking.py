@@ -106,24 +106,32 @@ class BoundedBlockingChecker(Checker):
             "get_nowait, or suppress with the reason the peer provably "
             "outlives this call")
 
+    # directories where every blocking ``ray_tpu.get`` must carry a
+    # deadline: serve/ is the latency-critical control plane, and rl/
+    # drives long-lived loops over killable rollout/learner actors (a
+    # bare get on a dead runner froze whole training iterations —
+    # the RLHF-crucible hardening extends serve/'s rule there)
+    _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/")
+
     def check(self, pf: ParsedFile) -> Iterable[Finding]:
         out: List[Finding] = []
         queues = _queue_targets(pf)
-        serve_plane = pf.relpath.startswith("ray_tpu/serve/")
+        deadline_plane = pf.relpath.startswith(self._DEADLINE_DIRS)
         for node in ast.walk(pf.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)):
                 continue
             op = node.func.attr
-            # serve is the latency-critical control plane: every blocking
-            # object-store get there needs a deadline, or a dead
-            # controller wedges router/proxy control threads forever
-            if serve_plane and dotted_name(node.func) == "ray_tpu.get" \
+            # every blocking object-store get in a deadline-required
+            # directory needs a bound, or a dead peer wedges the
+            # calling control thread/loop forever
+            if deadline_plane and dotted_name(node.func) == "ray_tpu.get" \
                     and keyword_arg(node, "timeout") is None:
                 out.append(self.finding(
                     pf, node,
-                    "control-plane ray_tpu.get without timeout= in serve/ "
-                    "— a dead peer blocks this control thread forever"))
+                    f"control-plane ray_tpu.get without timeout= in "
+                    f"{pf.relpath.split('/')[1]}/ — a dead peer blocks "
+                    f"this control thread forever"))
                 continue
             if op in ("put", "get"):
                 recv = _receiver(node)

@@ -702,6 +702,29 @@ class GcsServer:
             "start_time": time.time(),
         }
         self._publish("nodes", {"event": "node_added", "node_id": node_id})
+        # Push the refreshed view to every OTHER raylet now instead of
+        # waiting out their heartbeat period: a raylet whose scheduling
+        # view predates this join would route a whole task burst onto
+        # itself (SPREAD collapsing onto the submitting node — the
+        # test_tasks_spread_across_nodes race).  Best-effort and
+        # detached; the heartbeat reply remains the durable fallback.
+        view = self._cluster_view()
+        for other_id, other in list(self.nodes.items()):
+            if other_id == node_id or not other.get("alive"):
+                continue
+            raylet = self._raylet(other_id)
+            if raylet is None:
+                continue
+
+            async def _push(client=raylet, oid=other_id):
+                try:
+                    await asyncio.wait_for(
+                        client.call("cluster_view_update", nodes=view), 2.0)
+                except Exception:  # noqa: BLE001 — heartbeat covers it
+                    logger.debug("cluster-view push to %s failed; its "
+                                 "next heartbeat will catch up", oid[:8])
+
+            asyncio.ensure_future(_push())
         self._kick_pending()
         return {"ok": True}
 

@@ -909,6 +909,18 @@ class Raylet:
             out.add(self.node_id)
         return out
 
+    async def handle_cluster_view_update(self,
+                                         nodes: List[Dict[str, Any]]) -> bool:
+        """GCS push of the aggregated node view (sent when a node joins,
+        so a scheduling decision made before this raylet's next heartbeat
+        already sees the newcomer — without it, a SPREAD burst submitted
+        right after cluster scale-up lands entirely on the submitting
+        node).  Never regress to a view with fewer nodes: a racing push
+        must not shadow a fresher heartbeat reply."""
+        if len(nodes) >= len(self.cluster_view):
+            self.cluster_view = nodes
+        return True
+
     # ---------------------------------------------------------------- leasing
 
     def _node_views(self) -> List[NodeView]:

@@ -500,6 +500,11 @@ class CompiledDAG:
         # park here until their ref/future claims them
         self._buffered_results: Dict[int, List[Any]] = {}
         self._torn_down = False
+        # a DAG actor observed DEAD mid-execution poisons the pipeline:
+        # every pending/future result raises this instead of hanging on
+        # channels no exec loop will ever write again
+        self._dead_actor_error: Optional[BaseException] = None
+        self._last_liveness_probe = 0.0
         # separate locks: a producer blocked in a backpressured execute()
         # must not prevent a consumer's get() from draining the pipeline
         self._submit_lock = threading.Lock()
@@ -721,10 +726,54 @@ class CompiledDAG:
                 _start_exec_loop, self.dag_id, payload))
         ray_tpu.get(start_refs, timeout=self.submit_timeout)
 
+    # -- liveness ----------------------------------------------------------
+    def _check_actors_alive(self, min_interval_s: float = 0.5) -> None:
+        """Raise ``ActorDiedError`` if any DAG actor's process is gone.
+
+        Called from channel-read timeout slices: a killed actor leaves
+        its output channels unwritten forever, so without this probe a
+        deadline-less ``get()`` hangs and a deadlined one burns its
+        whole budget to report a generic channel timeout.  Probes the
+        GCS actor table, throttled to ``min_interval_s``; the verdict is
+        sticky — once a member is dead the whole pipeline is poisoned
+        (exec-loop iterations cannot be resumed mid-execution)."""
+        if self._dead_actor_error is not None:
+            raise self._dead_actor_error
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._last_liveness_probe < min_interval_s:
+            return
+        self._last_liveness_probe = now
+        from ray_tpu._private import worker as _worker_mod
+
+        w = _worker_mod.global_worker
+        if w is None:
+            return
+        for handle in self._actors:
+            try:
+                info = w.run_coro(w.gcs.call(
+                    "get_actor_info", actor_id=handle._actor_id.binary()))
+            except Exception:  # noqa: BLE001 — GCS hiccup: keep waiting
+                continue
+            if info is not None and info.get("state") == "DEAD":
+                from ray_tpu.exceptions import ActorDiedError
+
+                cause = info.get("death_cause") or "actor process died"
+                self._dead_actor_error = ActorDiedError(
+                    handle._actor_id,
+                    f"compiled DAG actor {handle._class_name} "
+                    f"({handle._actor_id.hex()[:12]}) died mid-execution "
+                    f"({cause}); the DAG cannot make progress — call "
+                    f"teardown() and recompile on live actors")
+                raise self._dead_actor_error
+
     # -- execution ---------------------------------------------------------
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
         if self._torn_down:
             raise RuntimeError("compiled DAG has been torn down")
+        if self._dead_actor_error is not None:
+            raise self._dead_actor_error
         with self._submit_lock:
             self._input_channel.write((args, kwargs),
                                       timeout=self.submit_timeout)
@@ -833,11 +882,28 @@ class CompiledDAG:
                 f"and unclaimed (max_buffered_results="
                 f"{self.max_buffered_results}); get()/await results to "
                 f"drain the pipeline")
+        from ray_tpu.experimental.channel import ChannelTimeoutError
+
         while len(self._partial_values) < len(self._output_channels):
             ch = self._output_channels[len(self._partial_values)]
-            budget = (None if deadline is None
-                      else max(0.0, deadline - time.monotonic()))
-            self._partial_values.append(ch.read(budget))
+            # read in bounded slices with a liveness probe between them:
+            # a killed exec-loop actor never writes its out-edge, and
+            # without the probe a deadline-less get() waits forever (a
+            # deadlined one burns the full budget on a generic channel
+            # timeout instead of naming the dead actor)
+            while True:
+                budget = (None if deadline is None
+                          else max(0.0, deadline - time.monotonic()))
+                slice_budget = 0.25 if budget is None else min(0.25, budget)
+                try:
+                    value = ch.read(slice_budget)
+                    break
+                except ChannelTimeoutError:
+                    self._check_actors_alive()
+                    if budget is not None and \
+                            time.monotonic() >= deadline:
+                        raise
+            self._partial_values.append(value)
         self._buffered_results[self._next_get_idx] = self._partial_values
         self._partial_values = []
         self._next_get_idx += 1

@@ -27,6 +27,21 @@ from ray_tpu.rl.multi_agent_ppo import (
     make_multi_agent_rollout_fn,
 )
 from ray_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
+from ray_tpu.rl.rlhf import (
+    RLHFConfig,
+    RLHFLoop,
+    RolloutActor,
+    RolloutGroup,
+    TrajectoryLedger,
+)
+from ray_tpu.rl.weight_sync import (
+    NoWeightsPublishedError,
+    WeightPublisher,
+    WeightSubscriber,
+    WeightSyncError,
+    WeightVersion,
+    WeightsStaleError,
+)
 
 __all__ = [
     "APPO", "BC", "CQL", "CQLParams", "DQN", "DQNConfig", "DQNParams",
@@ -35,7 +50,11 @@ __all__ = [
     "ReplayBuffer", "PPO", "SAC", "SACConfig", "SACParams",
     "Algorithm", "AlgorithmConfig", "ActorCriticModule",
     "CartPoleEnv", "EnvRunner", "EnvRunnerGroup", "EnvSpec", "GymVectorEnv",
-    "JaxMultiAgentEnv", "JaxVectorEnv", "MultiAgentPPO", "PPOConfig",
-    "PPOLearner", "PursuitTagEnv", "compute_gae",
+    "JaxMultiAgentEnv", "JaxVectorEnv", "MultiAgentPPO",
+    "NoWeightsPublishedError", "PPOConfig",
+    "PPOLearner", "PursuitTagEnv", "RLHFConfig", "RLHFLoop",
+    "RolloutActor", "RolloutGroup", "TrajectoryLedger",
+    "WeightPublisher", "WeightSubscriber", "WeightSyncError",
+    "WeightVersion", "WeightsStaleError", "compute_gae",
     "make_multi_agent_rollout_fn", "make_env", "register_env", "vtrace",
 ]

@@ -7,11 +7,14 @@ they exist for python envs and for scaling rollout collection across hosts.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
+
+logger = logging.getLogger(__name__)
 
 
 @ray_tpu.remote
@@ -92,26 +95,111 @@ class EnvRunner:
 
 
 class EnvRunnerGroup:
-    """N EnvRunner actors + weight broadcast via a shared object ref."""
+    """N EnvRunner actors + weight broadcast via a shared object ref.
+
+    Every blocking wait carries a deadline, and a runner whose process
+    died is respawned (bounded by ``respawn_budget``, re-synced to the
+    last broadcast weights) or — budget exhausted — dropped with a
+    logged count, so one dead host degrades a collection round instead
+    of failing the whole training iteration."""
 
     def __init__(self, env_name: str, num_runners: int, num_envs_per: int,
-                 module_spec: dict, seed: int = 0):
-        self.runners = [
-            EnvRunner.remote(env_name, num_envs_per, module_spec, seed + i)
-            for i in range(num_runners)]
+                 module_spec: dict, seed: int = 0, *,
+                 timeout_s: float = 120.0, respawn_budget: int = 3):
+        from ray_tpu.rl._respawn import RespawnBudget
+
+        self._spawn_args = (env_name, num_envs_per, dict(module_spec))
+        self._seed = seed
+        self._spawned = 0
+        self.timeout_s = timeout_s
+        self._budget = RespawnBudget(respawn_budget, "env runner")
+        self._last_weights_ref = None
+        self.runners = [self._spawn() for _ in range(num_runners)]
+
+    @property
+    def respawns_left(self) -> int:
+        return self._budget.respawns_left
+
+    @property
+    def dropped_runners(self) -> int:
+        return self._budget.dropped
+
+    def _spawn(self):
+        env_name, num_envs_per, module_spec = self._spawn_args
+        self._spawned += 1
+        return EnvRunner.remote(env_name, num_envs_per, dict(module_spec),
+                                self._seed + self._spawned)
+
+    def _settle(self, refs: List[Any], op: str,
+                default: Any = None) -> List[Any]:
+        """Gather one ref per live runner under the group deadline.  A
+        dead runner is replaced (or dropped past the budget) and
+        contributes ``default``; a deadline overrun raises — a hang is
+        the caller's failure to see, not something to eat silently."""
+        import time
+
+        deadline = time.monotonic() + self.timeout_s
+        out: List[Any] = []
+        replaced: List[int] = []
+        try:
+            for i, ref in enumerate(refs):
+                budget = max(0.1, deadline - time.monotonic())
+                try:
+                    out.append(ray_tpu.get(ref, timeout=budget))
+                except ray_tpu.exceptions.GetTimeoutError:
+                    raise TimeoutError(
+                        f"EnvRunnerGroup.{op}: runner {i} exceeded the "
+                        f"{self.timeout_s:.0f}s group deadline")
+                except (ray_tpu.exceptions.ActorError,
+                        ray_tpu.exceptions.TaskError) as e:
+                    logger.warning(
+                        "EnvRunnerGroup.%s: runner %d died (%s)", op, i,
+                        type(e).__name__)
+                    replaced.append(i)
+                    out.append(default)
+        finally:
+            # settle membership even when a deadline overrun aborts the
+            # round — a dead runner detected before the raise must still
+            # be respawned (or dropped with its count), not linger dead
+            if replaced:
+                self._replace(replaced)
+        return [o for o in out if o is not None]
+
+    def _spawn_synced(self):
+        """A replacement runner, re-synced to the last broadcast weights
+        so it contributes from its first round."""
+        runner = self._spawn()
+        if self._last_weights_ref is not None:
+            try:
+                ray_tpu.get(runner.set_weights.remote(
+                    self._last_weights_ref), timeout=self.timeout_s)
+            except Exception:  # noqa: BLE001 — next sync covers it
+                logger.warning(
+                    "EnvRunnerGroup: weight re-sync to respawned runner "
+                    "failed; it syncs on the next broadcast")
+        return runner
+
+    def _replace(self, dead_indices: List[int]) -> None:
+        survivors = [r for i, r in enumerate(self.runners)
+                     if i not in set(dead_indices)]
+        self.runners = self._budget.replace(
+            survivors, len(dead_indices), self._spawn_synced)
 
     def sync_weights(self, params) -> None:
         ref = ray_tpu.put(params)  # one shm copy, all runners attach
-        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+        self._last_weights_ref = ref
+        self._settle([r.set_weights.remote(ref) for r in self.runners],
+                     "sync_weights")
 
     def sample(self, num_steps: int) -> List[Dict[str, Any]]:
-        return ray_tpu.get(
-            [r.sample.remote(num_steps) for r in self.runners])
+        return self._settle(
+            [r.sample.remote(num_steps) for r in self.runners], "sample")
 
     def episode_stats(self) -> List[float]:
         out: List[float] = []
-        for stats in ray_tpu.get(
-                [r.episode_stats.remote() for r in self.runners]):
+        for stats in self._settle(
+                [r.episode_stats.remote() for r in self.runners],
+                "episode_stats"):
             out.extend(stats)
         return out
 

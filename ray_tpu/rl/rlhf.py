@@ -1,0 +1,772 @@
+"""End-to-end RLHF loop: rollout → reward → update, with live weight-sync.
+
+The integration crucible (ROADMAP item 5): every subsystem that survived
+its own chaos rounds composed into one standing workload —
+
+- **rollout**: :class:`RolloutActor` processes host the generation policy
+  and sample trajectory batches (``rl.rollout.sample`` fault site), each
+  holding a :class:`~ray_tpu.rl.weight_sync.WeightSubscriber` so fresh
+  learner weights arrive live (atomic swap, no cold restart);
+- **reward**: trajectories are scored (``rl.reward.score`` fault site) —
+  by the built-in scripted reward model or any picklable callable (the
+  chaos runner routes this through a serve deployment);
+- **ingest**: scored trajectories become a Ray Data dataset and stream
+  through the pipelined ingest plane (``iter_jax_batches`` — prefetch +
+  H2D staging) into the learner;
+- **update**: a policy-gradient step on the GSPMD mesh
+  (``train.get_mesh()`` / ``train.shard_inputs`` — the PR 6 sharded
+  path; CPU-mesh in tier-1), run inside a ``JaxTrainer`` worker so node
+  drain → checkpoint → elastic restart come from the train controller
+  for free.  With ``num_workers > 1`` every rank runs its own rollout
+  shard and updates on a PER-RANK local mesh, with the params
+  mean-allreduced through the supervised collective group — the only
+  cross-rank wait, so it sits under the collective watchdog's timeout
+  (the DP pattern, and the collective seam the chaos runner aborts; a
+  single global jax mesh would turn every jitted update into an
+  unwatched global collective that deadlocks when chaos makes per-rank
+  batch counts diverge);
+- **weight-sync**: rank 0 publishes the updated params through
+  :class:`~ray_tpu.rl.weight_sync.WeightPublisher` (monotonic versions,
+  torn publishes unobservable, channel fast path with object-store
+  fallback) back to every rollout actor.
+
+Robustness contracts (all chaos-tested, see ``benchmarks/rlhf_chaos.py``
+and ``tests/test_rlhf.py``):
+
+- a killed rollout actor is respawned (bounded budget) and its in-flight
+  trajectories are DROPPED WITH ACCOUNTING in the
+  :class:`TrajectoryLedger` — never silently double-counted;
+- a hung rollout sample is cancelled at its deadline and counted, the
+  iteration proceeds on the surviving actors' data;
+- a publish fault retries the SAME version (idempotent) — consumers see
+  a gap-free monotonic version stream, and a fault between payload and
+  commit is never observable;
+- a drained/killed train node restarts the loop from the checkpoint and
+  weight publication resumes ABOVE the last committed version (epoch
+  bump), with fresh rollout actors resubscribed at the durable record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util import fault_injection
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RLHFConfig:
+    """Knobs for the loop.  Everything here must pickle (it ships into
+    the train worker inside ``train_loop_config``)."""
+
+    # task/model shape: the policy maps an obs ("prompt") to a
+    # categorical over vocab_size ("response tokens")
+    obs_dim: int = 8
+    vocab_size: int = 8
+    hidden: Tuple[int, ...] = (32, 32)
+    # loop shape
+    iterations: int = 5
+    num_rollout_actors: int = 2      # per train rank
+    rollout_batch: int = 64          # samples per actor per iteration
+    learner_batch_size: int = 64     # ingest minibatch
+    lr: float = 5e-2
+    seed: int = 0
+    # weight sync
+    name: str = "rlhf"
+    staleness_bound: Optional[int] = 4
+    stale_timeout_s: float = 30.0
+    use_channel: bool = True         # compiled-graph commit fast path
+    verify_weights_on_read: bool = False
+    # robustness
+    sample_timeout_s: float = 60.0
+    publish_retries: int = 3
+    respawn_budget: int = 3
+    checkpoint_every: int = 1
+    # trainer shape
+    mesh: Optional[str] = "dp"
+    num_workers: int = 1
+    max_failures: int = 0
+    storage_path: Optional[str] = None
+    # reward: None = built-in scripted linear-gold reward; else a
+    # picklable callable (obs, actions, cfg) -> np.ndarray of rewards
+    reward_fn: Optional[Callable] = None
+    # deterministic chaos, applied inside the loop's own processes:
+    #   kill_rollout_at_iter: int — ray_tpu.kill one rollout actor with
+    #       its sample in flight at that iteration (1-based)
+    #   publish_fault_at: int — arm rl.weight_sync.publish to fail on
+    #       that publish call (1-based; kind "connection" → retried)
+    #   reward_fault_at: int — arm rl.reward.score the same way
+    chaos: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# trajectory accounting
+# ---------------------------------------------------------------------------
+
+
+class TrajectoryLedger:
+    """Produced / consumed / dropped accounting with duplicate rejection.
+
+    One "trajectory" is one rollout batch (one ``sample()`` call on one
+    actor), identified by a unique 62-bit uid minted at actor spawn — a
+    respawned actor can never reuse a dead incarnation's uids, even
+    across an elastic restart of the whole loop.  ``admit`` is the
+    single consumption gate: a uid is consumed exactly once, ever (the
+    no-double-count invariant the chaos tests assert)."""
+
+    def __init__(self) -> None:
+        self.produced = 0
+        self.consumed = 0
+        self.dropped = 0
+        self.duplicates_rejected = 0
+        self.drop_reasons: Dict[str, int] = {}
+        self._consumed_ids: set = set()
+
+    def record_produced(self, n: int = 1) -> None:
+        self.produced += n
+
+    def record_dropped(self, n: int, reason: str) -> None:
+        self.dropped += n
+        self.drop_reasons[reason] = self.drop_reasons.get(reason, 0) + n
+        logger.warning("rlhf ledger: dropped %d trajectory batch(es): %s",
+                       n, reason)
+
+    def admit(self, uid: int) -> bool:
+        """True exactly once per uid; a second admit is a duplicate —
+        rejected and counted, never consumed twice."""
+        if uid in self._consumed_ids:
+            self.duplicates_rejected += 1
+            return False
+        self._consumed_ids.add(uid)
+        self.consumed += 1
+        return True
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"produced": self.produced, "consumed": self.consumed,
+                "dropped": self.dropped,
+                "duplicates_rejected": self.duplicates_rejected,
+                "drop_reasons": dict(self.drop_reasons),
+                "consumed_ids": sorted(self._consumed_ids)}
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "TrajectoryLedger":
+        led = cls()
+        led.produced = int(state["produced"])
+        led.consumed = int(state["consumed"])
+        led.dropped = int(state["dropped"])
+        led.duplicates_rejected = int(state["duplicates_rejected"])
+        led.drop_reasons = dict(state["drop_reasons"])
+        led._consumed_ids = set(int(i) for i in state["consumed_ids"])
+        return led
+
+    def counts(self) -> Dict[str, int]:
+        return {"trajectories_produced": self.produced,
+                "trajectories_consumed": self.consumed,
+                "trajectories_dropped": self.dropped,
+                "duplicates_rejected": self.duplicates_rejected}
+
+
+def _mint_uid_base() -> int:
+    # 62-bit random salt, low byte reserved for the per-actor sequence
+    # block; uniqueness must survive loop restarts (the ledger's
+    # consumed-id set persists through checkpoints), so the salt is
+    # entropy, not a counter
+    return (int.from_bytes(os.urandom(8), "big") >> 2) & ~0xFFFF
+
+
+# ---------------------------------------------------------------------------
+# rollout actors
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class RolloutActor:
+    """Generation actor: samples trajectory batches with the freshest
+    synced weights.  Each batch reports the exact weight version (and,
+    when ``verify_weights_on_read`` is armed, a digest-verified tree) it
+    was generated with."""
+
+    def __init__(self, cfg_dict: Dict[str, Any], uid_base: int, seed: int):
+        import jax
+
+        from ray_tpu.rl.models import ActorCriticModule
+        from ray_tpu.rl.weight_sync import WeightSubscriber
+
+        self.cfg = RLHFConfig(**cfg_dict)
+        self.module = ActorCriticModule(
+            self.cfg.obs_dim, self.cfg.vocab_size, self.cfg.hidden)
+        self.uid_base = uid_base
+        self.seq = 0
+        self.key = jax.random.PRNGKey(seed)
+        self._sample_jit = jax.jit(self.module.sample_action)
+        self._rng = np.random.default_rng(seed)
+        # resubscribe-on-restart: construction adopts the current
+        # durable version before the first sample
+        self.sub = WeightSubscriber(
+            self.cfg.name,
+            staleness_bound=self.cfg.staleness_bound,
+            verify_on_read=self.cfg.verify_weights_on_read)
+
+    def attach_channel(self, info: Dict[str, Any], slot: int) -> bool:
+        self.sub.detach_channel()
+        self.sub.attach_channel(info, slot)
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+    def sample(self, batch_size: int) -> Dict[str, Any]:
+        import jax
+
+        fault_injection.fault_point("rl.rollout.sample")
+        # backpressure: refuse to run ahead of a lagging learner
+        self.sub.gate(timeout_s=self.cfg.stale_timeout_s)
+        self.sub.poll(timeout_s=0.0)  # adopt the freshest committed version
+        params, ver = self.sub.current()
+        obs = self._rng.standard_normal(
+            (batch_size, self.cfg.obs_dim)).astype(np.float32)
+        self.key, k = jax.random.split(self.key)
+        actions, logp = self._sample_jit(params, obs, k)
+        self.sub.note_sample()
+        self.seq += 1
+        return {
+            "uid": self.uid_base + self.seq,
+            "weight_version": int(ver.version),
+            "weight_epoch": int(ver.epoch),
+            "obs": obs,
+            "actions": np.asarray(actions, np.int32),
+            "logp": np.asarray(logp, np.float32),
+        }
+
+    def sync_stats(self) -> Dict[str, Any]:
+        ver = self.sub.version
+        return {"version": None if ver is None else ver.version,
+                **self.sub.stats}
+
+
+class RolloutGroup:
+    """N rollout actors with deadlines, kill-respawn (bounded budget),
+    hung-sample cancellation, and drop accounting.
+
+    ``publisher`` is the rank-0 :class:`WeightPublisher` when this group
+    lives in the publishing rank (it owns the commit channel, re-rotated
+    on every membership change) — or None, in which case the group's
+    subscribers ride the durable object-store path only."""
+
+    def __init__(self, cfg: RLHFConfig, publisher, ledger: TrajectoryLedger):
+        from ray_tpu.rl._respawn import RespawnBudget
+
+        self.cfg = cfg
+        self.publisher = publisher
+        self.ledger = ledger
+        self.spawn_counter = 0
+        self._budget = RespawnBudget(
+            cfg.respawn_budget, "rollout actor",
+            respawn_note="; it resubscribed at the current published "
+            "version")
+        self.chaos_kill_pending = False
+        self.actors: List[Any] = [
+            self._spawn() for _ in range(cfg.num_rollout_actors)]
+        self._wire_channel()
+
+    @property
+    def respawns_left(self) -> int:
+        return self._budget.respawns_left
+
+    @property
+    def dropped_runners(self) -> int:
+        return self._budget.dropped
+
+    def _spawn(self):
+        self.spawn_counter += 1
+        return RolloutActor.remote(
+            dataclasses.asdict(self.cfg), _mint_uid_base(),
+            self.cfg.seed + self.spawn_counter)
+
+    def _wire_channel(self) -> None:
+        """(Re)build the commit channel over the CURRENT membership and
+        attach every live actor to its reader slot.  Called at spawn and
+        after any membership change — a dead reader's ack slot would
+        wedge the writer, so the channel epoch follows the group."""
+        if self.publisher is None or not self.cfg.use_channel \
+                or not self.actors:
+            return
+        info = self.publisher.rotate_channel(len(self.actors))
+        refs = [a.attach_channel.remote(info, slot)
+                for slot, a in enumerate(self.actors)]
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=10.0)
+            except Exception:  # noqa: BLE001 — actor keeps the KV path
+                pass
+
+    def kill_one(self) -> None:
+        """Deterministic chaos hook: SIGKILL the first actor's process."""
+        if self.actors:
+            ray_tpu.kill(self.actors[0])
+
+    def sample_all(self, batch_size: int) -> List[Dict[str, Any]]:
+        """One collection round.  Every in-flight expectation is settled:
+        a returned batch is recorded produced; a dead actor's batch is
+        dropped+counted and the actor respawned (budget permitting) or
+        removed; a deadline miss is cancelled and dropped+counted."""
+        from ray_tpu.exceptions import (
+            ActorError, GetTimeoutError, TaskError)
+
+        refs = [(i, a.sample.remote(batch_size))
+                for i, a in enumerate(self.actors)]
+        if self.chaos_kill_pending:
+            self.chaos_kill_pending = False
+            self.kill_one()  # the in-flight sample dies with the process
+        deadline = time.monotonic() + self.cfg.sample_timeout_s
+        out: List[Dict[str, Any]] = []
+        dead: List[int] = []
+        for i, ref in refs:
+            budget = max(0.1, deadline - time.monotonic())
+            try:
+                batch = ray_tpu.get(ref, timeout=budget)
+            except GetTimeoutError:
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:  # noqa: BLE001 — cancel is best-effort
+                    pass
+                self.ledger.record_dropped(1, "sample deadline exceeded")
+                continue
+            except (ActorError, TaskError) as e:
+                self.ledger.record_dropped(
+                    1, f"rollout actor died mid-sample "
+                    f"({type(e).__name__})")
+                dead.append(i)
+                continue
+            self.ledger.record_produced(1)
+            out.append(batch)
+        if dead:
+            self._replace(dead)
+        return out
+
+    def _replace(self, dead_indices: List[int]) -> None:
+        """Respawn dead actors within the budget; past it, drop the
+        runner (logged + counted) and continue with fewer."""
+        survivors = [a for i, a in enumerate(self.actors)
+                     if i not in set(dead_indices)]
+        self.actors = self._budget.replace(
+            survivors, len(dead_indices), self._spawn)
+        self._wire_channel()
+
+    def stop(self) -> None:
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        self.actors = []
+
+
+# ---------------------------------------------------------------------------
+# reward
+# ---------------------------------------------------------------------------
+
+
+def _gold_matrix(cfg: RLHFConfig) -> np.ndarray:
+    rng = np.random.default_rng(cfg.seed + 1000)
+    return rng.standard_normal(
+        (cfg.obs_dim, cfg.vocab_size)).astype(np.float32)
+
+
+def scripted_reward(obs: np.ndarray, actions: np.ndarray,
+                    cfg: RLHFConfig) -> np.ndarray:
+    """Built-in reward model: 1.0 where the sampled token matches a fixed
+    hidden linear scorer's argmax — a learnable signal with a known
+    optimum, so benches can assert improvement."""
+    gold = np.argmax(obs @ _gold_matrix(cfg), axis=-1)
+    return (actions == gold).astype(np.float32)
+
+
+def score_trajectories(batches: List[Dict[str, Any]], cfg: RLHFConfig
+                       ) -> List[Dict[str, Any]]:
+    """The reward leg.  ``rl.reward.score`` fires once per scoring round
+    (before any batch is mutated, so a retry re-scores cleanly)."""
+    fault_injection.fault_point("rl.reward.score")
+    fn = cfg.reward_fn or scripted_reward
+    for b in batches:
+        b["rewards"] = np.asarray(
+            fn(b["obs"], b["actions"], cfg), np.float32)
+    return batches
+
+
+# ---------------------------------------------------------------------------
+# learner
+# ---------------------------------------------------------------------------
+
+
+def _make_update_fn(module, lr: float):
+    """One jitted policy-gradient step (REINFORCE with a batch-mean
+    baseline).  Batches arrive sharded over the mesh's batch axis
+    (``train.shard_inputs``); params are replicated."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    tx = optax.adam(lr)
+
+    def loss_fn(params, batch):
+        logits = module.logits(params, batch["obs"])
+        logp = jax.nn.log_softmax(logits)
+        act_logp = jnp.take_along_axis(
+            logp, batch["actions"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        adv = batch["rewards"] - jnp.mean(batch["rewards"])
+        return -jnp.mean(adv * act_logp)
+
+    def update(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return tx, jax.jit(update)
+
+
+def _batches_to_dataset(batches: List[Dict[str, Any]],
+                        ledger: TrajectoryLedger):
+    """Admit each trajectory batch through the ledger (the one
+    consumption gate — duplicates rejected here) and build the Ray Data
+    dataset that streams through the ingest plane."""
+    from ray_tpu import data as rdata
+    from ray_tpu.data.block import batch_to_block
+
+    blocks = []
+    for b in batches:
+        if not ledger.admit(int(b["uid"])):
+            continue
+        n = len(b["actions"])
+        blocks.append(batch_to_block({
+            "obs": b["obs"],
+            "actions": b["actions"],
+            "rewards": b["rewards"],
+            "logp": b["logp"],
+            "uid": np.full((n,), int(b["uid"]), np.int64),
+            "weight_version": np.full(
+                (n,), int(b["weight_version"]), np.int64),
+        }))
+    if not blocks:
+        return None
+    return rdata.from_blocks(blocks)
+
+
+# ---------------------------------------------------------------------------
+# the train-worker loop
+# ---------------------------------------------------------------------------
+
+
+def _replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+class _LoopRuntime:
+    """Everything one rank needs for the loop; built inside the train
+    worker, torn down in its ``finally``."""
+
+    def __init__(self, cfg: RLHFConfig, ctx) -> None:
+        import jax
+
+        from ray_tpu.rl.models import ActorCriticModule
+        from ray_tpu.rl.weight_sync import WeightPublisher
+
+        self.cfg = cfg
+        self.ctx = ctx
+        self.rank = ctx.get_world_rank()
+        self.world = ctx.get_world_size()
+        if self.world == 1:
+            self.mesh = ctx.get_mesh()
+        else:
+            # world > 1 is DP over PER-RANK local meshes with the
+            # supervised TCP allreduce as the only cross-rank sync.  A
+            # single jax.distributed mesh would make EVERY jitted update
+            # a global collective — ranks whose ingest yields different
+            # batch counts (drops under chaos!) would deadlock with no
+            # watchdog, and orbax checkpoint saves would barrier on
+            # ranks that never checkpoint.  The local-mesh design keeps
+            # every jit local and puts all cross-rank waits under the
+            # collective watchdog's timeout.
+            from ray_tpu.parallel.mesh import MeshConfig, create_mesh
+
+            devs = jax.local_devices()
+            self.mesh = create_mesh(
+                MeshConfig(dp=-1).clamp_to(len(devs)), devices=devs)
+        self.module = ActorCriticModule(
+            cfg.obs_dim, cfg.vocab_size, cfg.hidden)
+
+        # ---- restore (drain/elastic restart resumes here) ----------------
+        self.start_iter = 0
+        self.ledger = TrajectoryLedger()
+        restored = None
+        ckpt = ctx.get_checkpoint()
+        if ckpt is not None:
+            state = ckpt.to_pytree()
+            restored = state["params"]
+            self.start_iter = int(state["iteration"])
+            self.ledger = TrajectoryLedger.from_state(state["ledger"])
+            logger.warning(
+                "rlhf[r%d]: restored at iteration %d (published "
+                "version %s)", self.rank, self.start_iter,
+                state.get("version"))
+        params = restored if restored is not None else \
+            self.module.init(jax.random.PRNGKey(cfg.seed))
+        self.params = jax.device_put(params, _replicated(self.mesh))
+        self.tx, self.update_fn = _make_update_fn(self.module, cfg.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.consumed_versions: List[int] = []
+        self.stale_minibatches = 0
+
+        # ---- collective group (world > 1: the DP/chaos seam) -------------
+        chaos = dict(cfg.chaos or {})
+        self.chaos = chaos
+        self.group_name = None
+        if self.world > 1:
+            self.group_name = ctx.collective_group(
+                timeout_s=cfg.sample_timeout_s + 30.0)
+            if chaos.get("collective_fault_op") and self.rank == \
+                    self.world - 1 and self.start_iter == 0:
+                # one-shot: only the FIRST incarnation injects the hang
+                # (a restarted generation resumes above iteration 0), so
+                # watchdog-abort → checkpoint-restart → completion is a
+                # terminating sequence, not a restart loop
+                fault_injection.arm(
+                    "collective.op",
+                    nth=int(chaos["collective_fault_op"]), exc="delay:120")
+
+        # ---- publisher + chaos arming (rank 0 only) ----------------------
+        self.publisher = None
+        if self.rank == 0:
+            # resume=True: a restarted publisher continues ABOVE the
+            # durable committed version — the stream never rewinds
+            self.publisher = WeightPublisher(cfg.name, resume=True)
+            if chaos.get("publish_fault_at"):
+                fault_injection.arm("rl.weight_sync.publish",
+                                    nth=int(chaos["publish_fault_at"]))
+            if chaos.get("reward_fault_at"):
+                fault_injection.arm("rl.reward.score",
+                                    nth=int(chaos["reward_fault_at"]))
+            self.publish(jax.device_get(self.params))
+        if self.world > 1:
+            # every rank must see a committed version before its rollout
+            # actors construct (they adopt it at construction)
+            from ray_tpu.util import collective as col
+
+            col.barrier(self.group_name)
+        self.rollout = RolloutGroup(cfg, self.publisher, self.ledger)
+
+    # -- legs ---------------------------------------------------------------
+    def publish(self, host_params) -> Any:
+        from ray_tpu._private.resilience import RetryPolicy, retry_call
+
+        policy = RetryPolicy(max_attempts=self.cfg.publish_retries,
+                             base_delay_s=0.05, max_delay_s=0.5)
+        return retry_call(lambda: self.publisher.publish(host_params),
+                          policy=policy, site="rl.weight_sync.publish")
+
+    def score(self, batches):
+        from ray_tpu._private.resilience import RetryPolicy, retry_call
+
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                             max_delay_s=0.5)
+        return retry_call(lambda: score_trajectories(batches, self.cfg),
+                          policy=policy, site="rl.reward.score")
+
+    def consume(self, ds) -> Dict[str, Any]:
+        """Stream the scored dataset through the ingest plane into
+        sharded update steps, enforcing the monotonic-version floor."""
+        import jax
+
+        from ray_tpu import train
+
+        losses, rewards, n_rows = [], [], 0
+        if ds is not None:
+            floor = (self.consumed_versions[-1]
+                     if self.consumed_versions else -1)
+            for jb in ds.iterator().iter_jax_batches(
+                    batch_size=self.cfg.learner_batch_size,
+                    drop_last=False, prefetch_batches=2):
+                versions = np.asarray(
+                    jax.device_get(jb["weight_version"]))
+                vmin, vmax = int(versions.min()), int(versions.max())
+                if vmin < floor:
+                    # never train on a version older than one already
+                    # consumed — the monotonicity invariant under chaos.
+                    # Counted apart from the ledger: these rows' uids
+                    # were legitimately admitted, only this minibatch's
+                    # update is skipped.
+                    self.stale_minibatches += 1
+                    logger.warning(
+                        "rlhf: skipped a minibatch with stale "
+                        "weight_version %d < floor %d", vmin, floor)
+                    continue
+                floor = max(floor, vmax)
+                self.consumed_versions.append(vmax)
+                batch = self._shard_batch({
+                    "obs": jb["obs"],
+                    "actions": jb["actions"],
+                    "rewards": jb["rewards"],
+                })
+                self.params, self.opt_state, loss = self.update_fn(
+                    self.params, self.opt_state, batch)
+                losses.append(float(jax.device_get(loss)))
+                rewards.append(float(np.mean(np.asarray(
+                    jax.device_get(jb["rewards"])))))
+                n_rows += int(jb["actions"].shape[0])
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "mean_reward":
+                float(np.mean(rewards)) if rewards else float("nan"),
+            "rows_consumed": n_rows,
+        }
+
+    def _shard_batch(self, batch):
+        """Batch-axis sharding over the loop's mesh.  world==1 goes
+        through the PR 6 session API (the trainer-path contract);
+        world>1 places on the per-rank local mesh directly."""
+        if self.world == 1:
+            from ray_tpu import train
+
+            return train.shard_inputs(batch)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = NamedSharding(self.mesh,
+                           PartitionSpec(self.mesh.axis_names[0]))
+        return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+    def allreduce_params(self) -> None:
+        """world>1: average the per-rank updated params so every rank
+        (and the published stream) holds the same tree."""
+        import jax
+
+        from ray_tpu.util import collective as col
+
+        host = jax.device_get(self.params)
+        leaves, treedef = jax.tree.flatten(host)
+        averaged = [
+            np.asarray(col.allreduce(np.asarray(x), self.group_name))
+            / self.world for x in leaves]
+        self.params = jax.device_put(
+            jax.tree.unflatten(treedef, averaged),
+            _replicated(self.mesh))
+        self.opt_state = self.tx.init(self.params)
+
+    def close(self) -> None:
+        if self.rollout is not None:
+            self.rollout.stop()
+        if self.publisher is not None:
+            self.publisher.close()
+        fault_injection.disarm("rl.weight_sync.publish")
+        fault_injection.disarm("rl.reward.score")
+        fault_injection.disarm("collective.op")
+
+
+def _rlhf_train_loop(config: Dict[str, Any]) -> None:
+    """Runs inside every JaxTrainer worker."""
+    import jax
+
+    from ray_tpu import train
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    cfg = RLHFConfig(**config["rlhf"])
+    ctx = train.get_context()
+    rt = _LoopRuntime(cfg, ctx)
+    try:
+        for it in range(rt.start_iter, cfg.iterations):
+            if rt.chaos.get("kill_rollout_at_iter") == it + 1:
+                rt.rollout.chaos_kill_pending = True
+            batches = rt.rollout.sample_all(cfg.rollout_batch)
+            batches = rt.score(batches)
+            stats = rt.consume(_batches_to_dataset(batches, rt.ledger))
+            if rt.world > 1:
+                rt.allreduce_params()
+            if rt.rank != 0:
+                train.report({"training_iteration": it + 1,
+                              "rank": rt.rank})
+                continue
+            ver = rt.publish(jax.device_get(rt.params))
+            metrics = {
+                "training_iteration": it + 1,
+                "published_version": int(ver.version),
+                "publisher_epoch": int(ver.epoch),
+                "consumed_versions": list(rt.consumed_versions),
+                "publish_faults_fired":
+                    fault_injection.fired_count("rl.weight_sync.publish"),
+                "reward_faults_fired":
+                    fault_injection.fired_count("rl.reward.score"),
+                "respawns_used":
+                    cfg.respawn_budget - rt.rollout.respawns_left,
+                "dropped_runners": rt.rollout.dropped_runners,
+                "stale_minibatches": rt.stale_minibatches,
+                **rt.ledger.counts(),
+                **{f"publisher_{k}": v
+                   for k, v in rt.publisher.stats.items()},
+                **stats,
+            }
+            want_ckpt = ((it + 1) % cfg.checkpoint_every == 0
+                         or it + 1 == cfg.iterations
+                         or ctx.drain_requested())
+            checkpoint = None
+            if want_ckpt:
+                checkpoint = Checkpoint.from_pytree({
+                    "params": jax.device_get(rt.params),
+                    "iteration": it + 1,
+                    "version": int(ver.version),
+                    "ledger": rt.ledger.state_dict(),
+                })
+            train.report(metrics, checkpoint=checkpoint)
+    finally:
+        rt.close()
+
+
+# ---------------------------------------------------------------------------
+# driver-facing wrapper
+# ---------------------------------------------------------------------------
+
+
+class RLHFLoop:
+    """Build-and-run handle: wires the config into a ``JaxTrainer`` so
+    drain handling, checkpoint restore, and elastic restart come from
+    the train controller."""
+
+    def __init__(self, config: RLHFConfig, *,
+                 run_config: Optional[Any] = None):
+        self.config = config
+        self.run_config = run_config
+
+    def run(self):
+        from ray_tpu import train
+
+        cfg = self.config
+        run_config = self.run_config
+        if run_config is None:
+            run_config = train.RunConfig(
+                name=f"rlhf-{cfg.name}",
+                storage_path=cfg.storage_path,
+                failure_config=train.FailureConfig(
+                    max_failures=cfg.max_failures))
+        trainer = train.JaxTrainer(
+            _rlhf_train_loop,
+            train_loop_config={"rlhf": dataclasses.asdict(cfg)},
+            scaling_config=train.ScalingConfig(
+                num_workers=cfg.num_workers, mesh=cfg.mesh),
+            run_config=run_config,
+        )
+        return trainer.fit()

@@ -1,0 +1,483 @@
+"""Versioned live weight-sync: learner → generation actors, no cold restart.
+
+The RLHF loop (``ray_tpu/rl/rlhf.py``) needs fresh learner weights on its
+rollout/generation actors every iteration *while those actors keep
+serving* — the continual-learning weight path real production RL systems
+need.  This module is that path, hardened:
+
+- **Monotonic versions.** Every publish carries a :class:`WeightVersion`
+  ``(version, epoch)``: ``version`` is globally monotonic across the
+  publisher's whole lifetime *including elastic restarts* (a resumed
+  publisher reads the durable KV record and continues above it, bumping
+  ``epoch``), so a consumer can assert non-decreasing versions no matter
+  how many times the learner was preempted.
+
+- **Torn publishes are never observed.**  A publish is three legs:
+  payload into the object store (immutable, atomic), then the
+  ``rl.weight_sync.publish`` fault site, then the *commit* — one KV write
+  of the latest-record.  A publisher that dies (or faults) between
+  payload and commit leaves only an orphan object; no subscriber can
+  observe the half-published version because discovery goes through the
+  commit record alone.  The payload additionally carries a digest over
+  every leaf, validated before the consumer swap — a corrupt or mixed
+  tree is rejected and counted, never served.
+
+- **Atomic consumer swap.**  :meth:`WeightSubscriber.current` returns
+  ``(params, WeightVersion)`` snapshotted under one lock, and the swap
+  installs the whole validated tree in a single reference assignment —
+  a replica never serves params from two versions at once.
+
+- **Compiled-graph channel fast path, object-store fallback.**  The
+  publisher can attach a compiled-graph shm channel
+  (:class:`~ray_tpu.experimental.channel.Channel`); commits ride it with
+  a bounded write (small payloads inline, large ones as the commit
+  record).  A dead/slow reader times the write out → the channel is
+  retired and publication continues on the always-written KV +
+  object-store path.  A respawned subscriber needs no channel at all:
+  it rejoins at the current version from the durable record
+  (resubscribe-on-restart).
+
+- **Bounded staleness backpressure.**  Subscribers count samples served
+  per version; past ``staleness_bound`` without a newer publish, the
+  :meth:`WeightSubscriber.gate` blocks (bounded) until the learner
+  catches up — rollout cannot run away producing stale trajectories
+  when the learner falls behind — and raises :class:`WeightsStaleError`
+  if the learner stays silent past the deadline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import pickle
+import threading
+import time
+from dataclasses import dataclass
+from functools import total_ordering
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.util import fault_injection
+
+logger = logging.getLogger(__name__)
+
+_NAMESPACE = "rl_weights"
+
+
+class WeightSyncError(RuntimeError):
+    """Base for weight-sync failures."""
+
+
+class WeightsStaleError(WeightSyncError):
+    """The staleness gate timed out: the learner has not published within
+    the bound while rollout kept sampling — backpressure gave up."""
+
+
+class NoWeightsPublishedError(WeightSyncError):
+    """A subscriber asked for weights before any publish committed."""
+
+
+@total_ordering
+@dataclass(frozen=True)
+class WeightVersion:
+    """Monotonic weight identity.  ``version`` is globally monotonic
+    (never reused, survives publisher restarts); ``epoch`` counts
+    publisher incarnations and exists for diagnostics."""
+
+    version: int
+    epoch: int = 0
+
+    def __lt__(self, other: "WeightVersion") -> bool:
+        return self.version < other.version
+
+    def __int__(self) -> int:
+        return self.version
+
+
+def params_digest(params: Any, version: int, epoch: int) -> str:
+    """Digest over every leaf's bytes + the version identity.  A payload
+    whose tree was torn, truncated, or mixed across versions cannot
+    reproduce it."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256(f"{version}:{epoch}".encode())
+    leaves, treedef = jax.tree.flatten(params)
+    h.update(repr(treedef).encode())
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _latest_key(name: str) -> bytes:
+    return f"{name}/latest".encode()
+
+
+def _read_latest_record(name: str) -> Optional[Dict[str, Any]]:
+    """The durable commit record, or None when nothing has been
+    published under ``name``.  This is the ONLY discovery path — a
+    publish is visible iff this record points at it."""
+    from ray_tpu.experimental import internal_kv
+
+    raw = internal_kv._internal_kv_get(_latest_key(name),
+                                       namespace=_NAMESPACE)
+    if raw is None:
+        return None
+    return pickle.loads(raw)
+
+
+class WeightPublisher:
+    """Learner-side: assign versions, publish payloads, commit atomically.
+
+    Payloads go to the object store (one immutable put per version; the
+    publisher pins the last ``keep`` refs so an in-flight fetch of the
+    previous version cannot lose the object mid-swap).  The commit is a
+    single KV write of the latest-record.  An attached compiled-graph
+    channel is a latency optimization only — every commit is durable in
+    KV first, so losing the channel loses nothing but latency.
+    """
+
+    def __init__(self, name: str, *, keep: int = 2,
+                 channel_write_timeout_s: float = 2.0,
+                 resume: bool = True):
+        self.name = name
+        self.keep = max(1, keep)
+        self.channel_write_timeout_s = channel_write_timeout_s
+        self._pinned: Dict[int, Any] = {}  # version -> ObjectRef (alive)
+        self._channel = None
+        self._channel_inline_limit = 0
+        self._lock = threading.Lock()
+        self.stats = {"publishes": 0, "publish_failures": 0,
+                      "channel_commits": 0, "channel_retired": 0}
+        self._epoch = 0
+        self._version = 0  # last committed version
+        if resume:
+            rec = _read_latest_record(name)
+            if rec is not None:
+                self._version = int(rec["version"])
+                self._epoch = int(rec["epoch"]) + 1
+
+    # -- channel fast path -------------------------------------------------
+    def rotate_channel(self, num_readers: int,
+                       *, buffer_size: int = 1 << 20) -> Dict[str, Any]:
+        """(Re)create the shm commit channel for ``num_readers``
+        subscribers and return the attach info ``{"name", "num_readers",
+        "buffer_size"}``.  Called whenever group membership changes (a
+        respawned actor cannot inherit a dead reader's ack slot)."""
+        from ray_tpu.experimental.channel import Channel
+
+        self.retire_channel()
+        if num_readers <= 0:
+            return {}
+        ch = Channel(buffer_size=buffer_size, num_readers=num_readers)
+        with self._lock:
+            self._channel = ch
+            # leave headroom for the pickle framing around the params
+            self._channel_inline_limit = max(0, buffer_size - 4096)
+        return {"name": ch.name, "num_readers": num_readers,
+                "buffer_size": buffer_size}
+
+    def retire_channel(self) -> None:
+        with self._lock:
+            ch, self._channel = self._channel, None
+        if ch is not None:
+            try:
+                ch.destroy()
+            except Exception:  # noqa: BLE001 — shm already unlinked is fine
+                pass
+
+    # -- publish -----------------------------------------------------------
+    @property
+    def latest_version(self) -> Optional[WeightVersion]:
+        if self._version <= 0:
+            return None
+        return WeightVersion(self._version, self._epoch)
+
+    def publish(self, params: Any, *, meta: Optional[Dict[str, Any]] = None
+                ) -> WeightVersion:
+        """Publish one version.  Raises without bumping the committed
+        version if any leg fails — a retry re-publishes the SAME version
+        number (idempotent), so an injected fault between payload and
+        commit can never skip or tear a version."""
+        import ray_tpu
+
+        version = self._version + 1
+        epoch = self._epoch
+        digest = params_digest(params, version, epoch)
+        payload = {"version": version, "epoch": epoch, "digest": digest,
+                   "params": params, "meta": dict(meta or {})}
+        try:
+            ref = ray_tpu.put(payload)
+            record = {"version": version, "epoch": epoch, "digest": digest,
+                      "ref": pickle.dumps(ref), "published_at": time.time()}
+            # the torn-publish seam: payload exists, commit has not
+            # happened — a fault here must leave the version unobservable
+            fault_injection.fault_point("rl.weight_sync.publish")
+            from ray_tpu.experimental import internal_kv
+
+            internal_kv._internal_kv_put(
+                _latest_key(self.name), pickle.dumps(record),
+                namespace=_NAMESPACE)
+        except BaseException:
+            self.stats["publish_failures"] += 1
+            raise
+        # committed: expose the version, pin the payload, drop old pins
+        self._version = version
+        self._pinned[version] = ref
+        for v in sorted(self._pinned):
+            if len(self._pinned) <= self.keep:
+                break
+            del self._pinned[v]
+        self.stats["publishes"] += 1
+        self._channel_notify(payload, record)
+        return WeightVersion(version, epoch)
+
+    def _channel_notify(self, payload: Dict[str, Any],
+                        record: Dict[str, Any]) -> None:
+        """Best-effort fast-path commit broadcast.  Inline the full
+        payload when it fits the channel buffer; otherwise send the
+        commit record (subscribers fetch from the object store).  A
+        write timeout means a reader died or wedged: retire the channel
+        — the KV commit already happened, nothing is lost."""
+        with self._lock:
+            ch = self._channel
+            limit = self._channel_inline_limit
+        if ch is None:
+            return
+        # serialize ONCE with the channel's own encoder (so the size
+        # gate measures the bytes actually written — a mismatched probe
+        # encoding could oversize the write and masquerade as a dead
+        # reader) and ship the blob directly
+        from ray_tpu._private import serialization
+
+        blob = serialization.dumps(payload)
+        try:
+            if len(blob) <= limit:
+                ch.write_bytes(blob, timeout=self.channel_write_timeout_s)
+            else:
+                ch.write(dict(record),
+                         timeout=self.channel_write_timeout_s)
+            self.stats["channel_commits"] += 1
+        except Exception as e:  # noqa: BLE001 — timeout/closed/unlinked
+            logger.warning(
+                "weight-sync %s: commit channel lost (%s); continuing on "
+                "the object-store path", self.name, type(e).__name__)
+            self.stats["channel_retired"] += 1
+            self.retire_channel()
+
+    def close(self) -> None:
+        self.retire_channel()
+        self._pinned.clear()
+
+
+class WeightSubscriber:
+    """Consumer-side: poll/receive commits, validate, swap atomically.
+
+    Construction performs the resubscribe leg: one durable-record poll, so
+    a respawned actor rejoins at the current version before serving
+    anything.  ``current()`` raises :class:`NoWeightsPublishedError`
+    until a first version commits.
+    """
+
+    def __init__(self, name: str, *, staleness_bound: Optional[int] = None,
+                 poll_interval_s: float = 0.05,
+                 fetch_timeout_s: float = 30.0,
+                 verify_on_read: bool = False):
+        self.name = name
+        self.staleness_bound = staleness_bound
+        self.poll_interval_s = poll_interval_s
+        self.fetch_timeout_s = fetch_timeout_s
+        self.verify_on_read = verify_on_read
+        self._lock = threading.Lock()
+        self._params: Any = None
+        self._version: Optional[WeightVersion] = None
+        self._digest: Optional[str] = None
+        self._samples_at_version = 0
+        self._channel = None
+        # digest of the last REJECTED commit: a poisoned record would
+        # otherwise be refetched and revalidated on every poll tick; a
+        # legitimate re-publish of the version carries a fresh digest
+        self._rejected_digest: Optional[str] = None
+        self.stats = {"updates": 0, "rejected": 0, "stale_waits": 0,
+                      "channel_updates": 0}
+        self.poll(timeout_s=0.0)  # resubscribe: adopt the current version
+
+    # -- channel fast path -------------------------------------------------
+    def attach_channel(self, info: Dict[str, Any], slot: int) -> None:
+        """Attach to the publisher's commit channel at reader ``slot``.
+        Failure to attach (other host, channel gone) silently leaves the
+        subscriber on the durable poll path."""
+        if not info:
+            return
+        from ray_tpu.experimental.channel import Channel
+
+        try:
+            ch = Channel(info["name"], buffer_size=info["buffer_size"],
+                         num_readers=info["num_readers"], _create=False)
+            ch.set_reader_slot(slot)
+        except Exception:  # noqa: BLE001 — fall back to KV poll
+            logger.warning("weight-sync %s: channel attach failed; "
+                           "using object-store path", self.name)
+            return
+        with self._lock:
+            self._channel = ch
+
+    def detach_channel(self) -> None:
+        with self._lock:
+            ch, self._channel = self._channel, None
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- consume -----------------------------------------------------------
+    @property
+    def version(self) -> Optional[WeightVersion]:
+        with self._lock:
+            return self._version
+
+    def current(self) -> Tuple[Any, WeightVersion]:
+        """Atomic ``(params, version)`` snapshot.  With
+        ``verify_on_read`` the tree is re-hashed against the digest that
+        was committed with it — direct evidence the served tree is not
+        mixed across versions."""
+        with self._lock:
+            params, ver, digest = self._params, self._version, self._digest
+        if ver is None:
+            raise NoWeightsPublishedError(
+                f"weight-sync {self.name!r}: no version committed yet")
+        if self.verify_on_read:
+            actual = params_digest(params, ver.version, ver.epoch)
+            if actual != digest:
+                raise WeightSyncError(
+                    f"weight-sync {self.name!r}: served tree digest "
+                    f"mismatch at v{ver.version} — mixed/torn params")
+        return params, ver
+
+    def note_sample(self) -> None:
+        """Count one rollout batch served at the current version (the
+        staleness gate's input)."""
+        with self._lock:
+            self._samples_at_version += 1
+
+    def poll(self, timeout_s: float = 0.0) -> bool:
+        """Check for (and adopt) a newer committed version.  Reads the
+        channel first (cheap, may carry the payload inline), then the
+        durable record.  Returns True when a newer version was
+        installed.  Bounded by ``timeout_s``."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        updated = self._drain_channel()
+        if self._poll_durable():
+            updated = True
+        while not updated and time.monotonic() < deadline:
+            time.sleep(self.poll_interval_s)
+            updated = self._drain_channel() or self._poll_durable()
+        return updated
+
+    def gate(self, timeout_s: float = 30.0) -> None:
+        """Staleness backpressure.  No-op under the bound; past it, block
+        (bounded) until a newer version commits, else raise
+        :class:`WeightsStaleError` — rollout must not keep producing
+        trajectories the learner can never catch up to."""
+        if self.staleness_bound is None:
+            return
+        with self._lock:
+            behind = self._samples_at_version >= self.staleness_bound
+        if not behind:
+            return
+        self.stats["stale_waits"] += 1
+        if self.poll(timeout_s=timeout_s):
+            return
+        with self._lock:
+            ver = self._version
+        raise WeightsStaleError(
+            f"weight-sync {self.name!r}: {self._samples_at_version} "
+            f"batches sampled at v{ver.version if ver else '?'} "
+            f"(bound {self.staleness_bound}) and no newer publish within "
+            f"{timeout_s:.1f}s — learner is behind or dead")
+
+    # -- internals ---------------------------------------------------------
+    def _drain_channel(self) -> bool:
+        with self._lock:
+            ch = self._channel
+        if ch is None:
+            return False
+        updated = False
+        while True:
+            try:
+                msg = ch.read(timeout=0.0)
+            except Exception:  # noqa: BLE001 — empty (timeout) or torn down
+                break
+            got = self._commit(msg, from_channel=True)
+            updated = updated or got
+            if not got:
+                break
+        return updated
+
+    def _poll_durable(self) -> bool:
+        try:
+            rec = _read_latest_record(self.name)
+        except Exception:  # noqa: BLE001 — GCS hiccup: keep serving current
+            return False
+        if rec is None:
+            return False
+        with self._lock:
+            cur = self._version
+            rejected = self._rejected_digest
+        if cur is not None and int(rec["version"]) <= cur.version:
+            return False
+        if rejected is not None and rec.get("digest") == rejected:
+            return False  # already validated and refused this commit
+        return self._commit(rec, from_channel=False)
+
+    def _fetch_payload(self, record: Dict[str, Any]
+                       ) -> Optional[Dict[str, Any]]:
+        import ray_tpu
+
+        try:
+            ref = pickle.loads(record["ref"])
+            return ray_tpu.get(ref, timeout=self.fetch_timeout_s)
+        except Exception:  # noqa: BLE001 — publisher died with the payload
+            logger.warning(
+                "weight-sync %s: payload fetch for v%s failed; keeping "
+                "current version", self.name, record.get("version"))
+            return None
+
+    def _commit(self, msg: Dict[str, Any], *, from_channel: bool) -> bool:
+        """Validate and atomically install one commit message (payload
+        inline or a record pointing at the object store)."""
+        payload = msg if "params" in msg else self._fetch_payload(msg)
+        if payload is None:
+            return False
+        version = int(payload["version"])
+        epoch = int(payload["epoch"])
+        with self._lock:
+            if self._version is not None and \
+                    version <= self._version.version:
+                return False
+        digest = params_digest(payload["params"], version, epoch)
+        if digest != payload["digest"]:
+            self.stats["rejected"] += 1
+            with self._lock:
+                self._rejected_digest = payload["digest"]
+            logger.error(
+                "weight-sync %s: digest mismatch on v%d — torn payload "
+                "REJECTED, still serving %s", self.name, version,
+                self._version)
+            return False
+        with self._lock:
+            if self._version is not None and \
+                    version <= self._version.version:
+                return False  # raced a newer commit; keep it
+            # the atomic swap: params+version+digest change together
+            self._params = payload["params"]
+            self._version = WeightVersion(version, epoch)
+            self._digest = digest
+            self._samples_at_version = 0
+        self.stats["updates"] += 1
+        if from_channel:
+            self.stats["channel_updates"] += 1
+        return True

@@ -38,6 +38,9 @@ site                        guards
 ``collective.op``           every supervised collective op, before dispatch
 ``collective.leader.recv``  the TCP leader's per-connection serve edge
 ``collective.rendezvous``   the epoch/leader KV legs of group rendezvous
+``rl.weight_sync.publish``  between weight-payload put and version commit
+``rl.rollout.sample``       the rollout actor's sample edge (RLHF loop)
+``rl.reward.score``         the RLHF reward-scoring leg, before any mutation
 ==========================  =================================================
 
 Two kinds are special:

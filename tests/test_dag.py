@@ -861,3 +861,84 @@ class TestXlaMeshDagCollective:
                 compiled.execute(0).get(timeout=30)
             finally:
                 compiled.teardown()
+
+
+class TestActorDeathMidExecute:
+    """A killed DAG actor must surface a clean error from
+    ``CompiledDAGRef.get`` — including a deadline-less get — and leave
+    ``teardown()`` able to complete promptly, not hang until
+    ``submit_timeout`` compounds."""
+
+    def _slow_dag(self):
+        import time as _time
+
+        @ray_tpu.remote
+        class Sleeper:
+            def slow(self, x):
+                _time.sleep(5.0)
+                return x + 1
+
+        a = Sleeper.remote()
+        with InputNode() as inp:
+            dag = a.slow.bind(inp)
+        return a, dag.experimental_compile()
+
+    def test_get_surfaces_clean_error_and_teardown_completes(self):
+        import time
+
+        a, compiled = self._slow_dag()
+        try:
+            ref = compiled.execute(1)
+            time.sleep(0.3)
+            ray_tpu.kill(a)
+            t0 = time.monotonic()
+            # deadline-less get: without liveness probing this hangs
+            # forever on a channel no exec loop will ever write
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError,
+                               match="died mid-execution"):
+                ref.get()
+            assert time.monotonic() - t0 < 10.0
+            # the pipeline is poisoned: further submits refuse fast
+            # instead of wedging in the input-channel write
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+                compiled.execute(2)
+        finally:
+            t0 = time.monotonic()
+            compiled.teardown(timeout=10)
+            # no submit_timeout compounding: teardown observed the dead
+            # exec loop and returned promptly
+            assert time.monotonic() - t0 < 8.0
+
+    def test_deadlined_get_names_the_dead_actor(self):
+        import time
+
+        a, compiled = self._slow_dag()
+        try:
+            ref = compiled.execute(1)
+            time.sleep(0.3)
+            ray_tpu.kill(a)
+            t0 = time.monotonic()
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+                ref.get(timeout=30)
+            # the probe fires well before the 30s deadline
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            compiled.teardown(timeout=10)
+
+    def test_async_future_surfaces_death(self):
+        import asyncio
+        import time
+
+        a, compiled = self._slow_dag()
+
+        async def drive():
+            fut = await compiled.execute_async(1)
+            await asyncio.sleep(0.3)
+            ray_tpu.kill(a)
+            return await fut
+
+        try:
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+                asyncio.run(asyncio.wait_for(drive(), timeout=30))
+        finally:
+            compiled.teardown(timeout=10)

@@ -73,6 +73,63 @@ def test_tasks_spread_across_nodes(cluster):
     assert len(seen) >= 2, f"SPREAD used only {seen}"
 
 
+_STALE_VIEW_SCRIPT = """
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+try:
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2)
+    c.connect()
+    c.wait_for_nodes()
+
+    def _whereami():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    whereami = ray_tpu.remote(_whereami)
+    seen = set(ray_tpu.get([
+        whereami.options(scheduling_strategy="SPREAD").remote()
+        for _ in range(12)
+    ], timeout=60))
+    assert len(seen) >= 2, (
+        f"SPREAD used only {seen}: the head raylet scheduled the burst "
+        f"from a scheduling view that predates the node joins")
+    print("SPREAD-OK", len(seen))
+finally:
+    c.shutdown()
+"""
+
+
+def test_spread_survives_stale_scheduling_view():
+    """Regression for the long-standing test_tasks_spread_across_nodes
+    flake (failed under suite load since PR 1).  Root cause: the head
+    raylet's ``cluster_view`` — the node list SPREAD picks from — was
+    refreshed ONLY by its own heartbeat reply (period
+    ``health_check_period_s / 5``), so a task burst submitted right
+    after ``add_node`` raced the first post-join heartbeat; when the
+    heartbeat lost (a loaded box), every candidate except the head was
+    missing from the view and the whole burst collapsed onto the head
+    node.  The GCS now pushes the refreshed view to live raylets at
+    node registration.  Replayed deterministically in a subprocess:
+    with the heartbeat slowed to a 60s period the pre-fix scheduler
+    failed 100% of the time — only the join-time push can spread the
+    burst."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["RAY_TPU_HEALTH_CHECK_PERIOD_S"] = "300"  # heartbeat every 60s
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _STALE_VIEW_SCRIPT], env=env,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"stale-view SPREAD regression failed:\n{proc.stdout[-2000:]}\n"
+        f"{proc.stderr[-2000:]}")
+    assert "SPREAD-OK" in proc.stdout
+
+
 def test_node_affinity_pins_task(cluster):
     c, n1, n2 = cluster
     _whereami = _whereami_fn()

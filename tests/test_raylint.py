@@ -132,17 +132,25 @@ class Box:
 
 def test_bounded_blocking_serve_get_fixtures(tmp_path):
     bad = "import ray_tpu\n\ndef f(ref):\n    return ray_tpu.get(ref)\n"
-    r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": bad},
+    # the deadline-required set: serve/ (the latency-critical control
+    # plane) AND rl/ (long-lived loops over killable rollout/learner
+    # actors — the RLHF-crucible rule)
+    r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": bad,
+                             "ray_tpu/rl/mod.py": bad},
                   rules=["bounded-blocking"])
-    assert rules_of(r) == ["bounded-blocking"], r.findings
-    # same code outside serve/ is NOT the control plane — no finding
+    assert rules_of(r) == ["bounded-blocking"] * 2, r.findings
+    assert {f.path for f in r.findings} == \
+        {"ray_tpu/serve/mod.py", "ray_tpu/rl/mod.py"}
+    # same code outside the deadline set is NOT the control plane
     r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": "",
+                             "ray_tpu/rl/mod.py": "",
                              "ray_tpu/other.py": bad},
                   rules=["bounded-blocking"])
     assert not r.findings, r.findings
     good = ("import ray_tpu\n\ndef f(ref):\n"
             "    return ray_tpu.get(ref, timeout=5)\n")
     r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": good,
+                             "ray_tpu/rl/mod.py": good,
                              "ray_tpu/other.py": ""},
                   rules=["bounded-blocking"])
     assert not r.findings, r.findings

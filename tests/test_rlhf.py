@@ -1,0 +1,359 @@
+"""RLHF chaos crucible: versioned weight-sync units + the end-to-end
+rollout → reward → update loop under fault injection.
+
+Tier-1 (non-slow) covers the weight-sync layer's contracts (monotonic
+versions, torn publishes unobservable, digest-validated atomic swap,
+staleness backpressure, resume-above-committed) and the acceptance e2e:
+≥3 loop iterations with a rollout-actor kill AND a weight-publish fault
+injected, asserting loop completion, no double-counted trajectories,
+monotonically non-decreasing consumed weight versions, and no consumer
+ever observing a mixed-version param tree (digest re-verified on every
+read).  The slow tier drives the standing chaos runner
+(``benchmarks/rlhf_chaos.py``) through train-node drain mid-epoch and
+the remaining registry scenarios.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import fault_injection as fi
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _params(scale: float = 1.0):
+    return {"w": np.full((4, 4), scale, np.float32),
+            "b": np.zeros((4,), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# ledger units (no cluster)
+# ---------------------------------------------------------------------------
+
+
+class TestTrajectoryLedger:
+    def test_admit_is_exactly_once(self):
+        from ray_tpu.rl import TrajectoryLedger
+
+        led = TrajectoryLedger()
+        assert led.admit(7)
+        assert not led.admit(7)
+        assert led.consumed == 1
+        assert led.duplicates_rejected == 1
+
+    def test_roundtrip_preserves_consumed_ids(self):
+        from ray_tpu.rl import TrajectoryLedger
+
+        led = TrajectoryLedger()
+        led.record_produced(3)
+        led.admit(1)
+        led.admit(2)
+        led.record_dropped(1, "actor died")
+        led2 = TrajectoryLedger.from_state(led.state_dict())
+        # the exactly-once gate survives checkpoint/restore
+        assert not led2.admit(2)
+        assert led2.admit(3)
+        assert led2.dropped == 1
+        assert led2.drop_reasons == {"actor died": 1}
+
+    def test_uid_bases_unique_across_mints(self):
+        from ray_tpu.rl.rlhf import _mint_uid_base
+
+        bases = {_mint_uid_base() for _ in range(512)}
+        assert len(bases) == 512
+        assert all(0 < b < 2 ** 63 for b in bases)
+
+
+# ---------------------------------------------------------------------------
+# weight-sync layer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.usefixtures("ray_start")
+class TestWeightSync:
+    def test_publish_subscribe_atomic_snapshot(self):
+        from ray_tpu.rl import WeightPublisher, WeightSubscriber
+
+        pub = WeightPublisher("ws-basic", resume=False)
+        v1 = pub.publish(_params(1.0))
+        assert (v1.version, v1.epoch) == (1, 0)
+        sub = WeightSubscriber("ws-basic", verify_on_read=True)
+        params, ver = sub.current()
+        assert ver == v1
+        np.testing.assert_array_equal(params["w"], _params(1.0)["w"])
+        v2 = pub.publish(_params(2.0))
+        assert v2.version == 2
+        assert sub.poll(timeout_s=2.0)
+        params, ver = sub.current()
+        assert ver.version == 2 and float(params["w"][0, 0]) == 2.0
+        pub.close()
+
+    def test_torn_publish_never_observed_and_retry_is_gapless(self):
+        from ray_tpu.rl import WeightPublisher, WeightSubscriber
+
+        pub = WeightPublisher("ws-torn", resume=False)
+        pub.publish(_params(1.0))
+        sub = WeightSubscriber("ws-torn")
+        with fi.armed("rl.weight_sync.publish", nth=1):
+            with pytest.raises(ConnectionError):
+                pub.publish(_params(9.0))
+        # the payload exists but the commit never happened: unobservable
+        assert not sub.poll(timeout_s=0.2)
+        _, ver = sub.current()
+        assert ver.version == 1
+        # the retry re-publishes the SAME version number — no gap, no
+        # rewind, and consumers converge on it
+        v2 = pub.publish(_params(2.0))
+        assert v2.version == 2
+        assert sub.poll(timeout_s=2.0)
+        params, ver = sub.current()
+        assert ver.version == 2 and float(params["w"][0, 0]) == 2.0
+        assert pub.stats["publish_failures"] == 1
+        pub.close()
+
+    def test_corrupt_payload_rejected_not_served(self):
+        import pickle
+
+        from ray_tpu.experimental import internal_kv
+        from ray_tpu.rl import WeightPublisher, WeightSubscriber
+        from ray_tpu.rl.weight_sync import _NAMESPACE, _latest_key
+
+        pub = WeightPublisher("ws-corrupt", resume=False)
+        pub.publish(_params(1.0))
+        sub = WeightSubscriber("ws-corrupt")
+        # forge a commit record whose payload digest cannot validate:
+        # point v2 at a payload whose tree bytes disagree with the digest
+        bad = {"version": 2, "epoch": 0, "digest": "0" * 64,
+               "params": _params(666.0)}
+        ref = ray_tpu.put(bad)
+        internal_kv._internal_kv_put(
+            _latest_key("ws-corrupt"),
+            pickle.dumps({"version": 2, "epoch": 0, "digest": "0" * 64,
+                          "ref": pickle.dumps(ref),
+                          "published_at": time.time()}),
+            namespace=_NAMESPACE)
+        assert not sub.poll(timeout_s=0.2)
+        params, ver = sub.current()
+        assert ver.version == 1 and float(params["w"][0, 0]) == 1.0
+        assert sub.stats["rejected"] == 1
+        pub.close()
+
+    def test_staleness_gate_backpressures_then_releases(self):
+        from ray_tpu.rl import (
+            WeightPublisher, WeightSubscriber, WeightsStaleError)
+
+        pub = WeightPublisher("ws-stale", resume=False)
+        pub.publish(_params(1.0))
+        sub = WeightSubscriber("ws-stale", staleness_bound=2)
+        sub.gate(timeout_s=0.1)  # under the bound: no-op
+        sub.note_sample()
+        sub.note_sample()
+        with pytest.raises(WeightsStaleError):
+            sub.gate(timeout_s=0.3)
+        pub.publish(_params(2.0))
+        sub.gate(timeout_s=5.0)  # released by the fresh publish
+        _, ver = sub.current()
+        assert ver.version == 2
+        pub.close()
+
+    def test_resume_continues_above_committed_version(self):
+        from ray_tpu.rl import WeightPublisher, WeightSubscriber
+
+        pub = WeightPublisher("ws-resume", resume=False)
+        for s in (1.0, 2.0, 3.0):
+            pub.publish(_params(s))
+        pub.close()
+        # a restarted learner (drain, preemption) must continue ABOVE
+        # the durable version with a bumped epoch — never rewind
+        pub2 = WeightPublisher("ws-resume", resume=True)
+        v = pub2.publish(_params(4.0))
+        assert (v.version, v.epoch) == (4, 1)
+        sub = WeightSubscriber("ws-resume")
+        _, ver = sub.current()
+        assert (ver.version, ver.epoch) == (4, 1)
+        pub2.close()
+
+    def test_channel_fast_path_and_dead_reader_fallback(self):
+        from ray_tpu.rl import WeightPublisher, WeightSubscriber
+
+        pub = WeightPublisher("ws-chan", resume=False,
+                              channel_write_timeout_s=0.3)
+        pub.publish(_params(1.0))
+        info = pub.rotate_channel(1)
+        sub = WeightSubscriber("ws-chan")
+        sub.attach_channel(info, 0)
+        pub.publish(_params(2.0))
+        assert sub.poll(timeout_s=2.0)
+        assert sub.stats["channel_updates"] >= 1
+        # reader stops draining (dead consumer): the bounded channel
+        # write times out, the channel is retired, and publication
+        # continues on the durable path
+        sub.detach_channel()
+        pub.publish(_params(3.0))  # fills the channel slot, no ack ever
+        pub.publish(_params(4.0))  # write times out -> retire
+        assert pub.stats["channel_retired"] == 1
+        assert pub.latest_version.version == 4
+        assert sub.poll(timeout_s=2.0)
+        _, ver = sub.current()
+        assert ver.version == 4
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e (tier-1): ≥3 iterations under kill + publish fault
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.usefixtures("ray_start")
+class TestRLHFLoopEndToEnd:
+    def test_loop_survives_rollout_kill_and_publish_fault(self):
+        from ray_tpu.rl import RLHFConfig, RLHFLoop
+
+        cfg = RLHFConfig(
+            iterations=4, num_rollout_actors=2, rollout_batch=32,
+            learner_batch_size=32, name="rlhf-e2e", mesh="dp",
+            sample_timeout_s=60.0,
+            # every RolloutActor.current() re-hashes the served tree
+            # against its committed digest: a mixed-version tree anywhere
+            # would fail the sample, and so the loop
+            verify_weights_on_read=True,
+            chaos={"kill_rollout_at_iter": 2, "publish_fault_at": 2,
+                   "reward_fault_at": 3},
+        )
+        result = RLHFLoop(cfg).run()
+        assert result.error is None, result.error
+        m = result.metrics
+        # the loop completed every iteration through the chaos
+        assert m["training_iteration"] == 4
+        # all three armed faults actually fired
+        assert m["publish_faults_fired"] >= 1
+        assert m["reward_faults_fired"] >= 1
+        assert m["respawns_used"] >= 1
+        # the killed actor's in-flight batch was dropped WITH accounting
+        assert m["trajectories_dropped"] >= 1
+        # ...and nothing was double-counted (the retried reward round
+        # re-scored cleanly, the respawned actor minted fresh uids)
+        assert m["duplicates_rejected"] == 0
+        assert m["trajectories_consumed"] <= m["trajectories_produced"]
+        # every consumed batch's weight version is monotonically
+        # non-decreasing
+        cv = m["consumed_versions"]
+        assert len(cv) >= 3
+        assert all(a <= b for a, b in zip(cv, cv[1:])), cv
+        # version stream is gapless-monotonic despite the publish fault:
+        # 1 initial + one per iteration
+        assert m["published_version"] == 5
+        assert m["publisher_epoch"] == 0
+        # the loop actually learned from consumed rows
+        assert m["rows_consumed"] > 0
+        assert np.isfinite(m["loss"])
+
+
+# ---------------------------------------------------------------------------
+# EnvRunnerGroup hardening (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.usefixtures("ray_start")
+class TestEnvRunnerGroupHardening:
+    def _group(self, n=2, respawn_budget=2):
+        from ray_tpu.rl.env_runner import EnvRunnerGroup
+
+        return EnvRunnerGroup(
+            "CartPole-v1", n, 2,
+            {"obs_dim": 4, "num_actions": 2, "hidden": (8,), "gamma": 0.99},
+            seed=0, timeout_s=60.0, respawn_budget=respawn_budget)
+
+    def test_dead_runner_respawned_and_iteration_survives(self):
+        group = self._group()
+        try:
+            group.sync_weights(_module_params())
+            ray_tpu.kill(group.runners[0])
+            time.sleep(0.3)
+            out = group.sample(4)  # dead runner dropped from THIS round
+            assert 1 <= len(out) <= 2
+            assert len(group.runners) == 2, "dead runner not respawned"
+            assert group.respawns_left == 1
+            # the respawned runner was re-synced to the last broadcast
+            # weights: the next round has everyone contributing
+            out = group.sample(4)
+            assert len(out) == 2
+            assert group.dropped_runners == 0
+        finally:
+            group.stop()
+
+    def test_budget_exhausted_drops_runner_with_count(self):
+        group = self._group(respawn_budget=0)
+        try:
+            group.sync_weights(_module_params())
+            ray_tpu.kill(group.runners[1])
+            time.sleep(0.3)
+            out = group.sample(4)
+            assert len(out) == 1
+            assert len(group.runners) == 1
+            assert group.dropped_runners == 1
+            # the group keeps operating at reduced strength
+            assert len(group.sample(4)) == 1
+        finally:
+            group.stop()
+
+
+def _module_params():
+    import jax
+
+    from ray_tpu.rl.models import ActorCriticModule
+
+    return ActorCriticModule(4, 2, (8,)).init(jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------------------
+# the standing chaos runner (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestRLHFChaosRunner:
+    """Each scenario replays one registry fault against the whole loop —
+    the new rl.* sites plus the existing drain/collective/serve sites."""
+
+    def _run(self, name):
+        from benchmarks.rlhf_chaos import run_scenario
+
+        rec = run_scenario(name)
+        assert rec["ok"], rec["problems"]
+        return rec
+
+    @pytest.mark.usefixtures("no_cluster")
+    def test_rollout_hang_cancelled_at_deadline(self):
+        self._run("rollout_hang")
+
+    @pytest.mark.usefixtures("no_cluster")
+    def test_rollout_sigkill_mid_sample(self):
+        self._run("rollout_sigkill")
+
+    @pytest.mark.usefixtures("no_cluster")
+    def test_gcs_flake_absorbed(self):
+        self._run("gcs_flake")
+
+    @pytest.mark.usefixtures("ray_isolated")
+    def test_serve_hosted_reward_with_router_fault(self):
+        self._run("serve_reward")
+
+    @pytest.mark.usefixtures("no_cluster")
+    def test_train_node_drain_mid_epoch(self):
+        """The acceptance drain leg: drain the node hosting the train
+        worker mid-epoch; the loop restarts from the checkpoint and
+        publication resumes above the committed version."""
+        rec = self._run("drain")
+        assert rec["metrics"]["publisher_epoch"] >= 1
+
+    @pytest.mark.usefixtures("ray_isolated")
+    def test_collective_abort_restarts_loop(self):
+        self._run("collective")
