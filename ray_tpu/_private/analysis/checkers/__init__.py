@@ -11,6 +11,7 @@ from ray_tpu._private.analysis.checkers import (  # noqa: F401
     lock_discipline,
     proxy_context,
     serial_blocking_get,
+    span_hygiene,
     test_hygiene,
     thread_lifecycle,
 )

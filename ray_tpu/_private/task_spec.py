@@ -98,6 +98,12 @@ class TaskSpec:
     # streaming generators: producer pauses when the consumer lags this
     # many items (0 = window-only pipelining, no consumer coupling)
     backpressure_num_objects: int = 0
+    # causal trace context (tracing.mint_task_context): trace_id/span_id/
+    # parent_span_id plus the submit wall-clock; the executor installs it
+    # around the user function and stamps it onto the task event, so the
+    # timeline export links submit→queue→execute phases across processes.
+    # None when tracing is disabled — every hop skips the work.
+    trace_ctx: Optional[Dict[str, Any]] = None
 
     def return_ids(self) -> List[ObjectID]:
         # num_returns < 0 marks a streaming generator task: returns are

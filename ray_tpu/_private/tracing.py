@@ -1,0 +1,554 @@
+"""Causal distributed tracing: a zero-dependency trace-context layer.
+
+Dapper-style context propagation (reference: ``ray.util.tracing``'s
+OpenTelemetry integration, here dependency-free): a ``trace_id`` names one
+causal tree (a serve request, a training step, a driver session), every
+unit of work gets a ``span_id``, and ``parent_span_id`` links the tree.
+The context rides
+
+* ``TaskSpec.trace_ctx`` for task/actor submissions (minted in
+  ``remote_function.remote`` / ``actor._invoke``, installed by the
+  executor around the user function, so nested submissions chain);
+* ``serve.context.RequestContext.trace_ctx`` for the serving plane;
+* the contextvar in this module for everything in-process (collective
+  ops, compiled-DAG submits, RLHF loop phases).
+
+Finished spans land in a bounded per-process buffer, published through
+the GCS internal KV (namespace ``"trace"``, key ``spans/<worker>``) by a
+background publisher — the same channel the metrics registry uses — and
+merged into the chrome://tracing export by ``util.state.timeline()``,
+which also synthesizes submit/queue/execute phase spans from the task
+event feed (``_record_task_event`` stamps the trace context onto every
+event).
+
+Overhead contract: with ``RAY_TPU_TRACING=0`` every hook is one dict/env
+check (no allocation, no lock); the bench measures this at <2% of a
+training step.  Enabled, a span is one ``time.time()`` pair plus a deque
+append.
+
+Span-hygiene (enforced by the ``span-hygiene`` raylint rule): prefer the
+``span()`` context manager.  ``start_span()`` returns a handle that MUST
+reach ``.end()`` on every path; stashing it in an attribute without a
+closing path leaks an open span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+ENV_ENABLED = "RAY_TPU_TRACING"
+ENV_BUFFER = "RAY_TPU_TRACE_BUFFER"
+# shared cadence with the metrics publisher (util/metrics.py)
+ENV_PUBLISH_INTERVAL = "RAY_TPU_METRICS_INTERVAL_S"
+
+KV_NAMESPACE = "trace"
+KV_PREFIX = "spans/"
+# dashboard/state-side cutoff: span records from publishers silent longer
+# than this are swept (matches the metrics/data namespace policy)
+KV_STALE_S = 600.0
+
+
+def is_enabled() -> bool:
+    return os.environ.get(ENV_ENABLED, "1") not in ("0", "false", "no")
+
+
+def _buffer_cap() -> int:
+    try:
+        return max(64, int(os.environ.get(ENV_BUFFER, "4096") or 4096))
+    except ValueError:
+        return 4096
+
+
+def new_trace_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(6).hex()
+
+
+class SpanContext:
+    """Immutable (trace_id, span_id, parent_span_id) triple."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: Optional[str] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+
+    def child(self) -> "SpanContext":
+        return SpanContext(self.trace_id, new_span_id(), self.span_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_span_id": self.parent_span_id}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["SpanContext"]:
+        if not d or not d.get("trace_id") or not d.get("span_id"):
+            return None
+        return cls(d["trace_id"], d["span_id"], d.get("parent_span_id"))
+
+    def __repr__(self):
+        return (f"SpanContext({self.trace_id}, {self.span_id}, "
+                f"parent={self.parent_span_id})")
+
+
+_current: contextvars.ContextVar[Optional[SpanContext]] = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+_buffer_lock = threading.Lock()
+_finished: deque = deque(maxlen=_buffer_cap())
+# manually-opened spans (start_span) + the lazy process root, by span_id;
+# published with their current duration and ``open: True`` so a trace is
+# never missing an ancestor just because it has not closed yet
+_open: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+_root_ctx: Optional[SpanContext] = None
+_publisher_started = False
+
+# pluggable duration sinks: the train step ledger registers here so
+# layers that must not import train/ (collective supervision, the data
+# iterator) can still attribute wall time to step buckets.  Keyed by an
+# opaque token for removal.
+_sink_lock = threading.Lock()
+_duration_sinks: Dict[int, Callable[[str, float], None]] = {}
+_sink_token = 0
+
+
+def register_duration_sink(fn: Callable[[str, float], None]) -> int:
+    """Register ``fn(bucket, seconds)`` to receive attributed durations
+    (collective-wait, data-wait, H2D, ...).  Returns a token for
+    :func:`unregister_duration_sink`."""
+    global _sink_token
+    with _sink_lock:
+        _sink_token += 1
+        _duration_sinks[_sink_token] = fn
+        return _sink_token
+
+
+def unregister_duration_sink(token: int) -> None:
+    with _sink_lock:
+        _duration_sinks.pop(token, None)
+
+
+def note_duration(bucket: str, seconds: float) -> None:
+    """Attribute ``seconds`` of wall time to ``bucket`` in every
+    registered sink.  One dict check when nothing is registered — safe
+    on hot paths."""
+    if not _duration_sinks:
+        return
+    with _sink_lock:
+        sinks = list(_duration_sinks.values())
+    for fn in sinks:
+        try:
+            fn(bucket, seconds)
+        except Exception:  # noqa: BLE001 — attribution must never fail work
+            pass
+
+
+# ---------------------------------------------------------------------------
+# context accessors
+# ---------------------------------------------------------------------------
+
+
+def current() -> Optional[SpanContext]:
+    """The in-flight span context, or None outside any traced scope."""
+    return _current.get()
+
+
+def set_current(ctx: Optional[SpanContext]):
+    """Install ``ctx`` as the current span context; returns the reset
+    token (pair with :func:`reset_current`)."""
+    return _current.set(ctx)
+
+
+def reset_current(token) -> None:
+    _current.reset(token)
+
+
+def _process_kind() -> str:
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker is not None:
+            from ray_tpu._private.worker import WorkerMode
+
+            return ("driver" if global_worker.mode == WorkerMode.DRIVER
+                    else "worker")
+    except Exception:  # noqa: BLE001 — no runtime yet
+        pass
+    return "process"
+
+
+def _ensure_root() -> SpanContext:
+    """The lazy per-process root span: work submitted outside any scope
+    (a bare driver script) still forms one connected tree per process."""
+    global _root_ctx
+    if _root_ctx is not None:
+        return _root_ctx
+    with _buffer_lock:
+        if _root_ctx is None:
+            ctx = SpanContext(new_trace_id(), new_span_id(), None)
+            _open[ctx.span_id] = {
+                "name": f"{_process_kind()}-root", "kind": "root",
+                "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+                "parent_span_id": None, "start": time.time(), "end": None,
+                "pid": os.getpid(),
+            }
+            _root_ctx = ctx
+    return _root_ctx
+
+
+def current_or_root() -> SpanContext:
+    return _current.get() or _ensure_root()
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+
+def record_span(name: str, start: float, end: float,
+                ctx: SpanContext, *, kind: str = "",
+                attrs: Optional[Dict[str, Any]] = None) -> None:
+    """Append one completed span to the process buffer."""
+    if not is_enabled():
+        return
+    entry: Dict[str, Any] = {
+        "name": name, "kind": kind, "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id, "parent_span_id": ctx.parent_span_id,
+        "start": start, "end": end, "pid": os.getpid(),
+    }
+    if attrs:
+        entry["attrs"] = attrs
+    with _buffer_lock:
+        _finished.append(entry)
+    _ensure_publisher()
+
+
+class Span:
+    """A manually-managed span (``start_span``).  Must reach :meth:`end`
+    on every path — the ``span-hygiene`` lint rule flags handles stashed
+    in attributes without a closing path."""
+
+    __slots__ = ("name", "kind", "ctx", "start", "attrs", "_ended")
+
+    def __init__(self, name: str, kind: str, ctx: SpanContext,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.kind = kind
+        self.ctx = ctx
+        self.attrs = attrs
+        self.start = time.time()
+        self._ended = False
+        with _buffer_lock:
+            _open[ctx.span_id] = {
+                "name": name, "kind": kind, "trace_id": ctx.trace_id,
+                "span_id": ctx.span_id,
+                "parent_span_id": ctx.parent_span_id,
+                "start": self.start, "end": None, "pid": os.getpid(),
+            }
+            while len(_open) > _buffer_cap():  # leak backstop
+                _open.popitem(last=False)
+
+    def end(self) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        with _buffer_lock:
+            _open.pop(self.ctx.span_id, None)
+        record_span(self.name, self.start, time.time(), self.ctx,
+                    kind=self.kind, attrs=self.attrs)
+
+
+def start_span(name: str, *, kind: str = "",
+               parent: Optional[SpanContext] = None,
+               attrs: Optional[Dict[str, Any]] = None) -> Optional[Span]:
+    """Open a span with a non-lexical lifetime.  Returns None when
+    tracing is disabled (callers guard with ``if s is not None``, or use
+    :func:`span` which handles it)."""
+    if not is_enabled():
+        return None
+    ctx = (parent or current_or_root()).child()
+    _ensure_publisher()
+    return Span(name, kind, ctx, attrs)
+
+
+@contextlib.contextmanager
+def span(name: str, *, kind: str = "",
+         attrs: Optional[Dict[str, Any]] = None) -> Iterator[Optional[SpanContext]]:
+    """Record a span around the block and make it the current context, so
+    work submitted inside (tasks, collectives) parents to it."""
+    if not is_enabled():
+        yield None
+        return
+    ctx = current_or_root().child()
+    token = _current.set(ctx)
+    start = time.time()
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+        record_span(name, start, time.time(), ctx, kind=kind, attrs=attrs)
+
+
+@contextlib.contextmanager
+def trace(name: str, *, attrs: Optional[Dict[str, Any]] = None
+          ) -> Iterator[Optional[SpanContext]]:
+    """Start a FRESH trace (new ``trace_id``) rooted at this block — one
+    causal tree per request/step/iteration::
+
+        with tracing.trace("rlhf-iteration", attrs={"iter": it}):
+            ...  # everything submitted here shares one trace_id
+    """
+    if not is_enabled():
+        yield None
+        return
+    ctx = SpanContext(new_trace_id(), new_span_id(), None)
+    token = _current.set(ctx)
+    start = time.time()
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+        record_span(name, start, time.time(), ctx, kind="root", attrs=attrs)
+
+
+# ---------------------------------------------------------------------------
+# task-submission face (TaskSpec.trace_ctx)
+# ---------------------------------------------------------------------------
+
+
+def mint_task_context(name: str) -> Optional[Dict[str, Any]]:
+    """The wire dict a submission stamps onto ``TaskSpec.trace_ctx``:
+    a fresh span for the task, parented to the submitter's current
+    context (or the lazy process root).  ``submitted_at`` anchors the
+    submit→queue→execute phase synthesis in the timeline export."""
+    if not is_enabled():
+        return None
+    parent = current_or_root()
+    _ensure_publisher()
+    return {
+        "trace_id": parent.trace_id, "span_id": new_span_id(),
+        "parent_span_id": parent.span_id, "name": name,
+        "submitted_at": time.time(),
+    }
+
+
+@contextlib.contextmanager
+def task_scope(trace_ctx: Optional[Dict[str, Any]]) -> Iterator[None]:
+    """Executor-side: install the spec-carried context around the user
+    function so nested submissions/collectives parent to this task."""
+    ctx = SpanContext.from_dict(trace_ctx)
+    if ctx is None:
+        yield
+        return
+    token = _current.set(ctx)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# buffer access + KV publication
+# ---------------------------------------------------------------------------
+
+
+def local_spans(include_open: bool = True) -> List[Dict[str, Any]]:
+    """Snapshot of this process's span buffer (finished + open)."""
+    now = time.time()
+    with _buffer_lock:
+        out = [dict(e) for e in _finished]
+        if include_open:
+            for e in _open.values():
+                d = dict(e)
+                d["end"] = now
+                d["open"] = True
+                out.append(d)
+    return out
+
+
+def clear_local() -> None:
+    """Drop buffered spans (test isolation)."""
+    global _root_ctx
+    with _buffer_lock:
+        _finished.clear()
+        _open.clear()
+        _root_ctx = None
+
+
+def publish_kv() -> None:
+    """Best-effort publish of the local span buffer into the GCS KV.
+    Bounded (5s) so a wedged control plane can never turn a shutdown
+    flush into a hang."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        return
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker(required=False)
+    if w is None:
+        return
+    spans = local_spans()
+    if not spans:
+        return
+    wid = w.worker_id.hex()[:12]
+    payload = json.dumps({"ts": time.time(), "worker": wid, "spans": spans})
+    w.run_coro(
+        w.gcs.call("kv_put", ns=KV_NAMESPACE, key=f"{KV_PREFIX}{wid}",
+                   value=payload.encode(), overwrite=True, timeout=2),
+        timeout=4)
+
+
+def flush() -> None:
+    """Synchronous best-effort publish (used by ``timeline()`` for the
+    local process and by worker shutdown so short-lived workers' spans
+    are not lost to the publish interval)."""
+    try:
+        publish_kv()
+    except Exception:  # noqa: BLE001 — flush must never fail the caller
+        pass
+
+
+def publish_interval_s() -> float:
+    # ONE cadence knob: the metrics module owns the parse (env name,
+    # floor, default); a drifted duplicate here would silently
+    # desynchronize the two publishers
+    from ray_tpu.util.metrics import publish_interval_s as _interval
+
+    return _interval()
+
+
+def _ensure_publisher() -> None:
+    global _publisher_started
+    if _publisher_started:
+        return
+    with _buffer_lock:
+        if _publisher_started:
+            return
+        _publisher_started = True
+
+    def loop():
+        while True:
+            time.sleep(publish_interval_s())
+            flush()
+
+    threading.Thread(target=loop, daemon=True, name="rtpu-trace-pub").start()
+
+
+def chrome_trace_events(task_events: List[Dict[str, Any]],
+                        spans: List[Dict[str, Any]] = (),
+                        ) -> List[Dict[str, Any]]:
+    """Render task events + published spans as chrome://tracing events.
+
+    Trace-stamped task events become a causally-linked tree: one ph=X box
+    for the task (``ts`` anchored at SUBMIT time, so owner-side latency is
+    visible) plus synthesized ``submit`` / ``queue`` / ``execute`` phase
+    children — submit is the owner-side pipeline (enqueue + lease + push
+    flight), queue is the executor-side wait for a thread/loop slot,
+    execute is the user function.  Phase spans carry deterministic ids
+    (``<task-span>.<phase>``) so parent links always resolve.  Events
+    without a trace context render exactly as before (execution box only).
+    """
+    events: List[Dict[str, Any]] = []
+    for e in task_events:
+        pid = e.get("node_id", "node")[:8]
+        tid = e.get("worker_id", "worker")
+        base_args = {"ok": e.get("ok"), "task_id": e.get("task_id")}
+        tr = e.get("trace") or {}
+        if not tr.get("trace_id"):
+            events.append({
+                "name": e["name"], "cat": e.get("kind", "TASK"), "ph": "X",
+                "ts": e["start"] * 1e6,
+                "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
+                "pid": pid, "tid": tid, "args": base_args,
+            })
+            continue
+        sid = tr["span_id"]
+        # clocks cross hosts: clamp each phase boundary into [prev, end]
+        submitted = min(tr.get("submitted_at") or e["start"], e["start"])
+        received = min(max(tr.get("received_at") or e["start"], submitted),
+                       e["start"])
+        events.append({
+            "name": e["name"], "cat": e.get("kind", "TASK"), "ph": "X",
+            "ts": submitted * 1e6,
+            "dur": max(e["end"] - submitted, 1e-6) * 1e6,
+            "pid": pid, "tid": tid,
+            "args": {**base_args, "trace_id": tr["trace_id"],
+                     "span_id": sid,
+                     "parent_span_id": tr.get("parent_span_id"),
+                     "phase": "task"},
+        })
+        for phase, t0, t1 in (("submit", submitted, received),
+                              ("queue", received, e["start"]),
+                              ("execute", e["start"], e["end"])):
+            events.append({
+                "name": phase, "cat": "PHASE", "ph": "X",
+                "ts": t0 * 1e6, "dur": max(t1 - t0, 1e-6) * 1e6,
+                "pid": pid, "tid": tid,
+                "args": {"task": e["name"], "task_id": e.get("task_id"),
+                         "trace_id": tr["trace_id"],
+                         "span_id": f"{sid}.{phase}",
+                         "parent_span_id": sid, "phase": phase},
+            })
+    for s in spans:
+        args = {"trace_id": s.get("trace_id"), "span_id": s.get("span_id"),
+                "parent_span_id": s.get("parent_span_id"),
+                "phase": s.get("kind") or "span"}
+        if s.get("open"):
+            args["open"] = True
+        if s.get("attrs"):
+            args.update(s["attrs"])
+        events.append({
+            "name": s["name"], "cat": s.get("kind") or "SPAN", "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": max((s.get("end") or s["start"]) - s["start"], 1e-6) * 1e6,
+            "pid": f"spans-{s.get('pid', 0)}", "tid": s.get("pid", 0),
+            "args": args,
+        })
+    return events
+
+
+def merge_span_payloads(raw_payloads) -> List[Dict[str, Any]]:
+    """Merge raw KV span records (JSON bytes/str) into a deduplicated
+    span list: a span republished across publish ticks keeps one record,
+    and an open span is superseded by its closed record.  Shared by the
+    state-API timeline (worker-side KV reads) and the dashboard (direct
+    head-side table reads) so the two exports can never diverge."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for raw in raw_payloads:
+        try:
+            payload = json.loads(raw)
+        except (ValueError, TypeError):
+            continue
+        for s in payload.get("spans", []):
+            sid = s.get("span_id")
+            if not sid:
+                continue
+            prev = by_id.get(sid)
+            if prev is None or (prev.get("open") and not s.get("open")):
+                by_id[sid] = s
+    return list(by_id.values())
+
+
+def collect_cluster_spans() -> List[Dict[str, Any]]:
+    """All published spans cluster-wide (see :func:`merge_span_payloads`)."""
+    from ray_tpu.experimental.internal_kv import _internal_kv_get_prefix
+
+    try:
+        table = _internal_kv_get_prefix(KV_PREFIX, namespace=KV_NAMESPACE)
+    except Exception:  # noqa: BLE001 — no cluster
+        return []
+    return merge_span_payloads((table or {}).values())

@@ -37,7 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import resilience, serialization
+from ray_tpu._private import resilience, serialization, tracing
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import (
     ActorID,
@@ -1231,8 +1231,24 @@ class CoreWorker:
         async def _attempt():
             await self._acquire_lease(lease, spec, avoid_node_ids)
 
-        await resilience.retry_call_async(
-            _attempt, policy=self._LEASE_RETRY_POLICY, site="worker.lease")
+        t0 = time.time()
+        try:
+            await resilience.retry_call_async(
+                _attempt, policy=self._LEASE_RETRY_POLICY,
+                site="worker.lease")
+        finally:
+            tc = spec.trace_ctx
+            if tc is not None:
+                # owner-side lease phase, a child of the task's span (the
+                # executor-side phases come from the task event instead)
+                tracing.record_span(
+                    "lease", t0, time.time(),
+                    tracing.SpanContext(tc["trace_id"],
+                                        tracing.new_span_id(),
+                                        tc["span_id"]),
+                    kind="lease",
+                    attrs={"task_id": spec.task_id.hex(),
+                           "node_id": lease.node_id})
 
     async def _release_lease_token(self, raylet: RpcClient, token: str):
         """Best-effort compensation for a lease call whose reply was lost
@@ -1663,6 +1679,10 @@ class CoreWorker:
     async def handle_push_task(self, spec_bytes: bytes) -> Dict:
         with serialization.uncounted_refs():
             spec: TaskSpec = serialization.loads(spec_bytes)
+        if spec.trace_ctx is not None:
+            # executor arrival: the submit phase ends here, the queue
+            # phase (executor-side wait for a thread/loop slot) begins
+            spec.trace_ctx["received_at"] = time.time()
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             return await self._exec_actor_creation(spec)
         if spec.task_type == TaskType.ACTOR_TASK:
@@ -1734,21 +1754,23 @@ class CoreWorker:
                 if spec.task_id in self._cancel_requested:
                     raise exc.TaskCancelledError(
                         f"task {spec.task_id.hex()[:8]} was cancelled")
-                gen = fn(*args, **kwargs)
-                for value in gen:
-                    if send_errors:
-                        raise send_errors[0]
-                    if spec.task_id in self._cancel_requested:
-                        raise exc.TaskCancelledError(
-                            f"task {spec.task_id.hex()[:8]} was cancelled")
-                    entry = self._package_stream_item(spec, count, value)
-                    # bounded pipeline: block the generator while the
-                    # window is full (the owner's delayed acks implement
-                    # consumer-lag backpressure on top)
-                    window.acquire()
-                    asyncio.run_coroutine_threadsafe(
-                        _send(count, entry), self.loop)
-                    count += 1
+                with tracing.task_scope(spec.trace_ctx):
+                    gen = fn(*args, **kwargs)
+                    for value in gen:
+                        if send_errors:
+                            raise send_errors[0]
+                        if spec.task_id in self._cancel_requested:
+                            raise exc.TaskCancelledError(
+                                f"task {spec.task_id.hex()[:8]} was "
+                                f"cancelled")
+                        entry = self._package_stream_item(spec, count, value)
+                        # bounded pipeline: block the generator while the
+                        # window is full (the owner's delayed acks
+                        # implement consumer-lag backpressure on top)
+                        window.acquire()
+                        asyncio.run_coroutine_threadsafe(
+                            _send(count, entry), self.loop)
+                        count += 1
                 with self._inject_lock:
                     self._running_task_threads.pop(spec.task_id, None)
                 ok = True
@@ -1812,13 +1834,14 @@ class CoreWorker:
                     # cancelled while args were resolving / task was queued
                     raise exc.TaskCancelledError(
                         f"task {spec.task_id.hex()[:8]} was cancelled")
-                if spec.runtime_env:
-                    from ray_tpu import runtime_env as renv
+                with tracing.task_scope(spec.trace_ctx):
+                    if spec.runtime_env:
+                        from ray_tpu import runtime_env as renv
 
-                    with renv.applied(spec.runtime_env):
+                        with renv.applied(spec.runtime_env):
+                            out = True, fn(*args, **kwargs)
+                    else:
                         out = True, fn(*args, **kwargs)
-                else:
-                    out = True, fn(*args, **kwargs)
                 # deregister under the injection lock while still inside
                 # the try: an already-issued async-exc lands HERE (caught
                 # below as a cancellation), never in the next task that
@@ -1851,12 +1874,17 @@ class CoreWorker:
         (reference: ``TaskEventBuffer`` → ``GcsTaskManager`` →
         ``ray timeline``, ``src/ray/core_worker/task_event_buffer.h``)."""
         name = spec.function.method_name or spec.function.qualname or "task"
-        self._task_events.append({
+        event = {
             "task_id": spec.task_id.hex(), "name": name,
             "kind": spec.task_type.name, "start": start, "end": end,
             "ok": ok, "worker_id": self.worker_id.hex()[:12],
             "node_id": self.node_id,
-        })
+        }
+        if spec.trace_ctx is not None:
+            # the causal link + phase anchors: timeline() synthesizes
+            # submit/queue/execute child spans from these timestamps
+            event["trace"] = dict(spec.trace_ctx)
+        self._task_events.append(event)
 
     def start_log_streaming(self):
         """Driver-side: stream worker stdout/stderr lines from the GCS log
@@ -2001,12 +2029,18 @@ class CoreWorker:
 
         def _create():
             token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            t0 = time.time()
+            ok = False
             try:
-                return True, cls(*args, **kwargs)
+                with tracing.task_scope(spec.trace_ctx):
+                    out = True, cls(*args, **kwargs)
+                ok = True
+                return out
             except BaseException as e:  # noqa: BLE001
                 return False, exc.TaskError.from_exception(e)
             finally:
                 _exec_ctx.reset(token)
+                self._record_task_event(spec, t0, time.time(), ok)
 
         ok, result = await self.loop.run_in_executor(self._task_executor, _create)
         if not ok:
@@ -2199,16 +2233,22 @@ class CoreWorker:
                 # queued on the semaphore still finds and cancels this task
                 self._running_async_tasks[spec.task_id] = (
                     asyncio.current_task())
+                t0 = time.time()
+                ok = False
                 try:
                     async with (sema if sema is not None
                                 else contextlib.nullcontext()):
                         token = _exec_ctx.set(
                             ExecutionContext(spec.task_id, spec.job_id,
                                              spec.actor_id))
+                        t0 = time.time()  # execute phase excludes sema wait
                         try:
                             if spec.task_id in self._cancel_requested:
                                 raise asyncio.CancelledError()
-                            return True, await method(*args, **kwargs)
+                            with tracing.task_scope(spec.trace_ctx):
+                                out = True, await method(*args, **kwargs)
+                            ok = True
+                            return out
                         finally:
                             _exec_ctx.reset(token)
                 except asyncio.CancelledError:
@@ -2219,6 +2259,10 @@ class CoreWorker:
                 finally:
                     self._running_async_tasks.pop(spec.task_id, None)
                     self._cancel_requested.discard(spec.task_id)
+                    # async methods were invisible to the task-event feed;
+                    # record them so the timeline shows the full causal
+                    # tree (they carry trace_ctx like every actor task)
+                    self._record_task_event(spec, t0, time.time(), ok)
 
             assert self._user_loop is not None, "async method on non-async actor"
             cfut = asyncio.run_coroutine_threadsafe(_run_coro(), self._user_loop)
@@ -2230,6 +2274,21 @@ class CoreWorker:
 
     async def _terminate_self(self):
         await asyncio.sleep(0.05)
+        # best-effort final telemetry: a short-lived worker's counters and
+        # spans would otherwise be lost to the publish interval.  Bounded:
+        # run in a thread with a hard exit behind it, so a wedged GCS can
+        # never turn termination into a hang.
+        def _final_publish_and_exit():
+            try:
+                from ray_tpu._private.worker import _final_telemetry_publish
+
+                _final_telemetry_publish()
+            finally:
+                os._exit(0)
+
+        t = threading.Thread(target=_final_publish_and_exit, daemon=True)
+        t.start()
+        await asyncio.sleep(2.0)
         os._exit(0)
 
     # ------------------------------------------------------------ rpc handlers
@@ -2417,6 +2476,9 @@ class CoreWorker:
     def shutdown(self):
         if self._shutdown:
             return
+        # final telemetry BEFORE tearing down the GCS client: driver-side
+        # counters/spans from a short session survive the publish interval
+        _final_telemetry_publish()
         self._shutdown = True
 
         async def _close():
@@ -2437,6 +2499,19 @@ class CoreWorker:
         self.shared_store.close(unlink_created=False)
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._loop_thread.join(timeout=2)
+
+
+def _final_telemetry_publish():
+    """Best-effort one-shot publish of metrics + trace spans (worker
+    shutdown / actor termination): without it a short-lived process's
+    telemetry never reaches the KV before the 5s interval fires."""
+    try:
+        from ray_tpu.util import metrics as metrics_mod
+
+        metrics_mod.final_publish()
+    except Exception:  # noqa: BLE001 — telemetry must never fail shutdown
+        pass
+    tracing.flush()
 
 
 # The process-wide worker singleton (reference: python/ray/_private/worker.py:426).

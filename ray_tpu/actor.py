@@ -84,6 +84,7 @@ class ActorHandle:
         return self._actor_id
 
     def _invoke(self, method_name: str, args, kwargs, options: Dict[str, Any]):
+        from ray_tpu._private import tracing
         from ray_tpu._private.worker import get_global_worker
 
         worker = get_global_worker()
@@ -112,6 +113,8 @@ class ActorHandle:
             max_concurrency=self._max_concurrency,
             is_async_actor=self._is_async,
             concurrency_group=options.get("concurrency_group", ""),
+            trace_ctx=tracing.mint_task_context(
+                f"{self._class_name}.{method_name}"),
         )
         refs = worker.submit_actor_task(spec, nested_arg_refs=nested_refs)
         if spec.num_returns == 1:
@@ -191,6 +194,7 @@ class ActorClass:
         return out
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._private import tracing
         from ray_tpu._private.config import config
         from ray_tpu._private.worker import get_global_worker
 
@@ -258,6 +262,8 @@ class ActorClass:
             is_async_actor=is_async,
             actor_name=name,
             namespace=namespace,
+            trace_ctx=tracing.mint_task_context(
+                f"{self._cls.__qualname__}.__init__"),
         )
         worker.run_coro(
             worker.gcs.call("create_actor", spec_bytes=serialization.dumps(spec))

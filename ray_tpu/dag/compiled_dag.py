@@ -447,7 +447,11 @@ class CompiledDAGRef:
         self._has_result = False
 
     def get(self, timeout: Optional[float] = None):
-        return self._dag._get_result(self, timeout)
+        from ray_tpu._private import tracing
+
+        with tracing.span("dag.get", kind="dag",
+                          attrs={"exec_idx": self._idx}):
+            return self._dag._get_result(self, timeout)
 
     def __repr__(self):
         return f"CompiledDAGRef(idx={self._idx})"
@@ -774,9 +778,16 @@ class CompiledDAG:
             raise RuntimeError("compiled DAG has been torn down")
         if self._dead_actor_error is not None:
             raise self._dead_actor_error
+        from ray_tpu._private import tracing
+
         with self._submit_lock:
-            self._input_channel.write((args, kwargs),
-                                      timeout=self.submit_timeout)
+            with tracing.span("dag.execute", kind="dag",
+                              attrs={"exec_idx": self._next_exec_idx}):
+                # the channel write is the (possibly backpressured) submit
+                # hop; node execution runs in the actors' standing loops,
+                # whose collective/nested spans join via their own paths
+                self._input_channel.write((args, kwargs),
+                                          timeout=self.submit_timeout)
             ref = CompiledDAGRef(self, self._next_exec_idx)
             self._next_exec_idx += 1
             return ref

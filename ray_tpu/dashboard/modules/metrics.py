@@ -8,18 +8,39 @@ Reference: ``dashboard/modules/metrics`` + the metrics agent's
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict
+
+# a worker that stopped publishing this long ago is gone (crashed without
+# a final publish, or evicted): its series are dropped AND its KV record
+# deleted, or dead workers would pin their last gauge values — and one KV
+# entry each — forever.  Matches the "data" namespace sweep from the
+# ingest plane (data/iterator.py _KV_STALE_S) and the trace-span sweep.
+STALE_S = 600.0
+
+
+def _sweep_stale(gcs, ns: str, key: str) -> None:
+    # head-side twin of handle_kv_del (the dashboard runs in the GCS
+    # process): drop + mark dirty so persistence notices
+    gcs.kv.pop((ns, key), None)
+    gcs._dirty = True
 
 
 def aggregate_metrics(gcs) -> Dict[str, Any]:
     merged: Dict[str, Any] = {}
-    for (ns, _key), raw in list(gcs.kv.items()):
-        if ns != "metrics":
+    now = time.time()
+    for (ns, key), raw in list(gcs.kv.items()):
+        if ns not in ("metrics", "trace"):
             continue
         try:
             payload = json.loads(raw)
         except (ValueError, TypeError):
             continue
+        if now - payload.get("ts", now) > STALE_S:
+            _sweep_stale(gcs, ns, key)
+            continue
+        if ns != "metrics":
+            continue  # trace records only get the stale sweep here
         for name, entry in payload.get("metrics", {}).items():
             if name not in merged:
                 merged[name] = {"kind": entry["kind"],

@@ -29,18 +29,15 @@ def routes(gcs, helpers):
         return jresp(out)
 
     async def api_timeline(_req):
-        # chrome://tracing export, one track per worker (same shape as
-        # ray_tpu.timeline() / the reference's `ray timeline`)
-        events = []
-        for e in gcs.task_events:
-            events.append({
-                "name": e["name"], "cat": e.get("kind", "TASK"), "ph": "X",
-                "ts": e["start"] * 1e6,
-                "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
-                "pid": e.get("node_id", "node")[:8],
-                "tid": e.get("worker_id", "worker"),
-                "args": {"ok": e.get("ok"), "task_id": e.get("task_id")},
-            })
+        # chrome://tracing export, one track per worker plus the causal
+        # span layer (same renderer as ray_tpu.util.state.timeline())
+        from ray_tpu._private import tracing
+
+        spans = tracing.merge_span_payloads(
+            raw for (ns, key), raw in list(gcs.kv.items())
+            if ns == tracing.KV_NAMESPACE
+            and key.startswith(tracing.KV_PREFIX))
+        events = tracing.chrome_trace_events(list(gcs.task_events), spans)
         return web.Response(
             text=json.dumps(events),
             content_type="application/json",

@@ -1,31 +1,54 @@
-"""Train module: run status / progress view.
+"""Train module: run status / progress view + step-time breakdown.
 
 Reference: ``dashboard/modules/train``.  Each TrainController publishes
 its run's status (world size, latest rank-0 metrics, restarts, state)
-into the GCS KV under namespace "train" while the run is live; the head
-lists all runs with plain table reads.
+into the GCS KV under namespace "train" while the run is live; each
+worker's :class:`~ray_tpu.train.session.StepLedger` publishes its
+step-time attribution under ``step_breakdown/<group>/<rank>`` in the
+same namespace.  The head lists both with plain table reads; breakdown
+records from workers silent past the stale window are dropped (and
+swept — dead workers must not pin their last breakdown forever).
 """
 
 from __future__ import annotations
 
 import json
+import time
+
+_STALE_S = 600.0
 
 
 def routes(gcs, helpers):
     jresp = helpers["jresp"]
 
-    async def api_train(_req):
-        runs = []
+    def _split_tables():
+        runs, breakdowns = [], []
+        now = time.time()
         for (ns, key), raw in list(gcs.kv.items()):
             if ns != "train":
                 continue
             try:
-                run = json.loads(raw)
+                rec = json.loads(raw)
             except (ValueError, TypeError):
                 continue
-            run.setdefault("name", key)
-            runs.append(run)
+            if key.startswith("step_breakdown/"):
+                if now - rec.get("ts", now) > _STALE_S:
+                    # head-side twin of handle_kv_del (same process)
+                    gcs.kv.pop((ns, key), None)
+                    gcs._dirty = True
+                    continue
+                rec.setdefault("key", key[len("step_breakdown/"):])
+                breakdowns.append(rec)
+            else:
+                rec.setdefault("name", key)
+                runs.append(rec)
         runs.sort(key=lambda r: r.get("started_at", 0.0), reverse=True)
-        return jresp({"runs": runs})
+        breakdowns.sort(key=lambda r: (r.get("group", ""),
+                                       r.get("rank", 0)))
+        return runs, breakdowns
+
+    async def api_train(_req):
+        runs, breakdowns = _split_tables()
+        return jresp({"runs": runs, "step_breakdowns": breakdowns})
 
     return [("GET", "/api/train", api_train)]

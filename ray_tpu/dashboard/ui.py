@@ -84,6 +84,16 @@ async function renderOverview(root) {
     name: r.name, status: r.status, world: r.world_size,
     iteration: r.iteration, restarts: r.restarts,
     metrics: r.latest_metrics}));
+  const stepRows = (train.step_breakdowns || []).map(r => {
+    const f = r.fractions || {};
+    const pct = k => ((f[k] || 0) * 100).toFixed(1) + "%";
+    return {group: r.group, rank: r.rank, steps: r.steps,
+      "step ms": (Number(r.step_wall_s || 0) * 1000).toFixed(1),
+      compute: pct("compute"), "data wait": pct("data_wait"),
+      h2d: pct("h2d"), "coll wait": pct("collective_wait"),
+      ckpt: pct("checkpoint"), "w-pub": pct("weight_publish"),
+      other: pct("other")};
+  });
   const dataRows = (data.iterators || []).map(r => ({
     iterator: r.iterator, state: r.done ? "done" : "running",
     blocks: r.blocks, batches: r.batches,
@@ -115,6 +125,11 @@ async function renderOverview(root) {
       : "<i>serve not running</i>") +
     "<h2>Train runs</h2>" + table(trainRows,
       ["name","status","world","iteration","restarts","metrics"]) +
+    "<h2>Step breakdown</h2>" + (stepRows.length
+      ? table(stepRows, ["group","rank","steps","step ms","compute",
+                         "data wait","h2d","coll wait","ckpt","w-pub",
+                         "other"])
+      : "<i>no step ledger reporting</i>") +
     "<h2>Data ingest</h2>" + table(dataRows,
       ["iterator","state","blocks","batches","MB","xnode MB","fetch s",
        "blocked s","h2d s","locality","dev buf"]) +

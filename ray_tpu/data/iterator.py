@@ -41,6 +41,7 @@ from ray_tpu._private.concurrency import (
     get_live,
     put_unless_stopped,
 )
+from ray_tpu._private import tracing
 from ray_tpu.data.block import BlockAccessor, concat_blocks
 from ray_tpu.data.context import DataContext
 
@@ -106,6 +107,14 @@ class IngestStats:
     def add(self, field: str, value: float) -> None:
         with self._lock:
             self._fields[field] += value
+        # feed the step-time attribution ledger (train.StepLedger): a
+        # consumer-facing stall is data-wait, device staging is H2D.  One
+        # dict check when no ledger step is active (tracing.note_duration
+        # fast path) — the ingest hot loop stays unburdened.
+        if field == "consumer_blocked_s":
+            tracing.note_duration("data_wait", value)
+        elif field == "h2d_s":
+            tracing.note_duration("h2d", value)
 
     def set_max(self, field: str, value: float) -> None:
         with self._lock:

@@ -56,6 +56,7 @@ class RemoteFunction:
         return cached
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._private import tracing
         from ray_tpu._private.config import config
         from ray_tpu._private.worker import get_global_worker
 
@@ -85,6 +86,8 @@ class RemoteFunction:
             runtime_env=self._packaged_runtime_env(worker),
             backpressure_num_objects=int(
                 opts.get("_generator_backpressure_num_objects", 0) or 0),
+            trace_ctx=tracing.mint_task_context(
+                getattr(self._function, "__qualname__", "fn")),
         )
         refs = worker.submit_task(spec, nested_arg_refs=nested_refs)
         if spec.num_returns == 1:

@@ -57,6 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu._private import tracing
 from ray_tpu.util import fault_injection
 
 logger = logging.getLogger(__name__)
@@ -623,9 +624,14 @@ class _LoopRuntime:
                     "actions": jb["actions"],
                     "rewards": jb["rewards"],
                 })
+                t_up = time.perf_counter()
                 self.params, self.opt_state, loss = self.update_fn(
                     self.params, self.opt_state, batch)
                 losses.append(float(jax.device_get(loss)))
+                # loss readback synchronizes the device: charge the jitted
+                # update (+sync) to the ledger's compute bucket
+                tracing.note_duration("compute",
+                                      time.perf_counter() - t_up)
                 rewards.append(float(np.mean(np.asarray(
                     jax.device_get(jb["rewards"])))))
                 n_rows += int(jb["actions"].shape[0])
@@ -688,50 +694,67 @@ def _rlhf_train_loop(config: Dict[str, Any]) -> None:
     cfg = RLHFConfig(**config["rlhf"])
     ctx = train.get_context()
     rt = _LoopRuntime(cfg, ctx)
+    ledger = ctx.step_ledger()
     try:
         for it in range(rt.start_iter, cfg.iterations):
             if rt.chaos.get("kill_rollout_at_iter") == it + 1:
                 rt.rollout.chaos_kill_pending = True
-            batches = rt.rollout.sample_all(cfg.rollout_batch)
-            batches = rt.score(batches)
-            stats = rt.consume(_batches_to_dataset(batches, rt.ledger))
-            if rt.world > 1:
-                rt.allreduce_params()
-            if rt.rank != 0:
-                train.report({"training_iteration": it + 1,
-                              "rank": rt.rank})
-                continue
-            ver = rt.publish(jax.device_get(rt.params))
-            metrics = {
-                "training_iteration": it + 1,
-                "published_version": int(ver.version),
-                "publisher_epoch": int(ver.epoch),
-                "consumed_versions": list(rt.consumed_versions),
-                "publish_faults_fired":
-                    fault_injection.fired_count("rl.weight_sync.publish"),
-                "reward_faults_fired":
-                    fault_injection.fired_count("rl.reward.score"),
-                "respawns_used":
-                    cfg.respawn_budget - rt.rollout.respawns_left,
-                "dropped_runners": rt.rollout.dropped_runners,
-                "stale_minibatches": rt.stale_minibatches,
-                **rt.ledger.counts(),
-                **{f"publisher_{k}": v
-                   for k, v in rt.publisher.stats.items()},
-                **stats,
-            }
-            want_ckpt = ((it + 1) % cfg.checkpoint_every == 0
-                         or it + 1 == cfg.iterations
-                         or ctx.drain_requested())
-            checkpoint = None
-            if want_ckpt:
-                checkpoint = Checkpoint.from_pytree({
-                    "params": jax.device_get(rt.params),
-                    "iteration": it + 1,
-                    "version": int(ver.version),
-                    "ledger": rt.ledger.state_dict(),
-                })
-            train.report(metrics, checkpoint=checkpoint)
+            # one causal tree per iteration: rollout actor calls, reward
+            # tasks, data ingest, collective allreduce and the weight
+            # publish all share this trace_id in `raytpu timeline`; the
+            # step ledger buckets the same wall time (collective_wait and
+            # weight_publish auto-attribute, ingest feeds data_wait/h2d)
+            with tracing.trace("rlhf.iteration",
+                               attrs={"iter": it + 1, "rank": rt.rank}), \
+                    ledger.step():
+                with tracing.span("rlhf.rollout", kind="phase"):
+                    batches = rt.rollout.sample_all(cfg.rollout_batch)
+                with tracing.span("rlhf.reward", kind="phase"):
+                    batches = rt.score(batches)
+                with tracing.span("rlhf.update", kind="phase"):
+                    stats = rt.consume(
+                        _batches_to_dataset(batches, rt.ledger))
+                if rt.world > 1:
+                    rt.allreduce_params()
+                if rt.rank != 0:
+                    train.report({"training_iteration": it + 1,
+                                  "rank": rt.rank})
+                    continue
+                with tracing.span("rlhf.publish", kind="phase"):
+                    ver = rt.publish(jax.device_get(rt.params))
+                metrics = {
+                    "training_iteration": it + 1,
+                    "published_version": int(ver.version),
+                    "publisher_epoch": int(ver.epoch),
+                    "consumed_versions": list(rt.consumed_versions),
+                    "publish_faults_fired":
+                        fault_injection.fired_count(
+                            "rl.weight_sync.publish"),
+                    "reward_faults_fired":
+                        fault_injection.fired_count("rl.reward.score"),
+                    "respawns_used":
+                        cfg.respawn_budget - rt.rollout.respawns_left,
+                    "dropped_runners": rt.rollout.dropped_runners,
+                    "stale_minibatches": rt.stale_minibatches,
+                    **rt.ledger.counts(),
+                    **{f"publisher_{k}": v
+                       for k, v in rt.publisher.stats.items()},
+                    **stats,
+                }
+                want_ckpt = ((it + 1) % cfg.checkpoint_every == 0
+                             or it + 1 == cfg.iterations
+                             or ctx.drain_requested())
+                checkpoint = None
+                if want_ckpt:
+                    with tracing.span("rlhf.checkpoint", kind="phase"), \
+                            ledger.bucket("checkpoint"):
+                        checkpoint = Checkpoint.from_pytree({
+                            "params": jax.device_get(rt.params),
+                            "iteration": it + 1,
+                            "version": int(ver.version),
+                            "ledger": rt.ledger.state_dict(),
+                        })
+                train.report(metrics, checkpoint=checkpoint)
     finally:
         rt.close()
 

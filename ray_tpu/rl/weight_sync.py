@@ -203,6 +203,9 @@ class WeightPublisher:
         commit can never skip or tear a version."""
         import ray_tpu
 
+        from ray_tpu._private import tracing
+
+        t_pub0 = time.perf_counter()
         version = self._version + 1
         epoch = self._epoch
         digest = params_digest(params, version, epoch)
@@ -232,6 +235,17 @@ class WeightPublisher:
             del self._pinned[v]
         self.stats["publishes"] += 1
         self._channel_notify(payload, record)
+        # attribution: publish wall time is the step ledger's
+        # weight_publish bucket, and a span in the caller's trace
+        dt = time.perf_counter() - t_pub0
+        tracing.note_duration("weight_publish", dt)
+        if tracing.is_enabled():
+            now = time.time()
+            tracing.record_span(
+                "weights.publish", now - dt, now,
+                tracing.current_or_root().child(), kind="weight_publish",
+                attrs={"name": self.name, "version": version,
+                       "epoch": epoch})
         return WeightVersion(version, epoch)
 
     def _channel_notify(self, payload: Dict[str, Any],

@@ -304,7 +304,20 @@ def cmd_timeline(args):
     from ray_tpu.util import state as state_api
 
     events = state_api.timeline(args.output)
+    # per-trace summary: one causal tree per request/step (the span layer
+    # of docs/observability.md) — how many connected trees the export
+    # holds and how big each is, so `raytpu timeline` answers "did my
+    # request/step form ONE trace" without opening the viewer
+    traces = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            traces[tid] = traces.get(tid, 0) + 1
     print(f"Wrote {len(events)} events to {args.output}")
+    if traces:
+        top = sorted(traces.items(), key=lambda kv: -kv[1])[:8]
+        print(f"{len(traces)} trace(s); largest: "
+              + ", ".join(f"{t[:8]}…×{n}" for t, n in top))
     ray_tpu.shutdown()
 
 

@@ -37,6 +37,11 @@ class RequestContext:
 
     request_id: str
     deadline_s: Optional[float] = None
+    # causal trace context (tracing.SpanContext.to_dict()): one fresh
+    # trace per request, minted at the ingress with the RequestContext
+    # itself; every hop that installs the request scope also installs
+    # this, so replica-side task submissions parent to the request span.
+    trace_ctx: Optional[Dict[str, Any]] = None
 
     def remaining_s(self) -> Optional[float]:
         if self.deadline_s is None:
@@ -52,7 +57,8 @@ class RequestContext:
         return max(0.0, time.time() - self.deadline_s)
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"request_id": self.request_id, "deadline_s": self.deadline_s}
+        return {"request_id": self.request_id, "deadline_s": self.deadline_s,
+                "trace_ctx": self.trace_ctx}
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]
@@ -60,7 +66,8 @@ class RequestContext:
         if not d:
             return None
         return cls(request_id=d.get("request_id", ""),
-                   deadline_s=d.get("deadline_s"))
+                   deadline_s=d.get("deadline_s"),
+                   trace_ctx=d.get("trace_ctx"))
 
 
 _request_ctx: contextvars.ContextVar[Optional[RequestContext]] = \
@@ -75,11 +82,27 @@ def current_context() -> Optional[RequestContext]:
 def new_request_context(*, timeout_s: Optional[float],
                         request_id: Optional[str] = None) -> RequestContext:
     """Mint an ingress context: ``timeout_s`` from now becomes the
-    request's ABSOLUTE deadline.  Every proxy route must call this (with a
+    request's ABSOLUTE deadline, and a FRESH trace is rooted here — one
+    causal tree per request.  Every proxy route must call this (with a
     real timeout) before touching a deployment handle."""
+    from ray_tpu._private import tracing
+
+    rid = request_id or uuid.uuid4().hex[:16]
+    trace_ctx = None
+    if tracing.is_enabled():
+        ctx = tracing.SpanContext(tracing.new_trace_id(),
+                                  tracing.new_span_id(), None)
+        # record the request root at mint time (near-zero duration): the
+        # tree's spans parent to it, and an ingress can't know when the
+        # last hop retires — the per-hop spans carry the durations
+        now = time.time()
+        tracing.record_span("serve.request", now, now, ctx, kind="request",
+                            attrs={"request_id": rid})
+        trace_ctx = ctx.to_dict()
     return RequestContext(
-        request_id=request_id or uuid.uuid4().hex[:16],
-        deadline_s=None if timeout_s is None else time.time() + timeout_s)
+        request_id=rid,
+        deadline_s=None if timeout_s is None else time.time() + timeout_s,
+        trace_ctx=trace_ctx)
 
 
 @contextlib.contextmanager
@@ -91,10 +114,21 @@ def scope(ctx: Optional[RequestContext]) -> Iterator[None]:
     explicitly) and by the replica around the user callable so nested
     handle calls inherit the remaining budget.
     """
+    from ray_tpu._private import tracing
+
     token = _request_ctx.set(ctx)
+    # carry the trace context alongside the deadline: handle calls made
+    # inside the scope parent to the request's trace root
+    trace_token = None
+    span_ctx = tracing.SpanContext.from_dict(
+        ctx.trace_ctx if ctx is not None else None)
+    if span_ctx is not None:
+        trace_token = tracing.set_current(span_ctx)
     try:
         yield
     finally:
+        if trace_token is not None:
+            tracing.reset_current(trace_token)
         _request_ctx.reset(token)
 
 

@@ -24,6 +24,7 @@ from ray_tpu.train.policies import (
     ScalingPolicy,
 )
 from ray_tpu.train.session import (
+    StepLedger,
     TrainContext,
     get_context,
     get_dataset_shard,
@@ -45,6 +46,6 @@ __all__ = [
     "FailureDecision", "FailurePolicy", "FixedScalingPolicy", "ResizeDecision",
     "ScalingPolicy", "TrainContext", "get_context", "get_dataset_shard",
     "get_mesh", "shard_inputs", "shard_params",
-    "profile", "report", "DataParallelTrainer", "JaxTrainer",
+    "profile", "report", "StepLedger", "DataParallelTrainer", "JaxTrainer",
     "initialize_jax_distributed", "latest_committed_checkpoint",
 ]

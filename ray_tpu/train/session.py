@@ -9,14 +9,201 @@ controller polls (Train-v2 poll-based worker group,
 
 from __future__ import annotations
 
+import contextlib
+import json
 import queue
 import threading
-from typing import Any, Dict, Optional
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 
 _session_lock = threading.Lock()
 _session: Optional["_TrainSession"] = None
+
+
+class StepLedger:
+    """Per-training-step wall-time attribution: where did this step go?
+
+    Buckets every second of a step into ``data_wait`` (blocked on the
+    ingest pipeline), ``h2d`` (host→device staging), ``compute`` (the
+    jitted update), ``collective_wait`` (supervised collective ops —
+    auto-attributed via the tracing duration-sink, no loop changes),
+    ``checkpoint``, ``weight_publish`` (auto-attributed by the RL
+    weight-sync publisher), and ``other`` (the unexplained remainder).
+    The MFU number finally gets a denominator breakdown::
+
+        ledger = train.get_context().step_ledger()
+        for batch in it:
+            with ledger.step():
+                with ledger.bucket("compute"):
+                    state, m = train_step(state, batch)
+
+    Emissions: a ``train_step_bucket_s`` histogram series per bucket, a
+    ``step_breakdown/<group>/<rank>`` KV record for the dashboard's
+    step-breakdown panel (throttled), and a ``train.step`` span in the
+    current trace.  Standalone-constructible (``StepLedger(group_name=
+    "bench")``) — bench.py uses it without a session.
+    """
+
+    BUCKETS = ("data_wait", "h2d", "compute", "collective_wait",
+               "checkpoint", "weight_publish")
+
+    _PUBLISH_EVERY_S = 2.0
+    _HISTORY = 64
+
+    def __init__(self, group_name: str = "", rank: int = 0,
+                 publish: bool = True):
+        self.group_name = group_name
+        self.rank = rank
+        self._publish = publish
+        self._lock = threading.Lock()  # sinks fire from prefetch threads
+        self._cur: Dict[str, float] = {}
+        self._in_step = False
+        self._step_idx = 0
+        self._history: deque = deque(maxlen=self._HISTORY)
+        self._totals: Dict[str, float] = {}
+        self._total_wall = 0.0
+        self._last_publish = 0.0
+        self._metric = None
+
+    # -- accumulation -------------------------------------------------------
+
+    def note(self, bucket: str, seconds: float) -> None:
+        """Attribute ``seconds`` to ``bucket`` in the current step (no-op
+        between steps, so pipelined background work between boundaries is
+        not mischarged)."""
+        if not self._in_step or seconds <= 0:
+            return
+        with self._lock:
+            if self._in_step:
+                self._cur[bucket] = self._cur.get(bucket, 0.0) + seconds
+
+    @contextlib.contextmanager
+    def bucket(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.note(name, time.perf_counter() - t0)
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator["StepLedger"]:
+        """Mark one training-step boundary; nesting is rejected."""
+        from ray_tpu._private import tracing
+
+        if self._in_step:
+            raise RuntimeError("StepLedger.step() does not nest")
+        with self._lock:
+            self._cur = {}
+            self._in_step = True
+        # route auto-attributed durations (collective_wait from the
+        # supervision spine, weight_publish from the RL publisher,
+        # data_wait/h2d from the ingest plane) into this step
+        token = tracing.register_duration_sink(self.note)
+        t0 = time.perf_counter()
+        start_wall = time.time()
+        try:
+            yield self
+        finally:
+            wall = time.perf_counter() - t0
+            tracing.unregister_duration_sink(token)
+            with self._lock:
+                self._in_step = False
+                buckets = dict(self._cur)
+            self._finish_step(buckets, wall, start_wall)
+
+    # -- per-step bookkeeping ----------------------------------------------
+
+    def _finish_step(self, buckets: Dict[str, float], wall: float,
+                     start_wall: float) -> None:
+        from ray_tpu._private import tracing
+
+        accounted = sum(buckets.values())
+        buckets["other"] = max(0.0, wall - accounted)
+        self._step_idx += 1
+        entry = {"step": self._step_idx, "wall_s": wall,
+                 "buckets": buckets}
+        self._history.append(entry)
+        for k, v in buckets.items():
+            self._totals[k] = self._totals.get(k, 0.0) + v
+        self._total_wall += wall
+        try:
+            self._observe_metrics(buckets, wall)
+        except Exception:  # noqa: BLE001 — attribution must never fail a step
+            pass
+        if tracing.is_enabled():
+            ctx = tracing.current_or_root().child()
+            tracing.record_span(
+                "train.step", start_wall, start_wall + wall, ctx,
+                kind="step",
+                attrs={"step": self._step_idx, "group": self.group_name,
+                       "rank": self.rank,
+                       **{f"{k}_ms": round(v * 1e3, 3)
+                          for k, v in buckets.items()}})
+        if self._publish and \
+                time.time() - self._last_publish > self._PUBLISH_EVERY_S:
+            self._last_publish = time.time()
+            try:
+                self._publish_kv()
+            except Exception:  # noqa: BLE001 — best-effort surfacing
+                pass
+
+    def _observe_metrics(self, buckets: Dict[str, float],
+                         wall: float) -> None:
+        if self._metric is None:
+            from ray_tpu.util.metrics import Histogram
+
+            self._metric = Histogram(
+                "train_step_bucket_s",
+                "per-step wall time attributed to each step-ledger bucket",
+                boundaries=[0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0],
+                tag_keys=("bucket", "group"))
+        for k, v in buckets.items():
+            self._metric.observe(v, tags={"bucket": k,
+                                          "group": self.group_name or "-"})
+
+    # -- read-out -----------------------------------------------------------
+
+    def last_breakdown(self) -> Optional[Dict[str, Any]]:
+        return dict(self._history[-1]) if self._history else None
+
+    def breakdown(self) -> Dict[str, Any]:
+        """Aggregate view: mean seconds and fraction per bucket across
+        recorded steps — the ``step_time_breakdown`` block bench records."""
+        n = max(self._step_idx, 1)
+        wall = self._total_wall
+        out: Dict[str, Any] = {
+            "steps": self._step_idx,
+            "step_wall_s": wall / n,
+            "buckets_s": {k: v / n for k, v in self._totals.items()},
+            "fractions": {k: (v / wall if wall > 0 else 0.0)
+                          for k, v in self._totals.items()},
+        }
+        return out
+
+    def _publish_kv(self) -> None:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            return
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker(required=False)
+        if w is None:
+            return
+        rec = {"ts": time.time(), "group": self.group_name,
+               "rank": self.rank, **self.breakdown(),
+               "last": self.last_breakdown()}
+        key = f"step_breakdown/{self.group_name or 'default'}/{self.rank}"
+        # bounded: this runs inline at a step boundary — a wedged GCS
+        # must cost the training loop at most the timeout, never a hang
+        w.run_coro(
+            w.gcs.call("kv_put", ns="train", key=key,
+                       value=json.dumps(rec).encode(), overwrite=True,
+                       timeout=2),
+            timeout=4)
 
 
 class _TrainSession:
@@ -51,6 +238,8 @@ class _TrainSession:
         # drain (preemption) notice: the loop should checkpoint at its
         # next step boundary; cleared when a checkpoint is reported
         self.checkpoint_requested = threading.Event()
+        # lazy per-session step-time attribution ledger (step_ledger())
+        self._ledger: Optional[StepLedger] = None
 
 
 def _start_session(**kw) -> _TrainSession:
@@ -215,6 +404,14 @@ class TrainContext:
         """Shard input arrays over the mesh's data axes (see
         :func:`shard_inputs`)."""
         return shard_inputs(batch, logical_axes=logical_axes, rules=rules)
+
+    def step_ledger(self) -> StepLedger:
+        """This worker's step-time attribution ledger (one per session;
+        see :class:`StepLedger`)."""
+        s = _get_session()
+        if s._ledger is None:
+            s._ledger = StepLedger(group_name=s.group_name, rank=s.rank)
+        return s._ledger
 
     def drain_requested(self) -> bool:
         """True when the node hosting this worker received a drain

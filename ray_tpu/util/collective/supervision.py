@@ -249,6 +249,29 @@ def aggregate_status_records(records) -> List[Dict[str, Any]]:
     return sorted(groups.values(), key=lambda g: g["group_name"])
 
 
+def _note_op_span(group: "SupervisedGroup", op: str,
+                  entry: Dict[str, Any]) -> None:
+    """Flight-recorder entry → trace span + ``collective_wait`` ledger
+    time.  Runs in the op's finally (success AND failure paths) so a hung
+    op that finally aborts still shows its full wall time in the trace."""
+    try:
+        from ray_tpu._private import tracing
+
+        t0 = entry["t_start"]
+        t1 = time.time()
+        tracing.note_duration("collective_wait", t1 - t0)
+        if not tracing.is_enabled():
+            return
+        ctx = tracing.current_or_root().child()
+        tracing.record_span(
+            f"collective.{op}", t0, t1, ctx, kind="collective",
+            attrs={"group": group.group_name, "rank": group.rank,
+                   "seq": entry.get("seq"),
+                   "shape": str(entry.get("shape"))})
+    except Exception:  # noqa: BLE001 — tracing must never fail an op
+        pass
+
+
 def _supervised(fn):
     """Route a group op through the supervision spine (seq number, flight
     recorder, ``collective.op`` fault site, abort-aware error mapping)."""
@@ -385,7 +408,15 @@ class SupervisedGroup:
         self._inflight = entry
         try:
             fault_point("collective.op")
-            out = fn(self, *args, **kwargs)
+            try:
+                out = fn(self, *args, **kwargs)
+            finally:
+                # supervision seq → span event: every op becomes a span in
+                # the caller's trace (child of the enclosing task span),
+                # and its wall time feeds the step ledger's
+                # collective_wait bucket.  One enabled-check when tracing
+                # is off; zero behavioral coupling to the op itself.
+                _note_op_span(self, op, entry)
             if self._state is GroupState.ABORTED:
                 # the watchdog fired while this op was still running and
                 # the backend's abort() could not interrupt it (XLA): the

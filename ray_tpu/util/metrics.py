@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -18,6 +19,19 @@ from typing import Dict, List, Optional, Sequence, Tuple
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _publisher_started = False
+
+# publish cadence, shared with the trace-span publisher (tracing.py)
+ENV_PUBLISH_INTERVAL = "RAY_TPU_METRICS_INTERVAL_S"
+
+
+def publish_interval_s() -> float:
+    """Effective publish interval: ``RAY_TPU_METRICS_INTERVAL_S`` env
+    (read per tick, so tests and long-lived jobs can retune it live),
+    floored at 0.2s, default 5s."""
+    try:
+        return max(0.2, float(os.environ.get(ENV_PUBLISH_INTERVAL, "5") or 5))
+    except ValueError:
+        return 5.0
 
 
 def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
@@ -124,9 +138,8 @@ def collect_local() -> Dict[str, Dict]:
     return out
 
 
-def _publish_once():
+def _publish_once(timeout: Optional[float] = None):
     import ray_tpu
-    from ray_tpu.experimental.internal_kv import _internal_kv_put
 
     if not ray_tpu.is_initialized():
         return
@@ -137,6 +150,8 @@ def _publish_once():
         return
     wid = w.worker_id.hex()[:12]
     data = collect_local()
+    if not data:
+        return
     # tag every series with the publishing worker: the dashboard aggregator
     # concatenates across workers, and duplicate label sets would be an
     # invalid Prometheus exposition
@@ -146,8 +161,20 @@ def _publish_once():
         for h in entry.get("histogram", []):
             h["tags"] = dict(h["tags"], worker=wid)
     payload = json.dumps({"ts": time.time(), "metrics": data})
-    _internal_kv_put(f"metrics/{wid}".encode(), payload.encode(),
-                     namespace="metrics")
+    w.run_coro(
+        w.gcs.call("kv_put", ns="metrics", key=f"metrics/{wid}",
+                   value=payload.encode(), overwrite=True, timeout=timeout),
+        timeout=None if timeout is None else timeout + 3)
+
+
+def final_publish():
+    """Best-effort bounded flush at worker/driver shutdown: a process
+    shorter-lived than the publish interval would otherwise lose every
+    counter it ever incremented."""
+    try:
+        _publish_once(timeout=2)
+    except Exception:  # noqa: BLE001 — telemetry must never fail shutdown
+        pass
 
 
 def _ensure_publisher():
@@ -159,7 +186,7 @@ def _ensure_publisher():
 
     def loop():
         while True:
-            time.sleep(5.0)
+            time.sleep(publish_interval_s())
             try:
                 _publish_once()
             except Exception:
@@ -168,11 +195,21 @@ def _ensure_publisher():
     threading.Thread(target=loop, daemon=True, name="rtpu-metrics").start()
 
 
+def _escape_label_value(v: str) -> str:
+    """Exposition-format label-value escaping: backslash, double-quote and
+    newline are the three characters the spec requires escaped — raw, any
+    of them terminates/corrupts the ``{k="v"}`` token and scrapers reject
+    the whole page."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text(all_metrics: Dict[str, Dict]) -> str:
     """Render aggregated metrics in Prometheus exposition format
     (reference: ``python/ray/_private/prometheus_exporter.py``)."""
     def labels(tags: Dict[str, str], extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in sorted(tags.items())]
+        parts = [f'{k}="{_escape_label_value(v)}"'
+                 for k, v in sorted(tags.items())]
         if extra:
             parts.append(extra)
         return f"{{{','.join(parts)}}}" if parts else ""
@@ -181,7 +218,10 @@ def prometheus_text(all_metrics: Dict[str, Dict]) -> str:
     for name, entry in sorted(all_metrics.items()):
         safe = name.replace("-", "_").replace(".", "_")
         if entry.get("description"):
-            lines.append(f"# HELP {safe} {entry['description']}")
+            # HELP text has its own (smaller) escape set: backslash + newline
+            help_text = (str(entry["description"])
+                         .replace("\\", "\\\\").replace("\n", "\\n"))
+            lines.append(f"# HELP {safe} {help_text}")
         lines.append(f"# TYPE {safe} {entry['kind']}")
         if entry["kind"] == "histogram":
             # exposition format requires _bucket{le}/_sum/_count series

@@ -147,22 +147,22 @@ def summarize_tasks() -> Dict[str, Dict[str, Any]]:
 
 
 def timeline(filename: Optional[str] = None):
-    """Export a chrome://tracing timeline: task execution spans (ph=X, one
-    track per worker) + cluster lifecycle instants (reference
-    ``python/ray/_private/state.py:444 profile_events`` → ``ray timeline``)."""
+    """Export a chrome://tracing timeline: one causally-linked tree per
+    trace — task boxes anchored at submit time with synthesized
+    submit/queue/execute phase children, owner-side lease spans, and every
+    span published through the trace KV channel (collective ops, serve
+    requests, RLHF/step phases) — plus cluster lifecycle instants
+    (reference ``python/ray/_private/state.py:444 profile_events`` →
+    ``ray timeline``; causal layer: docs/observability.md)."""
+    from ray_tpu._private import tracing
+
     w = _worker()
-    events = []
-    for e in w.run_coro(w.gcs.call("get_task_events")):
-        events.append({
-            "name": e["name"],
-            "cat": e.get("kind", "TASK"),
-            "ph": "X",
-            "ts": e["start"] * 1e6,
-            "dur": max(e["end"] - e["start"], 1e-6) * 1e6,
-            "pid": e.get("node_id", "node")[:8],
-            "tid": e.get("worker_id", "worker"),
-            "args": {"ok": e.get("ok"), "task_id": e.get("task_id")},
-        })
+    # local spans first (synchronous): the driver's own spans — lease
+    # phases, trace roots — must never lag the publish interval
+    tracing.flush()
+    task_events = w.run_coro(w.gcs.call("get_task_events"))
+    events = tracing.chrome_trace_events(
+        task_events, tracing.collect_cluster_spans())
     reply = w.run_coro(w.gcs.call("subscribe", cursor=0, timeout=0.01))
     for e in reply.get("events", []):
         events.append({

@@ -93,6 +93,59 @@ class Joined:
     assert not r.findings, r.findings
 
 
+def test_span_hygiene_fixtures(tmp_path):
+    bad = """from ray_tpu._private import tracing
+
+class Loop:
+    def begin(self):
+        self._span = tracing.start_span("loop")  # stashed, never ended
+
+    def tick(self):
+        pass
+
+def leak_cm():
+    s = tracing.span("work")  # CM stashed instead of with-entered
+    return s
+
+def drop_handle():
+    tracing.start_span("orphan")  # handle dropped on the floor
+"""
+    r = lint_tree(tmp_path, {"ray_tpu/bad.py": bad},
+                  rules=["span-hygiene"])
+    assert rules_of(r) == ["span-hygiene"] * 3, r.findings
+
+    good = """from ray_tpu._private import tracing
+
+class Loop:
+    def begin(self):
+        self._span = tracing.start_span("loop")
+
+    def stop(self):
+        if self._span is not None:
+            self._span.end()
+
+def lexical():
+    with tracing.span("work"):
+        pass
+    with tracing.trace("request"):
+        pass
+
+def handoff():
+    s = tracing.start_span("phase")
+    return s  # caller owns the lifetime
+
+def local_closed():
+    s = tracing.start_span("phase")
+    try:
+        pass
+    finally:
+        s.end()
+"""
+    r = lint_tree(tmp_path, {"ray_tpu/bad.py": good},
+                  rules=["span-hygiene"])
+    assert not r.findings, r.findings
+
+
 def test_bounded_blocking_fixtures(tmp_path):
     bad = """import queue
 
