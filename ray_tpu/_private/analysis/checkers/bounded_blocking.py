@@ -13,6 +13,14 @@ collects every name/attribute the file assigns from a
 ``queue.Queue``-family constructor, then flags unbounded ``put``/``get``
 on *those* receivers only.  ``asyncio.Queue`` assignments are excluded —
 awaiting an async queue parks a coroutine, not a thread.
+
+Deadline-required directories additionally demand a bound on every
+blocking ``ray_tpu.get`` AND every compiled-graph channel read
+(``Channel``/``EdgeTransport`` receivers, type-anchored the same way):
+a channel whose peer died never delivers, so a deadline-less read wedges
+the reading exec loop / pipeline stage forever — the hang class PR 8
+closed by hand, enforced since the tiered-transport PR for
+``experimental/channel/`` and ``dag/`` alongside ``serve/`` and ``rl/``.
 """
 
 from __future__ import annotations
@@ -25,6 +33,14 @@ from ray_tpu._private.analysis.core import (
     register)
 
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+# channel-plane constructors/factories whose handles block on read
+_CHANNEL_CTORS = {"Channel", "EdgeTransport", "CompositeChannel",
+                  "make_edge_transport", "attach_edge_transport"}
+# blocking read entrypoints on a channel-typed receiver; value is the
+# positional index a timeout may occupy
+_CHANNEL_READS = {"read": 0, "read_bytes": 0, "read_value": 0,
+                  "read_acquire": 0, "read_borrowed": 1}
 
 
 def _ctor_is_bounded(call: ast.Call) -> bool:
@@ -75,6 +91,41 @@ def _queue_targets(pf: ParsedFile) -> Dict[Tuple[str, str], bool]:
     return targets
 
 
+def _channel_targets(pf: ParsedFile) -> set:
+    """("self", attr) / ("local", name) for every name assigned from a
+    channel constructor/factory — unwrapping builder chains like
+    ``Channel(...).set_reader_slot(...)``."""
+    targets: set = set()
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign):
+            value, tgts = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, tgts = node.value, [node.target]
+        else:
+            continue
+        # unwrap chained calls: Channel(...).set_reader_slot(0)
+        inner = value
+        while isinstance(inner, ast.Call) and \
+                isinstance(inner.func, ast.Attribute) and \
+                isinstance(inner.func.value, ast.Call):
+            inner = inner.func.value
+        if not isinstance(inner, ast.Call):
+            continue
+        f = inner.func
+        ctor = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if ctor not in _CHANNEL_CTORS:
+            continue
+        for tgt in tgts:
+            if isinstance(tgt, ast.Name):
+                targets.add(("local", tgt.id))
+            elif isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                targets.add(("self", tgt.attr))
+    return targets
+
+
 def _receiver(call: ast.Call) -> Optional[Tuple[str, str]]:
     v = call.func.value  # type: ignore[union-attr]
     if isinstance(v, ast.Name):
@@ -106,17 +157,21 @@ class BoundedBlockingChecker(Checker):
             "get_nowait, or suppress with the reason the peer provably "
             "outlives this call")
 
-    # directories where every blocking ``ray_tpu.get`` must carry a
-    # deadline: serve/ is the latency-critical control plane, and rl/
-    # drives long-lived loops over killable rollout/learner actors (a
-    # bare get on a dead runner froze whole training iterations —
-    # the RLHF-crucible hardening extends serve/'s rule there)
-    _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/")
+    # directories where every blocking ``ray_tpu.get`` AND every channel
+    # read must carry a deadline: serve/ is the latency-critical control
+    # plane, rl/ drives long-lived loops over killable rollout/learner
+    # actors, and experimental/channel/ + dag/ are the compiled-graph
+    # data plane — a dead peer never writes its channel, so a bare read
+    # wedges the exec loop / pipeline stage forever (the hang class PR 8
+    # fixed by hand)
+    _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/",
+                      "ray_tpu/experimental/channel/", "ray_tpu/dag/")
 
     def check(self, pf: ParsedFile) -> Iterable[Finding]:
         out: List[Finding] = []
         queues = _queue_targets(pf)
         deadline_plane = pf.relpath.startswith(self._DEADLINE_DIRS)
+        channels = _channel_targets(pf) if deadline_plane else set()
         for node in ast.walk(pf.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)):
@@ -132,6 +187,16 @@ class BoundedBlockingChecker(Checker):
                     f"control-plane ray_tpu.get without timeout= in "
                     f"{pf.relpath.split('/')[1]}/ — a dead peer blocks "
                     f"this control thread forever"))
+                continue
+            if op in _CHANNEL_READS and _receiver(node) in channels:
+                t_pos = _CHANNEL_READS[op]
+                if keyword_arg(node, "timeout") is None and \
+                        len(node.args) <= t_pos:
+                    out.append(self.finding(
+                        pf, node,
+                        f"channel {op}() without a deadline — a dead "
+                        f"peer never writes, wedging this reader "
+                        f"forever"))
                 continue
             if op in ("put", "get"):
                 recv = _receiver(node)

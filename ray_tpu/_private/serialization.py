@@ -89,9 +89,64 @@ def _reduce_jax_array(arr):
     return (_rebuild_jax_array, (np.asarray(arr),))
 
 
+class device_rebuild_guard:
+    """Alias guard for deserializing device arrays from a REUSABLE buffer
+    (a channel segment that the writer overwrites once readers ack).
+
+    CPU-backend ``jax.device_put`` returns a zero-copy VIEW of the host
+    buffer (the PR 5 aliasing bug class), so a jax array rebuilt straight
+    from a shm view would be corrupted by the next write.  Inside this
+    context, ``_rebuild_jax_array`` alias-checks the device platform:
+    host-aliasing backends get an owned aligned host copy first (which
+    device_put then aliases — one memcpy total); DMA backends (tpu)
+    device_put straight from the view.  Every rebuilt array is collected
+    in ``.arrays`` so the caller can ``block_until_ready()`` before
+    releasing the buffer.
+
+    ``borrow=True`` skips the owned copy on host-aliasing backends too:
+    the rebuilt arrays alias the source buffer and are only valid until
+    it is released — strictly for borrow-scoped consumption
+    (``EdgeTransport.read_borrowed``), never for values that escape.
+    """
+
+    def __init__(self, borrow: bool = False):
+        self.arrays: List[Any] = []
+        self.borrow = borrow
+
+    def __enter__(self) -> "device_rebuild_guard":
+        _local.rebuild_guard = self
+        return self
+
+    def __exit__(self, *exc):
+        _local.rebuild_guard = None
+
+
+def _aligned_owned_copy(src):
+    """Copy ``src`` into a fresh 64-byte-aligned owned buffer.  CPU
+    ``jax.device_put`` zero-copy-aliases exactly such buffers, so the
+    guarded rebuild pays ONE memcpy total (the copy IS the emulated DMA;
+    an unaligned copy would be copied again inside device_put)."""
+    import numpy as np
+
+    buf = np.empty(src.nbytes + _ALIGN, np.uint8)
+    off = (-buf.ctypes.data) % _ALIGN
+    dst = buf[off:off + src.nbytes].view(src.dtype).reshape(src.shape)
+    np.copyto(dst, src)
+    return dst
+
+
 def _rebuild_jax_array(np_arr):
     import jax
 
+    guard = getattr(_local, "rebuild_guard", None)
+    if guard is not None:
+        if not guard.borrow and jax.default_backend() == "cpu":
+            # cpu device_put aliases host buffers: it must never see the
+            # reusable source buffer itself — hand it an owned copy
+            np_arr = _aligned_owned_copy(np_arr)
+        arr = jax.device_put(np_arr)
+        guard.arrays.append(arr)
+        return arr
     return jax.numpy.asarray(np_arr)
 
 
@@ -132,6 +187,20 @@ def serialize_parts(value: Any):
     return core, raw_bufs, tracker.refs, total
 
 
+def _copy_into(out, off: int, b) -> None:
+    n = b.nbytes if hasattr(b, "nbytes") else len(b)
+    if n >= (1 << 20):
+        # bulk memcpy through numpy: measurably faster than memoryview
+        # slice assignment for the multi-MiB array buffers that dominate
+        # channel payloads
+        import numpy as np
+
+        np.copyto(np.frombuffer(out, np.uint8, n, off),
+                  np.frombuffer(b, np.uint8, n))
+    else:
+        out[off : off + n] = b
+
+
 def write_parts(out, core: bytes, raw_bufs) -> None:
     """Pack the output of ``serialize_parts`` into writable buffer ``out``."""
     _HDR.pack_into(out, 0, _MAGIC, len(raw_bufs), len(core))
@@ -140,10 +209,10 @@ def write_parts(out, core: bytes, raw_bufs) -> None:
         struct.pack_into("<Q", out, off, b.nbytes)
         off += 8
     off = _pad(off)
-    out[off : off + len(core)] = core
+    _copy_into(out, off, core)
     off = _pad(off + len(core))
     for b in raw_bufs:
-        out[off : off + b.nbytes] = b
+        _copy_into(out, off, b)
         off = _pad(off + b.nbytes)
 
 

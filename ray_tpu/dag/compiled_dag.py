@@ -8,10 +8,15 @@ runs the method, writes its out-edge, and the driver reads the output
 channel — microseconds per hop instead of the milliseconds of the RPC task
 path.
 
-Same-actor edges short-circuit through a local cache (no channel).  Device
-values: jax.Arrays are staged through host shm on cross-process edges; keep
-a DAG's nodes in one mesh-holding process (or fuse the step under jit) for
-the ICI path — see ``channel.communicator.TpuCommunicator``.
+Same-actor edges short-circuit through a local cache (no channel).  Every
+cross-process edge rides a tier-negotiated ``EdgeTransport``
+(``experimental/channel/transport.py``): tier A in-mesh fusion (below),
+tier B device frames for same-mesh/slice endpoints (zero-copy serialize
+into shm, reader lands arrays with an alias-guarded ``device_put`` from
+the segment view — the DMA leg on TPU), tier C zero-copy host shm
+everywhere else.  Tiers are fixed once at compile time from actor
+placement/device probes, recorded in ``stats()["channel_transport"]`` and
+on the dag spans, and degrade to tier C on failure — docs/compiled_graphs.md.
 
 In-mesh jit fusion: a method bound with ``.options(jit=True)`` promises a
 jax-traceable body; adjacent jit-marked nodes on the same actor are fused
@@ -46,6 +51,11 @@ from ray_tpu.dag.dag_node import (
 )
 from ray_tpu.exceptions import TaskError
 from ray_tpu.experimental.channel import Channel, ChannelClosedError
+from ray_tpu.experimental.channel import transport as transport_mod
+from ray_tpu.experimental.channel.transport import (
+    TIER_FUSED,
+    EdgeTransport,
+)
 
 # node types that execute as tasks inside an actor's exec loop
 _TASK_NODES = (ClassMethodNode, CollectiveNode)
@@ -450,7 +460,9 @@ class CompiledDAGRef:
         from ray_tpu._private import tracing
 
         with tracing.span("dag.get", kind="dag",
-                          attrs={"exec_idx": self._idx}):
+                          attrs={"exec_idx": self._idx,
+                                 "channel_transport":
+                                     self._dag._tier_summary()}):
             return self._dag._get_result(self, timeout)
 
     def __repr__(self):
@@ -489,9 +501,12 @@ class CompiledDAG:
         self.submit_timeout = submit_timeout
         self.max_buffered_results = max_buffered_results
         self.dag_id = uuid.uuid4().hex
-        self._input_channel: Optional[Channel] = None
-        self._output_channels: List[Channel] = []
+        self._input_channel: Optional[EdgeTransport] = None
+        self._output_channels: List[EdgeTransport] = []
         self._all_channels: List[Channel] = []
+        # edge label -> negotiated transport tier (fixed at compile time;
+        # surfaced in stats() and on dag.execute/dag.get spans)
+        self._edge_tiers: Dict[str, str] = {}
         self._actors: List[Any] = []
         self._collective_groups: List[Any] = []
         self._next_exec_idx = 0
@@ -595,6 +610,20 @@ class CompiledDAG:
                                    for n in method_nodes}
         self._actors = list(handles.values())
 
+        # transport negotiation: one placement/device probe per actor,
+        # once, at compile time — every edge's tier is fixed before the
+        # first execute (reference: per-edge NCCL channel init at dag
+        # compilation, torch_tensor_nccl_channel.py)
+        infos = transport_mod.gather_endpoint_info(
+            self._actors, timeout=self.submit_timeout)
+        driver_info = transport_mod.local_endpoint_info()
+
+        def _label(n) -> str:
+            return f"{n.method_name}@{actor_of[id(n)].hex()[:6]}"
+
+        def _aid_label(aid) -> str:
+            return f"@{aid.hex()[:6]}"
+
         # consumer sets
         consumes_input: Dict[Any, bool] = {aid: False for aid in handles}
         consumers: Dict[int, List[Any]] = {id(n): [] for n in method_nodes}
@@ -611,29 +640,71 @@ class CompiledDAG:
             terminal_counts[id(t)] = terminal_counts.get(id(t), 0) + 1
         terminal_ids = set(terminal_counts)
 
+        # Tiered channels run the pure-Python data plane (native=False):
+        # zero-copy value writes and deferred-ack reads need direct
+        # segment access.  The buffer gets frame-header slack so the
+        # user-visible payload capacity stays buffer_size_bytes.
+        chan_capacity = self.buffer_size + 256
+
         # input channel: one writer (driver), one reader slot per actor
         # that consumes the input
         input_actors = [aid for aid, used in consumes_input.items() if used]
-        self._input_channel = Channel(
-            buffer_size=self.buffer_size, num_readers=max(1, len(input_actors)))
-        self._all_channels.append(self._input_channel)
+        input_ch = Channel(buffer_size=chan_capacity,
+                           num_readers=max(1, len(input_actors)),
+                           native=False)
+        input_tier = transport_mod.negotiate_channel(
+            driver_info, [infos.get(aid) for aid in input_actors])
+        for aid in input_actors:
+            # record the EFFECTIVE tier: one channel serves every reader
+            # with one encoding, so a weakest-link downgrade applies to
+            # all its edges (stats must not claim a device frame that
+            # never ships)
+            self._edge_tiers[f"input->{_aid_label(aid)}"] = input_tier
+        self._input_channel = EdgeTransport(input_ch, input_tier, "input")
+        self._all_channels.append(input_ch)
         input_slot = {aid: i for i, aid in enumerate(input_actors)}
 
         # per-node output channels (cross-actor consumers + driver)
         out_channel: Dict[int, Optional[Channel]] = {}
+        out_tier: Dict[int, str] = {}
         out_slots: Dict[int, Dict[Any, int]] = {}
         for n in method_nodes:
             readers = sorted(set(consumers[id(n)]), key=repr)
+            writer_info = infos.get(actor_of[id(n)])
             # a node listed k times in MultiOutputNode gets k driver slots
             # (each driver read consumes its own ack slot)
-            n_readers = len(readers) + terminal_counts.get(id(n), 0)
+            n_driver = terminal_counts.get(id(n), 0)
+            n_readers = len(readers) + n_driver
             if n_readers == 0:
                 out_channel[id(n)] = None
                 continue
-            ch = Channel(buffer_size=self.buffer_size, num_readers=n_readers)
+            ch = Channel(buffer_size=chan_capacity, num_readers=n_readers,
+                         native=False)
             self._all_channels.append(ch)
             out_channel[id(n)] = ch
+            tier = transport_mod.negotiate_channel(
+                writer_info,
+                [infos.get(aid) for aid in readers]
+                + [driver_info] * n_driver)
+            out_tier[id(n)] = tier
+            # record the EFFECTIVE channel tier per edge (weakest-link:
+            # one encoding serves every reader — stats must not claim a
+            # device frame a mixed reader set downgrades away)
+            for aid in readers:
+                self._edge_tiers[f"{_label(n)}->{_aid_label(aid)}"] = tier
+            if n_driver:
+                self._edge_tiers[f"{_label(n)}->driver"] = tier
             out_slots[id(n)] = {aid: i for i, aid in enumerate(readers)}
+
+        # same-actor edges never leave the process: record them as tier A
+        # (jit-fused runs literally compile away; unfused locals pass by
+        # reference) so DAG stats account for every edge
+        for n in method_nodes:
+            for dep in n._upstream():
+                if isinstance(dep, _TASK_NODES) and \
+                        actor_of[id(dep)] == actor_of[id(n)]:
+                    self._edge_tiers[f"{_label(dep)}->{_label(n)}"] = \
+                        TIER_FUSED
 
         # driver's output channels, in terminal order (driver slots follow
         # the actor-consumer slots)
@@ -642,26 +713,28 @@ class CompiledDAG:
                             for nid in terminal_ids}
         for t in terminals:
             ch = out_channel[id(t)]
-            reader = Channel(ch.name, buffer_size=self.buffer_size,
+            reader = Channel(ch.name, buffer_size=ch.buffer_size,
                              num_readers=ch.num_readers, _create=False)
             reader.set_reader_slot(next_driver_slot[id(t)])
             next_driver_slot[id(t)] += 1
-            self._output_channels.append(reader)
+            self._output_channels.append(EdgeTransport(
+                reader, out_tier[id(t)], f"{_label(t)}->driver"))
 
         # per-actor exec specs
         specs: Dict[Any, Dict[str, Any]] = {}
         for aid, handle in handles.items():
-            read_chs: Dict[str, Channel] = {}
+            read_chs: Dict[str, EdgeTransport] = {}
             if consumes_input[aid]:
-                rc = Channel(self._input_channel.name,
-                             buffer_size=self.buffer_size,
-                             num_readers=self._input_channel.num_readers,
+                rc = Channel(input_ch.name,
+                             buffer_size=input_ch.buffer_size,
+                             num_readers=input_ch.num_readers,
                              _create=False)
                 rc.set_reader_slot(input_slot[aid])
-                read_chs[self._input_channel.name] = rc
+                read_chs[input_ch.name] = EdgeTransport(
+                    rc, input_tier, f"input->{_aid_label(aid)}")
             specs[aid] = {
                 "read_channels": read_chs,
-                "input_channel": self._input_channel.name,
+                "input_channel": input_ch.name,
                 "tasks": [],
             }
 
@@ -679,20 +752,24 @@ class CompiledDAG:
                         return ("local", node_idx[id(v)])
                     ch = out_channel[id(v)]
                     if ch.name not in spec["read_channels"]:
-                        rc = Channel(ch.name, buffer_size=self.buffer_size,
+                        rc = Channel(ch.name, buffer_size=ch.buffer_size,
                                      num_readers=ch.num_readers, _create=False)
                         rc.set_reader_slot(out_slots[id(v)][aid])
-                        spec["read_channels"][ch.name] = rc
+                        spec["read_channels"][ch.name] = EdgeTransport(
+                            rc, out_tier[id(v)],
+                            f"{_label(v)}->{_aid_label(aid)}")
                     return ("chan", ch.name)
                 if isinstance(v, DAGNode):
                     raise TypeError(f"unsupported DAG arg {type(v).__name__}")
                 return ("const", v)
 
+            ch = out_channel[id(n)]
             task = {
                 "method": n.method_name,
                 "args": [argspec(a) for a in n._bound_args],
                 "kwargs": {k: argspec(v) for k, v in n._bound_kwargs.items()},
-                "out_channel": out_channel[id(n)],
+                "out_channel": None if ch is None else EdgeTransport(
+                    ch, out_tier[id(n)], _label(n)),
                 "local_idx": node_idx[id(n)],
             }
             if isinstance(n, CollectiveNode):
@@ -772,6 +849,28 @@ class CompiledDAG:
                     f"teardown() and recompile on live actors")
                 raise self._dead_actor_error
 
+    # -- introspection -----------------------------------------------------
+    def _tier_summary(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for tier in self._edge_tiers.values():
+            out[tier] = out.get(tier, 0) + 1
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        """Channel-plane introspection: the per-edge negotiated transport
+        (``channel_transport``) plus driver-side channel counters (the
+        actor-side read waits land in the ``channel_wait`` step-ledger
+        bucket and the exec loops' transport stats)."""
+        chans: Dict[str, Dict[str, Any]] = {}
+        for tr in [self._input_channel] + list(self._output_channels):
+            if tr is not None:
+                chans[tr.edge] = {"tier": tr.tier, **tr.stats}
+        return {
+            "channel_transport": dict(self._edge_tiers),
+            "tiers": self._tier_summary(),
+            "driver_channels": chans,
+        }
+
     # -- execution ---------------------------------------------------------
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
         if self._torn_down:
@@ -782,7 +881,9 @@ class CompiledDAG:
 
         with self._submit_lock:
             with tracing.span("dag.execute", kind="dag",
-                              attrs={"exec_idx": self._next_exec_idx}):
+                              attrs={"exec_idx": self._next_exec_idx,
+                                     "channel_transport":
+                                         self._tier_summary()}):
                 # the channel write is the (possibly backpressured) submit
                 # hop; node execution runs in the actors' standing loops,
                 # whose collective/nested spans join via their own paths

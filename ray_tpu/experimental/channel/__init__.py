@@ -11,8 +11,23 @@ from ray_tpu.experimental.channel.shared_memory_channel import (
     ChannelTimeoutError,
     CompositeChannel,
 )
+from ray_tpu.experimental.channel.transport import (
+    TIER_DEVICE,
+    TIER_FUSED,
+    TIER_HOST,
+    EdgeTransport,
+    EndpointInfo,
+    gather_endpoint_info,
+    local_endpoint_info,
+    make_edge_transport,
+    negotiate,
+    negotiate_channel,
+)
 
 __all__ = [
     "Channel", "ChannelClosedError", "ChannelTimeoutError",
     "CompositeChannel", "Communicator", "CpuCommunicator", "TpuCommunicator",
+    "EdgeTransport", "EndpointInfo", "TIER_DEVICE", "TIER_FUSED",
+    "TIER_HOST", "gather_endpoint_info", "local_endpoint_info",
+    "make_edge_transport", "negotiate", "negotiate_channel",
 ]

@@ -65,7 +65,15 @@ class CpuCommunicator(Communicator):
 
     def get_rank(self, actor) -> int:
         key = getattr(actor, "_actor_id", None) or actor
-        return self._actor_ranks.get(key, -1)
+        rank = self._actor_ranks.get(key)
+        if rank is None:
+            # a silent -1 here becomes a wrong-peer send downstream —
+            # name the actor instead
+            raise ValueError(
+                f"actor {actor!r} is not a member of communicator group "
+                f"{self.group_name!r} (known ranks: "
+                f"{sorted(map(repr, self._actor_ranks))})")
+        return rank
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -113,6 +121,12 @@ class TpuCommunicator(CpuCommunicator):
     every node of a DAG edge lives in one process holding a mesh, keep the
     whole step under one jit so values stay as jax.Arrays and XLA moves
     them over ICI inside the compiled program (no channel hop at all).
+
+    Compiled-graph edges no longer go through this class for bulk data:
+    the tier-negotiated ``transport.EdgeTransport`` (device frames +
+    alias-guarded ``device_put`` from the shm view) is the channel plane
+    — see ``experimental/channel/transport.py`` and
+    docs/compiled_graphs.md.
     """
 
     def send(self, tensor, peer_rank: int) -> None:

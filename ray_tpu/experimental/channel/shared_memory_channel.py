@@ -27,7 +27,7 @@ from __future__ import annotations
 import struct
 import time
 import uuid
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 _U64 = struct.Struct("<Q")
 _HDR = 24  # version, payload_len, n_readers
@@ -39,6 +39,24 @@ class ChannelTimeoutError(TimeoutError):
 
 class ChannelClosedError(RuntimeError):
     pass
+
+
+# Writer-side copy accounting (the channel bench's no-double-copy gate):
+# every memcpy of payload bytes into a staging buffer or the segment adds
+# here, so a regression that reintroduces an intermediate pickle-buffer
+# copy shows up as bytes_copied ≈ 2x payload instead of ≈ 1x.
+COPY_STATS = {"bytes_copied": 0, "payloads": 0, "payload_bytes": 0}
+
+
+def _count_copy(nbytes: int, payload: Optional[int] = None) -> None:
+    COPY_STATS["bytes_copied"] += nbytes
+    if payload is not None:
+        COPY_STATS["payloads"] += 1
+        COPY_STATS["payload_bytes"] += payload
+
+
+def reset_copy_stats() -> None:
+    COPY_STATS.update(bytes_copied=0, payloads=0, payload_bytes=0)
 
 
 _CLOSED_BIT = 1 << 63  # high bit of the n_readers word: channel torn down.
@@ -111,7 +129,8 @@ class Channel:
     """Handle to one shm channel; picklable (reconstructs by name)."""
 
     def __init__(self, name: Optional[str] = None, *, buffer_size: int = 1 << 20,
-                 num_readers: int = 1, _create: bool = True):
+                 num_readers: int = 1, _create: bool = True,
+                 native: Optional[bool] = None):
         self.name = name or f"rtpu_ch_{uuid.uuid4().hex[:16]}"
         self.buffer_size = buffer_size
         self.num_readers = num_readers
@@ -125,7 +144,12 @@ class Channel:
             # The creator fixes the channel's data-plane mode for all peers
             # (see _NATIVE_BIT above) — mixed mode only ever arises when a
             # later attacher lacks the toolchain, and then only on TSO hosts.
-            flags = num_readers | (_NATIVE_BIT if lib else 0)
+            # ``native=False`` keeps the pure-Python plane even when the
+            # toolchain is present: the zero-copy value path (write_value /
+            # read_acquire) serializes straight into the segment, which the
+            # native write entrypoint cannot do.
+            flags = num_readers | (
+                _NATIVE_BIT if lib and native is not False else 0)
             _U64.pack_into(self._seg.buf, 16, flags)
         else:
             self._seg = open_shm(name=self.name)
@@ -220,6 +244,7 @@ class Channel:
             timeout, "readers to consume previous value")
         base = _HDR + 8 * self.num_readers
         self._seg.buf[base:base + len(payload)] = payload
+        _count_copy(len(payload))
         _U64.pack_into(self._seg.buf, 8, len(payload))
         _U64.pack_into(self._seg.buf, 0, v + 2)
 
@@ -260,12 +285,134 @@ class Channel:
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         from ray_tpu._private import serialization
 
-        self.write_bytes(serialization.dumps(value), timeout)
+        payload = serialization.dumps(value)
+        _count_copy(len(payload), payload=len(payload))  # pickle staging copy
+        self.write_bytes(payload, timeout)
 
     def read(self, timeout: Optional[float] = None) -> Any:
         from ray_tpu._private import serialization
 
         return serialization.loads(self.read_bytes(timeout))
+
+    # -- zero-copy data plane (tier-C transport path) ----------------------
+    #
+    # The legacy write() path copies every payload twice: once building the
+    # pickle byte string, once moving it into the segment.  write_value()
+    # serializes with pickle-5 out-of-band buffers and packs them STRAIGHT
+    # into the segment view — one copy of the big arrays total.  On the
+    # read side, read_acquire()/read_release() expose the payload as a
+    # memoryview over the segment WITHOUT consuming the reader's ack slot,
+    # so a transport can deserialize zero-copy (or device_put straight from
+    # shm) and only ack once no live alias of the buffer remains — the
+    # version guard for buffer reuse (see transport.py's alias rules).
+    #
+    # Encoding contract: write_value/read_value carry the BARE serialized
+    # payload; EdgeTransport frames payloads with a 64-byte marker header.
+    # Both peers of a channel must use the same plane — never pair
+    # read_value() with EdgeTransport.write() (or vice versa) on one
+    # channel.
+
+    @property
+    def supports_zero_copy(self) -> bool:
+        """True when the pure-Python data plane owns this channel (the
+        native write entrypoint takes a contiguous byte string and cannot
+        accept a serialize-into-segment write)."""
+        return self._nh is None
+
+    def acquire_write_buffer(self, nbytes: int,
+                             timeout: Optional[float] = None) -> memoryview:
+        """Wait until every reader consumed the previous value, then hand
+        out a writable view of the payload region.  The caller fills it
+        and MUST call :meth:`commit_write` to publish."""
+        if nbytes > self.buffer_size:
+            raise ValueError(
+                f"payload of {nbytes}B exceeds channel buffer "
+                f"{self.buffer_size}B (set buffer_size at compile time)")
+        if self._nh is not None:
+            raise RuntimeError(
+                f"channel {self.name} runs the native data plane; "
+                f"zero-copy writes need Channel(..., native=False)")
+        if self._is_closed():
+            raise ChannelClosedError(f"channel {self.name} closed")
+        v = self._version()
+        self._wait(
+            lambda: all(self._ack(r) >= v for r in range(self.num_readers)),
+            timeout, "readers to consume previous value")
+        base = _HDR + 8 * self.num_readers
+        return memoryview(self._seg.buf)[base:base + nbytes]
+
+    def commit_write(self, nbytes: int) -> None:
+        """Publish the payload staged by :meth:`acquire_write_buffer`."""
+        _count_copy(nbytes, payload=nbytes)
+        _U64.pack_into(self._seg.buf, 8, nbytes)
+        _U64.pack_into(self._seg.buf, 0, self._version() + 2)
+
+    def write_value(self, value: Any,
+                    timeout: Optional[float] = None) -> int:
+        """Zero-copy value write: serialize straight into the segment
+        (one copy of out-of-band array buffers total).  Falls back to the
+        staged write on native-plane channels.  Returns payload bytes."""
+        from ray_tpu._private import serialization
+
+        core, raw_bufs, _refs, total = serialization.serialize_parts(value)
+        if self._nh is not None:  # native plane: stage once, then hand off
+            out = bytearray(total)
+            serialization.write_parts(out, core, raw_bufs)
+            _count_copy(total, payload=total)
+            self.write_bytes(bytes(out), timeout)
+            return total
+        buf = self.acquire_write_buffer(total, timeout)
+        serialization.write_parts(buf, core, raw_bufs)
+        self.commit_write(total)
+        return total
+
+    def read_acquire(self, timeout: Optional[float] = None
+                     ) -> Tuple[memoryview, int]:
+        """Wait for an unread value and return ``(payload_view, version)``
+        WITHOUT acking — the writer cannot reuse the buffer until
+        :meth:`read_release` runs.  Pair with read_release on every path."""
+        if self._nh is not None:
+            raise RuntimeError(
+                f"channel {self.name} runs the native data plane; "
+                f"zero-copy reads need Channel(..., native=False)")
+        slot = self._reader_slot or 0
+        last = self._ack(slot)
+        self._wait(lambda: self._version() > last, timeout, "a new value")
+        v = self._version()
+        if self._is_closed():
+            raise ChannelClosedError(f"channel {self.name} closed")
+        n = _U64.unpack_from(self._seg.buf, 8)[0]
+        base = _HDR + 8 * self.num_readers
+        return memoryview(self._seg.buf)[base:base + n], v
+
+    def read_release(self, version: int) -> None:
+        """Ack the value acquired at ``version``.  Raises if the segment
+        was overwritten while the view was live (a reuse-protocol
+        violation — the alias guard's backstop, never expected when every
+        reader releases before the writer's ack wait can pass)."""
+        cur = self._version()
+        if cur != version and not self._is_closed():
+            raise RuntimeError(
+                f"channel {self.name}: buffer overwritten while a "
+                f"zero-copy view was live (read v{version}, now v{cur})")
+        self._set_ack(self._reader_slot or 0, version)
+
+    def read_value(self, timeout: Optional[float] = None) -> Any:
+        """Safe value read: deserialize with owned (copied) buffers, then
+        ack — the returned value never aliases the segment.  Transports
+        that can prove alias-safety use read_acquire directly instead."""
+        from ray_tpu._private import serialization
+
+        if self._nh is not None:
+            value, _ = serialization.deserialize(
+                self.read_bytes(timeout), zero_copy=True)
+            return value
+        view, v = self.read_acquire(timeout)
+        try:
+            value, _ = serialization.deserialize(view, zero_copy=False)
+        finally:
+            self.read_release(v)
+        return value
 
     # -- lifecycle ---------------------------------------------------------
     def set_reader_slot(self, slot: int) -> "Channel":

@@ -147,7 +147,6 @@ class WeightPublisher:
         self.channel_write_timeout_s = channel_write_timeout_s
         self._pinned: Dict[int, Any] = {}  # version -> ObjectRef (alive)
         self._channel = None
-        self._channel_inline_limit = 0
         self._lock = threading.Lock()
         self.stats = {"publishes": 0, "publish_failures": 0,
                       "channel_commits": 0, "channel_retired": 0}
@@ -164,20 +163,29 @@ class WeightPublisher:
                        *, buffer_size: int = 1 << 20) -> Dict[str, Any]:
         """(Re)create the shm commit channel for ``num_readers``
         subscribers and return the attach info ``{"name", "num_readers",
-        "buffer_size"}``.  Called whenever group membership changes (a
-        respawned actor cannot inherit a dead reader's ack slot)."""
-        from ray_tpu.experimental.channel import Channel
+        "buffer_size", "tier"}``.  Called whenever group membership
+        changes (a respawned actor cannot inherit a dead reader's ack
+        slot).  The channel rides the negotiated transport plane: params
+        pytrees of jax arrays ship as device frames (zero-copy serialize
+        into the segment; subscribers land them with an alias-guarded
+        ``device_put`` from the shm view), anything else takes the
+        zero-copy host encoding — the compiled-graph channel work for
+        free."""
+        from ray_tpu.experimental.channel.transport import (
+            TIER_DEVICE,
+            make_edge_transport,
+        )
 
         self.retire_channel()
         if num_readers <= 0:
             return {}
-        ch = Channel(buffer_size=buffer_size, num_readers=num_readers)
+        tr = make_edge_transport(
+            tier=TIER_DEVICE, edge=f"weights:{self.name}",
+            buffer_size=buffer_size, num_readers=num_readers)
         with self._lock:
-            self._channel = ch
-            # leave headroom for the pickle framing around the params
-            self._channel_inline_limit = max(0, buffer_size - 4096)
-        return {"name": ch.name, "num_readers": num_readers,
-                "buffer_size": buffer_size}
+            self._channel = tr
+        return {"name": tr.channel.name, "num_readers": num_readers,
+                "buffer_size": buffer_size, "tier": tr.tier}
 
     def retire_channel(self) -> None:
         with self._lock:
@@ -251,26 +259,20 @@ class WeightPublisher:
     def _channel_notify(self, payload: Dict[str, Any],
                         record: Dict[str, Any]) -> None:
         """Best-effort fast-path commit broadcast.  Inline the full
-        payload when it fits the channel buffer; otherwise send the
-        commit record (subscribers fetch from the object store).  A
-        write timeout means a reader died or wedged: retire the channel
-        — the KV commit already happened, nothing is lost."""
+        payload when it fits the channel buffer (the transport raises
+        ``ValueError`` on oversize, measuring the bytes actually
+        written); otherwise send the commit record (subscribers fetch
+        from the object store).  A write timeout means a reader died or
+        wedged: retire the channel — the KV commit already happened,
+        nothing is lost."""
         with self._lock:
             ch = self._channel
-            limit = self._channel_inline_limit
         if ch is None:
             return
-        # serialize ONCE with the channel's own encoder (so the size
-        # gate measures the bytes actually written — a mismatched probe
-        # encoding could oversize the write and masquerade as a dead
-        # reader) and ship the blob directly
-        from ray_tpu._private import serialization
-
-        blob = serialization.dumps(payload)
         try:
-            if len(blob) <= limit:
-                ch.write_bytes(blob, timeout=self.channel_write_timeout_s)
-            else:
+            try:
+                ch.write(payload, timeout=self.channel_write_timeout_s)
+            except ValueError:  # payload exceeds the segment: record only
                 ch.write(dict(record),
                          timeout=self.channel_write_timeout_s)
             self.stats["channel_commits"] += 1
@@ -326,17 +328,23 @@ class WeightSubscriber:
         if not info:
             return
         from ray_tpu.experimental.channel import Channel
+        from ray_tpu.experimental.channel.transport import (
+            TIER_HOST,
+            EdgeTransport,
+        )
 
         try:
             ch = Channel(info["name"], buffer_size=info["buffer_size"],
                          num_readers=info["num_readers"], _create=False)
             ch.set_reader_slot(slot)
+            tr = EdgeTransport(ch, info.get("tier", TIER_HOST),
+                               f"weights:{self.name}")
         except Exception:  # noqa: BLE001 — fall back to KV poll
             logger.warning("weight-sync %s: channel attach failed; "
                            "using object-store path", self.name)
             return
         with self._lock:
-            self._channel = ch
+            self._channel = tr
 
     def detach_channel(self) -> None:
         with self._lock:

@@ -30,8 +30,11 @@ class StepLedger:
     ingest pipeline), ``h2d`` (host→device staging), ``compute`` (the
     jitted update), ``collective_wait`` (supervised collective ops —
     auto-attributed via the tracing duration-sink, no loop changes),
-    ``checkpoint``, ``weight_publish`` (auto-attributed by the RL
-    weight-sync publisher), and ``other`` (the unexplained remainder).
+    ``channel_wait`` (compiled-graph / pipeline channel reads —
+    auto-attributed by ``EdgeTransport.read``, so pipeline steps see
+    their inter-stage stalls), ``checkpoint``, ``weight_publish``
+    (auto-attributed by the RL weight-sync publisher), and ``other``
+    (the unexplained remainder).
     The MFU number finally gets a denominator breakdown::
 
         ledger = train.get_context().step_ledger()
@@ -48,7 +51,7 @@ class StepLedger:
     """
 
     BUCKETS = ("data_wait", "h2d", "compute", "collective_wait",
-               "checkpoint", "weight_publish")
+               "channel_wait", "checkpoint", "weight_publish")
 
     _PUBLISH_EVERY_S = 2.0
     _HISTORY = 64

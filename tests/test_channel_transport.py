@@ -1,0 +1,438 @@
+"""Tiered channel transport: negotiation, zero-copy data plane, alias
+guard, degradation (``ray_tpu/experimental/channel/transport.py``).
+
+The ICI tier runs under its ``JAX_PLATFORMS=cpu`` emulation backend
+(``RAY_TPU_ICI_EMULATE=1``) — identical negotiation, framing, and
+alias-guard logic to the hardware path, tier-1-testable without TPUs.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.channel import Channel, ChannelTimeoutError
+from ray_tpu.experimental.channel.shared_memory_channel import (
+    COPY_STATS,
+    reset_copy_stats,
+)
+from ray_tpu.experimental.channel.transport import (
+    TIER_DEVICE,
+    TIER_FUSED,
+    TIER_HOST,
+    EdgeTransport,
+    EndpointInfo,
+    attach_edge_transport,
+    make_edge_transport,
+    negotiate,
+    negotiate_channel,
+)
+
+
+def _info(**kw):
+    base = dict(node_id="n1", pid=100, platform="cpu", slice_name="",
+                device_ids=(0,), process_index=0)
+    base.update(kw)
+    return EndpointInfo(**base)
+
+
+class TestNegotiationMatrix:
+    """Compile-time tier selection from endpoint placement/device info."""
+
+    def test_same_process_is_fused(self):
+        a = _info()
+        assert negotiate(a, _info()) == TIER_FUSED
+
+    def test_same_tpu_slice_is_device_tier(self):
+        w = _info(pid=1, platform="tpu", slice_name="slice-a")
+        r = _info(pid=2, platform="tpu", slice_name="slice-a")
+        assert negotiate(w, r) == TIER_DEVICE
+
+    def test_cross_slice_tpu_is_host_tier(self):
+        w = _info(pid=1, platform="tpu", slice_name="slice-a")
+        r = _info(pid=2, platform="tpu", slice_name="slice-b")
+        assert negotiate(w, r) == TIER_HOST
+
+    def test_heterogeneous_edge_is_host_tier(self):
+        w = _info(pid=1, platform="tpu", slice_name="slice-a")
+        assert negotiate(w, _info(pid=2, platform="none",
+                                  device_ids=())) == TIER_HOST
+        assert negotiate(w, None) == TIER_HOST
+
+    def test_cpu_cross_process_needs_emulation(self, monkeypatch):
+        w, r = _info(pid=1), _info(pid=2)
+        monkeypatch.delenv("RAY_TPU_ICI_EMULATE", raising=False)
+        assert negotiate(w, r) == TIER_HOST
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        assert negotiate(w, r) == TIER_DEVICE
+        # emulation never spans nodes
+        assert negotiate(w, _info(pid=2, node_id="n2")) == TIER_HOST
+
+    def test_channel_tier_is_weakest_reader(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        w = _info(pid=1)
+        dev, host = _info(pid=2), _info(pid=3, platform="none",
+                                        device_ids=())
+        assert negotiate_channel(w, [dev, dev]) == TIER_DEVICE
+        assert negotiate_channel(w, [dev, host]) == TIER_HOST
+        assert negotiate_channel(w, []) == TIER_HOST
+
+
+class TestZeroCopyDataPlane:
+    def test_write_value_roundtrip_and_single_copy(self):
+        tr = make_edge_transport(tier=TIER_HOST, buffer_size=1 << 22)
+        rd = attach_edge_transport(tr, 0)
+        payload = {"a": np.arange(2048, dtype=np.float64),
+                   "meta": {"k": "v"}, "n": 7}
+        reset_copy_stats()
+        tr.write(payload, timeout=5)
+        assert COPY_STATS["bytes_copied"] <= \
+            1.15 * COPY_STATS["payload_bytes"], COPY_STATS
+        out = rd.read(timeout=5)
+        np.testing.assert_array_equal(out["a"], payload["a"])
+        assert out["meta"] == {"k": "v"} and out["n"] == 7
+        # the returned arrays own their memory (no segment alias)
+        tr.write({"a": np.zeros(2048), "meta": {}, "n": 0}, timeout=5)
+        assert out["a"][10] == 10.0
+        tr.destroy()
+
+    def test_device_frame_roundtrip(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        import jax
+        import jax.numpy as jnp
+
+        tr = make_edge_transport(tier=TIER_DEVICE, buffer_size=1 << 22)
+        rd = attach_edge_transport(tr, 0)
+        x = jnp.arange(4096, dtype=jnp.float32)
+        tr.write({"x": x, "step": 3}, timeout=5)
+        out = rd.read(timeout=5)
+        assert isinstance(out["x"], jax.Array) and out["step"] == 3
+        np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(x))
+        assert tr.stats["device_frames"] == 1
+        tr.destroy()
+
+    def test_numpy_leaves_force_host_frame(self, monkeypatch):
+        # raw numpy leaves have no rebuild hook to alias-guard: the
+        # writer must fall back to the host encoding
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        import jax.numpy as jnp
+
+        tr = make_edge_transport(tier=TIER_DEVICE, buffer_size=1 << 22)
+        rd = attach_edge_transport(tr, 0)
+        tr.write({"x": jnp.ones(8), "y": np.ones(8)}, timeout=5)
+        out = rd.read(timeout=5)
+        assert tr.stats["device_frames"] == 0
+        np.testing.assert_allclose(np.asarray(out["y"]), np.ones(8))
+        tr.destroy()
+
+    def test_oversize_write_raises_value_error(self):
+        tr = make_edge_transport(tier=TIER_HOST, buffer_size=1 << 10)
+        with pytest.raises(ValueError, match="exceeds"):
+            tr.write(np.zeros(1 << 12), timeout=1)
+        tr.destroy()
+
+
+class TestAliasSafety:
+    """The PR 5 bug class: CPU ``device_put`` returns a VIEW of the host
+    buffer, and channel segments are reused."""
+
+    def test_reuse_while_cpu_device_put_view_live(self, monkeypatch):
+        """A tier-C/B staging buffer is overwritten while the reader's
+        CPU device_put'd value is still live — the alias guard must have
+        copied, so the first value survives intact."""
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        import jax
+        import jax.numpy as jnp
+
+        assert jax.default_backend() == "cpu"  # the aliasing platform
+        for tier in (TIER_HOST, TIER_DEVICE):
+            tr = make_edge_transport(tier=tier, buffer_size=1 << 22)
+            rd = attach_edge_transport(tr, 0)
+            tr.write({"x": jnp.full((4096,), 7.0)}, timeout=5)
+            first = rd.read(timeout=5)["x"]  # ack released: buffer reusable
+            tr.write({"x": jnp.full((4096,), 9.0)}, timeout=5)  # reuse
+            second = rd.read(timeout=5)["x"]
+            assert float(first[0]) == 7.0 and float(first[-1]) == 7.0, tier
+            assert float(second[0]) == 9.0, tier
+            tr.destroy()
+
+    def test_unreleased_view_blocks_buffer_reuse(self):
+        """The version guard's other half: while a zero-copy view is
+        held (ack withheld), the writer cannot reuse the buffer."""
+        ch = Channel(buffer_size=1 << 12, num_readers=1, native=False)
+        rd = Channel(ch.name, buffer_size=ch.buffer_size, num_readers=1,
+                     _create=False).set_reader_slot(0)
+        ch.write_value(b"one", timeout=5)
+        view, version = rd.read_acquire(timeout=5)
+        with pytest.raises(ChannelTimeoutError):
+            ch.write_value(b"two", timeout=0.2)  # blocked by the borrow
+        rd.read_release(version)
+        view.release()
+        ch.write_value(b"two", timeout=5)  # borrow gone: reuse OK
+        assert rd.read_value(timeout=5) == b"two"
+        ch.destroy()
+
+    def test_borrowed_read_consumes_in_scope(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        import jax.numpy as jnp
+
+        tr = make_edge_transport(tier=TIER_DEVICE, buffer_size=1 << 22)
+        rd = attach_edge_transport(tr, 0)
+        tr.write({"x": jnp.arange(1024, dtype=jnp.float32)}, timeout=5)
+        total = rd.read_borrowed(lambda v: float(v["x"].sum()), timeout=5)
+        assert total == float(np.arange(1024, dtype=np.float32).sum())
+        tr.write({"x": jnp.zeros(1024, jnp.float32)}, timeout=5)
+        assert rd.read_borrowed(lambda v: float(v["x"].sum()),
+                                timeout=5) == 0.0
+        tr.destroy()
+
+
+class TestDegradation:
+    def test_device_decode_failure_degrades_to_host(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        import jax.numpy as jnp
+
+        from ray_tpu._private import serialization
+
+        tr = make_edge_transport(tier=TIER_DEVICE, buffer_size=1 << 22)
+        rd = attach_edge_transport(tr, 0)
+        tr.write({"x": jnp.arange(256, dtype=jnp.float32)}, timeout=5)
+        assert tr.stats["device_frames"] == 1
+
+        class _Boom:
+            def __init__(self, *a, **kw):
+                raise RuntimeError("device landing broken")
+
+        monkeypatch.setattr(serialization, "device_rebuild_guard", _Boom)
+        out = rd.read(timeout=5)  # decode degrades, value still arrives
+        np.testing.assert_allclose(np.asarray(out["x"]),
+                                   np.arange(256, dtype=np.float32))
+        assert rd.tier == TIER_HOST and rd.stats["degraded"] == 1
+        monkeypatch.undo()
+        # sticky: later messages use the host path, no further flapping
+        tr.write({"x": jnp.ones(4)}, timeout=5)
+        rd.read(timeout=5)
+        assert rd.tier == TIER_HOST and rd.stats["degraded"] == 1
+        tr.destroy()
+
+
+@pytest.mark.usefixtures("ray_start")
+class TestCompiledDagTransports:
+    def test_dag_stats_record_negotiated_tiers(self, monkeypatch):
+        """Transport-negotiation matrix at the DAG level: cross-process
+        edges pick tier B under the ICI emulation, and same-actor edges
+        are recorded as tier A (fused)."""
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class JaxAdder:
+            def __init__(self, inc):
+                import jax  # initialize the backend: the passive probe
+                import jax.numpy as jnp
+
+                jax.devices()
+                self.inc = jnp.float32(inc)
+
+            def add(self, x):
+                import jax.numpy as jnp
+
+                return jnp.asarray(x) + self.inc
+
+            def to_float(self, x):
+                return float(x)
+
+        a, b = JaxAdder.remote(1.0), JaxAdder.remote(10.0)
+        with InputNode() as inp:
+            dag = b.to_float.bind(b.add.bind(a.add.bind(inp)))
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(5.0).get(timeout=30) == 16.0
+            st = compiled.stats()
+            tiers = st["channel_transport"]
+            # cross-process actor edge negotiated the device tier
+            cross = [t for e, t in tiers.items()
+                     if e.startswith("add@") and "->@" in e]
+            assert cross == [TIER_DEVICE], tiers
+            # same-actor b.add -> b.to_float edge is fused (tier A)
+            fused = [t for e, t in tiers.items()
+                     if e.startswith("add@") and "->to_float@" in e]
+            assert fused == [TIER_FUSED], tiers
+            assert st["tiers"].get(TIER_DEVICE, 0) >= 1
+            assert st["driver_channels"]["input"]["sends"] == 1
+        finally:
+            compiled.teardown()
+
+    def test_dag_without_jax_actors_negotiates_host_tier(self):
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class PlainAdder:
+            def add(self, x):
+                return x + 1
+
+        a = PlainAdder.remote()
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            assert compiled.execute(1).get(timeout=30) == 2
+            tiers = set(compiled.stats()["channel_transport"].values())
+            assert tiers == {TIER_HOST}
+        finally:
+            compiled.teardown()
+
+    def test_tier_b_peer_death_surfaces_actor_died(self, monkeypatch):
+        """Tier-B edge + dead peer mid-pipeline: the degradation ladder
+        ends in channel retirement with PR 8 semantics —
+        ``CompiledDAGRef.get`` raises ``ActorDiedError``, teardown
+        completes promptly."""
+        import time
+
+        monkeypatch.setenv("RAY_TPU_ICI_EMULATE", "1")
+        from ray_tpu.dag import InputNode
+
+        @ray_tpu.remote
+        class SlowJax:
+            def __init__(self):
+                import jax
+
+                jax.devices()
+
+            def slow(self, x):
+                import time as _t
+
+                import jax.numpy as jnp
+
+                _t.sleep(5.0)
+                return jnp.asarray(x) + 1
+
+            def out(self, x):
+                return float(x)
+
+        a, b = SlowJax.remote(), SlowJax.remote()
+        with InputNode() as inp:
+            dag = b.out.bind(a.slow.bind(inp))
+        compiled = dag.experimental_compile()
+        try:
+            tiers = compiled.stats()["channel_transport"]
+            assert any(t == TIER_DEVICE and "->@" in e
+                       for e, t in tiers.items()), tiers
+            ref = compiled.execute(1.0)
+            time.sleep(0.3)
+            ray_tpu.kill(a)
+            t0 = time.monotonic()
+            with pytest.raises(ray_tpu.exceptions.ActorDiedError,
+                               match="died mid-execution"):
+                ref.get()
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            t0 = time.monotonic()
+            compiled.teardown(timeout=10)
+            assert time.monotonic() - t0 < 8.0
+
+
+@pytest.mark.usefixtures("ray_start")
+class TestChannelPipelineRunner:
+    def _stage_cls(self):
+        @ray_tpu.remote
+        class LinearStage:
+            def __init__(self, w):
+                self.w = np.asarray(w, np.float64)
+                self.acts = {}
+                self.grad_w = np.zeros_like(self.w)
+
+            def forward(self, mb, x):
+                x = np.asarray(x, np.float64)
+                self.acts[mb] = x
+                return x @ self.w
+
+            def backward(self, mb, g):
+                x = self.acts.pop(mb)
+                if g is None:
+                    g = np.ones((x.shape[0], self.w.shape[1]))
+                g = np.asarray(g, np.float64)
+                self.grad_w += x.T @ g
+                return g @ self.w.T
+
+            def get_grad(self):
+                return self.grad_w
+
+        return LinearStage
+
+    def test_channel_runner_matches_objects_runner(self):
+        from ray_tpu.dag.pipeline_schedule import PipelineRunner
+
+        rng = np.random.default_rng(0)
+        S, M = 3, 6
+        ws = [rng.normal(size=(8, 8)) for _ in range(S)]
+        mbs = [rng.normal(size=(4, 8)) for _ in range(M)]
+        LinearStage = self._stage_cls()
+
+        grads = []
+        for transport in ("objects", "channels"):
+            stages = [LinearStage.remote(w) for w in ws]
+            runner = PipelineRunner(stages, transport=transport,
+                                    op_timeout_s=60)
+            res = runner.run(mbs, timeout=120)
+            assert set(res.outputs) == set(range(M))
+            assert set(res.input_grads) == set(range(M))
+            grads.append(ray_tpu.get(
+                [s.get_grad.remote() for s in stages]))
+            if transport == "channels":
+                st = res.stats
+                assert st["analytic_bubble"] == pytest.approx(
+                    (S - 1) / (M + S - 1))
+                assert 0.0 <= st["bubble_fraction"] <= 1.0
+                assert set(st["channel_transport"]) == {
+                    "fwd:0->1", "fwd:1->2", "bwd:1->0", "bwd:2->1"}
+                assert st["channel_wait_s_by_tier"]
+                runner.close()
+            else:
+                assert res.stats is None
+        for a, b in zip(*grads):
+            np.testing.assert_allclose(a, b, rtol=1e-12)
+
+    def test_channel_runner_forward_only(self):
+        from ray_tpu.dag.pipeline_schedule import PipelineRunner
+
+        LinearStage = self._stage_cls()
+        stages = [LinearStage.remote(np.eye(4) * 2),
+                  LinearStage.remote(np.eye(4) * 3)]
+        runner = PipelineRunner(stages, transport="channels",
+                                op_timeout_s=60)
+        res = runner.run([np.ones((2, 4)), np.ones((2, 4)) * 2],
+                         backward=False, timeout=60)
+        np.testing.assert_allclose(res.outputs[0], np.ones((2, 4)) * 6)
+        np.testing.assert_allclose(res.outputs[1], np.ones((2, 4)) * 12)
+        assert res.input_grads == {}
+        runner.close()
+
+    def test_stage_death_mid_pipeline_raises_actor_died(self):
+        import time
+
+        from ray_tpu.dag.pipeline_schedule import PipelineRunner
+
+        @ray_tpu.remote
+        class SlowStage:
+            def forward(self, mb, x):
+                time.sleep(2.0)
+                return x
+
+            def backward(self, mb, g):
+                return g
+
+        stages = [SlowStage.remote(), SlowStage.remote()]
+        runner = PipelineRunner(stages, transport="channels",
+                                op_timeout_s=30)
+        import threading
+
+        def _kill():
+            time.sleep(0.5)
+            ray_tpu.kill(stages[1])
+
+        killer = threading.Thread(target=_kill)
+        killer.start()
+        with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+            runner.run([np.ones((2, 2)), np.ones((2, 2))], timeout=60)
+        killer.join()
+        runner.close(timeout=5)

@@ -209,6 +209,48 @@ def test_bounded_blocking_serve_get_fixtures(tmp_path):
     assert not r.findings, r.findings
 
 
+def test_bounded_blocking_channel_read_fixtures(tmp_path):
+    """Deadline-required dirs (now incl. experimental/channel/ and dag/):
+    every channel read needs a bound — a dead peer never writes, so a
+    bare read wedges the exec loop / pipeline stage forever."""
+    bad = """from ray_tpu.experimental.channel import Channel, EdgeTransport
+
+def f():
+    ch = Channel(buffer_size=1 << 12, num_readers=1)
+    rc = Channel(ch.name, num_readers=1, _create=False).set_reader_slot(0)
+    tr = EdgeTransport(ch)
+    a = rc.read()            # TP: no deadline
+    b = tr.read_bytes()      # TP: no deadline
+    c = tr.read_borrowed(float)  # TP: fn only, no deadline
+    return a, b, c
+"""
+    # the rule binds in every deadline dir, incl. the two new ones
+    r = lint_tree(tmp_path, {"ray_tpu/experimental/channel/mod.py": bad,
+                             "ray_tpu/dag/mod.py": bad},
+                  rules=["bounded-blocking"])
+    assert rules_of(r) == ["bounded-blocking"] * 6, r.findings
+    assert {f.path for f in r.findings} == \
+        {"ray_tpu/experimental/channel/mod.py", "ray_tpu/dag/mod.py"}
+    # same code outside the deadline set is not flagged (TN), and
+    # bounded reads inside it are clean (TN)
+    good = """from ray_tpu.experimental.channel import Channel, EdgeTransport
+
+def f():
+    ch = Channel(buffer_size=1 << 12, num_readers=1)
+    tr = EdgeTransport(ch)
+    a = ch.read(0.5)                      # positional timeout
+    b = tr.read(timeout=None)             # explicit deadline decision
+    c = tr.read_borrowed(float, timeout=2)
+    d = open("/dev/null").read()          # not a channel receiver
+    return a, b, c, d
+"""
+    r = lint_tree(tmp_path, {"ray_tpu/experimental/channel/mod.py": "",
+                             "ray_tpu/dag/mod.py": good,
+                             "ray_tpu/other.py": bad},
+                  rules=["bounded-blocking"])
+    assert not r.findings, r.findings
+
+
 def test_async_purity_fixtures(tmp_path):
     bad = """import time
 import ray_tpu
