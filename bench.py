@@ -21,7 +21,6 @@ instead of dying with a traceback.  Chaos test: arm
 ``RAY_TPU_FAULT_INJECT="bench.backend_init:1:2:unavailable"``.
 """
 
-import json
 import os
 import sys
 import time
@@ -756,16 +755,21 @@ def run_pipeline(n_stages: int = 2,
 
 
 def main() -> None:
+    from ray_tpu._private.bench_emit import (
+        emit_final_record,
+        emit_record_line,
+    )
+
     try:
         _, init_retries = init_backend()
         on_tpu = jax.default_backend() == "tpu"
     except Exception as e:  # noqa: BLE001 — rc-0 structured record, not a traceback
-        print(json.dumps({
+        emit_final_record({
             "metric": "llama_train_mfu", "value": 0.0, "unit": "%MFU",
             "vs_baseline": 0.0,
             "detail": {"error": f"backend init failed after retries: {e!r}",
                        "scope": "single_chip_proxy"},
-        }))
+        })
         return
 
     staged = resilience.run_staged(bench_stages(on_tpu), measure_stage)
@@ -809,11 +813,11 @@ def main() -> None:
     except Exception:  # noqa: BLE001 — backend lost after the ladder
         n_visible = 1
     if n_visible > 1:
-        print(json.dumps(run_multichip()))
+        emit_record_line(run_multichip())
     # Pipeline-parallel scenario: 1F1B Llama over negotiated channel
     # transports.  Own line; the single-chip headline stays LAST.
-    print(json.dumps(run_pipeline()))
-    print(json.dumps(result))
+    emit_record_line(run_pipeline())
+    emit_final_record(result)
 
 
 if __name__ == "__main__":

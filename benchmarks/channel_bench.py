@@ -27,12 +27,13 @@ Run: PYTHONPATH=. JAX_PLATFORMS=cpu python benchmarks/channel_bench.py
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record
 
 os.environ.setdefault("RAY_TPU_ICI_EMULATE", "1")
 
@@ -213,7 +214,7 @@ def main():
             "write_copy_ratio_legacy": round(legacy_ratio, 3),
         },
     }
-    print(json.dumps(result))
+    emit_final_record(result)
 
     tr.destroy()
     legacy.destroy()

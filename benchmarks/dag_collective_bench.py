@@ -15,8 +15,13 @@ Usage: python benchmarks/dag_collective_bench.py [size_kib] [iters]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record
 
 
 def _bench(backend: str, size_kib: int, iters: int) -> float:
@@ -75,6 +80,12 @@ def main():
     print(f"dag allreduce tcp (host-stage ring): {tcp * 1e3:.1f} ms/op")
     print(f"dag allreduce xla (device plane):    {xla * 1e3:.1f} ms/op "
           f"({tcp / xla:.2f}x vs tcp)")
+    emit_final_record({
+        "benchmark": "dag_allreduce", "payload_kib": size_kib,
+        "iters": iters, "tcp_ms_per_op": round(tcp * 1e3, 2),
+        "xla_ms_per_op": round(xla * 1e3, 2),
+        "xla_speedup_vs_tcp": round(tcp / xla, 2),
+    })
 
 
 if __name__ == "__main__":

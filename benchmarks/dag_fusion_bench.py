@@ -20,7 +20,6 @@ edge; the fast path is in-mesh fusion.  This bench measures both sides:
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -28,6 +27,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record
 
 
 def _bench_chain(w, k: int, dim: int, iters: int, jit: bool) -> float:
@@ -111,14 +112,14 @@ def main():
     hop = _bench_hop(wa, wb, args.dim, args.iters)
 
     mib = args.dim * args.dim * 4 / (1 << 20)
-    print(json.dumps({
+    emit_final_record({
         "dim": args.dim, "k": args.k,
         "chain_unfused_ms": round(unfused * 1e3, 3),
         "chain_fused_ms": round(fused * 1e3, 3),
         "fusion_speedup": round(unfused / fused, 2),
         "host_hop_ms_per_edge": round(hop * 1e3, 3),
         "host_hop_payload_mib": round(mib, 2),
-    }))
+    })
     ray_tpu.shutdown()
 
 

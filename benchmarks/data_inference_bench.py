@@ -19,7 +19,8 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import json
+
+from ray_tpu._private.bench_emit import emit_final_record
 import time
 
 import numpy as np
@@ -105,7 +106,7 @@ def main():
         ends = sorted({float(r["t_end"]) for r in out})
         steady_batches = len(starts) - 1
         steady_s = ends[-1] - ends[0] if steady_batches else float("nan")
-        print(json.dumps({
+        emit_final_record({
             "benchmark": "data_map_batches_inference",
             "model": "ViT-B/16 bf16 (ImageNet-shaped 224x224)",
             "steady_batches_per_s": round(steady_batches / steady_s, 2),
@@ -124,7 +125,7 @@ def main():
             # data panel and ingest_bench.py report) — BENCH rounds get
             # ingest throughput/overlap alongside the inference rate
             "ingest": it.ingest_stats.to_dict(),
-        }))
+        })
     finally:
         ray_tpu.shutdown()
 

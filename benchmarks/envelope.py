@@ -16,7 +16,6 @@ big_object, pg_churn.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -24,6 +23,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record
 
 
 def _timer():
@@ -220,8 +221,9 @@ def main():
                 print(f"[envelope] {name} FAILED: {e!r}", file=sys.stderr)
     finally:
         ray_tpu.shutdown()
-    print(json.dumps({"results": results, "failures": failures,
-                      "quick": args.quick}))
+    emit_final_record({"benchmark": "scalability_envelope",
+                       "results": results, "failures": failures,
+                       "quick": args.quick})
     sys.exit(1 if failures else 0)
 
 

@@ -22,11 +22,12 @@ RELATIVE gap is the signal.
 from __future__ import annotations
 
 import argparse
-import json
 import time
 
 import os, sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record
 
 
 def main():
@@ -63,7 +64,7 @@ def main():
     out["direct_gib_s"] = round(bench(lambda: src), 3)
 
     if not available():
-        print(json.dumps({**out, "error": "native arena unavailable"}))
+        emit_final_record({**out, "error": "native arena unavailable"})
         return
     store = NativeArenaStore("/rtpu_h2d_bench", max(2 * n + (1 << 20),
                                                     1 << 26), create=True)
@@ -86,7 +87,7 @@ def main():
             out["arena_gib_s"] / out["copychain_gib_s"], 3)
     finally:
         store.close(unlink_created=True)
-    print(json.dumps(out))
+    emit_final_record(out)
 
 
 if __name__ == "__main__":

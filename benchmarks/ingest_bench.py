@@ -21,7 +21,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import json
+
+from ray_tpu._private.bench_emit import emit_final_record
 
 import numpy as np
 
@@ -113,10 +114,10 @@ def main():
 
     ray_tpu.init(num_cpus=2, num_tpus=0)
     try:
-        print(json.dumps(run_compare(
+        emit_final_record(run_compare(
             blocks=args.blocks, rows=args.rows,
             block_delay_s=args.block_delay,
-            step_delay_s=args.step_delay)))
+            step_delay_s=args.step_delay))
     finally:
         ray_tpu.shutdown()
 

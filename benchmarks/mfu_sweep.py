@@ -17,8 +17,13 @@ Prints one JSON line per configuration.
 from __future__ import annotations
 
 import argparse
-import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record, emit_record_line
 
 import jax
 import jax.numpy as jnp
@@ -63,16 +68,15 @@ def run_cfg(name, cfg, batch, seq, steps, accum=1, extra=None):
     try:
         dt, state = step_time(tr, state, b, steps)
     except Exception as e:  # noqa: BLE001 — OOM/compile reject is a RESULT
-        print(json.dumps({"config": name, "error": repr(e)[:300]}),
-              flush=True)
+        emit_record_line({"config": name, "error": repr(e)[:300]})
         return
     flops = train_flops_per_step(cfg, batch, seq)
     mfu = flops / dt / peak_flops_per_chip()
-    print(json.dumps({
+    emit_record_line({
         "config": name, "batch": batch, "seq": seq, "accum": accum,
         "step_ms": round(dt * 1e3, 1), "mfu_pct": round(mfu * 100, 2),
         "tokens_per_s": round(batch * seq / dt),
-    }), flush=True)
+    })
     del tr, state, b
 
 
@@ -107,12 +111,12 @@ def int8_gather_micro(steps=20):
 
     t_bf16 = timeit(bf16_gather, table, toks)
     t_int8 = timeit(int8_gather, table_q, scale, toks)
-    print(json.dumps({
+    emit_record_line({
         "config": "embed_gather_micro",
         "bf16_ms": round(t_bf16 * 1e3, 3),
         "int8_ms": round(t_int8 * 1e3, 3),
         "speedup": round(t_bf16 / t_int8, 2),
-    }), flush=True)
+    })
 
 
 def multichip_sweep():
@@ -127,14 +131,14 @@ def multichip_sweep():
 
     for preset in sorted(MESH_PRESETS):
         rec = run_multichip(preset=preset)
-        print(json.dumps({
+        emit_record_line({
             "config": f"multichip_{preset}",
             "metric": rec["metric"], "value": rec["value"],
             "unit": rec["unit"],
             "mesh": rec["detail"].get("mesh"),
             "tokens_per_s": rec["detail"].get("tokens_per_s"),
             "step_ms": rec["detail"].get("step_ms"),
-        }), flush=True)
+        })
 
 
 def main():
@@ -152,6 +156,8 @@ def main():
 
     if args.multichip:
         multichip_sweep()
+        emit_final_record({"benchmark": "mfu_sweep",
+                           "mode": "multichip", "done": True})
         return
 
     base = LlamaConfig(
@@ -174,6 +180,8 @@ def main():
             32, seq, args.steps, accum=2)
     # 5) embedding-gather micro
     int8_gather_micro()
+    emit_final_record({"benchmark": "mfu_sweep", "mode": "single_chip",
+                       "done": True})
 
 
 if __name__ == "__main__":

@@ -10,7 +10,6 @@ Prints one JSON line per metric plus a summary table.
 from __future__ import annotations
 
 import argparse
-import json
 import time
 
 import numpy as np
@@ -18,6 +17,8 @@ import numpy as np
 import os, sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record, emit_record_line
 
 import ray_tpu
 
@@ -52,7 +53,7 @@ def timeit(name, fn, n, unit="ops/s", baseline=None):
     if baseline:
         row["vs_reference"] = round(rate / baseline, 2)
         row["reference"] = baseline
-    print(json.dumps(row))
+    emit_record_line(row)
     return row
 
 
@@ -124,7 +125,7 @@ def main():
     row = {"metric": "single_client_put_gigabytes", "value": round(gbs, 2),
            "unit": "GB/s", "vs_reference": round(gbs / 20.1, 2),
            "reference": 20.1}
-    print(json.dumps(row))
+    emit_record_line(row)
     rows.append(row)
 
     # -- get gigabytes (zero-copy read path) --------------------------------
@@ -139,7 +140,7 @@ def main():
     dt = time.perf_counter() - t0
     row = {"metric": "single_client_get_gigabytes",
            "value": round(n_puts * blob.nbytes / dt / 1e9, 2), "unit": "GB/s"}
-    print(json.dumps(row))
+    emit_record_line(row)
     rows.append(row)
 
     # -- placement group create/remove (768.9/s reference) ------------------
@@ -163,6 +164,7 @@ def main():
         ref = f"  ({r['vs_reference']}x reference)" if "vs_reference" in r \
             else ""
         print(f"  {r['metric']:34s} {r['value']:>10} {r['unit']}{ref}")
+    emit_final_record({"benchmark": "core_microbench", "results": rows})
 
 
 if __name__ == "__main__":

@@ -24,7 +24,8 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import json
+
+from ray_tpu._private.bench_emit import emit_final_record, emit_record_line
 import time
 
 
@@ -97,19 +98,19 @@ def main():
     ray_tpu.init(num_cpus=max(4, args.runners + 1), num_tpus=1)
     try:
         vec = run_mode(0, args.iters, num_envs=1024, frag=128)
-        print(json.dumps({"benchmark": "rl_ppo_vectorized",
+        emit_record_line({"benchmark": "rl_ppo_vectorized",
                           "env": "CartPole-v1 (jax, on-device)",
-                          **vec}))
+                          **vec})
         dist = run_mode(args.runners, max(4, args.iters // 4),
                         num_envs=32, frag=128)
-        print(json.dumps({"benchmark": "rl_ppo_distributed",
+        emit_record_line({"benchmark": "rl_ppo_distributed",
                           "env": "CartPole-v1",
                           "num_env_runners": args.runners,
-                          **dist}))
+                          **dist})
         ma = run_multi_agent(args.iters, num_envs=512, frag=128)
-        print(json.dumps({"benchmark": "rl_ppo_multi_agent",
+        emit_final_record({"benchmark": "rl_ppo_multi_agent",
                           "env": "PursuitTag (2-agent zero-sum, jax)",
-                          **ma}))
+                          **ma})
     finally:
         ray_tpu.shutdown()
 

@@ -52,7 +52,6 @@ call :func:`run_scenario` directly.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import threading
@@ -60,6 +59,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record, emit_record_line
 
 FAST_SCENARIOS = ["baseline", "publish_fault", "reward_fault",
                   "rollout_kill", "rollout_hang", "gcs_flake"]
@@ -435,13 +436,13 @@ def main() -> int:
         rec = run_scenario(name)
         records.append(rec)
         failed = failed or not rec["ok"]
-        print(json.dumps(rec), flush=True)
-    print(json.dumps({
+        emit_record_line(rec)
+    emit_final_record({
         "suite": "rlhf_chaos",
         "scenarios": len(records),
         "passed": sum(1 for r in records if r["ok"]),
         "failed": sum(1 for r in records if not r["ok"]),
-    }))
+    })
     return 1 if failed else 0
 
 

@@ -28,6 +28,8 @@ import time
 import os, sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from ray_tpu._private.bench_emit import emit_final_record
+
 
 def engine_bench(args) -> dict:
     import dataclasses
@@ -321,7 +323,7 @@ def main():
     args = ap.parse_args()
     out = {"engine": engine_bench, "serve": serve_bench,
            "serve-breakdown": serve_breakdown}[args.mode](args)
-    print(json.dumps(out))
+    emit_final_record(out)
 
 
 if __name__ == "__main__":

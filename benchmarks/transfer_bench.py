@@ -12,12 +12,13 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record, emit_record_line
 
 from ray_tpu._private.ids import ObjectID  # noqa: E402
 from ray_tpu._private.object_transfer import (  # noqa: E402
@@ -93,13 +94,13 @@ def main():
     dt = time.perf_counter() - t0
     assert ok and len(dst_store.get_buffer(oid)) == size
 
-    print(json.dumps({
+    emit_record_line({
         "metric": "chunked_pull_point_to_point",
         "value": round(size / dt / 1024**3, 3), "unit": "GiB/s",
         "detail": {"size_gb": args.size_gb, "seconds": round(dt, 2),
                    "chunk_mb": args.chunk_mb, "window": args.window,
                    "chunks": puller.stats["chunks"]},
-    }))
+    })
 
     for c in clients.values():
         loop.run_until_complete(c.close())
@@ -125,12 +126,12 @@ def main():
     dt2 = time.perf_counter() - t0
     assert buf is not None and len(buf) >= size
 
-    print(json.dumps({
+    emit_final_record({
         "metric": "same_host_handoff",
         "value": round(size / dt2 / 1024**3, 3), "unit": "GiB/s",
         "detail": {"size_gb": args.size_gb, "seconds": round(dt2, 3),
                    "speedup_vs_chunked": round(dt / dt2, 1)},
-    }))
+    })
     buf = None
     attacher.close(unlink_created=False)
     published.delete(oid2)

@@ -23,7 +23,8 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import json
+
+from ray_tpu._private.bench_emit import emit_final_record
 import sys
 import time
 
@@ -107,10 +108,12 @@ def main():
         )
         result = trainer.fit()
         if result.error is not None:
-            print(json.dumps({"error": str(result.error)}))
+            emit_final_record(
+                {"benchmark": "vision_train_dp",
+                 "error": str(result.error)})
             sys.exit(1)
         m = result.metrics
-        print(json.dumps({
+        emit_final_record({
             "benchmark": "vision_train_dp",
             "model": f"vit-cifar {m['params_m']:.1f}M params",
             "images_per_s_per_chip": round(m["images_per_s"], 1),
@@ -119,7 +122,7 @@ def main():
             "gflops_per_image": round(m["gflops_per_image"], 2),
             "loss": round(m["loss"], 4),
             "device": m["device"],
-        }))
+        })
     finally:
         ray_tpu.shutdown()
 

@@ -4,6 +4,7 @@ them; a new checker only needs a module here with a ``@register`` class
 
 from ray_tpu._private.analysis.checkers import (  # noqa: F401
     async_purity,
+    bench_emission,
     bounded_blocking,
     collective_supervision,
     context_capture,

@@ -236,7 +236,8 @@ def get_checkers(rules: Optional[Sequence[str]] = None) -> List[Checker]:
 _SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
 
 #: default scan set, relative to the repo root
-DEFAULT_PATHS = ("ray_tpu", "tests", "bench.py")
+DEFAULT_PATHS = ("ray_tpu", "tests", "bench.py", "benchmarks",
+                 "__graft_entry__.py")
 
 
 @dataclasses.dataclass

@@ -1,0 +1,118 @@
+"""Bench-record emission: the FINAL-bare-JSON-line contract, centralized.
+
+The bench harness parses the **last line of captured output** as the
+round's record; stdout and stderr are captured *merged*.  Every round in
+which the multichip dryrun's record failed to parse traced back to one
+of two leaks in hand-rolled ``print(json.dumps(...))`` endings:
+
+- **interleave**: stderr (XLA sharding warnings, absl teardown chatter)
+  is unbuffered while piped stdout is block-buffered, so bytes written
+  to stderr *before* the record routinely landed *after* it in the
+  merged capture — the harness then parsed a warning fragment;
+- **failure skips emission**: any assert/raise before the final print
+  exits with a traceback as the last output and no record at all;
+- **post-record teardown chatter**: asyncio "Task was destroyed"
+  warnings and other interpreter-exit output print after the last
+  user statement, stealing the final line from the record.
+
+:func:`emit_final_record` fixes the first (flush stderr, then write the
+record flushed, as one atomic line); :func:`final_record_guard` fixes
+the second (whatever happens inside the guard, a record — the real one
+or a structured error record — is the last thing on stdout).
+``raylint``'s ``bench-emission`` rule keeps every benchmark entrypoint
+on these helpers so the contract can't silently regress again.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import traceback
+from typing import Any, Dict, Iterator, Optional
+
+
+def emit_record_line(record: Dict[str, Any]) -> None:
+    """Print one intermediate bare-JSON record line, flushed — for
+    benches that stream per-scenario/per-section records before the
+    final headline record.
+
+    The record is written with a LEADING newline: in a merged capture an
+    unterminated stderr fragment (absl and XLA both write warnings in
+    pieces) would otherwise glue onto the front of the record line and
+    break the harness's ``json.loads(last_line)``.  A blank line in the
+    stream is harmless; a half-warning prefix is not."""
+    sys.stderr.flush()
+    sys.stdout.write("\n" + json.dumps(record) + "\n")
+    sys.stdout.flush()
+
+
+def emit_final_record(record: Dict[str, Any]) -> None:
+    """Emit the bench's FINAL record so it is the last parseable line of
+    the merged (stdout+stderr) capture: everything buffered on either
+    stream is flushed *first*, then the record is written as one line
+    and flushed — and then both std streams are redirected to devnull,
+    so teardown chatter (asyncio "Task was destroyed" warnings, logging
+    shutdown, atexit hooks) cannot print after the record and steal the
+    harness's last line.  Nothing may be printed after this call — the
+    raylint ``bench-emission`` rule enforces that statically for the
+    bench's own code, and the redirect enforces it for everyone else's
+    interpreter-exit output.
+
+    Post-record output is not discarded blind: it lands in a side-
+    channel tail log (``RAY_TPU_BENCH_TAIL_LOG``, default
+    ``<tmpdir>/ray_tpu_bench_tail_<pid>.log``) so a teardown crash after
+    a success-shaped record still leaves its traceback somewhere a
+    human can find it."""
+    emit_record_line(record)
+    tail_path = os.environ.get("RAY_TPU_BENCH_TAIL_LOG") or os.path.join(
+        tempfile.gettempdir(), f"ray_tpu_bench_tail_{os.getpid()}.log")
+    try:
+        sink = open(tail_path, "w", buffering=1)
+    except OSError:
+        sink = open(os.devnull, "w")
+    sys.stdout = sink
+    sys.stderr = sink
+
+
+@contextlib.contextmanager
+def final_record_guard(metric: str, *,
+                       detail: Optional[Dict[str, Any]] = None,
+                       unit: str = "") -> Iterator[Dict[str, Any]]:
+    """Guarantee a final bare-JSON record even when the bench body dies.
+
+    Usage::
+
+        with final_record_guard("llama_train_mfu_multichip") as out:
+            ...  # bench body
+            out["record"] = record        # the real record
+
+    On clean exit the guard emits ``out["record"]``.  On an exception it
+    prints the traceback to stderr, emits a structured zero-value error
+    record (same ``metric``, ``value: 0.0``, the error in ``detail``) as
+    the final line, and exits rc 1 via ``SystemExit`` — the harness
+    still parses a record, and the nonzero rc still marks the failure.
+    """
+    out: Dict[str, Any] = {}
+    try:
+        yield out
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 — the record IS the report
+        traceback.print_exc()
+        err_detail = dict(detail or {})
+        err_detail["error"] = f"{type(e).__name__}: {e}"
+        emit_final_record({
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0, "detail": err_detail,
+        })
+        raise SystemExit(1) from e
+    record = out.get("record")
+    if record is None:
+        err_detail = dict(detail or {})
+        err_detail["error"] = "bench body set no record"
+        record = {"metric": metric, "value": 0.0, "unit": unit,
+                  "vs_baseline": 0.0, "detail": err_detail}
+    emit_final_record(record)

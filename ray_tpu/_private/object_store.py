@@ -474,12 +474,25 @@ class HybridObjectStore:
                 logger.debug("native arena store unavailable", exc_info=True)
                 self.arena = None
 
-    def _spill_cold_objects(self, max_n: int = 64) -> int:
+    def _spill_cold_objects(self, max_n: int = 64,
+                            need_bytes: Optional[int] = None) -> int:
         """Persist evictable (sealed, refcount-0) arena objects to disk so
         pressure-driven LRU eviction can't destroy data, then delete them
-        from the arena to make room.  Returns objects spilled."""
+        from the arena to make room.  Returns objects spilled.
+
+        ``need_bytes`` bounds the drain: once roughly that much arena
+        space (plus slack for allocator fragmentation) has been freed,
+        stop.  A small put under pressure — a weight-sync KV commit
+        racing a data plane that keeps the arena full of ingest blocks —
+        must pay for ITS allocation, not synchronously flush every cold
+        block to disk (the production-day crucible measured multi-second
+        publish stalls exactly there).  ``None`` keeps the full drain
+        (the destructive-eviction last resort wants maximum headroom)."""
         if self.arena is None or self.spill is None:
             return 0
+        freed = 0
+        target = None if need_bytes is None else max(
+            2 * need_bytes, 1 << 20)
         # pins leaked by SIGKILLed workers would otherwise hold their
         # blocks forever (and hide them from evictable())
         try:
@@ -503,6 +516,8 @@ class HybridObjectStore:
                     if buf is not None and not self.spill.contains(oid):
                         self.spill.put_bytes(oid, buf)
                         spilled += 1
+                    if buf is not None:
+                        freed += len(buf)
                 except OSError:
                     logger.warning("spill write failed", exc_info=True)
                     self.arena.release(oid)
@@ -510,7 +525,9 @@ class HybridObjectStore:
                 self.arena.release(oid)
                 self.arena.delete(oid)
                 progressed = True
-            if not progressed:
+                if target is not None and freed >= target:
+                    break
+            if not progressed or (target is not None and freed >= target):
                 break
         if spilled:
             logger.info("spilled %d cold objects to %s", spilled,
@@ -536,9 +553,10 @@ class HybridObjectStore:
                 return self.arena.put_into(object_id, nbytes, write_fn,
                                            no_evict=True)
             except MemoryError:
-                # arena pressure: spill cold released objects to disk and
-                # retry (destructive eviction allowed as the last resort)
-                self._spill_cold_objects()
+                # arena pressure: spill JUST ENOUGH cold released objects
+                # to disk for this allocation and retry (destructive
+                # eviction allowed as the last resort)
+                self._spill_cold_objects(need_bytes=nbytes)
                 try:
                     return self.arena.put_into(object_id, nbytes, write_fn)
                 except MemoryError:

@@ -14,9 +14,10 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
     logs,
     metrics,
     serve,
+    slo,
     tasks,
     train,
 )
 
 ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train,
-               collective, data)
+               collective, data, slo)

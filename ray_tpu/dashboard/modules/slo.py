@@ -1,0 +1,34 @@
+"""SLO module: per-plane verdict view.
+
+Workloads that enforce SLOs (the production-day crucible, any job using
+``ray_tpu.util.slo``) publish verdict records into the GCS KV under
+namespace "slo" (key ``verdict/<plane>/<name>[/<phase>]``); the head
+lists them with plain table reads through the same
+``aggregate_verdict_records`` helper the state API and CLI use, so all
+three surfaces agree on ordering and on the staleness sweep (records
+from publishers silent past the shared observability window are
+dropped — a crucible that died mid-run must not pin a verdict forever).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_slo(_req):
+        from ray_tpu.util.slo import aggregate_verdict_records
+
+        records = []
+        for (ns, key), raw in list(gcs.kv.items()):
+            if ns != "slo" or not key.startswith("verdict/"):
+                continue
+            try:
+                records.append(json.loads(raw))
+            except (ValueError, TypeError):
+                continue
+        return jresp({"verdicts": aggregate_verdict_records(records)})
+
+    return [("GET", "/api/slo", api_slo)]

@@ -62,12 +62,12 @@ function spark(points, w=220, h=36) {
 
 async function renderOverview(root) {
   const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train, coll,
-         data] =
+         data, slo] =
     await Promise.all([
       j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
       j("/api/placement_groups"), j("/api/submitted_jobs"),
       j("/api/tasks/summary"), j("/api/serve"), j("/api/train"),
-      j("/api/collective"), j("/api/data")]);
+      j("/api/collective"), j("/api/data"), j("/api/slo")]);
   const taskRows = Object.entries(tasks).map(([name, s]) =>
     ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   const depRows = Object.entries(serve.deployments || {}).map(
@@ -106,6 +106,14 @@ async function renderOverview(root) {
       ? `${r.locality_hits}/${r.locality_hits + r.locality_misses}` : "",
     "dev buf": r.device_buffer_capacity
       ? `${r.device_prefetch_depth}/${r.device_buffer_capacity}` : ""}));
+  const sloRows = (slo.verdicts || []).map(v => ({
+    plane: v.plane, name: v.name, phase: v.phase || "",
+    status: v.status,
+    metrics: Object.entries(v.metrics || {}).filter(([k, val]) =>
+      typeof val === "number").map(([k, val]) => `${k}=${val}`).join(" "),
+    violations: (v.violations || []).map(x =>
+      `${x.metric}: ${x.value} > ${x.limit}`).join("; ") ||
+      (v.degraded_reason || "")}));
   const collRows = (coll.groups || []).map(g => ({
     group: g.group_name, state: g.state, backend: g.backend,
     epoch: g.epoch, members: `${g.joined}/${g.world_size}`,
@@ -130,6 +138,10 @@ async function renderOverview(root) {
                          "data wait","h2d","coll wait","ckpt","w-pub",
                          "other"])
       : "<i>no step ledger reporting</i>") +
+    "<h2>SLO verdicts</h2>" + (sloRows.length
+      ? table(sloRows, ["plane","name","phase","status","metrics",
+                        "violations"])
+      : "<i>no SLO verdicts published</i>") +
     "<h2>Data ingest</h2>" + table(dataRows,
       ["iterator","state","blocks","batches","MB","xnode MB","fetch s",
        "blocked s","h2d s","locality","dev buf"]) +

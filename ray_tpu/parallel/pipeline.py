@@ -117,10 +117,13 @@ def pipeline_apply(
         return jax.lax.psum(outputs, axis)
 
     shard_spec = jax.tree.map(lambda _: P(axis), stacked_params)
-    # Partial-manual shard_map (only `axis` manual, rest auto) has no
-    # equivalent in the legacy jax.experimental.shard_map that
-    # ops/attention.py's compat wrapper can fall back to — fail with a
-    # clear version message instead of an opaque TypeError.
+    # Partial-manual shard_map: only `axis` manual, rest auto.  Modern
+    # jax spells that `jax.shard_map(..., axis_names={axis},
+    # check_vma=False)`; on older jax (< 0.6) the same program is the
+    # legacy `jax.experimental.shard_map.shard_map(..., auto=<the other
+    # mesh axes>, check_rep=False)`.  Try modern first, fall back, and
+    # only fail — with a clear version message — when neither spelling
+    # exists.
     try:
         mapped = jax.shard_map(
             pipelined,
@@ -130,11 +133,25 @@ def pipeline_apply(
             axis_names={axis},
             check_vma=False,
         )
-    except (AttributeError, TypeError) as e:
-        raise RuntimeError(
-            "pipeline parallelism needs jax.shard_map with partial-manual "
-            "axis_names support (jax >= 0.6); this jax lacks it"
-        ) from e
+    except (AttributeError, TypeError):
+        try:
+            from jax.experimental.shard_map import shard_map as _legacy
+
+            mapped = _legacy(
+                pipelined,
+                mesh=mesh,
+                in_specs=(shard_spec, P()),
+                out_specs=P(),
+                check_rep=False,
+                auto=frozenset(n for n in mesh.axis_names if n != axis),
+            )
+        except (ImportError, AttributeError, TypeError) as e:
+            raise RuntimeError(
+                "pipeline parallelism needs a shard_map with "
+                "partial-manual axis support (jax.shard_map axis_names= "
+                "on jax >= 0.6, or jax.experimental.shard_map auto= on "
+                "0.4.x); this jax has neither"
+            ) from e
     out = mapped(stacked_params, micro)
     return out.reshape(x.shape)
 

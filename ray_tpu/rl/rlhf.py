@@ -85,6 +85,12 @@ class RLHFConfig:
     learner_batch_size: int = 64     # ingest minibatch
     lr: float = 5e-2
     seed: int = 0
+    # continual-learning cadence: pad each iteration to at least this
+    # wall time (sleep the remainder), so a loop on tiny proxy models
+    # paces like one gated on real rollout/data arrival — the
+    # production-day crucible uses it to keep the loop LIVE across its
+    # chaos window instead of finishing before the faults land
+    iteration_interval_s: float = 0.0
     # weight sync
     name: str = "rlhf"
     staleness_bound: Optional[int] = 4
@@ -101,6 +107,10 @@ class RLHFConfig:
     num_workers: int = 1
     max_failures: int = 0
     storage_path: Optional[str] = None
+    # extra custom resources per train worker (ScalingConfig
+    # resources_per_worker) — the production-day crucible pins the
+    # learner to a non-draining node with this
+    resources_per_worker: Optional[Dict[str, float]] = None
     # reward: None = built-in scripted linear-gold reward; else a
     # picklable callable (obs, actions, cfg) -> np.ndarray of rewards
     reward_fn: Optional[Callable] = None
@@ -695,8 +705,12 @@ def _rlhf_train_loop(config: Dict[str, Any]) -> None:
     ctx = train.get_context()
     rt = _LoopRuntime(cfg, ctx)
     ledger = ctx.step_ledger()
+    # per-iteration wall times (this incarnation) — the RLHF plane's
+    # step-time ledger for SLO evaluation (util.slo.evaluate_rlhf)
+    iter_walls: List[float] = []
     try:
         for it in range(rt.start_iter, cfg.iterations):
+            t_iter = time.perf_counter()
             if rt.chaos.get("kill_rollout_at_iter") == it + 1:
                 rt.rollout.chaos_kill_pending = True
             # one causal tree per iteration: rollout actor calls, reward
@@ -716,6 +730,12 @@ def _rlhf_train_loop(config: Dict[str, Any]) -> None:
                         _batches_to_dataset(batches, rt.ledger))
                 if rt.world > 1:
                     rt.allreduce_params()
+                if cfg.iteration_interval_s > 0:
+                    pad = cfg.iteration_interval_s - (
+                        time.perf_counter() - t_iter)
+                    if pad > 0:
+                        time.sleep(pad)
+                iter_walls.append(time.perf_counter() - t_iter)
                 if rt.rank != 0:
                     train.report({"training_iteration": it + 1,
                                   "rank": rt.rank})
@@ -724,6 +744,10 @@ def _rlhf_train_loop(config: Dict[str, Any]) -> None:
                     ver = rt.publish(jax.device_get(rt.params))
                 metrics = {
                     "training_iteration": it + 1,
+                    # rollout→reward→update(→allreduce) wall per
+                    # iteration, this incarnation — the plane's step
+                    # ledger for SLO verdicts (production_day bench)
+                    "iteration_walls_s": [round(w, 4) for w in iter_walls],
                     "published_version": int(ver.version),
                     "publisher_epoch": int(ver.epoch),
                     "consumed_versions": list(rt.consumed_versions),
@@ -789,7 +813,8 @@ class RLHFLoop:
             _rlhf_train_loop,
             train_loop_config={"rlhf": dataclasses.asdict(cfg)},
             scaling_config=train.ScalingConfig(
-                num_workers=cfg.num_workers, mesh=cfg.mesh),
+                num_workers=cfg.num_workers, mesh=cfg.mesh,
+                resources_per_worker=cfg.resources_per_worker),
             run_config=run_config,
         )
         return trainer.fit()

@@ -133,6 +133,25 @@ def cmd_status(args):
                          f"cancelled={ov.get('cancelled', 0)} "
                          f"queued={ov.get('queued', 0)}")
             print(line)
+    try:
+        from ray_tpu.util.state import list_slo_verdicts
+
+        verdicts = list_slo_verdicts()
+    except Exception:  # noqa: BLE001 — status must render without KV
+        verdicts = []
+    if verdicts:
+        print("SLO verdicts:")
+        for v in verdicts:
+            tag = f"{v.get('plane')}/{v.get('name')}"
+            if v.get("phase"):
+                tag += f"/{v['phase']}"
+            line = f"  {tag} [{v.get('status')}]"
+            for viol in v.get("violations") or []:
+                line += (f" {viol.get('metric')}={viol.get('value')} "
+                         f"(limit {viol.get('limit')})")
+            if v.get("status") == "DEGRADED" and v.get("degraded_reason"):
+                line += f" ({v['degraded_reason']})"
+            print(line)
     ray_tpu.shutdown()
 
 

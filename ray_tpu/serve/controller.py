@@ -45,13 +45,15 @@ class ServeController:
             st = self._deployments.get(name)
             if st is None:
                 st = {"replicas": [], "version": 0, "last_scale": 0.0,
-                      "scale_marks": []}
+                      "scale_marks": [], "ready": set(), "starting": {}}
                 self._deployments[name] = st
             elif st.get("target") != target_payload or st.get("config") != config:
                 # code or config changed: running replicas embed the OLD
                 # payload — restart them all (full restart, not rolling)
                 old_replicas = list(st["replicas"])
                 st["replicas"] = []
+                st["ready"] = set()
+                st["starting"] = {}
             st.update(
                 target=target_payload, init_args=init_args,
                 init_kwargs=init_kwargs, config=config,
@@ -111,7 +113,17 @@ class ServeController:
             st = self._deployments.get(name)
             if st is None:
                 return None
-            return {"replicas": list(st["replicas"]),
+            # routers get READY replicas only: a still-constructing
+            # replacement (cold jit init can take seconds) must not
+            # receive dispatches that then queue behind its __init__ —
+            # the head-of-line the production-day drain surfaced.  With
+            # no confirmed-ready replica yet (initial deploy window) the
+            # full set is returned: queueing on a cold replica beats
+            # shedding the first seconds of traffic.
+            ready = st.get("ready") or set()
+            reps = [r for r in st["replicas"]
+                    if r._actor_id.hex() in ready] or list(st["replicas"])
+            return {"replicas": reps,
                     "max_ongoing_requests":
                         st["config"]["max_ongoing_requests"],
                     "max_queued_requests":
@@ -227,6 +239,10 @@ class ServeController:
             st["target"], st["init_args"], st["init_kwargs"],
             st["config"].get("user_config"), name, rid)
         st["replicas"].append(handle)
+        # readiness probe issued NOW; _confirm_starting_once promotes the
+        # replica into the routed set once this resolves
+        st.setdefault("starting", {})[handle._actor_id.hex()] = \
+            handle.check_health.remote()
         st["version"] += 1
 
     def _reconcile_once(self):
@@ -238,11 +254,79 @@ class ServeController:
                     self._start_replica(name, st)
                 while len(st["replicas"]) > goal:
                     victim = st["replicas"].pop()
+                    self._forget_replica(st, victim)
                     st["version"] += 1
                     try:
                         ray_tpu.kill(victim)
                     except Exception:
                         pass
+
+    @staticmethod
+    def _forget_replica(st: Dict[str, Any], replica) -> None:
+        """Lock held: drop a replica from the readiness bookkeeping."""
+        key = replica._actor_id.hex()
+        st.setdefault("ready", set()).discard(key)
+        st.setdefault("starting", {}).pop(key, None)
+
+    def _confirm_starting_once(self):
+        """Promote replicas whose readiness probe resolved into the
+        routed set (``ready``).  Runs every tick, so a replacement
+        becomes routable ~1 reconcile interval after its __init__
+        finishes — and not one request earlier."""
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, st in items:
+            with self._lock:
+                starting = list(st.get("starting", {}).items())
+            for key, ref in starting:
+                try:
+                    done, _ = ray_tpu.wait([ref], timeout=0)
+                except Exception:  # noqa: BLE001 — transient: next tick
+                    continue
+                if not done:
+                    continue
+                ok = False
+                try:
+                    ray_tpu.get(ref, timeout=1)
+                    ok = True
+                except Exception:  # noqa: BLE001 — failed init: health
+                    pass           # checker / prune will replace it
+                with self._lock:
+                    st.get("starting", {}).pop(key, None)
+                    if ok and any(r._actor_id.hex() == key
+                                  for r in st["replicas"]):
+                        st.setdefault("ready", set()).add(key)
+                        st["version"] += 1
+
+    def _prune_dead_replicas(self):
+        """Drop replicas whose actor the GCS reports DEAD (chaos kill,
+        node loss) the tick it happens, instead of waiting up to three
+        10s health-check rounds — the window in which every router kept
+        dispatching to a corpse and burning its retry budget."""
+        with self._lock:
+            if not any(st["replicas"] for st in self._deployments.values()):
+                return  # idle controller: no actor-table scan per tick
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            actors = w.run_coro(w.gcs.call("list_actors"))
+            dead = {a["actor_id"].hex() for a in actors
+                    if a.get("state") == "DEAD"}
+        except Exception:  # noqa: BLE001 — control-plane hiccup
+            return
+        if not dead:
+            return
+        with self._lock:
+            for st in self._deployments.values():
+                gone = [r for r in st["replicas"]
+                        if r._actor_id.hex() in dead]
+                for r in gone:
+                    st["replicas"].remove(r)
+                    self._forget_replica(st, r)
+                    self._health_fails.pop(r._actor_id.hex(), None)
+                if gone:
+                    st["version"] += 1
 
     def _autoscale_once(self):
         with self._lock:
@@ -321,6 +405,7 @@ class ServeController:
                     if st is None or r not in st["replicas"]:
                         continue
                     st["replicas"].remove(r)
+                    self._forget_replica(st, r)
                     st["version"] += 1
                     self._start_replica(name, st)
                     replacement = st["replicas"][-1]
@@ -362,6 +447,13 @@ class ServeController:
                 try:
                     ray_tpu.get(r.check_health.remote(), timeout=10)
                     self._health_fails.pop(key, None)
+                    with self._lock:
+                        st = self._deployments.get(name)
+                        if st and r in st["replicas"] and \
+                                key not in st.setdefault("ready", set()):
+                            st["ready"].add(key)
+                            st.get("starting", {}).pop(key, None)
+                            st["version"] += 1
                     continue
                 except Exception:
                     # a slow check (e.g. the replica is jit-compiling and
@@ -376,6 +468,7 @@ class ServeController:
                     st = self._deployments.get(name)
                     if st and r in st["replicas"]:
                         st["replicas"].remove(r)
+                        self._forget_replica(st, r)
                         st["version"] += 1
                 try:
                     ray_tpu.kill(r)
@@ -426,6 +519,8 @@ class ServeController:
             try:
                 self._autoscale_once()
                 self._reconcile_once()
+                self._confirm_starting_once()
+                self._prune_dead_replicas()
                 self._drain_migrate_once()
                 if n % 10 == 9:
                     self._health_check_once()

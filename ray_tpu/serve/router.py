@@ -217,7 +217,14 @@ class Router:
             if hit and hit[1] > now:
                 return hit[0]
         try:
-            info = ray_tpu.get(replica.probe.remote(), timeout=5)
+            # short: the probe rides the DISPATCH path, so an unreachable
+            # replica (dying mid-drain, wedged in a long GIL hold) must
+            # cost one bounded stall per cache window, not 5s per probe —
+            # under open-loop load the old timeout alone inflated p99 by
+            # seconds whenever a replica was killed (production-day
+            # crucible).  The failure result is negative-cached below for
+            # QUEUE_LEN_CACHE_S like any other probe answer.
+            info = ray_tpu.get(replica.probe.remote(), timeout=1.5)
             qlen = info["qlen"]
             self._sync_models(key, info.get("models") or [])
         except Exception:

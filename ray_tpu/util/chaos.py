@@ -1,0 +1,195 @@
+"""Deterministic scheduled chaos timelines.
+
+The fault-injection registry (:mod:`ray_tpu.util.fault_injection`) arms
+one site at a time; a *production-day* rehearsal needs a whole script:
+"drain node 2 at t=10s, kill a serve replica at t=15s, flake the GCS
+for 5s at t=20s".  :class:`ChaosTimeline` executes exactly that — a
+list of events, each with an offset ``at`` (seconds from timeline
+start), run by one background thread in scheduled order.
+
+Two event families:
+
+- ``kind="fault"`` — windowed arming of a registry site.  At its
+  offset the event calls :func:`fault_injection.arm_window` with the
+  event's ``duration`` (default 1s), so the site fails (or hangs, for
+  ``fault="delay"``) for the window and then disarms itself.
+- anything else — dispatched to a caller-registered **action**
+  (``actions={"drain_node": fn, ...}``).  Actions receive
+  ``(event, rng)`` where ``rng`` is the timeline's seeded
+  ``random.Random``; an action that needs to pick a victim (which
+  replica? which rollout actor?) draws from ``rng`` so the same
+  ``(spec, seed)`` always picks the same victim.  Whatever the action
+  returns is recorded in the execution log.
+
+Determinism contract (unit-tested): :meth:`plan` is a pure function of
+``(events, seed)`` — same spec in, identical normalized schedule out
+(fire offsets, order, sites, chosen arguments).  Wall-clock execution
+adds jitter to *when* an event lands, never to *what* fires or in what
+order; the log records both the scheduled and actual offsets so a run
+can prove it executed its plan.
+
+Scenario files are plain JSON::
+
+    {"seed": 0,
+     "events": [
+       {"at": 10, "kind": "drain_node", "node_index": 1,
+        "deadline_s": 8},
+       {"at": 15, "kind": "kill_replica", "deployment": "pd-llm"},
+       {"at": 18, "kind": "kill_rollout"},
+       {"at": 20, "kind": "fault", "site": "gcs_store.call",
+        "duration": 5, "fault": "connection"}]}
+
+(see docs/fault_tolerance.md, "Production day").
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ray_tpu.util import fault_injection as fi
+
+ActionFn = Callable[[Dict[str, Any], random.Random], Any]
+
+
+def _normalize_event(ev: Dict[str, Any], idx: int) -> Dict[str, Any]:
+    if "at" not in ev or "kind" not in ev:
+        raise ValueError(
+            f"chaos event #{idx} needs 'at' and 'kind': {ev!r}")
+    out = dict(ev)
+    out["at"] = float(ev["at"])
+    if out["at"] < 0:
+        raise ValueError(f"chaos event #{idx}: negative offset {out['at']}")
+    out["seq"] = idx
+    if out["kind"] == "fault":
+        if "site" not in out:
+            raise ValueError(f"chaos fault event #{idx} needs 'site'")
+        out.setdefault("duration", 1.0)
+        out.setdefault("fault", "connection")
+        out.setdefault("nth", 1)
+        out.setdefault("count", 1 << 30)
+    return out
+
+
+class ChaosTimeline:
+    """Execute a scheduled list of chaos events, deterministically."""
+
+    def __init__(self, events: Sequence[Dict[str, Any]], *,
+                 seed: int = 0,
+                 actions: Optional[Dict[str, ActionFn]] = None):
+        self._events = [_normalize_event(ev, i)
+                        for i, ev in enumerate(events)]
+        self._events.sort(key=lambda e: (e["at"], e["seq"]))
+        self._seed = seed
+        self._actions = dict(actions or {})
+        for ev in self._events:
+            if ev["kind"] != "fault" and ev["kind"] not in self._actions:
+                raise ValueError(
+                    f"chaos event kind {ev['kind']!r} has no registered "
+                    f"action (have: fault, {sorted(self._actions)})")
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._log: List[Dict[str, Any]] = []
+        self._log_lock = threading.Lock()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_file(cls, path: str, *,
+                  actions: Optional[Dict[str, ActionFn]] = None,
+                  seed: Optional[int] = None) -> "ChaosTimeline":
+        """Load a JSON scenario file (``{"seed": ..., "events": [...]}``
+        or a bare event list).  ``seed=`` overrides the file's."""
+        with open(path) as f:
+            spec = json.load(f)
+        if isinstance(spec, list):
+            events, file_seed = spec, 0
+        else:
+            events, file_seed = spec.get("events", []), spec.get("seed", 0)
+        return cls(events, seed=file_seed if seed is None else seed,
+                   actions=actions)
+
+    # -- introspection -------------------------------------------------------
+
+    def plan(self) -> List[Dict[str, Any]]:
+        """The normalized, ordered schedule this timeline will execute —
+        a pure function of ``(events, seed)``.  Two timelines built from
+        the same spec return identical plans (the determinism gate)."""
+        return [dict(ev) for ev in self._events]
+
+    @property
+    def duration_s(self) -> float:
+        """Offset of the last scheduled event (fault windows extend it)."""
+        end = 0.0
+        for ev in self._events:
+            end = max(end, ev["at"] + (ev.get("duration", 0.0)
+                                       if ev["kind"] == "fault" else 0.0))
+        return end
+
+    def executed(self) -> List[Dict[str, Any]]:
+        """Execution log so far: one entry per fired event with its
+        scheduled ``at``, actual ``fired_at`` offset, and outcome."""
+        with self._log_lock:
+            return [dict(e) for e in self._log]
+
+    # -- execution -----------------------------------------------------------
+
+    def start(self) -> "ChaosTimeline":
+        if self._thread is not None:
+            raise RuntimeError("timeline already started")
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-timeline", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Abandon any not-yet-fired events and settle the thread."""
+        self._stop.set()
+        self.join()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout if timeout is not None
+                   else self.duration_s + 30.0)
+            if t.is_alive():
+                raise RuntimeError("chaos timeline thread did not settle")
+
+    def _run(self) -> None:
+        # one seeded rng, consumed in deterministic (scheduled) event
+        # order — victim choice is a function of (spec, seed) alone
+        rng = random.Random(self._seed)
+        t0 = time.monotonic()
+        for ev in self._events:
+            delay = ev["at"] - (time.monotonic() - t0)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            entry: Dict[str, Any] = {
+                "at": ev["at"], "kind": ev["kind"], "seq": ev["seq"],
+                "fired_at": round(time.monotonic() - t0, 3),
+            }
+            try:
+                entry["result"] = self._fire(ev, rng)
+                entry["ok"] = True
+            except Exception as e:  # noqa: BLE001 — log, keep scripting
+                entry["ok"] = False
+                entry["error"] = f"{type(e).__name__}: {e}"
+            with self._log_lock:
+                self._log.append(entry)
+
+    def _fire(self, ev: Dict[str, Any], rng: random.Random) -> Any:
+        if ev["kind"] == "fault":
+            kind = ev["fault"]
+            exc = f"delay:{ev['arg']}" if kind == "delay" and "arg" in ev \
+                else kind
+            fi.arm_window(ev["site"], 0.0, float(ev["duration"]),
+                          nth=int(ev["nth"]), count=int(ev["count"]),
+                          exc=exc)
+            return {"site": ev["site"], "window_s": ev["duration"],
+                    "fault": kind}
+        return self._actions[ev["kind"]](ev, rng)

@@ -14,11 +14,22 @@ or, for subprocesses (bench, spawned workers), via the environment::
     RAY_TPU_FAULT_INJECT="bench.backend_init:1:2:unavailable"
     #                      site              :nth:count:kind[:arg]
 
-Spec grammar: ``site:nth[:count[:kind[:arg]]]`` — calls ``nth ..
-nth+count-1`` to the site trigger the ``kind`` (see ``_KINDS``); only
-``delay`` takes an ``arg`` (seconds).  Multiple specs join with ``;``.
-Arming is deterministic — a site fires on exact call indices, never
-randomly — so chaos tests reproduce bit-for-bit.
+Spec grammar: ``site:nth[:count[:kind[:arg]]][@start+duration]`` —
+calls ``nth .. nth+count-1`` to the site trigger the ``kind`` (see
+``_KINDS``); only ``delay`` takes an ``arg`` (seconds).  Multiple specs
+join with ``;``.  Arming is deterministic — a site fires on exact call
+indices, never randomly — so chaos tests reproduce bit-for-bit.
+
+The optional ``@start+duration`` suffix is **windowed (scheduled)
+arming**: the site is armed ``start`` seconds after the spec is loaded
+and disarms itself ``duration`` seconds later (``gcs_store.call:1:9999:
+connection@10+5`` = every store RPC between t=10s and t=15s fails).
+Calls outside the window neither count nor fire, so the ``nth``/
+``count`` indices are *window-relative* and a scenario replays
+identically however much traffic preceded its window.  Via the API use
+:func:`arm_window`; scenario files script whole fault timelines through
+``ray_tpu.util.chaos.ChaosTimeline``, which arms these windows (and
+fires cluster-level actions like node drains) at scheduled offsets.
 
 Sites currently wired (see docs/fault_tolerance.md):
 
@@ -98,29 +109,64 @@ _KINDS = {
 
 
 class _Arm:
-    __slots__ = ("nth", "count", "make", "delay", "calls", "fired")
+    __slots__ = ("nth", "count", "make", "delay", "calls", "fired",
+                 "start", "until")
 
-    def __init__(self, nth: int, count: int, make, delay=None):
+    def __init__(self, nth: int, count: int, make, delay=None,
+                 start=None, until=None):
         self.nth = nth      # 1-based call index of the first failure
         self.count = count  # how many consecutive calls fail
         self.make = make    # site -> Exception (None for delay kind)
         self.delay = delay  # seconds to sleep instead of raising
         self.calls = 0      # total fault_point() hits at this site
         self.fired = 0      # how many times the fault actually fired
+        # windowed arming (monotonic deadlines): calls before `start`
+        # are invisible (not counted); past `until` the arm is spent
+        self.start = start
+        self.until = until
+
+    def in_window(self, now: float) -> bool:
+        if self.start is not None and now < self.start:
+            return False
+        if self.until is not None and now >= self.until:
+            return False
+        return True
 
 
 _lock = threading.Lock()
 _armed: Dict[str, _Arm] = {}
 
 
+def _parse_window(part: str):
+    """Split the optional ``@start+duration`` suffix off one spec part.
+    Returns ``(spec_without_suffix, start_s, duration_s)`` where the
+    times are None when no window rides the spec."""
+    if "@" not in part:
+        return part, None, None
+    body, _, win = part.rpartition("@")
+    start_s, plus, dur = win.partition("+")
+    if not plus:
+        raise ValueError(
+            f"{ENV_VAR}: bad window {win!r} (want @start+duration)")
+    return body, float(start_s), float(dur)
+
+
+def _monotonic() -> float:
+    import time
+
+    return time.monotonic()
+
+
 def _load_env() -> None:
     spec = os.environ.get(ENV_VAR, "")
     if not spec:
         return
+    now = _monotonic()
     for part in spec.split(";"):
         part = part.strip()
         if not part:
             continue
+        part, win_start, win_dur = _parse_window(part)
         fields = part.split(":")
         if len(fields) < 2:
             raise ValueError(
@@ -129,18 +175,39 @@ def _load_env() -> None:
         nth = int(fields[1])
         count = int(fields[2]) if len(fields) > 2 else 1
         kind = fields[3] if len(fields) > 3 else "connection"
+        start = until = None
+        if win_start is not None:
+            start = now + win_start
+            until = start + win_dur
         if kind == "delay":
             seconds = float(fields[4]) if len(fields) > 4 else 30.0
-            _armed[site] = _Arm(nth, count, None, delay=seconds)
+            _armed[site] = _Arm(nth, count, None, delay=seconds,
+                                start=start, until=until)
             continue
         if kind not in _KINDS:
             raise ValueError(
                 f"{ENV_VAR}: unknown kind {kind!r} "
                 f"(expected 'delay' or one of {sorted(_KINDS)})")
-        _armed[site] = _Arm(nth, count, _KINDS[kind])
+        _armed[site] = _Arm(nth, count, _KINDS[kind], start=start,
+                            until=until)
 
 
 _load_env()
+
+
+def _resolve_exc(exc: Union[BaseException, type, str, None]):
+    """``exc`` vocabulary -> ``(make, delay)`` for an ``_Arm``."""
+    if isinstance(exc, str) and (exc == "delay"
+                                 or exc.startswith("delay:")):
+        _, _, arg = exc.partition(":")
+        return None, (float(arg) if arg else 30.0)
+    if exc is None:
+        return _KINDS["connection"], None
+    if isinstance(exc, str):
+        return _KINDS[exc], None
+    if isinstance(exc, BaseException):
+        return (lambda site, _e=exc: _e), None
+    return (lambda site, _c=exc: _c(f"fault injected at {site}")), None
 
 
 def arm(site: str, *, nth: int = 1, count: int = 1,
@@ -153,23 +220,31 @@ def arm(site: str, *, nth: int = 1, count: int = 1,
     calls SLEEP instead of raising, injecting a hang), or None
     (ConnectionError).
     """
-    if isinstance(exc, str) and (exc == "delay"
-                                 or exc.startswith("delay:")):
-        _, _, arg = exc.partition(":")
-        with _lock:
-            _armed[site] = _Arm(nth, count, None,
-                                delay=float(arg) if arg else 30.0)
-        return
-    if exc is None:
-        make = _KINDS["connection"]
-    elif isinstance(exc, str):
-        make = _KINDS[exc]
-    elif isinstance(exc, BaseException):
-        make = lambda site, _e=exc: _e  # noqa: E731
-    else:
-        make = lambda site, _c=exc: _c(f"fault injected at {site}")  # noqa: E731
+    make, delay = _resolve_exc(exc)
     with _lock:
-        _armed[site] = _Arm(nth, count, make)
+        _armed[site] = _Arm(nth, count, make, delay=delay)
+
+
+def arm_window(site: str, start_s: float, duration_s: float, *,
+               nth: int = 1, count: int = 1 << 30,
+               exc: Union[BaseException, type, str, None] = None) -> None:
+    """Windowed (scheduled) arming: ``site`` arms ``start_s`` seconds
+    from now and disarms itself ``duration_s`` later.  Within the window
+    the usual ``nth``/``count`` indices apply, counted from the window's
+    first call (default: every in-window call fires).  The chaos
+    timeline uses this to script "flake the GCS for 5s at t=20s" without
+    a babysitting disarm thread."""
+    if duration_s <= 0:
+        raise ValueError(f"arm_window: duration must be > 0, "
+                         f"got {duration_s}")
+    # the _Arm is built with its window in ONE publication: a two-step
+    # arm-then-attach-window would leave the site live (windowless) for
+    # a racing fault_point between the two lock acquisitions
+    make, delay = _resolve_exc(exc)
+    start = _monotonic() + start_s
+    with _lock:
+        _armed[site] = _Arm(nth, count, make, delay=delay,
+                            start=start, until=start + duration_s)
 
 
 def disarm(site: Optional[str] = None) -> None:
@@ -217,6 +292,9 @@ def fault_point(site: str) -> None:
         a = _armed.get(site)
         if a is None:
             return
+        if a.start is not None or a.until is not None:
+            if not a.in_window(_monotonic()):
+                return  # outside the window: invisible, not counted
         a.calls += 1
         if a.nth <= a.calls < a.nth + a.count:
             a.fired += 1

@@ -1,0 +1,408 @@
+"""Per-plane SLO specs, evaluator, and verdict records.
+
+The production-day macro-crucible (``benchmarks/production_day.py``) runs
+three planes — serve, RLHF/train, data ingest — on one cluster and needs
+a first-class answer to "did each plane hold its promises while chaos
+ran?".  This module is that answer, in three layers:
+
+1. **Specs** — declarative per-plane SLOs: :class:`ServeSLO` (open-loop
+   p99 latency ceiling, shed-rate ceiling, sheds-fail-fast bound),
+   :class:`RLHFSLO` (step-time ceiling + zero trajectory loss), and
+   :class:`IngestSLO` (throughput floor + post-event recovery bound).
+2. **Evaluator** — pure functions from ledger snapshots to
+   :class:`Verdict` records.  They consume what the runtime already
+   measures: the serve plane's per-request samples (latencies measured
+   from the *intended* Poisson arrival time, so a stalled client cannot
+   hide a slow server — coordinated omission), ``OverloadStats``
+   counter snapshots, the RLHF loop's per-iteration walls +
+   ``TrajectoryLedger`` counts, and ``IngestStats``-adjacent batch
+   timelines.  A missing or empty ledger degrades the verdict to
+   ``DEGRADED`` (explicitly not PASS: silence is not compliance) instead
+   of crashing the evaluation.
+3. **Verdict records** — published to the GCS KV (namespace ``"slo"``,
+   key ``verdict/<plane>/<name>``) so ``util.state.list_slo_verdicts`` /
+   ``raytpu status`` / the dashboard SLO panel can render cluster-wide
+   SLO state with one prefix read.  Records older than :data:`STALE_S`
+   (the PR 9 observability window) are swept from listings.
+
+Verdict statuses: ``PASS`` (every enforced threshold held), ``FAIL``
+(at least one violation, each named with measured value and limit), and
+``DEGRADED`` (the plane produced no evaluable evidence — missing ledger,
+zero samples).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# verdict records older than this are dropped from listings — the same
+# staleness window the metrics/trace publishers use (docs/observability.md)
+STALE_S = 600.0
+
+_KV_NAMESPACE = "slo"
+_KV_PREFIX = "verdict/"
+
+PASS = "PASS"
+FAIL = "FAIL"
+DEGRADED = "DEGRADED"
+
+
+# ---------------------------------------------------------------------------
+# specs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServeSLO:
+    """Serving-plane SLO under open-loop traffic.
+
+    ``p99_latency_s`` bounds the 99th-percentile latency of *successful*
+    requests, measured from the intended (scheduled) arrival time.
+    ``max_shed_rate`` bounds the fraction of offered requests that were
+    not served OK (shed + expired + errored).  ``shed_fail_fast_s``
+    bounds how long a rejected request took to be rejected — the
+    overload layer's promise is that sheds fail *fast*, never ride out
+    the full client timeout."""
+
+    name: str = "serve"
+    p99_latency_s: Optional[float] = 1.0
+    max_shed_rate: Optional[float] = 0.10
+    shed_fail_fast_s: Optional[float] = 1.0
+
+
+@dataclasses.dataclass
+class RLHFSLO:
+    """RLHF/train-plane SLO.
+
+    ``p99_step_time_s`` bounds the per-iteration wall time;
+    ``zero_trajectory_loss`` requires exactly-once trajectory
+    accounting: no double-counts and every produced batch either
+    consumed or dropped *with* accounting."""
+
+    name: str = "rlhf"
+    p99_step_time_s: Optional[float] = None
+    zero_trajectory_loss: bool = True
+
+
+# the train plane shares the RLHF spec shape (step-time + accounting)
+TrainSLO = RLHFSLO
+
+
+@dataclasses.dataclass
+class IngestSLO:
+    """Data-plane SLO.
+
+    ``min_rows_per_s`` is the phase-wide throughput floor.
+    ``recovery_s`` bounds how long after each chaos event the
+    *instantaneous* throughput (sliding ``probe_window_s`` window) may
+    stay below the floor — ingest must recover, not merely average out."""
+
+    name: str = "ingest"
+    min_rows_per_s: Optional[float] = None
+    recovery_s: Optional[float] = None
+    probe_window_s: float = 2.0
+
+
+# ---------------------------------------------------------------------------
+# verdicts
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One plane's SLO evaluation over one window/phase."""
+
+    plane: str
+    name: str
+    status: str                       # PASS | FAIL | DEGRADED
+    phase: str = ""                   # e.g. "baseline" | "chaos"
+    metrics: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    violations: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    degraded_reason: str = ""
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    @property
+    def ok(self) -> bool:
+        return self.status == PASS
+
+    def violate(self, metric: str, value: Any, limit: Any) -> None:
+        self.status = FAIL
+        self.violations.append(
+            {"metric": metric, "value": value, "limit": limit})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _degraded(plane: str, name: str, phase: str, reason: str) -> Verdict:
+    return Verdict(plane=plane, name=name, phase=phase, status=DEGRADED,
+                   degraded_reason=reason)
+
+
+def quantile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile (q in [0, 1]) without interpolation — the
+    conservative choice for latency SLOs (p99 of 100 samples is the
+    100th-worst sample, not a blend)."""
+    if not values:
+        return math.nan
+    s = sorted(values)
+    rank = max(1, math.ceil(q * len(s)))
+    return s[min(rank, len(s)) - 1]
+
+
+# ---------------------------------------------------------------------------
+# evaluators
+# ---------------------------------------------------------------------------
+
+
+def evaluate_serve(slo: ServeSLO,
+                   samples: Optional[Sequence[Dict[str, Any]]],
+                   *,
+                   overload: Optional[Dict[str, int]] = None,
+                   phase: str = "") -> Verdict:
+    """Evaluate the serving plane from open-loop request samples.
+
+    Each sample: ``{"t": intended-arrival wall, "latency_s": float,
+    "outcome": "ok" | "shed" | "expired" | "error"}``.  Latencies MUST
+    be measured from the intended arrival time (the Poisson schedule),
+    not the actual send time — a client thread that stalls behind a slow
+    response would otherwise silently pause the arrival process and
+    launder server slowness out of the percentile (coordinated
+    omission).  ``overload`` optionally carries the OverloadStats
+    counter totals for the window (shed/expired/cancelled/queued); it
+    enriches the metrics block and backstops the shed-rate when the
+    client saw fewer rejections than the router counted."""
+    if not samples:
+        return _degraded("serve", slo.name, phase,
+                         "no request samples (serve ledger missing)")
+    v = Verdict(plane="serve", name=slo.name, status=PASS, phase=phase)
+    ok_lat = [s["latency_s"] for s in samples if s["outcome"] == "ok"]
+    # the fail-fast gate clocks a rejection from DISPATCH when the
+    # sample carries it: a shed that sat behind a saturated client pool
+    # is the pool's latency (already charged to the p99 above via the
+    # intended-arrival clock), not the overload layer's
+    shed_lat = [s.get("dispatch_latency_s", s["latency_s"])
+                for s in samples
+                if s["outcome"] in ("shed", "expired")]
+    offered = len(samples)
+    served = len(ok_lat)
+    not_ok = offered - served
+    shed_rate = not_ok / offered
+    v.metrics.update({
+        "offered": offered,
+        "served": served,
+        "shed_or_failed": not_ok,
+        "shed_rate": round(shed_rate, 4),
+        "p50_latency_s": round(quantile(ok_lat, 0.50), 4)
+        if ok_lat else None,
+        "p99_latency_s": round(quantile(ok_lat, 0.99), 4)
+        if ok_lat else None,
+        "p99_shed_latency_s": round(quantile(shed_lat, 0.99), 4)
+        if shed_lat else None,
+    })
+    if overload:
+        v.metrics["overload"] = dict(overload)
+    if slo.p99_latency_s is not None:
+        if not ok_lat:
+            v.violate("p99_latency_s", None, slo.p99_latency_s)
+        elif v.metrics["p99_latency_s"] > slo.p99_latency_s:
+            v.violate("p99_latency_s", v.metrics["p99_latency_s"],
+                      slo.p99_latency_s)
+    if slo.max_shed_rate is not None and shed_rate > slo.max_shed_rate:
+        v.violate("shed_rate", round(shed_rate, 4), slo.max_shed_rate)
+    if slo.shed_fail_fast_s is not None and shed_lat:
+        p99_shed = quantile(shed_lat, 0.99)
+        if p99_shed > slo.shed_fail_fast_s:
+            v.violate("p99_shed_latency_s", round(p99_shed, 4),
+                      slo.shed_fail_fast_s)
+    return v
+
+
+def evaluate_rlhf(slo: RLHFSLO,
+                  step_walls_s: Optional[Sequence[float]],
+                  ledger_counts: Optional[Dict[str, int]] = None,
+                  *,
+                  phase: str = "") -> Verdict:
+    """Evaluate the RLHF/train plane from per-iteration wall times and
+    the trajectory ledger's counter snapshot (``TrajectoryLedger.counts``
+    shape: produced/consumed/dropped/duplicates_rejected)."""
+    if not step_walls_s:
+        return _degraded("rlhf", slo.name, phase,
+                         "no step ledger (loop produced no iterations)")
+    v = Verdict(plane="rlhf", name=slo.name, status=PASS, phase=phase)
+    p99 = quantile(step_walls_s, 0.99)
+    v.metrics.update({
+        "iterations": len(step_walls_s),
+        "p50_step_s": round(quantile(step_walls_s, 0.50), 4),
+        "p99_step_s": round(p99, 4),
+        "max_step_s": round(max(step_walls_s), 4),
+    })
+    if slo.p99_step_time_s is not None and p99 > slo.p99_step_time_s:
+        v.violate("p99_step_s", round(p99, 4), slo.p99_step_time_s)
+    if ledger_counts is None:
+        # step times alone cannot prove exactly-once accounting
+        if slo.zero_trajectory_loss:
+            v.status = DEGRADED if v.status == PASS else v.status
+            v.degraded_reason = v.degraded_reason or \
+                "trajectory ledger missing (accounting unverifiable)"
+        return v
+    # TrajectoryLedger semantics: a settled sample attempt is either
+    # *produced* (returned a batch) or *dropped* (actor death / deadline
+    # — counted WITH a reason, never produced).  Zero loss therefore
+    # means every produced batch was consumed exactly once: no
+    # duplicates, and produced == consumed.  Drops are legal chaos
+    # behavior — reported, not a violation.
+    produced = int(ledger_counts.get("produced", 0))
+    consumed = int(ledger_counts.get("consumed", 0))
+    dropped = int(ledger_counts.get("dropped", 0))
+    dups = int(ledger_counts.get("duplicates_rejected", 0))
+    lost = produced - consumed
+    v.metrics.update({
+        "trajectories_produced": produced,
+        "trajectories_consumed": consumed,
+        "trajectories_dropped": dropped,
+        "duplicates_rejected": dups,
+        "trajectories_unaccounted": lost,
+    })
+    if slo.zero_trajectory_loss:
+        if dups != 0:
+            v.violate("duplicates_rejected", dups, 0)
+        if lost != 0:
+            v.violate("trajectories_unaccounted", lost, 0)
+    return v
+
+
+def evaluate_ingest(slo: IngestSLO,
+                    batch_events: Optional[Sequence[Tuple[float, int]]],
+                    *,
+                    chaos_events_at: Sequence[float] = (),
+                    phase: str = "") -> Verdict:
+    """Evaluate the data plane from its batch completion timeline.
+
+    ``batch_events``: ``[(wall_ts, rows), ...]`` — one entry per batch
+    the consumer finished.  ``chaos_events_at``: wall times of injected
+    faults; after each, the sliding-window throughput must re-cross the
+    floor within ``recovery_s`` (recovery, not just a good average)."""
+    if not batch_events:
+        return _degraded("ingest", slo.name, phase,
+                         "no ingest batches (data ledger missing)")
+    v = Verdict(plane="ingest", name=slo.name, status=PASS, phase=phase)
+    events = sorted(batch_events)
+    t0, t1 = events[0][0], events[-1][0]
+    total_rows = sum(r for _t, r in events)
+    wall = max(t1 - t0, 1e-9)
+    rows_per_s = total_rows / wall
+    v.metrics.update({
+        "batches": len(events),
+        "rows": total_rows,
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(rows_per_s, 2),
+    })
+    if slo.min_rows_per_s is not None and rows_per_s < slo.min_rows_per_s:
+        v.violate("rows_per_s", round(rows_per_s, 2), slo.min_rows_per_s)
+    if slo.recovery_s is not None and slo.min_rows_per_s is not None \
+            and chaos_events_at:
+        recoveries = []
+        for et in chaos_events_at:
+            rec = _recovery_after(events, et, slo.min_rows_per_s,
+                                  slo.probe_window_s)
+            recoveries.append(None if rec is None else round(rec, 3))
+            if rec is None:
+                v.violate(f"recovery_after_t{round(et - t0, 1)}",
+                          "never", slo.recovery_s)
+            elif rec > slo.recovery_s:
+                v.violate(f"recovery_after_t{round(et - t0, 1)}",
+                          round(rec, 3), slo.recovery_s)
+        v.metrics["recovery_s_per_event"] = recoveries
+    return v
+
+
+def _recovery_after(events: Sequence[Tuple[float, int]], event_t: float,
+                    floor_rows_per_s: float,
+                    window_s: float) -> Optional[float]:
+    """Seconds after ``event_t`` until the trailing-``window_s``
+    throughput first reaches the floor again; None if it never does
+    within the recorded timeline.  An event that precedes the first
+    recorded batch clocks from that first batch instead — the plane
+    wasn't flowing yet, so charging its ramp-up as "recovery" would
+    blame the fault for startup."""
+    base = max(event_t, events[0][0]) if events else event_t
+    for i, (t, _rows) in enumerate(events):
+        if t < base:
+            continue
+        w0 = t - window_s
+        rows = sum(r for (bt, r) in events[:i + 1] if bt > w0)
+        if rows / window_s >= floor_rows_per_s:
+            return t - base
+    return None
+
+
+# ---------------------------------------------------------------------------
+# suite helper
+# ---------------------------------------------------------------------------
+
+
+def summarize(verdicts: Sequence[Verdict]) -> Dict[str, Any]:
+    """Roll a set of per-plane verdicts into one pass/fail summary the
+    bench record embeds.  ``ok`` requires every plane to PASS —
+    DEGRADED (no evaluable evidence) is not compliance, per the module
+    contract."""
+    return {
+        "ok": all(v.status == PASS for v in verdicts),
+        "planes": {f"{v.plane}/{v.phase}" if v.phase else v.plane:
+                   v.status for v in verdicts},
+        "violations": [
+            {"plane": v.plane, "phase": v.phase, **viol}
+            for v in verdicts for viol in v.violations],
+    }
+
+
+# ---------------------------------------------------------------------------
+# verdict records: publish / list / aggregate
+# ---------------------------------------------------------------------------
+
+
+def publish_verdict(verdict: Verdict) -> bool:
+    """Write one verdict record into the GCS KV (namespace ``"slo"``) so
+    the state API / CLI / dashboard can list it.  Best-effort: SLO
+    surfacing must never fail the workload that produced the verdict."""
+    try:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            return False
+        from ray_tpu.experimental import internal_kv
+
+        key = f"{_KV_PREFIX}{verdict.plane}/{verdict.name}"
+        if verdict.phase:
+            key += f"/{verdict.phase}"
+        internal_kv._internal_kv_put(
+            key.encode(), json.dumps(verdict.to_dict()).encode(),
+            namespace=_KV_NAMESPACE)
+        return True
+    except Exception:  # noqa: BLE001 — visibility stays best-effort
+        return False
+
+
+def aggregate_verdict_records(records: List[Dict[str, Any]],
+                              *, now: Optional[float] = None
+                              ) -> List[Dict[str, Any]]:
+    """Order raw verdict records for display and sweep stale ones (older
+    than :data:`STALE_S`): a crucible that died mid-run must not pin its
+    last verdict in every status listing forever.  The same
+    aggregate-records pattern the collective/serve panels use."""
+    now = time.time() if now is None else now
+    out = []
+    for rec in records:
+        ts = rec.get("ts")
+        if ts is not None and now - ts > STALE_S:
+            continue
+        out.append(rec)
+    out.sort(key=lambda r: (r.get("plane", ""), r.get("name", ""),
+                            r.get("phase", "")))
+    return out

@@ -96,6 +96,32 @@ def list_serve_deployments() -> List[Dict[str, Any]]:
     return out
 
 
+def list_slo_verdicts() -> List[Dict[str, Any]]:
+    """Cluster-wide per-plane SLO verdicts from the records workloads
+    publish through :func:`ray_tpu.util.slo.publish_verdict` (GCS KV,
+    namespace "slo"): plane, phase, PASS/FAIL/DEGRADED status, measured
+    metrics, and the named violations when a threshold was broken.
+    Stale records (publisher silent past the observability window) are
+    swept from the listing."""
+    import json as _json
+
+    from ray_tpu.util.slo import aggregate_verdict_records
+
+    try:
+        from ray_tpu.experimental.internal_kv import _internal_kv_get_prefix
+
+        table = _internal_kv_get_prefix("verdict/", namespace="slo")
+    except Exception:  # noqa: BLE001 — no cluster
+        return []
+    records = []
+    for raw in (table or {}).values():
+        try:
+            records.append(_json.loads(raw))
+        except Exception:  # noqa: BLE001 — record mid-write
+            continue
+    return aggregate_verdict_records(records)
+
+
 def list_actors() -> List[Dict[str, Any]]:
     w = _worker()
     out = w.run_coro(w.gcs.call("list_actors"))

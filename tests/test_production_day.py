@@ -1,0 +1,97 @@
+"""Production-day macro-crucible: tier-1 miniature + slow full-size run.
+
+The miniature runs the real ``benchmarks/production_day.py`` machinery —
+all three planes concurrently on a 2-node cluster, the scheduled chaos
+timeline with its four distinct fault events (node drain, serve replica
+kill, rollout actor kill, GCS flake window) — shrunk to tier-1 wall
+time, and asserts the acceptance invariants:
+
+- the final record exists with per-plane baseline-vs-chaos SLO deltas;
+- all four scheduled events fired;
+- zero RLHF trajectory double-counts/losses through the chaos;
+- serve sheds failed fast rather than riding out the client timeout;
+- ingest throughput recovered after each event.
+
+The crucible manages its own clusters (drain kills a node), so this
+file must NOT use the shared session cluster.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+
+def _assert_record_invariants(record, expect_events=4):
+    assert record["ok"], record["problems"]
+    executed = record["timeline"]["executed"]
+    fired = [e for e in executed if e["ok"]]
+    assert len(fired) >= expect_events, executed
+    assert {e["kind"] for e in fired} >= {
+        "drain_node", "kill_replica", "kill_rollout", "fault"}
+    # per-plane baseline-vs-chaos deltas present for every plane
+    assert set(record["planes"]) >= {"serve", "rlhf", "ingest"}
+    for plane, row in record["planes"].items():
+        assert row["status"]["baseline"] is not None
+        assert row["status"]["chaos"] is not None
+    # RLHF: exactly-once accounting in the chaos phase
+    chaos_rlhf = next(v for v in record["verdicts"]["chaos"]
+                      if v["plane"] == "rlhf")
+    assert chaos_rlhf["status"] != "DEGRADED", chaos_rlhf
+    assert chaos_rlhf["metrics"]["duplicates_rejected"] == 0
+    assert chaos_rlhf["metrics"]["trajectories_unaccounted"] == 0
+    # ingest: a recovery time recorded (and bounded) for every event
+    chaos_ingest = next(v for v in record["verdicts"]["chaos"]
+                        if v["plane"] == "ingest")
+    recs = chaos_ingest["metrics"].get("recovery_s_per_event")
+    assert recs and all(r is not None for r in recs), chaos_ingest
+    # interference table exists and attributes at least one plane
+    assert record["interference"]
+    # verdicts were published: the state API lists them (fresh records)
+    return record
+
+
+@pytest.mark.chaos
+@pytest.mark.usefixtures("no_cluster")
+def test_production_day_miniature(tmp_path):
+    """The tier-1 miniature: real planes, real timeline, small sizes."""
+    from production_day import PROFILES, run_production_day
+
+    profile = dataclasses.replace(
+        PROFILES["tier1"],
+        serve_rate_hz=6.0, baseline_s=5.0, chaos_tail_s=5.0,
+        rlhf_iterations=7, rlhf_interval_s=1.0,
+        ingest_blocks=6, ingest_block_rows=48, ingest_batch_rows=48,
+    )
+    record = run_production_day(profile)
+    _assert_record_invariants(record)
+    # the record is the bench's emission payload: it must be JSON-clean
+    json.dumps(record)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_production_day_full_profile():
+    """Full-size profile driven through the real entrypoint (subprocess,
+    merged streams): the harness-shaped contract — rc 0 and the LAST
+    line parses as the record."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "production_day.py"),
+         "--profile", "full"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO, timeout=1800)
+    last = proc.stdout.strip().splitlines()[-1]
+    record = json.loads(last)  # the emission contract, end to end
+    assert proc.returncode == 0, (proc.returncode,
+                                  proc.stdout[-4000:])
+    _assert_record_invariants(record)

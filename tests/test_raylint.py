@@ -447,6 +447,71 @@ def _kill_worker(pid):
     assert not r.findings, r.findings
 
 
+def test_bench_emission_fixtures(tmp_path):
+    bad = """import json
+
+
+def main():
+    print(json.dumps({"metric": "m", "value": 1}))
+
+
+if __name__ == "__main__":
+    main()
+"""
+    # two findings: the hand-printed bare-JSON record AND the missing
+    # final-record emission
+    r = lint_tree(tmp_path, {"benchmarks/bad_bench.py": bad},
+                  rules=["bench-emission"])
+    assert rules_of(r) == ["bench-emission"] * 2, r.findings
+
+    good = """import json
+
+from ray_tpu._private.bench_emit import emit_final_record, emit_record_line
+
+
+def main():
+    emit_record_line({"config": "intermediate"})
+    print("MULTICHIP_TIMINGS " + json.dumps({"x": 1}))  # prefixed: legal
+    emit_final_record({"metric": "m", "value": 1})
+
+
+if __name__ == "__main__":
+    main()
+"""
+    r = lint_tree(tmp_path, {"benchmarks/bad_bench.py": good},
+                  rules=["bench-emission"])
+    assert not r.findings, r.findings
+
+    # running the body under final_record_guard satisfies the contract
+    guarded = """from ray_tpu._private.bench_emit import final_record_guard
+
+
+def main():
+    with final_record_guard("m") as out:
+        out["record"] = {"metric": "m", "value": 1}
+
+
+if __name__ == "__main__":
+    main()
+"""
+    r = lint_tree(tmp_path, {"benchmarks/bad_bench.py": guarded},
+                  rules=["bench-emission"])
+    assert not r.findings, r.findings
+
+    # importable helper modules (no __main__ guard) are exempt, and so
+    # are bare-JSON prints outside the benchmark file set
+    helper = """import json
+
+
+def report(rec):
+    print(json.dumps(rec))
+"""
+    r = lint_tree(tmp_path, {"benchmarks/bad_bench.py": helper,
+                             "ray_tpu/mod.py": bad},
+                  rules=["bench-emission"])
+    assert not r.findings, r.findings
+
+
 # -- migrated project-checker fixtures --------------------------------------
 
 _FI_DOC = '''"""Fault injection registry.
@@ -721,7 +786,7 @@ def test_expected_rule_set(live_result):
         "thread-lifecycle", "bounded-blocking", "async-purity",
         "lock-discipline", "context-capture", "fault-site-coverage",
         "proxy-request-context", "collective-supervision",
-        "serial-blocking-get", "test-hygiene"}
+        "serial-blocking-get", "test-hygiene", "bench-emission"}
 
 
 @pytest.mark.parametrize("rule", sorted(

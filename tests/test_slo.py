@@ -1,0 +1,405 @@
+"""SLO evaluator + chaos-timeline units (production-day satellite).
+
+Pure-function tier: verdict math on synthetic ledgers (open-loop p99,
+shed-rate windows, sheds-fail-fast, trajectory accounting, throughput
+floors and post-event recovery, missing-ledger degradation), windowed
+fault arming, and the chaos timeline's determinism contract (same
+``(spec, seed)`` ⇒ identical plan and victim choices).
+"""
+
+import math
+import threading
+import time
+
+import pytest
+
+from ray_tpu.util import fault_injection as fi
+from ray_tpu.util import slo
+from ray_tpu.util.chaos import ChaosTimeline
+
+
+# ---------------------------------------------------------------------------
+# quantile
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_nearest_rank_is_conservative():
+    vals = [float(i) for i in range(1, 101)]  # 1..100
+    assert slo.quantile(vals, 0.50) == 50.0
+    assert slo.quantile(vals, 0.99) == 99.0
+    assert slo.quantile(vals, 1.0) == 100.0
+    assert slo.quantile([7.0], 0.99) == 7.0
+    assert math.isnan(slo.quantile([], 0.99))
+
+
+# ---------------------------------------------------------------------------
+# serve plane
+# ---------------------------------------------------------------------------
+
+
+def _samples(ok_lat, shed_lat=(), error_lat=()):
+    out = []
+    t = 1000.0
+    for v in ok_lat:
+        out.append({"t": t, "latency_s": v, "outcome": "ok"})
+        t += 0.01
+    for v in shed_lat:
+        out.append({"t": t, "latency_s": v, "outcome": "shed"})
+        t += 0.01
+    for v in error_lat:
+        out.append({"t": t, "latency_s": v, "outcome": "error"})
+        t += 0.01
+    return out
+
+
+def test_serve_p99_under_open_loop_arrivals():
+    # 99 fast + 1 slow: nearest-rank p99 is the 99th-worst value —
+    # the slow one must NOT hide behind interpolation
+    spec = slo.ServeSLO(p99_latency_s=0.5, max_shed_rate=None,
+                        shed_fail_fast_s=None)
+    v = slo.evaluate_serve(spec, _samples([0.01] * 99 + [3.0]))
+    assert v.status == slo.PASS  # p99 = 99th of 100 = 0.01... rank 99
+    assert v.metrics["p99_latency_s"] == 0.01
+    # with 2% slow, p99 lands on a slow sample and violates
+    v = slo.evaluate_serve(spec, _samples([0.01] * 97 + [3.0] * 3))
+    assert v.status == slo.FAIL
+    assert v.violations[0]["metric"] == "p99_latency_s"
+    assert v.violations[0]["value"] == 3.0
+
+
+def test_serve_shed_rate_window():
+    spec = slo.ServeSLO(p99_latency_s=None, max_shed_rate=0.10,
+                        shed_fail_fast_s=None)
+    v = slo.evaluate_serve(spec, _samples([0.01] * 95, [0.001] * 5))
+    assert v.status == slo.PASS
+    assert v.metrics["shed_rate"] == 0.05
+    v = slo.evaluate_serve(spec, _samples([0.01] * 80, [0.001] * 20))
+    assert v.status == slo.FAIL
+    assert v.violations[0]["metric"] == "shed_rate"
+    # errors count against the rate too (a failed request is not served)
+    v = slo.evaluate_serve(spec, _samples([0.01] * 80, (), [0.2] * 20))
+    assert v.status == slo.FAIL
+
+
+def test_serve_sheds_must_fail_fast():
+    # a shed that took as long as the client timeout is the overload
+    # layer lying about failing fast — flagged even when rate is fine
+    spec = slo.ServeSLO(p99_latency_s=None, max_shed_rate=0.5,
+                        shed_fail_fast_s=0.5)
+    v = slo.evaluate_serve(spec, _samples([0.01] * 9, [5.0]))
+    assert v.status == slo.FAIL
+    assert v.violations[0]["metric"] == "p99_shed_latency_s"
+    v = slo.evaluate_serve(spec, _samples([0.01] * 9, [0.002]))
+    assert v.status == slo.PASS
+
+
+def test_shed_fail_fast_clocks_from_dispatch_when_available():
+    # shed 4.5s after the INTENDED arrival but 5ms after dispatch: the
+    # rejection itself was immediate — the 4.5s is client-pool backlog,
+    # already charged to the open-loop latency metric, not a slow shed
+    spec = slo.ServeSLO(p99_latency_s=None, max_shed_rate=None,
+                        shed_fail_fast_s=0.5)
+    sample = {"t": 1000.0, "latency_s": 4.5, "dispatch_latency_s": 0.005,
+              "outcome": "shed"}
+    v = slo.evaluate_serve(spec, [sample])
+    assert v.status == slo.PASS, v.violations
+    # but a rejection that itself took seconds still fails
+    sample = {"t": 1000.0, "latency_s": 4.5, "dispatch_latency_s": 4.4,
+              "outcome": "shed"}
+    v = slo.evaluate_serve(spec, [sample])
+    assert v.status == slo.FAIL
+
+
+def test_serve_missing_ledger_degrades():
+    spec = slo.ServeSLO()
+    for empty in (None, []):
+        v = slo.evaluate_serve(spec, empty)
+        assert v.status == slo.DEGRADED
+        assert not v.ok
+        assert "missing" in v.degraded_reason
+    # all-shed traffic: p99 over OK samples is unevaluable -> violation,
+    # not a silent pass
+    v = slo.evaluate_serve(
+        slo.ServeSLO(p99_latency_s=1.0, max_shed_rate=None,
+                     shed_fail_fast_s=None),
+        _samples([], [0.001] * 5))
+    assert v.status == slo.FAIL
+
+
+# ---------------------------------------------------------------------------
+# RLHF plane
+# ---------------------------------------------------------------------------
+
+
+def test_rlhf_step_time_and_accounting():
+    spec = slo.RLHFSLO(p99_step_time_s=1.0)
+    # 2 sample attempts failed (dropped WITH accounting), every produced
+    # batch consumed: clean
+    ledger = {"produced": 8, "consumed": 8, "dropped": 2,
+              "duplicates_rejected": 0}
+    v = slo.evaluate_rlhf(spec, [0.5] * 10, ledger)
+    assert v.status == slo.PASS
+    assert v.metrics["trajectories_unaccounted"] == 0
+    # a slow step violates the ceiling
+    v = slo.evaluate_rlhf(spec, [0.5] * 8 + [4.0] * 2, ledger)
+    assert v.status == slo.FAIL
+    assert v.violations[0]["metric"] == "p99_step_s"
+
+
+def test_rlhf_zero_trajectory_loss_gate():
+    spec = slo.RLHFSLO(p99_step_time_s=None)
+    # double-count
+    v = slo.evaluate_rlhf(spec, [0.1], {"produced": 4, "consumed": 4,
+                                        "dropped": 0,
+                                        "duplicates_rejected": 1})
+    assert v.status == slo.FAIL
+    assert any(x["metric"] == "duplicates_rejected" for x in v.violations)
+    # silent loss: a produced batch vanished without being consumed
+    v = slo.evaluate_rlhf(spec, [0.1], {"produced": 4, "consumed": 3,
+                                        "dropped": 1,
+                                        "duplicates_rejected": 0})
+    assert v.status == slo.FAIL
+    assert any(x["metric"] == "trajectories_unaccounted"
+               for x in v.violations)
+    # failed sample attempts dropped WITH accounting are legal chaos
+    # behavior (they were never produced)
+    v = slo.evaluate_rlhf(spec, [0.1], {"produced": 2, "consumed": 2,
+                                        "dropped": 2,
+                                        "duplicates_rejected": 0})
+    assert v.status == slo.PASS
+
+
+def test_rlhf_missing_ledgers_degrade():
+    spec = slo.RLHFSLO()
+    v = slo.evaluate_rlhf(spec, None, None)
+    assert v.status == slo.DEGRADED
+    # steps but no trajectory ledger: accounting unverifiable
+    v = slo.evaluate_rlhf(spec, [0.1, 0.1], None)
+    assert v.status == slo.DEGRADED
+    assert "unverifiable" in v.degraded_reason
+
+
+# ---------------------------------------------------------------------------
+# ingest plane
+# ---------------------------------------------------------------------------
+
+
+def _steady(t0, rate_hz, rows, n):
+    return [(t0 + i / rate_hz, rows) for i in range(n)]
+
+
+def test_ingest_throughput_floor():
+    spec = slo.IngestSLO(min_rows_per_s=100.0)
+    v = slo.evaluate_ingest(spec, _steady(0.0, 10.0, 64, 50))
+    assert v.status == slo.PASS
+    assert v.metrics["rows_per_s"] > 100.0
+    v = slo.evaluate_ingest(spec, _steady(0.0, 1.0, 64, 50))
+    assert v.status == slo.FAIL
+    assert v.violations[0]["metric"] == "rows_per_s"
+
+
+def test_ingest_recovery_after_event():
+    spec = slo.IngestSLO(min_rows_per_s=500.0, recovery_s=3.0,
+                         probe_window_s=1.0)
+    # steady 640 rows/s, a 2s gap after the event at t=5, then recovery
+    events = _steady(0.0, 10.0, 64, 50)            # t in [0, 5)
+    events += _steady(7.0, 10.0, 64, 30)           # resumes at t=7
+    v = slo.evaluate_ingest(spec, events, chaos_events_at=[5.0])
+    assert v.status == slo.PASS, v.violations
+    rec = v.metrics["recovery_s_per_event"][0]
+    assert 2.0 <= rec <= 3.0
+    # a 5s outage blows the 3s recovery bound
+    events = _steady(0.0, 10.0, 64, 50) + _steady(10.0, 10.0, 64, 30)
+    v = slo.evaluate_ingest(spec, events, chaos_events_at=[5.0])
+    assert v.status == slo.FAIL
+    assert any(x["metric"].startswith("recovery_after")
+               for x in v.violations)
+    # never recovering at all is also a violation, not an index error
+    v = slo.evaluate_ingest(spec, _steady(0.0, 10.0, 64, 50),
+                            chaos_events_at=[5.0])
+    assert v.status == slo.FAIL
+    assert any(x["value"] == "never" for x in v.violations)
+
+
+def test_ingest_missing_ledger_degrades():
+    v = slo.evaluate_ingest(slo.IngestSLO(min_rows_per_s=1.0), [])
+    assert v.status == slo.DEGRADED
+
+
+# ---------------------------------------------------------------------------
+# verdict plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_and_stale_sweep():
+    good = slo.Verdict(plane="serve", name="a", status=slo.PASS,
+                       phase="baseline")
+    bad = slo.Verdict(plane="ingest", name="a", status=slo.PASS,
+                      phase="chaos")
+    bad.violate("rows_per_s", 1.0, 2.0)
+    s = slo.summarize([good, bad])
+    assert s["ok"] is False
+    assert s["planes"]["serve/baseline"] == slo.PASS
+    assert s["violations"][0]["plane"] == "ingest"
+
+    now = time.time()
+    records = [
+        {"plane": "serve", "name": "x", "ts": now - 10},
+        {"plane": "rlhf", "name": "x", "ts": now - slo.STALE_S - 5},
+    ]
+    out = slo.aggregate_verdict_records(records, now=now)
+    assert [r["plane"] for r in out] == ["serve"]
+
+
+# ---------------------------------------------------------------------------
+# windowed fault arming
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedArming:
+    def teardown_method(self):
+        fi.disarm()
+
+    def test_window_opens_and_expires(self):
+        fi.arm_window("slo.test.site", 0.05, 0.15, exc="runtime")
+        fi.fault_point("slo.test.site")  # before window: invisible
+        assert fi.call_count("slo.test.site") == 0
+        time.sleep(0.08)
+        with pytest.raises(RuntimeError):
+            fi.fault_point("slo.test.site")
+        time.sleep(0.15)
+        fi.fault_point("slo.test.site")  # after window: invisible again
+        assert fi.fired_count("slo.test.site") == 1
+
+    def test_window_relative_nth(self):
+        # nth=2 counts calls INSIDE the window, not process-lifetime
+        fi.arm_window("slo.test.site", 0.0, 5.0, nth=2, count=1,
+                      exc="runtime")
+        fi.fault_point("slo.test.site")         # in-window call #1: ok
+        with pytest.raises(RuntimeError):
+            fi.fault_point("slo.test.site")     # call #2 fires
+        fi.fault_point("slo.test.site")         # call #3: spent
+        assert fi.fired_count("slo.test.site") == 1
+
+    def test_env_grammar_window_suffix(self):
+        spec, start, dur = fi._parse_window(
+            "gcs_store.call:1:9999:connection@10+5")
+        assert spec == "gcs_store.call:1:9999:connection"
+        assert (start, dur) == (10.0, 5.0)
+        with pytest.raises(ValueError):
+            fi._parse_window("site:1:1:runtime@10")  # no +duration
+        # no suffix: passthrough
+        assert fi._parse_window("site:1") == ("site:1", None, None)
+
+    def test_duration_must_be_positive(self):
+        with pytest.raises(ValueError):
+            fi.arm_window("slo.test.site", 0.0, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# chaos timeline determinism
+# ---------------------------------------------------------------------------
+
+
+_SPEC = [
+    {"at": 0.30, "kind": "fault", "site": "gcs_store.call",
+     "duration": 1.0},
+    {"at": 0.10, "kind": "pick"},
+    {"at": 0.10, "kind": "pick"},
+    {"at": 0.20, "kind": "pick"},
+]
+
+
+def _run_timeline(seed):
+    picks = []
+
+    def act_pick(ev, rng):
+        victim = sorted(["a", "b", "c", "d"])[rng.randrange(4)]
+        picks.append(victim)
+        return victim
+
+    tl = ChaosTimeline(_SPEC, seed=seed, actions={"pick": act_pick})
+    tl.start()
+    tl.join(timeout=10.0)
+    return tl.plan(), tl.executed(), picks
+
+
+class TestChaosTimelineDeterminism:
+    def teardown_method(self):
+        fi.disarm()
+
+    def test_same_seed_same_plan_fires_and_victims(self):
+        plan1, ex1, picks1 = _run_timeline(seed=7)
+        plan2, ex2, picks2 = _run_timeline(seed=7)
+        assert plan1 == plan2
+        # scheduled offsets, order, and kinds identical
+        assert [(e["at"], e["kind"], e["seq"]) for e in ex1] == \
+            [(e["at"], e["kind"], e["seq"]) for e in ex2]
+        assert all(e["ok"] for e in ex1)
+        # same seed -> same victims, in the same order
+        assert picks1 == picks2
+        # equal offsets break ties by spec order (seq), deterministically
+        assert [e["seq"] for e in ex1] == [1, 2, 3, 0]
+
+    def test_different_seed_may_differ_but_plan_is_stable(self):
+        plan1, _, _ = _run_timeline(seed=1)
+        plan2, _, _ = _run_timeline(seed=2)
+        assert plan1 == plan2  # the schedule never depends on the seed
+
+    def test_fault_event_arms_a_window(self):
+        tl = ChaosTimeline(
+            [{"at": 0.0, "kind": "fault", "site": "slo.tl.site",
+              "duration": 0.5, "fault": "runtime"}])
+        tl.start()
+        tl.join(timeout=5.0)
+        time.sleep(0.05)
+        with pytest.raises(RuntimeError):
+            fi.fault_point("slo.tl.site")
+        time.sleep(0.6)
+        fi.fault_point("slo.tl.site")  # window expired: disarmed
+
+    def test_unknown_kind_rejected_at_construction(self):
+        with pytest.raises(ValueError, match="no registered action"):
+            ChaosTimeline([{"at": 0.0, "kind": "nope"}])
+        with pytest.raises(ValueError, match="needs 'site'"):
+            ChaosTimeline([{"at": 0.0, "kind": "fault"}])
+        with pytest.raises(ValueError, match="negative"):
+            ChaosTimeline([{"at": -1.0, "kind": "fault", "site": "s"}])
+
+    def test_stop_abandons_unfired_events(self):
+        fired = []
+        tl = ChaosTimeline(
+            [{"at": 0.05, "kind": "pick"}, {"at": 30.0, "kind": "pick"}],
+            actions={"pick": lambda ev, rng: fired.append(ev["at"])})
+        tl.start()
+        time.sleep(0.3)
+        tl.stop()
+        assert fired == [0.05]
+        assert len(tl.executed()) == 1
+
+    def test_action_error_is_logged_not_fatal(self):
+        def boom(ev, rng):
+            raise RuntimeError("victim pool empty")
+
+        ok = []
+        tl = ChaosTimeline(
+            [{"at": 0.0, "kind": "boom"},
+             {"at": 0.05, "kind": "ok"}],
+            actions={"boom": boom, "ok": lambda ev, rng: ok.append(1)})
+        tl.start()
+        tl.join(timeout=5.0)
+        ex = tl.executed()
+        assert ex[0]["ok"] is False and "victim pool" in ex[0]["error"]
+        assert ex[1]["ok"] is True and ok == [1]
+
+    def test_scenario_file_roundtrip(self, tmp_path):
+        import json
+
+        path = tmp_path / "scenario.json"
+        path.write_text(json.dumps(
+            {"seed": 3, "events": [{"at": 1.0, "kind": "fault",
+                                    "site": "x", "duration": 2.0}]}))
+        tl = ChaosTimeline.from_file(str(path))
+        assert tl._seed == 3
+        assert tl.plan()[0]["site"] == "x"
+        assert tl.duration_s == 3.0  # fault window extends the horizon
