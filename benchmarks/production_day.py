@@ -82,6 +82,12 @@ class Profile:
     serve_replicas: int = 2
     serve_work_ms: float = 8.0
     serve_mode: str = "proxy"        # "proxy" (numpy decode) | "engine"
+    # disaggregated serve plane: real LLM prefill/decode pools behind the
+    # two-stage ingress instead of the monolithic PdLLM deployment — the
+    # existing chaos timeline (drain / kill_replica / GCS flake) then
+    # exercises KV handoffs + re-prefill fallback with no new scenario
+    # code (`--disaggregated`)
+    serve_disaggregated: bool = False
     max_ongoing: int = 4
     max_queued: int = 16
     # RLHF plane
@@ -201,6 +207,39 @@ def _build_app(profile: Profile):
                       profile.seed)
 
 
+def _build_disagg_app(profile: Profile):
+    """Disaggregated serve plane: tiny-engine prefill + decode pools
+    behind the two-stage ingress.  Replica placement mirrors the PdLLM
+    deployment (``pd_replica`` steers one decode replica onto the
+    drainable worker node so the drain event migrates real serving
+    capacity)."""
+    from ray_tpu.llm.serving import (LLMDecodeServer, LLMDisaggIngress,
+                                     LLMPrefillServer)
+
+    ek = {"model": "tiny", "batch_slots": 4, "max_len": 96}
+    prefill = LLMPrefillServer.options(
+        num_replicas=1, max_ongoing_requests=profile.max_ongoing,
+        max_queued_requests=profile.max_queued,
+        ray_actor_options={"resources": {"pd_replica": 1}}).bind(ek)
+    decode = LLMDecodeServer.options(
+        num_replicas=profile.serve_replicas,
+        max_ongoing_requests=profile.max_ongoing,
+        max_queued_requests=profile.max_queued,
+        ray_actor_options={"resources": {"pd_replica": 1}}).bind(ek)
+    return LLMDisaggIngress.options(
+        max_ongoing_requests=profile.max_ongoing * 2,
+        max_queued_requests=profile.max_queued).bind(prefill, decode)
+
+
+def _serve_body(profile: Profile, prompt: List[int]):
+    """The per-request payload: raw token list for PdLLM, an LLM body
+    for the disaggregated ingress."""
+    if profile.serve_disaggregated:
+        return {"prompt": [max(3, t % 256) for t in prompt],
+                "max_tokens": 8, "temperature": 0.0}
+    return prompt
+
+
 def _open_loop_client(handle, profile: Profile, duration_s: float,
                       samples: List[Dict[str, Any]],
                       stop: threading.Event) -> None:
@@ -227,7 +266,7 @@ def _open_loop_client(handle, profile: Profile, duration_s: float,
         t_dispatch = time.time()
         try:
             with serve.request_scope(timeout_s=profile.serve_timeout_s):
-                handle.remote(prompt).result(
+                handle.remote(_serve_body(profile, prompt)).result(
                     timeout=profile.serve_timeout_s)
         except BackPressureError:
             outcome = "shed"
@@ -485,12 +524,20 @@ def _run_phase(profile: Profile, phase: str,
         cluster.wait_for_nodes()
         head_id = next(n["node_id"] for n in ray_tpu.nodes()
                        if n["node_id"] != worker.node_id)
-        handle = serve.run(_build_app(profile))
-        # one warm request per replica: jit/actor cold start must not
-        # masquerade as baseline latency
-        for _ in range(profile.serve_replicas):
+        handle = serve.run(_build_disagg_app(profile)
+                           if profile.serve_disaggregated
+                           else _build_app(profile))
+        # warm requests: jit/actor cold start must not masquerade as
+        # baseline latency.  The disaggregated topology needs several
+        # per decode replica — the two-stage reservation picks the
+        # least-loaded decode replica per request, so serial warm
+        # requests reach every engine's compile with high probability
+        warms = profile.serve_replicas * (
+            3 if profile.serve_disaggregated else 1)
+        for _ in range(warms):
             try:
-                handle.remote(list(range(16))).result(timeout=120)
+                handle.remote(_serve_body(profile, list(range(16)))
+                              ).result(timeout=120)
             except Exception:  # noqa: BLE001 — measured run will tell
                 break
 
@@ -561,8 +608,10 @@ def _run_phase(profile: Profile, phase: str,
         try:
             from ray_tpu.util.state import list_serve_deployments
 
+            ingress = "LLMIngress" if profile.serve_disaggregated \
+                else "pd-llm"
             for d in list_serve_deployments():
-                if d.get("name") == "pd-llm":
+                if d.get("name") == ingress:
                     overload = d.get("overload") or {}
         except Exception:  # noqa: BLE001 — status is best-effort
             pass
@@ -775,8 +824,18 @@ def main() -> int:
     ap.add_argument("--scenario", default=None,
                     help="JSON scenario file overriding the built-in "
                          "timeline (docs/fault_tolerance.md)")
+    ap.add_argument("--disaggregated", action="store_true",
+                    help="serve plane runs the disaggregated "
+                         "prefill/decode topology (KV handoffs over the "
+                         "channel plane) under the same chaos timeline")
     args = ap.parse_args()
     profile = PROFILES[args.profile]
+    if args.disaggregated:
+        # real engine replicas: give the open-loop client headroom over
+        # the proxy-calibrated timeout (decode batches + two-stage hops)
+        profile = dataclasses.replace(
+            profile, serve_disaggregated=True,
+            serve_timeout_s=max(profile.serve_timeout_s, 10.0))
     scenario = None
     if args.scenario:
         with open(args.scenario) as f:

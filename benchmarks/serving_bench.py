@@ -4,7 +4,7 @@ BASELINE.md row: "Serve + Compiled Graph Llama-2-7B TP inference —
 tokens/s" (the reference's number comes from vLLM under ray Serve;
 ``/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/``).
 
-Two modes:
+Modes:
 
 * ``--mode engine`` (default): the paged-KV engine in-process on the real
   chip — Llama-2-7B shapes, bf16 params, continuous batching.  Reports
@@ -13,10 +13,20 @@ Two modes:
   (``llm/serving.py``), driven over HTTP with concurrent clients — the
   full serve-path number.  The driver process never imports jax, so the
   replica worker owns the TPU.
+* ``--mode openloop``: the disaggregation gate.  Seeded-Poisson
+  open-loop traffic (latencies measured from the INTENDED arrival — the
+  PR 11 coordinated-omission-aware clock, ``ray_tpu.util.slo``) under a
+  long-prompt + many-streams mix, A/B'd across topologies: a colocated
+  single replica vs a disaggregated 1-prefill + 1-decode pair shipping
+  KV blocks over the tiered channel plane.  Emits
+  ``llm_serve_tokens_per_s`` + ``llm_serve_p99_ms`` and gates the record
+  on: disaggregated p99 < colocated p99 AND disaggregated tokens/s
+  within 10% of colocated.
 
-Usage:  python benchmarks/serving_bench.py [--mode engine|serve]
+Usage:  python benchmarks/serving_bench.py [--mode engine|serve|openloop]
         [--model llama2_7b|llama3_8b|tiny] [--slots 8] [--max-len 256]
         [--prompt-len 64] [--max-tokens 64] [--requests 32]
+        [--rate 6.0] [--duration 20] [--long-every 8]
 """
 
 from __future__ import annotations
@@ -306,10 +316,194 @@ def serve_breakdown(args) -> dict:
         ray_tpu.shutdown()
 
 
+def _openloop_workload(args, seed: int = 7):
+    """Fixed seeded workload shared by both topologies: Poisson intended
+    arrivals at ``--rate`` for ``--duration`` seconds; every
+    ``--long-every``-th request carries a LONG prompt (the head-of-line
+    antagonist), the rest are short streaming requests."""
+    import random
+
+    rng = random.Random(seed)
+    arrivals = []
+    t = 0.0
+    while t < args.duration:
+        t += rng.expovariate(args.rate)
+        if t < args.duration:
+            arrivals.append(t)
+    long_len = max(args.max_len - args.max_tokens - 8, args.prompt_len)
+    reqs = []
+    for i, at in enumerate(arrivals):
+        if args.long_every and i % args.long_every == args.long_every - 1:
+            prompt = [3 + rng.randrange(200) for _ in range(long_len)]
+            body = {"prompt": prompt, "max_tokens": 4, "temperature": 0.0}
+            kind = "long"
+        else:
+            prompt = [3 + rng.randrange(200) for _ in range(16)]
+            # short streams decode a modest budget: the mix must sit
+            # BELOW saturation so the A/B measures head-of-line
+            # interference, not backlog dynamics
+            body = {"prompt": prompt,
+                    "max_tokens": min(args.max_tokens, 16),
+                    "temperature": 0.0}
+            kind = "short"
+        reqs.append((at, kind, body))
+    return reqs
+
+
+def _drive_openloop(call_fn, stream_fn, reqs):
+    """Open-loop client: the arrival schedule is fixed up front; a slow
+    response never delays later arrivals (pool threads), and latency
+    counts from the INTENDED arrival instant (coordinated omission).
+    Short requests stream (the many-streams mix); longs are unary.
+    Per-request timeouts live inside ``call_fn``/``stream_fn``."""
+    import concurrent.futures
+    import threading
+
+    samples = []
+    lock = threading.Lock()
+
+    def one(intended_wall, kind, body):
+        outcome, tokens = "ok", 0
+        try:
+            if kind == "short" and stream_fn is not None:
+                for chunk in stream_fn(body):
+                    if chunk.get("done"):
+                        tokens = chunk["num_generated_tokens"]
+            else:
+                tokens = call_fn(body)["num_generated_tokens"]
+        except Exception:  # noqa: BLE001 — outcome IS the datum
+            outcome = "error"
+        now = time.time()
+        with lock:
+            samples.append({"t": intended_wall, "kind": kind,
+                            "latency_s": now - intended_wall,
+                            "tokens": tokens, "outcome": outcome})
+
+    width = max(32, int(len(reqs) / 2))
+    t0 = time.time()
+    with concurrent.futures.ThreadPoolExecutor(width) as pool:
+        for at, kind, body in reqs:
+            delay = at - (time.time() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            pool.submit(one, t0 + at, kind, body)
+    wall = time.time() - t0
+    return samples, wall
+
+
+def _openloop_summary(samples, wall):
+    from ray_tpu.util.slo import quantile
+
+    ok = [s for s in samples if s["outcome"] == "ok"]
+    lat = [s["latency_s"] for s in ok]
+    short = [s["latency_s"] for s in ok if s["kind"] == "short"]
+    toks = sum(s["tokens"] for s in ok)
+    return {
+        "offered": len(samples), "served": len(ok),
+        "errors": len(samples) - len(ok),
+        "tokens": toks,
+        "tokens_per_s": round(toks / wall, 1),
+        "p50_ms": round(quantile(lat, 0.50) * 1e3, 1) if lat else None,
+        "p99_ms": round(quantile(lat, 0.99) * 1e3, 1) if lat else None,
+        "short_p99_ms": round(quantile(short, 0.99) * 1e3, 1)
+        if short else None,
+        "wall_s": round(wall, 2),
+    }
+
+
+def openloop_bench(args) -> dict:
+    """A/B: colocated single replica vs disaggregated 1-prefill +
+    1-decode under the same seeded open-loop schedule."""
+    os.environ.setdefault("RAY_TPU_ICI_EMULATE", "1")
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.router import DeploymentHandle
+    from ray_tpu.llm.serving import (build_disaggregated_llm_deployment,
+                                     build_llm_deployment,
+                                     disaggregated_handle)
+
+    ray_tpu.init(num_cpus=8, num_tpus=args.num_tpus)
+    engine_kwargs = {"model": args.model, "batch_slots": args.slots,
+                     "max_len": args.max_len,
+                     "kv_cache_dtype": args.kv_dtype or None,
+                     "prefill_chunk": 64}
+    reqs = _openloop_workload(args)
+    warm_long = {"prompt": list(range(3, 3 + args.max_len - 32)),
+                 "max_tokens": 4, "temperature": 0.0}
+    warm_short = {"prompt": list(range(3, 19)), "max_tokens": 8,
+                  "temperature": 0.0}
+    out: dict = {"benchmark": "llm_serving_openloop", "model": args.model,
+                 "rate_hz": args.rate, "duration_s": args.duration,
+                 "long_every": args.long_every,
+                 "requests": len(reqs)}
+    try:
+        # ---- A: colocated single replica --------------------------------
+        serve.run(build_llm_deployment(
+            engine_kwargs,
+            num_tpus_per_replica=args.num_tpus and 1),
+            name="colo", route_prefix="/colo")
+        handle = DeploymentHandle("LLMServer")
+        for body in (warm_short, warm_long):  # compile both bucket sets
+            handle.remote(body).result(timeout=300)
+        list(handle.stream.remote_streaming(warm_short))
+
+        def colo_call(body):
+            return handle.remote(body).result(timeout=args.timeout_s)
+
+        def colo_stream(body):
+            yield from handle.stream.remote_streaming(body)
+
+        samples, wall = _drive_openloop(colo_call, colo_stream, reqs)
+        out["colocated"] = _openloop_summary(samples, wall)
+        serve.delete("LLMServer")
+
+        # ---- B: disaggregated 1 prefill + 1 decode ----------------------
+        serve.run(build_disaggregated_llm_deployment(
+            engine_kwargs, prefill_replicas=1, decode_replicas=1,
+            num_tpus_per_replica=args.num_tpus and 1),
+            name="disagg", route_prefix="/llm")
+        two = disaggregated_handle()
+        for body in (warm_short, warm_long):
+            two.call(body, timeout=300)
+        list(two.stream(warm_short))
+
+        samples, wall = _drive_openloop(
+            lambda b: two.call(b, timeout=args.timeout_s), two.stream,
+            reqs)
+        out["disaggregated"] = _openloop_summary(samples, wall)
+        # shipping-plane evidence: tier + handoff counters from the pools
+        try:
+            pre = DeploymentHandle("LLMPrefill").stats.remote().result(
+                timeout=30)
+            out["shipper"] = pre.get("shipper")
+            out["handoff"] = pre.get("handoff")
+        except Exception:  # noqa: BLE001 — evidence is best-effort
+            pass
+    finally:
+        ray_tpu.shutdown()
+
+    colo, dis = out["colocated"], out["disaggregated"]
+    gates = {
+        "p99_improves": bool(
+            colo["p99_ms"] is not None and dis["p99_ms"] is not None
+            and dis["p99_ms"] < colo["p99_ms"]),
+        "tokens_within_10pct": bool(
+            dis["tokens_per_s"] >= 0.9 * colo["tokens_per_s"]),
+        "all_served": dis["errors"] == 0,
+    }
+    out["gates"] = gates
+    out["ok"] = all(gates.values())
+    # headline metrics (the parsed record fields)
+    out["llm_serve_tokens_per_s"] = dis["tokens_per_s"]
+    out["llm_serve_p99_ms"] = dis["p99_ms"]
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", default="engine",
-                    choices=["engine", "serve", "serve-breakdown"])
+                    choices=["engine", "serve", "serve-breakdown",
+                             "openloop"])
     ap.add_argument("--model", default="llama2_7b")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=256)
@@ -320,9 +514,24 @@ def main():
                     help="int8: half-size KV pool, ~2x slots per chip")
     ap.add_argument("--spec", type=int, default=0,
                     help="prompt-lookup speculative decoding draft length")
+    ap.add_argument("--rate", type=float, default=6.0,
+                    help="openloop: Poisson arrival rate (req/s)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="openloop: offered-traffic window (s)")
+    ap.add_argument("--long-every", type=int, default=8,
+                    help="openloop: every Nth request is a long prompt")
+    ap.add_argument("--timeout-s", type=float, default=120.0,
+                    help="openloop: per-request client timeout")
+    ap.add_argument("--num-tpus", type=int, default=0,
+                    help="openloop: TPU chips to give the cluster "
+                         "(0 = CPU tiny-model proxy)")
     args = ap.parse_args()
+    if args.mode == "openloop" and args.model == "llama2_7b" \
+            and not args.num_tpus:
+        args.model = "tiny"  # CPU A/B runs the tiny proxy by default
     out = {"engine": engine_bench, "serve": serve_bench,
-           "serve-breakdown": serve_breakdown}[args.mode](args)
+           "serve-breakdown": serve_breakdown,
+           "openloop": openloop_bench}[args.mode](args)
     emit_final_record(out)
 
 

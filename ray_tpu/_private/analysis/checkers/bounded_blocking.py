@@ -160,12 +160,15 @@ class BoundedBlockingChecker(Checker):
     # directories where every blocking ``ray_tpu.get`` AND every channel
     # read must carry a deadline: serve/ is the latency-critical control
     # plane, rl/ drives long-lived loops over killable rollout/learner
-    # actors, and experimental/channel/ + dag/ are the compiled-graph
-    # data plane — a dead peer never writes its channel, so a bare read
-    # wedges the exec loop / pipeline stage forever (the hang class PR 8
+    # actors, experimental/channel/ + dag/ are the compiled-graph data
+    # plane, and llm/ ships KV handoffs between killable prefill/decode
+    # replicas (shipper writes, landing reads, handoff waits) — a dead
+    # peer never writes its channel, so a bare read wedges the exec loop
+    # / pipeline stage / landing thread forever (the hang class PR 8
     # fixed by hand)
     _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/",
-                      "ray_tpu/experimental/channel/", "ray_tpu/dag/")
+                      "ray_tpu/experimental/channel/", "ray_tpu/dag/",
+                      "ray_tpu/llm/")
 
     def check(self, pf: ParsedFile) -> Iterable[Finding]:
         out: List[Finding] = []

@@ -11,6 +11,7 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
     collective,
     data,
     entities,
+    llm,
     logs,
     metrics,
     serve,
@@ -20,4 +21,4 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
 )
 
 ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train,
-               collective, data, slo)
+               collective, data, slo, llm)

@@ -1,0 +1,41 @@
+"""LLM module: engine-per-replica serving state.
+
+Every engine-hosting serve replica (colocated / prefill / decode —
+``ray_tpu/llm/serving.py``) publishes its ``LLMEngine.stats()`` snapshot
+into the GCS KV under namespace ``"llm"`` (key
+``engine/<deployment>/<replica>``) on the metrics cadence; the head
+lists them with plain table reads.  These are the same records the
+serve controller's pool autoscaler consumes (queue depth, slot
+occupancy, block-pool pressure) — the panel shows what the autoscaler
+sees.  Records older than ``_STALE_S`` are dropped from the listing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+_STALE_S = 600.0
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_llm(_req):
+        engines = []
+        now = time.time()
+        for (ns, key), raw in list(gcs.kv.items()):
+            if ns != "llm" or not key.startswith("engine/"):
+                continue
+            try:
+                rec = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            if now - rec.get("ts", now) > _STALE_S:
+                continue
+            engines.append(rec)
+        engines.sort(key=lambda r: (r.get("deployment", ""),
+                                    r.get("replica", "")))
+        return jresp({"engines": engines})
+
+    return [("GET", "/api/llm", api_llm)]

@@ -30,7 +30,7 @@ def aggregate_metrics(gcs) -> Dict[str, Any]:
     merged: Dict[str, Any] = {}
     now = time.time()
     for (ns, key), raw in list(gcs.kv.items()):
-        if ns not in ("metrics", "trace"):
+        if ns not in ("metrics", "trace", "llm"):
             continue
         try:
             payload = json.loads(raw)
@@ -40,7 +40,10 @@ def aggregate_metrics(gcs) -> Dict[str, Any]:
             _sweep_stale(gcs, ns, key)
             continue
         if ns != "metrics":
-            continue  # trace records only get the stale sweep here
+            # trace spans and llm engine-stats records only get the
+            # stale sweep here (a dead/scaled-down replica's last
+            # publish must not pin a KV entry forever)
+            continue
         for name, entry in payload.get("metrics", {}).items():
             if name not in merged:
                 merged[name] = {"kind": entry["kind"],

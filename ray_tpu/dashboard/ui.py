@@ -62,12 +62,13 @@ function spark(points, w=220, h=36) {
 
 async function renderOverview(root) {
   const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train, coll,
-         data, slo] =
+         data, slo, llm] =
     await Promise.all([
       j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
       j("/api/placement_groups"), j("/api/submitted_jobs"),
       j("/api/tasks/summary"), j("/api/serve"), j("/api/train"),
-      j("/api/collective"), j("/api/data"), j("/api/slo")]);
+      j("/api/collective"), j("/api/data"), j("/api/slo"),
+      j("/api/llm")]);
   const taskRows = Object.entries(tasks).map(([name, s]) =>
     ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   const depRows = Object.entries(serve.deployments || {}).map(
@@ -114,6 +115,17 @@ async function renderOverview(root) {
     violations: (v.violations || []).map(x =>
       `${x.metric}: ${x.value} > ${x.limit}`).join("; ") ||
       (v.degraded_reason || "")}));
+  const llmRows = (llm.engines || []).map(r => ({
+    deployment: r.deployment, replica: r.replica, role: r.role,
+    slots: `${r.slots_used}/${r.slots_total}`,
+    queued: (r.queued || 0) + (r.adopt_queued || 0),
+    "block press": Number(r.block_pressure || 0).toFixed(2),
+    blocks: `${r.blocks_available}/${r.blocks_total}`,
+    kv: r.kv_cache_dtype,
+    handoff: r.handoff
+      ? `out=${r.handoff.exported} in=${r.handoff.adopted} ` +
+        `fail=${r.handoff.adopt_failures}`
+      : ""}));
   const collRows = (coll.groups || []).map(g => ({
     group: g.group_name, state: g.state, backend: g.backend,
     epoch: g.epoch, members: `${g.joined}/${g.world_size}`,
@@ -142,6 +154,10 @@ async function renderOverview(root) {
       ? table(sloRows, ["plane","name","phase","status","metrics",
                         "violations"])
       : "<i>no SLO verdicts published</i>") +
+    "<h2>LLM engines</h2>" + (llmRows.length
+      ? table(llmRows, ["deployment","replica","role","slots","queued",
+                        "block press","blocks","kv","handoff"])
+      : "<i>no engine replicas reporting</i>") +
     "<h2>Data ingest</h2>" + table(dataRows,
       ["iterator","state","blocks","batches","MB","xnode MB","fetch s",
        "blocked s","h2d s","locality","dev buf"]) +

@@ -7,11 +7,22 @@ rides ``ray_tpu.data`` actor pools and serving rides ``ray_tpu.serve``.
 
 from ray_tpu.llm.batch import LLMPredictor, build_llm_processor
 from ray_tpu.llm.engine import ByteTokenizer, GenerationOutput, LLMEngine
-from ray_tpu.llm.serving import LLMServer, build_llm_deployment
+from ray_tpu.llm.kv_transfer import KVBlockShipper, KVLandingStrip
+from ray_tpu.llm.serving import (
+    LLMDecodeServer,
+    LLMDisaggIngress,
+    LLMPrefillServer,
+    LLMServer,
+    build_disaggregated_llm_deployment,
+    build_llm_deployment,
+    disaggregated_handle,
+)
 from ray_tpu.models.generation import SamplingParams
 
 __all__ = [
-    "ByteTokenizer", "GenerationOutput", "LLMEngine", "LLMPredictor",
-    "LLMServer", "SamplingParams", "build_llm_deployment",
-    "build_llm_processor",
+    "ByteTokenizer", "GenerationOutput", "KVBlockShipper",
+    "KVLandingStrip", "LLMDecodeServer", "LLMDisaggIngress", "LLMEngine",
+    "LLMPredictor", "LLMPrefillServer", "LLMServer", "SamplingParams",
+    "build_disaggregated_llm_deployment", "build_llm_deployment",
+    "build_llm_processor", "disaggregated_handle",
 ]

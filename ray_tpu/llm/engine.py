@@ -94,6 +94,10 @@ class Request:
     # max_tokens budget survive any number of preemptions
     n_prompt: int = -1
     error: Optional[str] = None
+    # disaggregated serving: a prefill-only request retires right after
+    # its first sampled token, holding its blocks for export (the KV
+    # handoff to a decode replica) instead of releasing them
+    prefill_only: bool = False
 
     def __post_init__(self):
         if self.n_prompt < 0:
@@ -125,6 +129,7 @@ class _BlockManager:
 
     def __init__(self, num_blocks: int):
         # block 0 is the jit-side scratch block (padding / masked writes)
+        self.num_blocks = num_blocks
         self.free: collections.deque = collections.deque(
             range(1, num_blocks))
         self.refs: Dict[int, int] = {}
@@ -133,7 +138,8 @@ class _BlockManager:
         self.lru: "collections.OrderedDict[Any, int]" = \
             collections.OrderedDict()
         self.stats = {"prefix_hits": 0, "prefix_blocks_reused": 0,
-                      "evictions": 0, "preemptions": 0}
+                      "evictions": 0, "preemptions": 0,
+                      "adopted_blocks": 0}
 
     def available(self) -> int:
         return len(self.free) + len(self.lru)
@@ -182,6 +188,58 @@ class _BlockManager:
             self.lru[key] = bid  # retain contents for future prefix hits
         else:
             self.free.append(bid)
+
+    def adopt(self, keys: List[Any]) -> Optional[List[int]]:
+        """Allocate one block per entry of ``keys`` for KV grafted from a
+        remote pool (disaggregated prefill handoff) and register the
+        non-None chain keys so the shipped prefix serves future local
+        prefix hits too.  All-or-nothing: on pool pressure every block
+        allocated so far is UNPUBLISHED and freed (a plain ``release``
+        would LRU-retain the registered keys pointing at never-written
+        blocks — a prefix-cache poisoning: the fallback re-prefill would
+        then "hit" garbage KV) and None is returned."""
+        bids: List[int] = []
+        for key in keys:
+            bid = self.alloc()
+            if bid is None:
+                self.unpublish_free(bids)
+                return None
+            if key is not None:
+                self.register(bid, key)
+            bids.append(bid)
+        self.stats["adopted_blocks"] += len(bids)
+        return bids
+
+    def unpublish_free(self, bids: List[int]) -> None:
+        """Roll back adopted blocks whose KV was never (fully) written:
+        unpublish any registered chain keys and return the blocks to the
+        free list.  A plain ``release`` would LRU-retain the keys
+        pointing at garbage blocks — prefix-cache poisoning."""
+        for b in bids:
+            k = self.key_of.pop(b, None)
+            if k is not None and self.by_key.get(k) == b:
+                del self.by_key[k]
+            self.refs.pop(b, None)
+            self.free.append(b)
+
+    def assert_integrity(self) -> None:
+        """Audit invariant (tests): every non-scratch block is in exactly
+        one of {free, LRU-retained, refcounted}, and every refcount is
+        positive — the abort/preemption paths must never leak or
+        double-free a block."""
+        free = set(self.free)
+        lru = set(self.lru.values())
+        refed = set(self.refs)
+        assert all(n > 0 for n in self.refs.values()), \
+            f"non-positive refcounts: {self.refs}"
+        assert not (free & lru), f"blocks both free and cached: {free & lru}"
+        assert not (free & refed), f"blocks both free and held: {free & refed}"
+        assert not (lru & refed), f"blocks both cached and held: {lru & refed}"
+        everything = free | lru | refed
+        expect = set(range(1, self.num_blocks))
+        assert everything == expect, \
+            (f"block accounting leak: missing {expect - everything}, "
+             f"phantom {everything - expect}")
 
 
 class LLMEngine:
@@ -296,6 +354,16 @@ class LLMEngine:
         self._ids = itertools.count()
         self._queue: "collections.deque[Request]" = collections.deque()
         self._failed: List[Request] = []  # per-request admission failures
+        # disaggregated serving state: finished prefill-only requests
+        # holding their blocks for export, adopted (already-prefilled)
+        # requests waiting for a free decode slot, and the jitted
+        # gather/scatter programs that move block-aligned pool slices
+        self._exports: Dict[int, Request] = {}
+        self._adopt_queue: "collections.deque[Request]" = collections.deque()
+        self._gather_blocks = None
+        self._scatter_blocks = None
+        self.handoff_stats = {"exported": 0, "adopted": 0,
+                              "adopt_failures": 0}
         self._slots: List[Optional[Request]] = [None] * self.B
         self._cur_len = np.zeros(self.B, np.int32)
         self._next_token = np.zeros(self.B, np.int32)
@@ -356,12 +424,14 @@ class LLMEngine:
 
     # -- request API --------------------------------------------------------
 
-    def submit(self, prompt, sampling: Optional[SamplingParams] = None) -> int:
+    def submit(self, prompt, sampling: Optional[SamplingParams] = None, *,
+               prefill_only: bool = False) -> int:
         if isinstance(prompt, str):
             prompt = self.tokenizer.encode(prompt)
         sampling = sampling or SamplingParams(
             stop_token_id=getattr(self.tokenizer, "eos_id", None))
-        req = Request(next(self._ids), list(prompt), sampling)
+        req = Request(next(self._ids), list(prompt), sampling,
+                      prefill_only=prefill_only)
         if len(req.prompt_tokens) >= self.max_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_tokens)} tokens >= engine "
@@ -388,15 +458,27 @@ class LLMEngine:
                     self.blocks.release(bid)
                 req.chunk_blocks = []
                 return True
+        for qi, req in enumerate(self._adopt_queue):
+            if req.request_id == request_id:
+                del self._adopt_queue[qi]
+                for bid in req.blocks:
+                    self.blocks.release(bid)
+                req.blocks = []
+                return True
+        if request_id in self._exports:
+            self.release_export(request_id)
+            return True
         for i in range(self.B):
             req = self._slots[i]
             if req is not None and req.request_id == request_id:
                 req.done = True
+                req.prefill_only = False  # abandoned: nothing to export
                 return True
         return False
 
     def has_unfinished(self) -> bool:
         return (bool(self._queue) or bool(self._failed)
+                or bool(self._adopt_queue)
                 or any(s is not None for s in self._slots))
 
     def free_slot_count(self) -> int:
@@ -412,6 +494,23 @@ class LLMEngine:
         run ONE decode step for all active slots, retire finished."""
         import jax
         import jax.numpy as jnp
+
+        # 0. place adopted (already-prefilled, KV grafted) requests into
+        # free slots: no prefill dispatch at all — the shipped blocks ARE
+        # the cache, the first token came with the handoff
+        for i in range(self.B):
+            if not self._adopt_queue:
+                break
+            if self._slots[i] is not None:
+                continue
+            req = self._adopt_queue.popleft()
+            self._slots[i] = req
+            self._cur_len[i] = len(req.prompt_tokens)
+            self._next_token[i] = req.out_tokens[-1] if req.out_tokens \
+                else 0
+            self._tables[i] = 0
+            self._tables[i, :len(req.blocks)] = req.blocks
+            self._dev_dirty = True
 
         # 1. admit — prefills dispatch back-to-back; the first tokens of
         # ALL admissions are sampled and fetched in ONE host sync
@@ -502,9 +601,14 @@ class LLMEngine:
                 out.append(GenerationOutput(
                     req.request_id, req.prompt_tokens[:req.n_prompt], toks,
                     text=self.tokenizer.decode(toks)))
-                for bid in req.blocks:
-                    self.blocks.release(bid)
-                req.blocks = []
+                if req.prefill_only and req.blocks:
+                    # blocks stay held for export_kv (the KV handoff);
+                    # release_export is the abandonment path
+                    self._exports[req.request_id] = req
+                else:
+                    for bid in req.blocks:
+                        self.blocks.release(bid)
+                    req.blocks = []
                 self._slots[i] = None
                 self._tables[i] = 0
                 self._dev_dirty = True
@@ -518,6 +622,189 @@ class LLMEngine:
             for out in self.step():
                 results[out.request_id] = out
         return [results[i] for i in ids]
+
+    # -- disaggregated prefill/decode handoff --------------------------------
+    #
+    # A prefill replica runs ``submit(..., prefill_only=True)`` requests:
+    # the engine prefills the prompt, samples the FIRST token, and parks
+    # the finished request in ``_exports`` with its block refs held.
+    # ``export_kv`` gathers those block-aligned pool slices into fresh
+    # device arrays (never views of the live pool — the alias-gotcha
+    # class) and releases the refs; the payload ships to a decode
+    # replica whose ``adopt_prefilled`` grafts the blocks + their
+    # prefix-cache chain keys into its own pool and resumes the decode
+    # loop at full batch occupancy, no re-prefill.
+
+    def export_kv(self, request_id: int) -> Dict[str, Any]:
+        """Pop a finished prefill-only request and gather its KV blocks.
+
+        Returns the self-contained handoff payload: prompt/out tokens,
+        sampling params, and ``kv`` — a dict of ``[L, n_blocks, bs, ...]``
+        device arrays (one per pool tensor, so int8 pools ship their
+        scales alongside).  The gather materializes NEW buffers
+        (``block_until_ready`` before the refs release), so the shipped
+        arrays can never alias pool blocks that a later step overwrites.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        req = self._exports.pop(request_id)
+        if self._gather_blocks is None:
+            self._gather_blocks = jax.jit(
+                lambda pool, ids: {k: v[:, ids] for k, v in pool.items()})
+        # pad the id list to its power-of-2 bucket with the scratch
+        # block: the gather/scatter programs then compile per BUCKET
+        # (O(log MB) compiles), not per distinct block count — an
+        # unbucketed gather recompiles a pool-sized program for every
+        # new prompt length, inside the engine lock
+        n = len(req.blocks)
+        P = _bucket(n, self.MB + 1)
+        ids = np.zeros(P, np.int32)
+        ids[:n] = req.blocks
+        kv = self._gather_blocks(self.pool, jnp.asarray(ids))
+        jax.block_until_ready(kv)
+        for bid in req.blocks:
+            self.blocks.release(bid)
+        req.blocks = []
+        self.handoff_stats["exported"] += 1
+        return {
+            "request_id": req.request_id,
+            "prompt_tokens": list(req.prompt_tokens),
+            "n_prompt": req.n_prompt,
+            "out_tokens": list(req.out_tokens),
+            "sampling": req.sampling,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "block_size": self.bs,
+            "n_blocks": n,
+            "kv": kv,
+        }
+
+    def release_export(self, request_id: int) -> bool:
+        """Abandonment path: drop a held export (client gone before the
+        handoff shipped) and release its block refs."""
+        req = self._exports.pop(request_id, None)
+        if req is None:
+            return False
+        for bid in req.blocks:
+            self.blocks.release(bid)
+        req.blocks = []
+        return True
+
+    def adopt_prefilled(self, handoff: Dict[str, Any],
+                        sampling: Optional[SamplingParams] = None
+                        ) -> Optional[int]:
+        """Graft a shipped prefill into this engine: allocate local
+        blocks, scatter the shipped KV into the pool, register the full
+        prompt blocks' prefix-chain keys (future local prompts hit the
+        shipped prefix too), and queue a ready-to-decode request seeded
+        with the prefill's first token.  Returns the local request id,
+        or None under pool pressure (caller re-prefills the prompt
+        through the ordinary path)."""
+        import jax.numpy as jnp
+
+        kv = handoff["kv"]
+        if handoff.get("kv_cache_dtype") != self.kv_cache_dtype:
+            raise ValueError(
+                f"handoff kv_cache_dtype {handoff.get('kv_cache_dtype')!r} "
+                f"!= engine {self.kv_cache_dtype!r}")
+        if int(handoff.get("block_size", self.bs)) != self.bs:
+            raise ValueError(
+                f"handoff block_size {handoff.get('block_size')} != "
+                f"engine block_size {self.bs}")
+        ref = self.pool["k"]
+        if set(kv) != set(self.pool) or kv["k"].shape[0] != ref.shape[0] \
+                or kv["k"].shape[2:] != ref.shape[2:]:
+            raise ValueError(
+                f"handoff pool layout {jnp.shape(kv['k'])} incompatible "
+                f"with engine pool {ref.shape}")
+        prompt = list(handoff["prompt_tokens"])
+        n = len(prompt)
+        P = int(kv["k"].shape[1])  # bucketed width (scratch-padded)
+        n_ship = int(handoff.get("n_blocks", P))
+        # a handoff from a LARGER-max_len prefill engine must fail the
+        # one request here (caller re-prefills or errors), never crash
+        # the engine loop scattering past the [B, MB] table width
+        if n_ship > self.MB or n >= self.max_len:
+            raise ValueError(
+                f"handoff of {n_ship} blocks / {n} prompt tokens exceeds "
+                f"this engine's table ({self.MB} blocks, max_len "
+                f"{self.max_len}) — prefill and decode pools must share "
+                f"max_len/block_size")
+        keys = self._prompt_chain_keys(prompt)
+        key_list = [keys[b] if b < len(keys) and (b + 1) * self.bs <= n
+                    else None for b in range(n_ship)]
+        bids = self.blocks.adopt(key_list)
+        if bids is None:
+            self.handoff_stats["adopt_failures"] += 1
+            return None
+        if self._scatter_blocks is None:
+            import jax
+
+            self._scatter_blocks = jax.jit(
+                lambda pool, ids, new: {
+                    k: pool[k].at[:, ids].set(new[k]) for k in pool},
+                donate_argnums=(0,))
+        # pad lanes scatter into the scratch block (its designated role:
+        # absorbing masked writes) so one compiled program per bucket
+        # serves every handoff width
+        dst = np.zeros(P, np.int32)
+        dst[:n_ship] = bids
+        try:
+            self.pool = self._scatter_blocks(self.pool, jnp.asarray(dst),
+                                             kv)
+        except BaseException:
+            # scatter failed AFTER the blocks were allocated+registered
+            # (compile OOM, kv tensor rejected inside the program): the
+            # never-written blocks must be unpublished, not leaked with
+            # chain keys pointing at garbage
+            self.blocks.unpublish_free(bids)
+            raise
+        sp = sampling or handoff.get("sampling") or SamplingParams(
+            stop_token_id=getattr(self.tokenizer, "eos_id", None))
+        req = Request(next(self._ids), prompt, sp,
+                      out_tokens=list(handoff.get("out_tokens", [])),
+                      blocks=bids, n_prompt=int(handoff.get("n_prompt", n)))
+        req.cached_prefix_len = n
+        # re-evaluate finish conditions locally: the prefill side's first
+        # token may already exhaust the budget (max_tokens=1) or the
+        # prompt may sit at the engine's length ceiling
+        if not req.out_tokens:
+            req.done = True  # stop token hit at the prefill's first sample
+        elif (req.num_generated >= sp.max_tokens
+              or len(req.prompt_tokens) + len(req.out_tokens)
+              >= self.max_len - 1):
+            req.done = True
+        self._adopt_queue.append(req)
+        self.handoff_stats["adopted"] += 1
+        return req.request_id
+
+    def stats(self) -> Dict[str, Any]:
+        """Engine signals for the serve autoscaler + dashboard ``/api/llm``
+        panel: queue depth, slot occupancy, block-pool pressure, prefix /
+        speculative / handoff counters.  Host-side bookkeeping only — no
+        device sync."""
+        used = sum(1 for s in self._slots if s is not None)
+        capacity = max(1, self.num_blocks - 1)  # excl. the scratch block
+        available = self.blocks.available()
+        return {
+            "queued": len(self._queue),
+            "adopt_queued": len(self._adopt_queue),
+            "exports_held": len(self._exports),
+            "slots_used": used,
+            "slots_total": self.B,
+            "slot_occupancy": round(used / self.B, 4),
+            "blocks_total": capacity,
+            "blocks_free": len(self.blocks.free),
+            "blocks_cached": len(self.blocks.lru),
+            "blocks_available": available,
+            "block_pressure": round(1.0 - available / capacity, 4),
+            "block_size": self.bs,
+            "kv_cache_dtype": self.kv_cache_dtype or "native",
+            "prefix_cache": dict(self.blocks.stats),
+            "prefill_chunks": self.prefill_stats["chunks"],
+            "spec": dict(self.spec_stats),
+            "handoff": dict(self.handoff_stats),
+        }
 
     # -- admission / prefill ------------------------------------------------
 
@@ -957,6 +1244,11 @@ class LLMEngine:
             return
         req.out_tokens.append(tok)
         self._next_token[i] = tok
+        if req.prefill_only:
+            # first sampled token is the handoff payload's seed; the
+            # decode replica generates everything after it
+            req.done = True
+            return
         if self.on_token is not None:
             try:
                 self.on_token(req.request_id, tok)

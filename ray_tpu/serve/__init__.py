@@ -7,6 +7,7 @@ optional HTTP proxy exposes route prefixes (``serve.start(http_options=...)``).
 
 from __future__ import annotations
 
+import dataclasses
 import uuid
 from typing import Any, Dict, Optional
 
@@ -20,15 +21,22 @@ from ray_tpu.serve.deployment import (
     DeploymentConfig,
     deployment,
 )
+from ray_tpu.serve.context import ReplicaContext, get_replica_context
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import batch
-from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.router import (
+    DeploymentHandle,
+    DeploymentResponse,
+    TwoStageHandle,
+)
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "RequestContext", "batch",
+    "DeploymentHandle", "DeploymentResponse", "ReplicaContext",
+    "RequestContext", "TwoStageHandle", "batch",
     "context", "delete", "deployment",
     "get_app_handle", "get_deployment_handle", "get_multiplexed_model_id",
+    "get_replica_context",
     "grpc_proxy_port", "multiplexed", "request_scope", "run",
     "shutdown", "start",
     "status",
@@ -106,15 +114,8 @@ def run(target: Application | Deployment, *, name: str = "default",
             "max_ongoing_requests": cfg.max_ongoing_requests,
             "max_queued_requests": cfg.max_queued_requests,
             "autoscaling_config": (
-                None if cfg.autoscaling_config is None else {
-                    "min_replicas": cfg.autoscaling_config.min_replicas,
-                    "max_replicas": cfg.autoscaling_config.max_replicas,
-                    "target_ongoing_requests":
-                        cfg.autoscaling_config.target_ongoing_requests,
-                    "upscale_delay_s": cfg.autoscaling_config.upscale_delay_s,
-                    "downscale_delay_s":
-                        cfg.autoscaling_config.downscale_delay_s,
-                }),
+                None if cfg.autoscaling_config is None
+                else dataclasses.asdict(cfg.autoscaling_config)),
             "user_config": cfg.user_config,
             "ray_actor_options": cfg.ray_actor_options,
         }

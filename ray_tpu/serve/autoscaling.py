@@ -1,0 +1,140 @@
+"""Signal-driven replica autoscaling: the pool-target decision logic.
+
+The serve controller's original autoscaler watched ONE signal (average
+in-flight requests per replica).  Disaggregated LLM serving needs
+per-pool targets driven by the signals that actually distinguish the
+pools: a **prefill** pool saturates on *queue depth* (prompts waiting
+for a prefill slot — the router's bounded queue plus the engines' own
+admission queues) and on overload verdicts (sheds, deadline misses),
+while a **decode** pool saturates on *slot occupancy* and *block-pool
+pressure* (every decode slot busy / KV blocks near exhaustion) long
+before its request queue grows — a decode request parks in a slot for
+its whole generation.
+
+This module is the PURE half: :func:`desired_delta` maps one pool's
+:class:`PoolSignals` snapshot to ``+1 / 0 / -1`` with no clocks and no
+cluster state, so the synthetic-ramp tests drive it directly.  The
+controller (``serve/controller.py``) owns the stateful half: collecting
+signals (replica probes, aggregated ``OverloadStats``, the engine-stats
+records LLM replicas publish to the GCS KV namespace ``"llm"``),
+applying the up/downscale delays, and actuating ``goal_replicas``
+through the existing reconcile/start-first machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.deployment import AutoscalingConfig
+
+
+@dataclasses.dataclass
+class PoolSignals:
+    """One deployment's load snapshot for a single autoscale tick.
+
+    ``shed_delta`` / ``expired_delta`` are events since the LAST tick
+    (monotonic counters differenced by the controller); everything else
+    is an instantaneous gauge.  Engine signals default to ``None`` for
+    deployments that publish no engine stats (plain serve apps) — a
+    missing signal never votes."""
+
+    replicas: int = 0
+    ongoing_avg: float = 0.0          # in-flight requests per replica
+    router_queued: int = 0            # aggregated router queue gauge
+    shed_delta: int = 0               # sheds since last tick
+    expired_delta: int = 0            # deadline misses since last tick
+    engine_queue_avg: Optional[float] = None   # engine-queued per replica
+    slot_occupancy: Optional[float] = None     # avg slots_used/slots_total
+    block_pressure: Optional[float] = None     # avg 1 - available/capacity
+
+
+def pool_signals_from_engine_records(
+        records, replicas: int, *, ongoing_avg: float = 0.0,
+        router_queued: int = 0, shed_delta: int = 0,
+        expired_delta: int = 0) -> PoolSignals:
+    """Fold the engine-stats KV records of one deployment's replicas
+    into a :class:`PoolSignals` (records: the dicts LLM replicas publish
+    — ``queued``/``adopt_queued``/``slot_occupancy``/``block_pressure``).
+    """
+    sig = PoolSignals(replicas=replicas, ongoing_avg=ongoing_avg,
+                      router_queued=router_queued, shed_delta=shed_delta,
+                      expired_delta=expired_delta)
+    recs = [r for r in records or [] if isinstance(r, dict)]
+    if recs:
+        n = len(recs)
+        sig.engine_queue_avg = sum(
+            float(r.get("queued", 0)) + float(r.get("adopt_queued", 0))
+            for r in recs) / n
+        sig.slot_occupancy = sum(
+            float(r.get("slot_occupancy", 0.0)) for r in recs) / n
+        sig.block_pressure = sum(
+            float(r.get("block_pressure", 0.0)) for r in recs) / n
+    return sig
+
+
+def desired_delta(cfg: AutoscalingConfig, sig: PoolSignals) -> int:
+    """+1 (scale up), -1 (scale down), or 0 — pure decision.
+
+    Upscale when ANY enforced signal crosses its target: load must be
+    relieved even if only one dimension is saturated (a decode pool at
+    full slot occupancy with an empty queue still needs a replica).
+    Downscale only when EVERY enforced signal sits below half its
+    target and no overload events landed this tick — one hot dimension
+    vetoes shrinking.  Delays/hysteresis are the controller's job."""
+    replicas = max(1, sig.replicas)
+    queue_depth = sig.router_queued / replicas
+    if sig.engine_queue_avg is not None:
+        queue_depth += sig.engine_queue_avg
+
+    up = False
+    if cfg.target_ongoing_requests is not None \
+            and sig.ongoing_avg > cfg.target_ongoing_requests:
+        up = True
+    if cfg.target_queue_depth is not None \
+            and queue_depth > cfg.target_queue_depth:
+        up = True
+    if cfg.upscale_on_overload and (sig.shed_delta > 0
+                                    or sig.expired_delta > 0):
+        up = True
+    if cfg.target_slot_occupancy is not None \
+            and sig.slot_occupancy is not None \
+            and sig.slot_occupancy > cfg.target_slot_occupancy:
+        up = True
+    if cfg.target_block_pressure is not None \
+            and sig.block_pressure is not None \
+            and sig.block_pressure > cfg.target_block_pressure:
+        up = True
+    if up:
+        return 1
+
+    down = True
+    if cfg.target_ongoing_requests is not None \
+            and sig.ongoing_avg >= 0.5 * cfg.target_ongoing_requests:
+        down = False
+    if cfg.target_queue_depth is not None \
+            and queue_depth >= 0.5 * cfg.target_queue_depth:
+        down = False
+    if cfg.target_slot_occupancy is not None \
+            and sig.slot_occupancy is not None \
+            and sig.slot_occupancy >= 0.5 * cfg.target_slot_occupancy:
+        down = False
+    if cfg.target_block_pressure is not None \
+            and sig.block_pressure is not None \
+            and sig.block_pressure >= 0.5 * cfg.target_block_pressure:
+        down = False
+    if sig.shed_delta > 0 or sig.expired_delta > 0:
+        down = False
+    return -1 if down else 0
+
+
+def autoscaling_config_from_dict(asc: Dict[str, Any]) -> AutoscalingConfig:
+    """Rebuild an :class:`AutoscalingConfig` from the controller's stored
+    config dict, tolerating records written before the signal fields
+    existed.  Legacy ongoing-average semantics are preserved, with ONE
+    deliberate upgrade: overload events (sheds, deadline misses) now
+    vote for upscale by default — a pool sized to shed sustained excess
+    on purpose should set ``upscale_on_overload=False``."""
+    names = {f.name for f in dataclasses.fields(AutoscalingConfig)}
+    return AutoscalingConfig(**{k: v for k, v in (asc or {}).items()
+                                if k in names})

@@ -25,6 +25,33 @@ from typing import Any, Dict, Iterator, Optional
 
 
 @dataclasses.dataclass(frozen=True)
+class ReplicaContext:
+    """Identity of the replica hosting the current callable (reference:
+    ``serve.get_replica_context``) — deployment name + replica id, so a
+    callable can label what it publishes (e.g. the LLM engine-stats
+    records the pool autoscaler reads) without threading its own name
+    through init args.  Lives here (not in ``replica.py``) because the
+    replica ACTOR class ships by value; this module is always imported
+    by reference, so its global is the one every reader sees."""
+
+    deployment: str
+    replica_id: str
+
+
+_replica_context: Optional[ReplicaContext] = None
+
+
+def _set_replica_context(ctx: Optional[ReplicaContext]) -> None:
+    global _replica_context
+    _replica_context = ctx
+
+
+def get_replica_context() -> Optional[ReplicaContext]:
+    """The hosting replica's context, or None outside a replica."""
+    return _replica_context
+
+
+@dataclasses.dataclass(frozen=True)
 class RequestContext:
     """One serving request's identity and end-to-end budget.
 

@@ -328,7 +328,47 @@ class ServeController:
                 if gone:
                     st["version"] += 1
 
+    # engine-stats KV records older than this don't vote in autoscaling
+    # (a dead replica's last published pressure must not pin a pool up)
+    ENGINE_STATS_FRESH_S = 30.0
+
+    def _engine_records(self, name: str) -> list:
+        """Fresh engine-stats records LLM replicas of deployment ``name``
+        published to the GCS KV (namespace "llm") — the autoscaler's
+        engine-signal feed.  Empty for non-engine deployments."""
+        import json
+
+        try:
+            from ray_tpu.experimental.internal_kv import \
+                _internal_kv_get_prefix
+
+            table = _internal_kv_get_prefix(f"engine/{name}/",
+                                            namespace="llm")
+        except Exception:  # noqa: BLE001 — control-plane hiccup
+            return []
+        out = []
+        now = time.time()
+        for raw in (table or {}).values():
+            try:
+                rec = json.loads(raw)
+            except Exception:  # noqa: BLE001 — record mid-write
+                continue
+            if now - rec.get("ts", 0) <= self.ENGINE_STATS_FRESH_S:
+                out.append(rec)
+        return out
+
     def _autoscale_once(self):
+        """Per-pool signal-driven scaling (``serve/autoscaling.py``):
+        overload counters (queue gauge, shed/expired deltas) + engine
+        signals (slot occupancy, block pressure) + the legacy in-flight
+        average, so e.g. a prefill pool scales up on queue depth while
+        the decode pool scales up on slot occupancy — independently."""
+        from ray_tpu.serve.autoscaling import (
+            autoscaling_config_from_dict,
+            desired_delta,
+            pool_signals_from_engine_records,
+        )
+
         with self._lock:
             items = list(self._deployments.items())
         for name, st in items:
@@ -341,21 +381,50 @@ class ServeController:
             total = 0
             for r in replicas:
                 try:
-                    total += ray_tpu.get(r.get_queue_len.remote(), timeout=5)
+                    # peak-since-last-tick, not the instantaneous gauge:
+                    # a burst shorter than the tick period must still be
+                    # visible to the next autoscale decision
+                    total += ray_tpu.get(r.take_load_peak.remote(),
+                                         timeout=5)
                 except Exception:
                     pass
-            avg = total / len(replicas)
+            cfg = autoscaling_config_from_dict(asc)
+            # the KV prefix read costs one GCS RPC per tick: only pay it
+            # for pools that actually scale on engine signals — a plain
+            # serve deployment never publishes engine stats
+            engine_recs = [] if (cfg.target_slot_occupancy is None
+                                 and cfg.target_block_pressure is None
+                                 and cfg.target_queue_depth is None) \
+                else self._engine_records(name)
             now = time.monotonic()
             with self._lock:
-                target = asc["target_ongoing_requests"]
+                overload = self._overload_total(st)
+                # first tick: seed the baseline without acting — the
+                # deployment's whole overload HISTORY is not one tick's
+                # worth of events
+                first = "autoscale_last_overload" not in st
+                last = st.get("autoscale_last_overload") or {}
+                st["autoscale_last_overload"] = dict(overload)
+                sig = pool_signals_from_engine_records(
+                    engine_recs, len(replicas),
+                    ongoing_avg=total / len(replicas),
+                    router_queued=int(overload.get("queued", 0)),
+                    shed_delta=0 if first else
+                    max(0, overload.get("shed", 0) - last.get("shed", 0)),
+                    expired_delta=0 if first else
+                    max(0, overload.get("expired", 0)
+                        - last.get("expired", 0)))
+                delta = desired_delta(cfg, sig)
                 goal = st.get("goal_replicas", 1)
-                if avg > target and goal < asc["max_replicas"]:
-                    if now - st["last_scale"] >= asc["upscale_delay_s"]:
-                        st["goal_replicas"] = min(goal + 1, asc["max_replicas"])
+                if delta > 0 and goal < cfg.max_replicas:
+                    if now - st["last_scale"] >= cfg.upscale_delay_s:
+                        st["goal_replicas"] = min(goal + 1,
+                                                  cfg.max_replicas)
                         st["last_scale"] = now
-                elif avg < target * 0.5 and goal > asc["min_replicas"]:
-                    if now - st["last_scale"] >= asc["downscale_delay_s"]:
-                        st["goal_replicas"] = max(goal - 1, asc["min_replicas"])
+                elif delta < 0 and goal > cfg.min_replicas:
+                    if now - st["last_scale"] >= cfg.downscale_delay_s:
+                        st["goal_replicas"] = max(goal - 1,
+                                                  cfg.min_replicas)
                         st["last_scale"] = now
 
     def _drain_migrate_once(self):

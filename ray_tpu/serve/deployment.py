@@ -12,13 +12,26 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Reference: ``serve/config.py`` AutoscalingConfig (queue-depth driven)."""
+    """Reference: ``serve/config.py`` AutoscalingConfig (queue-depth
+    driven), extended with the overload + engine signals the
+    disaggregated LLM pools scale on (``serve/autoscaling.py``):
+    a prefill pool sets ``target_queue_depth`` (scale on prompts
+    waiting), a decode pool sets ``target_slot_occupancy`` /
+    ``target_block_pressure`` (scale on busy decode slots / KV-pool
+    exhaustion).  ``None`` disables a signal; the legacy
+    ``target_ongoing_requests`` behavior is the default."""
 
     min_replicas: int = 1
     max_replicas: int = 4
-    target_ongoing_requests: float = 2.0
+    target_ongoing_requests: Optional[float] = 2.0
     upscale_delay_s: float = 3.0
     downscale_delay_s: float = 10.0
+    # overload signals (PR 4 counters, aggregated by the controller)
+    target_queue_depth: Optional[float] = None   # queued per replica
+    upscale_on_overload: bool = True             # sheds/deadline misses
+    # engine signals (LLM replicas' published engine stats)
+    target_slot_occupancy: Optional[float] = None   # 0..1
+    target_block_pressure: Optional[float] = None   # 0..1
 
 
 @dataclasses.dataclass

@@ -12,6 +12,10 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.serve.context import (  # noqa: F401 — canonical home; re-
+    ReplicaContext,                   # exported here for discoverability
+    get_replica_context,
+)
 
 
 class _BatchQueue:
@@ -116,6 +120,7 @@ class ReplicaActor:
         self._deployment = deployment_name
         self._replica_id = replica_id
         self._ongoing = 0
+        self._peak_ongoing = 0  # high-water since the last autoscale poll
         self._total = 0
         # degradation counters: deadline-expired drops (the request sat
         # queued past its budget — never executed) and client-abandon
@@ -124,6 +129,14 @@ class ReplicaActor:
         self._cancelled = 0
         self._overload = None  # lazy OverloadStats (metrics registry)
         self._lock = threading.Lock()
+        # runtime import: the actor class ships by VALUE (the decorator
+        # shadows its module name), so a module-global write here would
+        # land in the pickled copy's namespace — the context must live
+        # in a by-reference module (serve.context) instead
+        from ray_tpu.serve import context as serve_context
+
+        serve_context._set_replica_context(
+            ReplicaContext(deployment_name, replica_id))
         if isinstance(target, type):
             self._callable = target(*init_args, **init_kwargs)
         else:
@@ -173,6 +186,7 @@ class ReplicaActor:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            self._peak_ongoing = max(self._peak_ongoing, self._ongoing)
         token = _mux_model_id.set(multiplexed_model_id)
         try:
             # scope(ctx): nested DeploymentHandle calls made by the user
@@ -215,6 +229,7 @@ class ReplicaActor:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            self._peak_ongoing = max(self._peak_ongoing, self._ongoing)
         token = _mux_model_id.set(multiplexed_model_id)
         try:
             with scope(ctx):
@@ -235,6 +250,18 @@ class ReplicaActor:
 
     def get_queue_len(self) -> int:
         return self._ongoing
+
+    def take_load_peak(self) -> int:
+        """Autoscaler sample: the HIGH-WATER in-flight count since the
+        last call, reset to the current level.  An instantaneous gauge
+        sampled every tick is blind to bursts shorter than the tick (a
+        second-long surge of N requests can land exactly between two
+        polls and read 0 twice); the peak makes every burst visible to
+        the next tick."""
+        with self._lock:
+            peak = max(self._peak_ongoing, self._ongoing)
+            self._peak_ongoing = self._ongoing
+            return peak
 
     def probe(self) -> Dict[str, Any]:
         """Router probe: queue length + currently loaded multiplexed

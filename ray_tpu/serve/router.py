@@ -586,6 +586,56 @@ class Router:
                     multiplexed_model_id=model_id, request_context=ctx_d))
         return _SlotReleasingStream(gen, self, key)
 
+    # ------------------------------------------------- targeted dispatch
+    #
+    # Two-stage (disaggregated) serving needs the replica CHOICE and the
+    # dispatch to decouple: the decode replica must be reserved before
+    # prefill starts, because the prefill stage ships KV blocks to that
+    # specific replica's channel.  These helpers expose the admission
+    # valve (reserve) and the dispatch separately, with the same
+    # slot-accounting/queueing/shed semantics as assign().
+
+    def acquire_replica(self, ctx=None):
+        """Reserve one admission slot on a chosen replica; returns
+        ``(replica, key)``.  Blocks in the bounded router queue when the
+        pool is saturated; sheds with ``BackPressureError`` / expires
+        with ``DeadlineExceededError`` exactly like ``assign``.  The
+        caller MUST end the reservation via ``dispatch_to`` (slot
+        released on completion) or ``release_replica``."""
+        self._maybe_refresh()
+        replica = self._acquire_replica("", ctx)
+        return replica, self._cache_key(replica)
+
+    def release_replica(self, key: str) -> None:
+        """Give back a reservation acquired via ``acquire_replica``
+        without dispatching (stage-1 failure)."""
+        self._release(key)
+
+    def dispatch_to(self, replica, key: str, method: str, args: tuple,
+                    kwargs: dict, *, streaming: bool = False):
+        """Dispatch to an already-reserved replica.  Unary returns the
+        ref (completion watcher releases the slot); streaming returns a
+        ``_SlotReleasingStream``.  On dispatch failure the reservation is
+        released before the error surfaces."""
+        ctx = current_context()
+        ctx_d = None if ctx is None else ctx.to_dict()
+        try:
+            if streaming:
+                out = replica.handle_request_streaming.options(
+                    num_returns="streaming").remote(
+                        method, args, kwargs, request_context=ctx_d)
+            else:
+                out = replica.handle_request.remote(
+                    method, args, kwargs, request_context=ctx_d)
+        except BaseException:
+            self._release(key)
+            raise
+        self.note_dispatch(replica)
+        if streaming:
+            return _SlotReleasingStream(out, self, key)
+        self._track_completion(out, key)
+        return out
+
 
 class _SlotReleasingStream:
     """Iterator proxy over a streaming dispatch that gives the replica's
@@ -709,3 +759,202 @@ class DeploymentStreamingResponse:
     @property
     def ref_generator(self):
         return self._gen
+
+
+class TwoStageHandle:
+    """Disaggregated two-stage dispatch: prefill → handoff token → decode.
+
+    Stage 1 goes through the prefill deployment's ordinary router
+    (queueing on the prefill pool is the autoscaler's queue-depth
+    signal).  The decode replica is RESERVED first — the prefill stage
+    ships KV blocks into that specific replica's landing channel — then
+    stage 2 dispatches the handoff token to the reserved replica, unary
+    or streaming, so the token fan-out the client sees is byte-identical
+    to the colocated path.
+
+    A decode replica that dies mid-request (or mid-stream) triggers a
+    bounded **re-prefill**: the whole two-stage flow re-runs on a
+    healthy pair within the request's remaining deadline, counted in
+    ``reprefills``; already-delivered stream chunks are deduplicated by
+    index.  Overload verdicts (``BackPressureError``,
+    ``DeadlineExceededError``) from either stage surface unchanged —
+    they are never retried here (the proxy owns that decision).
+    """
+
+    # generous stage-1 bound for deadline-less direct use: a wedged
+    # prefill replica must surface as an error, not a permanent hang
+    DEFAULT_STAGE_TIMEOUT_S = 300.0
+
+    def __init__(self, prefill: "DeploymentHandle",
+                 decode: "DeploymentHandle", *,
+                 prefill_method: str = "prefill",
+                 decode_method: str = "decode",
+                 decode_stream_method: str = "decode_stream",
+                 max_reprefills: int = 1):
+        self._prefill = prefill
+        self._decode = decode
+        self._m1 = prefill_method
+        self._m2 = decode_method
+        self._m2s = decode_stream_method
+        self._max_reprefills = max_reprefills
+        self.stats = {"requests": 0, "reprefills": 0}
+
+    def _remaining(self, ctx, deadline: Optional[float] = None) -> float:
+        """Remaining budget: the tighter of the request context's
+        deadline and the caller's explicit bound (monotonic)."""
+        rem = self.DEFAULT_STAGE_TIMEOUT_S
+        if ctx is not None:
+            ctx_rem = ctx.remaining_s()
+            if ctx_rem is not None:
+                rem = max(0.0, ctx_rem)
+        if deadline is not None:
+            rem = min(rem, max(0.0, deadline - time.monotonic()))
+        return rem
+
+    def _dispatch(self, body, *, streaming: bool,
+                  deadline: Optional[float] = None):
+        """One full two-stage attempt; returns the stage-2 ref/stream."""
+        ctx = current_context()
+        r2 = self._decode._get_router()
+        replica, key = r2.acquire_replica(ctx)
+        try:
+            token = self._prefill.options(method_name=self._m1).remote(
+                body, replica).result(
+                    timeout=self._remaining(ctx, deadline))
+        except BaseException:
+            r2.release_replica(key)
+            raise
+        return r2.dispatch_to(
+            replica, key, self._m2s if streaming else self._m2,
+            (token, body), {}, streaming=streaming)
+
+    _reprefill_counter = None
+
+    def _note_reprefill(self):
+        self.stats["reprefills"] += 1
+        try:
+            from ray_tpu.util import metrics
+
+            cls = TwoStageHandle
+            if cls._reprefill_counter is None:
+                # cached: Metric.__init__ re-registers (and would reset)
+                cls._reprefill_counter = metrics.Counter(
+                    "llm_reprefills",
+                    "two-stage requests re-prefilled after a "
+                    "decode-replica failure")
+            cls._reprefill_counter.inc()
+        except Exception:  # noqa: BLE001 — visibility never fails a request
+            pass
+
+    def _retryable(self, err: BaseException, ctx,
+                   deadline: Optional[float] = None) -> bool:
+        """A mid-flight replica/transport death is worth a re-prefill on
+        a healthy pair; overload verdicts, spent budgets (request
+        deadline OR the caller's explicit bound), and non-``Exception``
+        BaseExceptions are not — a client disconnect surfaces as
+        ``GeneratorExit`` at the yield, and re-dispatching a whole
+        prefill+ship+decode nobody will read (then yielding into the
+        closed generator) is exactly wrong."""
+        if not isinstance(err, Exception):
+            return False  # GeneratorExit / KeyboardInterrupt / SystemExit
+        if isinstance(err, (BackPressureError, DeadlineExceededError)):
+            return False
+        if ctx is not None and ctx.expired():
+            return False
+        if deadline is not None and time.monotonic() >= deadline:
+            return False
+        return True
+
+    def _pre_retry(self):
+        """Refresh the decode replica set (the controller prunes a
+        killed replica within a tick) and back off briefly so the next
+        attempt doesn't land straight back on the corpse."""
+        try:
+            self._decode._get_router().refresh()
+        except Exception:  # noqa: BLE001 — next attempt retries anyway
+            pass
+        time.sleep(0.25)
+
+    def call(self, body, timeout: Optional[float] = None):
+        """Blocking unary request through both stages.  ``timeout``
+        bounds the WHOLE call including any re-prefill attempts — with
+        no surrounding request scope, a deadline-carrying context is
+        minted from it so the router-queue waits of BOTH pools honor
+        the bound too (they block on the context, not the caller's
+        clock)."""
+        import contextlib
+
+        import ray_tpu
+        from ray_tpu.serve.context import RequestContext, scope
+
+        self.stats["requests"] += 1
+        ctx = current_context()
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        minted = contextlib.nullcontext()
+        if ctx is None and timeout is not None:
+            ctx = RequestContext(uuid.uuid4().hex,
+                                 deadline_s=time.time() + timeout)
+            minted = scope(ctx)
+        attempts = self._max_reprefills + 1
+        with minted:
+            for attempt in range(attempts):
+                try:
+                    ref = self._dispatch(body, streaming=False,
+                                         deadline=deadline)
+                    return ray_tpu.get(
+                        ref, timeout=self._remaining(ctx, deadline))
+                except BaseException as e:  # noqa: BLE001 — classified
+                    if attempt + 1 >= attempts \
+                            or not self._retryable(e, ctx, deadline):
+                        raise
+                    self._note_reprefill()
+                    self._pre_retry()
+
+    @staticmethod
+    def _stream_resumable(body) -> bool:
+        """Resume-at-index after a mid-stream death splices chunks from
+        TWO generations — only coherent when decoding is deterministic.
+        Greedy (``temperature == 0``) requests resume; sampled ones
+        surface the error once chunks were delivered (the engine's
+        default temperature is 0.7, so an absent field counts as
+        sampled)."""
+        if not isinstance(body, dict):
+            return False
+        try:
+            return float(body.get("temperature", 0.7) or 0.0) == 0.0
+        except (TypeError, ValueError):
+            return False
+
+    def stream(self, body):
+        """Streaming request: yields the decode replica's chunks (each
+        carries ``index``; the final chunk carries ``done``).  A decode
+        death mid-stream re-prefills and resumes from the first
+        undelivered index — for greedy streams; a sampled stream that
+        already delivered chunks cannot be coherently resumed and
+        surfaces the error instead (an untouched stream always
+        retries)."""
+        self.stats["requests"] += 1
+        ctx = current_context()
+        attempts = self._max_reprefills + 1
+        delivered = 0
+        for attempt in range(attempts):
+            try:
+                stream = self._dispatch(body, streaming=True)
+                for chunk in DeploymentStreamingResponse(stream):
+                    if chunk.get("done"):
+                        yield chunk
+                        return
+                    idx = chunk.get("index", delivered)
+                    if idx < delivered:
+                        continue  # replayed after a re-prefill: dedup
+                    delivered = idx + 1
+                    yield chunk
+                return  # stream ended without a done marker: complete
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if attempt + 1 >= attempts or not self._retryable(e, ctx) \
+                        or (delivered > 0
+                            and not self._stream_resumable(body)):
+                    raise
+                self._note_reprefill()
+                self._pre_retry()

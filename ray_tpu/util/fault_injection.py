@@ -52,6 +52,8 @@ site                        guards
 ``rl.weight_sync.publish``  between weight-payload put and version commit
 ``rl.rollout.sample``       the rollout actor's sample edge (RLHF loop)
 ``rl.reward.score``         the RLHF reward-scoring leg, before any mutation
+``llm.kv_ship``             every KV-handoff write on the prefill replica
+``llm.handoff``             the decode replica's wait-for-handoff edge
 ==========================  =================================================
 
 Two kinds are special:

@@ -186,17 +186,21 @@ class Box:
 def test_bounded_blocking_serve_get_fixtures(tmp_path):
     bad = "import ray_tpu\n\ndef f(ref):\n    return ray_tpu.get(ref)\n"
     # the deadline-required set: serve/ (the latency-critical control
-    # plane) AND rl/ (long-lived loops over killable rollout/learner
-    # actors — the RLHF-crucible rule)
+    # plane), rl/ (long-lived loops over killable rollout/learner
+    # actors — the RLHF-crucible rule), and llm/ (KV-handoff plane
+    # between killable prefill/decode replicas)
     r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": bad,
-                             "ray_tpu/rl/mod.py": bad},
+                             "ray_tpu/rl/mod.py": bad,
+                             "ray_tpu/llm/mod.py": bad},
                   rules=["bounded-blocking"])
-    assert rules_of(r) == ["bounded-blocking"] * 2, r.findings
+    assert rules_of(r) == ["bounded-blocking"] * 3, r.findings
     assert {f.path for f in r.findings} == \
-        {"ray_tpu/serve/mod.py", "ray_tpu/rl/mod.py"}
+        {"ray_tpu/serve/mod.py", "ray_tpu/rl/mod.py",
+         "ray_tpu/llm/mod.py"}
     # same code outside the deadline set is NOT the control plane
     r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": "",
                              "ray_tpu/rl/mod.py": "",
+                             "ray_tpu/llm/mod.py": "",
                              "ray_tpu/other.py": bad},
                   rules=["bounded-blocking"])
     assert not r.findings, r.findings
@@ -204,7 +208,34 @@ def test_bounded_blocking_serve_get_fixtures(tmp_path):
             "    return ray_tpu.get(ref, timeout=5)\n")
     r = lint_tree(tmp_path, {"ray_tpu/serve/mod.py": good,
                              "ray_tpu/rl/mod.py": good,
+                             "ray_tpu/llm/mod.py": good,
                              "ray_tpu/other.py": ""},
+                  rules=["bounded-blocking"])
+    assert not r.findings, r.findings
+
+
+def test_bounded_blocking_llm_channel_read_fixtures(tmp_path):
+    """llm/ is a deadline-required dir for channel reads too: a KV
+    landing loop whose prefill peer died must poll with a bound, never
+    park forever on a channel nobody will write."""
+    bad = """from ray_tpu.experimental.channel.transport import (
+    attach_edge_transport, make_edge_transport)
+
+def land(info):
+    tr = attach_edge_transport(info, 0)
+    return tr.read()          # TP: no deadline
+"""
+    r = lint_tree(tmp_path, {"ray_tpu/llm/mod.py": bad},
+                  rules=["bounded-blocking"])
+    assert rules_of(r) == ["bounded-blocking"], r.findings
+    good = """from ray_tpu.experimental.channel.transport import (
+    attach_edge_transport, make_edge_transport)
+
+def land(info):
+    tr = attach_edge_transport(info, 0)
+    return tr.read(timeout=0.25)   # TN: bounded poll
+"""
+    r = lint_tree(tmp_path, {"ray_tpu/llm/mod.py": good},
                   rules=["bounded-blocking"])
     assert not r.findings, r.findings
 

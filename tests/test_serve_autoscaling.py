@@ -1,0 +1,176 @@
+"""Signal-driven pool autoscaling (``serve/autoscaling.py`` + the
+controller wiring): per-pool targets move on the signals that
+distinguish disaggregated LLM pools — queue depth for prefill, slot
+occupancy / block pressure for decode — and scale back down after the
+load passes."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.autoscaling import (
+    PoolSignals,
+    autoscaling_config_from_dict,
+    desired_delta,
+    pool_signals_from_engine_records,
+)
+from ray_tpu.serve.deployment import AutoscalingConfig
+
+
+@pytest.fixture
+def serve_shutdown(ray_start):
+    yield
+    serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pure decision logic
+# ---------------------------------------------------------------------------
+
+
+def _apply(cfg, goal, sig):
+    goal += desired_delta(cfg, sig)
+    return max(cfg.min_replicas, min(goal, cfg.max_replicas))
+
+
+def test_overload_ramp_scales_pools_independently():
+    """The acceptance scenario: a synthetic overload ramp where queued
+    prompts pile on the prefill pool while decode slots saturate — each
+    pool's target moves on ITS signal, and both return to min after."""
+    prefill_cfg = AutoscalingConfig(
+        min_replicas=1, max_replicas=4, target_ongoing_requests=None,
+        target_queue_depth=4.0)
+    decode_cfg = AutoscalingConfig(
+        min_replicas=1, max_replicas=4, target_ongoing_requests=None,
+        target_slot_occupancy=0.85, target_block_pressure=0.9)
+
+    # ramp: tick -> (queued prompts, decode occupancy)
+    ramp = [(0, 0.1), (2, 0.3),            # idle-ish
+            (12, 0.95), (20, 0.97), (30, 0.99),   # overload
+            (1, 0.2), (0, 0.1), (0, 0.1)]          # drained
+    p_goal = d_goal = 1
+    p_trace, d_trace = [], []
+    for queued, occ in ramp:
+        p_goal = _apply(prefill_cfg, p_goal, PoolSignals(
+            replicas=p_goal, router_queued=queued))
+        d_goal = _apply(decode_cfg, d_goal, PoolSignals(
+            replicas=d_goal, slot_occupancy=occ, block_pressure=occ / 2))
+        p_trace.append(p_goal)
+        d_trace.append(d_goal)
+    # both pools grew during the ramp...
+    assert max(p_trace) >= 3, p_trace
+    assert max(d_trace) >= 3, d_trace
+    # ...and shrank back to min afterwards
+    assert p_trace[-1] == 1 and d_trace[-1] == 1, (p_trace, d_trace)
+
+    # independence: queue pressure alone moves ONLY the prefill pool,
+    # occupancy alone moves ONLY the decode pool
+    assert desired_delta(prefill_cfg, PoolSignals(
+        replicas=1, router_queued=20, slot_occupancy=0.1)) == 1
+    assert desired_delta(decode_cfg, PoolSignals(
+        replicas=1, router_queued=20, slot_occupancy=0.1)) == -1
+    assert desired_delta(decode_cfg, PoolSignals(
+        replicas=1, router_queued=0, slot_occupancy=0.99)) == 1
+    assert desired_delta(prefill_cfg, PoolSignals(
+        replicas=1, router_queued=0, slot_occupancy=0.99)) == -1
+
+
+def test_overload_events_trigger_upscale_and_veto_downscale():
+    cfg = AutoscalingConfig(target_ongoing_requests=2.0)
+    assert desired_delta(cfg, PoolSignals(
+        replicas=2, ongoing_avg=0.1, shed_delta=3)) == 1
+    assert desired_delta(cfg, PoolSignals(
+        replicas=2, ongoing_avg=0.1, expired_delta=1)) == 1
+    # disabled: back to pure ongoing-average behavior
+    quiet = AutoscalingConfig(target_ongoing_requests=2.0,
+                              upscale_on_overload=False)
+    assert desired_delta(quiet, PoolSignals(
+        replicas=2, ongoing_avg=0.1, shed_delta=3)) == 0
+
+
+def test_legacy_config_dict_and_behavior_preserved():
+    """Configs stored before the signal fields existed reconstruct and
+    keep the old ongoing-average semantics."""
+    cfg = autoscaling_config_from_dict({
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.5,
+        "downscale_delay_s": 10.0})
+    assert cfg.target_queue_depth is None
+    assert desired_delta(cfg, PoolSignals(replicas=2,
+                                          ongoing_avg=2.0)) == 1
+    assert desired_delta(cfg, PoolSignals(replicas=2,
+                                          ongoing_avg=0.4)) == -1
+    assert desired_delta(cfg, PoolSignals(replicas=2,
+                                          ongoing_avg=0.8)) == 0
+
+
+def test_engine_record_folding():
+    sig = pool_signals_from_engine_records(
+        [{"queued": 4, "adopt_queued": 2, "slot_occupancy": 1.0,
+          "block_pressure": 0.8},
+         {"queued": 0, "adopt_queued": 0, "slot_occupancy": 0.5,
+          "block_pressure": 0.2}],
+        replicas=2, router_queued=6)
+    assert sig.engine_queue_avg == 3.0
+    assert sig.slot_occupancy == 0.75
+    assert sig.block_pressure == 0.5
+    # no engine records -> engine signals stay None (never vote)
+    sig2 = pool_signals_from_engine_records([], replicas=2)
+    assert sig2.slot_occupancy is None
+    cfg = AutoscalingConfig(target_ongoing_requests=None,
+                            target_slot_occupancy=0.8)
+    assert desired_delta(cfg, sig2) == -1  # nothing enforced holds it up
+
+
+# ---------------------------------------------------------------------------
+# controller integration: engine records drive goal_replicas
+# ---------------------------------------------------------------------------
+
+
+def _publish_engine_record(deployment, replica, *, occupancy, queued=0,
+                           pressure=0.0):
+    from ray_tpu.experimental import internal_kv
+
+    rec = {"ts": time.time(), "deployment": deployment,
+           "replica": replica, "role": "decode",
+           "queued": queued, "adopt_queued": 0,
+           "slot_occupancy": occupancy, "block_pressure": pressure}
+    internal_kv._internal_kv_put(
+        f"engine/{deployment}/{replica}".encode(),
+        json.dumps(rec).encode(), namespace="llm")
+
+
+def test_controller_scales_on_engine_signals(serve_shutdown):
+    """End-to-end: published engine-stats records (slot occupancy) move
+    a deployment's goal up, then back down once the pressure clears —
+    no request traffic at all, engine signals alone."""
+
+    @serve.deployment(name="EngPool", autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": None,
+        "target_slot_occupancy": 0.8,
+        "upscale_delay_s": 0.2, "downscale_delay_s": 0.2})
+    class EngPool:
+        def __call__(self, _x):
+            return "ok"
+
+    serve.run(EngPool.bind())
+
+    def goal():
+        return serve.status()["EngPool"]["goal"]
+
+    deadline = time.time() + 30
+    while time.time() < deadline and goal() < 2:
+        _publish_engine_record("EngPool", "r1", occupancy=1.0)
+        time.sleep(0.3)
+    assert goal() >= 2, serve.status()
+
+    deadline = time.time() + 40
+    while time.time() < deadline and goal() > 1:
+        _publish_engine_record("EngPool", "r1", occupancy=0.05)
+        _publish_engine_record("EngPool", "r2", occupancy=0.05)
+        time.sleep(0.3)
+    assert goal() == 1, serve.status()
