@@ -122,7 +122,10 @@ def int8_gather_micro(steps=20):
 def multichip_sweep():
     """Sweep every ScalingConfig mesh preset over all visible devices
     through the trainer path (bench.run_multichip): one JSON line per
-    preset with the mesh it resolved to and MFU / tokens/s."""
+    preset with the mesh it resolved to, MFU / tokens/s, the per-preset
+    SPMD resharding-warning count and the step-time breakdown — the
+    sweep shows at a glance which mesh layouts are CLEAN, not just
+    which are fast."""
     import sys
 
     sys.path.insert(0, ".")
@@ -131,13 +134,22 @@ def multichip_sweep():
 
     for preset in sorted(MESH_PRESETS):
         rec = run_multichip(preset=preset)
+        d = rec["detail"]
+        bd = d.get("step_time_breakdown") or {}
         emit_record_line({
             "config": f"multichip_{preset}",
             "metric": rec["metric"], "value": rec["value"],
             "unit": rec["unit"],
-            "mesh": rec["detail"].get("mesh"),
-            "tokens_per_s": rec["detail"].get("tokens_per_s"),
-            "step_ms": rec["detail"].get("step_ms"),
+            "mesh": d.get("mesh"),
+            "tokens_per_s": d.get("tokens_per_s"),
+            "step_ms": d.get("step_ms"),
+            "xla_sharding_warnings": d.get("xla_sharding_warnings"),
+            "step_time_breakdown": {
+                "buckets_s": bd.get("buckets_s"),
+                "coverage": bd.get("coverage"),
+                "step_wall_s": bd.get("step_wall_s"),
+            } if bd and "error" not in bd else bd,
+            "sharding_ab": d.get("sharding_ab"),
         })
 
 

@@ -12,6 +12,7 @@ from ray_tpu._private.analysis.checkers import (  # noqa: F401
     lock_discipline,
     proxy_context,
     serial_blocking_get,
+    sharding_discipline,
     span_hygiene,
     test_hygiene,
     thread_lifecycle,

@@ -1,0 +1,94 @@
+"""sharding-discipline: model code shards by LOGICAL axis names only.
+
+The multichip bench spent five rounds paying silent full-layout round
+trips ("involuntary full rematerialization") on its hottest gather
+because an activation layout was pinned against the params' rule table
+instead of THROUGH it — two halves of one program disagreeing about
+where the model dim lives.  The repo-wide contract that prevents the
+class: ``ray_tpu/models/`` never names a device mesh axis.  Layouts are
+expressed as logical axis names ("batch", "embed", "heads", ...) and
+resolved through the rule table (``DEFAULT_RULES`` /
+``ShardedTrainer(rules=...)``) by the ``ray_tpu.parallel.sharding``
+helpers — ``with_logical_constraint`` / ``with_named_sharding`` for
+intermediates, ``logical_to_pspec`` / ``spec_tree_to_shardings`` for
+specs — so switching parallelism strategy stays a rule-table change and
+params + activations always move together.
+
+Flagged inside ``ray_tpu/models/``:
+
+- any call to ``with_sharding_constraint`` (bare or dotted): raw
+  constraints bypass the rule table — use ``with_logical_constraint``;
+- ``PartitionSpec(...)`` / ``P(...)`` literals naming an axis (any
+  string argument, directly or inside a tuple/list): device-axis
+  layouts hard-code one strategy.  ``P()`` / ``P(None)`` (explicit
+  replication, no axis named) stay legal — replicated scaffolding like
+  an optimizer's scalar-state sharding names no device axis.
+
+``NamedSharding`` built from such a literal is caught via the literal
+itself; ``NamedSharding(mesh, P())`` stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.analysis.core import (
+    Checker,
+    Finding,
+    ParsedFile,
+    dotted_name,
+    register,
+)
+
+_SPEC_NAMES = ("PartitionSpec", "P")
+
+
+def _names_an_axis(call: ast.Call) -> bool:
+    """True when the P(...) literal names at least one axis (a string
+    constant anywhere in its positional args)."""
+    for a in call.args:
+        for node in ast.walk(a):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                return True
+    return False
+
+
+@register
+class ShardingDisciplineChecker(Checker):
+    rule = "sharding-discipline"
+    description = ("models/ must shard via logical-axis rules "
+                   "(parallel.sharding helpers), never raw "
+                   "with_sharding_constraint calls or device-axis "
+                   "PartitionSpec literals")
+    hint = ("express the layout as logical axis names and resolve it "
+            "through the rule table: with_logical_constraint(x, mesh, "
+            "\"batch\", \"seq\", rules=rules) for intermediates, "
+            "logical_to_pspec / spec_tree_to_shardings for specs "
+            "(ray_tpu/parallel/sharding.py)")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("ray_tpu/models/")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if pf.tree is None:
+            return out
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            last = dotted_name(node.func).split(".")[-1]
+            if last == "with_sharding_constraint":
+                out.append(self.finding(
+                    pf, node,
+                    "raw with_sharding_constraint in model code bypasses "
+                    "the logical-axis rule table — params and activations "
+                    "can disagree about a dim's mesh axis, which XLA "
+                    "patches with involuntary full rematerializations"))
+            elif last in _SPEC_NAMES and _names_an_axis(node):
+                out.append(self.finding(
+                    pf, node,
+                    "device-axis PartitionSpec literal in model code "
+                    "hard-codes one parallelism strategy — derive the "
+                    "spec from logical axis names via the rule table"))
+        return out

@@ -172,15 +172,40 @@ def llama_param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     return specs
 
 
-def _constrain(x, mesh, *axes):
+def _constrain(x, mesh, *axes, rules=None):
     if mesh is None:
         return x
-    from ray_tpu.parallel.sharding import with_named_sharding
+    from ray_tpu.parallel.sharding import with_logical_constraint
 
-    return with_named_sharding(x, mesh, *axes)
+    return with_logical_constraint(x, mesh, *axes, rules=rules)
 
 
-def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
+def _embed_lookup(params, tokens, cfg: LlamaConfig, *, mesh, rules=None):
+    """Embedding gather under layout discipline.
+
+    The gather's OPERANDS are pinned before the gather itself: the
+    table keeps its vocab sharding but replicates the model dim (the
+    FSDP all-gather every weight pays for compute anyway), and the
+    token indices carry the batch/seq layout.  The gather output then
+    *is* the canonical activation layout — without the operand pins,
+    XLA propagates the table's model-dim sharding into the output and
+    the very next activation constraint forces an involuntary full
+    rematerialization (the multichip bench's per-round warning tail).
+    ``RAY_TPU_LEGACY_SHARDING=1`` restores the unpinned legacy gather
+    for the fixed-vs-legacy bench A/B.
+    """
+    from ray_tpu.parallel.sharding import legacy_sharding_enabled
+
+    if mesh is None or legacy_sharding_enabled():
+        x = params["embed"][tokens].astype(cfg.dtype)
+        return _constrain(x, mesh, "batch", "seq", None, rules=rules)
+    table = _constrain(params["embed"], mesh, "vocab", None, rules=rules)
+    toks = _constrain(tokens, mesh, "batch", "seq", rules=rules)
+    x = table[toks].astype(cfg.dtype)
+    return _constrain(x, mesh, "batch", "seq", None, rules=rules)
+
+
+def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh, rules=None):
     b, s, h = x.shape
     hd = cfg.resolved_head_dim
     dt = cfg.dtype
@@ -197,7 +222,7 @@ def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
     v = v.reshape(b, s, cfg.num_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    q = _constrain(q, mesh, "batch", "seq", "heads", None)
+    q = _constrain(q, mesh, "batch", "seq", "heads", None, rules=rules)
     attn = dot_product_attention(
         q, k, v, causal=True, impl=cfg.attention_impl, mesh=mesh,
         window=cfg.sliding_window
@@ -206,7 +231,7 @@ def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
     attn = attn.reshape(b, s, cfg.num_heads * hd)
     x = x + jnp.einsum("bsq,qh->bsh", attn, lp["wo"].astype(dt),
                        preferred_element_type=jnp.float32).astype(dt)
-    x = _constrain(x, mesh, "batch", "seq", None)
+    x = _constrain(x, mesh, "batch", "seq", None, rules=rules)
     # MLP block.
     y = rms_norm(x, lp["mlp_norm"])
     gate = jnp.einsum("bsh,hm->bsm", y, lp["w_gate"].astype(dt),
@@ -216,7 +241,7 @@ def _decoder_layer(x, lp, *, cfg: LlamaConfig, cos, sin, mesh):
     act = checkpoint_name(swiglu(gate, up), "mlp_act")
     x = x + jnp.einsum("bsm,mh->bsh", act, lp["w_down"].astype(dt),
                        preferred_element_type=jnp.float32).astype(dt)
-    return _constrain(x, mesh, "batch", "seq", None)
+    return _constrain(x, mesh, "batch", "seq", None, rules=rules)
 
 
 def llama_apply(
@@ -225,15 +250,21 @@ def llama_apply(
     cfg: LlamaConfig,
     *,
     mesh=None,
+    rules=None,
 ) -> jnp.ndarray:
-    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] (fp32)."""
+    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] (fp32).
+
+    ``rules`` is the logical-axis rule table the surrounding trainer
+    shards params with (None = ``DEFAULT_RULES``): activations are
+    constrained through the SAME table, so layouts stay consistent end
+    to end — the named-sharding discipline.
+    """
     s = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.resolved_head_dim, s, cfg.rope_theta)
-    x = params["embed"][tokens].astype(cfg.dtype)
-    x = _constrain(x, mesh, "batch", "seq", None)
+    x = _embed_lookup(params, tokens, cfg, mesh=mesh, rules=rules)
 
     layer_fn = functools.partial(_decoder_layer, cfg=cfg, cos=cos, sin=sin,
-                                 mesh=mesh)
+                                 mesh=mesh, rules=rules)
     if cfg.remat:
         if cfg.remat_policy == "save_attn":
             # Also save the flash kernel's residuals (output + lse) so the
@@ -268,11 +299,13 @@ def llama_apply(
 
     n_stages = pp_size(mesh)
     if n_stages > 1:
-        # Pipeline path: layers are stage-sharded over "pp"; the microbatch
-        # ppermute schedule runs in a partial-manual shard_map.  Sharding
-        # constraints and ring attention do their own (nested) mesh
-        # manipulation, so inside a stage we drop constraints and use an
-        # attention impl GSPMD can partition over the remaining auto axes.
+        # Pipeline path: layers are stage-sharded over "pp"; the
+        # microbatch rotate schedule runs in plain GSPMD over a
+        # stage-dim-sharded buffer (parallel/pipeline.py).  Per-stage
+        # compute carries a leading stage dim under a vmap, which the
+        # rank-sensitive constraints and attention impls don't expect,
+        # so inside a stage we drop constraints and use an attention
+        # impl GSPMD can partition over the remaining axes.
         if not cfg.scan_layers:
             raise ValueError("pp>1 requires scan_layers=True (stacked params)")
         from ray_tpu.parallel.pipeline import pipeline_apply
@@ -281,13 +314,13 @@ def llama_apply(
             raise ValueError(
                 f"attention_impl={cfg.attention_impl!r} is incompatible "
                 "with pp>1: ring needs its own (nested) shard_map and "
-                "pallas flash can't be auto-partitioned inside the "
-                "pipeline's partial-manual region; use 'auto' or 'ref'"
+                "pallas flash can't be auto-partitioned under the "
+                "pipeline's vmapped stage dim; use 'auto' or 'ref'"
             )
         stage_cfg = dataclasses.replace(cfg, attention_impl="ref")
         stage_fn = functools.partial(
             _decoder_layer, cfg=stage_cfg, cos=cos, sin=sin, mesh=None
-        )
+        )  # mesh=None: no rank-3 constraints under the vmapped stage dim
         if cfg.remat:
             stage_fn = jax.checkpoint(stage_fn, policy=policy)
         x = pipeline_apply(
@@ -309,7 +342,7 @@ def llama_apply(
     ).astype(cfg.dtype)
     logits = jnp.einsum("bsh,hv->bsv", x, head,
                         preferred_element_type=jnp.float32)
-    return _constrain(logits, mesh, "batch", "seq", None)
+    return _constrain(logits, mesh, "batch", "seq", None, rules=rules)
 
 
 def llama_loss(
@@ -318,11 +351,12 @@ def llama_loss(
     cfg: LlamaConfig,
     *,
     mesh=None,
+    rules=None,
 ) -> jnp.ndarray:
     """Next-token cross-entropy; batch has 'tokens' [b,s] and optional
     'mask' [b,s] (1 = contribute to loss)."""
     tokens = batch["tokens"]
-    logits = llama_apply(params, tokens[:, :-1], cfg, mesh=mesh)
+    logits = llama_apply(params, tokens[:, :-1], cfg, mesh=mesh, rules=rules)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

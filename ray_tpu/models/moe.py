@@ -180,11 +180,15 @@ def moe_block(x: jnp.ndarray, lp: Dict[str, jnp.ndarray], cfg: MoEConfig
 
 
 def moe_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: MoEConfig,
-              *, mesh=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+              *, mesh=None, rules=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Forward: tokens [b,s] -> (logits [b,s,V] fp32, total router aux)."""
+    from ray_tpu.models.llama import _embed_lookup
+
     s = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.resolved_head_dim, s, cfg.rope_theta)
-    x = params["embed"][tokens].astype(cfg.dtype)
+    # same gather-operand discipline as llama (the warning class is
+    # identical: table model-dim sharding leaking into the activations)
+    x = _embed_lookup(params, tokens, cfg, mesh=mesh, rules=rules)
     hd = cfg.resolved_head_dim
 
     def layer_fn(x, lp):
@@ -225,11 +229,11 @@ def moe_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: MoEConfig,
 
 
 def moe_loss(params: Dict[str, Any], batch: Dict[str, jnp.ndarray],
-             cfg: MoEConfig, *, mesh=None) -> jnp.ndarray:
+             cfg: MoEConfig, *, mesh=None, rules=None) -> jnp.ndarray:
     """Next-token cross entropy + router load-balance aux."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = moe_apply(params, inputs, cfg, mesh=mesh)
+    logits, aux = moe_apply(params, inputs, cfg, mesh=mesh, rules=rules)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean() + cfg.router_aux_coef * aux
@@ -243,7 +247,7 @@ def make_moe_trainer(cfg: MoEConfig, mesh, *, optimizer=None, rules=None):
     rules = reject_pp(mesh, "MoE", rules)
     return ShardedTrainer(
         init_fn=lambda key: moe_init(key, cfg),
-        loss_fn=functools.partial(moe_loss, cfg=cfg, mesh=mesh),
+        loss_fn=functools.partial(moe_loss, cfg=cfg, mesh=mesh, rules=rules),
         param_specs=moe_param_specs(cfg),
         mesh=mesh,
         optimizer=optimizer or default_optimizer(),

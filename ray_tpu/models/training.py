@@ -83,6 +83,7 @@ class ShardedTrainer:
         rules: Optional[LogicalAxisRules] = None,
         batch_spec: Optional[Any] = None,
         accum_steps: int = 1,
+        donate_batch: bool = False,
     ):
         self.mesh = mesh
         self.rules = rules or DEFAULT_RULES
@@ -112,9 +113,9 @@ class ShardedTrainer:
             "step": replicated,
         }
         if batch_spec is None:
-            batch_spec = P(
-                tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-            )
+            # derived through the rule table (not a device-axis literal)
+            # so a rules override moves the batch layout with the params
+            batch_spec = logical_to_pspec(("batch",), self.rules, mesh=mesh)
         # batch_spec may be one PartitionSpec (applied to every leaf) or a
         # pytree of them matching the batch structure.
         self.batch_sharding = jax.tree.map(
@@ -126,9 +127,15 @@ class ShardedTrainer:
         self._jit_init = jax.jit(
             self._state_init, out_shardings=self.state_shardings
         )
+        # State (params + opt state) is always donated: the update runs
+        # in place in HBM, so the parameter copy never serializes the
+        # step tail behind the gradient collectives.  ``donate_batch``
+        # additionally donates the input buffers — opt-IN because many
+        # callers (benches, the H2D stager's reused staging arrays)
+        # legitimately feed the same batch buffers to every step.
         self._jit_step = jax.jit(
             self._train_step,
-            donate_argnums=(0,),
+            donate_argnums=(0, 1) if donate_batch else (0,),
             out_shardings=(self.state_shardings, replicated),
         )
 
@@ -230,12 +237,15 @@ def make_llama_trainer(
     # Batch leaves (tokens, optional mask — both [b, s]) are sharded over
     # batch only: the raw token length (s) differs from the activation
     # length (s-1 after the shift), so sp-sharding happens via activation
-    # constraints inside the program.  A single spec applies to all leaves.
-    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-    batch_spec = P(batch_axes)
+    # constraints inside the program.  A single spec applies to all
+    # leaves; it is derived from the same rule table the loss constrains
+    # activations with ("batch" consumes only the mesh's data axes).
+    batch_spec = logical_to_pspec(("batch",), rules, mesh=mesh)
     return ShardedTrainer(
         functools.partial(llama_init, cfg=cfg),
-        functools.partial(llama_loss, cfg=cfg, mesh=mesh),
+        # the rule table reaches the loss too: params AND activations
+        # shard from one table, the named-sharding discipline
+        functools.partial(llama_loss, cfg=cfg, mesh=mesh, rules=rules),
         llama_param_specs(cfg),
         mesh=mesh,
         optimizer=optimizer,

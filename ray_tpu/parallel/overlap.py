@@ -1,0 +1,128 @@
+"""Collective/compute overlap: stop serializing the sharded step.
+
+With FSDP/TP shardings, every step issues weight all-gathers, gradient
+reduce-scatters and activation collective-permutes.  Left to the
+default scheduler they run back-to-back with the matmuls they feed —
+the step pays ``compute + collectives`` instead of
+``max(compute, collectives)``.  Two levers close the gap, both applied
+*before* backend init (the TPU runtime reads its flags once):
+
+- **async collectives**: all-gather / all-reduce / collective-permute
+  start early and complete at their first use instead of blocking at
+  issue;
+- **latency-hiding scheduler**: XLA reorders independent compute
+  between a collective's start and done, which is what actually hides
+  the wire time.
+
+Donation is the second half of the same story:
+``ShardedTrainer._jit_step`` donates the state (params + opt state)
+buffers, so the updated tree reuses the old tree's HBM and the
+optimizer update can run in place while gradient collectives for later
+layers are still in flight — no double-buffered parameter copy
+serializing the step tail.
+
+Mechanics and safety:
+
+- the flags ride ``LIBTPU_INIT_ARGS`` (libtpu's own flag channel),
+  NEVER ``XLA_FLAGS`` — measured on this container's jaxlib, XLA's
+  ``parse_flags_from_env`` treats every one of these TPU-runtime flags
+  as unknown and ABORTS the process at backend init;
+- arming is **opt-in** (``RAY_TPU_COLLECTIVE_OVERLAP=1``) and further
+  gated on the process provably heading for a TPU backend.  A libtpu
+  generation that rejects one of these flags would zero the whole
+  bench round at init, and the current TPU rounds are single-chip
+  (no collectives to overlap) — so the default stays inert until a
+  multichip TPU round can validate the set (ROADMAP item 2 names
+  this exact follow-up).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+#: flags appended to ``LIBTPU_INIT_ARGS`` when overlap is armed — the
+#: production set TPU training stacks ship for async-collective overlap
+OVERLAP_TPU_FLAGS: Tuple[str, ...] = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+)
+
+ENV_OPT_IN = "RAY_TPU_COLLECTIVE_OVERLAP"
+
+
+def overlap_requested(env: Optional[dict] = None) -> bool:
+    env = os.environ if env is None else env
+    return env.get(ENV_OPT_IN, "").strip().lower() in ("1", "true", "yes")
+
+
+def _expects_tpu(env) -> bool:
+    """Deliberately CONSERVATIVE, unlike bench's same-named probe: a
+    wrong True here injects TPU-runtime flags a non-TPU process can
+    only be hurt by (bench's probe merely tunes an error
+    classification, so it can afford the looser jax_plugins namespace
+    check — a GPU plugin lives in that namespace too).  Arm only when
+    ``JAX_PLATFORMS`` names tpu or the TPU-specific libtpu package is
+    importable."""
+    plats = env.get("JAX_PLATFORMS", "")
+    if plats:
+        return "tpu" in plats.lower()
+    try:
+        import importlib.util
+
+        return importlib.util.find_spec("libtpu") is not None
+    except Exception:  # noqa: BLE001 — probe only
+        return False
+
+
+def _flag_states(current: str) -> dict:
+    """``LIBTPU_INIT_ARGS`` tokens -> {flag_name: enabled}.  Name-exact
+    (token-split, not substring: ``..._fusion`` is a prefix of
+    ``..._fusion_fuse_all_gather``); a bare ``--flag`` counts as
+    enabled, an explicit ``=false``/``=0`` as disabled."""
+    states = {}
+    for tok in current.split():
+        name, eq, val = tok.partition("=")
+        states[name] = (not eq) or val.strip().lower() not in (
+            "false", "0", "no")
+    return states
+
+
+def ensure_collective_overlap(env: Optional[dict] = None) -> bool:
+    """Append the overlap flags to ``LIBTPU_INIT_ARGS`` when the
+    operator opted in (``RAY_TPU_COLLECTIVE_OVERLAP=1``) and this
+    process is headed for a TPU backend.
+
+    Must run BEFORE the first ``jax.devices()`` call (the TPU runtime
+    snapshots its flags at init).  Idempotent: flags already present
+    are not duplicated, and a flag the operator explicitly set
+    (``=false`` included) is never overridden.  Returns True when the
+    overlap set is active in the environment after the call — the
+    bench records this so a round's scheduling mode is visible in its
+    record.
+    """
+    env = os.environ if env is None else env
+    if not overlap_requested(env):
+        return overlap_active(env)
+    if not _expects_tpu(env):
+        return False
+    current = env.get("LIBTPU_INIT_ARGS", "")
+    states = _flag_states(current)
+    missing = [f for f in OVERLAP_TPU_FLAGS
+               if f.split("=", 1)[0] not in states]
+    if missing:
+        env["LIBTPU_INIT_ARGS"] = (
+            current + " " + " ".join(missing)).strip()
+    return overlap_active(env)
+
+
+def overlap_active(env: Optional[dict] = None) -> bool:
+    """True when every overlap flag is present AND enabled in
+    ``LIBTPU_INIT_ARGS`` (however it got there — this helper, or the
+    operator's own env)."""
+    env = os.environ if env is None else env
+    states = _flag_states(env.get("LIBTPU_INIT_ARGS", ""))
+    return all(states.get(f.split("=", 1)[0]) for f in OVERLAP_TPU_FLAGS)

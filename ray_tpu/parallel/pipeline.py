@@ -9,15 +9,26 @@ axis like dp/fsdp/tp/sp, implemented the TPU way:
 
 - layer-stacked params are sharded over ``pp`` (each stage holds
   ``L / pp_size`` contiguous layers);
-- the microbatch schedule is a ``lax.scan`` of compute+``ppermute`` ticks
-  inside a *partial-manual* ``shard_map`` — only ``pp`` is manual, the
-  other axes stay auto so GSPMD keeps inserting the dp/fsdp/tp collectives
-  from sharding annotations;
-- reverse-mode AD transposes the ``ppermute`` ring, so the backward pass is
-  the mirrored pipeline schedule for free.  With per-layer remat the live
-  state per stage is one microbatch activation + the output buffer, which
-  is the 1F1B memory profile (activations for at most the in-flight
-  microbatches, not all of them).
+- the microbatch schedule is a ``lax.scan`` of compute+rotate ticks in
+  PLAIN GSPMD: per-stage activation buffers ride a leading stage dim
+  sharded over ``pp``, the per-tick stage compute is a ``vmap`` over
+  that dim (each pp shard runs its own stage), and the inter-stage hop
+  is ``jnp.roll`` on the sharded dim — which XLA lowers to exactly the
+  ``collective-permute`` ring a manual ``ppermute`` would issue.  No
+  ``shard_map`` at all: earlier revisions ran the schedule in a
+  partial-manual ``shard_map`` (``pp`` manual, the rest auto), but
+  mixing manual and auto subgroups is unreliable across jax/XLA
+  versions — 0.4.x rejects the region's ``axis_index`` with
+  "UNIMPLEMENTED: PartitionId" at execution and hard-aborts
+  (``IsManualSubgroup`` check) on scalar bridges between the manual
+  and auto halves.  Sharding annotations alone express the same
+  program portably, and dp/fsdp/tp stay auto-partitioned inside each
+  stage for free;
+- reverse-mode AD transposes the roll (a roll the other way), so the
+  backward pass is the mirrored pipeline schedule for free.  With
+  per-layer remat the live state per stage is one microbatch activation
+  + the output buffer, which is the 1F1B memory profile (activations
+  for at most the in-flight microbatches, not all of them).
 
 Bubble fraction is ``(S-1) / (M + S - 1)`` for S stages and M microbatches;
 raise ``num_microbatches`` to amortize.
@@ -74,7 +85,19 @@ def pipeline_apply(
     if n_layers % S != 0:
         raise ValueError(f"{n_layers} layers not divisible by {S} stages")
 
+    def _pp_constrain(v):
+        # leading stage dim over `axis`, everything else auto (GSPMD
+        # keeps partitioning the dp/fsdp/tp dims inside each stage)
+        return jax.lax.with_sharding_constraint(
+            v, jax.sharding.NamedSharding(mesh, P(axis)))
+
     micro = x.reshape((M, b // M) + x.shape[1:])
+    # [L, ...] -> [S, L/S, ...]: stage s owns the contiguous layer block
+    # [s*L/S, (s+1)*L/S), stage dim sharded over `axis`.
+    staged_params = jax.tree.map(
+        lambda p: _pp_constrain(
+            p.reshape((S, n_layers // S) + p.shape[1:])),
+        stacked_params)
 
     def stage_body(state, layers_shard):
         def body(carry, lp):
@@ -82,78 +105,36 @@ def pipeline_apply(
         out, _ = jax.lax.scan(body, state, layers_shard)
         return out
 
-    def pipelined(layers_shard, micro):
-        stage = jax.lax.axis_index(axis)
-        state = jnp.zeros_like(micro[0])
-        outputs = jnp.zeros_like(micro)
+    # buf[i] = the activation currently sitting at stage i.
+    buf = jnp.zeros((S,) + micro.shape[1:], micro.dtype)
+    outputs = jnp.zeros_like(micro)
 
-        def tick(carry, t):
-            state, outputs = carry
-            # Stage 0 ingests microbatch t (clamped; masked off past M).
-            inp = jax.lax.dynamic_index_in_dim(
-                micro, jnp.minimum(t, M - 1), axis=0, keepdims=False
-            )
-            state = jnp.where(stage == 0, inp, state)
-            state = stage_body(state, layers_shard)
-            # Last stage emits microbatch t-(S-1) once the fill completes.
-            out_idx = t - (S - 1)
-            emit = (stage == S - 1) & (out_idx >= 0)
-            emitted = jax.lax.dynamic_update_index_in_dim(
-                outputs, state, jnp.maximum(out_idx, 0), axis=0
-            )
-            outputs = jnp.where(emit, emitted, outputs)
-            # Rotate activations one stage down the ring.
-            state = jax.lax.ppermute(
-                state, axis, [(i, (i + 1) % S) for i in range(S)]
-            )
-            return (state, outputs), None
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 ingests microbatch t (clamped; masked off past M).
+        inp = jax.lax.dynamic_index_in_dim(
+            micro, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        buf = jax.lax.dynamic_update_index_in_dim(buf, inp, 0, axis=0)
+        # One tick of every stage: vmap over the sharded stage dim puts
+        # each stage's layer scan on its own pp shard.
+        buf = _pp_constrain(buf)
+        buf = jax.vmap(stage_body)(buf, staged_params)
+        buf = _pp_constrain(buf)
+        # Last stage emits microbatch t-(S-1) once the fill completes.
+        out_idx = t - (S - 1)
+        emitted = jax.lax.dynamic_index_in_dim(
+            buf, S - 1, axis=0, keepdims=False)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, emitted, jnp.maximum(out_idx, 0), axis=0)
+        outputs = jnp.where(out_idx >= 0, updated, outputs)
+        # Rotate activations one stage down the ring (roll on the
+        # pp-sharded dim == XLA collective-permute).
+        buf = _pp_constrain(jnp.roll(buf, 1, axis=0))
+        return (buf, outputs), None
 
-        (state, outputs), _ = jax.lax.scan(
-            tick, (state, outputs), jnp.arange(M + S - 1)
-        )
-        # Only the last stage holds real outputs; psum replicates them
-        # across the pp ring (zeros elsewhere) so out_specs can be P().
-        outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
-        return jax.lax.psum(outputs, axis)
-
-    shard_spec = jax.tree.map(lambda _: P(axis), stacked_params)
-    # Partial-manual shard_map: only `axis` manual, rest auto.  Modern
-    # jax spells that `jax.shard_map(..., axis_names={axis},
-    # check_vma=False)`; on older jax (< 0.6) the same program is the
-    # legacy `jax.experimental.shard_map.shard_map(..., auto=<the other
-    # mesh axes>, check_rep=False)`.  Try modern first, fall back, and
-    # only fail — with a clear version message — when neither spelling
-    # exists.
-    try:
-        mapped = jax.shard_map(
-            pipelined,
-            mesh=mesh,
-            in_specs=(shard_spec, P()),
-            out_specs=P(),
-            axis_names={axis},
-            check_vma=False,
-        )
-    except (AttributeError, TypeError):
-        try:
-            from jax.experimental.shard_map import shard_map as _legacy
-
-            mapped = _legacy(
-                pipelined,
-                mesh=mesh,
-                in_specs=(shard_spec, P()),
-                out_specs=P(),
-                check_rep=False,
-                auto=frozenset(n for n in mesh.axis_names if n != axis),
-            )
-        except (ImportError, AttributeError, TypeError) as e:
-            raise RuntimeError(
-                "pipeline parallelism needs a shard_map with "
-                "partial-manual axis support (jax.shard_map axis_names= "
-                "on jax >= 0.6, or jax.experimental.shard_map auto= on "
-                "0.4.x); this jax has neither"
-            ) from e
-    out = mapped(stacked_params, micro)
-    return out.reshape(x.shape)
+    (buf, outputs), _ = jax.lax.scan(
+        tick, (buf, outputs), jnp.arange(M + S - 1))
+    return outputs.reshape(x.shape)
 
 
 def pipeline_microbatches(cfg_microbatches: Optional[int], mesh: Mesh,

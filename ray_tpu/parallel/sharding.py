@@ -11,10 +11,25 @@ with one declarative mechanism.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: A/B escape hatch for the multichip layout-discipline bench: ``1``
+#: restores the pre-discipline constraint set (no gather-operand
+#: constraints, DEFAULT_RULES-only ``_constrain``) so a round can
+#: measure fixed-vs-legacy on identical hardware.  Read at TRACE time —
+#: set it before the trainer's first step, not mid-run.
+ENV_LEGACY_SHARDING = "RAY_TPU_LEGACY_SHARDING"
+
+
+def legacy_sharding_enabled() -> bool:
+    """True when the legacy (pre-layout-discipline) constraint set is
+    requested via :data:`ENV_LEGACY_SHARDING`."""
+    return os.environ.get(ENV_LEGACY_SHARDING, "").strip().lower() in (
+        "1", "true", "yes")
 
 # A logical axis maps to one mesh axis, a tuple of mesh axes, or None
 # (replicated).
@@ -112,8 +127,33 @@ def shard_tree(
     return jax.tree.map(jax.device_put, tree, shardings)
 
 
-def with_named_sharding(x: jax.Array, mesh: Mesh, *axes: Optional[str]) -> Any:
-    """Constrain an intermediate value's sharding inside jit."""
+def with_logical_constraint(
+    x: jax.Array,
+    mesh: Optional[Mesh],
+    *axes: Optional[str],
+    rules: Optional[LogicalAxisRules] = None,
+) -> Any:
+    """Constrain an intermediate value's sharding inside jit, by
+    LOGICAL axis names resolved through the rule table.
+
+    This is the one sanctioned way for model code to pin a layout: the
+    same rule table that shards the params decides the activation
+    layout, so a rules override (``ScalingConfig.logical_axis_rules``,
+    ``ShardedTrainer(rules=...)``) moves params *and* activations
+    together — mismatched halves are exactly what XLA's involuntary
+    full rematerializations punished.  ``mesh=None`` is a no-op so
+    model code stays mesh-optional.  The raylint ``sharding-discipline``
+    rule rejects raw device-axis ``PartitionSpec`` literals in
+    ``models/`` in favor of this helper.
+    """
+    if mesh is None:
+        return x
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, logical_to_pspec(axes, mesh=mesh))
+        x, NamedSharding(mesh, logical_to_pspec(axes, rules, mesh=mesh))
     )
+
+
+def with_named_sharding(x: jax.Array, mesh: Mesh, *axes: Optional[str]) -> Any:
+    """Back-compat alias: :func:`with_logical_constraint` under
+    :data:`DEFAULT_RULES` (no rule-table override)."""
+    return with_logical_constraint(x, mesh, *axes)

@@ -1,0 +1,151 @@
+"""XLA SPMD resharding-warning capture: make layout bugs *countable*.
+
+XLA's SPMD partitioner reports inefficient sharding transitions — the
+"involuntary full rematerialization" / "SPMD will replicate the tensor"
+messages — from C++ directly onto **file descriptor 2**, bypassing
+``sys.stderr`` entirely.  Python-level redirection
+(``contextlib.redirect_stderr``) never sees them, which is how the
+multichip bench shipped five rounds of silent full-layout round trips on
+its hottest gather: the warnings scrolled past in the tail text and no
+record field ever counted them.
+
+:func:`capture_stderr_fd` dup2-swaps fd 2 onto a temp file for the
+scope of a compile, restores it, and **re-emits the captured bytes** to
+the real stderr afterwards — nothing is swallowed, it just becomes
+readable to the process that produced it.  :func:`count_sharding_warnings`
+turns the captured text into the ``xla_sharding_warnings`` number the
+bench records and the golden-sharding guard test gate on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import tempfile
+from typing import Dict, Iterator, List
+
+#: substrings that mark one SPMD layout-transition warning line.  Two
+#: classes exist in practice: the partitioner's "involuntary full
+#: rematerialization" (it copied the whole tensor through a fresh
+#: layout) and the "last resort" replicate-then-repartition fallback on
+#: a sharding_constraint it could not honor efficiently.
+SHARDING_WARNING_MARKERS = (
+    "Involuntary full rematerialization",
+    "SPMD will replicate the tensor",
+)
+
+#: while a capture is live, names the on-disk file fd 2 is redirected
+#: into — the post-mortem pointer for a hard crash inside the scope
+_ENV_CAPTURE_PATH = "RAY_TPU_FD2_CAPTURE_PATH"
+
+_capture_seq = 0
+
+
+def count_sharding_warnings(text: str) -> int:
+    """Number of SPMD layout-transition warning LINES in ``text`` (a
+    line matching several markers still counts once)."""
+    return sum(
+        1 for line in text.splitlines()
+        if any(m in line for m in SHARDING_WARNING_MARKERS))
+
+
+def sharding_warning_lines(text: str) -> List[str]:
+    return [line for line in text.splitlines()
+            if any(m in line for m in SHARDING_WARNING_MARKERS)]
+
+
+@contextlib.contextmanager
+def capture_stderr_fd(replay: bool = True) -> Iterator[Dict[str, str]]:
+    """Capture everything written to fd 2 (C++ included) in the scope.
+
+    Yields a dict that gains ``"text"`` (the captured bytes, decoded
+    with replacement) when the scope exits.  With ``replay=True`` the
+    captured bytes are written back to the original stderr on exit, so
+    wrapping a compile in this capture never hides its diagnostics —
+    it only makes them *also* available to the caller.
+
+    Nesting is safe (each level saves its own duplicate of the current
+    fd 2).  If fd plumbing fails (no fd 2 — some embedded interpreters),
+    the scope degrades to a no-op capture with ``"text": ""``.
+
+    Crash safety: the capture file is NAMED
+    (``<tmpdir>/ray_tpu_fd2_capture_<pid>_<n>.log``, also exported via
+    ``RAY_TPU_FD2_CAPTURE_PATH`` while a capture is live) and deleted
+    only on orderly exit — a hard abort mid-scope (XLA check failure,
+    SIGABRT) leaves its final words on disk at that path instead of in
+    an unlinked anonymous file nobody can read post-mortem.
+    """
+    out: Dict[str, str] = {}
+    try:
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001 — a closed stderr must not break capture
+        pass
+    try:
+        saved_fd = os.dup(2)
+    except OSError:
+        out["text"] = ""
+        yield out
+        return
+    global _capture_seq
+    _capture_seq += 1
+    path = os.path.join(
+        tempfile.gettempdir(),
+        f"ray_tpu_fd2_capture_{os.getpid()}_{_capture_seq}.log")
+    try:
+        tmp = open(path, "w+b")
+    except OSError:
+        # unwritable/full tmpdir: same degrade-to-no-op contract as a
+        # missing fd 2 — a bench round must never die over diagnostics
+        os.close(saved_fd)
+        out["text"] = ""
+        yield out
+        return
+    prev_path = os.environ.get(_ENV_CAPTURE_PATH)
+    os.environ[_ENV_CAPTURE_PATH] = path
+    try:
+        os.dup2(tmp.fileno(), 2)
+        try:
+            yield out
+        finally:
+            try:
+                sys.stderr.flush()
+            except Exception:  # noqa: BLE001
+                pass
+            os.dup2(saved_fd, 2)
+            tmp.seek(0)
+            data = tmp.read()
+            out["text"] = data.decode("utf-8", errors="replace")
+            if replay and data:
+                try:
+                    os.write(saved_fd, data)
+                except OSError:
+                    pass
+    finally:
+        os.close(saved_fd)
+        tmp.close()
+        try:
+            os.unlink(path)  # orderly exit: bytes are replayed/returned
+        except OSError:
+            pass
+        if prev_path is None:
+            os.environ.pop(_ENV_CAPTURE_PATH, None)
+        else:
+            os.environ[_ENV_CAPTURE_PATH] = prev_path
+
+
+@contextlib.contextmanager
+def sharding_warning_capture(replay: bool = True) -> Iterator[Dict]:
+    """Count SPMD resharding warnings emitted inside the scope.
+
+    Yields a dict that gains ``"count"`` and ``"lines"`` on exit::
+
+        with sharding_warning_capture() as w:
+            trainer.compile(state, batch)
+        record["xla_sharding_warnings"] = w["count"]
+    """
+    with capture_stderr_fd(replay=replay) as cap:
+        out = cap
+        yield out
+    out["count"] = count_sharding_warnings(out.get("text", ""))
+    out["lines"] = sharding_warning_lines(out.get("text", ""))

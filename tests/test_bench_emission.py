@@ -135,3 +135,6 @@ def test_dryrun_success_emits_parsed_metric_last():
                              "llama_train_multichip_tokens_per_s")
     assert isinstance(rec["value"], (int, float))
     assert rec["value"] > 0, rec
+    # layout discipline holds on the trainer path end to end: the
+    # record COUNTS the SPMD resharding warnings and there are none
+    assert rec["detail"]["xla_sharding_warnings"] == 0, rec["detail"]

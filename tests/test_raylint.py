@@ -478,6 +478,52 @@ def _kill_worker(pid):
     assert not r.findings, r.findings
 
 
+def test_sharding_discipline_fixtures(tmp_path):
+    bad = """import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def decoder_layer(x, mesh):
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("dp", None, "tp")))
+    spec = P(("dp", "fsdp"))
+    return x, spec
+"""
+    # two findings: the raw constraint call AND the device-axis literal
+    # in the same expression, plus the second bare literal
+    r = lint_tree(tmp_path, {"ray_tpu/models/bad.py": bad},
+                  rules=["sharding-discipline"])
+    assert rules_of(r) == ["sharding-discipline"] * 3, r.findings
+
+    good = """from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.sharding import (
+    logical_to_pspec,
+    spec_tree_to_shardings,
+    with_logical_constraint,
+)
+
+
+def decoder_layer(x, mesh, rules):
+    x = with_logical_constraint(x, mesh, "batch", "seq", None, rules=rules)
+    batch_spec = logical_to_pspec(("batch",), rules, mesh=mesh)
+    replicated = NamedSharding(mesh, P())  # no device axis named: legal
+    empty = P(None)
+    return x, batch_spec, replicated, empty
+"""
+    r = lint_tree(tmp_path, {"ray_tpu/models/bad.py": good},
+                  rules=["sharding-discipline"])
+    assert not r.findings, r.findings
+
+    # scope: the rule owns models/ only — the parallel substrate and
+    # trainers elsewhere legitimately build NamedShardings
+    r = lint_tree(tmp_path, {"ray_tpu/models/bad.py": "",
+                             "ray_tpu/parallel/impl.py": bad,
+                             "bench.py": bad},
+                  rules=["sharding-discipline"])
+    assert not r.findings, r.findings
+
+
 def test_bench_emission_fixtures(tmp_path):
     bad = """import json
 
@@ -817,7 +863,8 @@ def test_expected_rule_set(live_result):
         "thread-lifecycle", "bounded-blocking", "async-purity",
         "lock-discipline", "context-capture", "fault-site-coverage",
         "proxy-request-context", "collective-supervision",
-        "serial-blocking-get", "test-hygiene", "bench-emission"}
+        "serial-blocking-get", "test-hygiene", "bench-emission",
+        "sharding-discipline"}
 
 
 @pytest.mark.parametrize("rule", sorted(
