@@ -81,6 +81,36 @@ class TPUAcceleratorManager:
         return out
 
     @staticmethod
+    def slice_topology_labels() -> Dict[str, str]:
+        """Node labels advertising pod-slice topology for the scheduler's
+        slice table (GCS) and ``STRICT_PACK_SLICE`` packing.
+
+        - ``tpu-slice-name``: the slice this host belongs to (TPU_NAME);
+        - ``tpu-pod-type``: e.g. ``v5litepod-16``;
+        - ``tpu-worker-index``: this host's position along the slice's
+          torus — consecutive indexes are ICI neighbors, which is what
+          the adjacency-preferring pack order keys on;
+        - ``tpu-chip-coords``: this host's first-chip coordinate hint
+          (linear offset = worker_index * chips_per_host) so the GCS
+          slice table can render physical adjacency;
+        - ``tpu-ici-neighbors``: comma-joined worker indexes of this
+          host's ICI-adjacent peers (ring hint: index ± 1 mod hosts).
+        """
+        out: Dict[str, str] = {}
+        pod = TPUAcceleratorManager.get_current_pod_name()
+        t = os.environ.get(TPUAcceleratorManager.ENV_TYPE)
+        if not pod or not t:
+            return out
+        idx = TPUAcceleratorManager.get_current_pod_worker_id()
+        hosts = TPUAcceleratorManager.get_current_pod_worker_count()
+        chips = TPUAcceleratorManager.get_current_node_num_accelerators()
+        out["tpu-slice-name"] = pod
+        out["tpu-pod-type"] = t
+        out["tpu-worker-index"] = str(idx)
+        out.update(topology_hint_labels(idx, hosts, chips))
+        return out
+
+    @staticmethod
     def set_visible_chips(env: Dict[str, str], chip_ids: List[int]) -> None:
         """Per-worker chip isolation for fractional TPU scheduling
         (reference: CUDA_VISIBLE_DEVICES analog for TPU)."""
@@ -100,3 +130,23 @@ def detect_resources() -> Dict[str, float]:
             out[at] = float(n)
         out.update(TPUAcceleratorManager.slice_resources())
     return out
+
+
+def topology_hint_labels(worker_index: int, num_hosts: int,
+                         chips_per_host: int) -> Dict[str, str]:
+    """Adjacency-hint labels for one slice host — THE formula, shared by
+    metadata detection (above) and the slice provider, so emulated and
+    real hosts group/order identically: chip coords as a linear offset
+    along the worker chain, ICI neighbors as the ring ``index ± 1``."""
+    out = {"tpu-chip-coords": str(worker_index * max(chips_per_host, 1))}
+    if num_hosts > 1:
+        neighbors = sorted({(worker_index - 1) % num_hosts,
+                            (worker_index + 1) % num_hosts}
+                           - {worker_index})
+        out["tpu-ici-neighbors"] = ",".join(str(n) for n in neighbors)
+    return out
+
+
+def detect_labels() -> Dict[str, str]:
+    """Auto-detected topology labels for this node (empty off-TPU)."""
+    return TPUAcceleratorManager.slice_topology_labels()

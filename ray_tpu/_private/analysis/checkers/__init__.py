@@ -9,6 +9,7 @@ from ray_tpu._private.analysis.checkers import (  # noqa: F401
     collective_supervision,
     context_capture,
     fault_sites,
+    gang_state,
     lock_discipline,
     proxy_context,
     serial_blocking_get,

@@ -161,14 +161,17 @@ class BoundedBlockingChecker(Checker):
     # read must carry a deadline: serve/ is the latency-critical control
     # plane, rl/ drives long-lived loops over killable rollout/learner
     # actors, experimental/channel/ + dag/ are the compiled-graph data
-    # plane, and llm/ ships KV handoffs between killable prefill/decode
-    # replicas (shipper writes, landing reads, handoff waits) — a dead
-    # peer never writes its channel, so a bare read wedges the exec loop
-    # / pipeline stage / landing thread forever (the hang class PR 8
-    # fixed by hand)
+    # plane, llm/ ships KV handoffs between killable prefill/decode
+    # replicas (shipper writes, landing reads, handoff waits), and
+    # train/ + autoscaler/ drive the gang/slice scheduling surface
+    # (controller restart loops over fate-shareable gang members,
+    # provision/reclaim over killable slices) — a dead peer never
+    # writes its channel / resolves its ref, so a bare read wedges the
+    # control loop forever (the hang class PR 8 fixed by hand)
     _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/",
                       "ray_tpu/experimental/channel/", "ray_tpu/dag/",
-                      "ray_tpu/llm/")
+                      "ray_tpu/llm/", "ray_tpu/train/",
+                      "ray_tpu/autoscaler/")
 
     def check(self, pf: ParsedFile) -> Iterable[Finding]:
         out: List[Finding] = []

@@ -1,0 +1,108 @@
+"""gang-table-discipline: gang state writes go through the persisted table.
+
+The gang state machine (PENDING -> RESERVING -> PLACED -> PREEMPTING ->
+REMOVED, docs/fault_tolerance.md "Gangs, slices & priority preemption")
+is only crash-consistent because EVERY transition is one call to
+``GcsServer._gang_transition`` — the single write path that updates the
+snapshot/WAL-persisted ``gangs`` table, appends history, and publishes
+the audit event.  A direct ``gang["state"] = ...`` (or a raw write into
+``self.gangs[...]``) anywhere else would be an in-memory-only
+transition: invisible to the audit stream, lost on a GCS restart, and a
+re-opened door to the partial-gang bugs the table closed.
+
+Flagged anywhere under ``ray_tpu/``:
+
+- assignment to a ``["state"]`` subscript whose base names a gang
+  (``gang``, ``victim_gang``, ``self.gangs[...]`` …);
+- assignment into the gang table itself (``self.gangs[...] = ...`` or
+  ``<x>.gangs[...] = ...``);
+
+unless the enclosing function IS ``_gang_transition`` (the one place
+the write is the point).  Reads are never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ray_tpu._private.analysis.core import (
+    Checker, Finding, ParsedFile, dotted_name, register)
+
+
+def _is_gang_name(node: ast.AST) -> bool:
+    """True when the expression names a gang record: a variable whose
+    name contains ``gang``, or a subscript of a ``gangs`` table."""
+    if isinstance(node, ast.Name):
+        return "gang" in node.id.lower()
+    if isinstance(node, ast.Attribute):
+        return "gang" in node.attr.lower()
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Attribute) and base.attr == "gangs":
+            return True
+        if isinstance(base, ast.Name) and base.id == "gangs":
+            return True
+    return False
+
+
+def _is_gang_table(node: ast.AST) -> bool:
+    """True for the gang table itself (``self.gangs`` / ``gcs.gangs``)."""
+    if isinstance(node, ast.Attribute) and node.attr == "gangs":
+        return True
+    return isinstance(node, ast.Name) and node.id == "gangs"
+
+
+def _enclosing_function(pf: ParsedFile, node: ast.AST) -> Optional[str]:
+    fn = pf.enclosing(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    return fn.name if fn is not None else None
+
+
+@register
+class GangTableDisciplineChecker(Checker):
+    rule = "gang-table-discipline"
+    description = ("gang state transitions must write through "
+                   "_gang_transition (the persisted GCS gang table) — "
+                   "no in-memory-only transitions")
+    hint = ("call self._gang_transition(gang_id, \"<STATE>\", ...) "
+            "instead of assigning gang state or gang-table entries "
+            "directly; the helper persists, appends history, and "
+            "publishes the audit event in one step")
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath.startswith("ray_tpu/")
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        out: List[Finding] = []
+        if pf.tree is None:
+            return out
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                if not isinstance(tgt, ast.Subscript):
+                    continue
+                if _enclosing_function(pf, node) == "_gang_transition":
+                    continue
+                # gang["state"] = ... on a gang-named receiver
+                sl = tgt.slice
+                if isinstance(sl, ast.Constant) and sl.value == "state" \
+                        and _is_gang_name(tgt.value):
+                    out.append(self.finding(
+                        pf, node,
+                        f"direct gang state assignment on "
+                        f"{dotted_name(tgt.value) or 'a gang record'} — "
+                        f"an in-memory-only transition bypasses the "
+                        f"persisted table, history, and audit stream"))
+                    continue
+                # self.gangs[...] = ... raw table writes
+                if _is_gang_table(tgt.value):
+                    out.append(self.finding(
+                        pf, node,
+                        "raw write into the gang table — entries are "
+                        "created/updated only by _gang_transition so "
+                        "every record carries a consistent state + "
+                        "history"))
+        return out

@@ -19,14 +19,15 @@ from ray_tpu._private.task_spec import SchedulingStrategy, TaskArg
 _TASK_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "num_returns", "max_retries",
     "retry_exceptions", "scheduling_strategy", "name", "runtime_env", "memory",
-    "label_selector", "_metadata", "_generator_backpressure_num_objects",
+    "label_selector", "priority", "_metadata",
+    "_generator_backpressure_num_objects",
 }
 _ACTOR_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
     "max_concurrency", "concurrency_groups", "name", "namespace",
     "lifetime", "get_if_exists",
     "scheduling_strategy", "runtime_env", "memory", "label_selector", "max_pending_calls",
-    "_metadata",
+    "priority", "_metadata",
 }
 
 
@@ -87,6 +88,11 @@ def normalize_strategy(strategy) -> SchedulingStrategy:
                                   soft=strategy.soft)
     if kind == "PlacementGroupSchedulingStrategy":
         pg = strategy.placement_group
+        if pg is None:
+            # the explicit opt-OUT of gang inheritance (reference
+            # semantics): a task inside a capture_child_tasks gang
+            # passes placement_group=None to schedule outside it
+            return SchedulingStrategy()
         return SchedulingStrategy(
             kind="PLACEMENT_GROUP",
             placement_group_id=pg.id,
@@ -96,6 +102,25 @@ def normalize_strategy(strategy) -> SchedulingStrategy:
     if kind == "NodeLabelSchedulingStrategy":
         return SchedulingStrategy(kind="NODE_LABEL", label_selector=dict(strategy.hard or {}))
     raise ValueError(f"Unsupported scheduling strategy: {strategy!r}")
+
+
+def resolve_strategy(options_strategy, worker) -> SchedulingStrategy:
+    """Normalize the user's strategy, inheriting gang membership.
+
+    Reference semantics
+    (``placement_group_capture_child_tasks``): a task/actor submitted
+    INSIDE a gang whose own strategy captured child tasks lands in the
+    same gang by default — nested scheduling stays on the reserved
+    slice.  An explicit strategy (including an explicit None-PG
+    strategy) always wins; only the no-strategy default inherits.
+    """
+    if options_strategy is None and worker is not None:
+        pg_id, capture = worker.current_placement_group_info()
+        if pg_id is not None and capture:
+            return SchedulingStrategy(
+                kind="PLACEMENT_GROUP", placement_group_id=pg_id,
+                bundle_index=-1, capture_child_tasks=True)
+    return normalize_strategy(options_strategy)
 
 
 def build_args(worker, args: Tuple, kwargs: Dict

@@ -57,6 +57,13 @@ _FLAG_DEFS: Dict[str, Any] = {
     # concurrent leased workers per scheduling key (reference
     # NormalTaskSubmitter requests one worker per queued task)
     "max_leases_per_scheduling_key": 32,
+    # seed for the gang-preemption victim tiebreak (chaos.py-style
+    # determinism: same cluster spec + same seed => same victims)
+    "gang_preempt_seed": 0,
+    # drain deadline broadcast when preempting a lower-priority gang:
+    # the victim's budget to checkpoint + vacate before its nodes are
+    # treated as preempted (never SIGKILL-first)
+    "gang_preempt_drain_deadline_s": 30.0,
     # --- worker pool ---
     "num_prestart_workers": 0,
     "worker_startup_timeout_s": 60.0,

@@ -1,0 +1,121 @@
+"""Gang scheduling primitives: states, victim selection, claims.
+
+A *gang* is the GCS-side identity of a placement group: an atomic
+all-or-nothing reservation moving through a persisted state machine
+
+    PENDING -> RESERVING -> PLACED -> (PREEMPTING | FAILED) -> REMOVED
+
+(``FAILED`` re-enters ``PENDING`` for ``restartable=True`` gangs — the
+train controller's mode).  Every transition is written through the
+GCS's persisted gang table by ``GcsServer._gang_transition`` (enforced
+by the ``gang-table-discipline`` raylint checker): a crash between any
+two transitions restores to a consistent state, and the audit contract
+holds — outside the RESERVING window a gang's raylet-side reservations
+are either complete or empty, never partial.
+
+This module keeps the *pure* pieces (state vocabulary, deterministic
+victim selection) import-light so the scheduler tests exercise them
+without a GCS.
+
+Victim selection (priority preemption)
+--------------------------------------
+
+When a priority-P gang is infeasible but would fit by evicting
+strictly-lower-priority PLACED gangs, :func:`select_victims` picks the
+victim set deterministically:
+
+1. **fewest gangs disturbed** — every single-victim solution is tried
+   before any multi-victim one;
+2. **lowest priority first** — candidates are ordered by ascending
+   priority so the cheapest tenants are disturbed first;
+3. **seeded tiebreak** — equal-priority candidates are ordered by a
+   ``random.Random(seed)`` shuffle keyed on the preemptor's id (the
+   ``chaos.py`` determinism contract: same spec + same seed => same
+   victims, unit-tested).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu._private import scheduling
+from ray_tpu._private.scheduling import NodeView, ResourceSet
+
+# the persisted gang state machine (docs/fault_tolerance.md)
+GANG_STATES = ("PENDING", "RESERVING", "PLACED", "PREEMPTING", "FAILED",
+               "REMOVED")
+#: states whose gangs still own (or may own) capacity / claims
+ACTIVE_STATES = ("PENDING", "RESERVING", "PLACED", "PREEMPTING")
+#: terminal states: all reservations provably released
+TERMINAL_STATES = ("FAILED", "REMOVED")
+
+
+def tiebreak_rng(seed: int, preemptor_id: bytes) -> random.Random:
+    """One seeded rng per (cluster seed, preemptor): victim choice is a
+    pure function of the spec, never of arrival jitter."""
+    return random.Random(f"{seed}|{bytes(preemptor_id).hex()}")
+
+
+def _views_with_released(views: Sequence[NodeView],
+                         victims: Sequence[Dict[str, Any]]) -> List[NodeView]:
+    """Simulated cluster view with every victim's reserved bundles
+    returned to availability."""
+    out = [NodeView(v.node_id, v.total.to_dict(), v.available.to_dict(),
+                    dict(v.labels), v.alive) for v in views]
+    by_id = {v.node_id: v for v in out}
+    for victim in victims:
+        placement = victim.get("placement") or []
+        bundles = victim.get("bundles") or []
+        for node_id, bundle in zip(placement, bundles):
+            node = by_id.get(node_id)
+            if node is not None:
+                node.available.add(ResourceSet(bundle))
+    return out
+
+
+def select_victims(
+    bundles: List[Dict[str, float]],
+    strategy: str,
+    priority: int,
+    preemptor_id: bytes,
+    views: Sequence[NodeView],
+    placed_gangs: Sequence[Dict[str, Any]],
+    seed: int = 0,
+    exclude_node_ids: Optional[set] = None,
+) -> Optional[List[bytes]]:
+    """Pick the gangs to evict so ``bundles`` becomes placeable.
+
+    ``placed_gangs`` entries carry ``gang_id``, ``priority``,
+    ``placement`` (node per bundle) and ``bundles``.  Only strictly
+    lower-priority gangs are candidates.  Returns the victim gang ids
+    (deterministic for equal inputs + seed) or None when no eviction of
+    lower-priority gangs makes the gang fit.
+    """
+    candidates = [g for g in placed_gangs
+                  if g.get("priority", 0) < priority
+                  and g.get("placement")]
+    if not candidates:
+        return None
+    rng = tiebreak_rng(seed, preemptor_id)
+    tiebreak = {id(g): rng.random() for g in sorted(
+        candidates, key=lambda g: bytes(g["gang_id"]))}
+    candidates.sort(key=lambda g: (g.get("priority", 0), tiebreak[id(g)]))
+
+    def fits(victims: Sequence[Dict[str, Any]]) -> bool:
+        trial = _views_with_released(views, victims)
+        return scheduling.pack_bundles(
+            trial, bundles, strategy,
+            exclude_node_ids=exclude_node_ids) is not None
+
+    # fewest-gangs-disturbed: any single victim beats every pair
+    for g in candidates:
+        if fits([g]):
+            return [g["gang_id"]]
+    # greedy accumulation in (priority, tiebreak) order
+    acc: List[Dict[str, Any]] = []
+    for g in candidates:
+        acc.append(g)
+        if fits(acc):
+            return [v["gang_id"] for v in acc]
+    return None

@@ -37,7 +37,8 @@ _READONLY_HANDLERS = frozenset({
     "job_logs", "list_submitted_jobs", "wait_actor_ready", "get_actor_info",
     "get_named_actor", "list_named_actors", "list_actors",
     "wait_placement_group_ready", "get_placement_group",
-    "list_placement_groups", "subscribe", "cluster_resources",
+    "list_placement_groups", "list_gangs", "get_slice_topology",
+    "subscribe", "cluster_resources",
     "available_resources", "publish_logs", "tail_logs", "job_logs_delta",
 })
 
@@ -60,6 +61,12 @@ class GcsServer:
         self.named_actors: Dict[Tuple[str, str], bytes] = {}
         self.jobs: Dict[int, Dict[str, Any]] = {}
         self.pgs: Dict[bytes, Dict[str, Any]] = {}
+        # gang table: per placement group, the persisted scheduling state
+        # machine (PENDING -> RESERVING -> PLACED -> PREEMPTING ->
+        # REMOVED, FAILED re-entering PENDING for restartable gangs).
+        # EVERY transition goes through _gang_transition (the persisted
+        # write path; raylint's gang-table-discipline enforces it).
+        self.gangs: Dict[bytes, Dict[str, Any]] = {}
         self.workers: Dict[bytes, Dict[str, Any]] = {}
 
         self._job_counter = 0
@@ -163,7 +170,7 @@ class GcsServer:
     # ------------------------------------------------------- persistence
 
     _SNAPSHOT_TABLES = ("kv", "nodes", "actors", "named_actors", "jobs",
-                        "pgs", "workers")
+                        "pgs", "gangs", "workers")
 
     def _mark_dirty_wrapper(self, handler):
         async def wrapped(**kwargs):
@@ -646,6 +653,18 @@ class GcsServer:
         for pg_id, info in self.pgs.items():
             if info.get("state") == "PENDING":
                 self._pending_pgs.append(pg_id)
+        # a crash mid-RESERVING leaves the reservation outcome unknown:
+        # roll the gang back to PENDING (the next schedule pass releases
+        # any leftover raylet-side reservations before re-reserving, and
+        # raylets make re-reservation idempotent) — never boot with a
+        # gang claiming to hold partial capacity
+        for gang_id, gang in list(self.gangs.items()):
+            if gang.get("state") == "RESERVING":
+                self._gang_transition(
+                    gang_id, "PENDING",
+                    note="rolled back: GCS restarted mid-reservation")
+                if gang_id not in self._pending_pgs:
+                    self._pending_pgs.append(gang_id)
         logger.info(
             "gcs state restored from %s: %d nodes, %d actors, %d jobs",
             self._storage_path, len(self.nodes), len(self.actors),
@@ -807,6 +826,10 @@ class GcsServer:
             node["stats"] = stats
         node["last_heartbeat"] = time.time()
         if not node["alive"]:
+            if node.get("death_final"):
+                # dead for good (observed hardware death): never
+                # resurrect — order the still-running raylet down
+                return {"nodes": self._cluster_view(), "shutdown": True}
             if str(node.get("death_reason", "")).startswith(
                     "drain deadline expired"):
                 # dead ON PURPOSE: a drain-expired node must never
@@ -907,13 +930,33 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 pass
 
-    async def _mark_node_dead(self, node_id: str, reason: str):
+    async def handle_report_node_failure(self, node_id: str,
+                                         reason: str) -> bool:
+        """An OBSERVED hardware death, reported by whoever saw the chip
+        go (the autoscaler's provider reconcile, an operator tool): the
+        node is marked dead FINAL — it never heartbeat-resurrects, a
+        still-running raylet is ordered down, and a PLACED gang on it
+        fate-shares immediately instead of waiting out the heartbeat
+        timeout."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return False
+        await self._mark_node_dead(node_id, reason, final=True)
+        return True
+
+    async def _mark_node_dead(self, node_id: str, reason: str,
+                              final: bool = False):
         node = self.nodes.get(node_id)
         if node is None or not node["alive"]:
             return
         node["alive"] = False
         node["state"] = "DEAD"
         node["death_reason"] = reason
+        if final:
+            # an OBSERVED hardware death (chip failure, slice preemption
+            # verdict): the raylet process may still heartbeat, but its
+            # accelerator is gone — refuse resurrection, order shutdown
+            node["death_final"] = True
         self._publish("nodes", {"event": "node_dead", "node_id": node_id, "reason": reason})
         # fail the dead node's RPC client so UNTIMED calls parked on it
         # (actor lease requests) raise now — a raylet that stalls without
@@ -925,10 +968,15 @@ class GcsServer:
                 await client.close()
             except Exception:  # noqa: BLE001
                 pass
+        # gang fate-sharing FIRST: a gang member's death must fail the
+        # whole gang (marking its actors DEAD with the fate-share cause),
+        # not restart members one by one against a dead mesh
+        await self._fate_share_gangs(node_id, reason)
         # restart or fail actors that lived there
         for actor_id, info in list(self.actors.items()):
             if info.get("node_id") == node_id and info["state"] == "ALIVE":
                 await self._on_actor_interrupted(actor_id, f"node {node_id[:8]} died: {reason}")
+        self._maybe_cancel_preempt_drains()
 
     # --------------------------------------------------------------------- kv
 
@@ -980,6 +1028,14 @@ class GcsServer:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
             self._dirty = True
+        # driver exit reclaims its placement groups (reference: PG
+        # lifetime scoping) — EXCEPT lifetime="detached" ones, which
+        # survive until explicitly removed
+        for pg_id, pg in list(self.pgs.items()):
+            if (pg.get("job_id") == job_id
+                    and pg.get("lifetime") != "detached"
+                    and pg.get("state") not in ("REMOVED",)):
+                await self.handle_remove_placement_group(pg_id)
         return True
 
     async def handle_list_jobs(self) -> List[Dict[str, Any]]:
@@ -1140,6 +1196,7 @@ class GcsServer:
                 bundle_index=strategy.bundle_index,
                 owner_addr="gcs",
                 dedicated=True,
+                priority=getattr(spec, "priority", 0),
                 timeout=None,
             )
             if "spillback" in lease or lease.get("retry_pg_pending"):
@@ -1148,6 +1205,11 @@ class GcsServer:
                     self._pending_actors.append(actor_id)
                 return
             info["node_id"] = pick
+            # gang membership for fate-sharing: a node death inside the
+            # gang kills this actor with the gang, not one-by-one
+            if strategy.kind == "PLACEMENT_GROUP" and \
+                    strategy.placement_group_id is not None:
+                info["pg_id"] = strategy.placement_group_id.binary()
             info["worker_id"] = lease["worker_id"]
             worker = RpcClient(lease["worker_addr"], "gcs-actor-push")
             reply = await worker.call(
@@ -1166,6 +1228,13 @@ class GcsServer:
     async def _retry_pending_loop(self):
         while not self._stopping:
             await asyncio.sleep(0.5)
+            # backstop for missed release notifications: a preempt drain
+            # whose victims vacated is cancelled here at the latest
+            try:
+                self._maybe_cancel_preempt_drains()
+            except Exception:  # noqa: BLE001 — never wedge the retry loop
+                logger.debug("preempt-drain cancel sweep failed",
+                             exc_info=True)
             self._kick_pending()
 
     def _kick_pending(self):
@@ -1303,79 +1372,475 @@ class GcsServer:
                     fut.set_result(None)
 
     # ------------------------------------------------------- placement groups
+    #
+    # Every placement group is backed by a GANG record in the persisted
+    # gang table: the reservation step is atomic all-or-nothing with
+    # rollback, a priority-P gang that cannot place may preempt
+    # strictly-lower-priority gangs over the drain protocol, and a node
+    # death inside a PLACED gang fate-shares the whole gang.
 
     async def handle_create_placement_group(self, bundles: List[Dict[str, float]],
                                             strategy: str = "PACK",
-                                            name: str = "") -> bytes:
+                                            name: str = "",
+                                            lifetime: Optional[str] = None,
+                                            priority: int = 0,
+                                            restartable: bool = False,
+                                            job_id: Optional[int] = None
+                                            ) -> bytes:
         pg_id = PlacementGroupID.from_random().binary()
         self.pgs[pg_id] = {
             "pg_id": pg_id,
             "bundles": bundles,
             "strategy": strategy,
             "name": name,
+            "lifetime": lifetime,
+            "priority": int(priority),
+            "restartable": bool(restartable),
+            "job_id": job_id,
             "state": "PENDING",
             "placement": None,
             "create_time": time.time(),
         }
+        self._gang_transition(pg_id, "PENDING", name=name,
+                              priority=int(priority),
+                              restartable=bool(restartable),
+                              bundle_count=len(bundles))
         asyncio.ensure_future(self._schedule_pg(pg_id))
         return pg_id
 
+    # -- gang state machine (single persisted write path) ------------------
+
+    def _gang_transition(self, gang_id: bytes, state: str, **fields):
+        """THE write path for gang state: updates the persisted gang
+        table, appends bounded history, and publishes an auditable event
+        — all in one step, so a consumer observing the event stream sees
+        exactly the table's transitions (the no-partial-gang audit).
+        Raylint's ``gang-table-discipline`` rule keeps every state write
+        in the tree routed through here."""
+        from ray_tpu._private.gangs import GANG_STATES
+
+        assert state in GANG_STATES, state
+        gang = self.gangs.setdefault(gang_id, {"gang_id": gang_id,
+                                               "history": []})
+        prev = gang.get("state")
+        gang.update(fields)
+        gang["state"] = state
+        gang["state_since"] = time.time()
+        gang["history"].append({"from": prev, "to": state,
+                                "time": gang["state_since"],
+                                **({"note": fields["note"]}
+                                   if "note" in fields else {})})
+        del gang["history"][:-32]  # bounded: long-lived gangs churn
+        self._dirty = True  # also for non-RPC (scheduler-loop) callers
+        self._publish("gangs", {"event": "gang_state", "gang_id": gang_id,
+                                "from": prev, "to": state,
+                                "priority": gang.get("priority", 0)})
+
+    def _credit_cached_availability(self, placement: List[str],
+                                    bundles: List[Dict[str, float]],
+                                    node_ids) -> None:
+        """Return released bundle reservations to the cached node views
+        NOW (raylets stay authoritative; heartbeats overwrite) — a
+        preempting claimant must be able to reserve the moment its
+        victim releases, not a heartbeat later."""
+        for sid in node_ids:
+            node = self.nodes.get(sid)
+            if node is None:
+                continue
+            avail = ResourceSet(node["available"])
+            for nid, bundle in zip(placement, bundles):
+                if nid == sid:
+                    avail.add(ResourceSet(bundle))
+            node["available"] = avail.to_dict()
+
+    def _claimed_by_others(self, gang_id: bytes) -> set:
+        """Nodes held under another active gang's preemption claim —
+        HARD-excluded from this gang's packing, so back-to-back arrivals
+        can never steal the capacity a preemptor is waiting on (the
+        no-livelock guarantee)."""
+        from ray_tpu._private.gangs import TERMINAL_STATES
+
+        out: set = set()
+        for gid, gang in self.gangs.items():
+            if gid == gang_id or gang.get("state") in TERMINAL_STATES:
+                continue
+            out.update(gang.get("claim_nodes") or ())
+        return out
+
+    def _placed_gang_records(self) -> List[Dict[str, Any]]:
+        """Victim-selection view: every PLACED gang with its placement
+        and bundle specs (from the pg table, same key space)."""
+        out = []
+        for gid, gang in self.gangs.items():
+            if gang.get("state") != "PLACED":
+                continue
+            pg = self.pgs.get(gid)
+            if pg is None or not pg.get("placement"):
+                continue
+            out.append({"gang_id": gid,
+                        "priority": gang.get("priority", 0),
+                        "placement": list(pg["placement"]),
+                        "bundles": list(pg["bundles"])})
+        return out
+
     async def _schedule_pg(self, pg_id: bytes):
         pg = self.pgs.get(pg_id)
-        if pg is None or pg["state"] in ("CREATED", "REMOVED"):
+        if pg is None or pg["state"] in ("CREATED", "REMOVED", "FAILED"):
             return
-        views = [NodeView(n["node_id"], n["total"], n["available"], n["labels"], n["alive"])
-                 for n in self.nodes.values() if n["alive"]]
+        gang = self.gangs.get(pg_id, {})
+        if gang.get("state") == "RESERVING":
+            return  # single-flight: a reservation pass is already running
+        claimed = self._claimed_by_others(pg_id)
+        views = [NodeView(n["node_id"], n["total"], n["available"],
+                          n["labels"], n["alive"])
+                 for n in self.nodes.values()
+                 if n["alive"] and n["node_id"] not in claimed]
         placement = scheduling.pack_bundles(
             views, pg["bundles"], pg["strategy"],
             exclude_node_ids=self._draining_node_ids())
         if placement is None:
+            await self._maybe_preempt_for(pg_id, pg, views)
             if pg_id not in self._pending_pgs:
                 self._pending_pgs.append(pg_id)
             return
-        # two-phase: reserve every bundle, roll back on any failure
-        # (reference gcs_placement_group_scheduler.h:288 prepare/commit)
+        await self._reserve_gang(pg_id, pg, placement)
+
+    async def _reserve_gang(self, pg_id: bytes, pg: Dict[str, Any],
+                            placement: List[str]):
+        """Two-phase atomic reservation (reference
+        ``gcs_placement_group_scheduler.h:288`` prepare/commit), now with
+        the gang contract: the gang enters RESERVING, and a bundle that
+        fails to reserve releases EVERY sibling reservation before the
+        single transition back to PENDING — no partial gang ever holds
+        capacity past a gang-table transition."""
+        from ray_tpu.util.fault_injection import fault_point
+
+        self._gang_transition(pg_id, "RESERVING",
+                              planned_placement=list(placement))
         reserved: List[Tuple[str, int]] = []
+        failure = ""
         ok = True
-        for idx, (node_id, bundle) in enumerate(zip(placement, pg["bundles"])):
+        for idx, (node_id, bundle) in enumerate(zip(placement,
+                                                    pg["bundles"])):
             raylet = self._raylet(node_id)
             if raylet is None:
                 ok = False
+                failure = f"node {node_id[:8]} gone before reserve"
                 break
             try:
+                # the injected-fault edge: a failure here mid-gang must
+                # roll back every sibling reservation
+                fault_point("gang.reserve")
                 success = await raylet.call("reserve_bundle", pg_id=pg_id,
-                                            bundle_index=idx, resources=bundle)
-            except Exception:
+                                            bundle_index=idx,
+                                            resources=bundle)
+            except Exception as e:  # noqa: BLE001
                 success = False
+                failure = f"reserve bundle {idx} on {node_id[:8]}: {e}"
             if not success:
                 ok = False
+                failure = failure or (f"bundle {idx} did not fit on "
+                                      f"{node_id[:8]}")
                 break
             reserved.append((node_id, idx))
-        if not ok:
+        # the awaits above may have raced a removal (controller shutdown
+        # mid-re-reservation): a REMOVED/FAILED pg must not be
+        # resurrected by this commit — release everything and bow out
+        # (the terminal transition already happened)
+        current = self.pgs.get(pg_id)
+        if current is None or current.get("state") in ("REMOVED", "FAILED"):
             for node_id, idx in reserved:
                 raylet = self._raylet(node_id)
                 if raylet is not None:
                     try:
-                        await raylet.call("release_placement_group", pg_id=pg_id)
-                    except Exception:
+                        await raylet.call("release_placement_group",
+                                          pg_id=pg_id)
+                    except Exception:  # noqa: BLE001
                         pass
+            return
+        if not ok:
+            # rollback: every sibling releases, then ONE transition back
+            for node_id, idx in reserved:
+                raylet = self._raylet(node_id)
+                if raylet is not None:
+                    try:
+                        await raylet.call("release_placement_group",
+                                          pg_id=pg_id)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._gang_transition(pg_id, "PENDING", note=failure)
             if pg_id not in self._pending_pgs:
                 self._pending_pgs.append(pg_id)
             return
+        # commit: reflect the reservation in the cached node view NOW so
+        # sibling gangs scheduled before the next heartbeat don't
+        # double-book (raylets stay authoritative; heartbeats overwrite)
+        for node_id, bundle in zip(placement, pg["bundles"]):
+            node = self.nodes.get(node_id)
+            if node is not None:
+                avail = ResourceSet(node["available"])
+                avail.subtract(ResourceSet(bundle))
+                node["available"] = avail.to_dict()
         pg["placement"] = placement
         pg["state"] = "CREATED"
+        claim_victims = list((self.gangs.get(pg_id) or {})
+                             .get("claim_victims") or ())
+        self._gang_transition(pg_id, "PLACED", placement=list(placement),
+                              claim_nodes=None, claim_victims=None)
+        # the claim (if any) is over: a claimant satisfied ELSEWHERE
+        # (capacity freed on another slice before the victims vacated)
+        # must un-preempt its still-intact victims and cancel their
+        # drains — nobody needs that eviction anymore
+        self._unpreempt_victims(pg_id, claim_victims)
         self._publish("pgs", {"event": "pg_created", "pg_id": pg_id})
         for fut in self._pg_waiters.pop(pg_id, []):
             if not fut.done():
                 fut.set_result(None)
+
+    # -- priority preemption over the drain protocol -----------------------
+
+    async def _maybe_preempt_for(self, pg_id: bytes, pg: Dict[str, Any],
+                                 views: List[NodeView]):
+        """An infeasible gang that would fit by evicting strictly-lower-
+        priority gangs picks victims deterministically, drains their
+        nodes via the PR 2 protocol (checkpoint -> re-mesh smaller or
+        clean exit, bounded by the drain deadline, never SIGKILL-first),
+        and holds a CLAIM over the freed nodes so it is admitted the
+        moment the reservations release — no later arrival can starve
+        it."""
+        from ray_tpu._private.gangs import select_victims
+
+        gang = self.gangs.get(pg_id)
+        if gang is None:
+            return
+        if gang.get("claim_nodes"):
+            if all((self.nodes.get(n) or {}).get("alive")
+                   for n in gang["claim_nodes"]):
+                # claim intact: don't stack a second victim set, but DO
+                # re-drain claim nodes whose drain RPC was lost — the
+                # claim must never wedge as a half-drained victim set
+                await self._drain_claim_nodes(pg_id, gang)
+                return
+            # a claimed node DIED (the victim rode the drain into its
+            # deadline, or the hardware went): the claim no longer
+            # covers usable capacity and would otherwise pin this gang
+            # in PENDING forever — release it (un-preempting surviving
+            # victims) and fall through to fresh victim selection
+            stale_victims = list(gang.get("claim_victims") or ())
+            self._gang_transition(pg_id, "PENDING", claim_nodes=None,
+                                  claim_victims=None,
+                                  note="claim released: claimed "
+                                       "node(s) died")
+            self._unpreempt_victims(pg_id, stale_victims)
+        priority = gang.get("priority", 0)
+        if priority <= 0:
+            return
+        victims = select_victims(
+            pg["bundles"], pg["strategy"], priority, pg_id, views,
+            self._placed_gang_records(),
+            seed=config.gang_preempt_seed,
+            exclude_node_ids=self._claimed_by_others(pg_id) or None)
+        if not victims:
+            return
+        claim_nodes: set = set()
+        for vid in victims:
+            vpg = self.pgs.get(vid) or {}
+            claim_nodes.update(vpg.get("placement") or ())
+        # claim FIRST (one transition), then drain: a crash between the
+        # two replays the drain from the restored claim on the next pass
+        self._gang_transition(pg_id, "PENDING",
+                              claim_nodes=sorted(claim_nodes),
+                              claim_victims=[v for v in victims],
+                              note=f"preempting {len(victims)} gang(s)")
+        for vid in victims:
+            self._gang_transition(
+                vid, "PREEMPTING", preempted_by=pg_id,
+                note=f"preempted by priority-{priority} gang")
+        await self._drain_claim_nodes(pg_id, gang)
+
+    async def _drain_claim_nodes(self, pg_id: bytes, gang: Dict[str, Any]):
+        """Drain every claimed node not yet draining.  Idempotent and
+        re-entrant: a pass whose drain RPC was lost (injected fault,
+        socket blip) covers the remainder on the next scheduler pass."""
+        from ray_tpu.util.fault_injection import fault_point
+
+        priority = gang.get("priority", 0)
+        deadline_s = config.gang_preempt_drain_deadline_s
+        for node_id in sorted(gang.get("claim_nodes") or ()):
+            node = self.nodes.get(node_id)
+            if node is None or not node.get("alive"):
+                continue
+            if node.get("state") == "DRAINING":
+                continue  # drain already accepted (or underway)
+            try:
+                # the injected-fault edge: a lost drain here must leave a
+                # retryable claim, never a half-drained victim set
+                fault_point("gang.preempt.drain")
+                ack = await self.handle_drain_node(
+                    node_id,
+                    reason=(f"preempted by gang "
+                            f"{pg_id.hex()[:8]} (priority {priority})"),
+                    deadline_s=deadline_s)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("preempt drain of %s failed (retried next "
+                               "pass): %s", node_id[:8], e)
+                continue
+            if ack.get("accepted"):
+                # tag the drain so it is CANCELLED (node back to ALIVE)
+                # once every victim vacates — preemption frees the
+                # capacity for the claimant; it does not kill the node
+                node["preempt_claimant"] = pg_id
+
+    def _unpreempt_victims(self, claimant_id: bytes,
+                           victims: List[bytes]):
+        """Revert still-intact PREEMPTING victims of a finished claim
+        (claimant admitted elsewhere, or removed before admission) back
+        to PLACED, then cancel the now-ownerless preempt drains.  A
+        victim that already vacated or died (terminal / fate-shared) is
+        left as-is."""
+        for vid in victims or ():
+            vgang = self.gangs.get(vid)
+            if vgang is None or vgang.get("state") != "PREEMPTING":
+                continue
+            if vgang.get("preempted_by") != claimant_id:
+                continue  # re-claimed by a different preemptor since
+            self._gang_transition(
+                vid, "PLACED", preempted_by=None,
+                note="preemption released: claimant no longer needs "
+                     "the capacity")
+        # with the claimant's claim_victims cleared, the vacated check
+        # in the sweep is trivially true for its tagged drains
+        self._maybe_cancel_preempt_drains()
+
+    def _maybe_cancel_preempt_drains(self):
+        """Cancel preemption drains whose victims have all vacated: the
+        node returns to ALIVE and the claimant's next schedule pass
+        reserves it.  (A drain that expires first falls through to the
+        ordinary deadline path: node dead, fate-sharing cleans up.)"""
+        from ray_tpu._private.gangs import TERMINAL_STATES
+
+        for node_id, node in self.nodes.items():
+            claimant = node.get("preempt_claimant")
+            if claimant is None or node.get("state") != "DRAINING":
+                continue
+            claim_gang = self.gangs.get(claimant) or {}
+            victims = claim_gang.get("claim_victims") or []
+            vacated = all(
+                (self.gangs.get(v) or {}).get("state") in TERMINAL_STATES
+                or node_id not in ((self.pgs.get(v) or {}).get(
+                    "placement") or ())
+                for v in victims)
+            if not vacated:
+                continue
+            node["state"] = "ALIVE"
+            node.pop("preempt_claimant", None)
+            node.pop("drain_reason", None)
+            node.pop("drain_deadline", None)
+            node.pop("drain_lease_holders", None)
+            self._publish("nodes", {"event": "node_drain_cancelled",
+                                    "node_id": node_id})
+            logger.info("preempt drain of %s cancelled: victims vacated",
+                        node_id[:8])
+            raylet = self._raylet(node_id)
+            if raylet is not None:
+                async def _push(client=raylet, nid=node_id):
+                    try:
+                        await asyncio.wait_for(client.call("cancel_drain"),
+                                               2.0)
+                    except Exception:  # noqa: BLE001 — heartbeat covers it
+                        logger.debug("cancel_drain push to %s failed",
+                                     nid[:8])
+
+                asyncio.ensure_future(_push())
+            self._kick_pending()
+
+    # -- fate-sharing ------------------------------------------------------
+
+    async def _fate_share_gangs(self, node_id: str, reason: str):
+        """A node/chip death inside a PLACED gang fails the WHOLE gang in
+        one transition: surviving members' leases are killed, sibling
+        reservations released, and (for restartable gangs — the train
+        controller's mode) the full gang re-enters atomic reservation."""
+        for pg_id, pg in list(self.pgs.items()):
+            if pg.get("state") != "CREATED" or not pg.get("placement"):
+                continue
+            if node_id not in pg["placement"]:
+                continue
+            cause = (f"gang fate-shared: node {node_id[:8]} died "
+                     f"({reason})")
+            restartable = bool(pg.get("restartable"))
+            # ONE transition marks the whole gang failed — the audit
+            # contract: observers never see a half-failed gang.
+            # `fate_shared`/`failure` are deliberately STICKY across the
+            # restartable re-admission: the train controller reads them
+            # AFTER the GCS has already re-placed the gang to route the
+            # no-charge restart, and each controller generation creates
+            # a fresh gang, so the marker never leaks across runs.
+            self._gang_transition(pg_id, "FAILED", fate_shared=True,
+                                  failure=cause, claim_nodes=None)
+            placement = list(pg["placement"])
+            pg["placement"] = None
+            pg["state"] = "PENDING" if restartable else "FAILED"
+            # kill surviving members' leases: a gang member outliving its
+            # gang would keep computing against a dead mesh
+            await self._kill_gang_members(pg_id, cause)
+            survivors = set(placement) - {node_id}
+            for sid in survivors:
+                raylet = self._raylet(sid)
+                if raylet is not None:
+                    try:
+                        await raylet.call("release_placement_group",
+                                          pg_id=pg_id)
+                    except Exception:  # noqa: BLE001 — node may be dying too
+                        pass
+            self._credit_cached_availability(placement, pg["bundles"],
+                                            survivors)
+            if restartable:
+                # atomic re-reservation for the FULL gang
+                self._gang_transition(pg_id, "PENDING",
+                                      note="restartable: re-reserving "
+                                           "after fate-share")
+                if pg_id not in self._pending_pgs:
+                    self._pending_pgs.append(pg_id)
+            else:
+                for fut in self._pg_waiters.pop(pg_id, []):
+                    if not fut.done():
+                        fut.set_result(None)
+
+    async def _kill_gang_members(self, pg_id: bytes, cause: str):
+        """Mark every ALIVE actor scheduled into the gang DEAD (with the
+        fate-share cause surfaced to owners/controllers) and kill its
+        worker lease best-effort."""
+        for actor_id, info in list(self.actors.items()):
+            if info.get("pg_id") != pg_id or info.get("state") != "ALIVE":
+                continue
+            addr = info.get("addr")
+            info["state"] = "DEAD"
+            info["death_cause"] = cause
+            if info.get("name"):
+                self.named_actors.pop((info["namespace"], info["name"]),
+                                      None)
+            self._publish("actors", {"event": "actor_dead",
+                                     "actor_id": actor_id})
+            for fut in self._actor_waiters.pop(actor_id, []):
+                if not fut.done():
+                    fut.set_result(None)
+            if addr:
+                try:
+                    client = RpcClient(addr)
+                    await asyncio.wait_for(
+                        client.call("kill_actor", no_restart=True), 2.0)
+                    await client.close()
+                except Exception:  # noqa: BLE001 — worker may be dead
+                    pass
 
     async def handle_wait_placement_group_ready(self, pg_id: bytes,
                                                 timeout: float = 60.0) -> Dict:
         pg = self.pgs.get(pg_id)
         if pg is None:
             return {"state": "NOT_FOUND"}
-        if pg["state"] == "CREATED":
-            return {"state": "CREATED", "placement": pg["placement"]}
+        if pg["state"] in ("CREATED", "FAILED"):
+            return {"state": pg["state"], "placement": pg["placement"]}
         fut = asyncio.get_event_loop().create_future()
         self._pg_waiters.setdefault(pg_id, []).append(fut)
         try:
@@ -1396,17 +1861,96 @@ class GcsServer:
         pg = self.pgs.get(pg_id)
         if pg is None:
             return False
-        if pg.get("placement"):
-            for node_id in set(pg["placement"]):
+        placement = pg.get("placement") or []
+        if placement:
+            for node_id in set(placement):
                 raylet = self._raylet(node_id)
                 if raylet is not None:
                     try:
                         await raylet.call("release_placement_group", pg_id=pg_id)
                     except Exception:
                         pass
+            self._credit_cached_availability(placement, pg["bundles"],
+                                            set(placement))
         pg["state"] = "REMOVED"
+        pg["placement"] = None
+        claim_victims = list((self.gangs.get(pg_id) or {})
+                             .get("claim_victims") or ())
+        if pg_id in self.gangs:
+            self._gang_transition(pg_id, "REMOVED", claim_nodes=None,
+                                  claim_victims=None)
+        # a removed gang may itself have been mid-preemption: un-preempt
+        # its still-intact victims (nobody needs that eviction anymore)
+        self._unpreempt_victims(pg_id, claim_victims)
         self._publish("pgs", {"event": "pg_removed", "pg_id": pg_id})
+        # ... or somebody's preemption victim: cancel the drain and
+        # admit the claimant now
+        self._maybe_cancel_preempt_drains()
+        self._kick_pending()
         return True
+
+    async def handle_list_gangs(self) -> List[Dict[str, Any]]:
+        """The gang table, joined with its pg's live placement — the
+        state API / CLI / dashboard read this one verb."""
+        out = []
+        for gid, gang in self.gangs.items():
+            pg = self.pgs.get(gid) or {}
+            out.append({
+                "gang_id": gid,
+                "name": gang.get("name", ""),
+                "state": gang.get("state"),
+                "priority": gang.get("priority", 0),
+                "restartable": gang.get("restartable", False),
+                "bundle_count": gang.get("bundle_count",
+                                         len(pg.get("bundles") or ())),
+                "bundles": list(pg.get("bundles") or ()),
+                "strategy": pg.get("strategy"),
+                "placement": pg.get("placement"),
+                "claim_nodes": gang.get("claim_nodes"),
+                "preempted_by": gang.get("preempted_by"),
+                "fate_shared": gang.get("fate_shared", False),
+                "failure": gang.get("failure"),
+                "state_since": gang.get("state_since"),
+                "history": list(gang.get("history") or ()),
+            })
+        return out
+
+    async def handle_get_slice_topology(self) -> List[Dict[str, Any]]:
+        """The slice table, derived from node-registration labels: one
+        row per pod slice with its ICI-ordered member hosts, chip
+        coordinates, and per-host liveness — what STRICT_PACK_SLICE
+        packs against, surfaced for operators."""
+        from ray_tpu._private.gangs import TERMINAL_STATES
+
+        views = [NodeView(n["node_id"], n["total"], n["available"],
+                          n["labels"], n["alive"])
+                 for n in self.nodes.values()]
+        gang_nodes: Dict[str, List[str]] = {}
+        for gid, gang in self.gangs.items():
+            if gang.get("state") in TERMINAL_STATES:
+                continue
+            for nid in (self.pgs.get(gid) or {}).get("placement") or ():
+                gang_nodes.setdefault(nid, []).append(gid.hex())
+        out = []
+        for name, members in sorted(
+                scheduling.slice_groups(views).items()):
+            rows = []
+            for m in members:
+                node = self.nodes.get(m.node_id, {})
+                rows.append({
+                    "node_id": m.node_id,
+                    "worker_index": m.labels.get(
+                        scheduling.WORKER_INDEX_LABEL),
+                    "chip_coords": m.labels.get("tpu-chip-coords"),
+                    "ici_neighbors": m.labels.get("tpu-ici-neighbors"),
+                    "state": node.get("state"),
+                    "gangs": gang_nodes.get(m.node_id, []),
+                })
+            out.append({"slice": name,
+                        "pod_type": members[0].labels.get("tpu-pod-type")
+                        if members else None,
+                        "hosts": rows})
+        return out
 
     # ----------------------------------------------------------------- pubsub
 
@@ -1505,10 +2049,15 @@ class GcsServer:
         # and it disappears at its deadline, so consumers sizing new
         # work against this aggregate (elastic train restarts, the
         # autoscaler's demand math) must not count capacity that is
-        # already on its way out
+        # already on its way out.  Nodes under an active preemption
+        # claim are excluded for the same reason: between the victim's
+        # release and the claimant's admission their resources look
+        # free, but the claimant owns them (no-livelock guarantee).
+        claimed = self._claimed_by_others(b"")
         avail = ResourceSet({})
         for n in self.nodes.values():
-            if n["alive"] and n.get("state") != "DRAINING":
+            if n["alive"] and n.get("state") != "DRAINING" \
+                    and n["node_id"] not in claimed:
                 avail.add(ResourceSet(n["available"]))
         return avail.to_dict()
 

@@ -94,7 +94,13 @@ class Raylet:
         self.node_name = node_name
         self.total = ResourceSet(resources)
         self.available = self.total.copy()
-        self.labels = labels or {}
+        # explicit labels win; detected slice-topology labels (TPU VM
+        # metadata env) fill the gaps so every raylet on a pod slice
+        # advertises its slice/worker-index/ICI hints without operator
+        # plumbing (the GCS slice table + STRICT_PACK_SLICE key on them)
+        from ray_tpu._private.accelerators import detect_labels
+
+        self.labels = {**detect_labels(), **(labels or {})}
 
         self.server = RpcServer(f"raylet-{self.node_id[:8]}")
         self.addr = ""
@@ -234,6 +240,7 @@ class Raylet:
         hb_failures = 0
         while not self._stopping:
             try:
+                hb_sent = time.time()
                 reply = await self.gcs.call(
                     "heartbeat",
                     node_id=self.node_id,
@@ -265,6 +272,16 @@ class Raylet:
                     # deadline to an already-draining raylet
                     self._begin_drain(drain.get("reason", ""),
                                       drain.get("deadline", 0.0))
+                elif self.draining and \
+                        getattr(self, "_drain_adopted_at", 0.0) < hb_sent:
+                    # the GCS stopped advertising the drain (preemption
+                    # victims vacated, drain cancelled): adopt the
+                    # cancellation too — covers a lost cancel_drain RPC.
+                    # Self-initiated drains (SIGTERM) are never cleared,
+                    # and a drain adopted AFTER this heartbeat was sent
+                    # is too fresh to cancel: the reply predates it (a
+                    # push racing a stale reply must not un-drain us).
+                    self._cancel_drain()
                 if reply.get("unknown"):
                     # GCS restarted without our registration: re-attach
                     logger.info("gcs forgot this node: re-registering")
@@ -848,21 +865,45 @@ class Raylet:
 
     # ---------------------------------------------------------------- drain
 
-    def _begin_drain(self, reason: str, deadline: float):
+    def _begin_drain(self, reason: str, deadline: float,
+                     source: str = "gcs"):
         """Enter DRAINING locally: stop steering new leases here (the
         lease path soft-avoids this node from now on).  Idempotent; a
-        second notice only ever shortens the window."""
+        second notice only ever shortens the window.  ``source`` records
+        who initiated it: only GCS-initiated drains may be CANCELLED by
+        the GCS (preemption drains whose victims vacated) — a SIGTERM
+        self-drain is a local fact no control-plane reply can undo."""
         if self.draining:
             if deadline and deadline < self.drain_deadline:
                 self.drain_deadline = deadline
             return
         self.draining = True
+        self._drain_source = source
+        self._drain_adopted_at = time.time()
         self.drain_reason = reason
         self.drain_deadline = deadline or (
             time.time() + config.node_drain_deadline_s)
         logger.warning("raylet %s draining: %s (%.1fs to deadline)",
                        self.node_id[:8], reason or "<no reason>",
                        max(0.0, self.drain_deadline - time.time()))
+
+    def _cancel_drain(self) -> bool:
+        """Leave DRAINING (gcs-initiated drains only): the preemption
+        victims vacated, so this node's capacity is back in play for the
+        claimant gang.  Returns whether a drain was cancelled."""
+        if not self.draining or \
+                getattr(self, "_drain_source", "gcs") != "gcs":
+            return False
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_deadline = 0.0
+        logger.warning("raylet %s drain cancelled: accepting leases again",
+                       self.node_id[:8])
+        self._pump_leases()
+        return True
+
+    async def handle_cancel_drain(self) -> bool:
+        return self._cancel_drain()
 
     def _lease_holders(self) -> List[Dict[str, Any]]:
         return [{"worker_id": h.worker_id.hex(),
@@ -892,7 +933,7 @@ class Raylet:
         node stops taking new leases — then report it cluster-wide."""
         if deadline_s is None:
             deadline_s = config.node_drain_deadline_s
-        self._begin_drain(reason, time.time() + deadline_s)
+        self._begin_drain(reason, time.time() + deadline_s, source="self")
         try:
             await self.gcs.call("drain_node", node_id=self.node_id,
                                 reason=reason, deadline_s=deadline_s,
@@ -956,6 +997,7 @@ class Raylet:
         dedicated: bool = False,
         avoid_node_ids: Optional[List[str]] = None,
         lease_token: Optional[str] = None,
+        priority: int = 0,
     ) -> Dict:
         demand = ResourceSet(resources)
         if pg_id is not None:
@@ -1001,7 +1043,7 @@ class Raylet:
             if target != self.node_id:
                 addr = self._addr_of(target) or (await self._gcs_node_addr(target))
                 return {"spillback": addr, "spillback_node": target}
-            return await self._grant_local(demand, pg_id, bundle_index, dedicated, owner_addr, lease_token)
+            return await self._grant_local(demand, pg_id, bundle_index, dedicated, owner_addr, lease_token, priority)
 
         # soft-avoid set: a retrying owner's just-saw-a-worker-die-there
         # nodes (likely mid-death, heartbeat not yet timed out) plus every
@@ -1031,7 +1073,7 @@ class Raylet:
             local_view = NodeView(self.node_id, self.total.to_dict(),
                                   self.available.to_dict(), self.labels, True)
             if _hard_ok(local_view):
-                return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token)
+                return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token, priority)
             # This fallback must honor the soft-avoid set too: a retrying
             # owner whose lease RPC just died against a node would
             # otherwise be spilled straight back to the corpse (its
@@ -1087,7 +1129,7 @@ class Raylet:
         if pick != self.node_id:
             return {"spillback": self._addr_of(pick),
                     "spillback_node": pick}
-        return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token)
+        return await self._grant_local(demand, None, -1, dedicated, owner_addr, lease_token, priority)
 
     async def _gcs_node_addr(self, node_id: str) -> Optional[str]:
         nodes = await self.gcs.call("get_all_nodes")
@@ -1137,10 +1179,11 @@ class Raylet:
         return placement[0] if placement else None
 
     async def _grant_local(self, demand: ResourceSet, pg_id, bundle_index, dedicated,
-                           owner_addr, lease_token=None) -> Dict:
+                           owner_addr, lease_token=None,
+                           priority: int = 0) -> Dict:
         fut = asyncio.get_event_loop().create_future()
         self._lease_waiters.append((demand, pg_id, bundle_index, dedicated, owner_addr,
-                                    lease_token, fut))
+                                    lease_token, fut, priority))
         self._pump_leases()
         return await fut
 
@@ -1175,6 +1218,12 @@ class Raylet:
 
     def _pump_leases(self):
         made_progress = True
+        if len({w[7] for w in self._lease_waiters}) > 1:
+            # priority dispatch: higher-priority leases are granted first
+            # (stable sort keeps FIFO fairness within a priority class —
+            # the reference's dispatch-queue behavior at priority 0)
+            self._lease_waiters = deque(sorted(
+                self._lease_waiters, key=lambda w: -w[7]))
         while made_progress and self._lease_waiters:
             made_progress = False
             n = len(self._lease_waiters)
@@ -1183,7 +1232,7 @@ class Raylet:
             starting = self._starting
             for _ in range(n):
                 (demand, pg_id, bundle_index, dedicated, owner_addr,
-                 lease_token, fut) = self._lease_waiters[0]
+                 lease_token, fut, _prio) = self._lease_waiters[0]
                 if fut.done():
                     self._lease_waiters.popleft()
                     made_progress = True
@@ -1413,6 +1462,14 @@ class Raylet:
     async def handle_reserve_bundle(self, pg_id: bytes, bundle_index: int,
                                     resources: Dict[str, float]) -> bool:
         demand = ResourceSet(resources)
+        prior = self._bundle_totals.get(pg_id, {}).get(bundle_index)
+        if prior is not None:
+            # idempotent re-reserve (GCS retried after a crash/rollback
+            # whose release RPC was lost): return the prior reservation
+            # before re-checking, or the same gang double-books itself
+            self.available.add(prior)
+            self.bundles.get(pg_id, {}).pop(bundle_index, None)
+            self._bundle_totals[pg_id].pop(bundle_index, None)
         if not self.available.is_superset_of(demand):
             return False
         self.available.subtract(demand)
@@ -1572,6 +1629,14 @@ class Raylet:
         self._stopping = True
         for t in self._tasks:
             t.cancel()
+        # a stopped node holds no gang capacity: release bundle tables so
+        # a lingering in-process object (tests, embedded head) can't be
+        # mistaken for a node still holding its gang's reservations
+        for table in self._bundle_totals.values():
+            for rs in table.values():
+                self.available.add(rs)
+        self._bundle_totals.clear()
+        self.bundles.clear()
         # node teardown: SIGKILL straight away and in bulk — a graceful
         # exit RPC per worker (1 s timeout each, serial) would outlive the
         # 3 s shutdown budget at ~4 workers and orphan the rest of a

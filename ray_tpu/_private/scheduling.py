@@ -18,9 +18,16 @@ match on — replacing the reference's string-resource hack
 from __future__ import annotations
 
 import itertools
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 GRANULARITY = 10000  # milli-resource fixed point, reference fixed_point.h
+
+# Node labels expressing pod-slice topology.  ``tpu-slice-name`` is the
+# canonical key (accelerators.py metadata detection); ``tpu-slice`` is
+# the provider-layer alias (tpu_slice_provider.py) — both are honored so
+# real-metadata nodes and provider-launched fake hosts group the same.
+SLICE_LABEL_KEYS = ("tpu-slice-name", "tpu-slice")
+WORKER_INDEX_LABEL = "tpu-worker-index"
 
 
 def to_fixed(v: float) -> int:
@@ -101,6 +108,46 @@ class NodeView:
             avail = self.available._res.get(k, 0)
             best = max(best, 1.0 - avail / tot)
         return best
+
+
+def slice_of(node: "NodeView") -> Optional[str]:
+    """The pod-slice name a node belongs to, or None for slice-less nodes."""
+    for key in SLICE_LABEL_KEYS:
+        name = node.labels.get(key)
+        if name:
+            return name
+    return None
+
+
+def ici_order(nodes: List["NodeView"]) -> List["NodeView"]:
+    """Order one slice's hosts so consecutive picks are ICI neighbors.
+
+    Within a slice, worker indexes are assigned along the physical torus
+    (reference tpu.py worker numbering), so sorting by
+    ``tpu-worker-index`` yields an adjacency-preferring chain: bundles
+    placed in this order land on hosts whose chips share ICI links, and
+    tier-B device-frame channels negotiate instead of falling to host
+    shm.  Nodes without an index sort after indexed ones, by node id."""
+    def key(n: "NodeView"):
+        raw = n.labels.get(WORKER_INDEX_LABEL)
+        try:
+            return (0, int(raw), n.node_id)
+        except (TypeError, ValueError):
+            return (1, 0, n.node_id)
+
+    return sorted(nodes, key=key)
+
+
+def slice_groups(nodes: List["NodeView"]) -> Dict[str, List["NodeView"]]:
+    """slice name -> member nodes (alive only), each group ICI-ordered."""
+    groups: Dict[str, List[NodeView]] = {}
+    for n in nodes:
+        if not n.alive:
+            continue
+        name = slice_of(n)
+        if name is not None:
+            groups.setdefault(name, []).append(n)
+    return {name: ici_order(members) for name, members in groups.items()}
 
 
 _spread_rr = itertools.count()
@@ -198,7 +245,9 @@ def pack_bundles(
     Strategies (reference ``bundle_scheduling_policy.cc`` /
     ``python/ray/util/placement_group.py``): PACK (minimize nodes, best
     effort), STRICT_PACK (all on one node), SPREAD (best-effort one-per-node),
-    STRICT_SPREAD (hard one-per-node).  Returns node_id per bundle or None.
+    STRICT_SPREAD (hard one-per-node), STRICT_PACK_SLICE (all bundles on
+    nodes sharing one pod-slice label, ICI-adjacency-preferring order —
+    the TPU-native gang shape).  Returns node_id per bundle or None.
 
     ``exclude_node_ids`` is the same SOFT avoidance set as
     :func:`pick_node`'s: DRAINING nodes (advance-notice preemption) are
@@ -219,6 +268,42 @@ def pack_bundles(
 
     def fits(nid, d):
         return avail[nid].is_superset_of(d)
+
+    if strategy == "STRICT_PACK_SLICE":
+        # Gang-schedule one contiguous slice: every bundle lands inside a
+        # single slice-labelled node group, filling hosts in ICI order so
+        # neighboring bundles share ICI links.  A gang that straddles two
+        # slices is REJECTED (split-slice), not silently spread — the
+        # whole point is that the mesh forms over one ICI domain.
+        groups = slice_groups([n for n in nodes if n.alive])
+        if not groups:
+            # slice-less cluster (dev box, CPU proxy): every node is its
+            # own one-host "slice" — degenerates to STRICT_PACK, which
+            # is what topology-requesting callers got before slices
+            groups = {n.node_id: [n] for n in nodes if n.alive}
+        # deterministic slice preference: smallest slice that fits
+        # (leave big slices for big gangs), then name for stable ties
+        for name in sorted(groups, key=lambda s: (len(groups[s]), s)):
+            members = groups[name]
+            trial = {n.node_id: avail[n.node_id].copy() for n in members
+                     if n.node_id in avail}
+            placement = []
+            ok = True
+            for d in demands:
+                pick = None
+                for n in members:  # ICI order: fill along the chain
+                    t = trial.get(n.node_id)
+                    if t is not None and t.is_superset_of(d):
+                        pick = n.node_id
+                        break
+                if pick is None:
+                    ok = False
+                    break
+                trial[pick].subtract(d)
+                placement.append(pick)
+            if ok:
+                return placement
+        return None
 
     if strategy == "STRICT_PACK":
         for nid in avail:

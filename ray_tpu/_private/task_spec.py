@@ -73,6 +73,9 @@ class TaskSpec:
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
     max_retries: int = 0
     retry_exceptions: bool = False
+    # scheduling priority (gang/preemption tier): higher wins dispatch
+    # ties at the raylet and qualifies a gang to preempt lower tiers
+    priority: int = 0
     # actor fields
     actor_id: Optional[ActorID] = None
     actor_seq_no: int = 0

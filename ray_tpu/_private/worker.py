@@ -74,12 +74,24 @@ class WorkerMode(enum.Enum):
 class ExecutionContext:
     """Per-task execution context (current task/actor ids, counters)."""
 
-    def __init__(self, task_id: TaskID, job_id: JobID, actor_id: Optional[ActorID] = None):
+    def __init__(self, task_id: TaskID, job_id: JobID, actor_id: Optional[ActorID] = None,
+                 spec=None):
         self.task_id = task_id
         self.job_id = job_id
         self.actor_id = actor_id
         self.put_index = 0
         self.submit_index = 0
+        # gang membership (reference: TaskSpec placement_group_id): lets
+        # get_current_placement_group() resolve inside the executing
+        # task, and capture_child_tasks route nested submissions into
+        # the same gang by default
+        self.placement_group_id = None
+        self.pg_capture_child_tasks = False
+        strategy = getattr(spec, "scheduling_strategy", None)
+        if strategy is not None and strategy.kind == "PLACEMENT_GROUP":
+            self.placement_group_id = strategy.placement_group_id
+            self.pg_capture_child_tasks = bool(
+                getattr(strategy, "capture_child_tasks", False))
 
 
 _exec_ctx: contextvars.ContextVar[Optional[ExecutionContext]] = contextvars.ContextVar(
@@ -277,6 +289,23 @@ class CoreWorker:
     def current_ctx(self) -> ExecutionContext:
         ctx = _exec_ctx.get()
         return ctx if ctx is not None else self._root_ctx
+
+    def current_placement_group_info(self):
+        """(placement_group_id, capture_child_tasks) of the gang the
+        CURRENT task/actor is scheduled in, or (None, False).  Actor
+        method contexts fall back to the actor's creation strategy — gang
+        membership is a property of the actor, not of each call."""
+        ctx = self.current_ctx()
+        pg_id = getattr(ctx, "placement_group_id", None)
+        capture = getattr(ctx, "pg_capture_child_tasks", False)
+        if pg_id is None:
+            strategy = getattr(getattr(self, "_actor_spec", None),
+                               "scheduling_strategy", None)
+            if strategy is not None and strategy.kind == "PLACEMENT_GROUP":
+                pg_id = strategy.placement_group_id
+                capture = bool(getattr(strategy, "capture_child_tasks",
+                                       False))
+        return pg_id, capture
 
     # --------------------------------------------------------------- ownership
 
@@ -1291,6 +1320,7 @@ class CoreWorker:
                     dedicated=spec.task_type == TaskType.ACTOR_CREATION_TASK,
                     avoid_node_ids=sorted(avoid_node_ids) if avoid_node_ids else None,
                     lease_token=lease_token,
+                    priority=spec.priority,
                     # the resilience wrapper above owns the retry budget;
                     # a big inner reconnect loop on top would multiply
                     # into minutes against a dead peer
@@ -1745,7 +1775,7 @@ class CoreWorker:
 
         def _run():
             token = _exec_ctx.set(
-                ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+                ExecutionContext(spec.task_id, spec.job_id, spec.actor_id, spec=spec))
             self._running_task_threads[spec.task_id] = threading.get_ident()
             t0 = time.time()
             count = 0
@@ -1822,7 +1852,7 @@ class CoreWorker:
         args, kwargs = await self._resolve_args(spec)
 
         def _run():
-            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id, spec=spec))
             # register BEFORE the cancel re-check: a cancel that misses the
             # check will find the registration and inject; one that lands
             # before it is caught by the check — no lost window
@@ -2028,7 +2058,7 @@ class CoreWorker:
                              name="rtpu-actor-loop").start()
 
         def _create():
-            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id))
+            token = _exec_ctx.set(ExecutionContext(spec.task_id, spec.job_id, spec.actor_id, spec=spec))
             t0 = time.time()
             ok = False
             try:
@@ -2240,7 +2270,7 @@ class CoreWorker:
                                 else contextlib.nullcontext()):
                         token = _exec_ctx.set(
                             ExecutionContext(spec.task_id, spec.job_id,
-                                             spec.actor_id))
+                                             spec.actor_id, spec=spec))
                         t0 = time.time()  # execute phase excludes sema wait
                         try:
                             if spec.task_id in self._cancel_requested:
@@ -2480,6 +2510,16 @@ class CoreWorker:
         # counters/spans from a short session survive the publish interval
         _final_telemetry_publish()
         self._shutdown = True
+        if self.mode == WorkerMode.DRIVER:
+            # driver exit finishes its job: the GCS reclaims job-scoped
+            # state (non-detached placement groups).  Best-effort — a
+            # dead GCS cannot block shutdown.
+            try:
+                self.run_coro(self.gcs.call(
+                    "mark_job_finished", job_id=self.job_id.int_value(),
+                    timeout=2.0), timeout=3.0)
+            except Exception:  # noqa: BLE001
+                pass
 
         async def _close():
             await self.server.close()

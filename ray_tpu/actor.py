@@ -246,7 +246,9 @@ class ActorClass:
             resources=api_utils.build_resources(opts, default_num_cpus=0),
             owner_addr=worker.serve_addr,
             parent_task_id=ctx.task_id,
-            scheduling_strategy=api_utils.normalize_strategy(opts.get("scheduling_strategy")),
+            scheduling_strategy=api_utils.resolve_strategy(
+                opts.get("scheduling_strategy"), worker),
+            priority=int(opts.get("priority", 0) or 0),
             actor_id=actor_id,
             max_restarts=opts.get("max_restarts", config.actor_max_restarts_default),
             max_concurrency=max_concurrency,

@@ -34,6 +34,12 @@ class AutoscalerConfig:
     idle_timeout_s: float = 60.0
     upscale_interval_s: float = 2.0
     max_launches_per_round: int = 4
+    # slice-reclaim guard: an instance whose member nodes host a PLACED
+    # (or mid-preemption) gang at or above this priority is NEVER
+    # idle-reclaimed — the gang's reservation is a commitment even while
+    # its workers are momentarily between leases (restart window).
+    # Default 0: any gang pins its slice.
+    reclaim_priority: int = 0
 
 
 def _fits(demand: Dict[str, float], resources: Dict[str, float]) -> bool:
@@ -53,6 +59,7 @@ class Autoscaler:
         self.instance_manager = InstanceManager(
             provider, drain_node_fn=self._drain_node)
         self._idle_since: Dict[str, float] = {}
+        self._failure_reported: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -67,6 +74,27 @@ class Autoscaler:
                 await c.close()
 
         return run_sync(go())
+
+    def _get_gangs(self) -> List[Dict[str, Any]]:
+        """The GCS gang table (best-effort: an autoscaler must keep
+        reconciling node demand even when the gang verb is unavailable,
+        e.g. against an older head)."""
+        async def go():
+            c = RpcClient(self.gcs_addr)
+            try:
+                return await c.call("list_gangs", timeout=5.0)
+            finally:
+                await c.close()
+
+        try:
+            return run_sync(go()) or []
+        except Exception:  # noqa: BLE001
+            logger.debug("list_gangs failed", exc_info=True)
+            return []
+
+    @staticmethod
+    def _gang_bundles(gang: Dict[str, Any]) -> List[Dict[str, float]]:
+        return [dict(b) for b in gang.get("bundles") or ()]
 
     def _drain_node(self, node_id: str, reason: str,
                     deadline_s: Optional[float]):
@@ -84,6 +112,33 @@ class Autoscaler:
 
         run_sync(go())
 
+    def _report_dead_instances(self, im) -> None:
+        """Report member nodes of provider-died instances to the GCS as
+        FINAL deaths (observed hardware loss, not a heartbeat blip)."""
+        for inst in im.by_state(InstanceState.FAILED):
+            if inst.instance_id in self._failure_reported:
+                continue
+            if not inst.node_ids or "died" not in (inst.failure or ""):
+                continue
+            self._failure_reported.add(inst.instance_id)
+
+            async def go(node_ids=list(inst.node_ids),
+                         cause=f"instance {inst.instance_id}: "
+                               f"{inst.failure}"):
+                c = RpcClient(self.gcs_addr)
+                try:
+                    for nid in node_ids:
+                        await c.call("report_node_failure", node_id=nid,
+                                     reason=cause, timeout=5.0)
+                finally:
+                    await c.close()
+
+            try:
+                run_sync(go())
+            except Exception:  # noqa: BLE001 — retried next round
+                self._failure_reported.discard(inst.instance_id)
+                logger.debug("report_node_failure failed", exc_info=True)
+
     def reconcile_once(self) -> Dict[str, Any]:
         """Returns a summary of the decisions taken this round."""
         im = self.instance_manager
@@ -94,11 +149,23 @@ class Autoscaler:
 
         # 0. converge existing instances with provider/cluster reality
         im.reconcile(alive_ids)
+        # provider-observed deaths are FINAL: report member nodes so the
+        # GCS fate-shares their gangs now (no heartbeat-timeout wait)
+        # and refuses resurrection from a lingering raylet process
+        self._report_dead_instances(im)
 
-        # 1. unmet demand: pending shapes that fit NO alive node's total
+        # 1. unmet demand: pending shapes that fit NO alive node's total.
+        #    Pending GANGS contribute their bundle shapes too — a
+        #    STRICT_PACK_SLICE gang waiting for a slice that does not
+        #    exist yet is exactly the demand whole-slice provisioning
+        #    answers (one instance = every host of the slice).
+        gangs = self._get_gangs()
         demand: List[Dict[str, float]] = []
         for n in nodes:
             demand.extend(n.get("pending_demand", []))
+        for g in gangs:
+            if g.get("state") in ("PENDING", "RESERVING"):
+                demand.extend(self._gang_bundles(g))
         unmet = [d for d in demand
                  if not any(_fits(d, n["total"]) for n in nodes)]
         # plus shapes that fit somewhere but everything is saturated: any
@@ -149,12 +216,25 @@ class Autoscaler:
         #    (idle = every member node fully available, no pending demand)
         now = time.monotonic()
         by_node_id = {n["node_id"]: n for n in nodes}
+        # reclaim guard: nodes hosting (or claimed by) a gang at or
+        # above reclaim_priority pin their whole instance — a slice
+        # carrying a PLACED gang must never be idle-reclaimed out from
+        # under it, even during a restart window between leases
+        pinned_nodes: set = set()
+        for g in gangs:
+            if g.get("state") not in ("PLACED", "PREEMPTING", "RESERVING"):
+                continue
+            if g.get("priority", 0) < self.config.reclaim_priority:
+                continue
+            pinned_nodes.update(g.get("placement") or ())
+            pinned_nodes.update(g.get("claim_nodes") or ())
         for inst in im.by_state(InstanceState.RUNNING):
             cfg = self.config.node_types.get(inst.node_type)
             members = [by_node_id.get(nid) for nid in inst.node_ids]
-            idle = (not demand and all(
-                m is not None and m["available"] == m["total"]
-                for m in members))
+            idle = (not demand
+                    and not (pinned_nodes & set(inst.node_ids))
+                    and all(m is not None and m["available"] == m["total"]
+                            for m in members))
             if not idle:
                 self._idle_since.pop(inst.instance_id, None)
                 continue

@@ -66,7 +66,12 @@ class TPUPodSliceProvider(NodeProvider):
 
     def create_node(self, node_type: str, resources: Dict[str, float],
                     labels: Dict[str, str]) -> str:
+        from ray_tpu.util.fault_injection import fault_point
+
         spec = parse_pod_type(node_type)
+        # before any host spawns: an injected provisioning failure (cloud
+        # stockout, quota) must leave no partial slice behind
+        fault_point("slice.provision")
         self._counter += 1
         slice_id = f"{spec.pod_type}-slice-{self._counter}"
         hosts = []
@@ -95,11 +100,16 @@ class TPUPodSliceProvider(NodeProvider):
             # requiring TPU-{type}-head (reference tpu.py:403)
             res[f"TPU-{spec.pod_type}-head"] = 1.0
         res.update(extra_resources or {})
+        from ray_tpu._private.accelerators import topology_hint_labels
+
         host_labels = dict(labels or {})
         host_labels.update({
             "tpu-slice": slice_id,
+            "tpu-slice-name": slice_id,  # canonical scheduler key
             "tpu-pod-type": spec.pod_type,
             "tpu-worker-index": str(worker),
+            **topology_hint_labels(worker, spec.num_hosts,
+                                   spec.chips_per_host),
         })
         name = f"{slice_id}-w{worker}"
         spawned = spawn_raylet(self._session_dir, self._gcs_addr, name,

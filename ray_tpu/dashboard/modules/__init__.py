@@ -11,6 +11,7 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
     collective,
     data,
     entities,
+    gangs,
     llm,
     logs,
     metrics,
@@ -21,4 +22,4 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
 )
 
 ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train,
-               collective, data, slo, llm)
+               collective, data, slo, llm, gangs)

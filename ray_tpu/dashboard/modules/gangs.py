@@ -1,0 +1,28 @@
+"""Gangs module: the slice-native gang-scheduling panel.
+
+Serves the persisted GCS gang table (state machine, priorities,
+preemption claims, fate-share markers, bounded transition history) and
+the derived slice-topology table — the same records ``raytpu status``
+and ``util.state.list_gangs`` read, so all three surfaces agree.
+"""
+
+from __future__ import annotations
+
+
+def _jsonable_gang(g):
+    out = dict(g)
+    out["gang_id"] = g["gang_id"].hex()
+    if out.get("preempted_by"):
+        out["preempted_by"] = out["preempted_by"].hex()
+    return out
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_gangs(_req):
+        gangs = [_jsonable_gang(g) for g in await gcs.handle_list_gangs()]
+        slices = await gcs.handle_get_slice_topology()
+        return jresp({"gangs": gangs, "slices": slices})
+
+    return [("GET", "/api/gangs", api_gangs)]

@@ -250,7 +250,8 @@ class LLMEngine:
                  seed: int = 0, mesh=None,
                  kv_cache_dtype: Optional[str] = None,
                  spec_tokens: int = 0, spec_ngram: int = 2,
-                 spec_lookup_window: int = 512, prefill_chunk: int = 0):
+                 spec_lookup_window: int = 512, prefill_chunk: int = 0,
+                 arm_clock=None):
         import jax
         import jax.numpy as jnp
 
@@ -323,7 +324,13 @@ class LLMEngine:
             raise ValueError("spec_lookup_window must be >= 1")
         self.spec_lookup_window = int(spec_lookup_window)
         self.spec_stats = {"proposed": 0, "accepted": 0, "verify_steps": 0,
-                           "backoffs": 0}
+                           "backoffs": 0, "dry_rests": 0}
+        # the bandit's clock: every arm timing (window + verify) reads
+        # THIS callable, so tests inject a deterministic tick counter
+        # and the win-arm decision becomes a pure function of the
+        # workload — wall-clock stalls on a loaded box can't flip it
+        self._arm_clock = arm_clock if arm_clock is not None \
+            else time.perf_counter
         self._arm_seen: set = set()  # compiles persist across resets
         # dynamic disable (vLLM-style): a verify pass that mispredicts
         # yields ~1 token per host sync vs decode_window per sync, so a
@@ -546,7 +553,7 @@ class LLMEngine:
             # arm timing starts BEFORE block growth / mirror refresh /
             # uploads so the window arm carries the same per-step host
             # costs the verify arm does (symmetric bandit comparison)
-            t_arm = time.perf_counter()
+            t_arm = self._arm_clock()
             # ensure every active slot has blocks for the whole window;
             # preempt the youngest request if the pool is exhausted
             active = self._ensure_decode_blocks(active, horizon=self.K)
@@ -578,7 +585,7 @@ class LLMEngine:
                 # arity gets its own sample stream — the verify gate
                 # compares against the arity it would displace
                 self._observe_arm(("window", window_k), window_k,
-                                  time.perf_counter() - t_arm)
+                                  self._arm_clock() - t_arm)
             for step in range(window_k):
                 for i in active:
                     req = self._slots[i]
@@ -1108,13 +1115,16 @@ class LLMEngine:
         # keyed "verify" and ("window", arity) — per-arity EMAs
         self._arm_tps: Dict[Any, float] = {}
         self.spec_stats.update(proposed=0, accepted=0, verify_steps=0,
-                               backoffs=0)
+                               backoffs=0, dry_rests=0)
 
-    def _spec_rest(self):
+    def _spec_rest(self, dry: bool = False):
         """Rest the drafter for a growing number of steps (ONE escalation
-        rule for both triggers: low acceptance and persistent draftless
-        scans)."""
-        self.spec_stats["backoffs"] += 1
+        rule for every trigger).  ``dry`` rests (persistent draftless
+        scans — the drafter had nothing to say) are counted separately
+        from ``backoffs`` (the bandit judged the window faster, or
+        acceptance collapsed): consumers watching whether speculation
+        is LOSING must not conflate it with merely idling."""
+        self.spec_stats["dry_rests" if dry else "backoffs"] += 1
         self._spec_backoff = self._spec_backoff_len
         self._spec_backoff_len = min(self._spec_backoff_len * 2, 256)
 
@@ -1145,7 +1155,7 @@ class LLMEngine:
             return False
         # arm timing starts HERE: the drafting scan is a cost unique to
         # the verify path, so it must count against that arm
-        t_arm = time.perf_counter()
+        t_arm = self._arm_clock()
         drafts: Dict[int, List[int]] = {}
         for i in active:
             req = self._slots[i]
@@ -1155,15 +1165,19 @@ class LLMEngine:
             W = self.spec_lookup_window
             hist = (req.prompt_tokens[-W:] + req.out_tokens[-W:])[-W:]
             drafts[i] = _propose_ngram(hist, self.G, self.spec_ngram)[:self.G]
-            if not drafts[i]:
-                # a run of draftless steps rests the drafter like low
-                # acceptance does: never-drafting workloads must not pay
-                # the history scan every single step
-                self._spec_dry += 1
-                if self._spec_dry >= 4:
-                    self._spec_dry = 0
-                    self._spec_rest()
-                return False
+        if not any(drafts.values()):
+            # a run of FULLY draftless steps rests the drafter like low
+            # acceptance does: never-drafting workloads must not pay
+            # the history scan every single step.  A draftless MINORITY
+            # lane rides the verify pass with an empty proposal instead
+            # (it still gets its bonus token — exactly a 1-token window),
+            # so one non-repetitive request can't veto speculation for
+            # the whole batch.
+            self._spec_dry += 1
+            if self._spec_dry >= 4:
+                self._spec_dry = 0
+                self._spec_rest(dry=True)
+            return False
         self._spec_dry = 0
         active = self._ensure_decode_blocks(active, horizon=self.G + 1)
         if not active:
@@ -1183,7 +1197,7 @@ class LLMEngine:
             self.params, jnp.asarray(tokens), jnp.asarray(self._cur_len),
             self._tables_d, self.pool)
         preds = np.asarray(jnp.argmax(logits_d, -1))  # ONE sync: [B, G+1]
-        arm_elapsed = time.perf_counter() - t_arm
+        arm_elapsed = self._arm_clock() - t_arm
         self.spec_stats["verify_steps"] += 1
         accepted_last: Dict[int, int] = {}
         for i in active:

@@ -263,20 +263,26 @@ def verify_step(params, tokens, cur_len, cache, cfg: LlamaConfig):
 
 def _propose_ngram(history: List[int], k: int, ngram: int = 2) -> List[int]:
     """Prompt-lookup drafting (self-speculation, no draft model): find the
-    most recent earlier occurrence of the trailing n-gram and propose the
-    k tokens that followed it."""
+    most recent earlier occurrence of the trailing n-gram whose
+    continuation is FULL-LENGTH and propose the k tokens that followed
+    it; fall back to the longest partial continuation.  (A match
+    adjacent to the tail — every periodic sequence has one — truncates
+    its continuation at the sequence end, so stopping at the first
+    match capped steady-loop workloads at ~1 proposed token.)"""
     n = len(history)
     if n < ngram + 1:
         return []
     tail = history[-ngram:]
+    best: List[int] = []
     # search right-to-left, excluding the trailing occurrence itself
     for start in range(n - ngram - 1, -1, -1):
         if history[start:start + ngram] == tail:
             cont = history[start + ngram:start + ngram + k]
-            if cont:
+            if len(cont) == k:
                 return cont
-            return []
-    return []
+            if len(cont) > len(best):
+                best = cont
+    return best
 
 
 def sample_token(logits, key, sp: SamplingParams):

@@ -80,9 +80,11 @@ class RemoteFunction:
             resources=api_utils.build_resources(opts, default_num_cpus=1),
             owner_addr=worker.serve_addr,
             parent_task_id=worker.current_ctx().task_id,
-            scheduling_strategy=api_utils.normalize_strategy(opts.get("scheduling_strategy")),
+            scheduling_strategy=api_utils.resolve_strategy(
+                opts.get("scheduling_strategy"), worker),
             max_retries=opts.get("max_retries", config.task_max_retries_default),
             retry_exceptions=opts.get("retry_exceptions", False),
+            priority=int(opts.get("priority", 0) or 0),
             runtime_env=self._packaged_runtime_env(worker),
             backpressure_num_objects=int(
                 opts.get("_generator_backpressure_num_objects", 0) or 0),

@@ -134,6 +134,29 @@ def cmd_status(args):
                          f"queued={ov.get('queued', 0)}")
             print(line)
     try:
+        from ray_tpu.util.state import list_gangs
+
+        gangs = list_gangs()
+    except Exception:  # noqa: BLE001 — status must render without gangs
+        gangs = []
+    if gangs:
+        print("Gangs:")
+        for g in gangs:
+            line = (f"  {g['gang_id'][:12]}"
+                    f"{' ' + g['name'] if g.get('name') else ''}"
+                    f" [{g['state']}] priority={g.get('priority', 0)}"
+                    f" bundles={g.get('bundle_count')}")
+            if g.get("placement"):
+                line += f" nodes={sorted({n[:8] for n in g['placement']})}"
+            if g.get("claim_nodes"):
+                line += (f" claiming={len(g['claim_nodes'])} node(s)"
+                         f" (preempting)")
+            if g.get("preempted_by"):
+                line += f" preempted_by={g['preempted_by'][:8]}"
+            if g.get("fate_shared"):
+                line += f" fate-shared: {g.get('failure')}"
+            print(line)
+    try:
         from ray_tpu.util.state import list_slo_verdicts
 
         verdicts = list_slo_verdicts()
@@ -229,6 +252,8 @@ def cmd_list(args):
         "nodes": state_api.list_nodes,
         "jobs": state_api.list_jobs,
         "placement-groups": state_api.list_placement_groups,
+        "gangs": state_api.list_gangs,
+        "slices": state_api.get_slice_topology,
     }[args.entity]
     for row in fn():
         print(json.dumps(row, default=str))
@@ -422,7 +447,9 @@ def main(argv=None):
     p.set_defaults(fn=cmd_drain)
 
     p = sub.add_parser("list", help="list cluster entities")
-    p.add_argument("entity", choices=["actors", "nodes", "jobs", "placement-groups"])
+    p.add_argument("entity", choices=["actors", "nodes", "jobs",
+                                      "placement-groups", "gangs",
+                                      "slices"])
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
 

@@ -45,6 +45,11 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     mesh: Union[str, "MeshConfig", None] = None
     logical_axis_rules: Optional["LogicalAxisRules"] = None
+    # gang-scheduling tier: the worker group's placement gang carries
+    # this priority — a higher-priority gang arriving on a full cluster
+    # preempts lower tiers over the drain protocol (the preempted run
+    # checkpoint-restarts on a clamp_to-smaller mesh, no budget charge)
+    priority: int = 0
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

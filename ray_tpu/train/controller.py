@@ -301,6 +301,29 @@ class TrainController:
             time.sleep(self.poll_interval_s)
         return True
 
+    def _gang_fate_shared(self, group: WorkerGroup) -> bool:
+        """True when THIS group's placement gang was failed as a unit by
+        the GCS (node death inside the gang -> whole gang FAILED ->
+        atomic re-reservation).  Like a drain, that is infrastructure
+        preemption, not an application fault: the restart takes the
+        existing no-charge path.  Each generation creates a fresh gang,
+        so the check never sees a previous generation's marker."""
+        pg = getattr(group, "pg", None)
+        if pg is None:
+            return False
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker()
+            gangs = w.run_coro(w.gcs.call("list_gangs", timeout=5.0),
+                               timeout=10.0)
+        except Exception:  # noqa: BLE001 — control plane hiccup
+            return False
+        for g in gangs or []:
+            if g.get("gang_id") == pg.id.binary():
+                return bool(g.get("fate_shared"))
+        return False
+
     # -- control loop ------------------------------------------------------
     def run(self) -> Result:
         self._started_at = time.time()
@@ -331,6 +354,17 @@ class TrainController:
                         "drain covering a worker; restarting from the "
                         "latest checkpoint (planned migration, no "
                         "failure-budget charge):\n%s",
+                        self.name, errs[0].error)
+                    group.shutdown()
+                    group = self._restart_group()
+                    continue
+                if errs and any(s.dead for s in errs) and \
+                        self._gang_fate_shared(group):
+                    logger.warning(
+                        "train %s: placement gang fate-shared (node died "
+                        "inside the gang); restarting the FULL group from "
+                        "the latest checkpoint (infrastructure preemption,"
+                        " no failure-budget charge):\n%s",
                         self.name, errs[0].error)
                     group.shutdown()
                     group = self._restart_group()

@@ -190,13 +190,20 @@ class WorkerGroup:
         sc = self.scaling_config
         res = sc.worker_resources()
         bundles = [dict(res) for _ in range(sc.num_workers)]
-        # Gang-reserve: one bundle per worker.  STRICT_PACK keeps a slice's
-        # workers on one ICI domain when a topology is requested; PACK
-        # otherwise (reference: BackendExecutor._create_placement_group,
+        # Gang-reserve: one bundle per worker.  A requested topology
+        # gang-schedules a contiguous pod slice (all bundles on nodes
+        # sharing one slice label, ICI-adjacency order) when the cluster
+        # advertises slice labels; PACK otherwise (reference:
+        # BackendExecutor._create_placement_group,
         # python/ray/train/_internal/backend_executor.py:230).
-        strategy = "STRICT_PACK" if sc.topology else "PACK"
+        # restartable=True is the train controller's mode: a node death
+        # inside the gang fate-shares it and the GCS re-runs atomic
+        # reservation while the controller checkpoint-restarts.
+        strategy = "STRICT_PACK_SLICE" if sc.topology else "PACK"
         self.pg = placement_group(bundles, strategy=strategy,
-                                  name=f"train-{self.group_name}")
+                                  name=f"train-{self.group_name}",
+                                  priority=getattr(sc, "priority", 0),
+                                  restartable=True)
         if not self.pg.wait(timeout_seconds=60):
             raise RuntimeError(
                 f"placement group for {self.group_name} not placed in 60s "

@@ -14,7 +14,9 @@ Two event families:
   event's ``duration`` (default 1s), so the site fails (or hangs, for
   ``fault="delay"``) for the window and then disarms itself.
 - anything else — dispatched to a caller-registered **action**
-  (``actions={"drain_node": fn, ...}``).  Actions receive
+  (``actions={"drain_node": fn, ...}``) or a built-in one
+  (``preempt_slice``: kill every node of one pod slice at once, so a
+  PLACED gang there fate-shares).  Actions receive
   ``(event, rng)`` where ``rng`` is the timeline's seeded
   ``random.Random``; an action that needs to pick a victim (which
   replica? which rollout actor?) draws from ``rng`` so the same
@@ -55,6 +57,54 @@ from ray_tpu.util import fault_injection as fi
 ActionFn = Callable[[Dict[str, Any], random.Random], Any]
 
 
+def _preempt_slice_action(ev: Dict[str, Any], rng: random.Random) -> Any:
+    """Built-in ``preempt_slice`` action: preempt EVERY node of one pod
+    slice at once (a real slice preemption takes the whole ICI domain,
+    not one host).  The slice is ``ev["slice"]`` when named, else drawn
+    from ``rng`` (deterministic per (spec, seed)).  Each node gets a
+    drain with ``ev["deadline_s"]`` of notice (default 0 — the
+    kill-now shape): at the deadline the GCS marks it DEAD (a
+    drain-expired corpse never heartbeat-resurrects) and a PLACED gang
+    on the slice fate-shares — whole gang FAILED, atomic
+    re-reservation for restartable gangs."""
+    from ray_tpu._private.scheduling import SLICE_LABEL_KEYS
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    nodes = worker.run_coro(worker.gcs.call("get_all_nodes"))
+    groups: Dict[str, List[str]] = {}
+    for n in nodes:
+        if not n.get("alive"):
+            continue
+        labels = n.get("labels") or {}
+        name = next((labels[k] for k in SLICE_LABEL_KEYS
+                     if labels.get(k)), None)
+        if name:
+            groups.setdefault(name, []).append(n["node_id"])
+    if not groups:
+        return {"slice": None, "killed": []}
+    target = ev.get("slice")
+    if target is None:
+        names = sorted(groups)
+        target = names[rng.randrange(len(names))]
+    deadline_s = float(ev.get("deadline_s", 0.0))
+    killed = []
+    for node_id in sorted(groups.get(target, ())):
+        worker.run_coro(worker.gcs.call(
+            "drain_node", node_id=node_id,
+            reason=f"chaos: slice {target} preempted",
+            deadline_s=deadline_s, timeout=10.0))
+        killed.append(node_id)
+    return {"slice": target, "preempted": killed,
+            "deadline_s": deadline_s}
+
+
+#: actions available without caller registration (overridable)
+BUILTIN_ACTIONS: Dict[str, ActionFn] = {
+    "preempt_slice": _preempt_slice_action,
+}
+
+
 def _normalize_event(ev: Dict[str, Any], idx: int) -> Dict[str, Any]:
     if "at" not in ev or "kind" not in ev:
         raise ValueError(
@@ -84,7 +134,7 @@ class ChaosTimeline:
                         for i, ev in enumerate(events)]
         self._events.sort(key=lambda e: (e["at"], e["seq"]))
         self._seed = seed
-        self._actions = dict(actions or {})
+        self._actions = {**BUILTIN_ACTIONS, **(actions or {})}
         for ev in self._events:
             if ev["kind"] != "fault" and ev["kind"] not in self._actions:
                 raise ValueError(

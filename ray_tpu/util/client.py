@@ -307,6 +307,11 @@ class ClientCoreWorker:
     def current_ctx(self) -> _ClientContext:
         return self._root_ctx
 
+    def current_placement_group_info(self):
+        """A client driver never executes inside a gang: no placement
+        group to inherit for capture_child_tasks."""
+        return None, False
+
     # -- core ops ---------------------------------------------------------
 
     def put(self, value: Any) -> ObjectRef:

@@ -54,6 +54,9 @@ site                        guards
 ``rl.reward.score``         the RLHF reward-scoring leg, before any mutation
 ``llm.kv_ship``             every KV-handoff write on the prefill replica
 ``llm.handoff``             the decode replica's wait-for-handoff edge
+``gang.reserve``            each bundle's reserve RPC in a gang reservation
+``gang.preempt.drain``      the per-node drain leg of a gang preemption
+``slice.provision``         the slice provider's create_node edge
 ==========================  =================================================
 
 Two kinds are special:

@@ -12,7 +12,9 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private.ids import PlacementGroupID
 
-VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD",
+                    "STRICT_PACK_SLICE")
+VALID_LIFETIMES = (None, "detached")
 
 
 class PlacementGroup:
@@ -66,9 +68,25 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
+    priority: int = 0,
+    restartable: bool = False,
 ) -> PlacementGroup:
+    """Gang-reserve ``bundles`` across the cluster.
+
+    ``strategy="STRICT_PACK_SLICE"`` gang-schedules a contiguous pod
+    slice (all bundles on nodes sharing one slice label, ICI-adjacency-
+    preferring order).  ``lifetime="detached"`` makes the group survive
+    its creating driver's exit (reference semantics); the default scopes
+    it to the job.  ``priority`` qualifies the gang to preempt strictly-
+    lower-priority gangs over the drain protocol when it cannot place;
+    ``restartable=True`` (the train controller's mode) makes a gang
+    whose node died re-run atomic reservation instead of staying FAILED.
+    """
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"Invalid strategy {strategy!r}; valid: {VALID_STRATEGIES}")
+    if lifetime not in VALID_LIFETIMES:
+        raise ValueError(
+            f"Invalid lifetime {lifetime!r}; valid: {VALID_LIFETIMES}")
     if not bundles:
         raise ValueError("placement group requires at least one bundle")
     for b in bundles:
@@ -79,7 +97,9 @@ def placement_group(
     worker = get_global_worker()
     pg_id_bytes = worker.run_coro(
         worker.gcs.call("create_placement_group", bundles=bundles, strategy=strategy,
-                        name=name)
+                        name=name, lifetime=lifetime, priority=int(priority),
+                        restartable=bool(restartable),
+                        job_id=worker.job_id.int_value())
     )
     return PlacementGroup(PlacementGroupID(pg_id_bytes), bundles)
 
@@ -101,4 +121,24 @@ def placement_group_table(pg: Optional[PlacementGroup] = None):
 
 
 def get_current_placement_group() -> Optional[PlacementGroup]:
-    return None
+    """The placement group the CURRENT task/actor is scheduled in, or
+    None outside a gang (reference
+    ``ray.util.get_current_placement_group``).  Resolved from the
+    runtime context: the pg id rides the TaskSpec's scheduling strategy
+    (actor methods fall back to the actor's creation strategy), and the
+    bundle specs are fetched from the GCS gang table."""
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker(required=False)
+    if worker is None:
+        return None
+    pg_id, _capture = worker.current_placement_group_info()
+    if pg_id is None:
+        return None
+    try:
+        info = worker.run_coro(
+            worker.gcs.call("get_placement_group", pg_id=pg_id.binary()))
+    except Exception:  # noqa: BLE001 — control plane hiccup: no gang view
+        info = None
+    bundles = (info or {}).get("bundles") or []
+    return PlacementGroup(pg_id, bundles)

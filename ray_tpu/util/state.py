@@ -146,6 +146,30 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return out
 
 
+def list_gangs() -> List[Dict[str, Any]]:
+    """The GCS gang table: per placement group, the persisted scheduling
+    state machine (PENDING | RESERVING | PLACED | PREEMPTING | FAILED |
+    REMOVED) with priority, live placement, preemption claims
+    (``claim_nodes`` a preempting gang holds while its victims drain),
+    fate-sharing markers, and the bounded transition history — the
+    cluster-level audit surface for slice-native gang scheduling."""
+    w = _worker()
+    out = w.run_coro(w.gcs.call("list_gangs"))
+    for g in out:
+        g["gang_id"] = g["gang_id"].hex()
+        if g.get("preempted_by"):
+            g["preempted_by"] = g["preempted_by"].hex()
+    return out
+
+
+def get_slice_topology() -> List[Dict[str, Any]]:
+    """The GCS slice table, derived from node-registration labels: one
+    row per pod slice with ICI-ordered member hosts, chip-coordinate /
+    neighbor hints, drain state, and the gangs placed on each host."""
+    w = _worker()
+    return w.run_coro(w.gcs.call("get_slice_topology"))
+
+
 def list_named_actors(namespace: Optional[str] = None) -> List[Dict[str, str]]:
     w = _worker()
     return w.run_coro(w.gcs.call("list_named_actors", namespace=namespace))

@@ -74,7 +74,7 @@ CLIENT_PROGRAM = textwrap.dedent("""
 """)
 
 
-def test_remote_driver_end_to_end(ray_isolated):
+def test_remote_driver_end_to_end(ray_isolated, tmp_path):
     """A subprocess that never joins the cluster drives it via the proxy."""
     from ray_tpu.util.client import ClientServer
     from ray_tpu._private.worker import get_global_worker
@@ -83,7 +83,7 @@ def test_remote_driver_end_to_end(ray_isolated):
     server = ClientServer(w)
     host, port = w.run_coro(server.start(host="127.0.0.1", port=0))
     try:
-        script = os.path.join(os.path.dirname(__file__), "_client_prog.py")
+        script = str(tmp_path / "_client_prog.py")
         with open(script, "w") as f:
             f.write(CLIENT_PROGRAM)
         env = dict(os.environ)
@@ -96,7 +96,6 @@ def test_remote_driver_end_to_end(ray_isolated):
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         assert out.returncode == 0, out.stderr[-3000:]
         assert "CLIENT_OK" in out.stdout
-        os.unlink(script)
     finally:
         w.run_coro(server.stop())
 
@@ -222,7 +221,7 @@ STREAMING_CLIENT_PROGRAM = textwrap.dedent("""
 """)
 
 
-def test_client_streaming_generators(ray_isolated):
+def test_client_streaming_generators(ray_isolated, tmp_path):
     """Streaming generators over ray_tpu:// — task, actor, and a serve
     streaming deployment driven by the remote driver (closes the loud
     reject previously at util/client.py:319)."""
@@ -233,8 +232,7 @@ def test_client_streaming_generators(ray_isolated):
     server = ClientServer(w)
     host, port = w.run_coro(server.start(host="127.0.0.1", port=0))
     try:
-        script = os.path.join(os.path.dirname(__file__),
-                              "_client_stream_prog.py")
+        script = str(tmp_path / "_client_stream_prog.py")
         with open(script, "w") as f:
             f.write(STREAMING_CLIENT_PROGRAM)
         env = dict(os.environ)
@@ -247,6 +245,5 @@ def test_client_streaming_generators(ray_isolated):
             cwd=repo)
         assert out.returncode == 0, out.stderr[-3000:]
         assert "STREAM_CLIENT_OK" in out.stdout
-        os.unlink(script)
     finally:
         w.run_coro(server.stop())

@@ -1,0 +1,954 @@
+"""Slice-native gang scheduling: STRICT_PACK_SLICE topology packing,
+the persisted gang state machine (atomic all-or-nothing reservation
+with rollback), deterministic priority preemption over the drain
+protocol, gang fate-sharing, placement-group lifetime scoping, and
+`get_current_placement_group` / capture-child-tasks semantics.
+
+Three layers, mirroring test_drain.py:
+
+1. pure scheduler units (pack matrix, victim selection determinism);
+2. in-process GCS + raylet servers on one event loop (real sockets) for
+   the gang state machine, rollback faults, preemption claims, and
+   fate-sharing — with a no-partial-gang audit after transitions;
+3. cluster-level e2e: the two-tenant priority preemption scenario (a
+   high-priority gang lands within the drain deadline while the
+   low-priority training job checkpoint-restarts on a clamp_to-smaller
+   mesh with zero failure-budget charge) and the ChaosTimeline
+   ``preempt_slice`` fate-share path.
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from ray_tpu.util import fault_injection as fi
+
+
+# ---------------------------------------------------------------------------
+# 1. scheduler units
+# ---------------------------------------------------------------------------
+
+
+def _slice_node(nid, slice_name, idx, cpu=4.0, tpu=4.0, avail=None):
+    from ray_tpu._private.scheduling import NodeView
+
+    total = {"CPU": cpu, "TPU": tpu}
+    return NodeView(nid, total, avail or dict(total),
+                    {"tpu-slice-name": slice_name,
+                     "tpu-worker-index": str(idx)})
+
+
+def test_strict_pack_slice_matrix():
+    from ray_tpu._private.scheduling import pack_bundles
+
+    s1 = [_slice_node("a0", "s1", 0), _slice_node("a1", "s1", 1)]
+    s2 = [_slice_node("b0", "s2", 0), _slice_node("b1", "s2", 1),
+          _slice_node("b2", "s2", 2), _slice_node("b3", "s2", 3)]
+    nodes = s1 + s2
+    # fits: 2 bundles land on the SMALLEST slice that fits, in ICI
+    # (worker-index) order
+    p = pack_bundles(nodes, [{"TPU": 4}, {"TPU": 4}], "STRICT_PACK_SLICE")
+    assert p == ["a0", "a1"], p
+    # a bigger gang picks the bigger slice — never straddles two
+    p = pack_bundles(nodes, [{"TPU": 4}] * 4, "STRICT_PACK_SLICE")
+    assert p == ["b0", "b1", "b2", "b3"], p
+    # split-slice rejection: a gang that fits NO single slice is
+    # rejected outright, not spread across s1+s2
+    p = pack_bundles(nodes, [{"TPU": 4}] * 5, "STRICT_PACK_SLICE")
+    assert p is None
+    # adjacency preference: nodes fill along the worker-index chain even
+    # when the list order is scrambled
+    from ray_tpu._private.scheduling import ici_order
+
+    scrambled = [s2[2], s2[0], s2[3], s2[1]]
+    assert [n.node_id for n in ici_order(scrambled)] == \
+        ["b0", "b1", "b2", "b3"]
+    p = pack_bundles(scrambled, [{"TPU": 4}] * 3, "STRICT_PACK_SLICE")
+    assert p == ["b0", "b1", "b2"], p
+    # draining-slice soft-avoid: s1 draining -> the gang goes to s2;
+    # but a gang that fits ONLY the draining slice still places there
+    p = pack_bundles(nodes, [{"TPU": 4}, {"TPU": 4}], "STRICT_PACK_SLICE",
+                     exclude_node_ids={"a0", "a1"})
+    assert p == ["b0", "b1"], p
+    busy_s2 = s1 + [_slice_node(n.node_id, "s2", i, avail={"CPU": 4.0,
+                                                          "TPU": 0.0})
+                    for i, n in enumerate(s2)]
+    p = pack_bundles(busy_s2, [{"TPU": 4}, {"TPU": 4}],
+                     "STRICT_PACK_SLICE", exclude_node_ids={"a0", "a1"})
+    assert p == ["a0", "a1"], p
+    # slice-less fallback: no slice labels anywhere degenerates to
+    # STRICT_PACK (every node its own one-host slice)
+    from ray_tpu._private.scheduling import NodeView
+
+    plain = [NodeView("n1", {"CPU": 4}, {"CPU": 4}),
+             NodeView("n2", {"CPU": 4}, {"CPU": 4})]
+    p = pack_bundles(plain, [{"CPU": 2}, {"CPU": 2}], "STRICT_PACK_SLICE")
+    assert p is not None and len(set(p)) == 1
+
+
+def test_select_victims_deterministic():
+    from ray_tpu._private.gangs import select_victims
+
+    views = [_slice_node("a0", "s1", 0, tpu=4.0,
+                         avail={"CPU": 4.0, "TPU": 0.0}),
+             _slice_node("a1", "s1", 1, tpu=4.0,
+                         avail={"CPU": 4.0, "TPU": 0.0})]
+    placed = [
+        {"gang_id": b"g1", "priority": 1,
+         "placement": ["a0"], "bundles": [{"TPU": 4}]},
+        {"gang_id": b"g2", "priority": 1,
+         "placement": ["a1"], "bundles": [{"TPU": 4}]},
+        {"gang_id": b"g3", "priority": 3,
+         "placement": [], "bundles": []},
+    ]
+    # a 1-bundle gang needs only ONE victim (fewest-gangs-disturbed):
+    # both candidates tie on priority, the seeded tiebreak decides —
+    # and the SAME spec + seed always picks the same victim
+    picks = {tuple(select_victims([{"TPU": 4}], "PACK", 5, b"preemptor",
+                                  views, placed, seed=0))
+             for _ in range(5)}
+    assert len(picks) == 1
+    (pick,) = picks
+    assert len(pick) == 1 and pick[0] in (b"g1", b"g2")
+    # a 2-bundle gang disturbs both
+    two = select_victims([{"TPU": 4}, {"TPU": 4}], "PACK", 5,
+                         b"preemptor", views, placed, seed=0)
+    assert sorted(two) == [b"g1", b"g2"]
+    # only STRICTLY lower priorities are candidates
+    assert select_victims([{"TPU": 4}], "PACK", 1, b"preemptor",
+                          views, placed, seed=0) is None
+    # a different seed may (and here does) flip the equal-priority tie
+    flipped = {tuple(select_victims([{"TPU": 4}], "PACK", 5, b"preemptor",
+                                    views, placed, seed=s))
+               for s in range(8)}
+    assert len(flipped) >= 2, "seed never affected the tiebreak"
+
+
+def test_priority_option_validates_and_rides_spec():
+    from ray_tpu._private.api_utils import validate_options
+
+    validate_options({"priority": 3}, for_actor=False)
+    validate_options({"priority": 3}, for_actor=True)
+    with pytest.raises(ValueError):
+        validate_options({"priorty": 3}, for_actor=False)
+
+
+def test_pg_strategy_none_is_the_capture_opt_out():
+    """PlacementGroupSchedulingStrategy(None) is the documented opt-out
+    of gang capture-inheritance: it must normalize to DEFAULT, not
+    crash."""
+    from ray_tpu._private.api_utils import normalize_strategy
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    s = normalize_strategy(PlacementGroupSchedulingStrategy(None))
+    assert s.kind == "DEFAULT" and s.placement_group_id is None
+
+
+def test_placement_group_lifetime_validation():
+    from ray_tpu.util.placement_group import placement_group
+
+    with pytest.raises(ValueError, match="lifetime"):
+        placement_group([{"CPU": 1}], lifetime="bogus")
+    with pytest.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="PACK_SLICE")
+
+
+# ---------------------------------------------------------------------------
+# 2. in-process GCS + raylets: the gang state machine
+# ---------------------------------------------------------------------------
+
+
+def _gang_env(test_body, raylet_specs, flags=None):
+    """Run ``test_body(gcs, raylets)`` against in-process servers on one
+    event loop (the test_drain.py topology), with labelled raylets."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet
+
+    config.reload(dict({"health_check_period_s": 1.0}, **(flags or {})))
+
+    async def main():
+        sd = tempfile.mkdtemp()
+        os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+        g = GcsServer(sd)
+        await g.start()
+        raylets = []
+        for resources, labels in raylet_specs:
+            r = Raylet(sd, g.addr, resources, labels=labels)
+            await r.start()
+            raylets.append(r)
+        try:
+            await test_body(g, raylets)
+        finally:
+            for r in raylets:
+                try:
+                    await r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            await g.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        config.reload()
+
+
+def _assert_no_partial_gang(g, raylets):
+    """The audit contract: outside RESERVING, a gang's raylet-side
+    reservations are either complete or empty — never partial."""
+    for gang_id, gang in g.gangs.items():
+        if gang.get("state") == "RESERVING":
+            continue
+        held = sum(len(r.bundles.get(gang_id, {})) for r in raylets)
+        n = gang.get("bundle_count", 0)
+        assert held in (0, n), (
+            f"partial gang {gang_id.hex()[:8]}: state={gang.get('state')} "
+            f"holds {held}/{n} bundles")
+
+
+async def _wait_gang_state(g, gang_id, state, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if g.gangs.get(gang_id, {}).get("state") == state:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"gang never reached {state}; at "
+        f"{g.gangs.get(gang_id, {}).get('state')} "
+        f"(history {g.gangs.get(gang_id, {}).get('history')})")
+
+
+_SLICE_2X = [({"CPU": 2.0}, {"tpu-slice-name": "s1",
+                             "tpu-worker-index": "0"}),
+             ({"CPU": 2.0}, {"tpu-slice-name": "s1",
+                             "tpu-worker-index": "1"})]
+
+
+def test_gang_reserve_fault_rolls_back_all_siblings():
+    """A bundle that fails to reserve releases EVERY sibling reservation
+    in the same transition back to PENDING — then the retry loop places
+    the gang once the fault clears."""
+    async def body(g, raylets):
+        # every attempt faults on bundle 2 until disarm, so rollback is
+        # the steady state the test can observe without racing the
+        # async retry loop
+        fi.arm("gang.reserve", nth=2, count=1 << 30,
+               exc=ConnectionError("mid-gang fault"))
+        try:
+            pg_id = await g.handle_create_placement_group(
+                bundles=[{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+            # the armed fault fails bundle 2 -> rollback to PENDING,
+            # audited via the persisted history note
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                notes = [h.get("note", "")
+                         for h in g.gangs[pg_id]["history"]]
+                # observe rollback COMPLETE: note recorded and the gang
+                # back in PENDING (all sibling releases awaited before
+                # that transition) — then audit synchronously, before
+                # the retry loop can start another attempt
+                if g.gangs[pg_id]["state"] == "PENDING" and \
+                        any("fault" in n or "reserve" in n for n in notes):
+                    _assert_no_partial_gang(g, raylets)
+                    assert all(not r.bundles.get(pg_id)
+                               for r in raylets), \
+                        "rollback left a sibling reservation behind"
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"rollback never audited: "
+                    f"{g.gangs[pg_id]['history']}")
+        finally:
+            fi.disarm("gang.reserve")
+        # fault cleared: the pending retry loop reserves atomically
+        await _wait_gang_state(g, pg_id, "PLACED")
+        _assert_no_partial_gang(g, raylets)
+        assert sum(len(r.bundles.get(pg_id, {})) for r in raylets) == 2
+        states = [h["to"] for h in g.gangs[pg_id]["history"]]
+        assert states[:3] == ["PENDING", "RESERVING", "PENDING"]
+        assert states[-2:] == ["RESERVING", "PLACED"]
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_gang_fate_share_on_node_death_and_restartable_rereserve():
+    """A node death inside a PLACED gang fails the WHOLE gang in one
+    transition (surviving reservations released) and a restartable gang
+    re-runs atomic reservation onto the surviving capacity."""
+    async def body(g, raylets):
+        r1, r2 = raylets
+        # SPREAD (best-effort one-per-node): lands [r1, r2], and after
+        # the death the re-reservation may double up on the survivor
+        pg_id = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}, {"CPU": 1}], strategy="SPREAD",
+            restartable=True)
+        await _wait_gang_state(g, pg_id, "PLACED")
+        assert len(r1.bundles.get(pg_id, {})) == 1
+        assert len(r2.bundles.get(pg_id, {})) == 1
+        # the production wiring for an observed chip death (the
+        # autoscaler's provider reconcile reports it): dead FINAL —
+        # never heartbeat-resurrects, still-running raylet ordered down
+        assert await g.handle_report_node_failure(
+            r1.node_id, reason="chip failure")
+        assert g.nodes[r1.node_id]["death_final"] is True
+        # fate-share: FAILED in ONE transition, then restartable
+        # re-admission; both bundles re-reserve on the survivor
+        await _wait_gang_state(g, pg_id, "PLACED")
+        _assert_no_partial_gang(g, [r for r in raylets if r is not r1])
+        gang = g.gangs[pg_id]
+        states = [h["to"] for h in gang["history"]]
+        assert "FAILED" in states, states
+        i = states.index("FAILED")
+        # the failure transition is atomic: the very next states are the
+        # re-admission, never a partial continuation of the old gang
+        assert states[i:] == ["FAILED", "PENDING", "RESERVING", "PLACED"]
+        assert gang["fate_shared"] is True
+        assert "chip failure" in gang["failure"]
+        assert g.pgs[pg_id]["placement"] == [r2.node_id, r2.node_id]
+        # the GCS orders the dead-final node down on its next heartbeat;
+        # its stopped raylet then holds no reservations
+        deadline = time.time() + 10
+        while time.time() < deadline and r1.bundles.get(pg_id):
+            await asyncio.sleep(0.1)
+        assert not r1.bundles.get(pg_id)
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_gang_fate_share_nonrestartable_fails_terminally():
+    async def body(g, raylets):
+        r1, r2 = raylets
+        pg_id = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+        await _wait_gang_state(g, pg_id, "PLACED")
+        await g._mark_node_dead(r2.node_id, reason="preempted",
+                                final=True)
+        await _wait_gang_state(g, pg_id, "FAILED")
+        # the dead node clears its local tables on the heartbeat-ordered
+        # shutdown; survivors released synchronously in the fate-share
+        deadline = time.time() + 10
+        while time.time() < deadline and r2.bundles.get(pg_id):
+            await asyncio.sleep(0.1)
+        _assert_no_partial_gang(g, raylets)
+        assert g.pgs[pg_id]["state"] == "FAILED"
+        # waiters resolve instead of hanging
+        reply = await g.handle_wait_placement_group_ready(pg_id, timeout=1)
+        assert reply["state"] == "FAILED"
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_priority_preemption_claims_drain_and_admission():
+    """The two-tenant scenario at the control-plane level: a priority-5
+    gang evicts the priority-0 gang over the drain protocol, holds a
+    claim (no later arrival can steal the capacity), and is admitted the
+    moment the victim's reservations release — the preempt drain is then
+    CANCELLED, not ridden to node death."""
+    async def body(g, raylets):
+        r1, r2 = raylets
+        low = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE")
+        await _wait_gang_state(g, low, "PLACED")
+        assert sorted(set(g.pgs[low]["placement"])) == \
+            sorted([r1.node_id, r2.node_id])
+
+        high = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE",
+            priority=5)
+        # the victim enters PREEMPTING and its nodes drain
+        await _wait_gang_state(g, low, "PREEMPTING")
+        assert g.gangs[low]["preempted_by"] == high
+        assert sorted(g.gangs[high]["claim_nodes"]) == \
+            sorted([r1.node_id, r2.node_id])
+        for nid in (r1.node_id, r2.node_id):
+            assert g.nodes[nid]["state"] == "DRAINING"
+        _assert_no_partial_gang(g, raylets)
+
+        # no-livelock: a later same-shape arrival cannot take the
+        # claimed capacity once it frees
+        late = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE")
+
+        # the victim vacates (its controller checkpoint-restarted
+        # elsewhere): reservations release -> drain cancelled -> the
+        # CLAIMANT is admitted
+        await g.handle_remove_placement_group(low)
+        await _wait_gang_state(g, high, "PLACED")
+        _assert_no_partial_gang(g, raylets)
+        assert g.gangs[high].get("claim_nodes") in (None, []), \
+            "claim must clear at admission"
+        for nid in (r1.node_id, r2.node_id):
+            assert g.nodes[nid]["state"] == "ALIVE", "drain not cancelled"
+            assert g.nodes[nid]["alive"]
+        # the raylets adopted the cancellation too (push or heartbeat)
+        deadline = time.time() + 5
+        while time.time() < deadline and (r1.draining or r2.draining):
+            await asyncio.sleep(0.1)
+        assert not r1.draining and not r2.draining
+        # the late arrival is still waiting — it never jumped the claim
+        assert g.gangs[late]["state"] == "PENDING"
+        history = [h["to"] for h in g.gangs[late]["history"]]
+        assert "PLACED" not in history
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_preempt_drain_fault_leaves_retryable_claim():
+    """An injected fault on the preempt-drain leg must not leave a
+    half-drained victim set: the claim stands and the next scheduler
+    pass retries the drain."""
+    async def body(g, raylets):
+        r1, r2 = raylets
+        low = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE")
+        await _wait_gang_state(g, low, "PLACED")
+        fi.arm("gang.preempt.drain", nth=1, count=1,
+               exc=ConnectionError("drain RPC lost"))
+        try:
+            high = await g.handle_create_placement_group(
+                bundles=[{"CPU": 2}, {"CPU": 2}],
+                strategy="STRICT_PACK_SLICE", priority=5)
+            # first node's drain faulted; the retry pass covers it
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if all(g.nodes[n]["state"] == "DRAINING"
+                       for n in (r1.node_id, r2.node_id)):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError(
+                    f"drains never completed: "
+                    f"{[g.nodes[n]['state'] for n in (r1.node_id, r2.node_id)]}")
+            assert sorted(g.gangs[high]["claim_nodes"]) == \
+                sorted([r1.node_id, r2.node_id])
+        finally:
+            fi.disarm("gang.preempt.drain")
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_remove_mid_reserving_is_not_resurrected():
+    """A pg removed while its reservation pass is in flight must stay
+    REMOVED: the resuming commit releases everything instead of
+    resurrecting a zombie gang that permanently holds raylet capacity."""
+    async def body(g, raylets):
+        # the 2nd bundle's reserve HANGS 1s: a removal lands mid-pass
+        fi.arm("gang.reserve", nth=2, count=1, exc="delay:1.0")
+        try:
+            pg_id = await g.handle_create_placement_group(
+                bundles=[{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+            await _wait_gang_state(g, pg_id, "RESERVING", timeout=5.0)
+            await g.handle_remove_placement_group(pg_id)
+            assert g.gangs[pg_id]["state"] == "REMOVED"
+            # the in-flight pass resumes: it must NOT flip the gang back
+            # to PLACED or keep any reservation behind
+            deadline = time.time() + 10
+            while time.time() < deadline and any(
+                    r.bundles.get(pg_id) for r in raylets):
+                await asyncio.sleep(0.1)
+            assert g.gangs[pg_id]["state"] == "REMOVED"
+            assert g.pgs[pg_id]["state"] == "REMOVED"
+            assert all(not r.bundles.get(pg_id) for r in raylets), \
+                "zombie reservation survived removal"
+        finally:
+            fi.disarm("gang.reserve")
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_claim_released_when_claimed_nodes_die():
+    """A victim that rides the preempt drain into its deadline takes the
+    claimed nodes down with it; the claimant must release the dead claim
+    (not pin itself to corpses) and place the moment capacity exists."""
+    async def body(g, raylets):
+        from ray_tpu._private.raylet import Raylet
+
+        r1, r2 = raylets
+        low = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE")
+        await _wait_gang_state(g, low, "PLACED")
+        high = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE",
+            priority=5)
+        await _wait_gang_state(g, low, "PREEMPTING")
+        # the victim never vacates: the 1s drain deadline expires, the
+        # nodes die, the victim fate-shares FAILED, and the claim now
+        # points at corpses — the claimant must shed it
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if not (g.gangs[high].get("claim_nodes") or []):
+                break
+            await asyncio.sleep(0.2)
+        assert not (g.gangs[high].get("claim_nodes") or []), \
+            "claim over dead nodes never released"
+        assert g.gangs[high]["state"] == "PENDING"
+        notes = [h.get("note", "") for h in g.gangs[high]["history"]]
+        assert any("claim released" in n for n in notes), notes
+        # fresh capacity arrives: the unwedged claimant places on it
+        extra = []
+        try:
+            for w in ("0", "1"):
+                r = Raylet(r1.session_dir, g.addr, {"CPU": 2.0},
+                           labels={"tpu-slice-name": "s2",
+                                   "tpu-worker-index": w})
+                await r.start()
+                extra.append(r)
+            await _wait_gang_state(g, high, "PLACED", timeout=15.0)
+            assert set(g.pgs[high]["placement"]) == \
+                {r.node_id for r in extra}
+        finally:
+            for r in extra:
+                try:
+                    await r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    _gang_env(body, _SLICE_2X,
+              flags={"gang_preempt_drain_deadline_s": 1.0})
+
+
+def test_unpreempt_when_claimant_satisfied_elsewhere():
+    """A claimant that places on capacity freed ELSEWHERE before its
+    victims vacate must release the claim: victims revert to PLACED and
+    their preempt drains are cancelled — nobody needs that eviction."""
+    async def body(g, raylets):
+        from ray_tpu._private.raylet import Raylet
+
+        r1, r2 = raylets
+        low = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE")
+        await _wait_gang_state(g, low, "PLACED")
+        high = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE",
+            priority=5)
+        await _wait_gang_state(g, low, "PREEMPTING")
+        # a fresh slice joins before the victim vacates
+        extra = []
+        try:
+            for w in ("0", "1"):
+                r = Raylet(r1.session_dir, g.addr, {"CPU": 2.0},
+                           labels={"tpu-slice-name": "s2",
+                                   "tpu-worker-index": w})
+                await r.start()
+                extra.append(r)
+            await _wait_gang_state(g, high, "PLACED")
+            placed_on = set(g.pgs[high]["placement"])
+            assert placed_on == {r.node_id for r in extra}, placed_on
+            # the victim is un-preempted, its drains cancelled
+            await _wait_gang_state(g, low, "PLACED")
+            assert g.gangs[low].get("preempted_by") is None
+            notes = [h.get("note", "") for h in g.gangs[low]["history"]]
+            assert any("preemption released" in n for n in notes), notes
+            deadline = time.time() + 10
+            while time.time() < deadline and any(
+                    g.nodes[n]["state"] == "DRAINING"
+                    for n in (r1.node_id, r2.node_id)):
+                await asyncio.sleep(0.1)
+            for nid in (r1.node_id, r2.node_id):
+                assert g.nodes[nid]["state"] == "ALIVE"
+            _assert_no_partial_gang(g, raylets + extra)
+        finally:
+            for r in extra:
+                try:
+                    await r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_unpreempt_when_claimant_removed():
+    """Removing a claimant gang mid-preemption releases its claim: the
+    victim reverts to PLACED and keeps its capacity."""
+    async def body(g, raylets):
+        r1, r2 = raylets
+        low = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE")
+        await _wait_gang_state(g, low, "PLACED")
+        high = await g.handle_create_placement_group(
+            bundles=[{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK_SLICE",
+            priority=5)
+        await _wait_gang_state(g, low, "PREEMPTING")
+        await g.handle_remove_placement_group(high)
+        await _wait_gang_state(g, low, "PLACED")
+        assert g.gangs[low].get("preempted_by") is None
+        assert g.gangs[high]["state"] == "REMOVED"
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                g.nodes[n]["state"] == "DRAINING"
+                for n in (r1.node_id, r2.node_id)):
+            await asyncio.sleep(0.1)
+        for nid in (r1.node_id, r2.node_id):
+            assert g.nodes[nid]["state"] == "ALIVE"
+        # the victim still holds its full reservation
+        _assert_no_partial_gang(g, raylets)
+        assert sum(len(r.bundles.get(low, {})) for r in raylets) == 2
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_pg_lifetime_scoping_and_detached_survival():
+    """Non-detached placement groups are reclaimed when their job
+    finishes; lifetime="detached" groups survive until explicit
+    removal."""
+    async def body(g, raylets):
+        scoped = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}], strategy="PACK", job_id=7)
+        detached = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}], strategy="PACK", job_id=7,
+            lifetime="detached")
+        other = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}], strategy="PACK", job_id=8)
+        for pg in (scoped, detached, other):
+            await _wait_gang_state(g, pg, "PLACED")
+        await g.handle_mark_job_finished(7)
+        assert g.pgs[scoped]["state"] == "REMOVED"
+        assert g.gangs[scoped]["state"] == "REMOVED"
+        assert g.pgs[detached]["state"] == "CREATED", \
+            "detached group must survive its driver's job"
+        assert g.pgs[other]["state"] == "CREATED"
+        _assert_no_partial_gang(g, raylets)
+
+    _gang_env(body, _SLICE_2X)
+
+
+def test_gcs_restart_mid_reserving_rolls_back(tmp_path):
+    """A GCS that persisted a gang in RESERVING and crashed restores it
+    as PENDING (reservation outcome unknown -> rollback), never as a
+    gang claiming partial capacity."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+
+    config.reload({"gcs_storage": "file",
+                   "gcs_storage_path": str(tmp_path / "gcs.pkl")})
+
+    async def phase1():
+        sd = tempfile.mkdtemp()
+        os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+        g = GcsServer(sd)
+        await g.start()
+        # no raylets: the gang parks in PENDING; force RESERVING as the
+        # crash snapshot state through the one legal write path
+        pg_id = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}], strategy="PACK")
+        g._gang_transition(pg_id, "RESERVING",
+                           planned_placement=["gone-node"])
+        g._write_snapshot()
+        await g.stop()
+        return sd, pg_id
+
+    async def phase2(sd, pg_id):
+        g = GcsServer(sd)
+        assert g.gangs[pg_id]["state"] == "PENDING"
+        notes = [h.get("note", "") for h in g.gangs[pg_id]["history"]]
+        assert any("rolled back" in n for n in notes), notes
+        await g.stop()
+
+    try:
+        sd, pg_id = asyncio.run(phase1())
+        asyncio.run(phase2(sd, pg_id))
+    finally:
+        config.reload()
+
+
+def test_slice_topology_table_and_list_gangs():
+    async def body(g, raylets):
+        pg_id = await g.handle_create_placement_group(
+            bundles=[{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK_SLICE",
+            name="gang-a", priority=2)
+        await _wait_gang_state(g, pg_id, "PLACED")
+        gangs = await g.handle_list_gangs()
+        (row,) = [r for r in gangs if r["gang_id"] == pg_id]
+        assert row["state"] == "PLACED" and row["priority"] == 2
+        assert row["name"] == "gang-a"
+        assert len(row["placement"]) == 2
+        assert [h["to"] for h in row["history"]][-1] == "PLACED"
+        topo = await g.handle_get_slice_topology()
+        (s1,) = [s for s in topo if s["slice"] == "s1"]
+        assert [h["worker_index"] for h in s1["hosts"]] == ["0", "1"]
+        placed_on = [h for h in s1["hosts"] if h["gangs"]]
+        assert placed_on, "slice table must show the placed gang"
+
+    _gang_env(body, _SLICE_2X)
+
+
+# ---------------------------------------------------------------------------
+# 3. cluster-level e2e
+# ---------------------------------------------------------------------------
+
+
+def test_get_current_placement_group_and_capture(ray_start):
+    """get_current_placement_group resolves from the runtime context and
+    capture_child_tasks routes nested submissions into the same gang."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import (
+        get_current_placement_group, placement_group,
+        remove_placement_group)
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(30)
+    try:
+        assert get_current_placement_group() is None  # driver scope
+
+        @ray_tpu.remote
+        def inner():
+            from ray_tpu.util.placement_group import (
+                get_current_placement_group as gcp)
+
+            cur = gcp()
+            return cur.id.hex() if cur is not None else None
+
+        @ray_tpu.remote
+        def outer(capture):
+            import ray_tpu as rt
+            from ray_tpu.util.placement_group import (
+                get_current_placement_group as gcp)
+
+            cur = gcp()
+            child = rt.get(inner.options(num_cpus=0).remote(), timeout=30)
+            return (cur.id.hex() if cur is not None else None,
+                    cur.bundle_count if cur is not None else 0, child)
+
+        got = ray_tpu.get(
+            outer.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg, placement_group_capture_child_tasks=True),
+            ).remote(True), timeout=60)
+        assert got[0] == pg.id.hex()
+        assert got[1] == 1
+        assert got[2] == pg.id.hex(), \
+            "capture_child_tasks must land the nested task in the gang"
+
+        got = ray_tpu.get(
+            outer.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(pg),
+            ).remote(False), timeout=60)
+        assert got[0] == pg.id.hex()
+        assert got[2] is None, \
+            "without capture the nested task must NOT inherit the gang"
+    finally:
+        remove_placement_group(pg)
+
+
+def test_chaos_preempt_slice_fate_shares(no_cluster, monkeypatch):
+    """The ChaosTimeline ``preempt_slice`` action kills a whole slice;
+    the PLACED restartable gang there fate-shares (FAILED in one
+    transition) and re-reserves atomically on the surviving slice —
+    audited via the gang history."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.chaos import ChaosTimeline
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.state import list_gangs
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    try:
+        cluster.connect()
+        for s, w in (("s1", 0), ("s1", 1), ("s2", 0), ("s2", 1)):
+            cluster.add_node(num_cpus=2,
+                             labels={"tpu-slice-name": s,
+                                     "tpu-worker-index": str(w)})
+        cluster.wait_for_nodes()
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_PACK_SLICE",
+                             restartable=True)
+        assert pg.wait(30)
+        # equal slice sizes: the name tiebreak places on s1
+        tl = ChaosTimeline([{"at": 0.1, "kind": "preempt_slice",
+                             "slice": "s1"}], seed=3)
+        # determinism gate: same spec + seed -> identical plan
+        assert tl.plan() == ChaosTimeline(
+            [{"at": 0.1, "kind": "preempt_slice", "slice": "s1"}],
+            seed=3).plan()
+        tl.start()
+        tl.join(timeout=30)
+        (fired,) = tl.executed()
+        assert fired["ok"], fired
+        assert fired["result"]["slice"] == "s1"
+        assert len(fired["result"]["preempted"]) == 2
+
+        # the gang fate-shares and re-reserves on s2
+        deadline = time.time() + 45
+        row = None
+        while time.time() < deadline:
+            rows = [r for r in list_gangs()
+                    if r["gang_id"] == pg.id.hex()]
+            row = rows[0] if rows else None
+            if row and row["state"] == "PLACED" and \
+                    row.get("fate_shared"):
+                break
+            time.sleep(0.5)
+        assert row is not None, "gang vanished"
+        states = [h["to"] for h in row["history"]]
+        assert "FAILED" in states, (row["state"], states)
+        i = states.index("FAILED")
+        assert states[i:] == ["FAILED", "PENDING", "RESERVING", "PLACED"], \
+            states
+        assert row["fate_shared"] is True
+        assert row["state"] == "PLACED", states
+        # the re-reservation landed on the surviving slice, whole-gang
+        placement = row["placement"]
+        assert placement is not None and len(placement) == 2
+        dead = set(fired["result"]["preempted"])
+        assert not (set(placement) & dead), (placement, dead)
+    finally:
+        cluster.shutdown()
+
+
+def test_two_tenant_priority_preemption_e2e(no_cluster, tmp_path,
+                                            monkeypatch):
+    """THE acceptance scenario: a low-priority training gang occupies
+    the slice; a high-priority gang arrives and lands within the drain
+    deadline while the low-priority job checkpoint-restarts on a
+    clamp_to-smaller worker group with ZERO failure-budget charge
+    (max_failures=0 — any charged failure would fail the run)."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.policies import ElasticScalingPolicy
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    from ray_tpu.util.state import list_gangs
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    monkeypatch.setenv("RAY_TPU_GANG_PREEMPT_DRAIN_DEADLINE_S", "12.0")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        # slice s1: two hosts (the contended slice); slice s2: one host
+        # (where the preempted run re-meshes smaller)
+        for i in range(2):
+            cluster.add_node(num_cpus=2, resources={"trainer_slot": 1},
+                             labels={"tpu-slice-name": "s1",
+                                     "tpu-worker-index": str(i)})
+        cluster.add_node(num_cpus=2, resources={"trainer_slot": 1},
+                         labels={"tpu-slice-name": "s2",
+                                 "tpu-worker-index": "0"})
+        cluster.wait_for_nodes()
+        side = str(tmp_path / "side")
+        os.makedirs(side, exist_ok=True)
+
+        def loop(config):
+            import json as _json
+            import os as _os
+            import tempfile as _tempfile
+            import time as _t
+
+            from ray_tpu import train as _train
+
+            ctx = _train.get_context()
+            rank = ctx.get_world_rank()
+            start = 0
+            ck = ctx.get_checkpoint()
+            if ck is not None:
+                with open(_os.path.join(ck.path, "state.json")) as f:
+                    start = _json.load(f)["step"] + 1
+            for step in range(start, config["steps"]):
+                with open(_os.path.join(
+                        config["side_dir"],
+                        f"r{rank}-step{step}-{_t.time_ns()}"), "w") as f:
+                    _json.dump({"step": step, "rank": rank,
+                                "world": ctx.get_world_size()}, f)
+                _t.sleep(config["step_s"])
+                d = _tempfile.mkdtemp()
+                with open(_os.path.join(d, "state.json"), "w") as f:
+                    _json.dump({"step": step}, f)
+                _train.report({"step": step,
+                               "world": ctx.get_world_size()},
+                              checkpoint=_train.Checkpoint(d))
+
+        # low-priority tenant: gang-scheduled onto slice s1
+        # (STRICT_PACK_SLICE via topology=), elastic 1..2 workers,
+        # ZERO failure budget — the preemption must ride the no-charge
+        # drain path or this run fails
+        trainer = train.DataParallelTrainer(
+            loop,
+            train_loop_config={"side_dir": side, "steps": 8,
+                               "step_s": 0.5},
+            scaling_config=train.ScalingConfig(
+                num_workers=2, topology="v5e-8",
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+            run_config=train.RunConfig(
+                name="low-pri", storage_path=str(tmp_path),
+                failure_config=train.FailureConfig(max_failures=0)),
+            scaling_policy=ElasticScalingPolicy(
+                min_workers=1, max_workers=2, settle_s=1.0,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+        )
+        result_box = {}
+
+        def run_trainer():
+            try:
+                result_box["result"] = trainer.fit()
+            except BaseException as e:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+                result_box["error"] = e
+
+        t = threading.Thread(target=run_trainer, daemon=True)
+        t.start()
+
+        # wait until the 2-worker gang is running on s1 (step evidence)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if any(n.startswith("r1-step1-") for n in os.listdir(side)):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("low-pri run never reached step 1 at "
+                                 "world 2")
+
+        # high-priority tenant arrives: needs the whole contended slice
+        t0 = time.time()
+        pg = placement_group(
+            [{"CPU": 1, "trainer_slot": 1}] * 2,
+            strategy="STRICT_PACK_SLICE", priority=5, name="high-pri")
+        assert pg.wait(timeout_seconds=30), \
+            "high-priority gang did not land within the drain window"
+        landed_after = time.time() - t0
+        assert landed_after < 25.0, landed_after
+
+        # the low-priority run finishes from its pre-drain checkpoint
+        # with no failure-budget charge (max_failures=0) and no step gap
+        t.join(timeout=120)
+        assert not t.is_alive(), "trainer wedged after preemption"
+        assert "error" not in result_box, result_box.get("error")
+        result = result_box["result"]
+        assert result.error is None, result.error
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps[-1] == 7, steps
+        for a, b in zip(steps, steps[1:]):
+            assert b == a + 1 or b <= a, f"step gap: {steps}"
+        # it re-meshed SMALLER (clamp_to path): post-preemption evidence
+        # at world 1
+        worlds = set()
+        for name in os.listdir(side):
+            with open(os.path.join(side, name)) as f:
+                worlds.add(json.load(f)["world"])
+        assert worlds == {2, 1}, worlds
+
+        # audit the gang table: high-pri PLACED on the contended slice,
+        # the victim generation preempted, nothing partial
+        rows = list_gangs()
+        (high,) = [r for r in rows if r["gang_id"] == pg.id.hex()]
+        assert high["state"] == "PLACED"
+        assert len(high["placement"]) == 2
+        assert any(r.get("preempted_by") == pg.id.hex() for r in rows), \
+            [(r["name"], r["state"]) for r in rows]
+        for r in rows:
+            if r["state"] == "PLACED":
+                assert len(r["placement"]) == r["bundle_count"]
+            elif r["state"] in ("FAILED", "REMOVED", "PENDING"):
+                assert not r["placement"], r
+        remove_placement_group(pg)
+    finally:
+        cluster.shutdown()

@@ -104,11 +104,26 @@ def test_engine_continuous_batching():
         outs[1].token_ids != outs[2].token_ids
 
 
+class _TickClock:
+    """Deterministic bandit clock: every read advances one tick, so each
+    arm's measured elapsed is exactly 1 unit and per-arm tokens/s is a
+    pure function of the WORKLOAD (tokens yielded per pass) — a loaded
+    box's scheduling stalls can't flip the win-arm decision."""
+
+    def __init__(self):
+        self.t = 0
+
+    def __call__(self):
+        self.t += 1
+        return self.t
+
+
 def test_engine_speculative_matches_plain():
     """Paged prompt-lookup speculative decoding (spec_tokens=G) must be
     token-EXACT vs the plain engine: greedy acceptance only keeps tokens
     argmax would have produced.  Repetitive prompts make the drafter
-    fire; a non-repetitive one exercises the fallback window."""
+    fire; a non-repetitive one rides the verify pass with an empty
+    proposal (bonus token only) instead of vetoing the whole batch."""
     import jax.numpy as jnp
 
     from ray_tpu.llm import LLMEngine
@@ -121,9 +136,13 @@ def test_engine_speculative_matches_plain():
     plain = LLMEngine(cfg, params, batch_slots=4, max_len=96)
     ref = plain.generate(prompts, sp)
     # window=1 so the spec check runs every token; with the fixed seed
-    # the tiny model cycles quickly, so the n-gram drafter fires
+    # the tiny model cycles quickly, so the n-gram drafter fires.  The
+    # injected tick clock makes the bandit's arm timings workload-pure
+    # (verify yields >= 1 token per tick, same as the 1-token window),
+    # so the run is deterministic on any machine.
     spec = LLMEngine(cfg, params, batch_slots=4, max_len=96,
-                     spec_tokens=4, decode_window=1)
+                     spec_tokens=4, decode_window=1,
+                     arm_clock=_TickClock())
     got = spec.generate(prompts, sp)
     for a, b in zip(ref, got):
         assert a.token_ids == b.token_ids, (a.token_ids, b.token_ids)
@@ -676,33 +695,33 @@ def test_engine_speculative_win_arm_beats_window():
     cfg = LlamaConfig.tiny(num_layers=2, dtype=jnp.float32)
     params = llama_init(jax.random.PRNGKey(0), cfg)
 
-    # phase 1: find the greedy steady loop (tiny random models settle
-    # into short cycles; the tail is the loop)
-    warm = LLMEngine(cfg, params, batch_slots=1, max_len=96)
-    tail = warm.generate([[5, 6, 7, 8]],
-                         SamplingParams(temperature=0.0, max_tokens=60)
-                         )[0].token_ids[-24:]
+    # phase 1: drive the model INTO its greedy steady loop and keep the
+    # WHOLE converged trajectory as the phase-2 prompt.  (Truncating to
+    # the trailing cycle changes the model state — a fresh context of
+    # just the loop tokens continues differently — which is why the old
+    # tail-only prompt mispredicted and made this test flaky.)
+    warm = LLMEngine(cfg, params, batch_slots=1, max_len=512)
+    warm_out = warm.generate([[5, 6, 7, 8]],
+                             SamplingParams(temperature=0.0,
+                                            max_tokens=400))[0]
+    tail = [5, 6, 7, 8] + warm_out.token_ids
 
     # phase 2: decode_window=1 <= G+1=5 — every window sync yields 1
-    # token, a high-acceptance verify yields up to 5.  The throughput
-    # assertions depend on wall-clock arm timings, so a scheduling stall
-    # on a loaded box gets ONE retry with a fresh engine before failing
-    # (the token-exactness check below stays strict either way).
-    for attempt in range(2):
-        eng = LLMEngine(cfg, params, batch_slots=1, max_len=512,
-                        spec_tokens=4, decode_window=1)
-        out = eng.generate([list(tail)],
-                           SamplingParams(temperature=0.0,
-                                          max_tokens=300))[0]
-        assert len(out.token_ids) == 300
-        st = eng.spec_stats
-        acc = st["accepted"] / max(1, st["proposed"])
-        v = eng._arm_tps.get("verify")
-        w = eng._arm_tps.get(("window", 1))
-        timing_ok = (st["backoffs"] == 0 and v is not None
-                     and w is not None and v > w)
-        if timing_ok or attempt == 1:
-            break
+    # token, a high-acceptance verify yields up to 5.  The bandit runs
+    # on the injected tick clock, so its per-arm tokens/s is tokens per
+    # PASS — a pure function of the seeded workload, identical on every
+    # machine (the old wall-clock timings flipped under load).
+    eng = LLMEngine(cfg, params, batch_slots=1, max_len=1024,
+                    spec_tokens=4, decode_window=1,
+                    arm_clock=_TickClock())
+    out = eng.generate([list(tail)],
+                       SamplingParams(temperature=0.0,
+                                      max_tokens=300))[0]
+    assert len(out.token_ids) == 300
+    st = eng.spec_stats
+    acc = st["accepted"] / max(1, st["proposed"])
+    v = eng._arm_tps.get("verify")
+    w = eng._arm_tps.get(("window", 1))
     assert st["verify_steps"] >= 40, st
     assert acc >= 0.8, f"steady-loop workload should accept: {acc} ({st})"
     # the bandit kept the win arm on: a rest would mean it judged the
@@ -712,7 +731,7 @@ def test_engine_speculative_win_arm_beats_window():
     assert v is not None and w is not None, eng._arm_tps
     assert v > w, f"verify arm must beat the 1-token window: {eng._arm_tps}"
     # token-exactness vs the plain engine on the same workload
-    plain = LLMEngine(cfg, params, batch_slots=1, max_len=512)
+    plain = LLMEngine(cfg, params, batch_slots=1, max_len=1024)
     ref = plain.generate([list(tail)],
                          SamplingParams(temperature=0.0, max_tokens=300))[0]
     assert out.token_ids == ref.token_ids
