@@ -336,8 +336,9 @@ def measure_step_breakdown(tr, state, b, steps: int = 3,
     measures the opt-out floor the ISSUE acceptance names) — and
     returns ``(state, breakdown)`` where ``breakdown`` is
     the record's ``step_time_breakdown`` block: mean seconds per bucket
-    (compute / data_wait / h2d / collective_wait / checkpoint /
-    weight_publish / other), the mean step wall, and the measured
+    (compute / data_wait / h2d / collective_wait / checkpoint_snapshot /
+    checkpoint_persist / weight_publish / other), the mean step wall,
+    and the measured
     instrumentation overhead with tracing off.  Each loop does a
     per-step loss readback so the two time the same sync pattern;
     min-of-``runs`` per-step times make the overhead number robust to

@@ -1,0 +1,230 @@
+"""Checkpoint A/B: synchronous whole-tree stall vs async tiered save.
+
+PR 17 acceptance gate: a training step that checkpoints through the
+async tiered path (:class:`~ray_tpu.train.checkpoint_async.
+AsyncCheckpointer`) must stall for at most **25%** of what the
+synchronous whole-tree baseline stalls, at equal durability.  Both arms
+run the SAME save machinery — snapshot (D2H + serialize) then
+write+fsync+rename-commit — the only difference is *when the step
+resumes*:
+
+* ``sync``  — ``save(..., wait_persist=True)``: the step blocks until
+  the shard is fsynced and the generation's MANIFEST is committed
+  (what a plain ``Checkpoint.from_pytree`` loop pays every step);
+* ``async`` — ``save(...)``: the step resumes once the snapshot is in
+  host RAM; serialize+fsync+commit runs on the persist thread,
+  overlapping the next step's compute.
+
+The arms are **interleaved** step-for-step in one run (sync step i,
+then async step i), so background load drift hits both equally.  Each
+arm drives its own :class:`~ray_tpu.train.session.StepLedger`; the
+record carries both ``step_time_breakdown`` blocks, and the gate
+requires the split buckets (``checkpoint_snapshot`` /
+``checkpoint_persist``) visible in both.  Per-arm **stall** is
+``mean(step_wall − compute)`` — everything the checkpoint added to the
+step's critical path.
+
+Equal durability is asserted, not assumed: after the loop (and one
+``wait()`` to drain the async persist queue) both storage dirs must
+hold the same number of rename-committed generations, and the async
+arm's newest generation must restore bit-exact against the saved tree.
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py \
+        [--mib 32] [--steps 6] [--dir /path/with/real/fsync]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.bench_emit import emit_final_record
+
+GATE_STALL_RATIO = 0.25
+
+
+def _make_state(mib: int):
+    """A model-shaped pytree totaling ~``mib`` MiB of float32 leaves."""
+    import jax
+    import numpy as np
+
+    n_leaves = 8
+    per = (mib * 1024 * 1024) // (4 * n_leaves)
+    rng = np.random.default_rng(0)
+    host = {f"layer_{i}": rng.standard_normal(per).astype("float32")
+            for i in range(n_leaves)}
+    return jax.device_put(host)
+
+
+def _calibrated_compute(target_s: float):
+    """A jitted matmul loop sized so one call takes ~``target_s`` — the
+    'next step's compute' the async persist overlaps with."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((768, 768), dtype=jnp.float32)
+
+    @jax.jit
+    def mm(a):
+        return jnp.tanh(a @ a) * 0.5
+
+    jax.block_until_ready(mm(x))  # compile outside the timing
+    t0 = time.perf_counter()
+    jax.block_until_ready(mm(x))
+    t_one = max(time.perf_counter() - t0, 1e-4)
+    reps = max(1, int(target_s / t_one) + 1)
+
+    def compute():
+        y = x
+        for _ in range(reps):
+            y = mm(y)
+        jax.block_until_ready(y)
+
+    return compute
+
+
+def _run_arm_step(ledger, compute, ckptr, state, step, sync):
+    # save FIRST, then compute: the async arm's background persist then
+    # overlaps THIS step's compute, so the ledger attributes it to the
+    # step it actually overlapped (in an interleaved A/B the next step
+    # belongs to the other arm, which would hide the persist between
+    # this ledger's step boundaries)
+    with ledger.step():
+        ckptr.save(state, {"step": step}, wait_persist=sync)
+        with ledger.bucket("compute"):
+            compute()
+
+
+def _stall_s(bd):
+    return max(bd["step_wall_s"] - bd["buckets_s"].get("compute", 0.0), 0.0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mib", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--dir", default=None,
+                    help="parent dir for the two checkpoint stores "
+                         "(default: a tempdir under the cwd, so fsync "
+                         "hits the working disk, not a tmpfs)")
+    args = ap.parse_args()
+
+    import jax  # noqa: F401  — fail fast before building state
+    import numpy as np
+
+    from ray_tpu.train.checkpoint_async import (
+        AsyncCheckpointer, restore_tiered)
+    from ray_tpu.train.checkpoint_manager import committed_checkpoint_dirs
+    from ray_tpu.train.session import StepLedger
+
+    root = args.dir or tempfile.mkdtemp(prefix="ckpt_bench_", dir=os.getcwd())
+    dirs = {"sync": os.path.join(root, "sync"),
+            "async": os.path.join(root, "async")}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+
+    state = _make_state(args.mib)
+
+    # calibrate the per-step compute to ~3x one full sync persist, so
+    # the async arm's background write genuinely overlaps (and finishes
+    # inside) the same step's compute — the overlap claim, not a toy
+    # sleep.  Two probes, take the slower: fsync cost swings with the
+    # page-cache state, and an undersized compute window lets the
+    # persist spill past the step boundary (where the ledger correctly
+    # refuses to charge it)
+    probe = AsyncCheckpointer(dirs["sync"], "ckpt-bench-probe", 0, 1,
+                              publish_status=False)
+    t_persist = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        probe.save(state, wait_persist=True)
+        t_persist = max(t_persist, time.perf_counter() - t0)
+    probe.close()
+    shutil.rmtree(dirs["sync"])
+    os.makedirs(dirs["sync"])
+    compute = _calibrated_compute(3.0 * t_persist)
+
+    ledgers = {a: StepLedger(group_name=f"ckpt-bench-{a}", publish=False)
+               for a in dirs}
+    ckptrs = {a: AsyncCheckpointer(dirs[a], f"ckpt-bench-{a}", 0, 1,
+                                   ledger=ledgers[a], publish_status=False)
+              for a in dirs}
+
+    # warmup step per arm (first-save index discovery, thread spawn)
+    for a in dirs:
+        _run_arm_step(ledgers[a], compute, ckptrs[a], state, 0, a == "sync")
+    ckptrs["async"].wait(60.0)
+    for a in dirs:  # drop the warmup from the measured breakdowns
+        ledgers[a].__init__(group_name=f"ckpt-bench-{a}", publish=False)
+
+    # the interleaved measured loop: sync step i, then async step i
+    for step in range(1, args.steps + 1):
+        for a in ("sync", "async"):
+            _run_arm_step(ledgers[a], compute, ckptrs[a], state,
+                          step, a == "sync")
+
+    # equal durability: drain the async queue, then both stores must
+    # hold the same number of rename-committed generations
+    drained = ckptrs["async"].wait(120.0)
+    committed = {a: len(committed_checkpoint_dirs(dirs[a])) for a in dirs}
+    res = restore_tiered(dirs["async"], "ckpt-bench-async")
+    restored_exact = res is not None and all(
+        np.array_equal(np.asarray(res.tree[k]), np.asarray(v))
+        for k, v in jax.device_get(state).items())
+
+    bds = {a: ledgers[a].breakdown() for a in dirs}
+    stall = {a: _stall_s(bds[a]) for a in dirs}
+    ratio = stall["async"] / stall["sync"] if stall["sync"] > 0 else 1.0
+    buckets_ok = all(
+        b in bds[a]["buckets_s"]
+        for a in dirs for b in ("checkpoint_snapshot", "checkpoint_persist"))
+    ok = (ratio <= GATE_STALL_RATIO and drained and restored_exact
+          and buckets_ok and committed["sync"] == committed["async"]
+          and committed["async"] >= args.steps)
+
+    for a in dirs:
+        ckptrs[a].close()
+    if args.dir is None:
+        shutil.rmtree(root, ignore_errors=True)
+
+    emit_final_record({
+        "metric": "checkpoint_async_stall_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_of_sync_stall",
+        "ok": bool(ok),
+        "detail": {
+            "scope": "checkpoint_ab",
+            "mib": args.mib,
+            "steps": args.steps,
+            "gate_stall_ratio": GATE_STALL_RATIO,
+            "stall_sync_ms": round(stall["sync"] * 1e3, 2),
+            "stall_async_ms": round(stall["async"] * 1e3, 2),
+            "persist_probe_ms": round(t_persist * 1e3, 2),
+            "committed_generations": committed,
+            "async_restore_bit_exact": bool(restored_exact),
+            "step_time_breakdown": {a: bds[a] for a in dirs},
+        },
+    })
+
+    assert buckets_ok, (
+        f"split buckets missing from a breakdown: "
+        f"{ {a: sorted(bds[a]['buckets_s']) for a in dirs} }")
+    assert drained and committed["sync"] == committed["async"] \
+        and committed["async"] >= args.steps, (
+        f"durability mismatch: committed={committed} (need >= {args.steps} "
+        f"in both), drained={drained}")
+    assert restored_exact, "async arm's newest generation not bit-exact"
+    assert ratio <= GATE_STALL_RATIO, (
+        f"async step stall is {ratio:.2%} of the sync baseline "
+        f"(gate: <= {GATE_STALL_RATIO:.0%}; "
+        f"sync {stall['sync']*1e3:.1f}ms vs async {stall['async']*1e3:.1f}ms)")
+
+
+if __name__ == "__main__":
+    main()

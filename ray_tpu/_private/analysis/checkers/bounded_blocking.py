@@ -167,11 +167,16 @@ class BoundedBlockingChecker(Checker):
     # (controller restart loops over fate-shareable gang members,
     # provision/reclaim over killable slices) — a dead peer never
     # writes its channel / resolves its ref, so a bare read wedges the
-    # control loop forever (the hang class PR 8 fixed by hand)
+    # control loop forever (the hang class PR 8 fixed by hand).
+    # util/checkpoint_replica.py is the peer-RAM checkpoint plane:
+    # every push/fetch targets a replica server on a *different* host
+    # that may be SIGKILLed at any instant — exactly the peer-death
+    # window the tier exists for — so its RPCs must all be bounded
     _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/",
                       "ray_tpu/experimental/channel/", "ray_tpu/dag/",
                       "ray_tpu/llm/", "ray_tpu/train/",
-                      "ray_tpu/autoscaler/")
+                      "ray_tpu/autoscaler/",
+                      "ray_tpu/util/checkpoint_replica.py")
 
     def check(self, pf: ParsedFile) -> Iterable[Finding]:
         out: List[Finding] = []

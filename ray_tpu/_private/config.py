@@ -94,6 +94,18 @@ _FLAG_DEFS: Dict[str, Any] = {
     # checkpoint before restarting the group anyway (always additionally
     # capped by the drain deadline itself)
     "train_drain_checkpoint_wait_s": 10.0,
+    # --- tiered checkpointing (train.checkpoint_async) ---
+    # backpressure bound: a save() issued while the previous persist is
+    # still in flight waits at most this long (never silently drops)
+    "train_checkpoint_persist_wait_s": 120.0,
+    # rank 0's bounded wait for every peer's shard before the manifest
+    # commit; expiry leaves the generation torn (.tmp, swept later)
+    "train_checkpoint_manifest_wait_s": 60.0,
+    # bound for one replica-plane RPC (peer push / fetch / manifest)
+    "train_checkpoint_replica_rpc_timeout_s": 30.0,
+    # drain windows shorter than this can't fit the disk persist: the
+    # controller requests a memory-tier (peer-RAM) checkpoint instead
+    "train_drain_memory_tier_floor_s": 5.0,
     # --- health / failure detection ---
     # (reference gcs_health_check_manager.h:45 timings)
     "health_check_period_s": 5.0,

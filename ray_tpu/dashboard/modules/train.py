@@ -5,9 +5,13 @@ its run's status (world size, latest rank-0 metrics, restarts, state)
 into the GCS KV under namespace "train" while the run is live; each
 worker's :class:`~ray_tpu.train.session.StepLedger` publishes its
 step-time attribution under ``step_breakdown/<group>/<rank>`` in the
-same namespace.  The head lists both with plain table reads; breakdown
-records from workers silent past the stale window are dropped (and
-swept — dead workers must not pin their last breakdown forever).
+same namespace, and each :class:`~ray_tpu.train.checkpoint_async.
+AsyncCheckpointer` publishes its latest tiered-checkpoint state under
+``ckpt_status/<run>/<rank>`` (generation index, tier reached, peer-RAM
+ack, committed path, snapshot/persist seconds).  The head lists all
+three with plain table reads; records from workers silent past the
+stale window are dropped (and swept — dead workers must not pin their
+last record forever).
 """
 
 from __future__ import annotations
@@ -21,8 +25,16 @@ _STALE_S = 600.0
 def routes(gcs, helpers):
     jresp = helpers["jresp"]
 
+    def _sweep_stale(ns, key, rec, now):
+        if now - rec.get("ts", now) > _STALE_S:
+            # head-side twin of handle_kv_del (same process)
+            gcs.kv.pop((ns, key), None)
+            gcs._dirty = True
+            return True
+        return False
+
     def _split_tables():
-        runs, breakdowns = [], []
+        runs, breakdowns, checkpoints = [], [], []
         now = time.time()
         for (ns, key), raw in list(gcs.kv.items()):
             if ns != "train":
@@ -32,23 +44,28 @@ def routes(gcs, helpers):
             except (ValueError, TypeError):
                 continue
             if key.startswith("step_breakdown/"):
-                if now - rec.get("ts", now) > _STALE_S:
-                    # head-side twin of handle_kv_del (same process)
-                    gcs.kv.pop((ns, key), None)
-                    gcs._dirty = True
+                if _sweep_stale(ns, key, rec, now):
                     continue
                 rec.setdefault("key", key[len("step_breakdown/"):])
                 breakdowns.append(rec)
+            elif key.startswith("ckpt_status/"):
+                if _sweep_stale(ns, key, rec, now):
+                    continue
+                rec.setdefault("key", key[len("ckpt_status/"):])
+                checkpoints.append(rec)
             else:
                 rec.setdefault("name", key)
                 runs.append(rec)
         runs.sort(key=lambda r: r.get("started_at", 0.0), reverse=True)
         breakdowns.sort(key=lambda r: (r.get("group", ""),
                                        r.get("rank", 0)))
-        return runs, breakdowns
+        checkpoints.sort(key=lambda r: (r.get("run", ""),
+                                        r.get("rank", 0)))
+        return runs, breakdowns, checkpoints
 
     async def api_train(_req):
-        runs, breakdowns = _split_tables()
-        return jresp({"runs": runs, "step_breakdowns": breakdowns})
+        runs, breakdowns, checkpoints = _split_tables()
+        return jresp({"runs": runs, "step_breakdowns": breakdowns,
+                      "checkpoints": checkpoints})
 
     return [("GET", "/api/train", api_train)]

@@ -92,9 +92,18 @@ async function renderOverview(root) {
       "step ms": (Number(r.step_wall_s || 0) * 1000).toFixed(1),
       compute: pct("compute"), "data wait": pct("data_wait"),
       h2d: pct("h2d"), "coll wait": pct("collective_wait"),
-      ckpt: pct("checkpoint"), "w-pub": pct("weight_publish"),
+      "ckpt snap": pct("checkpoint_snapshot"),
+      "ckpt persist": pct("checkpoint_persist"),
+      "w-pub": pct("weight_publish"),
       other: pct("other")};
   });
+  const ckptRows = (train.checkpoints || []).map(r => ({
+    run: r.run, rank: r.rank, gen: r.index, tier: r.tier,
+    "peer ack": r.ram_acked ? "yes" : "no",
+    committed: r.committed_path || "",
+    "snap ms": (Number(r.snapshot_s || 0) * 1000).toFixed(1),
+    "persist ms": (Number(r.persist_s || 0) * 1000).toFixed(1),
+    error: r.error || ""}));
   const dataRows = (data.iterators || []).map(r => ({
     iterator: r.iterator, state: r.done ? "done" : "running",
     blocks: r.blocks, batches: r.batches,
@@ -147,9 +156,13 @@ async function renderOverview(root) {
       ["name","status","world","iteration","restarts","metrics"]) +
     "<h2>Step breakdown</h2>" + (stepRows.length
       ? table(stepRows, ["group","rank","steps","step ms","compute",
-                         "data wait","h2d","coll wait","ckpt","w-pub",
-                         "other"])
+                         "data wait","h2d","coll wait","ckpt snap",
+                         "ckpt persist","w-pub","other"])
       : "<i>no step ledger reporting</i>") +
+    "<h2>Checkpoint tiers</h2>" + (ckptRows.length
+      ? table(ckptRows, ["run","rank","gen","tier","peer ack","committed",
+                         "snap ms","persist ms","error"])
+      : "<i>no tiered checkpointing active</i>") +
     "<h2>SLO verdicts</h2>" + (sloRows.length
       ? table(sloRows, ["plane","name","phase","status","metrics",
                         "violations"])

@@ -424,6 +424,12 @@ class Dataset:
                                           max_skew)
 
         def make_source(rank: int):
+            # filled by the terminal next_bundle reply (the splitter's
+            # final locality counters); the DataIterator folds it into
+            # its ingest stats at drain — locally, so the counters
+            # survive the coordinator's post-drain self-retirement
+            cell: Dict[str, Any] = {}
+
             def source():
                 # pipelined coordinator protocol: keep one next_bundle
                 # request in flight ahead of consumption, so the
@@ -433,11 +439,14 @@ class Dataset:
                 while True:
                     # raylint: disable=serial-blocking-get -- split-protocol get on a request issued one iteration ahead
                     bundle = ray_tpu.get(pending)
-                    if bundle is None:
+                    if not isinstance(bundle, RefBundle):
+                        if isinstance(bundle, dict):
+                            cell["split"] = bundle.get("split_stats")
                         break
                     pending = coord.next_bundle.remote(rank)
                     yield bundle
 
+            source.final_split = cell
             return source
 
         return [DataIterator(make_source(i), owner=coord) for i in range(n)]
@@ -521,7 +530,12 @@ class _SplitCoordinator:
             raise item  # executor failure: surface, don't truncate silently
         if item.__class__ is not RefBundle:
             self._mark_done(rank)
-            return None
+            # The terminal reply CARRIES the splitter's final counters:
+            # this actor retires itself shortly after the last rank
+            # drains, so a post-drain split_stats RPC races its exit —
+            # final stats must travel with the drain signal, not after
+            # it.
+            return {"split_stats": self._splitter.split_stats()}
         return item
 
     def _mark_done(self, rank: int):

@@ -530,6 +530,11 @@ class DataIterator:
     def __init__(self, bundle_source: Callable[[], Iterator], owner=None):
         self._source = bundle_source
         self._owner = owner  # keeps Dataset (and its executor) alive
+        # streaming_split sources carry a cell the terminal next_bundle
+        # reply fills with the splitter's final locality counters —
+        # read locally at drain, so stats survive the coordinator's
+        # post-drain self-retirement (pickles with the source closure)
+        self._final_split = getattr(bundle_source, "final_split", None)
         self._stats = IngestStats()
         # lookahead knobs snapshot at CREATION time, in the creating
         # process: DataContext is process-local, and split iterators ship
@@ -552,9 +557,25 @@ class DataIterator:
         self._merge_owner_split_stats()
         return self._stats.report()
 
+    def _merge_terminal_split_stats(self) -> bool:
+        """Fold the splitter counters the terminal ``next_bundle`` reply
+        carried (streaming_split) — local and race-free even after the
+        coordinator process retires itself.  False when this iterator's
+        stream has not drained (no terminal reply seen yet)."""
+        cell = self._final_split
+        if cell is None or cell.get("split") is None:
+            return False
+        self._stats.merge_split_stats(cell["split"])
+        return True
+
     def _merge_owner_split_stats(self, timeout: float = 5.0) -> None:
         """Fold the split coordinator's locality counters (if this
-        iterator came from ``streaming_split``) into the report."""
+        iterator came from ``streaming_split``) into the report.  The
+        drain-delivered snapshot wins when present; the RPC below is
+        the pre-drain fallback and races the coordinator's post-drain
+        retirement (best-effort by design)."""
+        if self._merge_terminal_split_stats():
+            return
         split_stats = getattr(self._owner, "split_stats", None)
         if split_stats is None:
             return
@@ -662,6 +683,12 @@ class DataIterator:
                     stats.add("batches", 1)
                     yield b
             finally:
+                # drain-time fold of the terminal split counters (no
+                # RPC): per-rank ingest stats keep their locality
+                # numbers after the coordinator retires — and the
+                # throttle below may skip short-lived iterators, so
+                # this cannot ride the publish's enrich hook
+                self._merge_terminal_split_stats()
                 stats.maybe_publish(final=True,
                                     enrich=self._enrich_publish)
 

@@ -523,19 +523,23 @@ class _LoopRuntime:
             cfg.obs_dim, cfg.vocab_size, cfg.hidden)
 
         # ---- restore (drain/elastic restart resumes here) ----------------
+        # mode-appropriate: tiered runs walk the per-shard ladder (local
+        # RAM -> peer RAM -> committed disk — a memory-tier drain
+        # checkpoint restores from peer RAM with zero disk reads); sync
+        # runs load the controller-provided directory checkpoint
         self.start_iter = 0
         self.ledger = TrajectoryLedger()
         restored = None
-        ckpt = ctx.get_checkpoint()
-        if ckpt is not None:
-            state = ckpt.to_pytree()
+        res = ctx.restore_checkpoint()
+        if res is not None:
+            state = res.tree
             restored = state["params"]
             self.start_iter = int(state["iteration"])
             self.ledger = TrajectoryLedger.from_state(state["ledger"])
             logger.warning(
-                "rlhf[r%d]: restored at iteration %d (published "
-                "version %s)", self.rank, self.start_iter,
-                state.get("version"))
+                "rlhf[r%d]: restored at iteration %d from %s tier "
+                "(published version %s)", self.rank, self.start_iter,
+                res.tier, state.get("version"))
         params = restored if restored is not None else \
             self.module.init(jax.random.PRNGKey(cfg.seed))
         self.params = jax.device_put(params, _replicated(self.mesh))
@@ -770,14 +774,30 @@ def _rlhf_train_loop(config: Dict[str, Any]) -> None:
                              or ctx.drain_requested())
                 checkpoint = None
                 if want_ckpt:
-                    with tracing.span("rlhf.checkpoint", kind="phase"), \
-                            ledger.bucket("checkpoint"):
-                        checkpoint = Checkpoint.from_pytree({
-                            "params": jax.device_get(rt.params),
-                            "iteration": it + 1,
-                            "version": int(ver.version),
-                            "ledger": rt.ledger.state_dict(),
-                        })
+                    state = {
+                        "params": jax.device_get(rt.params),
+                        "iteration": it + 1,
+                        "version": int(ver.version),
+                        "ledger": rt.ledger.state_dict(),
+                    }
+                    with tracing.span("rlhf.checkpoint", kind="phase"):
+                        if ctx.checkpoint_mode() == "tiered":
+                            # async sharded save: the iteration pays only
+                            # the snapshot (charged checkpoint_snapshot by
+                            # the checkpointer); serialize+fsync+peer-push
+                            # run behind the next iteration
+                            # rank 0 is the sole writer here (params are
+                            # DP-replicated): writers=1, whole tree
+                            checkpoint = ctx.checkpointer(writers=1).save(
+                                state, metrics)
+                            if ctx.drain_requested() and \
+                                    ctx.drain_checkpoint_tier() == "memory":
+                                # deadline below disk-write time: the
+                                # peer-RAM ack IS the commit
+                                ctx.checkpointer().commit_ram()
+                        else:
+                            with ledger.bucket("checkpoint_persist"):
+                                checkpoint = Checkpoint.from_pytree(state)
                 train.report(metrics, checkpoint=checkpoint)
     finally:
         rt.close()

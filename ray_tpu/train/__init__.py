@@ -6,6 +6,12 @@ Parity target: ``ray.train`` (v2 control-loop design,
 """
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_async import (
+    AsyncCheckpointer,
+    RestoreResult,
+    TieredCheckpoint,
+    restore_tiered,
+)
 from ray_tpu.train.checkpoint_manager import latest_committed_checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -48,4 +54,6 @@ __all__ = [
     "get_mesh", "shard_inputs", "shard_params",
     "profile", "report", "StepLedger", "DataParallelTrainer", "JaxTrainer",
     "initialize_jax_distributed", "latest_committed_checkpoint",
+    "AsyncCheckpointer", "RestoreResult", "TieredCheckpoint",
+    "restore_tiered",
 ]

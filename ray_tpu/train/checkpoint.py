@@ -24,10 +24,35 @@ class Checkpoint:
         return cls(path)
 
     def to_directory(self, path: Optional[str] = None) -> str:
+        """Materialize the checkpoint at ``path`` with the same
+        tmp+fsync+rename commit discipline as the checkpoint manager: a
+        process crashing mid-copy leaves only a ``<path>.tmp`` staging
+        dir, never a restore-shaped torn directory at ``path``.  An
+        existing ``path`` is atomically replaced only when empty (a
+        plain swap); a non-empty one falls back to in-place copy for
+        backward compatibility, with the staging step still bounding
+        the torn window to the final merge."""
         if path is None or os.path.abspath(path) == self.path:
             return self.path
-        os.makedirs(path, exist_ok=True)
-        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        from ray_tpu.train.checkpoint_manager import _fsync_dir, _fsync_tree
+
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(self.path, tmp)
+        _fsync_tree(tmp)
+        if os.path.isdir(path) and os.listdir(path):
+            # merge into a non-empty destination (legacy
+            # dirs_exist_ok contract): stage fully first so the
+            # only non-atomic window is the local move
+            shutil.copytree(tmp, path, dirs_exist_ok=True)
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            if os.path.isdir(path):
+                os.rmdir(path)
+            os.rename(tmp, path)
+        _fsync_dir(parent)
         return path
 
     @contextlib.contextmanager

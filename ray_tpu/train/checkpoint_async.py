@@ -1,0 +1,869 @@
+"""Tiered asynchronous sharded checkpointing for ``JaxTrainer``.
+
+The Orbax emergency-checkpointing discipline, natively: a train step
+pays only the **snapshot** (donation-safe D2H copy of the shards this
+rank owns), while serialize+fsync runs on a background thread and a
+copy of the shard is pushed to a peer node's RAM
+(``ray_tpu.util.checkpoint_replica``).  Restore walks a preference
+ladder per shard — local RAM -> peer RAM -> committed disk — so the
+common failure (one preempted/SIGKILLed host in a slice) restores with
+zero disk reads for the lost shards.
+
+On-disk layout (same WAL discipline as ``checkpoint_manager``)::
+
+    <storage>/checkpoint_000007.tmp/     # staging dir, any rank creates
+        shard_r00          # each rank: write shard_rNN.tmp, fsync, rename
+        shard_r01
+        MANIFEST.json      # rank 0, after ALL shards landed (tmp+rename)
+    <storage>/checkpoint_000007/         # single rank-0 os.rename commits
+
+A writer SIGKILLed anywhere before the final rename leaves only a
+``*.tmp`` dir that ``committed_checkpoint_dirs`` ignores and the next
+``CheckpointManager`` sweeps — torn multi-rank writes are unobservable.
+
+Shard blobs are **self-describing** (pytree skeleton + global leaf
+shapes + index-bounded pieces), so restore can reassemble the full tree
+from any mix of RAM and disk shards, written by any world size — a
+``clamp_to``-shrunk mesh reassembles shards it didn't write
+(resharding-aware restore), and a pure RAM-tier ("memory") checkpoint
+that never reached disk restores the same way.
+
+Fault sites: ``train.checkpoint.persist_async`` (background serialize+
+fsync edge), ``train.checkpoint.peer_push`` (replication edge, in
+``checkpoint_replica``), ``train.checkpoint.restore`` (ladder entry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.config import config
+from ray_tpu.train.checkpoint_manager import (
+    _fsync_dir,
+    committed_checkpoint_dirs,
+)
+from ray_tpu.util import checkpoint_replica as replica
+from ray_tpu.util.fault_injection import fault_point
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_NAME = "MANIFEST.json"
+
+#: process-local RAM tier: ``(run, index, rank) -> blob bytes`` — the
+#: first rung of the restore ladder (free for in-process restarts, e.g.
+#: an elastic re-mesh that kept this worker alive)
+_LOCAL_KEEP = 2
+_local_lock = threading.Lock()
+_local_cache: Dict[Tuple[str, int, int], bytes] = {}
+
+
+def _local_put(run: str, index: int, rank: int, blob: bytes) -> None:
+    with _local_lock:
+        _local_cache[(run, index, rank)] = blob
+        gens = sorted({k[1] for k in _local_cache if k[0] == run})
+        for old in gens[:-_LOCAL_KEEP]:
+            for k in [k for k in _local_cache
+                      if k[0] == run and k[1] == old]:
+                del _local_cache[k]
+
+
+def _local_get(run: str, index: int, rank: int) -> Optional[bytes]:
+    with _local_lock:
+        return _local_cache.get((run, index, rank))
+
+
+def shard_name(rank: int) -> str:
+    return f"shard_r{rank:02d}"
+
+
+# ---------------------------------------------------------------------------
+# snapshot: donation-safe D2H copy of the pieces THIS rank owns
+# ---------------------------------------------------------------------------
+
+
+def _leaf_paths(tree: Any) -> Tuple[Any, List[str], List[Any]]:
+    """(skeleton, path strings, leaves): the skeleton is the tree with
+    each leaf replaced by its path string — picklable structure that
+    reassembly maps back to arrays (no treedef pickling needed)."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+    leaves = [x for _, x in flat]
+    skeleton = jax.tree_util.tree_unflatten(treedef, paths)
+    return skeleton, paths, leaves
+
+
+def _split_bounds(dim0: int, world: int) -> List[Tuple[int, int]]:
+    """Contiguous ``np.array_split``-compatible [lo, hi) bounds of a
+    leading axis of size ``dim0`` over ``world`` writers."""
+    base, extra = divmod(dim0, world)
+    bounds, lo = [], 0
+    for r in range(world):
+        hi = lo + base + (1 if r < extra else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def snapshot_shards(tree: Any, rank: int, world: int,
+                    run: str = "", index: int = 0,
+                    meta: Optional[Dict[str, Any]] = None) -> bytes:
+    """Snapshot the shard pieces ``rank`` owns as one self-describing
+    blob (pickled).  Every array is **copied to host RAM** before this
+    returns — the caller may immediately donate/overwrite the device
+    tree (donation-safe).
+
+    Ownership: a multi-process ``jax.Array`` contributes its addressable
+    shards (``replica_id == 0`` dedups replicas — the GSPMD-native
+    path); fully-addressable leaves are split contiguously along axis 0
+    across the world (replicated-DP path), with small/scalar leaves
+    owned by ``leaf_i % world`` alone.  Either way the union over ranks
+    tiles every leaf exactly once, which reassembly verifies.
+    """
+    import numpy as np
+
+    import jax
+
+    skeleton, paths, leaves = _leaf_paths(tree)
+    leaf_info: Dict[str, Tuple[List[int], str]] = {}
+    pieces: List[Tuple[str, Optional[List[Tuple[int, int]]], Any]] = []
+    for i, (path, x) in enumerate(zip(paths, leaves)):
+        is_jax = isinstance(x, jax.Array)
+        shape = tuple(x.shape) if hasattr(x, "shape") else ()
+        dtype = str(x.dtype) if hasattr(x, "dtype") else "object"
+        leaf_info[path] = (list(shape), dtype)
+        if is_jax and not x.is_fully_addressable:
+            # GSPMD global array: this process owns exactly its
+            # addressable shards (dedup replicas via replica_id)
+            for sh in x.addressable_shards:
+                if sh.replica_id != 0:
+                    continue
+                bounds = [(sl.start or 0,
+                           sl.stop if sl.stop is not None else dim)
+                          for sl, dim in zip(sh.index, shape)]
+                pieces.append((path, bounds, np.array(sh.data)))
+            continue
+        host = np.array(x)  # D2H (or defensive host copy): always a copy
+        if host.ndim >= 1 and host.shape[0] >= world > 1:
+            lo, hi = _split_bounds(host.shape[0], world)[rank]
+            bounds = [(lo, hi)] + [(0, d) for d in host.shape[1:]]
+            pieces.append((path, bounds, np.ascontiguousarray(host[lo:hi])))
+        elif i % world == rank:
+            pieces.append((path, None, host))  # sole owner, whole leaf
+    return pickle.dumps({
+        "format": 1,
+        "run": run,
+        "index": index,
+        "rank": rank,
+        "world": world,
+        "skeleton": skeleton,
+        "leaves": leaf_info,
+        "pieces": pieces,
+        "meta": dict(meta or {}),
+    })
+
+
+# ---------------------------------------------------------------------------
+# disk tier: per-rank shard stage+fsync+rename, rank-0 manifest commit
+# ---------------------------------------------------------------------------
+
+
+def _staging_dir(storage_dir: str, index: int) -> str:
+    return os.path.join(storage_dir, f"checkpoint_{index:06d}.tmp")
+
+
+def _committed_dir(storage_dir: str, index: int) -> str:
+    return os.path.join(storage_dir, f"checkpoint_{index:06d}")
+
+
+def write_shard(storage_dir: str, index: int, rank: int,
+                blob: bytes) -> str:
+    """Persist one rank's shard into the generation's staging dir:
+    write ``shard_rNN.tmp``, fsync, rename to ``shard_rNN``.  Any crash
+    mid-write leaves only ``*.tmp`` names the manifest commit ignores."""
+    stage = _staging_dir(storage_dir, index)
+    os.makedirs(stage, exist_ok=True)
+    final = os.path.join(stage, shard_name(rank))
+    tmp = final + ".tmp"
+    fault_point("train.checkpoint.persist_async")
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, final)
+    _fsync_dir(stage)
+    return final
+
+
+def commit_manifest(storage_dir: str, index: int, world: int,
+                    meta: Optional[Dict[str, Any]] = None,
+                    wait_s: Optional[float] = None) -> str:
+    """Rank 0's commit leg: wait (bounded) for all ``world`` shard files
+    to land in the staging dir, write ``MANIFEST.json`` (tmp+fsync+
+    rename), then publish the whole generation with one directory
+    rename.  Raises ``TimeoutError`` if a writer died mid-persist — the
+    generation then stays ``*.tmp`` (torn, unobservable to restore) and
+    the next manager sweep removes it."""
+    if wait_s is None:
+        wait_s = config.train_checkpoint_manifest_wait_s
+    stage = _staging_dir(storage_dir, index)
+    want = {shard_name(r) for r in range(world)}
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            have = set(os.listdir(stage))
+        except OSError:
+            have = set()
+        if want <= have:
+            break
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"checkpoint_{index:06d}: shards missing after {wait_s}s: "
+                f"{sorted(want - have)} (writer died mid-persist; "
+                "generation stays torn/.tmp)")
+        time.sleep(0.05)
+    manifest = {
+        "index": index,
+        "world_size": world,
+        "sharded": True,
+        "shards": sorted(want),
+        "meta": dict(meta or {}),
+    }
+    mtmp = os.path.join(stage, MANIFEST_NAME + ".tmp")
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(mtmp, os.path.join(stage, MANIFEST_NAME))
+    _fsync_dir(stage)
+    dest = _committed_dir(storage_dir, index)
+    # the commit point (same site as the legacy whole-tree path): a kill
+    # here leaves .tmp only; a committed dir is always fully durable
+    fault_point("train.checkpoint.commit")
+    os.rename(stage, dest)
+    _fsync_dir(storage_dir)
+    return dest
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest of a committed sharded checkpoint dir (None for
+    legacy whole-tree checkpoints, which have no MANIFEST.json)."""
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# reassembly (resharding-aware): full tree from any world's shard blobs
+# ---------------------------------------------------------------------------
+
+
+class IncompleteCheckpointError(RuntimeError):
+    """A generation's shards do not tile every leaf exactly once."""
+
+
+def reassemble(blobs: Dict[int, bytes]) -> Tuple[Any, Dict[str, Any]]:
+    """Rebuild the full host pytree from one generation's shard blobs
+    (``{writer_rank: blob}``), regardless of which mesh/world wrote
+    them.  Verifies exact tiling — every element written exactly once —
+    and raises :class:`IncompleteCheckpointError` otherwise."""
+    import numpy as np
+
+    import jax
+
+    if not blobs:
+        raise IncompleteCheckpointError("no shard blobs to reassemble")
+    decoded = {r: pickle.loads(b) for r, b in blobs.items()}
+    ref = decoded[min(decoded)]
+    world = ref["world"]
+    if set(decoded) != set(range(world)):
+        raise IncompleteCheckpointError(
+            f"have writer ranks {sorted(decoded)}, need 0..{world - 1}")
+    arrays: Dict[str, Any] = {}
+    filled: Dict[str, int] = {}
+    for path, (shape, dtype) in ref["leaves"].items():
+        arrays[path] = np.empty(shape, dtype=np.dtype(dtype))
+        filled[path] = 0
+    for shard in decoded.values():
+        for path, bounds, piece in shard["pieces"]:
+            arr = arrays[path]
+            if bounds is None:
+                arrays[path] = np.array(piece)
+                filled[path] += int(np.asarray(piece).size) or 1
+            else:
+                idx = tuple(slice(lo, hi) for lo, hi in bounds)
+                arr[idx] = piece
+                filled[path] += int(np.asarray(piece).size)
+    for path, (shape, _dtype) in ref["leaves"].items():
+        want = int(np.prod(shape)) if shape else 1
+        if filled[path] != want:
+            raise IncompleteCheckpointError(
+                f"leaf {path}: {filled[path]} of {want} elements covered "
+                "(overlapping or missing shard pieces)")
+    tree = jax.tree.map(lambda p: arrays[p], ref["skeleton"])
+    return tree, dict(ref["meta"])
+
+
+def load_disk_shards(path: str,
+                     ranks: Optional[Sequence[int]] = None
+                     ) -> Dict[int, bytes]:
+    """Read shard blobs from a committed sharded checkpoint dir."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return {}
+    world = manifest["world_size"]
+    want = range(world) if ranks is None else ranks
+    out: Dict[int, bytes] = {}
+    for r in want:
+        try:
+            with open(os.path.join(path, shard_name(r)), "rb") as f:
+                out[r] = f.read()
+        except OSError:
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# restore ladder: local RAM -> peer RAM -> committed disk
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RestoreResult:
+    tree: Any
+    meta: Dict[str, Any]
+    index: int
+    world: int                    # world size that WROTE the checkpoint
+    tier_by_rank: Dict[int, str]  # writer rank -> "local"|"peer"|"disk"
+    disk_reads: int
+    path: Optional[str]           # committed dir (None for memory tier)
+
+    @property
+    def tier(self) -> str:
+        """The slowest tier the ladder had to touch ("memory" when no
+        shard needed disk)."""
+        return "disk" if self.disk_reads else "memory"
+
+
+def _blob_world(blob: bytes) -> int:
+    return pickle.loads(blob)["world"]
+
+
+def _blob_matches(blob: bytes, run: str, index: int, rank: int) -> bool:
+    """RAM/local-cache blobs are validated against the generation being
+    restored: a blob whose embedded ``(run, index, rank)`` disagrees
+    with the slot it was fetched from is treated as MISSING, never
+    reassembled.  Defense in depth against cross-generation shard
+    mixing — disk shards skip this (they live inside the committed,
+    manifest-checked generation dir)."""
+    try:
+        hdr = pickle.loads(blob)
+        return (hdr.get("run", run) == run and hdr.get("index") == index
+                and hdr.get("rank") == rank)
+    except Exception:  # noqa: BLE001 — corrupt blob == missing shard
+        return False
+
+
+def restore_tiered(storage_dir: Optional[str], run: str, *,
+                   server_names: Sequence[str] = (),
+                   rpc_timeout_s: Optional[float] = None
+                   ) -> Optional[RestoreResult]:
+    """Restore the newest complete checkpoint generation for ``run``,
+    preferring RAM over disk per shard.
+
+    Candidates are the union of committed disk generations and
+    RAM-tier generations the replica plane holds (a ``memory``-tier
+    drain checkpoint may exist only in peer RAM).  For each candidate,
+    newest first, every writer rank's shard is fetched via the ladder —
+    process-local cache, then peer RAM, then the committed disk file —
+    and the first generation that reassembles completely wins.  Torn
+    disk generations (``*.tmp``) are invisible by construction; a
+    RAM generation missing shards (dead peer) falls through to disk or
+    to the next older candidate.
+    """
+    fault_point("train.checkpoint.restore")
+    if rpc_timeout_s is None:
+        rpc_timeout_s = config.train_checkpoint_replica_rpc_timeout_s
+    disk: Dict[int, str] = {}
+    if storage_dir:
+        for index, path in committed_checkpoint_dirs(storage_dir):
+            if read_manifest(path) is not None:
+                disk[index] = path
+    ram = replica.ram_manifest_by_names(server_names, timeout=rpc_timeout_s) \
+        if server_names else {}
+    with _local_lock:
+        local_gens = sorted({k[1] for k in _local_cache if k[0] == run})
+    candidates = sorted(set(disk) | set(ram) | set(local_gens), reverse=True)
+    for index in candidates:
+        got: Dict[int, bytes] = {}
+        tier_by_rank: Dict[int, str] = {}
+        disk_reads = 0
+        # discover the writing world: disk manifest, else any RAM blob
+        world: Optional[int] = None
+        path = disk.get(index)
+        if path is not None:
+            manifest = read_manifest(path)
+            world = manifest["world_size"] if manifest else None
+        probe_ranks = ram.get(index, []) or list(
+            {k[2] for k in _local_cache
+             if k[0] == run and k[1] == index})
+        if world is None and probe_ranks:
+            pr = probe_ranks[0]
+            candidates_pr = [_local_get(run, index, pr)]
+            if server_names:
+                candidates_pr.append(
+                    (replica.fetch_shard(server_names, index, pr,
+                                         timeout=rpc_timeout_s)
+                     or (None,))[0])
+            for blob in candidates_pr:
+                if blob is not None and _blob_matches(blob, run, index, pr):
+                    world = _blob_world(blob)
+                    got[pr] = blob
+                    break
+        if world is None:
+            continue
+        ok = True
+        for r in range(world):
+            if r in got:
+                lb = _local_get(run, index, r)
+                tier_by_rank[r] = "local" if (
+                    lb is not None and _blob_matches(lb, run, index, r)
+                ) else "peer"
+                continue
+            blob = _local_get(run, index, r)
+            if blob is not None and _blob_matches(blob, run, index, r):
+                got[r] = blob
+                tier_by_rank[r] = "local"
+                continue
+            fetched = replica.fetch_shard(
+                server_names, index, r,
+                timeout=rpc_timeout_s) if server_names else None
+            if fetched is not None and _blob_matches(
+                    fetched[0], run, index, r):
+                got[r] = fetched[0]
+                tier_by_rank[r] = "peer"
+                continue
+            if path is not None:
+                from_disk = load_disk_shards(path, ranks=[r])
+                if r in from_disk:
+                    got[r] = from_disk[r]
+                    tier_by_rank[r] = "disk"
+                    disk_reads += 1
+                    continue
+            ok = False
+            break
+        if not ok:
+            logger.warning(
+                "restore %s: generation %d incomplete across all tiers; "
+                "trying older", run, index)
+            continue
+        try:
+            tree, meta = reassemble(got)
+        except IncompleteCheckpointError as e:
+            logger.warning("restore %s: generation %d: %s", run, index, e)
+            continue
+        return RestoreResult(tree=tree, meta=meta, index=index, world=world,
+                             tier_by_rank=tier_by_rank,
+                             disk_reads=disk_reads, path=path)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the async checkpointer: snapshot inline, persist+replicate in background
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TieredCheckpoint:
+    """Handle for one tiered save: returned by ``AsyncCheckpointer.save``
+    the moment the snapshot lands in host RAM (the persist may still be
+    in flight — ``ram_acked``/``committed_path`` fill in as the
+    background tiers land)."""
+
+    run: str
+    index: int
+    rank: int
+    world: int
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False)
+    ram_acked: bool = False
+    committed_path: Optional[str] = None
+    error: Optional[BaseException] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    @property
+    def tier(self) -> str:
+        """Best durability tier reached so far: ``disk`` once the
+        manifest committed, else ``memory`` once a peer acked, else
+        ``local`` (this process's RAM only)."""
+        if self.committed_path:
+            return "disk"
+        return "memory" if self.ram_acked else "local"
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class AsyncCheckpointer:
+    """Per-rank tiered checkpoint writer with one-in-flight backpressure.
+
+    ``save()`` snapshots (D2H copy + local cache) inline — the only cost
+    the step pays, charged to the ``checkpoint_snapshot`` ledger bucket
+    — then hands persist+replicate to a daemon thread: peer-RAM push
+    first (the emergency tier lands fastest), then the fsynced shard
+    write and, on rank 0, the manifest commit.  A second ``save()``
+    while a persist is in flight **waits** (bounded by
+    ``train_checkpoint_persist_wait_s``, charged to
+    ``checkpoint_persist`` — lag surfacing inline), never silently
+    drops a snapshot.  A ``preempt_ram`` hook (wired by the train
+    session to the controller's memory-tier drain request) preempts
+    that wait and commits the save at the peer-RAM tier inline,
+    skipping the disk queue — the emergency-checkpoint leg of the
+    drain protocol.
+    """
+
+    def __init__(self, storage_dir: Optional[str], run: str, rank: int,
+                 world: int, *, peer_name: Optional[str] = None,
+                 server_names: Sequence[str] = (),
+                 ledger: Any = None, publish_status: bool = True,
+                 preempt_ram: Optional[Callable[[], bool]] = None,
+                 drain_avoid: Optional[Callable[[], Any]] = None):
+        self.storage_dir = storage_dir
+        self.run = run
+        self.rank = rank
+        self.world = world
+        self.peer_name = peer_name
+        self.server_names = list(server_names)
+        # when this returns True, save() must commit at the RAM tier NOW
+        # (a sub-disk-deadline drain is pending): it preempts the
+        # backpressure wait and bypasses the disk queue — see save()
+        self._preempt_ram = preempt_ram
+        # node ids the pending drain covers: the emergency push
+        # re-targets off these (a replica on a node the drain protocol
+        # is about to shut down is no replica at all)
+        self._drain_avoid = drain_avoid
+        self._ledger = ledger
+        self._publish_status = publish_status
+        self._idle = threading.Event()
+        self._idle.set()
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._last: Optional[TieredCheckpoint] = None
+        self._next_index: Optional[int] = None
+        self._lock = threading.Lock()
+        self._closed = False
+        self._snapshot_s = 0.0
+        self._persist_s = 0.0
+
+    # -- indexing -----------------------------------------------------------
+
+    def _ensure_index(self) -> int:
+        """First-save index discovery: one past the newest **complete**
+        generation in any tier — committed disk dirs, plus RAM
+        generations holding every writer rank's shard (a ``memory``-tier
+        drain checkpoint lives only there).
+
+        Completeness is load-bearing, not cosmetic.  Ranks discover at
+        slightly different times; if a sibling's *in-flight* first save
+        (a staged ``.tmp`` dir, a half-pushed RAM generation) bumped the
+        base, the late rank would start numbering one higher and every
+        generation after that would pair shards from ADJACENT training
+        steps under one index — restore then reassembles a tree that
+        never existed on any step.  Complete generations are the only
+        fixed points every rank observes identically, so all ranks
+        compute the same base and lockstep saves advance it
+        identically.  (An old torn ``.tmp`` at base+1 is simply
+        re-staged and committed by the new writers.)"""
+        if self._next_index is None:
+            base = 0
+            if self.storage_dir:
+                dirs = committed_checkpoint_dirs(self.storage_dir)
+                if dirs:
+                    base = dirs[-1][0]
+            if self.server_names:
+                complete = replica.ram_complete_generations(
+                    self.server_names)
+                if complete:
+                    base = max(base, complete[-1])
+            self._next_index = base + 1
+        return self._next_index
+
+    def _emergency_peer(self, avoid: Any) -> Optional[str]:
+        """Push target for a memory-tier emergency save: the normal ring
+        peer unless its node is covered by the drain notice, else the
+        first replica server on a surviving node.  Server names encode
+        their node (``_ckpt_replica::<run>::<node_id>``), so no extra
+        control-plane round trip is needed at the worst possible time."""
+        avoid = set(avoid or ())
+
+        def _node(name: str) -> str:
+            return name.rsplit("::", 1)[-1]
+
+        if self.peer_name and _node(self.peer_name) not in avoid:
+            return self.peer_name
+        for name in self.server_names:
+            if _node(name) not in avoid:
+                return name
+        return self.peer_name  # every node doomed: best effort
+
+    # -- background persist -------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._persist_loop,
+                name=f"ckpt-persist-{self.run}-r{self.rank}", daemon=True)
+            self._thread.start()
+
+    def _persist_loop(self) -> None:
+        while True:
+            try:
+                # bounded wake-ups (not a hang guard — the producer is
+                # this same process): lets a wedged owner's daemon
+                # thread notice interpreter shutdown instead of
+                # blocking in C forever
+                job = self._q.get(timeout=5.0)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if job is None:
+                return
+            handle, blob, meta = job
+            t0 = time.perf_counter()
+            try:
+                self._persist_one(handle, blob, meta)
+            except BaseException as e:  # noqa: BLE001 — surfaced on handle
+                handle.error = e
+                logger.warning(
+                    "async persist of %s checkpoint_%06d rank %d failed: "
+                    "%s (durable tiers: %s)", self.run, handle.index,
+                    self.rank, e, handle.tier)
+            finally:
+                dur = time.perf_counter() - t0
+                self._persist_s = dur
+                if self._ledger is not None:
+                    # off the step critical path, but attributed — the
+                    # breakdown shows persist OVERLAPPING compute
+                    self._ledger.note("checkpoint_persist", dur)
+                handle.done.set()
+                self._idle.set()
+                self._publish_kv(handle)
+
+    def _persist_one(self, handle: TieredCheckpoint, blob: bytes,
+                     meta: Dict[str, Any]) -> None:
+        # emergency tier first: the peer ack is what a short-deadline
+        # drain waits on, so it must not queue behind the disk write.
+        # A failed push degrades (no RAM tier this generation) — it
+        # must never abort the persist and take the disk tier with it
+        if self.peer_name:
+            try:
+                handle.ram_acked = replica.push_shard(
+                    self.peer_name, handle.index, self.rank, blob,
+                    {"run": self.run, "world": self.world, **meta})
+            except Exception as e:  # noqa: BLE001 — peer may be dead
+                handle.ram_acked = False
+                logger.warning(
+                    "peer-RAM push of %s checkpoint_%06d rank %d to %s "
+                    "failed (%s); continuing with the disk tier",
+                    self.run, handle.index, self.rank, self.peer_name, e)
+        if self.storage_dir:
+            write_shard(self.storage_dir, handle.index, self.rank, blob)
+            if self.rank == 0:
+                handle.committed_path = commit_manifest(
+                    self.storage_dir, handle.index, self.world, meta)
+            else:
+                # non-zero ranks surface commit completion too (poll,
+                # bounded): lets any rank's handle report tier="disk"
+                dest = _committed_dir(self.storage_dir, handle.index)
+                deadline = time.monotonic() + \
+                    config.train_checkpoint_manifest_wait_s
+                while time.monotonic() < deadline:
+                    if os.path.isdir(dest):
+                        handle.committed_path = dest
+                        break
+                    time.sleep(0.05)
+
+    # -- the public face ----------------------------------------------------
+
+    def save(self, tree: Any, metrics: Optional[Dict[str, Any]] = None, *,
+             wait_persist: bool = False,
+             persist_wait_s: Optional[float] = None) -> TieredCheckpoint:
+        """Tiered save of this rank's shards of ``tree``.
+
+        Returns as soon as the snapshot is in host RAM (and enqueued for
+        persist+replication).  ``wait_persist=True`` blocks until the
+        disk tier lands too — the synchronous arm of the A/B bench, and
+        what a final checkpoint before clean shutdown wants.
+        """
+        if persist_wait_s is None:
+            persist_wait_s = config.train_checkpoint_persist_wait_s
+        # backpressure: at most one persist in flight; a second save
+        # WAITS for it (bounded) — never silently drops a snapshot.
+        # The wait is PREEMPTIBLE by a memory-tier drain request
+        # (``preempt_ram``): a slow or faulted disk persist would
+        # otherwise wedge the loop in this wait right through a reclaim
+        # deadline the peer-RAM ack alone could meet — the emergency
+        # path below pushes inline and never touches the disk queue
+        ram_only = self._preempt_ram is not None and self._preempt_ram()
+        if not ram_only and not self._idle.is_set():
+            t0 = time.perf_counter()
+            deadline = t0 + persist_wait_s
+            while not self._idle.wait(0.05):
+                if self._preempt_ram is not None and self._preempt_ram():
+                    ram_only = True
+                    break
+                if time.perf_counter() >= deadline:
+                    raise TimeoutError(
+                        f"checkpoint persist backpressure: previous "
+                        f"persist still in flight after {persist_wait_s}s")
+            if self._ledger is not None:
+                self._ledger.note("checkpoint_persist",
+                                  time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        index = self._ensure_index()
+        self._next_index = index + 1
+        meta = dict(metrics or {})
+        blob = snapshot_shards(tree, self.rank, self.world,
+                               run=self.run, index=index, meta=meta)
+        _local_put(self.run, index, self.rank, blob)
+        snap_s = time.perf_counter() - t0
+        self._snapshot_s = snap_s
+        if self._ledger is not None:
+            self._ledger.note("checkpoint_snapshot", snap_s)
+        handle = TieredCheckpoint(run=self.run, index=index,
+                                  rank=self.rank, world=self.world)
+        with self._lock:
+            self._last = handle
+        if ram_only:
+            # emergency memory-tier save: inline peer push, no disk leg
+            # for this generation (it commits at the RAM tier or not at
+            # all — the restarted group restores it from the replica
+            # plane, and the next normal save resumes the disk cadence
+            # at index+1).  The in-flight persist keeps running; this
+            # handle completes without queuing behind it.
+            t1 = time.perf_counter()
+            target = self._emergency_peer(
+                self._drain_avoid() if self._drain_avoid else ())
+            if target:
+                try:
+                    handle.ram_acked = replica.push_shard(
+                        target, index, self.rank, blob,
+                        {"run": self.run, "world": self.world, **meta})
+                except Exception as e:  # noqa: BLE001 — peer may be dead
+                    handle.ram_acked = False
+                    logger.warning(
+                        "emergency peer-RAM push of %s checkpoint_%06d "
+                        "rank %d to %s failed: %s", self.run, index,
+                        self.rank, target, e)
+            handle.done.set()
+            if self._ledger is not None:
+                self._ledger.note("checkpoint_persist",
+                                  time.perf_counter() - t1)
+            self._publish_kv(handle)
+            return handle
+        self._idle.clear()
+        self._ensure_thread()
+        self._q.put((handle, blob, meta))
+        if wait_persist:
+            handle.wait(persist_wait_s)
+            if handle.error is not None:
+                raise handle.error
+        return handle
+
+    @property
+    def last(self) -> Optional[TieredCheckpoint]:
+        with self._lock:
+            return self._last
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Drain the persist queue (True when idle within ``timeout``)."""
+        return self._idle.wait(timeout)
+
+    def commit_ram(self, timeout: Optional[float] = None) -> bool:
+        """Wait (bounded) for the LAST save's peer-RAM ack — the
+        ``memory``-tier commit a short-deadline drain needs: once True,
+        this rank's newest shard is durable on a peer host and a
+        restarted group can restore it with zero disk reads."""
+        handle = self.last
+        if handle is None:
+            return False
+        if timeout is None:
+            timeout = config.train_checkpoint_persist_wait_s
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if handle.ram_acked or handle.committed_path:
+                return True
+            if handle.done.is_set():
+                return bool(handle.ram_acked or handle.committed_path)
+            time.sleep(0.02)
+        return bool(handle.ram_acked or handle.committed_path)
+
+    def restore(self) -> Optional[RestoreResult]:
+        """Walk the restore ladder with this checkpointer's plane wiring
+        (see :func:`restore_tiered`).  A successful restore also PINS
+        this rank's next save index to ``restored + 1``: every restarted
+        rank resumes from the same generation, so pinning is the one
+        cross-rank synchronization point index numbering gets — saves
+        after a restart agree by construction instead of by racy
+        re-discovery."""
+        res = restore_tiered(self.storage_dir, self.run,
+                             server_names=self.server_names)
+        if res is not None:
+            self._next_index = res.index + 1
+        return res
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._idle.wait(timeout)
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join(timeout)
+
+    # -- per-tier status surfacing (util.state + dashboard) -----------------
+
+    def _publish_kv(self, handle: TieredCheckpoint) -> None:
+        if not self._publish_status:
+            return
+        try:
+            import ray_tpu
+
+            if not ray_tpu.is_initialized():
+                return
+            from ray_tpu._private.worker import get_global_worker
+
+            w = get_global_worker(required=False)
+            if w is None:
+                return
+            rec = {
+                "ts": time.time(),
+                "run": self.run,
+                "rank": self.rank,
+                "world": self.world,
+                "index": handle.index,
+                "tier": handle.tier,
+                "ram_acked": handle.ram_acked,
+                "committed_path": handle.committed_path,
+                "snapshot_s": round(self._snapshot_s, 6),
+                "persist_s": round(self._persist_s, 6),
+                "error": repr(handle.error) if handle.error else None,
+            }
+            key = f"ckpt_status/{self.run}/{self.rank}"
+            w.run_coro(
+                w.gcs.call("kv_put", ns="train", key=key,
+                           value=json.dumps(rec).encode(), overwrite=True,
+                           timeout=2),
+                timeout=4)
+        except Exception:  # noqa: BLE001 — surfacing must never fail a save
+            pass

@@ -156,6 +156,25 @@ class CheckpointManager:
         """
         from ray_tpu.util.fault_injection import fault_point
 
+        # adopt-in-place: a checkpoint ALREADY committed inside this
+        # manager's storage dir (the tiered sharded writer renames
+        # checkpoint_NNNNNN directly into storage) keeps its index and
+        # is tracked without a copy — re-copying a multi-gigabyte
+        # sharded checkpoint to a second slot would defeat the plane
+        if self.storage_dir:
+            abspath = os.path.abspath(checkpoint.path)
+            m = _CKPT_RE.match(os.path.basename(abspath))
+            if m and os.path.dirname(abspath) == \
+                    os.path.abspath(self.storage_dir):
+                idx = int(m.group(1))
+                self._index = max(self._index, idx)
+                for t in self._tracked:
+                    if t.index == idx:  # already adopted (re-report)
+                        return t.checkpoint
+                self._tracked.append(
+                    _Tracked(checkpoint, dict(metrics), idx))
+                self._evict()
+                return checkpoint
         self._index += 1
         if self.storage_dir:
             dest = os.path.join(self.storage_dir,

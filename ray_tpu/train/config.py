@@ -72,9 +72,25 @@ class ScalingConfig:
 
 @dataclasses.dataclass
 class CheckpointConfig:
+    """Checkpoint bookkeeping + persistence mode.
+
+    mode: ``"sync"`` (legacy — the loop reports whole-tree directory
+    checkpoints the controller copies into storage) or ``"tiered"`` (the
+    async sharded plane of ``train.checkpoint_async``: each rank
+    persists only its owned shards in the background, pushes a copy to a
+    peer node's RAM, and the step pays only the D2H snapshot; the
+    controller wires per-node ``CheckpointReplicaServer`` actors and the
+    restore ladder local RAM -> peer RAM -> committed disk).
+    peer_replication: in tiered mode, replicate each rank's snapshot to
+    a peer node's RAM (the emergency tier a short-deadline drain and a
+    SIGKILLed-host restore depend on).
+    """
+
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"
+    mode: str = "sync"
+    peer_replication: bool = True
 
 
 @dataclasses.dataclass

@@ -89,6 +89,17 @@ class TrainController:
         )
         if resume_from_checkpoint is not None:
             self.checkpoint_manager.register(resume_from_checkpoint, {})
+        # tiered checkpoint plane (CheckpointConfig.mode == "tiered"):
+        # per-node peer-RAM replica servers owned HERE — outside the
+        # worker placement group — so the emergency tier survives the
+        # group restarts it exists to serve
+        self._tiered_mode = getattr(ckpt_cfg, "mode", "sync") == "tiered"
+        self._peer_replication = getattr(ckpt_cfg, "peer_replication", True)
+        self._replica_plane = None
+        # per-generation-index durability tracking from poll-time
+        # checkpointer status: index -> {"ranks_ram": set, "world": int,
+        # "path": str|None, "registered": bool}
+        self._tiered: Dict[int, Dict[str, Any]] = {}
         self.metrics_history: List[Dict[str, Any]] = []
         self._ctx = TrainRunContext()
         # report-row bookkeeping: rows are aligned by per-rank *absolute*
@@ -137,8 +148,45 @@ class TrainController:
             self.fn_payload, self.train_loop_config,
             self.checkpoint_manager.latest, shards, dist_env,
             mesh_config=sc.mesh_config(),
-            axis_rules=sc.logical_axis_rules)
+            axis_rules=sc.logical_axis_rules,
+            ckpt_planes=self._wire_replica_plane(group))
         return group
+
+    def _wire_replica_plane(self, group: WorkerGroup):
+        """Tiered mode: (re)build the per-node replica-server plane for
+        this generation's nodes and return each rank's plane wiring
+        (storage dir, run name, its peer server, all server names).
+        Servers are reused across generations — that is the whole point
+        — but servers whose node died are dropped so a replacement gets
+        pinned to live hardware."""
+        if not self._tiered_mode:
+            return None
+        from ray_tpu.util.checkpoint_replica import ReplicaPlane
+
+        if self._replica_plane is None:
+            self._replica_plane = ReplicaPlane(self.name)
+        plane = self._replica_plane
+        node_ids = group.worker_node_ids()
+        try:
+            import ray_tpu
+
+            alive = {n["node_id"] for n in ray_tpu.nodes() if n.get("alive")}
+            for nid in list(plane.node_ids):
+                if nid not in alive:
+                    plane.drop_node(nid)
+        except Exception:  # noqa: BLE001 — pruning is an optimization
+            pass
+        plane.ensure_for_nodes(node_ids)
+        servers = plane.server_names()
+        peers = plane.peer_assignment(node_ids) if self._peer_replication \
+            else [None] * len(node_ids)
+        return [{
+            "mode": "tiered",
+            "run": self.name,
+            "storage_dir": self.checkpoint_manager.storage_dir,
+            "peer": peers[rank],
+            "servers": servers,
+        } for rank in range(len(node_ids))]
 
     def _restart_group(self) -> WorkerGroup:
         """Start a replacement group, treating start-time failures (e.g.
@@ -275,14 +323,26 @@ class TrainController:
         self._drains_handled.update(overlap)
         deadline = min(overlap.values()) or (
             time.time() + config.train_drain_checkpoint_wait_s)
+        window = max(0.0, deadline - time.time())
+        # tier decision: a window too short for serialize+fsync cannot
+        # complete the disk tier — ask for a memory-tier checkpoint (the
+        # peer-RAM ack is the commit; the restarted group restores from
+        # the replica plane with zero disk reads for those shards)
+        tier = "any"
+        if self._tiered_mode and \
+                window < config.train_drain_memory_tier_floor_s:
+            tier = "memory"
         logger.warning(
             "train %s: drain notice for node(s) %s hosting workers "
-            "(%.1fs to deadline); requesting immediate checkpoint and "
-            "restarting off the draining node(s)",
-            self.name, [n[:8] for n in overlap],
-            max(0.0, deadline - time.time()))
-        pre_ckpts = len(self._ckpt_registered)
-        group.request_checkpoint()
+            "(%.1fs to deadline); requesting immediate %s-tier checkpoint "
+            "and restarting off the draining node(s)",
+            self.name, [n[:8] for n in overlap], window,
+            "memory" if tier == "memory" else "best")
+        pre_ckpts = len(self._ckpt_registered) + self._tiered_durable_count()
+        # the draining nodes ride along: an emergency replica pushed to
+        # hardware the drain protocol shuts down at the deadline is no
+        # replica at all — ranks whose ring peer is doomed re-target
+        group.request_checkpoint(tier=tier, avoid_nodes=list(overlap))
         # leave a margin before the deadline for group teardown + restart
         wait_until = min(deadline - 1.0,
                          time.time() + config.train_drain_checkpoint_wait_s)
@@ -294,12 +354,26 @@ class TrainController:
             # be torn down and pointlessly re-run from that checkpoint
             if all(s.finished for s in statuses):
                 return False  # the run beat the drain: nothing to migrate
-            if len(self._ckpt_registered) > pre_ckpts:
-                break  # the pre-drain checkpoint is committed
+            if len(self._ckpt_registered) + self._tiered_durable_count() \
+                    > pre_ckpts:
+                break  # the pre-drain checkpoint is durable (some tier)
             if any(s.error for s in statuses):
                 break  # deadline beat us; restart from what we have
             time.sleep(self.poll_interval_s)
         return True
+
+    def _tiered_durable_count(self) -> int:
+        """How many tiered checkpoint generations are durable at ANY
+        tier: disk-registered, or RAM-complete (every rank's shard acked
+        by a peer server — the ``memory``-tier commit)."""
+        n = 0
+        for info in self._tiered.values():
+            if info.get("registered"):
+                n += 1
+            elif info.get("world") and \
+                    len(info["ranks_ram"]) >= info["world"]:
+                n += 1
+        return n
 
     def _gang_fate_shared(self, group: WorkerGroup) -> bool:
         """True when THIS group's placement gang was failed as a unit by
@@ -396,6 +470,10 @@ class TrainController:
             raise
         finally:
             group.shutdown()
+            if self._replica_plane is not None:
+                # the RAM tier's lifetime is the run's: disk commits
+                # survive; the emergency replicas die with their purpose
+                self._replica_plane.shutdown()
             self._publish_status(
                 group, "FAILED" if error is not None else "FINISHED")
 
@@ -422,6 +500,8 @@ class TrainController:
                 key = (self._generation, base + off)
                 self._step_buffer.setdefault(key, {})[s.rank] = row
             self._rank_row_counts[s.rank] = base + len(s.results)
+            if s.ckpt:
+                self._note_tiered_status(s.rank, s.ckpt)
 
         for key in sorted(self._step_buffer):
             rows = self._step_buffer[key]
@@ -443,3 +523,31 @@ class TrainController:
                         break
             if len(rows) == len(statuses) and key in self._emitted:
                 del self._step_buffer[key]
+
+    def _note_tiered_status(self, rank: int, st: Dict[str, Any]) -> None:
+        """Fold one rank's poll-time checkpointer status into per-index
+        durability tracking (the background persist lands after the
+        report row drained, so tier progress arrives here).  A committed
+        sharded dir is adopted into the CheckpointManager in place — it
+        already lives inside the storage dir — which also gives it
+        top-K eviction and ``Result.checkpoint`` visibility."""
+        idx = st.get("index")
+        if idx is None:
+            return
+        info = self._tiered.setdefault(
+            idx, {"ranks_ram": set(), "world": st.get("world"),
+                  "path": None, "registered": False})
+        if st.get("world"):
+            info["world"] = st["world"]
+        if st.get("ram_acked"):
+            info["ranks_ram"].add(rank)
+        path = st.get("committed_path")
+        if path and not info["registered"]:
+            import os
+
+            if os.path.isdir(path):
+                metrics = self.metrics_history[-1] \
+                    if self.metrics_history else {}
+                self.checkpoint_manager.register(Checkpoint(path), metrics)
+                info["registered"] = True
+                info["path"] = path

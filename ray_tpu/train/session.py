@@ -32,9 +32,12 @@ class StepLedger:
     auto-attributed via the tracing duration-sink, no loop changes),
     ``channel_wait`` (compiled-graph / pipeline channel reads —
     auto-attributed by ``EdgeTransport.read``, so pipeline steps see
-    their inter-stage stalls), ``checkpoint``, ``weight_publish``
-    (auto-attributed by the RL weight-sync publisher), and ``other``
-    (the unexplained remainder).
+    their inter-stage stalls), ``checkpoint_snapshot`` (the inline D2H
+    copy a tiered save charges the step), ``checkpoint_persist``
+    (serialize+fsync — on the async path attributed from the background
+    thread, so the breakdown shows it OVERLAPPING compute instead of
+    stalling the step), ``weight_publish`` (auto-attributed by the RL
+    weight-sync publisher), and ``other`` (the unexplained remainder).
     The MFU number finally gets a denominator breakdown::
 
         ledger = train.get_context().step_ledger()
@@ -51,7 +54,8 @@ class StepLedger:
     """
 
     BUCKETS = ("data_wait", "h2d", "compute", "collective_wait",
-               "channel_wait", "checkpoint", "weight_publish")
+               "channel_wait", "checkpoint_snapshot", "checkpoint_persist",
+               "weight_publish")
 
     _PUBLISH_EVERY_S = 2.0
     _HISTORY = 64
@@ -219,6 +223,7 @@ class _TrainSession:
         checkpoint: Optional[Checkpoint],
         mesh_config: Any = None,
         axis_rules: Optional[Dict[str, Any]] = None,
+        ckpt_plane: Optional[Dict[str, Any]] = None,
     ):
         self.rank = rank
         self.world_size = world_size
@@ -241,6 +246,18 @@ class _TrainSession:
         # drain (preemption) notice: the loop should checkpoint at its
         # next step boundary; cleared when a checkpoint is reported
         self.checkpoint_requested = threading.Event()
+        # the tier the drain checkpoint must reach: "any" (default —
+        # whatever tier lands) or "memory" (deadline below disk-write
+        # time: peer-RAM ack suffices, skip waiting on the disk tier)
+        self.checkpoint_request_tier = "any"
+        # node ids covered by the drain notice: the emergency push must
+        # not place its replica on a node about to be shut down
+        self.checkpoint_request_avoid: set = set()
+        # tiered-checkpoint plane wiring from the controller (None in
+        # legacy sync mode): storage_dir/run/peer/server names — see
+        # ``train.checkpoint_async`` (mode "tiered")
+        self.ckpt_plane = ckpt_plane
+        self._checkpointer = None  # lazy AsyncCheckpointer
         # lazy per-session step-time attribution ledger (step_ledger())
         self._ledger: Optional[StepLedger] = None
 
@@ -263,12 +280,22 @@ def _get_session() -> _TrainSession:
 
 
 def report(
-    metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None
+    metrics: Dict[str, Any], checkpoint: Optional[Any] = None
 ) -> None:
-    """Report metrics (and optionally a checkpoint) to the controller."""
+    """Report metrics (and optionally a checkpoint) to the controller.
+
+    ``checkpoint`` may be a directory :class:`Checkpoint` (legacy
+    whole-tree path) or a ``checkpoint_async.TieredCheckpoint`` handle
+    from ``get_context().checkpointer().save(...)`` — the tiered row
+    carries the generation index; the controller tracks per-tier
+    durability from poll-time checkpointer status (the background
+    persist finishes after this call returns).
+    """
     s = _get_session()
     if checkpoint is not None:
         s.checkpoint_requested.clear()
+        s.checkpoint_request_tier = "any"
+        s.checkpoint_request_avoid = set()
     s.results.put({"metrics": dict(metrics), "checkpoint": checkpoint})
 
 
@@ -423,6 +450,77 @@ class TrainContext:
         the last reported checkpoint will be re-run by the replacement
         group.  Loops that checkpoint every step can ignore this."""
         return _get_session().checkpoint_requested.is_set()
+
+    def drain_checkpoint_tier(self) -> str:
+        """The durability tier the pending drain checkpoint must reach:
+        ``"any"`` (normal — let the disk tier land) or ``"memory"`` (the
+        drain deadline is below disk-write time: the peer-RAM ack is the
+        commit; call ``checkpointer().commit_ram()`` and report)."""
+        return _get_session().checkpoint_request_tier
+
+    def checkpoint_mode(self) -> str:
+        """``"tiered"`` when the controller wired the async sharded
+        checkpoint plane into this session (``CheckpointConfig(mode=
+        "tiered")``), else ``"sync"`` (legacy whole-tree reports)."""
+        return "tiered" if _get_session().ckpt_plane is not None else "sync"
+
+    def checkpointer(self, writers: Optional[int] = None):
+        """This rank's tiered :class:`~ray_tpu.train.checkpoint_async.
+        AsyncCheckpointer` (one per session, wired to the run's storage
+        dir, peer replica server, and this session's step ledger).
+        ``writers`` overrides the writer-group size when fewer ranks
+        than the world save (e.g. the RLHF loop checkpoints from rank 0
+        only: ``writers=1`` makes it a sole-writer generation).  Usable
+        even in sync mode (local-RAM + disk tiers only) — e.g. bench
+        arms construct sessions without a controller."""
+        s = _get_session()
+        if s._checkpointer is None:
+            from ray_tpu.train.checkpoint_async import AsyncCheckpointer
+
+            plane = s.ckpt_plane or {}
+            s._checkpointer = AsyncCheckpointer(
+                storage_dir=plane.get("storage_dir"),
+                run=plane.get("run", s.group_name),
+                rank=s.rank,
+                world=writers if writers is not None else s.world_size,
+                peer_name=plane.get("peer"),
+                server_names=plane.get("servers", ()),
+                ledger=self.step_ledger(),
+                # memory-tier drain requests preempt save()'s disk
+                # backpressure: the emergency checkpoint must commit at
+                # the RAM tier inside the reclaim window even when a
+                # slow disk persist is still in flight
+                preempt_ram=lambda: (
+                    s.checkpoint_requested.is_set()
+                    and s.checkpoint_request_tier == "memory"),
+                drain_avoid=lambda: s.checkpoint_request_avoid,
+            )
+        return s._checkpointer
+
+    def restore_checkpoint(self):
+        """Restore the newest complete checkpoint, mode-appropriately.
+
+        Tiered mode walks the per-shard preference ladder (local RAM ->
+        peer RAM -> committed disk) and reassembles the full tree
+        whatever mesh wrote it; sync mode loads the controller-provided
+        directory checkpoint.  Returns a ``checkpoint_async.
+        RestoreResult`` (``.tree``, ``.meta``, ``.index``, ``.tier``) or
+        None when no checkpoint exists yet.
+        """
+        s = _get_session()
+        if s.ckpt_plane is not None:
+            return self.checkpointer().restore()
+        ck = s.latest_checkpoint
+        if ck is None:
+            return None
+        import re
+
+        from ray_tpu.train.checkpoint_async import RestoreResult
+
+        m = re.search(r"checkpoint_(\d+)$", ck.path)
+        return RestoreResult(
+            tree=ck.to_pytree(), meta={}, index=int(m.group(1)) if m else 0,
+            world=s.world_size, tier_by_rank={}, disk_reads=1, path=ck.path)
 
     def collective_group(self, backend: str = "tcp",
                          timeout_s: Optional[float] = None) -> str:

@@ -22,7 +22,17 @@ from ray_tpu.train.policies import FailurePolicy, ScalingPolicy
 
 
 class DataParallelTrainer:
-    """SPMD trainer: run one function on N gang-scheduled workers."""
+    """SPMD trainer: run one function on N gang-scheduled workers.
+
+    Checkpointing: with ``RunConfig(checkpoint_config=CheckpointConfig(
+    mode="tiered"))`` the run uses the async sharded checkpoint plane
+    (``train.checkpoint_async``) — the loop's ``save()`` pays only the
+    D2H snapshot; serialize+fsync happens on a background thread, each
+    rank's shard is replicated to a peer node's RAM, and restores walk
+    the ladder local RAM -> peer RAM -> committed disk.  The controller
+    owns the per-node replica servers, so the RAM tier survives the very
+    worker-group restarts it exists to serve.
+    """
 
     def __init__(
         self,

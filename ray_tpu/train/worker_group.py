@@ -29,6 +29,11 @@ class WorkerStatus:
     error: Optional[str]
     results: List[Dict[str, Any]]  # drained (metrics, checkpoint) rows
     dead: bool = False  # actor unreachable
+    # tiered-checkpoint status of this rank's AsyncCheckpointer (None in
+    # sync mode): {"index", "tier", "ram_acked", "committed_path"} — the
+    # background persist lands AFTER the report row drained, so tier
+    # progress travels on every poll, not on the one-shot row
+    ckpt: Optional[Dict[str, Any]] = None
 
 
 class TrainWorker:
@@ -83,6 +88,7 @@ class TrainWorker:
         dataset_shard: Any = None,
         mesh_config: Any = None,
         axis_rules: Any = None,
+        ckpt_plane: Optional[Dict[str, Any]] = None,
     ) -> None:
         from ray_tpu._private import serialization
         from ray_tpu.train import session as session_mod
@@ -97,6 +103,7 @@ class TrainWorker:
             checkpoint=ckpt,
             mesh_config=mesh_config,
             axis_rules=axis_rules,
+            ckpt_plane=ckpt_plane,
         )
         sess.dataset_shard = dataset_shard
         self._session = sess
@@ -116,12 +123,20 @@ class TrainWorker:
         self._thread = threading.Thread(target=_run, daemon=True, name="train-loop")
         self._thread.start()
 
-    def request_checkpoint(self) -> bool:
+    def request_checkpoint(self, tier: str = "any",
+                           avoid_nodes: Optional[List[str]] = None) -> bool:
         """Drain-notice leg: ask the loop to checkpoint at its next step
-        boundary (``get_context().drain_requested()`` flips true)."""
+        boundary (``get_context().drain_requested()`` flips true).
+        ``tier="memory"`` marks the deadline too short for the disk
+        tier: the loop should ``commit_ram()`` and report as soon as
+        the peer-RAM replica acks.  ``avoid_nodes`` are the draining
+        node ids — the emergency push must not land its replica on a
+        node the drain protocol is about to shut down."""
         sess = self._session
         if sess is None:
             return False
+        sess.checkpoint_request_avoid = set(avoid_nodes or ())
+        sess.checkpoint_request_tier = tier
         sess.checkpoint_requested.set()
         return True
 
@@ -136,22 +151,41 @@ class TrainWorker:
             except Exception:
                 break
         # Checkpoints travel as paths (directories are node-local; the
-        # controller re-wraps them).
+        # controller re-wraps them).  Tiered handles travel as their
+        # generation index — durability progress rides the poll-level
+        # ``ckpt`` status below, since the background persist usually
+        # finishes after the row drains.
         out_rows = []
         for r in rows:
             ck = r.get("checkpoint")
-            out_rows.append({
-                "metrics": r["metrics"],
-                "checkpoint_path": ck.path if ck is not None else None,
-            })
+            row = {"metrics": r["metrics"], "checkpoint_path": None}
+            if ck is not None:
+                if hasattr(ck, "ram_acked"):  # TieredCheckpoint handle
+                    row["checkpoint_index"] = ck.index
+                    row["checkpoint_path"] = ck.committed_path
+                else:
+                    row["checkpoint_path"] = ck.path
+            out_rows.append(row)
         err = None
         if sess.error is not None:
             err = getattr(sess, "error_tb", None) or repr(sess.error)
+        ckpt_status = None
+        cp = sess._checkpointer
+        last = cp.last if cp is not None else None
+        if last is not None:
+            ckpt_status = {
+                "index": last.index,
+                "tier": last.tier,
+                "ram_acked": last.ram_acked,
+                "committed_path": last.committed_path,
+                "world": last.world,
+            }
         return {
             "running": self._thread is not None and self._thread.is_alive(),
             "finished": sess.finished.is_set(),
             "error": err,
             "results": out_rows,
+            "ckpt": ckpt_status,
         }
 
     def shutdown(self) -> bool:
@@ -244,12 +278,16 @@ class WorkerGroup:
         with the cluster's DRAINING set)."""
         return [m.get("node_id", "") for m in self.worker_metadata]
 
-    def request_checkpoint(self) -> None:
-        """Best-effort fan-out of the drain notice to every rank."""
+    def request_checkpoint(self, tier: str = "any",
+                           avoid_nodes: Optional[List[str]] = None) -> None:
+        """Best-effort fan-out of the drain notice to every rank
+        (``tier="memory"`` when the deadline can't fit the disk tier;
+        ``avoid_nodes`` = the draining nodes, so emergency replicas
+        steer clear of hardware about to disappear)."""
         refs = []
         for w in self.workers:
             try:
-                refs.append(w.request_checkpoint.remote())
+                refs.append(w.request_checkpoint.remote(tier, avoid_nodes))
             except Exception:  # noqa: BLE001 — dying worker
                 pass
         for r in refs:
@@ -267,6 +305,7 @@ class WorkerGroup:
         dist_env: Optional[List[Dict[str, str]]] = None,
         mesh_config: Any = None,
         axis_rules: Any = None,
+        ckpt_planes: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         n = len(self.workers)
         if dist_env is not None:
@@ -281,6 +320,7 @@ class WorkerGroup:
                 fn_payload, config, rank, n, self.group_name,
                 checkpoint.path if checkpoint else None, shard,
                 mesh_config, axis_rules,
+                ckpt_planes[rank] if ckpt_planes else None,
             ))
         ray_tpu.get(refs, timeout=60)
 
@@ -293,7 +333,8 @@ class WorkerGroup:
                 st = ray_tpu.get(ref, timeout=timeout)
                 statuses.append(WorkerStatus(
                     rank=rank, running=st["running"], finished=st["finished"],
-                    error=st["error"], results=st["results"]))
+                    error=st["error"], results=st["results"],
+                    ckpt=st.get("ckpt")))
             except Exception as e:  # actor died / unreachable
                 statuses.append(WorkerStatus(
                     rank=rank, running=False, finished=False,

@@ -1,0 +1,317 @@
+"""Peer-RAM checkpoint replica plane: host-memory shard replication.
+
+The emergency tier of the train checkpoint ladder (local RAM -> peer
+RAM -> committed disk shard, see ``ray_tpu.train.checkpoint_async``).
+One :class:`CheckpointReplicaServer` actor lives on each train node,
+OUTSIDE the worker placement group and owned by the driver-side
+controller, so it survives worker-group restarts: when a train host is
+SIGKILLed mid-run, the next generation restores that host's shards from
+the replica a peer node holds in RAM — zero disk reads for the lost
+shards (the Orbax "emergency checkpointing" discipline).
+
+Topology: rank ``r`` pushes its shard to the server on the node of rank
+``(r + 1) % world`` (ring), so a single lost host never takes both a
+shard and its replica.  Replication is an rpush over the object-store
+channel plane (actor call payloads ride the same transfer path as
+PR 10's edge transports); pushes happen on the background persist
+thread, off the step critical path.
+
+Every cross-actor wait in this module is bounded — a dead replica
+server must degrade the ladder to disk, never hang a restore.  The
+module is listed in raylint's ``bounded-blocking`` deadline-required
+dirs, so an unbounded ``ray_tpu.get`` here fails CI.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import ray_tpu
+from ray_tpu.util.fault_injection import fault_point
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+# generations of shard blobs a server retains per run (the newest
+# complete one plus the one being written)
+KEEP_GENERATIONS = 2
+
+def _rpc_timeout(timeout):
+    """Resolve an RPC bound: explicit arg wins, else the
+    ``train_checkpoint_replica_rpc_timeout_s`` config flag."""
+    if timeout is not None:
+        return timeout
+    from ray_tpu._private.config import config
+
+    return config.train_checkpoint_replica_rpc_timeout_s
+
+
+def server_name(run: str, node_id: str) -> str:
+    """Detached-actor-style name for the replica server of ``run`` on
+    ``node_id`` (named lookup lets restarted workers re-find their
+    peers without the controller re-shipping handles)."""
+    return f"_ckpt_replica::{run}::{node_id}"
+
+
+class CheckpointReplicaServer:
+    """Actor holding checkpoint shard blobs in host RAM for one node.
+
+    Keyed storage: ``(ckpt_index, writer_rank) -> (blob, meta)``.  Blobs
+    are the exact bytes the disk tier writes (``shard_rXX``), so a
+    restore can reassemble from any mix of RAM and disk sources.
+    Retention is bounded to :data:`KEEP_GENERATIONS` checkpoint indices
+    — a training loop checkpointing forever cannot OOM its peers.
+    """
+
+    def __init__(self, run: str):
+        self._run = run
+        # index -> {writer_rank: (blob_bytes, meta_dict)}
+        self._gens: Dict[int, Dict[int, Tuple[bytes, Dict[str, Any]]]] = {}
+        self._lock = threading.Lock()
+        self._pushes = 0
+        self._fetches = 0
+
+    def put_shard(self, index: int, writer_rank: int, blob: bytes,
+                  meta: Dict[str, Any]) -> bool:
+        """Store one writer rank's shard for checkpoint ``index``.
+        Returns True as the replication ack (the pusher treats anything
+        else — including a timeout — as tier failure)."""
+        with self._lock:
+            self._gens.setdefault(index, {})[writer_rank] = (blob, dict(meta))
+            self._pushes += 1
+            # bounded retention: evict the oldest generations beyond KEEP
+            while len(self._gens) > KEEP_GENERATIONS:
+                del self._gens[min(self._gens)]
+        return True
+
+    def get_shard(self, index: int,
+                  writer_rank: int) -> Optional[Tuple[bytes, Dict[str, Any]]]:
+        with self._lock:
+            got = self._gens.get(index, {}).get(writer_rank)
+            if got is not None:
+                self._fetches += 1
+            return got
+
+    def manifest(self) -> Dict[int, List[int]]:
+        """``{ckpt_index: [writer_ranks held]}`` for this node's RAM."""
+        with self._lock:
+            return {idx: sorted(ranks) for idx, ranks in self._gens.items()}
+
+    def manifest_meta(self) -> Dict[int, Dict[str, Any]]:
+        """Like :meth:`manifest` but with the writing world size from the
+        pushed shard meta: ``{ckpt_index: {"ranks": [...], "world": w}}``
+        (``world`` is None if no stored shard carried it).  Lets clients
+        judge generation COMPLETENESS, not just presence."""
+        with self._lock:
+            return {
+                idx: {
+                    "ranks": sorted(shards),
+                    "world": next(
+                        (m["world"] for (_b, m) in shards.values()
+                         if m.get("world")), None),
+                }
+                for idx, shards in self._gens.items()
+            }
+
+    def drop(self, index: Optional[int] = None) -> None:
+        with self._lock:
+            if index is None:
+                self._gens.clear()
+            else:
+                self._gens.pop(index, None)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "run": self._run,
+                "generations": sorted(self._gens),
+                "shards": sum(len(g) for g in self._gens.values()),
+                "bytes": sum(
+                    len(b) for g in self._gens.values()
+                    for (b, _m) in g.values()),
+                "pushes": self._pushes,
+                "fetches": self._fetches,
+            }
+
+
+class ReplicaPlane:
+    """Driver-side lifecycle of the per-node replica servers for a run.
+
+    Owned by the ``TrainController`` (NOT the worker group): servers are
+    named actors pinned to worker nodes with soft node affinity, created
+    once per node and reused across group generations, so RAM replicas
+    survive the very restarts they exist to serve.
+    """
+
+    def __init__(self, run: str):
+        self.run = run
+        self._servers: Dict[str, Any] = {}  # node_id -> ActorHandle
+
+    def ensure_for_nodes(self, node_ids: Sequence[str]) -> None:
+        """Idempotently spawn one server per (new) worker node."""
+        remote_cls = ray_tpu.remote(CheckpointReplicaServer)
+        for node_id in node_ids:
+            if not node_id or node_id in self._servers:
+                continue
+            handle = remote_cls.options(
+                name=server_name(self.run, node_id),
+                get_if_exists=True,
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=node_id, soft=True),
+            ).remote(self.run)
+            self._servers[node_id] = handle
+
+    def drop_node(self, node_id: str) -> None:
+        """Forget (and kill) the server on a dead node so a later
+        ``ensure_for_nodes`` respawns elsewhere-pinned state cleanly."""
+        handle = self._servers.pop(node_id, None)
+        if handle is not None:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass
+
+    @property
+    def node_ids(self) -> List[str]:
+        return list(self._servers)
+
+    def server_names(self) -> List[str]:
+        return [server_name(self.run, n) for n in self._servers]
+
+    def peer_assignment(self, worker_node_ids: Sequence[str]) -> List[str]:
+        """Per-rank peer server name: rank ``r`` replicates to the
+        server on the node of rank ``(r+1) % world`` — skipping forward
+        to the first peer on a *different* node when possible, so a
+        single host loss never holds both copies.  On a one-node
+        cluster the local server is the only (degenerate) choice."""
+        world = len(worker_node_ids)
+        names: List[str] = []
+        for r in range(world):
+            chosen = worker_node_ids[(r + 1) % world]
+            for step in range(1, world):
+                cand = worker_node_ids[(r + step) % world]
+                if cand != worker_node_ids[r]:
+                    chosen = cand
+                    break
+            names.append(server_name(self.run, chosen))
+        return names
+
+    def ram_manifest(
+            self, timeout: Optional[float] = None) -> Dict[int, List[int]]:
+        """Union of every live server's manifest:
+        ``{ckpt_index: sorted writer_ranks held anywhere in the plane}``.
+        Dead/slow servers are skipped (bounded), shrinking the union —
+        the ladder then falls through to disk for their shards."""
+        union: Dict[int, set] = {}
+        for handle in list(self._servers.values()):
+            try:
+                mf = ray_tpu.get(handle.manifest.remote(), timeout=timeout)
+            except Exception:
+                continue
+            for idx, ranks in mf.items():
+                union.setdefault(idx, set()).update(ranks)
+        return {idx: sorted(r) for idx, r in union.items()}
+
+    def shutdown(self) -> None:
+        for node_id in list(self._servers):
+            self.drop_node(node_id)
+
+
+# ---------------------------------------------------------------------------
+# worker-side helpers (run inside TrainWorker processes; servers are
+# re-found by name so no handle shipping is needed across restarts)
+# ---------------------------------------------------------------------------
+
+
+def push_shard(peer_name: str, index: int, writer_rank: int, blob: bytes,
+               meta: Dict[str, Any],
+               timeout: Optional[float] = None) -> bool:
+    """Replicate one shard blob to the peer's RAM.  Returns True only on
+    an explicit ack; any failure (dead peer, timeout, injected fault at
+    ``train.checkpoint.peer_push``) degrades to False — the caller's
+    checkpoint is then durable only at the tiers that did land."""
+    fault_point("train.checkpoint.peer_push")
+    timeout = _rpc_timeout(timeout)
+    try:
+        server = ray_tpu.get_actor(peer_name)
+        ack = ray_tpu.get(
+            server.put_shard.remote(index, writer_rank, blob, meta),
+            timeout=timeout)
+        return ack is True
+    except Exception:
+        return False
+
+
+def fetch_shard(server_names_: Sequence[str], index: int, writer_rank: int,
+                timeout: Optional[float] = None,
+                deadline_s: float = 120.0) -> Optional[
+                    Tuple[bytes, Dict[str, Any]]]:
+    """Fetch one writer rank's shard from whichever live server holds
+    it.  Tries every server (bounded per-RPC and by an overall
+    ``deadline_s``); None means the RAM tier lost this shard and the
+    restore ladder must fall through to disk."""
+    timeout = _rpc_timeout(timeout)
+    deadline = time.monotonic() + deadline_s
+    for name in server_names_:
+        if time.monotonic() >= deadline:
+            break
+        try:
+            server = ray_tpu.get_actor(name)
+            got = ray_tpu.get(
+                server.get_shard.remote(index, writer_rank),
+                timeout=min(timeout, max(0.1, deadline - time.monotonic())))
+        except Exception:
+            continue
+        if got is not None:
+            return got
+    return None
+
+
+def ram_manifest_by_names(
+        server_names_: Sequence[str],
+        timeout: Optional[float] = None) -> Dict[int, List[int]]:
+    """Worker-side union manifest via named lookup (the worker has no
+    ``ReplicaPlane``; it only knows the server names it was started
+    with)."""
+    timeout = _rpc_timeout(timeout)
+    union: Dict[int, set] = {}
+    for name in server_names_:
+        try:
+            server = ray_tpu.get_actor(name)
+            mf = ray_tpu.get(server.manifest.remote(), timeout=timeout)
+        except Exception:
+            continue
+        for idx, ranks in mf.items():
+            union.setdefault(idx, set()).update(ranks)
+    return {idx: sorted(r) for idx, r in union.items()}
+
+
+def ram_complete_generations(
+        server_names_: Sequence[str],
+        timeout: Optional[float] = None) -> List[int]:
+    """Sorted ckpt indices whose shard set is COMPLETE across the
+    plane's RAM — every writer rank ``0..world-1`` of the generation's
+    own world held somewhere (ranks push to different peers, so
+    completeness is a cross-server union).
+
+    This is what first-save index discovery must key on: a sibling
+    rank's half-pushed generation is *presence*, not a generation, and
+    counting it skews the late rank's numbering +1 — after which one
+    index holds shards from ADJACENT training steps and a restore
+    reassembles a tree that never existed."""
+    timeout = _rpc_timeout(timeout)
+    ranks_by_idx: Dict[int, set] = {}
+    world_by_idx: Dict[int, int] = {}
+    for name in server_names_:
+        try:
+            server = ray_tpu.get_actor(name)
+            mf = ray_tpu.get(server.manifest_meta.remote(), timeout=timeout)
+        except Exception:
+            continue
+        for idx, info in mf.items():
+            ranks_by_idx.setdefault(idx, set()).update(info["ranks"])
+            if info.get("world"):
+                world_by_idx[idx] = info["world"]
+    return sorted(
+        idx for idx, ranks in ranks_by_idx.items()
+        if idx in world_by_idx and ranks >= set(range(world_by_idx[idx])))

@@ -46,6 +46,9 @@ site                        guards
 ``gcs.drain_broadcast``     the GCS ``drain_node`` handler's hot edge
 ``raylet.drain_ack``        the raylet's ``drain_self`` ack (lost-RPC path)
 ``train.checkpoint.commit``  between checkpoint staging and rename-commit
+``train.checkpoint.persist_async``  the background shard serialize+fsync edge
+``train.checkpoint.peer_push``  the peer-RAM replica push (emergency tier)
+``train.checkpoint.restore``  entry of the tiered restore ladder
 ``collective.op``           every supervised collective op, before dispatch
 ``collective.leader.recv``  the TCP leader's per-connection serve edge
 ``collective.rendezvous``   the epoch/leader KV legs of group rendezvous

@@ -122,6 +122,38 @@ def list_slo_verdicts() -> List[Dict[str, Any]]:
     return aggregate_verdict_records(records)
 
 
+def list_checkpoint_status(run: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Per-rank tiered-checkpoint state from the records every
+    :class:`~ray_tpu.train.checkpoint_async.AsyncCheckpointer` publishes
+    (GCS KV, namespace "train", key ``ckpt_status/<run>/<rank>``):
+    generation index, tier reached (``local`` → ``memory`` → ``disk``),
+    peer-RAM ack, committed path, and snapshot/persist seconds — the
+    same table the dashboard's ``/api/train`` serves as
+    ``checkpoints``.  Pass ``run`` to filter to one training run."""
+    import json as _json
+
+    try:
+        from ray_tpu.experimental.internal_kv import _internal_kv_get_prefix
+
+        table = _internal_kv_get_prefix("ckpt_status/", namespace="train")
+    except Exception:  # noqa: BLE001 — no cluster
+        return []
+    records = []
+    for key, raw in (table or {}).items():
+        try:
+            rec = _json.loads(raw)
+        except Exception:  # noqa: BLE001 — record mid-write
+            continue
+        if isinstance(key, bytes):
+            key = key.decode("utf-8", "replace")
+        rec.setdefault("key", key[len("ckpt_status/"):])
+        if run is not None and rec.get("run") != run:
+            continue
+        records.append(rec)
+    records.sort(key=lambda r: (r.get("run", ""), r.get("rank", 0)))
+    return records
+
+
 def list_actors() -> List[Dict[str, Any]]:
     w = _worker()
     out = w.run_coro(w.gcs.call("list_actors"))

@@ -1,0 +1,695 @@
+"""PR 17: async multi-tier sharded checkpointing + peer-RAM restore.
+
+Fast tier (no cluster): the sharded WAL discipline (stage+fsync+rename
+per rank, MANIFEST commit), torn-generation invisibility — including a
+real SIGKILL mid-async-persist in a subprocess — restore-parity across
+same-mesh and clamped-mesh restores, save backpressure, and the
+``Checkpoint.to_directory`` commit discipline.
+
+Cluster tier: the replica plane (peer push/fetch, ring assignment,
+peer-death fall-through to disk) and the slow e2e chaos scenarios —
+SIGKILL one train worker mid-run and restore its shards from peer RAM
+with zero disk reads, and a drain below disk-write time committing the
+``memory`` tier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.train import checkpoint_async as ca
+from ray_tpu.train.checkpoint_async import (
+    AsyncCheckpointer,
+    IncompleteCheckpointError,
+    commit_manifest,
+    reassemble,
+    restore_tiered,
+    snapshot_shards,
+    write_shard,
+)
+from ray_tpu.train.checkpoint_manager import committed_checkpoint_dirs
+from ray_tpu.util import fault_injection as fi
+
+
+def _tree(seed: int = 0, n: int = 4096):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": rng.standard_normal(n).astype("float32"),
+        "bias": rng.standard_normal(64).astype("float32"),
+        "step": np.int64(seed),
+    }
+
+
+def _trees_equal(a, b) -> bool:
+    ka, kb = sorted(a), sorted(b)
+    if ka != kb:
+        return False
+    return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+               for k in ka)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_local_cache():
+    ca._local_cache.clear()
+    yield
+    ca._local_cache.clear()
+    fi.disarm()
+
+
+# ---------------------------------------------------------------------------
+# sharded WAL discipline
+# ---------------------------------------------------------------------------
+
+
+def test_shard_write_and_manifest_commit(tmp_path):
+    """Each rank stages + fsyncs + renames its own shard; the rank-0
+    MANIFEST commit makes the generation visible; restore reassembles
+    the full tree bit-exact (restore-parity (a): same mesh)."""
+    storage = str(tmp_path)
+    tree = _tree(1)
+    world = 2
+    for rank in range(world):
+        blob = snapshot_shards(tree, rank, world, run="r", index=1,
+                               meta={"step": 1})
+        write_shard(storage, 1, rank, blob)
+    path = commit_manifest(storage, 1, world, {"step": 1}, wait_s=5.0)
+    assert os.path.basename(path) == "checkpoint_000001"
+    assert [d for d, _ in committed_checkpoint_dirs(storage)] == [1]
+    # no staging residue after commit
+    assert not any(n.endswith(".tmp") for n in os.listdir(storage))
+
+    ca._local_cache.clear()  # force the disk leg
+    res = restore_tiered(storage, "r")
+    assert res is not None and res.index == 1 and res.world == world
+    assert res.tier == "disk" and res.disk_reads == world
+    assert _trees_equal(res.tree, tree)
+    assert res.meta["step"] == 1
+
+
+def test_torn_generation_unobservable(tmp_path):
+    """A generation missing shards never commits: ``commit_manifest``
+    times out leaving only ``.tmp`` staging, the directory listing shows
+    no committed gen, and restore falls back to the older complete one."""
+    storage = str(tmp_path)
+    tree = _tree(2)
+    # gen 1: complete, committed
+    blob = snapshot_shards(tree, 0, 1, run="r", index=1, meta={})
+    write_shard(storage, 1, 0, blob)
+    commit_manifest(storage, 1, 1, {}, wait_s=5.0)
+    # gen 2: world=2 but only rank 0 ever writes
+    blob = snapshot_shards(_tree(3), 0, 2, run="r", index=2, meta={})
+    write_shard(storage, 2, 0, blob)
+    with pytest.raises(TimeoutError):
+        commit_manifest(storage, 2, 2, {}, wait_s=0.3)
+    assert [d for d, _ in committed_checkpoint_dirs(storage)] == [1]
+
+    ca._local_cache.clear()
+    res = restore_tiered(storage, "r")
+    assert res is not None and res.index == 1
+    assert _trees_equal(res.tree, tree)
+
+
+def test_sigkill_mid_async_persist_ignored_on_restore(tmp_path):
+    """Chaos site ``train.checkpoint.persist_async``: a writer
+    SIGKILLed mid-background-persist (a preempted host) leaves gen 2
+    torn and staged-only; a restart restores gen 1 untouched."""
+    storage = str(tmp_path)
+    script = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import numpy as np
+from ray_tpu.train.checkpoint_async import AsyncCheckpointer
+
+tree = {{"w": np.arange(256, dtype=np.float32)}}
+ck = AsyncCheckpointer({storage!r}, "r", 0, 1, publish_status=False)
+ck.save(tree, {{"step": 1}}, wait_persist=True)   # gen 1 commits clean
+ck.save(tree, {{"step": 2}})                      # gen 2: killed mid-persist
+ck.wait(30.0)
+"""
+    env = dict(os.environ)
+    env["RAY_TPU_FAULT_INJECT"] = \
+        "train.checkpoint.persist_async:2:1:sigkill"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout, proc.stderr)
+    assert [d for d, _ in committed_checkpoint_dirs(storage)] == [1]
+
+    res = restore_tiered(storage, "r")
+    assert res is not None and res.index == 1
+    assert np.array_equal(res.tree["w"], np.arange(256, dtype=np.float32))
+
+
+def test_restore_fault_site_armed(tmp_path):
+    """``train.checkpoint.restore`` guards the ladder entry."""
+    storage = str(tmp_path)
+    blob = snapshot_shards(_tree(4), 0, 1, run="r", index=1, meta={})
+    write_shard(storage, 1, 0, blob)
+    commit_manifest(storage, 1, 1, {}, wait_s=5.0)
+    with fi.armed("train.checkpoint.restore", exc=ConnectionError("boom")):
+        with pytest.raises(ConnectionError):
+            restore_tiered(storage, "r")
+    assert restore_tiered(storage, "r") is not None
+
+
+# ---------------------------------------------------------------------------
+# resharding-aware reassembly (restore-parity (b): clamped mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_clamped_mesh_restore_reassembles_foreign_shards(tmp_path):
+    """A 4-way generation restored by a shrunk (clamped) mesh: the
+    restoring world is smaller, every foreign shard is fetched and the
+    tree reassembles bit-exact."""
+    storage = str(tmp_path)
+    tree = {"emb": np.arange(4 * 512, dtype=np.float32).reshape(4 * 512),
+            "table": np.arange(8 * 16, dtype=np.float32).reshape(8, 16),
+            "scalar": np.float32(7.5)}
+    world = 4
+    for rank in range(world):
+        blob = snapshot_shards(tree, rank, world, run="r", index=3,
+                               meta={"step": 3})
+        write_shard(storage, 3, rank, blob)
+    commit_manifest(storage, 3, world, {"step": 3}, wait_s=5.0)
+
+    ca._local_cache.clear()
+    res = restore_tiered(storage, "r")  # the restorer owns none of them
+    assert res is not None and res.world == 4 and res.disk_reads == 4
+    assert _trees_equal(res.tree, tree)
+    # and the shrunk mesh can immediately write its own generation
+    ck = AsyncCheckpointer(storage, "r", 0, 1, publish_status=False)
+    try:
+        h = ck.save(res.tree, {"step": 4}, wait_persist=True)
+        assert h.index == 4 and h.committed_path
+    finally:
+        ck.close()
+    res2 = restore_tiered(storage, "r")
+    assert res2.index == 4 and _trees_equal(res2.tree, tree)
+
+
+def test_reassemble_rejects_partial_tiling():
+    """Dropping one of the shards of an axis-0-split leaf is an
+    IncompleteCheckpointError, never a silently-wrong tree."""
+    tree = {"w": np.arange(64, dtype=np.float32)}
+    blobs = {r: snapshot_shards(tree, r, 2, run="r", index=1, meta={})
+             for r in range(2)}
+    full, _ = reassemble(blobs)
+    assert np.array_equal(full["w"], tree["w"])
+    with pytest.raises(IncompleteCheckpointError):
+        reassemble({0: blobs[0]})
+
+
+# ---------------------------------------------------------------------------
+# async semantics: step pays the snapshot; backpressure never drops
+# ---------------------------------------------------------------------------
+
+
+def test_save_returns_after_snapshot_and_backpressure_waits(tmp_path):
+    """save() returns before the persist lands; a second save during an
+    in-flight persist WAITS (bounded, charged to checkpoint_persist) —
+    both generations commit, nothing is dropped."""
+    from ray_tpu.train.session import StepLedger
+
+    ledger = StepLedger(group_name="t", publish=False)
+    ck = AsyncCheckpointer(str(tmp_path), "r", 0, 1, ledger=ledger,
+                           publish_status=False)
+    try:
+        with fi.armed("train.checkpoint.persist_async", exc="delay:0.8"):
+            t0 = time.perf_counter()
+            h1 = ck.save(_tree(5), {"step": 1})
+            snap_s = time.perf_counter() - t0
+            assert not h1.done.is_set() or h1.committed_path is None \
+                or snap_s < 0.8, "save() blocked on the persist"
+            with ledger.step():
+                t0 = time.perf_counter()
+                h2 = ck.save(_tree(6), {"step": 2})
+                waited = time.perf_counter() - t0
+            assert waited >= 0.3, f"second save did not backpressure: " \
+                                  f"{waited:.3f}s"
+        assert ck.wait(30.0)
+        assert h1.committed_path and h2.committed_path
+        assert [d for d, _ in committed_checkpoint_dirs(str(tmp_path))] \
+            == [1, 2]
+        # the stall was attributed to the persist bucket, in-step
+        bd = ledger.breakdown()
+        assert bd["buckets_s"].get("checkpoint_persist", 0.0) >= 0.3, bd
+        assert bd["buckets_s"].get("checkpoint_snapshot", 0.0) > 0.0, bd
+    finally:
+        ck.close()
+
+
+def test_backpressure_timeout_raises_never_drops(tmp_path):
+    """When the wait bound expires the save RAISES (the caller decides)
+    rather than silently skipping the snapshot."""
+    ck = AsyncCheckpointer(str(tmp_path), "r", 0, 1, publish_status=False)
+    try:
+        with fi.armed("train.checkpoint.persist_async", exc="delay:2.0"):
+            ck.save(_tree(7), {"step": 1})
+            with pytest.raises(TimeoutError):
+                ck.save(_tree(8), {"step": 2}, persist_wait_s=0.1)
+        assert ck.wait(30.0)
+        # the in-flight generation still landed
+        assert [d for d, _ in committed_checkpoint_dirs(str(tmp_path))] \
+            == [1]
+    finally:
+        ck.close()
+
+
+def test_local_ram_tier_restores_with_zero_disk_reads(tmp_path):
+    """The restarted-in-place case: this process's own host snapshot is
+    tier 1 of the ladder — restore touches no disk shards."""
+    ck = AsyncCheckpointer(str(tmp_path), "r", 0, 1, publish_status=False)
+    try:
+        tree = _tree(9)
+        ck.save(tree, {"step": 1}, wait_persist=True)
+        res = ck.restore()
+        assert res is not None and res.disk_reads == 0
+        assert res.tier == "memory" and res.tier_by_rank == {0: "local"}
+        assert _trees_equal(res.tree, tree)
+    finally:
+        ck.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint.to_directory commit discipline (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_to_directory_commits_via_rename(tmp_path):
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "state.json").write_text('{"step": 3}')
+    (src / "sub").mkdir()
+    (src / "sub" / "blob.bin").write_bytes(b"\x00" * 128)
+
+    dest = str(tmp_path / "dest")
+    out = Checkpoint(str(src)).to_directory(dest)
+    assert out == dest
+    assert json.loads((tmp_path / "dest" / "state.json").read_text()) \
+        == {"step": 3}
+    assert (tmp_path / "dest" / "sub" / "blob.bin").read_bytes() \
+        == b"\x00" * 128
+    # committed by rename: no staging dir left behind
+    assert not os.path.exists(dest + ".tmp")
+    # legacy merge contract into a non-empty destination still holds
+    extra = tmp_path / "dest2"
+    extra.mkdir()
+    (extra / "keep.txt").write_text("keep")
+    Checkpoint(str(src)).to_directory(str(extra))
+    assert (extra / "keep.txt").read_text() == "keep"
+    assert (extra / "state.json").exists()
+    assert not os.path.exists(str(extra) + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# replica plane (cluster): peer push/fetch, ring, death fall-through
+# ---------------------------------------------------------------------------
+
+
+def test_peer_ram_tier_and_peer_death_fall_through(ray_start, tmp_path):
+    """The ladder's middle rung: with the local cache gone (a restarted
+    host) shards restore from the peer replica server with ZERO disk
+    reads; kill the peer and the same restore falls through to the
+    committed disk generation."""
+    import ray_tpu
+    from ray_tpu.util import checkpoint_replica as cr
+
+    me = ray_tpu.nodes()[0]["node_id"]
+    plane = cr.ReplicaPlane("peer-tier-test")
+    try:
+        plane.ensure_for_nodes([me])
+        servers = plane.server_names()
+        assert servers == [cr.server_name("peer-tier-test", me)]
+
+        tree = _tree(11)
+        ck = AsyncCheckpointer(str(tmp_path), "peer-tier-test", 0, 1,
+                               peer_name=servers[0], server_names=servers,
+                               publish_status=False)
+        try:
+            h = ck.save(tree, {"step": 1}, wait_persist=True)
+            assert h.ram_acked and h.committed_path
+        finally:
+            ck.close()
+
+        ca._local_cache.clear()  # the writer host is gone
+        res = restore_tiered(str(tmp_path), "peer-tier-test",
+                             server_names=servers)
+        assert res is not None and res.disk_reads == 0
+        assert res.tier == "memory" and res.tier_by_rank == {0: "peer"}
+        assert _trees_equal(res.tree, tree)
+
+        # kill the peer: the ladder falls to the committed disk tier
+        ray_tpu.kill(ray_tpu.get_actor(servers[0]))
+        time.sleep(0.5)
+        res = restore_tiered(str(tmp_path), "peer-tier-test",
+                             server_names=servers)
+        assert res is not None and res.disk_reads == 1
+        assert res.tier == "disk" and res.tier_by_rank == {0: "disk"}
+        assert _trees_equal(res.tree, tree)
+    finally:
+        plane.shutdown()
+
+
+def test_replica_ring_assignment_skips_own_node(ray_start):
+    from ray_tpu.util import checkpoint_replica as cr
+
+    plane = cr.ReplicaPlane("ring-test")
+    try:
+        # single node: the local server is the only (degenerate) choice
+        # — still worth having, it survives a worker-process SIGKILL
+        me = "node-a"
+        assert plane.peer_assignment([me, me]) == \
+            [cr.server_name("ring-test", me)] * 2
+        # two nodes, two ranks each: each rank's peer server lives on
+        # the OTHER node (fate-sharing with your own host is pointless)
+        peers = plane.peer_assignment(["node-a", "node-b",
+                                      "node-a", "node-b"])
+        for nid, peer in zip(["node-a", "node-b", "node-a", "node-b"],
+                             peers):
+            assert peer == cr.server_name(
+                "ring-test",
+                "node-b" if nid == "node-a" else "node-a")
+    finally:
+        plane.shutdown()
+
+
+def test_peer_push_fault_site_degrades_to_disk(ray_start, tmp_path):
+    """``train.checkpoint.peer_push`` armed: the push fails, the save
+    still lands the disk tier (ram_acked False, committed True)."""
+    import ray_tpu
+    from ray_tpu.util import checkpoint_replica as cr
+
+    me = ray_tpu.nodes()[0]["node_id"]
+    plane = cr.ReplicaPlane("push-fault-test")
+    try:
+        plane.ensure_for_nodes([me])
+        servers = plane.server_names()
+        ck = AsyncCheckpointer(str(tmp_path), "push-fault-test", 0, 1,
+                               peer_name=servers[0], server_names=servers,
+                               publish_status=False)
+        try:
+            with fi.armed("train.checkpoint.peer_push",
+                          exc=ConnectionError("peer gone")):
+                h = ck.save(_tree(12), {"step": 1}, wait_persist=True)
+            assert not h.ram_acked
+            assert h.committed_path and h.tier == "disk"
+        finally:
+            ck.close()
+    finally:
+        plane.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# e2e chaos (slow tier): SIGKILL a worker mid-run; drain below the floor
+# ---------------------------------------------------------------------------
+
+
+def _make_tiered_loop():
+    """Deterministic 2-rank training loop on the tiered plane: state is
+    a seeded vector, each step applies a fixed update and reports a
+    'loss'; every step checkpoints through ctx.checkpointer().  Side
+    files record per-step losses and any restore's tier/disk_reads.
+    Built as a closure so it ships to workers by value (the test module
+    is not importable from a worker process)."""
+
+    def _tiered_loop(config):
+        import json as _json
+        import os as _os
+
+        import numpy as _np
+
+        from ray_tpu import train as _train
+
+        ctx = _train.get_context()
+        rank = ctx.get_world_rank()
+        side = config["side_dir"]
+        # REPLICATED state (the data-parallel contract the sharded
+        # snapshot's axis-0 ownership split assumes: every rank holds
+        # the same logical tree and persists only its owned slice)
+        state = {"w": _np.arange(128, dtype=_np.float64),
+                 "step": _np.int64(-1)}
+        start = 0
+        res = ctx.restore_checkpoint()
+        if res is not None:
+            state = res.tree
+            start = int(state["step"]) + 1
+            with open(_os.path.join(side, f"restore-r{rank}-{start}"),
+                      "w") as f:
+                _json.dump({"rank": rank, "start": start, "tier": res.tier,
+                            "disk_reads": res.disk_reads,
+                            "tier_by_rank": {str(k): v for k, v in
+                                             res.tier_by_rank.items()}}, f)
+        for step in range(start, config["steps"]):
+            state["w"] = _np.cos(state["w"]) * 1.000001
+            state["step"] = _np.int64(step)
+            loss = float(_np.sum(state["w"]))
+            if rank == 0:
+                with open(_os.path.join(side, f"loss-{step}"), "w") as f:
+                    _json.dump({"step": step, "loss": loss}, f)
+            h = ctx.checkpointer().save(state, {"step": step, "loss": loss})
+            if config.get("kill_rank") == rank and \
+                    step == config.get("kill_step") and \
+                    not _os.path.exists(_os.path.join(side, "killed")):
+                # wait for THIS generation to be durable somewhere off-host
+                # (peer RAM), then die like a preempted host — no cleanup
+                ctx.checkpointer().commit_ram(30.0)
+                with open(_os.path.join(side, "killed"), "w") as f:
+                    f.write(str(step))
+                _os.kill(_os.getpid(), 9)
+            if ctx.drain_requested() and \
+                    ctx.drain_checkpoint_tier() == "memory":
+                ctx.checkpointer().commit_ram(30.0)
+            _train.report({"step": step, "loss": loss}, checkpoint=h)
+        ctx.checkpointer().wait(60.0)
+
+    return _tiered_loop
+
+
+def _losses(side: str):
+    out = {}
+    for name in os.listdir(side):
+        if name.startswith("loss-"):
+            with open(os.path.join(side, name)) as f:
+                rec = json.load(f)
+            out[rec["step"]] = rec["loss"]
+    return out
+
+
+def _run_tiered(tmp_path, tag: str, steps: int, *, kill_step=None,
+                max_failures=0):
+    from ray_tpu import train
+
+    side = str(tmp_path / f"side-{tag}")
+    os.makedirs(side, exist_ok=True)
+    cfg = {"side_dir": side, "steps": steps}
+    if kill_step is not None:
+        cfg.update(kill_rank=1, kill_step=kill_step)
+    trainer = train.DataParallelTrainer(
+        _make_tiered_loop(),
+        train_loop_config=cfg,
+        scaling_config=train.ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 1}),
+        run_config=train.RunConfig(
+            name=f"tiered-{tag}", storage_path=str(tmp_path),
+            checkpoint_config=train.CheckpointConfig(mode="tiered"),
+            failure_config=train.FailureConfig(max_failures=max_failures)),
+    )
+    result = trainer.fit()
+    return result, side
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_worker_restores_from_peer_ram_bit_exact(no_cluster,
+                                                         tmp_path):
+    """The acceptance chaos scenario: SIGKILL one train worker mid-run;
+    the restarted group restores every rank's shards from peer RAM with
+    ZERO disk reads, and the loss curve is bit-exact against an
+    unkilled reference run."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+
+        ref, _ = _run_tiered(tmp_path, "ref", steps=6)
+        assert ref.error is None, ref.error
+        ref_losses = _losses(str(tmp_path / "side-ref"))
+        assert sorted(ref_losses) == list(range(6))
+
+        res, side = _run_tiered(tmp_path, "kill", steps=6, kill_step=3,
+                                max_failures=2)
+        assert res.error is None, res.error
+        assert os.path.exists(os.path.join(side, "killed"))
+
+        restores = [n for n in os.listdir(side) if n.startswith("restore-")]
+        assert restores, "restarted group never restored"
+        for name in restores:
+            with open(os.path.join(side, name)) as f:
+                rec = json.load(f)
+            # the ladder never touched disk for ANY shard — the lost
+            # rank's shards came from its peer's RAM
+            assert rec["disk_reads"] == 0, rec
+            assert rec["tier"] == "memory", rec
+            assert rec["start"] >= 1, rec
+
+        # loss curve bit-exact vs the unkilled reference (the rank-0
+        # writer re-emits the resumed steps; same bits -> same file)
+        kill_losses = _losses(side)
+        assert sorted(kill_losses) == list(range(6))
+        for step in range(6):
+            assert kill_losses[step] == ref_losses[step], (
+                step, kill_losses[step], ref_losses[step])
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_drain_below_disk_floor_commits_memory_tier(no_cluster, tmp_path,
+                                                    monkeypatch):
+    """A drain whose deadline is below disk-write time: the controller
+    requests a ``memory``-tier checkpoint, the peer-RAM ack commits it
+    inside the window, the elastic restart resumes from it, and the
+    failure budget is never charged (max_failures=0 and the run still
+    completes)."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    # every disk persist takes +6s: the 3s drain window below can only
+    # be met by the peer-RAM ack (pushed before the disk write)
+    monkeypatch.setenv("RAY_TPU_FAULT_INJECT",
+                       "train.checkpoint.persist_async:1:9999:delay:6")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    try:
+        cluster.connect()
+        cluster.add_node(num_cpus=2, resources={"trainer_slot": 1})
+        cluster.add_node(num_cpus=2, resources={"trainer_slot": 1})
+        cluster.wait_for_nodes()
+        side = str(tmp_path / "side-drain")
+        os.makedirs(side, exist_ok=True)
+
+        def loop(config):
+            import json as _json
+            import os as _os
+            import time as _t
+
+            import numpy as _np
+
+            from ray_tpu import train as _train
+
+            ctx = _train.get_context()
+            rank = ctx.get_world_rank()
+            state = {"w": _np.arange(64, dtype=_np.float64),
+                     "step": _np.int64(-1)}
+            start = 0
+            res = ctx.restore_checkpoint()
+            if res is not None:
+                state = res.tree
+                start = int(state["step"]) + 1
+                with open(_os.path.join(config["side_dir"],
+                                        f"resumed-r{rank}"), "w") as f:
+                    _json.dump({"start": start, "tier": res.tier,
+                                "disk_reads": res.disk_reads}, f)
+            for step in range(start, config["steps"]):
+                with open(_os.path.join(
+                        config["side_dir"],
+                        f"r{rank}-step{step}-{_t.time_ns()}"), "w") as f:
+                    _json.dump({"step": step, "rank": rank,
+                                "world": ctx.get_world_size(),
+                                "node": _os.environ.get(
+                                    "RAY_TPU_NODE_ID", "")}, f)
+                state["w"] = state["w"] + 1.0
+                state["step"] = _np.int64(step)
+                _t.sleep(config["step_s"])
+                h = ctx.checkpointer().save(state, {"step": step})
+                if ctx.drain_requested() and \
+                        ctx.drain_checkpoint_tier() == "memory":
+                    ok = ctx.checkpointer().commit_ram(10.0)
+                    with open(_os.path.join(config["side_dir"],
+                                            f"memtier-r{rank}-{step}"),
+                              "w") as f:
+                        _json.dump({"step": step, "ram_ok": bool(ok)}, f)
+                _train.report({"step": step}, checkpoint=h)
+            ctx.checkpointer().wait(60.0)
+
+        drained = {}
+
+        def drainer():
+            from ray_tpu.util.state import drain_node
+
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                for name in os.listdir(side):
+                    if not name.startswith("r1-step1-"):
+                        continue
+                    with open(os.path.join(side, name)) as f:
+                        info = json.load(f)
+                    if info["world"] == 2 and info["node"]:
+                        # 3s deadline < train_drain_memory_tier_floor_s
+                        ack = drain_node(info["node"],
+                                         reason="spot reclaim",
+                                         deadline_s=3.0)
+                        drained["node"] = info["node"]
+                        drained["ack"] = ack
+                        return
+                time.sleep(0.2)
+
+        t = threading.Thread(target=drainer, daemon=True)
+        t.start()
+
+        from ray_tpu.train.policies import ElasticScalingPolicy
+
+        trainer = train.DataParallelTrainer(
+            loop,
+            train_loop_config={"side_dir": side, "steps": 6,
+                               "step_s": 0.5},
+            scaling_config=train.ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+            run_config=train.RunConfig(
+                name="drain-mem-tier", storage_path=str(tmp_path),
+                checkpoint_config=train.CheckpointConfig(mode="tiered"),
+                # ZERO failure budget: the drain restart must ride the
+                # no-charge path or fit() errors out
+                failure_config=train.FailureConfig(max_failures=0)),
+            scaling_policy=ElasticScalingPolicy(
+                min_workers=1, max_workers=2,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+        )
+        result = trainer.fit()
+        t.join(timeout=5)
+
+        assert "node" in drained, "drainer never fired"
+        assert drained["ack"]["accepted"], drained["ack"]
+        assert result.error is None, result.error
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps and steps[-1] == 5, steps
+        # the loop committed the memory tier inside the drain window
+        mem = [n for n in os.listdir(side) if n.startswith("memtier-")]
+        assert mem, "memory-tier commit never requested of the loop"
+        assert any(json.load(open(os.path.join(side, n)))["ram_ok"]
+                   for n in mem), "peer-RAM ack never landed"
+        # and the restart actually resumed (elastic, off the drained node)
+        resumed = [n for n in os.listdir(side) if n.startswith("resumed-")]
+        assert resumed, "no worker resumed from the emergency checkpoint"
+    finally:
+        cluster.shutdown()

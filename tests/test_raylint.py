@@ -214,6 +214,31 @@ def test_bounded_blocking_serve_get_fixtures(tmp_path):
     assert not r.findings, r.findings
 
 
+def test_bounded_blocking_checkpoint_replica_fixtures(tmp_path):
+    """util/checkpoint_replica.py is deadline-required as a single
+    file (not a directory): every push/fetch targets a peer-RAM
+    replica server on another host that may be SIGKILLed mid-RPC —
+    the exact death the tier exists to survive — so a bare
+    ``ray_tpu.get`` there would wedge the persist thread forever."""
+    bad = "import ray_tpu\n\ndef push(ref):\n    return ray_tpu.get(ref)\n"
+    r = lint_tree(tmp_path, {"ray_tpu/util/checkpoint_replica.py": bad},
+                  rules=["bounded-blocking"])
+    assert rules_of(r) == ["bounded-blocking"], r.findings
+    assert r.findings[0].path == "ray_tpu/util/checkpoint_replica.py"
+    # the rest of util/ stays out of the deadline set — only the
+    # replica plane file is control-plane
+    r = lint_tree(tmp_path, {"ray_tpu/util/checkpoint_replica.py": "",
+                             "ray_tpu/util/other.py": bad},
+                  rules=["bounded-blocking"])
+    assert not r.findings, r.findings
+    good = ("import ray_tpu\n\ndef push(ref):\n"
+            "    return ray_tpu.get(ref, timeout=30.0)\n")
+    r = lint_tree(tmp_path, {"ray_tpu/util/checkpoint_replica.py": good,
+                             "ray_tpu/util/other.py": ""},
+                  rules=["bounded-blocking"])
+    assert not r.findings, r.findings
+
+
 def test_bounded_blocking_llm_channel_read_fixtures(tmp_path):
     """llm/ is a deadline-required dir for channel reads too: a KV
     landing loop whose prefill peer died must poll with a bound, never

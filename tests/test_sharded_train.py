@@ -485,7 +485,8 @@ class TestBenchMultichip:
         assert bd["coverage"] > 0.5, bd
         assert set(bd["buckets_s"]) <= {
             "data_wait", "h2d", "compute", "collective_wait",
-            "channel_wait", "checkpoint", "weight_publish", "other"}
+            "channel_wait", "checkpoint_snapshot", "checkpoint_persist",
+            "weight_publish", "other"}
         # in-bench legacy-vs-fixed A/B: the fixed layout compiles clean
         # and does not lose tokens/s.  The record's own `ok` keeps the
         # strict fixed>=legacy gate; under suite load a wall-clock tie
