@@ -40,6 +40,13 @@ Usage::
     python benchmarks/production_day.py                 # tier-1 profile
     python benchmarks/production_day.py --profile full  # the slow one
     python benchmarks/production_day.py --scenario my_timeline.json
+    python benchmarks/production_day.py --degrade       # health plane
+
+``--degrade`` swaps the timeline for the silent-degradation variant:
+one worker node is slowed 3x (no crash, no drain notice) and the
+record gates on the health plane noticing — probe-sweep detection,
+quarantine through the GCS ladder, a recorded detection latency, and
+ZERO quarantines in the clean baseline phase (false-positive gate).
 
 The tier-1 miniature lives in ``tests/test_production_day.py`` and calls
 :func:`run_production_day` directly.
@@ -106,6 +113,10 @@ class Profile:
     chaos_tail_s: float = 6.0        # keep running this long past the
     #                                  last event so recovery is visible
     drain_deadline_s: float = 10.0
+    # degrade variant: silent slowdown instead of a clean kill — the
+    # health plane's probe sweep must notice and quarantine
+    degrade_factor: float = 3.0
+    degrade_duration_s: float = 60.0
     # SLO thresholds (None = report only); chaos phase gets looser ones
     serve_p99_s: Optional[float] = None
     serve_max_shed_rate: Optional[float] = None
@@ -123,6 +134,23 @@ class Profile:
             {"at": 4.5, "kind": "kill_rollout"},
             {"at": 6.0, "kind": "fault", "site": "gcs_store.call",
              "duration": 2.0, "fault": "connection"},
+        ]}
+
+    def scenario_degrade(self) -> Dict[str, Any]:
+        """The degrade variant (``--degrade``): instead of clean kills,
+        silently slow one worker node ``degrade_factor``x (the ``slow``
+        fault on its compute + probe sites).  Nothing crashes and no
+        drain notice arrives — the health plane's probe sweep has to
+        NOTICE the sick node, quarantine it through the GCS ladder, and
+        the SLOs must pass once the planes re-land on healthy hardware.
+
+        One event on purpose: the quarantine itself cascades (drain,
+        replica migration, rollout respawn), so a second scripted kill
+        would race the health plane's own actuation for victims."""
+        return {"seed": self.seed, "events": [
+            {"at": 1.5, "kind": "degrade_node",
+             "factor": self.degrade_factor,
+             "duration": self.degrade_duration_s},
         ]}
 
 
@@ -494,7 +522,8 @@ def _interference(spans: List[Dict[str, Any]],
 
 
 def _run_phase(profile: Profile, phase: str,
-               scenario: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+               scenario: Optional[Dict[str, Any]],
+               monitor: bool = False) -> Dict[str, Any]:
     import ray_tpu
     from ray_tpu import serve
     from ray_tpu._private import tracing
@@ -512,6 +541,10 @@ def _run_phase(profile: Profile, phase: str,
         "resources": {"pd_replica": 3, "pd_learner": 1}})
     worker = cluster.add_node(num_cpus=profile.worker_cpus,
                               resources={"pd_replica": 1})
+    if monitor:
+        # the probe sweep needs >=3 alive nodes for a meaningful MAD
+        # population (and a healthy node to re-land work on)
+        cluster.add_node(num_cpus=profile.worker_cpus)
     cluster.connect()
     phase_t0 = time.time()
     samples: List[Dict[str, Any]] = []
@@ -519,11 +552,24 @@ def _run_phase(profile: Profile, phase: str,
     rlhf_out: Dict[str, Any] = {}
     stop = threading.Event()
     timeline = None
+    mon = None
     fired_log: Dict[str, Any] = {}
     try:
         cluster.wait_for_nodes()
         head_id = next(n["node_id"] for n in ray_tpu.nodes()
-                       if n["node_id"] != worker.node_id)
+                       if "pd_learner" in (n.get("total") or {}))
+        if monitor:
+            from ray_tpu._private.health_plane import HealthMonitor
+
+            # sweep-heavy posture: production_day's single-rank learner
+            # publishes no >=3-rank group, so detection rides the node
+            # probe sweep.  Thresholds stay at the defaults that must
+            # hold on a clean cluster — the baseline phase runs the SAME
+            # monitor and must produce zero quarantines.
+            mon = HealthMonitor(interval_s=0.5, suspect_windows=3,
+                                probe_factor=2.0, probe_timeout_s=20.0,
+                                probe_sweep=True, probe_sweep_every=2)
+            mon.start()
         handle = serve.run(_build_disagg_app(profile)
                            if profile.serve_disaggregated
                            else _build_app(profile))
@@ -543,8 +589,16 @@ def _run_phase(profile: Profile, phase: str,
 
         duration = profile.baseline_s
         if scenario is not None:
+            events = []
+            for ev in scenario["events"]:
+                ev = dict(ev)
+                if ev.get("kind") == "degrade_node":
+                    # never degrade the head: it carries the learner,
+                    # the serve clients and the monitor itself
+                    ev["exclude"] = list(ev.get("exclude", [])) + [head_id]
+                events.append(ev)
             timeline = ChaosTimeline(
-                scenario["events"], seed=scenario.get("seed", 0),
+                events, seed=scenario.get("seed", 0),
                 actions=_make_actions(head_id, fired_log))
             duration = timeline.duration_s + profile.chaos_tail_s
 
@@ -628,10 +682,16 @@ def _run_phase(profile: Profile, phase: str,
             "spans": spans,
             "executed": timeline.executed() if timeline else [],
             "fired_log": fired_log,
+            "health": mon.summary() if mon is not None else None,
             "stuck_threads": alive,
         }
     finally:
         stop.set()
+        if mon is not None:
+            try:
+                mon.stop()
+            except Exception:  # noqa: BLE001 — teardown must proceed
+                pass
         if timeline is not None:
             try:
                 timeline.stop()
@@ -713,9 +773,39 @@ def _plane_deltas(base_ev: Dict[str, Any],
 
 
 def _invariants(profile: Profile, chaos_ph: Dict[str, Any],
-                chaos_ev: Dict[str, Any]) -> List[str]:
+                chaos_ev: Dict[str, Any],
+                base_ph: Optional[Dict[str, Any]] = None) -> List[str]:
     """The acceptance gates; returns human-readable failures."""
     problems: List[str] = []
+    # degrade variant: the silently-slowed node must have been NOTICED —
+    # quarantined through the health ladder, with the detection latency
+    # recorded — and a clean baseline must never have quarantined anyone
+    degraded = [e for e in chaos_ph["executed"]
+                if e.get("ok") and e.get("kind") == "degrade_node"]
+    if degraded:
+        h = chaos_ph.get("health") or {}
+        victims = {(e.get("result") or {}).get("node") for e in degraded}
+        victims.discard(None)
+        quarantined = set(h.get("quarantined") or [])
+        if not victims & quarantined:
+            problems.append(
+                f"degraded node never quarantined: degraded={victims}, "
+                f"quarantined={quarantined}, events={h.get('events')}")
+        elif "detection_to_quarantine_s" not in h:
+            problems.append(
+                "quarantine happened but no detection_to_quarantine_s "
+                f"in the health summary: {h}")
+    if base_ph is not None:
+        base_h = base_ph.get("health") or {}
+        base_bad = sorted(
+            {e.get("node_id") or e.get("subject") or "?"
+             for e in base_h.get("events") or []
+             if e.get("event") in ("suspect", "quarantine")})
+        if base_bad:
+            problems.append(
+                f"health plane raised verdicts on the CLEAN baseline "
+                f"phase (false positive): {base_bad}, "
+                f"events={base_h.get('events')}")
     # every SCHEDULED event fired (the scenario's own count, not a
     # hardcoded 4 — custom --scenario files have their own timelines)
     expected = len(chaos_ph.get("planned") or [])
@@ -776,15 +866,20 @@ def run_production_day(profile: Profile = None,
     entry point for the tier-1 miniature and the slow full-size test)."""
     profile = profile or PROFILES["tier1"]
     scenario = scenario or profile.scenario()
-    base_ph = _run_phase(profile, "baseline", None)
+    # a degrade event puts the health plane in the loop: run the monitor
+    # in BOTH phases (the clean baseline doubles as the false-positive
+    # gate) on a 3-node cluster so the probe sweep has a MAD population
+    monitor = any(e.get("kind") == "degrade_node"
+                  for e in scenario.get("events") or [])
+    base_ph = _run_phase(profile, "baseline", None, monitor=monitor)
     base_ev = _evaluate_phase(profile, base_ph, None)
     base_rate = None
     for v in base_ev["verdicts"]:
         if v["plane"] == "ingest":
             base_rate = v["metrics"].get("rows_per_s")
-    chaos_ph = _run_phase(profile, "chaos", scenario)
+    chaos_ph = _run_phase(profile, "chaos", scenario, monitor=monitor)
     chaos_ev = _evaluate_phase(profile, chaos_ph, base_rate)
-    problems = _invariants(profile, chaos_ph, chaos_ev)
+    problems = _invariants(profile, chaos_ph, chaos_ev, base_ph=base_ph)
     record = {
         "benchmark": "production_day",
         "profile": profile.name,
@@ -805,6 +900,8 @@ def run_production_day(profile: Profile = None,
                            "error")}
                          for e in chaos_ph["executed"]],
         },
+        "health": {"baseline": base_ph.get("health"),
+                   "chaos": chaos_ph.get("health")},
         "interference": _interference(
             chaos_ph["spans"], chaos_ph["samples"],
             chaos_ph["executed"], chaos_ph["timeline_t0"]),
@@ -828,6 +925,11 @@ def main() -> int:
                     help="serve plane runs the disaggregated "
                          "prefill/decode topology (KV handoffs over the "
                          "channel plane) under the same chaos timeline")
+    ap.add_argument("--degrade", action="store_true",
+                    help="chaos phase silently slows one worker node "
+                         "instead of killing things; the health plane "
+                         "must detect and quarantine it "
+                         "(docs/fault_tolerance.md, health plane)")
     args = ap.parse_args()
     profile = PROFILES[args.profile]
     if args.disaggregated:
@@ -837,6 +939,8 @@ def main() -> int:
             profile, serve_disaggregated=True,
             serve_timeout_s=max(profile.serve_timeout_s, 10.0))
     scenario = None
+    if args.degrade:
+        scenario = profile.scenario_degrade()
     if args.scenario:
         with open(args.scenario) as f:
             scenario = json.load(f)

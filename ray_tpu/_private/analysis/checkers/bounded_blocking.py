@@ -171,12 +171,18 @@ class BoundedBlockingChecker(Checker):
     # util/checkpoint_replica.py is the peer-RAM checkpoint plane:
     # every push/fetch targets a replica server on a *different* host
     # that may be SIGKILLed at any instant — exactly the peer-death
-    # window the tier exists for — so its RPCs must all be bounded
+    # window the tier exists for — so its RPCs must all be bounded.
+    # The health plane probes SUSPECT hardware by construction: its
+    # whole job is to call nodes that may be degraded, hung, or
+    # corrupting, so an unbounded get there wedges the monitor on the
+    # very node it was sent to indict
     _DEADLINE_DIRS = ("ray_tpu/serve/", "ray_tpu/rl/",
                       "ray_tpu/experimental/channel/", "ray_tpu/dag/",
                       "ray_tpu/llm/", "ray_tpu/train/",
                       "ray_tpu/autoscaler/",
-                      "ray_tpu/util/checkpoint_replica.py")
+                      "ray_tpu/util/checkpoint_replica.py",
+                      "ray_tpu/util/health.py",
+                      "ray_tpu/_private/health_plane.py")
 
     def check(self, pf: ParsedFile) -> Iterable[Finding]:
         out: List[Finding] = []

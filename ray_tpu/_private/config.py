@@ -111,6 +111,24 @@ _FLAG_DEFS: Dict[str, Any] = {
     "health_check_period_s": 5.0,
     "health_check_timeout_s": 30.0,
     "num_heartbeats_timeout": 6,
+    # --- health plane (straggler / silent-degradation detection) ---
+    # passive-scoring cadence of the HealthMonitor loop
+    "health_monitor_interval_s": 2.0,
+    # robust-z threshold: |x - median| / (1.4826 * MAD) above this is an
+    # outlier window (3.5 is the classic Iglewicz-Hoaglin cutoff)
+    "health_mad_threshold": 3.5,
+    # hysteresis: consecutive outlier windows before SUSPECT promotion —
+    # one noisy window never trips the ladder
+    "health_suspect_windows": 3,
+    # active probe must run at least this factor slower on the suspect
+    # than on the healthy reference to confirm (2x = well past noise)
+    "health_probe_factor": 2.0,
+    # bound on one active-probe task round-trip; an unschedulable or
+    # wedged probe counts as confirmation-by-silence after this long
+    "health_probe_timeout_s": 30.0,
+    # drain deadline handed to the GCS when quarantining a node: long
+    # enough for a no-charge checkpoint, short enough to evict promptly
+    "health_quarantine_drain_deadline_s": 15.0,
     # non-force cancel: grace period for the injected async-exception to
     # take effect before the (disposable, fork-server-replaced) worker is
     # terminated — a thread blocked in a C call never sees the injection

@@ -40,6 +40,8 @@ _READONLY_HANDLERS = frozenset({
     "list_placement_groups", "list_gangs", "get_slice_topology",
     "subscribe", "cluster_resources",
     "available_resources", "publish_logs", "tail_logs", "job_logs_delta",
+    # chaos fan-out: arms in-process fault registries, no GCS tables
+    "arm_node_fault",
 })
 
 # kv values at or above this size are persisted as individual
@@ -717,6 +719,10 @@ class GcsServer:
             # True while DRAINING: the node still heartbeats and hosts
             # running leases; only NEW placement soft-avoids it.
             "state": "ALIVE",
+            # orthogonal health ladder: HEALTHY -> SUSPECT ->
+            # QUARANTINED (set by the health plane's verdict engine; a
+            # quarantine also triggers a drain, so `state` follows)
+            "health": "HEALTHY",
             "last_heartbeat": time.time(),
             "start_time": time.time(),
         }
@@ -805,6 +811,88 @@ class GcsServer:
         return {nid for nid, n in self.nodes.items()
                 if n.get("state") == "DRAINING"}
 
+    def _unschedulable_node_ids(self) -> set:
+        """Nodes NEW placement must avoid: DRAINING (about to vanish)
+        plus QUARANTINED (hardware under verdict — a quarantine opens a
+        drain, but the health mark must hold even if that drain was
+        rejected or hasn't landed yet)."""
+        return {nid for nid, n in self.nodes.items()
+                if n.get("state") == "DRAINING"
+                or n.get("health") == "QUARANTINED"}
+
+    async def handle_set_node_health(self, node_id: str, health: str,
+                                     reason: str = "",
+                                     hw_confirmed: bool = False) -> Dict:
+        """Move ``node_id`` on the health ladder (HEALTHY -> SUSPECT ->
+        QUARANTINED).  QUARANTINED is sticky — verdicts only escalate;
+        the way back for the capacity is a replacement node — and it
+        actuates: the node is excluded from new placement and a drain
+        opens immediately (``health_quarantine_drain_deadline_s``) so
+        the train controller takes its no-charge checkpoint-restart off
+        the sick node.  ``hw_confirmed`` (SDC canary / probe-proven
+        hardware fault) makes the eventual drain-expiry death FINAL,
+        exactly like ``report_node_failure`` — a corrupting chip must
+        never heartbeat itself back into the pool."""
+        if health not in ("HEALTHY", "SUSPECT", "QUARANTINED"):
+            return {"accepted": False,
+                    "rejection_reason": f"unknown health {health!r}"}
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"accepted": False,
+                    "rejection_reason": "node not found"}
+        prev = node.get("health", "HEALTHY")
+        if prev == "QUARANTINED" and health != "QUARANTINED":
+            return {"accepted": False, "health": prev,
+                    "rejection_reason": "QUARANTINED is sticky"}
+        node["health"] = health
+        node["health_reason"] = reason
+        if hw_confirmed:
+            node["health_hw_confirmed"] = True
+        if prev != health:
+            logger.warning("node %s health %s -> %s: %s", node_id[:8],
+                           prev, health, reason or "<no reason>")
+            self._publish("nodes", {"event": "node_health",
+                                    "node_id": node_id, "health": health,
+                                    "reason": reason,
+                                    "hw_confirmed": bool(hw_confirmed)})
+        drain = None
+        if health == "QUARANTINED" and node.get("alive"):
+            from ray_tpu.util.fault_injection import fault_point
+
+            fault_point("health.quarantine")
+            drain = await self.handle_drain_node(
+                node_id, reason=f"quarantine: {reason}",
+                deadline_s=config.health_quarantine_drain_deadline_s)
+            self._kick_pending()
+        return {"accepted": True, "node_id": node_id, "health": health,
+                "previous": prev, "drain": drain}
+
+    async def handle_arm_node_fault(self, node_id: str, site: str,
+                                    start_s: float = 0.0,
+                                    duration_s: float = 60.0,
+                                    nth: int = 1, count: int = 1 << 30,
+                                    exc: str = "slow:3") -> Dict:
+        """Chaos fan-out: arm a fault-injection window on every process
+        of ``node_id`` (its raylet relays to each pooled worker).  The
+        registry is per-process and reads its env spec once at import,
+        so degrading an already-running node needs this RPC path —
+        ``chaos.degrade_node`` scripts slowdowns through it."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.get("alive"):
+            return {"armed": 0, "rejection_reason":
+                    "node not found or not alive"}
+        raylet = self._raylet(node_id)
+        if raylet is None:
+            return {"armed": 0, "rejection_reason": "raylet unreachable"}
+        try:
+            ack = await asyncio.wait_for(
+                raylet.call("arm_fault", site=site, start_s=start_s,
+                            duration_s=duration_s, nth=nth, count=count,
+                            exc=exc), 5.0)
+        except Exception as e:  # noqa: BLE001 — chaos is best-effort
+            return {"armed": 0, "rejection_reason": str(e)}
+        return {"armed": int(ack.get("armed", 0)), "node_id": node_id}
+
     async def handle_unregister_node(self, node_id: str) -> bool:
         await self._mark_node_dead(node_id, reason="unregistered")
         return True
@@ -877,6 +965,7 @@ class GcsServer:
              "available": n["available"], "labels": n["labels"],
              "alive": n["alive"],
              "state": n.get("state", "ALIVE" if n["alive"] else "DEAD"),
+             "health": n.get("health", "HEALTHY"),
              "drain_deadline": n.get("drain_deadline"),
              "pending_demand": n.get("pending_demand", [])}
             for n in self.nodes.values()
@@ -909,7 +998,12 @@ class GcsServer:
                     await self._mark_node_dead(
                         node_id,
                         reason="drain deadline expired"
-                               f" ({node.get('drain_reason', '')})")
+                               f" ({node.get('drain_reason', '')})",
+                        # a hardware-confirmed quarantine (SDC canary,
+                        # probe-proven fault) dies FINAL, same as
+                        # report_node_failure: the chip is bad, the
+                        # node must never heartbeat back into the pool
+                        final=node.get("health_hw_confirmed", False))
                     # best-effort kill as a DETACHED task (fresh client:
                     # _mark_node_dead closed the cached one) — a batch of
                     # genuinely-preempted corpses must not serialize 2s
@@ -1165,9 +1259,10 @@ class GcsServer:
                 soft=strategy.soft,
                 label_selector=strategy.label_selector,
                 spread_threshold=config.scheduler_spread_threshold,
-                # DRAINING nodes are about to disappear: placing a fresh
-                # actor there guarantees an immediate restart cycle
-                exclude_node_ids=self._draining_node_ids(),
+                # DRAINING nodes are about to disappear (and QUARANTINED
+                # hardware is under verdict): placing a fresh actor
+                # there guarantees an immediate restart cycle
+                exclude_node_ids=self._unschedulable_node_ids(),
             )
         if pick is None:
             if actor_id not in self._pending_actors:
@@ -1497,7 +1592,7 @@ class GcsServer:
                  if n["alive"] and n["node_id"] not in claimed]
         placement = scheduling.pack_bundles(
             views, pg["bundles"], pg["strategy"],
-            exclude_node_ids=self._draining_node_ids())
+            exclude_node_ids=self._unschedulable_node_ids())
         if placement is None:
             await self._maybe_preempt_for(pg_id, pg, views)
             if pg_id not in self._pending_pgs:
@@ -2057,6 +2152,7 @@ class GcsServer:
         avail = ResourceSet({})
         for n in self.nodes.values():
             if n["alive"] and n.get("state") != "DRAINING" \
+                    and n.get("health") != "QUARANTINED" \
                     and n["node_id"] not in claimed:
                 avail.add(ResourceSet(n["available"]))
         return avail.to_dict()

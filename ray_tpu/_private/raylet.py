@@ -241,6 +241,16 @@ class Raylet:
         while not self._stopping:
             try:
                 hb_sent = time.time()
+                # per-device HBM occupancy rides every ~10th heartbeat:
+                # the devices live in the pool workers (the raylet never
+                # imports jax), so the refresh is a bounded worker
+                # fan-out at a cadence far below the heartbeat period
+                self._hb_count = getattr(self, "_hb_count", 0) + 1
+                if self._hb_count % 10 == 1:
+                    try:
+                        await self._refresh_device_stats()
+                    except Exception:  # noqa: BLE001 — stats best-effort
+                        pass
                 reply = await self.gcs.call(
                     "heartbeat",
                     node_id=self.node_id,
@@ -321,12 +331,89 @@ class Raylet:
             load1 = _os.getloadavg()[0]
         except OSError:
             load1 = 0.0
-        return {
+        stats = {
             "mem_used_gb": round(used / 1024**3, 2),
             "mem_total_gb": round(total / 1024**3, 2),
             "load1": round(load1, 2),
             "workers": len(self.workers),
         }
+        devices = getattr(self, "_device_stats", None)
+        if devices:
+            # per-device HBM occupancy (worker-reported, cached by the
+            # heartbeat loop): the health plane's memory-pressure input
+            # and the node panel's complement to host RSS
+            stats["devices"] = devices
+        return stats
+
+    async def _refresh_device_stats(self) -> None:
+        """Gather per-device HBM occupancy from the pool workers (the
+        processes that actually hold accelerator backends).  Workers
+        without jax imported answer ``[]`` immediately — a CPU-only
+        node pays one cheap RPC round per refresh, nothing more."""
+        async def _ask(addr: str):
+            client = RpcClient(addr)  # ephemeral: no leak on worker death
+            try:
+                return await client.call("device_stats", timeout=2.0)
+            except Exception:  # noqa: BLE001 — dying worker: best-effort
+                return None
+            finally:
+                await client.close()
+
+        gathered = await asyncio.gather(
+            *(_ask(h.addr) for h in list(self.workers.values())))
+        devices: List[Dict[str, Any]] = []
+        seen = set()
+        for rows in gathered:
+            for row in rows or ():
+                # dedupe: workers on one host see the same local devices
+                key = row.get("device")
+                if key in seen:
+                    continue
+                seen.add(key)
+                devices.append(row)
+        self._device_stats = devices
+
+    async def handle_arm_fault(self, site: str, start_s: float = 0.0,
+                               duration_s: float = 60.0, nth: int = 1,
+                               count: int = 1 << 30,
+                               exc: str = "slow:3") -> Dict:
+        """Chaos fan-out leg: arm a fault-injection window in THIS
+        raylet process and in every pool worker on the node (the
+        registry is per-process, and workers already running cannot
+        re-read the env spec).  ``chaos.degrade_node`` reaches here via
+        the GCS ``arm_node_fault`` verb."""
+        from ray_tpu.util import fault_injection as fi
+
+        fi.arm_window(site, start_s, duration_s, nth=nth, count=count,
+                      exc=exc)
+        # remember the window so workers spawned while it is active
+        # inherit it on registration (see _forward_armed_faults)
+        now = time.monotonic()
+        arms = getattr(self, "_armed_faults", None)
+        if arms is None:
+            arms = self._armed_faults = []
+        arms[:] = [a for a in arms if a["until_mono"] > now]
+        arms.append({"site": site, "start_mono": now + start_s,
+                     "until_mono": now + start_s + duration_s,
+                     "nth": nth, "count": count, "exc": exc})
+        armed = 1
+
+        async def _ask(addr: str):
+            client = RpcClient(addr)  # ephemeral: no leak on worker death
+            try:
+                await client.call("arm_fault", site=site, start_s=start_s,
+                                  duration_s=duration_s, nth=nth,
+                                  count=count, exc=exc, timeout=5.0)
+                return True
+            except Exception:  # noqa: BLE001 — dying worker: best-effort
+                return False
+            finally:
+                await client.close()
+
+        gathered = await asyncio.gather(
+            *(_ask(h.addr) for h in list(self.workers.values())))
+        armed += sum(1 for ok in gathered if ok)
+        return {"armed": armed, "node_id": self.node_id}
 
     # ------------------------------------------------- per-node agent API
     # The dashboard proxies these per node (reference: dashboard/agent.py
@@ -854,8 +941,38 @@ class Raylet:
         self.workers[worker_id] = h
         h.idle_since = time.monotonic()
         self.idle.append(h)
+        await self._forward_armed_faults(h)
         self._pump_leases()
         return {"node_id": self.node_id, "session_dir": self.session_dir}
+
+    async def _forward_armed_faults(self, h) -> None:
+        """Hand any still-active chaos fault windows to a freshly
+        registered worker BEFORE it can take a lease: a degrade window
+        models the node's *hardware* being slow, so a worker spawned
+        mid-window (e.g. to host a health probe) must misbehave exactly
+        like its siblings — otherwise the probe lands in the one clean
+        process on a sick node and acquits it."""
+        arms = getattr(self, "_armed_faults", None)
+        if not arms:
+            return
+        now = time.monotonic()
+        live = [a for a in arms if a["until_mono"] > now]
+        self._armed_faults = live
+        for a in live:
+            start_s = max(0.0, a["start_mono"] - now)
+            duration_s = a["until_mono"] - max(now, a["start_mono"])
+            if duration_s <= 0:
+                continue
+            client = RpcClient(h.addr)
+            try:
+                await client.call("arm_fault", site=a["site"],
+                                  start_s=start_s, duration_s=duration_s,
+                                  nth=a["nth"], count=a["count"],
+                                  exc=a["exc"], timeout=2.0)
+            except Exception:  # noqa: BLE001 — chaos is best-effort
+                pass
+            finally:
+                await client.close()
 
     def _adopt_proc(self, pid: int, proc):
         for h in self.workers.values():

@@ -2389,6 +2389,29 @@ class CoreWorker:
     async def handle_memory_report(self) -> Dict[str, Any]:
         return self.memory_report_local()
 
+    async def handle_arm_fault(self, site: str, start_s: float = 0.0,
+                               duration_s: float = 60.0, nth: int = 1,
+                               count: int = 1 << 30,
+                               exc: str = "slow:3") -> bool:
+        """Arm a fault-injection window in THIS worker process — the
+        leaf of the chaos fan-out (GCS ``arm_node_fault`` -> raylet ->
+        each pool worker).  The fi registry is per-process and reads
+        ``RAY_TPU_FAULT_INJECT`` only at import, so a running worker
+        can only be degraded through this RPC."""
+        from ray_tpu.util import fault_injection as fi
+
+        fi.arm_window(site, start_s, duration_s, nth=nth, count=count,
+                      exc=exc)
+        return True
+
+    async def handle_device_stats(self) -> List[Dict[str, Any]]:
+        """Per-device HBM occupancy of THIS worker's accelerators
+        (empty unless jax is already imported here — stats must never
+        trigger backend init)."""
+        from ray_tpu.util.health import device_memory_stats
+
+        return device_memory_stats()
+
     async def handle_kill_actor(self, no_restart: bool = True) -> bool:
         logger.info("actor %s killed", self.actor_id.hex() if self.actor_id else "?")
         asyncio.ensure_future(self._terminate_self())

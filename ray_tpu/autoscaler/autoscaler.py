@@ -166,8 +166,14 @@ class Autoscaler:
         for g in gangs:
             if g.get("state") in ("PENDING", "RESERVING"):
                 demand.extend(self._gang_bundles(g))
+        # QUARANTINED nodes still heartbeat (drain in progress) but the
+        # scheduler refuses them — their capacity must not satisfy
+        # demand here, or the replacement for a quarantined straggler
+        # would never be provisioned
+        schedulable = [n for n in nodes
+                       if n.get("health") != "QUARANTINED"]
         unmet = [d for d in demand
-                 if not any(_fits(d, n["total"]) for n in nodes)]
+                 if not any(_fits(d, n["total"]) for n in schedulable)]
         # plus shapes that fit somewhere but everything is saturated: any
         # pending demand at all means the cluster is short on slots
         congested = [d for d in demand if d not in unmet]

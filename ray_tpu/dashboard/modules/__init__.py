@@ -12,6 +12,7 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
     data,
     entities,
     gangs,
+    health,
     llm,
     logs,
     metrics,
@@ -22,4 +23,4 @@ from ray_tpu.dashboard.modules import (  # noqa: F401
 )
 
 ALL_MODULES = (cluster, tasks, entities, logs, metrics, serve, train,
-               collective, data, slo, llm, gangs)
+               collective, data, slo, llm, gangs, health)

@@ -28,6 +28,8 @@ def routes(gcs, helpers):
                           "state": n.get("state",
                                          "ALIVE" if n.get("alive")
                                          else "DEAD"),
+                          "health": n.get("health", "HEALTHY"),
+                          "health_reason": n.get("health_reason", ""),
                           "drain_reason": n.get("drain_reason"),
                           "drain_deadline": n.get("drain_deadline"),
                           "addr": n.get("addr", ""),

@@ -1,0 +1,47 @@
+"""Health module: node health ladder + straggler/SDC verdicts.
+
+The health plane (:mod:`ray_tpu._private.health_plane`) publishes one
+verdict record per suspect into the GCS KV under namespace "health"
+(key ``verdict/<kind>/<subject>``) and moves nodes along the
+HEALTHY -> SUSPECT -> QUARANTINED ladder in the GCS node table.  This
+module serves both through the same ``aggregate_health_records`` helper
+the state API and ``raytpu health`` use, so all three surfaces agree on
+ordering and on the staleness sweep (a verdict from a monitor that died
+mid-run must not pin a SUSPECT forever).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def routes(gcs, helpers):
+    jresp = helpers["jresp"]
+
+    async def api_health(_req):
+        from ray_tpu.util.health import aggregate_health_records
+
+        nodes = []
+        for nid, n in gcs.nodes.items():
+            nodes.append({
+                "node_id": nid,
+                "state": n.get("state",
+                               "ALIVE" if n.get("alive") else "DEAD"),
+                "health": n.get("health", "HEALTHY"),
+                "health_reason": n.get("health_reason", ""),
+                "hw_confirmed": bool(n.get("health_hw_confirmed")),
+                # per-device HBM occupancy rides the heartbeat stats
+                "devices": (n.get("stats") or {}).get("devices", []),
+            })
+        records = []
+        for (ns, key), raw in list(gcs.kv.items()):
+            if ns != "health" or not key.startswith("verdict/"):
+                continue
+            try:
+                records.append(json.loads(raw))
+            except (ValueError, TypeError):
+                continue
+        return jresp({"nodes": nodes,
+                      "verdicts": aggregate_health_records(records)})
+
+    return [("GET", "/api/health", api_health)]

@@ -62,13 +62,13 @@ function spark(points, w=220, h=36) {
 
 async function renderOverview(root) {
   const [cluster, actors, jobs, pgs, subjobs, tasks, serve, train, coll,
-         data, slo, llm] =
+         data, slo, llm, health] =
     await Promise.all([
       j("/api/cluster"), j("/api/actors"), j("/api/jobs"),
       j("/api/placement_groups"), j("/api/submitted_jobs"),
       j("/api/tasks/summary"), j("/api/serve"), j("/api/train"),
       j("/api/collective"), j("/api/data"), j("/api/slo"),
-      j("/api/llm")]);
+      j("/api/llm"), j("/api/health")]);
   const taskRows = Object.entries(tasks).map(([name, s]) =>
     ({name, ...s, mean_ms: (s.mean_s * 1000).toFixed(1)}));
   const depRows = Object.entries(serve.deployments || {}).map(
@@ -135,6 +135,19 @@ async function renderOverview(root) {
       ? `out=${r.handoff.exported} in=${r.handoff.adopted} ` +
         `fail=${r.handoff.adopt_failures}`
       : ""}));
+  const nodeRows = (cluster.nodes || []).map(n => {
+    const devs = ((health.nodes || []).find(
+      h => h.node_id === n.node_id) || {}).devices || [];
+    return {...n, health: n.health || "HEALTHY",
+      hbm: devs.map(d =>
+        `${d.device}: ${(d.occupancy * 100).toFixed(0)}%`).join(" ")};
+  });
+  const healthRows = (health.verdicts || []).map(v => ({
+    kind: v.kind, subject: v.subject, health: v.health,
+    reason: v.reason || "",
+    signals: Object.entries(v.signals || {}).filter(([k, val]) =>
+      typeof val === "number").map(([k, val]) => `${k}=${val}`).join(" "),
+    "hw": v.hw_confirmed ? "confirmed" : ""}));
   const collRows = (coll.groups || []).map(g => ({
     group: g.group_name, state: g.state, backend: g.backend,
     epoch: g.epoch, members: `${g.joined}/${g.world_size}`,
@@ -143,9 +156,13 @@ async function renderOverview(root) {
       : `r${m.rank}:idle@${m.last_done_seq}`).join(" "),
     abort: g.abort_reason || ""}));
   root.innerHTML =
-    "<h2>Nodes</h2>" + table(cluster.nodes,
-      ["node_id","state","resources","available","stats"],
+    "<h2>Nodes</h2>" + table(nodeRows,
+      ["node_id","state","health","hbm","resources","available","stats"],
       (r, c) => c === "node_id" ? `#node/${r.node_id}` : null) +
+    "<h2>Node health</h2>" + (healthRows.length
+      ? table(healthRows, ["kind","subject","health","reason","signals",
+                           "hw"])
+      : "<i>no health verdicts (no stragglers detected)</i>") +
     "<h2>Tasks</h2>" + table(taskRows, ["name","count","failed","mean_ms"]) +
     "<h2>Serve</h2>" + (serve.running
       ? table(depRows, ["name","num_replicas","goal","version","limits",

@@ -385,6 +385,7 @@ class EdgeTransport:
             dt = time.perf_counter() - t0
             self.stats["read_wait_s"] += dt
             tracing.note_duration("channel_wait", dt)
+            self._note_edge(dt)
 
     def read_borrowed(self, fn, timeout: Optional[float] = None) -> Any:
         """Device-landing read: apply ``fn`` to the value while it still
@@ -431,8 +432,20 @@ class EdgeTransport:
             if dt is not None:
                 self.stats["read_wait_s"] += dt
                 tracing.note_duration("channel_wait", dt)
+                self._note_edge(dt)
 
     # -- internals ----------------------------------------------------------
+    def _note_edge(self, dt: float) -> None:
+        # per-edge latency into the health plane's process-local tracker
+        # (shipped with StepLedger records): a degrading link shows up
+        # as one edge's EWMA drifting off its peers
+        try:
+            from ray_tpu.util.health import note_edge_latency
+
+            note_edge_latency(self.edge or self.channel.name, dt)
+        except Exception:  # noqa: BLE001 — evidence stays best-effort
+            pass
+
     def _degrade(self, why: str) -> None:
         if self.tier != TIER_HOST:
             import logging

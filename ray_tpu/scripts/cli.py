@@ -84,6 +84,9 @@ def cmd_status(args):
                      f" ({max(0.0, left):.0f}s to deadline)")
         elif mark == "DEAD" and n.get("death_reason"):
             extra = f" ({n['death_reason']})"
+        health = n.get("health", "HEALTHY")
+        if health != "HEALTHY":
+            extra += f" health={health}"
         print(f"  {n['node_id'][:12]} [{mark}] {n['addr']} "
               f"total={n['total']}{extra}")
     print("Cluster resources:", ray_tpu.cluster_resources())
@@ -175,6 +178,47 @@ def cmd_status(args):
             if v.get("status") == "DEGRADED" and v.get("degraded_reason"):
                 line += f" ({v['degraded_reason']})"
             print(line)
+    ray_tpu.shutdown()
+
+
+def cmd_health(args):
+    """Node health ladder + straggler/SDC verdicts (the health plane's
+    operator view — what ``/api/health`` serves on the dashboard)."""
+    ray_tpu = _connect(args.address or _default_address())
+    from ray_tpu.util.state import list_node_health
+
+    report = list_node_health()
+    if args.json:
+        print(json.dumps(report, default=str))
+        ray_tpu.shutdown()
+        return
+    print("Node health:")
+    for n in report["nodes"]:
+        line = (f"  {n['node_id'][:12]} [{n['state']}] "
+                f"health={n['health']}")
+        if n.get("health_reason"):
+            line += f" ({n['health_reason']})"
+        if n.get("hw_confirmed"):
+            line += " hw-confirmed"
+        print(line)
+    verdicts = report.get("verdicts") or []
+    if verdicts:
+        print("Verdicts:")
+        for v in verdicts:
+            line = (f"  {v.get('kind')}/{v.get('subject')} "
+                    f"[{v.get('health')}]")
+            if v.get("reason"):
+                line += f" {v['reason']}"
+            sig = v.get("signals") or {}
+            if sig.get("own_time_z") is not None:
+                line += f" z={sig['own_time_z']:.1f}"
+            if sig.get("probe_ratio") is not None:
+                line += f" probe={sig['probe_ratio']:.1f}x"
+            if v.get("hw_confirmed"):
+                line += " hw-confirmed"
+            print(line)
+    else:
+        print("Verdicts: none (no straggler or SDC reports)")
     ray_tpu.shutdown()
 
 
@@ -433,6 +477,12 @@ def main(argv=None):
     p = sub.add_parser("status", help="show cluster nodes and resources")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("health", help="node health ladder and "
+                                      "straggler/SDC verdicts")
+    p.add_argument("--address", default=None)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_health)
 
     p = sub.add_parser("drain", help="drain a node (advance-notice "
                                      "preemption: checkpoint/migrate, "

@@ -176,6 +176,23 @@ class StepLedger:
     def last_breakdown(self) -> Optional[Dict[str, Any]]:
         return dict(self._history[-1]) if self._history else None
 
+    def recent_breakdown(self, n: int = 16) -> Optional[Dict[str, Any]]:
+        """Mean wall + per-bucket seconds over the last ``n`` recorded
+        steps — the health plane's scoring window (lifetime means would
+        dilute a freshly degraded rank under a long healthy history)."""
+        with self._lock:
+            hist = list(self._history)[-n:]
+        if not hist:
+            return None
+        steps = len(hist)
+        wall = sum(h["wall_s"] for h in hist)
+        buckets: Dict[str, float] = {}
+        for h in hist:
+            for k, v in h["buckets"].items():
+                buckets[k] = buckets.get(k, 0.0) + v
+        return {"steps": steps, "wall_s_per_step": wall / steps,
+                "buckets_s": {k: v / steps for k, v in buckets.items()}}
+
     def breakdown(self) -> Dict[str, Any]:
         """Aggregate view: mean seconds and fraction per bucket across
         recorded steps — the ``step_time_breakdown`` block bench records."""
@@ -202,7 +219,20 @@ class StepLedger:
             return
         rec = {"ts": time.time(), "group": self.group_name,
                "rank": self.rank, **self.breakdown(),
-               "last": self.last_breakdown()}
+               "last": self.last_breakdown(),
+               # health-plane inputs: the recent scoring window, where
+               # this rank runs, and the per-edge channel latencies its
+               # process observed (straggler attribution evidence)
+               "recent": self.recent_breakdown(),
+               "node_id": getattr(w, "node_id", "") or ""}
+        try:
+            from ray_tpu.util.health import edge_latency_snapshot
+
+            edges = edge_latency_snapshot()
+            if edges:
+                rec["edges"] = edges
+        except Exception:  # noqa: BLE001 — evidence stays best-effort
+            pass
         key = f"step_breakdown/{self.group_name or 'default'}/{self.rank}"
         # bounded: this runs inline at a step boundary — a wedged GCS
         # must cost the training loop at most the timeout, never a hang

@@ -99,9 +99,54 @@ def _preempt_slice_action(ev: Dict[str, Any], rng: random.Random) -> Any:
             "deadline_s": deadline_s}
 
 
+#: degrade_node's default site list: the supervised collective edge and
+#: the health plane's probe loop — together they model "everything on
+#: this chip runs slow" (the probe must see the same degradation the
+#: workload does, or it would acquit the node it was sent to test)
+_DEGRADE_SITES = ("collective.op", "health.probe")
+
+
+def _degrade_node_action(ev: Dict[str, Any], rng: random.Random) -> Any:
+    """Built-in ``degrade_node`` action: make one node's processes run
+    SLOW (not dead) for a window — the silent-degradation rehearsal the
+    health plane exists to catch.  Arms the fault registry's ``slow``
+    kind (``ev["factor"]``, default 3.0) on ``ev["sites"]`` (default
+    ``collective.op`` + ``health.probe``) across every process of the
+    victim node for ``ev["duration"]`` seconds, via the GCS
+    ``arm_node_fault`` fan-out (registry is per-process; workers
+    spawned mid-window inherit the arm from their raylet).  The victim
+    is ``ev["node"]`` when named, else drawn deterministically from
+    ``rng`` over the sorted alive nodes minus ``ev["exclude"]`` (how
+    scenarios keep the head/driver node out of the draw)."""
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    nodes = worker.run_coro(worker.gcs.call("get_all_nodes"))
+    exclude = set(ev.get("exclude") or ())
+    candidates = sorted(n["node_id"] for n in nodes
+                        if n.get("alive") and n["node_id"] not in exclude)
+    if not candidates:
+        return {"node": None, "armed": 0}
+    target = ev.get("node")
+    if target is None:
+        target = candidates[rng.randrange(len(candidates))]
+    factor = float(ev.get("factor", 3.0))
+    duration = float(ev.get("duration", 10.0))
+    sites = list(ev.get("sites") or _DEGRADE_SITES)
+    armed = {}
+    for site in sites:
+        ack = worker.run_coro(worker.gcs.call(
+            "arm_node_fault", node_id=target, site=site, start_s=0.0,
+            duration_s=duration, exc=f"slow:{factor}", timeout=10.0))
+        armed[site] = ack.get("armed", 0)
+    return {"node": target, "factor": factor, "duration_s": duration,
+            "armed": armed}
+
+
 #: actions available without caller registration (overridable)
 BUILTIN_ACTIONS: Dict[str, ActionFn] = {
     "preempt_slice": _preempt_slice_action,
+    "degrade_node": _degrade_node_action,
 }
 
 

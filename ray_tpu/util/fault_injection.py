@@ -14,11 +14,12 @@ or, for subprocesses (bench, spawned workers), via the environment::
     RAY_TPU_FAULT_INJECT="bench.backend_init:1:2:unavailable"
     #                      site              :nth:count:kind[:arg]
 
-Spec grammar: ``site:nth[:count[:kind[:arg]]][@start+duration]`` —
+Spec grammar: ``site:nth[:count[:kind[:arg...]]][@start+duration]`` —
 calls ``nth .. nth+count-1`` to the site trigger the ``kind`` (see
-``_KINDS``); only ``delay`` takes an ``arg`` (seconds).  Multiple specs
-join with ``;``.  Arming is deterministic — a site fires on exact call
-indices, never randomly — so chaos tests reproduce bit-for-bit.
+``_KINDS``); ``delay`` takes an ``arg`` (seconds) and ``slow`` takes
+``factor[:duration_s]``.  Multiple specs join with ``;``.  Arming is
+deterministic — a site fires on exact call indices, never randomly — so
+chaos tests reproduce bit-for-bit.
 
 The optional ``@start+duration`` suffix is **windowed (scheduled)
 arming**: the site is armed ``start`` seconds after the spec is loaded
@@ -60,9 +61,11 @@ site                        guards
 ``gang.reserve``            each bundle's reserve RPC in a gang reservation
 ``gang.preempt.drain``      the per-node drain leg of a gang preemption
 ``slice.provision``         the slice provider's create_node edge
+``health.probe``            the health plane's active-probe dispatch edge
+``health.quarantine``       the health plane's quarantine actuation edge
 ==========================  =================================================
 
-Two kinds are special:
+Three kinds are special:
 
 - ``sigkill``: instead of raising, the armed call SIGKILLs the current
   process — a real mid-operation crash, for testing that on-disk state
@@ -74,6 +77,16 @@ Two kinds are special:
   collective supervision layer) are testable deterministically.  In the
   env spec the seconds ride the 5th field
   (``collective.op:1:1:delay:30``); via the API pass ``exc="delay:30"``.
+- ``slow:<factor>[:<duration_s>]``: a *relative* hang — each armed call
+  sleeps ``(factor - 1) ×`` the site's **measured baseline** inter-call
+  interval (an EWMA over the site's own cadence, net of the sleeps we
+  inject, so the slowdown never compounds on itself).  A 3×-slow rank is
+  then rehearsable on any hardware without knowing absolute step times:
+  ``collective.op:1:999999:slow:3`` makes every supervised collective in
+  the process take ~3× its natural period.  The optional ``duration_s``
+  auto-expires the effect that many seconds after the first firing call.
+  Via the API pass ``exc="slow:3"`` or ``exc="slow:3:20"``.  The first
+  counted call only seeds the baseline and passes clean.
 
 When nothing is armed, :func:`fault_point` is a single dict lookup —
 cheap enough to leave in production paths.
@@ -118,10 +131,11 @@ _KINDS = {
 
 class _Arm:
     __slots__ = ("nth", "count", "make", "delay", "calls", "fired",
-                 "start", "until")
+                 "start", "until", "factor", "slow_dur", "baseline",
+                 "last_call", "last_injected")
 
     def __init__(self, nth: int, count: int, make, delay=None,
-                 start=None, until=None):
+                 start=None, until=None, factor=None, slow_dur=None):
         self.nth = nth      # 1-based call index of the first failure
         self.count = count  # how many consecutive calls fail
         self.make = make    # site -> Exception (None for delay kind)
@@ -132,6 +146,13 @@ class _Arm:
         # are invisible (not counted); past `until` the arm is spent
         self.start = start
         self.until = until
+        # slow kind: sleep (factor-1) x the site's measured baseline
+        # inter-call interval; slow_dur auto-expires it after first fire
+        self.factor = factor
+        self.slow_dur = slow_dur
+        self.baseline = None       # EWMA of natural inter-call seconds
+        self.last_call = None      # monotonic ts of the previous call
+        self.last_injected = 0.0   # sleep we added on the previous call
 
     def in_window(self, now: float) -> bool:
         if self.start is not None and now < self.start:
@@ -192,10 +213,16 @@ def _load_env() -> None:
             _armed[site] = _Arm(nth, count, None, delay=seconds,
                                 start=start, until=until)
             continue
+        if kind == "slow":
+            factor = float(fields[4]) if len(fields) > 4 else 3.0
+            slow_dur = float(fields[5]) if len(fields) > 5 else None
+            _armed[site] = _Arm(nth, count, None, factor=factor,
+                                slow_dur=slow_dur, start=start, until=until)
+            continue
         if kind not in _KINDS:
             raise ValueError(
                 f"{ENV_VAR}: unknown kind {kind!r} "
-                f"(expected 'delay' or one of {sorted(_KINDS)})")
+                f"(expected 'delay', 'slow' or one of {sorted(_KINDS)})")
         _armed[site] = _Arm(nth, count, _KINDS[kind], start=start,
                             until=until)
 
@@ -204,18 +231,26 @@ _load_env()
 
 
 def _resolve_exc(exc: Union[BaseException, type, str, None]):
-    """``exc`` vocabulary -> ``(make, delay)`` for an ``_Arm``."""
+    """``exc`` vocabulary -> ``(make, delay, factor, slow_dur)`` for an
+    ``_Arm``."""
     if isinstance(exc, str) and (exc == "delay"
                                  or exc.startswith("delay:")):
         _, _, arg = exc.partition(":")
-        return None, (float(arg) if arg else 30.0)
+        return None, (float(arg) if arg else 30.0), None, None
+    if isinstance(exc, str) and (exc == "slow" or exc.startswith("slow:")):
+        _, _, arg = exc.partition(":")
+        factor_s, _, dur_s = arg.partition(":")
+        factor = float(factor_s) if factor_s else 3.0
+        slow_dur = float(dur_s) if dur_s else None
+        return None, None, factor, slow_dur
     if exc is None:
-        return _KINDS["connection"], None
+        return _KINDS["connection"], None, None, None
     if isinstance(exc, str):
-        return _KINDS[exc], None
+        return _KINDS[exc], None, None, None
     if isinstance(exc, BaseException):
-        return (lambda site, _e=exc: _e), None
-    return (lambda site, _c=exc: _c(f"fault injected at {site}")), None
+        return (lambda site, _e=exc: _e), None, None, None
+    return (lambda site, _c=exc: _c(f"fault injected at {site}")), \
+        None, None, None
 
 
 def arm(site: str, *, nth: int = 1, count: int = 1,
@@ -228,9 +263,10 @@ def arm(site: str, *, nth: int = 1, count: int = 1,
     calls SLEEP instead of raising, injecting a hang), or None
     (ConnectionError).
     """
-    make, delay = _resolve_exc(exc)
+    make, delay, factor, slow_dur = _resolve_exc(exc)
     with _lock:
-        _armed[site] = _Arm(nth, count, make, delay=delay)
+        _armed[site] = _Arm(nth, count, make, delay=delay, factor=factor,
+                            slow_dur=slow_dur)
 
 
 def arm_window(site: str, start_s: float, duration_s: float, *,
@@ -248,11 +284,12 @@ def arm_window(site: str, start_s: float, duration_s: float, *,
     # the _Arm is built with its window in ONE publication: a two-step
     # arm-then-attach-window would leave the site live (windowless) for
     # a racing fault_point between the two lock acquisitions
-    make, delay = _resolve_exc(exc)
+    make, delay, factor, slow_dur = _resolve_exc(exc)
     start = _monotonic() + start_s
     with _lock:
-        _armed[site] = _Arm(nth, count, make, delay=delay,
-                            start=start, until=start + duration_s)
+        _armed[site] = _Arm(nth, count, make, delay=delay, factor=factor,
+                            slow_dur=slow_dur, start=start,
+                            until=start + duration_s)
 
 
 def disarm(site: Optional[str] = None) -> None:
@@ -300,11 +337,35 @@ def fault_point(site: str) -> None:
         a = _armed.get(site)
         if a is None:
             return
+        now = None
+        if a.start is not None or a.until is not None \
+                or a.factor is not None:
+            now = _monotonic()
         if a.start is not None or a.until is not None:
-            if not a.in_window(_monotonic()):
+            if not a.in_window(now):
                 return  # outside the window: invisible, not counted
         a.calls += 1
-        if a.nth <= a.calls < a.nth + a.count:
+        if a.factor is not None:
+            # track the site's natural cadence, net of our own injected
+            # sleeps, so the baseline never compounds on the slowdown
+            if a.last_call is not None:
+                dt = max(0.0, now - a.last_call - a.last_injected)
+                a.baseline = dt if a.baseline is None \
+                    else 0.7 * a.baseline + 0.3 * dt
+            a.last_call = now
+            a.last_injected = 0.0
+            if not (a.nth <= a.calls < a.nth + a.count):
+                return
+            if a.baseline is None or a.baseline <= 0.0:
+                return  # first counted call only seeds the baseline
+            a.fired += 1
+            injected = (a.factor - 1.0) * a.baseline
+            a.last_injected = injected
+            if a.slow_dur is not None and a.until is None:
+                # the effect auto-expires slow_dur after its first fire
+                a.until = now + a.slow_dur
+            delay, err = injected, None
+        elif a.nth <= a.calls < a.nth + a.count:
             a.fired += 1
             if a.delay is not None:
                 delay, err = a.delay, None

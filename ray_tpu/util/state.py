@@ -122,6 +122,43 @@ def list_slo_verdicts() -> List[Dict[str, Any]]:
     return aggregate_verdict_records(records)
 
 
+def list_node_health() -> Dict[str, Any]:
+    """Cluster-wide hardware health: every node's position on the
+    HEALTHY -> SUSPECT -> QUARANTINED ladder (from the GCS node table)
+    plus the health plane's verdict records (KV namespace "health" —
+    the evidence: robust-z scores, collective-wait asymmetry, probe
+    ratios, SDC canary digests).  Stale verdict records are swept like
+    collective and SLO records.  Returns ``{"nodes": [...],
+    "verdicts": [...]}``."""
+    import json as _json
+
+    from ray_tpu.util.health import aggregate_health_records
+
+    nodes = []
+    for n in list_nodes():
+        nodes.append({
+            "node_id": n.get("node_id"),
+            "node_name": n.get("node_name", ""),
+            "state": n.get("state"),
+            "health": n.get("health", "HEALTHY"),
+            "health_reason": n.get("health_reason", ""),
+            "hw_confirmed": bool(n.get("health_hw_confirmed")),
+        })
+    records = []
+    try:
+        from ray_tpu.experimental.internal_kv import _internal_kv_get_prefix
+
+        table = _internal_kv_get_prefix("verdict/", namespace="health")
+        for raw in (table or {}).values():
+            try:
+                records.append(_json.loads(raw))
+            except Exception:  # noqa: BLE001 — record mid-write
+                continue
+    except Exception:  # noqa: BLE001 — no cluster
+        pass
+    return {"nodes": nodes, "verdicts": aggregate_health_records(records)}
+
+
 def list_checkpoint_status(run: Optional[str] = None) -> List[Dict[str, Any]]:
     """Per-rank tiered-checkpoint state from the records every
     :class:`~ray_tpu.train.checkpoint_async.AsyncCheckpointer` publishes

@@ -1,0 +1,809 @@
+"""Hardware health plane: straggler & silent-degradation detection.
+
+Covers the pure outlier math (median/MAD robust z, hysteresis), the
+passive signal extractors (step-record scoring with collective-wait
+asymmetry attribution, pending ages, edge latencies), the SDC canary,
+verdict aggregation + stale sweep, the HealthMonitor's confirm/acquit/
+quarantine legs (via the ``probe_fn`` hook — no cluster), and the GCS
+health ladder (SUSPECT -> QUARANTINED -> drain, sticky, exclusions)
+against in-process servers.
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+import pytest
+
+from ray_tpu.util import health as H
+
+
+# ---------------------------------------------------------------------------
+# robust statistics
+# ---------------------------------------------------------------------------
+
+
+def test_median_and_mad_basics():
+    assert H.median([3.0, 1.0, 2.0]) == 2.0
+    assert H.median([1.0, 2.0, 3.0, 4.0]) == 2.5
+    assert H.mad([1.0, 1.0, 1.0]) == 0.0
+    assert H.mad([1.0, 2.0, 3.0, 100.0]) == 1.0  # outlier cannot inflate
+    with pytest.raises(ValueError):
+        H.median([])
+
+
+def test_robust_z_identical_samples_score_zero():
+    zs = H.robust_z([0.1] * 8)
+    assert zs == [0.0] * 8  # noise floor, not division by zero
+
+
+def test_robust_z_flags_the_slow_sample():
+    values = [0.10, 0.11, 0.10, 0.09, 0.10, 0.30]
+    zs = H.robust_z(values)
+    assert zs[-1] > 3.5
+    assert all(abs(z) < 3.5 for z in zs[:-1])
+
+
+def test_mad_outliers_one_sided_ignores_fast_ranks():
+    # one unusually FAST sample is not a health problem
+    values = [0.10, 0.10, 0.11, 0.10, 0.01]
+    assert H.mad_outliers(values, 3.5) == []
+    assert 4 in H.mad_outliers(values, 3.5, one_sided=False)
+
+
+def test_hysteresis_requires_consecutive_windows():
+    t = H.HysteresisTracker(3)
+    assert t.observe(["a"], ["a", "b"]) == []
+    assert t.observe(["a"], ["a", "b"]) == []
+    assert t.observe(["a"], ["a", "b"]) == ["a"]      # exactly at N
+    assert t.observe(["a"], ["a", "b"]) == []         # promoted once
+    # a clean window resets the streak
+    t2 = H.HysteresisTracker(2)
+    t2.observe(["a"], ["a"])
+    t2.observe([], ["a"])                              # clean: reset
+    assert t2.streak("a") == 0
+    assert t2.observe(["a"], ["a"]) == []
+    assert t2.observe(["a"], ["a"]) == ["a"]
+
+
+def test_hysteresis_absent_from_population_keeps_streak():
+    # a rank that published no record this window is unknown, not clean
+    t = H.HysteresisTracker(2)
+    t.observe(["a"], ["a", "b"])
+    t.observe([], ["b"])                               # "a" absent
+    assert t.streak("a") == 1
+    assert t.observe(["a"], ["a", "b"]) == ["a"]
+
+
+def test_hysteresis_rejects_zero_windows():
+    with pytest.raises(ValueError):
+        H.HysteresisTracker(0)
+
+
+# ---------------------------------------------------------------------------
+# step-record scoring: the collective-wait asymmetry attribution
+# ---------------------------------------------------------------------------
+
+
+def _rec(rank, wall, coll, node="", steps=8):
+    return {"group": "g", "rank": rank, "node_id": node,
+            "recent": {"steps": steps, "wall_s_per_step": wall,
+                       "buckets_s": {"compute": max(0.0, wall - coll),
+                                     "collective_wait": coll}}}
+
+
+def test_score_step_records_attributes_the_straggler():
+    # synchronous mesh: every rank's WALL is identical (they all wait
+    # for the slowest); the straggler is the one with high OWN time and
+    # near-zero collective wait
+    records = [_rec(0, 0.30, 0.20), _rec(1, 0.30, 0.21),
+               _rec(2, 0.30, 0.00), _rec(3, 0.30, 0.19)]
+    score = H.score_step_records(records, mad_threshold=3.5)
+    assert score["suspects"] == [2]
+    assert score["ranks"][2]["own_s"] == pytest.approx(0.30)
+    assert score["ranks"][0]["own_s"] == pytest.approx(0.10)
+    assert score["ranks"][2]["z"] > 3.5
+
+
+def test_score_step_records_high_wait_outlier_is_not_a_straggler():
+    # an own-time outlier that ALSO waits above the median is blocked on
+    # someone else (e.g. its input pipeline stalls mid-collective) — not
+    # the rank everyone waits for
+    records = [_rec(0, 0.12, 0.02), _rec(1, 0.12, 0.02),
+               _rec(2, 0.42, 0.10), _rec(3, 0.12, 0.02)]
+    score = H.score_step_records(records, mad_threshold=3.5)
+    assert score["suspects"] == []
+
+
+def test_score_step_records_needs_three_ranks():
+    records = [_rec(0, 0.1, 0.05), _rec(1, 0.4, 0.0)]
+    assert H.score_step_records(records)["suspects"] == []
+
+
+def test_score_step_records_prefers_recent_window():
+    # lifetime means say healthy; the recent window says degraded — the
+    # fresh signal must win (a long healthy history would otherwise
+    # dilute a newly sick rank below threshold)
+    records = [_rec(0, 0.30, 0.20), _rec(1, 0.30, 0.20),
+               _rec(3, 0.30, 0.20)]
+    degraded = {"group": "g", "rank": 2, "node_id": "",
+                "steps": 500, "step_wall_s": 0.11,
+                "buckets_s": {"compute": 0.10, "collective_wait": 0.01},
+                "recent": {"steps": 8, "wall_s_per_step": 0.30,
+                           "buckets_s": {"compute": 0.30,
+                                         "collective_wait": 0.0}}}
+    score = H.score_step_records(records + [degraded])
+    assert score["suspects"] == [2]
+
+
+def test_score_step_records_falls_back_to_lifetime_breakdown():
+    # a record with no recent window (publisher predates it, or empty
+    # history) scores on the lifetime breakdown block's step_wall_s
+    records = [_rec(0, 0.30, 0.20), _rec(1, 0.30, 0.20),
+               {"group": "g", "rank": 2, "steps": 40, "step_wall_s": 0.30,
+                "buckets_s": {"compute": 0.30, "collective_wait": 0.0}},
+               _rec(3, 0.30, 0.20)]
+    score = H.score_step_records(records)
+    assert score["suspects"] == [2]
+
+
+def test_noisy_healthy_cluster_never_promotes():
+    """Acceptance gate: realistic jitter over many windows must never
+    reach a verdict — the hysteresis + robust-z stack absorbs it."""
+    import random
+
+    rng = random.Random(7)
+    tracker = H.HysteresisTracker(3)
+    promoted = []
+    for _window in range(60):
+        records = []
+        for rank in range(8):
+            wall = 0.30 * rng.uniform(0.9, 1.1)
+            coll = 0.18 * rng.uniform(0.7, 1.3)
+            records.append(_rec(rank, wall, min(coll, wall)))
+        score = H.score_step_records(records, mad_threshold=3.5)
+        promoted += tracker.observe(score["suspects"],
+                                    list(score["ranks"]))
+    assert promoted == []
+
+
+def test_3x_straggler_promotes_within_k_windows():
+    """The flip side: a genuine 3x-slow rank must be promoted after
+    exactly the hysteresis window count, jitter and all."""
+    import random
+
+    rng = random.Random(11)
+    windows = 3
+    tracker = H.HysteresisTracker(windows)
+    for w in range(1, 10):
+        records = []
+        for rank in range(8):
+            if rank == 5:
+                own = 0.30 * rng.uniform(0.95, 1.05) * 3.0
+                coll = 0.002
+            else:
+                own = 0.10 * rng.uniform(0.95, 1.05)
+                coll = 0.0
+            wall = own + coll + 0.0  # healthy ranks' wait added below
+            records.append(_rec(rank, wall, coll))
+        # healthy ranks park in the collective waiting for rank 5
+        for rec in records:
+            if rec["rank"] != 5:
+                gap = 0.92 - rec["recent"]["wall_s_per_step"]
+                rec["recent"]["buckets_s"]["collective_wait"] = gap
+                rec["recent"]["wall_s_per_step"] = 0.92
+        score = H.score_step_records(records, mad_threshold=3.5)
+        promoted = tracker.observe(score["suspects"],
+                                   list(score["ranks"]))
+        if promoted:
+            assert promoted == [5]
+            assert w == windows, f"promoted at window {w}, want {windows}"
+            return
+    pytest.fail("straggler never promoted")
+
+
+# ---------------------------------------------------------------------------
+# pending ages, edge latency, SDC canary, HBM stats
+# ---------------------------------------------------------------------------
+
+
+def test_pending_age_lags():
+    now = 1000.0
+    members = [{"rank": 0, "inflight": {"op": "allreduce",
+                                        "t_start": 998.0}},
+               {"rank": 1, "inflight": None},
+               {"rank": 2, "inflight": {"op": "allreduce",
+                                        "t_start": 999.5}}]
+    ages = H.pending_age_lags(members, now=now)
+    assert ages == {0: 2.0, 2: 0.5}
+
+
+def test_edge_latency_tracker_ewma_and_reset():
+    H.reset_edge_latency()
+    try:
+        H.note_edge_latency("a->b", 0.1)
+        H.note_edge_latency("a->b", 0.2)
+        snap = H.edge_latency_snapshot()
+        assert snap["a->b"]["count"] == 2
+        assert snap["a->b"]["last_s"] == pytest.approx(0.2)
+        assert 0.1 < snap["a->b"]["ewma_s"] < 0.2
+        # snapshot is a copy: mutating it must not leak back
+        snap["a->b"]["count"] = 999
+        assert H.edge_latency_snapshot()["a->b"]["count"] == 2
+    finally:
+        H.reset_edge_latency()
+    assert H.edge_latency_snapshot() == {}
+
+
+def test_sdc_digest_is_deterministic_and_seed_sensitive():
+    a = H.sdc_digest(seed=7)
+    assert a == H.sdc_digest(seed=7)          # bit-exact, always
+    assert a != H.sdc_digest(seed=8)          # actually depends on input
+    assert len(a) == 64                        # sha256 hex
+
+
+def test_device_memory_stats_shape():
+    # conftest imports jax (cpu backend), so rows must come back with at
+    # least the device identity; occupancy only where the backend
+    # exposes memory_stats()
+    rows = H.device_memory_stats()
+    assert isinstance(rows, list)
+    for row in rows:
+        assert row["device"]
+        assert "kind" in row
+        if "occupancy" in row:
+            assert 0.0 <= row["occupancy"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# verdict records: aggregation + stale sweep
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_health_records_orders_and_sweeps():
+    now = time.time()
+    records = [
+        {"kind": "rank", "subject": "g/1", "health": "SUSPECT",
+         "ts": now - 5},
+        {"kind": "node", "subject": "nodeZ", "health": "HEALTHY",
+         "ts": now - 5},
+        {"kind": "node", "subject": "nodeA", "health": "QUARANTINED",
+         "ts": now - 5},
+        # stale: a monitor that died must not pin its verdict forever
+        {"kind": "node", "subject": "ghost", "health": "QUARANTINED",
+         "ts": now - H.STALE_S - 1},
+    ]
+    out = H.aggregate_health_records(records, now=now)
+    assert [r["subject"] for r in out] == ["nodeA", "g/1", "nodeZ"]
+
+
+def test_health_verdict_roundtrip():
+    v = H.HealthVerdict(kind="node", subject="n1", health=H.QUARANTINED,
+                        reason="probe 3.1x slower than reference",
+                        node_id="n1", signals={"probe_ratio": 3.1},
+                        hw_confirmed=False, suspect_ts=1.0,
+                        quarantine_ts=2.0)
+    d = json.loads(json.dumps(v.to_dict()))
+    assert d["health"] == "QUARANTINED"
+    assert d["signals"]["probe_ratio"] == 3.1
+    assert d["quarantine_ts"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# HealthMonitor: confirm / acquit / SDC legs (probe_fn hook, no cluster)
+# ---------------------------------------------------------------------------
+
+
+def _make_monitor(step_records, nodes, probe_fn, **kw):
+    from ray_tpu._private.health_plane import HealthMonitor
+
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("suspect_windows", 2)
+    kw.setdefault("probe_factor", 2.0)
+    mon = HealthMonitor(probe_fn=probe_fn, **kw)
+    table = {f"step_breakdown/g/{r['rank']}": json.dumps(r).encode()
+             for r in step_records}
+    mon._kv_prefix = (
+        lambda prefix, ns: dict(table) if ns == "train" else {})
+    mon._alive_nodes = lambda: [
+        {"node_id": n, "alive": True, "health": "HEALTHY"} for n in nodes]
+    ladder = []
+    mon._set_node_health = (
+        lambda node_id, health, reason, hw_confirmed=False:
+        ladder.append((node_id, health, hw_confirmed)))
+    return mon, ladder
+
+
+_STRAGGLER_RECORDS = [_rec(0, 0.30, 0.20, node="nodeA"),
+                      _rec(1, 0.30, 0.21, node="nodeB"),
+                      _rec(2, 0.30, 0.00, node="nodeC"),
+                      _rec(3, 0.30, 0.19, node="nodeD")]
+
+
+def test_monitor_probe_ratio_confirms_and_quarantines():
+    good = H.sdc_digest(seed=7)
+
+    def probe(node_id):
+        slow = node_id == "nodeC"
+        return {"node_id": node_id, "digest": good,
+                "elapsed_s": 0.35 if slow else 0.10}
+
+    mon, ladder = _make_monitor(_STRAGGLER_RECORDS,
+                                ["nodeA", "nodeB", "nodeC", "nodeD"],
+                                probe)
+    mon.tick()                     # window 1: streak building
+    assert mon.summary()["quarantined"] == []
+    mon.tick()                     # window 2: promoted -> probe -> confirm
+    s = mon.summary()
+    assert s["quarantined"] == ["nodeC"]
+    assert "detection_to_quarantine_s" in s
+    kinds = [e["event"] for e in s["events"]]
+    assert kinds.count("suspect") >= 1 and kinds.count("quarantine") == 1
+    assert ("nodeC", "QUARANTINED", False) in ladder
+    # verdict mentions the probe ratio evidence
+    q = [e for e in s["events"] if e["event"] == "quarantine"][0]
+    assert "slower than reference" in q["reason"]
+
+
+def test_monitor_probe_acquittal_resets_the_streak():
+    good = H.sdc_digest(seed=7)
+
+    def probe(node_id):
+        return {"node_id": node_id, "digest": good, "elapsed_s": 0.10}
+
+    mon, ladder = _make_monitor(_STRAGGLER_RECORDS,
+                                ["nodeA", "nodeB", "nodeC", "nodeD"],
+                                probe)
+    for _ in range(4):
+        mon.tick()
+    s = mon.summary()
+    assert s["quarantined"] == []                 # probe cleared it
+    assert all(h != "QUARANTINED" for _, h, _hw in ladder)
+    # acquittal reset the streak: the passive signal alone keeps it
+    # SUSPECT-bound, never quarantined
+    assert mon._rank_hyst.streak(("g", 2)) < 2
+
+
+def test_monitor_sdc_mismatch_is_hw_confirmed_final():
+    """A canary digest mismatch means the chip corrupts data: quarantine
+    rides ``hw_confirmed`` so the GCS makes the eventual death final
+    (report_node_failure semantics)."""
+    good = H.sdc_digest(seed=7)
+
+    def probe(node_id):
+        bad = node_id == "nodeC"
+        return {"node_id": node_id,
+                "digest": "deadbeef" * 8 if bad else good,
+                "elapsed_s": 0.10}
+
+    mon, ladder = _make_monitor(_STRAGGLER_RECORDS,
+                                ["nodeA", "nodeB", "nodeC", "nodeD"],
+                                probe)
+    mon.tick()
+    mon.tick()
+    s = mon.summary()
+    assert s["quarantined"] == ["nodeC"]
+    q = [e for e in s["events"] if e["event"] == "quarantine"][0]
+    assert q["hw_confirmed"] is True
+    assert "SDC" in q["reason"]
+    assert ("nodeC", "QUARANTINED", True) in ladder
+
+
+def test_monitor_probe_timeout_while_reference_answers_confirms():
+    good = H.sdc_digest(seed=7)
+
+    def probe(node_id):
+        if node_id == "nodeC":
+            return None                    # suspect never answers
+        return {"node_id": node_id, "digest": good, "elapsed_s": 0.10}
+
+    mon, _ladder = _make_monitor(_STRAGGLER_RECORDS,
+                                 ["nodeA", "nodeB", "nodeC", "nodeD"],
+                                 probe)
+    mon.tick()
+    mon.tick()
+    s = mon.summary()
+    assert s["quarantined"] == ["nodeC"]
+    q = [e for e in s["events"] if e["event"] == "quarantine"][0]
+    assert "timed out" in q["reason"]
+
+
+def test_monitor_no_reference_leaves_suspect_unconfirmed():
+    # every other node quarantined/unreachable: no healthy yardstick —
+    # must NOT quarantine on passive evidence alone
+    def probe(node_id):
+        return None
+
+    mon, ladder = _make_monitor(_STRAGGLER_RECORDS, [], probe)
+    for _ in range(4):
+        mon.tick()
+    assert mon.summary()["quarantined"] == []
+    assert all(h != "QUARANTINED" for _, h, _hw in ladder)
+
+
+def test_monitor_probe_sweep_catches_degraded_node_without_groups():
+    """The node-sweep leg: detection with no train group at all (the
+    production-day crucible's shape — single-rank learners)."""
+    good = H.sdc_digest(seed=7)
+
+    def probe(node_id):
+        slow = node_id == "n3"
+        return {"node_id": node_id, "digest": good,
+                "elapsed_s": 0.50 if slow else 0.10}
+
+    mon, ladder = _make_monitor(
+        [], ["n1", "n2", "n3", "n4"], probe,
+        probe_sweep=True, probe_sweep_every=1, suspect_windows=2)
+    mon.tick()
+    assert mon.summary()["quarantined"] == []     # hysteresis holding
+    mon.tick()
+    s = mon.summary()
+    assert s["quarantined"] == ["n3"]
+    assert ("n3", "QUARANTINED", False) in ladder
+    assert "detection_to_quarantine_s" in s
+
+
+def test_monitor_probe_sweep_needs_three_nodes():
+    def probe(node_id):
+        return {"node_id": node_id, "digest": H.sdc_digest(seed=7),
+                "elapsed_s": 0.50 if node_id == "n2" else 0.10}
+
+    mon, _ladder = _make_monitor([], ["n1", "n2"], probe,
+                                 probe_sweep=True, probe_sweep_every=1)
+    for _ in range(4):
+        mon.tick()
+    assert mon.summary()["quarantined"] == []
+
+
+# ---------------------------------------------------------------------------
+# GCS ladder: SUSPECT -> QUARANTINED -> drain, sticky, exclusions
+# ---------------------------------------------------------------------------
+
+
+def _gcs_raylet_env(test_body, flags=None):
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet
+
+    config.reload(dict({"health_check_period_s": 1.0}, **(flags or {})))
+
+    async def main():
+        sd = tempfile.mkdtemp()
+        os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+        g = GcsServer(sd)
+        await g.start()
+        r1 = Raylet(sd, g.addr, {"CPU": 2})
+        await r1.start()
+        r2 = Raylet(sd, g.addr, {"CPU": 2})
+        await r2.start()
+        try:
+            await test_body(g, r1, r2)
+        finally:
+            for r in (r1, r2):
+                try:
+                    await r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            await g.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        config.reload()
+
+
+def test_gcs_health_ladder_quarantine_drains_and_excludes():
+    async def body(g, r1, r2):
+        nid = r1.node_id
+        assert g.nodes[nid]["health"] == "HEALTHY"
+        ack = await g.handle_set_node_health(node_id=nid,
+                                             health="SUSPECT",
+                                             reason="own-time outlier")
+        assert ack["accepted"] and ack["previous"] == "HEALTHY"
+        assert g.nodes[nid]["health"] == "SUSPECT"
+        # SUSPECT is advisory: still schedulable
+        assert nid not in g._unschedulable_node_ids()
+
+        ack = await g.handle_set_node_health(node_id=nid,
+                                             health="QUARANTINED",
+                                             reason="probe 3x slower")
+        assert ack["accepted"]
+        assert ack["drain"] and ack["drain"]["accepted"]
+        node = g.nodes[nid]
+        assert node["health"] == "QUARANTINED"
+        assert node["state"] == "DRAINING"          # actuation: drain opened
+        assert "quarantine" in node["drain_reason"]
+        # excluded from scheduling and from available capacity
+        assert nid in g._unschedulable_node_ids()
+        avail = await g.handle_available_resources()
+        total_with = await g.handle_cluster_resources()
+        assert avail.get("CPU", 0) <= total_with.get("CPU", 0) - 2
+        # cluster view carries the ladder for every surface
+        healths = {n["node_id"]: n["health"] for n in g._cluster_view()}
+        assert healths[nid] == "QUARANTINED"
+        assert healths[r2.node_id] == "HEALTHY"
+        # the broadcast fired
+        ev = await g.handle_subscribe(cursor=0, channel="nodes",
+                                      timeout=0.1)
+        assert any(e["event"] == "node_health" and
+                   e["health"] == "QUARANTINED" for e in ev["events"])
+
+        # sticky: no self-acquittal back down the ladder
+        ack = await g.handle_set_node_health(node_id=nid,
+                                             health="HEALTHY",
+                                             reason="oops")
+        assert not ack["accepted"]
+        assert "sticky" in ack["rejection_reason"]
+        assert g.nodes[nid]["health"] == "QUARANTINED"
+
+        # unknown node / unknown state rejected
+        assert not (await g.handle_set_node_health(
+            node_id="nope", health="SUSPECT"))["accepted"]
+        assert not (await g.handle_set_node_health(
+            node_id=nid, health="WEIRD"))["accepted"]
+
+    _gcs_raylet_env(body)
+
+
+def test_gcs_hw_confirmed_quarantine_death_is_final():
+    """An SDC-confirmed quarantine must make the drain-expiry death
+    FINAL: the corpse's late heartbeats cannot resurrect it."""
+    async def body(g, r1, r2):
+        nid = r1.node_id
+        await g.handle_set_node_health(
+            node_id=nid, health="QUARANTINED",
+            reason="SDC canary digest mismatch", hw_confirmed=True)
+        assert g.nodes[nid]["health_hw_confirmed"] is True
+        # let the quarantine drain expire
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if g.nodes[nid]["state"] == "DEAD":
+                break
+            await asyncio.sleep(0.1)
+        node = g.nodes[nid]
+        assert node["state"] == "DEAD"
+        assert node.get("death_final"), \
+            "hw-confirmed quarantine death must be final"
+
+    _gcs_raylet_env(body, flags={
+        "health_quarantine_drain_deadline_s": 0.4})
+
+
+def test_gcs_arm_node_fault_reaches_the_raylet_registry():
+    """The chaos fan-out path: GCS ``arm_node_fault`` relays to the
+    node's raylet, which arms its own in-process registry (and would
+    fan to pooled workers + re-arm late-spawning ones)."""
+    from ray_tpu.util import fault_injection as fi
+
+    async def body(g, r1, r2):
+        site = "health.test_arm"
+        try:
+            ack = await g.handle_arm_node_fault(
+                node_id=r1.node_id, site=site, start_s=0.0,
+                duration_s=30.0, exc="slow:3")
+            assert ack["armed"] >= 1, ack
+            # the raylet process (this process) armed the window
+            assert site in fi._armed
+            assert fi._armed[site].factor == 3.0
+            # the raylet remembers the window for late worker spawns
+            assert any(a["site"] == site for a in r1._armed_faults)
+            assert not (await g.handle_arm_node_fault(
+                node_id="nope", site=site))["armed"]
+        finally:
+            fi.disarm(site)
+
+    _gcs_raylet_env(body)
+
+
+# ---------------------------------------------------------------------------
+# state API surface
+# ---------------------------------------------------------------------------
+
+
+def test_list_node_health_reports_ladder_and_verdicts(ray_start):
+    import ray_tpu
+    from ray_tpu.util.state import list_node_health
+
+    v = H.HealthVerdict(kind="rank", subject="tg/3", health=H.SUSPECT,
+                        reason="own-time outlier", group="tg", rank=3,
+                        signals={"own_time_z": 5.2})
+    assert H.publish_health_verdict(v)
+    try:
+        report = list_node_health()
+        assert report["nodes"], "no nodes listed"
+        for n in report["nodes"]:
+            assert n["health"] in ("HEALTHY", "SUSPECT", "QUARANTINED")
+        subjects = {r["subject"]: r for r in report["verdicts"]}
+        assert "tg/3" in subjects
+        assert subjects["tg/3"]["signals"]["own_time_z"] == 5.2
+    finally:
+        from ray_tpu.experimental import internal_kv
+
+        internal_kv._internal_kv_del(b"verdict/rank/tg/3",
+                                     namespace="health")
+
+
+# ---------------------------------------------------------------------------
+# end to end: straggler -> detect -> quarantine -> drain -> re-mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_straggler_detected_quarantined_and_remeshed(no_cluster, tmp_path,
+                                                     monkeypatch):
+    """The full health-plane loop on a live multi-process CPU cluster:
+    degrade one trainer node 3x (``slow`` fault on its compute path AND
+    on ``health.probe`` so the confirm probe sees the sick hardware),
+    let the HealthMonitor attribute the straggler from step-ledger
+    evidence, confirm with the probe, quarantine through the GCS ladder
+    (which opens a drain), and assert the elastic run re-meshes off the
+    quarantined node and completes with a ZERO failure budget —
+    quarantine is a planned migration, never a charged failure."""
+    import threading
+
+    import ray_tpu  # noqa: F401
+    from ray_tpu import train
+    from ray_tpu._private.health_plane import HealthMonitor
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.policies import ElasticScalingPolicy
+
+    monkeypatch.setenv("RAY_TPU_HEALTH_CHECK_PERIOD_S", "1.0")
+    monkeypatch.setenv("RAY_TPU_HEALTH_QUARANTINE_DRAIN_DEADLINE_S", "8.0")
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    mon = None
+    try:
+        cluster.connect()
+        for _ in range(3):
+            cluster.add_node(num_cpus=2, resources={"trainer_slot": 1})
+        cluster.wait_for_nodes()
+        side = str(tmp_path / "side")
+        os.makedirs(side, exist_ok=True)
+
+        def loop(config):
+            import json as _json
+            import os as _os
+            import tempfile as _tempfile
+            import time as _t
+
+            from ray_tpu import train as _train
+            from ray_tpu.util.fault_injection import fault_point as _fp
+
+            ctx = _train.get_context()
+            rank = ctx.get_world_rank()
+            world = ctx.get_world_size()
+            ledger = ctx.step_ledger()
+            ledger._PUBLISH_EVERY_S = 0.0   # publish every step boundary
+            start = 0
+            ck = ctx.get_checkpoint()
+            if ck is not None:
+                with open(_os.path.join(ck.path, "state.json")) as f:
+                    start = _json.load(f)["step"] + 1
+            for step in range(start, config["steps"]):
+                with ledger.step():
+                    with ledger.bucket("compute"):
+                        _fp("train.work")   # the degradable compute path
+                        _t.sleep(config["step_s"])
+                    # file barrier standing in for the collective: the
+                    # wait is charged to collective_wait, so healthy
+                    # ranks show high wait and the straggler shows high
+                    # own-time — the attribution the scorer keys on
+                    me = _os.path.join(config["side_dir"],
+                                       f"s{step}-w{world}-r{rank}")
+                    with open(me + ".tmp", "w") as f:
+                        _json.dump(
+                            {"step": step, "rank": rank, "world": world,
+                             "node": _os.environ.get(
+                                 "RAY_TPU_NODE_ID", "")}, f)
+                    _os.replace(me + ".tmp", me)
+                    t0 = _t.monotonic()
+                    want = {f"s{step}-w{world}-r{r}" for r in range(world)}
+                    while _t.monotonic() - t0 < 60:
+                        if want <= set(_os.listdir(config["side_dir"])):
+                            break
+                        _t.sleep(0.01)
+                    ledger.note("collective_wait", _t.monotonic() - t0)
+                d = _tempfile.mkdtemp()
+                with open(_os.path.join(d, "state.json"), "w") as f:
+                    _json.dump({"step": step}, f)
+                _train.report({"step": step, "world": world},
+                              checkpoint=_train.Checkpoint(d))
+
+        armed = {}
+
+        def saboteur():
+            # wait for rank-1 step-2 evidence at full size, then arm a
+            # 3x slowdown on that whole node: the compute site AND the
+            # probe site (degraded hardware is slow for the probe too)
+            from ray_tpu._private.worker import get_global_worker
+
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                marker = os.path.join(side, "s2-w3-r1")
+                if os.path.exists(marker):
+                    with open(marker) as f:
+                        info = json.load(f)
+                    if info["node"]:
+                        w = get_global_worker()
+                        for site in ("train.work", "health.probe"):
+                            ack = w.run_coro(
+                                w.gcs.call("arm_node_fault",
+                                           node_id=info["node"],
+                                           site=site, start_s=0.0,
+                                           duration_s=120.0,
+                                           exc="slow:3", timeout=10),
+                                timeout=15)
+                            assert ack["armed"] >= 1, ack
+                        armed["node"] = info["node"]
+                        armed["t"] = time.time()
+                        return
+                time.sleep(0.2)
+
+        mon = HealthMonitor(interval_s=0.5, suspect_windows=2,
+                            probe_factor=1.5, probe_timeout_s=30.0)
+        mon.start()
+        t = threading.Thread(target=saboteur, daemon=True)
+        t.start()
+
+        trainer = train.DataParallelTrainer(
+            loop,
+            train_loop_config={"side_dir": side, "steps": 25,
+                               "step_s": 0.2},
+            scaling_config=train.ScalingConfig(
+                num_workers=3,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+            run_config=train.RunConfig(
+                name="health-run", storage_path=str(tmp_path),
+                failure_config=train.FailureConfig(max_failures=0)),
+            scaling_policy=ElasticScalingPolicy(
+                min_workers=2, max_workers=3,
+                resources_per_worker={"CPU": 1, "trainer_slot": 1}),
+        )
+        result = trainer.fit()
+        t.join(timeout=5)
+
+        assert "node" in armed, "saboteur never armed the degradation"
+        # the run completed despite the sick node — with max_failures=0:
+        # quarantine-drain is a planned migration, not a charged failure
+        assert result.error is None, result.error
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps[-1] == 24, f"did not finish: {steps}"
+
+        # the monitor detected, confirmed and quarantined the victim
+        s = mon.summary()
+        assert armed["node"] in s["quarantined"], s
+        assert "detection_to_quarantine_s" in s, s
+        assert s["detection_to_quarantine_s"] >= 0.0
+
+        # the re-meshed group ran at the surviving size, off the victim
+        post_nodes = set()
+        t_recovered = None
+        for name in os.listdir(side):
+            if "-w2-" not in name or name.endswith(".tmp"):
+                continue
+            path = os.path.join(side, name)
+            with open(path) as f:
+                post_nodes.add(json.load(f)["node"])
+            mtime = os.path.getmtime(path)
+            t_recovered = mtime if t_recovered is None \
+                else min(t_recovered, mtime)
+        assert post_nodes, "group never re-meshed at the surviving size"
+        assert armed["node"] not in post_nodes, post_nodes
+        # detection-to-recovery: degradation armed -> first step of the
+        # re-meshed group (generous bound; the point is it is bounded)
+        assert t_recovered is not None
+        assert t_recovered - armed["t"] < 90, (
+            f"recovery took {t_recovered - armed['t']:.1f}s")
+
+        # the GCS ladder shows the quarantine, and the node is DRAINING
+        # or already dead -- never schedulable again
+        victim = [n for n in ray_tpu.nodes()
+                  if n["node_id"] == armed["node"]][0]
+        assert victim.get("health") == "QUARANTINED", victim
+        assert victim["state"] in ("DRAINING", "DEAD"), victim
+    finally:
+        if mon is not None:
+            mon.stop()
+        cluster.shutdown()

@@ -115,6 +115,50 @@ def test_production_day_disaggregated():
 
 @pytest.mark.chaos
 @pytest.mark.slow
+@pytest.mark.usefixtures("no_cluster")
+def test_production_day_degrade_variant():
+    """Satellite: ``--degrade`` swaps the clean-kill timeline for a
+    silent 3x node slowdown.  The health plane (probe sweep) must
+    notice, quarantine the victim through the GCS ladder, record the
+    detection latency — and must NOT quarantine anyone during the
+    clean baseline phase.  SLOs still evaluate for both phases."""
+    from production_day import PROFILES, run_production_day
+
+    profile = dataclasses.replace(
+        PROFILES["tier1"],
+        serve_rate_hz=6.0, baseline_s=5.0, chaos_tail_s=8.0,
+        rlhf_iterations=7, rlhf_interval_s=1.0,
+        ingest_blocks=6, ingest_block_rows=48, ingest_batch_rows=48,
+    )
+    record = run_production_day(profile, profile.scenario_degrade())
+    json.dumps(record)  # emission payload stays JSON-clean
+    assert record["ok"], record["problems"]
+    executed = record["timeline"]["executed"]
+    fired = {e["kind"] for e in executed if e["ok"]}
+    assert fired >= {"degrade_node"}, executed
+    # the health block carries the full story
+    h = record["health"]["chaos"]
+    degraded = next(e for e in executed
+                    if e["ok"] and e["kind"] == "degrade_node")
+    victim = degraded["result"]["node"]
+    assert victim in h["quarantined"], h
+    assert h["detection_to_quarantine_s"] >= 0.0, h
+    kinds = [e["event"] for e in h["events"]]
+    assert "suspect" in kinds and "quarantine" in kinds
+    # false-positive gate: the clean baseline ran the same monitor and
+    # must report zero SUSPECT/QUARANTINED verdicts
+    base_h = record["health"]["baseline"]
+    assert base_h is not None and base_h["quarantined"] == [], base_h
+    assert base_h["events"] == [], base_h
+    assert base_h["ticks"] > 0, "baseline monitor never ticked"
+    # SLO verdicts still evaluated for every plane in both phases
+    for phase in ("baseline", "chaos"):
+        assert {v["plane"] for v in record["verdicts"][phase]} >= {
+            "serve", "rlhf", "ingest"}
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
 def test_production_day_full_profile():
     """Full-size profile driven through the real entrypoint (subprocess,
     merged streams): the harness-shaped contract — rc 0 and the LAST

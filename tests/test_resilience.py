@@ -283,6 +283,90 @@ def test_fault_injection_delay_env_spec():
     assert "DELAY_OK" in out.stdout
 
 
+def test_fault_injection_slow_kind_proportional_sleep():
+    """``slow:<factor>`` is a RELATIVE hang: each armed call sleeps
+    ``(factor-1) x`` the site's measured inter-call baseline, so the
+    site runs ``factor`` x slower at whatever its natural cadence is —
+    the silent-degradation knob the health plane rehearses with."""
+    import time
+
+    with fi.armed("t.slow", count=10, exc="slow:3"):
+        period = 0.05
+        t0 = time.monotonic()
+        fi.fault_point("t.slow")  # call 1: seeds the baseline, no sleep
+        assert time.monotonic() - t0 < 0.03
+        durations = []
+        for _ in range(4):
+            time.sleep(period)
+            t0 = time.monotonic()
+            fi.fault_point("t.slow")
+            durations.append(time.monotonic() - t0)
+        # steady state: injected sleep ~ (3-1) x 0.05s = 0.1s per call
+        assert durations[-1] >= 0.05, durations
+        assert durations[-1] <= 0.4, durations
+        assert fi.fired_count("t.slow") == 4
+    fi.fault_point("t.slow")  # disarmed on exit
+
+
+def test_fault_injection_slow_baseline_nets_out_injected_sleep():
+    """The baseline EWMA measures the site's NATURAL cadence net of the
+    sleeps the registry itself injected — a 3x slowdown stays ~3x
+    instead of compounding toward 9x, 27x, ..."""
+    import time
+
+    with fi.armed("t.slowc", count=100, exc="slow:3"):
+        period = 0.04
+        total = []
+        for _ in range(8):
+            time.sleep(period)
+            t0 = time.monotonic()
+            fi.fault_point("t.slowc")
+            total.append(time.monotonic() - t0)
+        # compounding would grow the sleep geometrically; netted-out it
+        # converges near (factor-1) x period = 0.08s
+        assert total[-1] < 4 * period + 0.05, total
+
+
+def test_fault_injection_slow_duration_expires():
+    """``slow:<factor>:<duration_s>``: the effect self-expires that many
+    seconds after its first firing call."""
+    import time
+
+    with fi.armed("t.slowd", count=1000, exc="slow:5:0.25"):
+        fi.fault_point("t.slowd")            # seeds baseline
+        time.sleep(0.05)
+        fi.fault_point("t.slowd")            # fires, starts the clock
+        assert fi.fired_count("t.slowd") >= 1
+        time.sleep(0.4)                      # expiry passes
+        t0 = time.monotonic()
+        fi.fault_point("t.slowd")            # outside window: clean
+        assert time.monotonic() - t0 < 0.05
+        fired_after = fi.fired_count("t.slowd")
+        fi.fault_point("t.slowd")
+        assert fi.fired_count("t.slowd") == fired_after
+
+
+def test_fault_injection_slow_env_spec():
+    """Env grammar leg: ``site:nth:count:slow:<factor>[:<duration_s>]``."""
+    code = (
+        "import time\n"
+        "from ray_tpu.util import fault_injection as fi\n"
+        "fi.fault_point('env.slow')\n"  # seeds the baseline
+        "time.sleep(0.1)\n"
+        "t0 = time.monotonic(); fi.fault_point('env.slow')\n"
+        "dt = time.monotonic() - t0\n"
+        "assert dt >= 0.1, f'no proportional sleep: {dt}'\n"
+        "assert fi.fired_count('env.slow') == 1\n"
+        "print('SLOW_OK')\n"
+    )
+    env = dict(os.environ,
+               RAY_TPU_FAULT_INJECT="env.slow:1:99:slow:3")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "SLOW_OK" in out.stdout
+
+
 def test_fault_injection_env_arming_in_subprocess():
     code = (
         "from ray_tpu.util import fault_injection as fi\n"

@@ -632,3 +632,62 @@ def test_memory_summary(ray_start):
 # serial blocking gets in data iteration loops — are now raylint
 # checkers (ray_tpu/_private/analysis/, enforced rule-by-rule in
 # tests/test_raylint.py with fixture self-tests each).
+
+
+def test_dashboard_and_cli_health_surfaces(ray_start):
+    """The health plane's operator views: ``/api/health`` joins the node
+    ladder with published verdicts (stale ones swept, QUARANTINED
+    first), the cluster view carries per-node ``health``, and ``raytpu
+    health --json`` serves the same report over the CLI."""
+    import subprocess
+
+    from ray_tpu.experimental import internal_kv
+    from ray_tpu.util import health as H
+
+    url = ray_tpu.dashboard_url()
+    assert url
+    fresh = H.HealthVerdict(
+        kind="rank", subject="toolgrp/2", health=H.SUSPECT,
+        reason="own-time outlier", group="toolgrp", rank=2,
+        signals={"own_time_z": 6.1})
+    stale = H.HealthVerdict(
+        kind="node", subject="ghost-node", health=H.QUARANTINED,
+        reason="probe 9x slower than reference", node_id="ghost-node")
+    stale.ts = time.time() - H.STALE_S - 5
+    assert H.publish_health_verdict(fresh)
+    assert H.publish_health_verdict(stale)
+    try:
+        report = _get_json(f"{url}/api/health")
+        assert report["nodes"], "no nodes in /api/health"
+        for n in report["nodes"]:
+            assert n["health"] in ("HEALTHY", "SUSPECT", "QUARANTINED")
+            assert "devices" in n      # HBM occupancy rows (may be [])
+        subjects = [v["subject"] for v in report["verdicts"]]
+        assert "toolgrp/2" in subjects
+        assert "ghost-node" not in subjects, "stale verdict not swept"
+        v = next(v for v in report["verdicts"]
+                 if v["subject"] == "toolgrp/2")
+        assert v["signals"]["own_time_z"] == 6.1
+
+        # the cluster view rides the ladder too
+        cluster = _get_json(f"{url}/api/cluster")
+        assert all(n.get("health") == "HEALTHY"
+                   for n in cluster["nodes"])
+
+        # CLI parity: raytpu health --json is the same report
+        from ray_tpu._private.worker import get_global_worker
+
+        addr = get_global_worker().gcs.addr
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "health",
+             "--json", "--address", addr],
+            capture_output=True, text=True, timeout=60, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        cli_report = json.loads(out.stdout.strip().splitlines()[-1])
+        assert [v["subject"] for v in cli_report["verdicts"]] == subjects
+        assert {n["node_id"] for n in cli_report["nodes"]} == \
+            {n["node_id"] for n in report["nodes"]}
+    finally:
+        for key in ("verdict/rank/toolgrp/2", "verdict/node/ghost-node"):
+            internal_kv._internal_kv_del(key.encode(), namespace="health")
