@@ -41,12 +41,20 @@ Usage::
     python benchmarks/production_day.py --profile full  # the slow one
     python benchmarks/production_day.py --scenario my_timeline.json
     python benchmarks/production_day.py --degrade       # health plane
+    python benchmarks/production_day.py --partition     # netem layer
 
 ``--degrade`` swaps the timeline for the silent-degradation variant:
 one worker node is slowed 3x (no crash, no drain notice) and the
 record gates on the health plane noticing — probe-sweep detection,
 quarantine through the GCS ladder, a recorded detection latency, and
 ZERO quarantines in the clean baseline phase (false-positive gate).
+
+``--partition`` swaps the timeline for the network-partition variant:
+one worker node is cut off the control plane for a transient netem
+window (``partition_nodes`` builtin — deterministic drop rules at the
+RPC transport).  Nothing is declared dead; the gate is that all three
+planes ride the partition out on the retry layer with exactly-once
+accounting intact and ingest recovering.
 
 The tier-1 miniature lives in ``tests/test_production_day.py`` and calls
 :func:`run_production_day` directly.
@@ -117,6 +125,11 @@ class Profile:
     # health plane's probe sweep must notice and quarantine
     degrade_factor: float = 3.0
     degrade_duration_s: float = 60.0
+    # partition variant: cut one worker off the control plane for a
+    # TRANSIENT window (well under the ~30s default death timeout) —
+    # the planes must ride it out on the retry layer, exactly-once
+    partition_duration_s: float = 3.0
+    partition_mode: str = "symmetric"
     # SLO thresholds (None = report only); chaos phase gets looser ones
     serve_p99_s: Optional[float] = None
     serve_max_shed_rate: Optional[float] = None
@@ -151,6 +164,19 @@ class Profile:
             {"at": 1.5, "kind": "degrade_node",
              "factor": self.degrade_factor,
              "duration": self.degrade_duration_s},
+        ]}
+
+    def scenario_partition(self) -> Dict[str, Any]:
+        """The partition variant (``--partition``): drop every frame
+        between one worker node and the GCS for a transient window via
+        the netem layer (``partition_nodes`` builtin).  Nothing dies —
+        the window is far shorter than the death timeout — so the gate
+        is that all three planes ride it out on the RPC retry layer
+        with exactly-once accounting intact and ingest recovering."""
+        return {"seed": self.seed, "events": [
+            {"at": 1.5, "kind": "partition_nodes",
+             "mode": self.partition_mode,
+             "duration": self.partition_duration_s},
         ]}
 
 
@@ -592,9 +618,9 @@ def _run_phase(profile: Profile, phase: str,
             events = []
             for ev in scenario["events"]:
                 ev = dict(ev)
-                if ev.get("kind") == "degrade_node":
-                    # never degrade the head: it carries the learner,
-                    # the serve clients and the monitor itself
+                if ev.get("kind") in ("degrade_node", "partition_nodes"):
+                    # never degrade/partition the head: it carries the
+                    # learner, the serve clients and the monitor itself
                     ev["exclude"] = list(ev.get("exclude", [])) + [head_id]
                 events.append(ev)
             timeline = ChaosTimeline(
@@ -821,6 +847,19 @@ def _invariants(profile: Profile, chaos_ph: Dict[str, Any],
             problems.append(
                 f"{v['plane']} plane unevaluable under chaos: "
                 f"{v['degraded_reason']}")
+    # partition variant: the event must actually have cut a link — a
+    # victim chosen and drop rules armed on at least one endpoint (the
+    # transient window then stresses the retry layer; the exactly-once
+    # and recovery gates below do the rest)
+    for e in chaos_ph["executed"]:
+        if not (e.get("ok") and e.get("kind") == "partition_nodes"):
+            continue
+        res = e.get("result") or {}
+        if not res.get("node"):
+            problems.append(f"partition event picked no victim: {res}")
+        elif not any((res.get("armed") or {}).values()):
+            problems.append(
+                f"partition rules armed on no endpoint: {res}")
     # RLHF: exactly-once trajectory accounting through the chaos
     if chaos_ph["rlhf"].get("error"):
         problems.append(f"rlhf loop failed: {chaos_ph['rlhf']['error']}")
@@ -930,6 +969,11 @@ def main() -> int:
                          "instead of killing things; the health plane "
                          "must detect and quarantine it "
                          "(docs/fault_tolerance.md, health plane)")
+    ap.add_argument("--partition", action="store_true",
+                    help="chaos phase cuts one worker off the control "
+                         "plane for a transient netem window; the "
+                         "planes must ride it out on the retry layer "
+                         "(docs/fault_tolerance.md, partitions)")
     args = ap.parse_args()
     profile = PROFILES[args.profile]
     if args.disaggregated:
@@ -941,6 +985,14 @@ def main() -> int:
     scenario = None
     if args.degrade:
         scenario = profile.scenario_degrade()
+    if args.partition:
+        scenario = profile.scenario_partition()
+        # the partition window itself is dead air, not recovery time:
+        # ingest cannot make progress against a cut control plane, so
+        # the recovery clock only really starts once the link heals
+        profile = dataclasses.replace(
+            profile, ingest_recovery_s=(profile.ingest_recovery_s
+                                        + profile.partition_duration_s))
     if args.scenario:
         with open(args.scenario) as f:
             scenario = json.load(f)

@@ -92,8 +92,10 @@ def init(
         async def _discover():
             c = RpcClient(gcs_addr)
             try:
+                from ray_tpu._private.rpc import mint_mid
+
                 nodes = await c.call("get_all_nodes")
-                job_id = await c.call("next_job_id")
+                job_id = await c.call("next_job_id", _mid=mint_mid())
                 return nodes, job_id
             finally:
                 await c.close()

@@ -10,6 +10,7 @@ from ray_tpu._private.analysis.checkers import (  # noqa: F401
     context_capture,
     fault_sites,
     gang_state,
+    gcs_idempotency,
     lock_discipline,
     proxy_context,
     serial_blocking_get,

@@ -1,0 +1,152 @@
+"""gcs-verb-idempotency: every mutating GCS verb is annotated.
+
+The at-most-once layer (PR 19) only holds if the verb audit is
+exhaustive: every ``handle_*`` verb on the GCS server must be either
+read-only (``_READONLY_HANDLERS``) or annotated ``idempotent`` /
+``deduped`` in ``GCS_VERB_IDEMPOTENCY`` — an unannotated mutating verb
+is a verb the retry layer may silently double-apply.  The GcsServer
+constructor asserts the same at runtime; this checker catches it at
+lint time, plus the drifts runtime can't see: table entries for verbs
+that no longer exist, verbs claimed both read-only and mutating, and
+annotation values outside the two-word vocabulary.
+
+The scan is AST-based: the handler set is every ``handle_<verb>``
+method of the class defining ``handle_register_node`` in
+``ray_tpu/_private/gcs.py``; the two registries are read as literals
+(a computed registry would defeat static audit, and is reported).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ray_tpu._private.analysis.core import (
+    Finding, ParsedFile, Project, ProjectChecker, register)
+
+_GCS_MODULE = "ray_tpu/_private/gcs.py"
+_VALID = ("idempotent", "deduped")
+
+
+def _literal_set(node: ast.AST) -> Optional[Tuple[int, List[str]]]:
+    """``frozenset({...})`` / ``{...}`` of string constants -> (line, names)."""
+    if isinstance(node, ast.Call) and node.args:
+        node = node.args[0]
+    if not isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        return None
+    names: List[str] = []
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+            return None
+        names.append(elt.value)
+    return node.lineno, names
+
+
+def _literal_str_dict(node: ast.AST) -> Optional[Dict[str, Tuple[int, str]]]:
+    """``{"verb": "kind", ...}`` -> {verb: (line, kind)}; None if computed."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, Tuple[int, str]] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, ast.Constant) and isinstance(v.value, str)):
+            return None
+        out[k.value] = (k.lineno, v.value)
+    return out
+
+
+def _module_assign(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.value
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name) \
+                and node.target.id == name and node.value is not None:
+            return node.value
+    return None
+
+
+@register
+class GcsVerbIdempotencyChecker(ProjectChecker):
+    rule = "gcs-verb-idempotency"
+    description = ("every mutating GCS verb must be annotated idempotent "
+                   "or deduped in GCS_VERB_IDEMPOTENCY (or be in "
+                   "_READONLY_HANDLERS)")
+    hint = ("annotate the verb in GCS_VERB_IDEMPOTENCY in "
+            "ray_tpu/_private/gcs.py — 'idempotent' if a replay converges, "
+            "'deduped' if callers must mint a _mid")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        pf = project.file(_GCS_MODULE)
+        out: List[Finding] = []
+        if pf is None or pf.tree is None:
+            return out  # tree not scanned / syntax-error rule covers it
+
+        handlers: Dict[str, ast.AST] = {}
+        gcs_cls = None
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                    isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and m.name == "handle_register_node" for m in node.body):
+                gcs_cls = node
+                break
+        if gcs_cls is None:
+            out.append(self.finding(
+                pf, 1, "cannot find the GCS server class (no "
+                "handle_register_node method) — the verb audit is broken"))
+            return out
+        for m in gcs_cls.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and m.name.startswith("handle_"):
+                handlers[m.name[len("handle_"):]] = m
+
+        ro_node = _module_assign(pf.tree, "_READONLY_HANDLERS")
+        ro = _literal_set(ro_node) if ro_node is not None else None
+        if ro is None:
+            out.append(self.finding(
+                pf, getattr(ro_node, "lineno", 1),
+                "_READONLY_HANDLERS is missing or not a literal set of "
+                "strings — the verb audit cannot be checked statically"))
+            return out
+        table_node = _module_assign(pf.tree, "GCS_VERB_IDEMPOTENCY")
+        table = _literal_str_dict(table_node) if table_node is not None else None
+        if table is None:
+            out.append(self.finding(
+                pf, getattr(table_node, "lineno", 1),
+                "GCS_VERB_IDEMPOTENCY is missing or not a literal "
+                "{str: str} dict — the verb audit cannot be checked "
+                "statically"))
+            return out
+
+        ro_line, ro_names = ro
+        readonly = set(ro_names)
+        for verb, m in sorted(handlers.items()):
+            if verb in readonly and verb in table:
+                out.append(self.finding(
+                    pf, table[verb][0],
+                    f"GCS verb {verb!r} is claimed both read-only and "
+                    f"mutating — pick one",
+                    hint="a verb in _READONLY_HANDLERS must not also "
+                         "appear in GCS_VERB_IDEMPOTENCY"))
+            elif verb not in readonly and verb not in table:
+                out.append(self.finding(
+                    pf, m, f"mutating GCS verb {verb!r} is not annotated "
+                    f"in GCS_VERB_IDEMPOTENCY"))
+        for verb, (line, kind) in sorted(table.items()):
+            if kind not in _VALID:
+                out.append(self.finding(
+                    pf, line, f"GCS verb {verb!r} has invalid idempotency "
+                    f"annotation {kind!r} (valid: {', '.join(_VALID)})"))
+            if verb not in handlers:
+                out.append(self.finding(
+                    pf, line, f"GCS_VERB_IDEMPOTENCY entry {verb!r} names "
+                    f"no handle_{verb} handler — stale table entry",
+                    hint="remove the stale entry (or restore the handler)"))
+        for verb in sorted(readonly):
+            if verb not in handlers:
+                out.append(self.finding(
+                    pf, ro_line, f"_READONLY_HANDLERS entry {verb!r} names "
+                    f"no handle_{verb} handler — stale entry",
+                    hint="remove the stale entry (or restore the handler)"))
+        return out

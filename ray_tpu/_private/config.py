@@ -23,6 +23,19 @@ _FLAG_DEFS: Dict[str, Any] = {
     # chaos injection, same spirit as RAY_testing_rpc_failure
     # (src/ray/rpc/rpc_chaos.h:23): "method=N:req_prob:resp_prob,..."
     "testing_rpc_failure": "",
+    # seed for the transport-chaos decision stream: same spec + same seed
+    # => the same drop/delay/dup decisions at the same call indices
+    # (chaos.py determinism contract, extended to the RPC layer)
+    "testing_rpc_seed": 0,
+    # netem rule set keyed on (src node, dst node, verb):
+    # "src>dst:verb:action[:p=..][:at=..][:for=..][:n=..][:phase=..];..."
+    # ("<>" for symmetric links; actions drop | delay=<s> | dup)
+    "netem": "",
+    "netem_seed": 0,
+    # bounded at-most-once reply cache: deduped GCS mutations keyed by a
+    # client-minted request id keep their first reply for replay, so the
+    # transport retry layer can never double-apply one
+    "gcs_reply_cache_size": 4096,
     # --- object store ---
     "object_store_memory_bytes": 2 * 1024**3,
     # C++ shm arena (ray_tpu/_native/store.cc) — the plasma-equivalent fast

@@ -41,8 +41,49 @@ _READONLY_HANDLERS = frozenset({
     "subscribe", "cluster_resources",
     "available_resources", "publish_logs", "tail_logs", "job_logs_delta",
     # chaos fan-out: arms in-process fault registries, no GCS tables
-    "arm_node_fault",
+    "arm_node_fault", "arm_netem",
 })
+
+# At-most-once audit of every STATE-MUTATING GCS verb (everything not in
+# _READONLY_HANDLERS must appear here — asserted at construction and by
+# raylint's gcs-verb-idempotency checker):
+#
+#   "idempotent" — re-applying the mutation converges to the same state
+#                  (keyed upserts, sticky escalations, guarded deaths),
+#                  so the transport retry layer may replay it freely.
+#   "deduped"    — a double-apply diverges (mints ids, increments restart
+#                  budgets, spawns processes, appends to feeds): callers
+#                  mint a request id (``_mid``) and the server replays the
+#                  first reply from a bounded cache instead of re-applying.
+GCS_VERB_IDEMPOTENCY: Dict[str, str] = {
+    # --- nodes ---
+    "register_node": "deduped",        # mints a fresh incarnation
+    "drain_node": "idempotent",        # a second notice only shortens
+    "set_node_health": "idempotent",   # ladder only escalates; sticky
+    "unregister_node": "idempotent",   # _mark_node_dead guards on alive
+    "report_node_failure": "idempotent",
+    # --- kv ---
+    "kv_put": "idempotent",
+    "kv_del": "idempotent",
+    # --- jobs ---
+    "next_job_id": "deduped",          # mints
+    "add_job": "idempotent",           # keyed upsert by job_id
+    "mark_job_finished": "idempotent",
+    "submit_job": "deduped",           # spawns a driver process
+    "stop_job": "idempotent",
+    # --- actors ---
+    "create_actor": "deduped",         # registers + schedules once
+    "report_actor_ready": "idempotent",
+    "report_actor_failed": "idempotent",
+    "kill_actor": "idempotent",
+    "report_worker_death": "deduped",  # burns restart budget per apply
+    # --- placement groups / gangs ---
+    "create_placement_group": "deduped",  # mints a pg id
+    "remove_placement_group": "idempotent",
+    # --- misc ---
+    "publish_event": "deduped",        # appends to the event feed
+    "shutdown_cluster": "idempotent",
+}
 
 # kv values at or above this size are persisted as individual
 # content-addressed side files instead of inside the snapshot pickle —
@@ -155,10 +196,30 @@ class GcsServer:
             self._load_snapshot()
             self._replay_wal()
 
+        # at-most-once reply cache for "deduped" verbs, keyed by
+        # (verb, client-minted _mid) — bounded LRU, successes only
+        from collections import OrderedDict as _OrderedDict
+
+        self._reply_cache: "_OrderedDict[Tuple[str, str], Any]" = _OrderedDict()
+
         self.server.register_all(self)
+        # audit: every verb is either read-only or explicitly annotated in
+        # the idempotency table — an unannotated mutating handler is a bug
+        # (raylint's gcs-verb-idempotency enforces the same at lint time)
+        for name in self.server._handlers:
+            if name not in _READONLY_HANDLERS and name not in GCS_VERB_IDEMPOTENCY:
+                raise AssertionError(
+                    f"GCS verb {name!r} mutates state but is not annotated "
+                    "in GCS_VERB_IDEMPOTENCY (idempotent | deduped)")
         for name, h in list(self.server._handlers.items()):
+            wrapped = self._fence_wrapper(h)
+            if GCS_VERB_IDEMPOTENCY.get(name) == "deduped":
+                wrapped = self._dedup_wrapper(name, wrapped)
+            else:
+                wrapped = self._strip_mid_wrapper(wrapped)
             if name not in _READONLY_HANDLERS:
-                self.server.register(name, self._mark_dirty_wrapper(h))
+                wrapped = self._mark_dirty_wrapper(wrapped)
+            self.server.register(name, wrapped)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         bound_host, bound_port = await self.server.listen_tcp(host, port)
@@ -178,6 +239,83 @@ class GcsServer:
         async def wrapped(**kwargs):
             self._dirty = True
             return await handler(**kwargs)
+
+        return wrapped
+
+    # ------------------------------------ fencing + at-most-once wrappers
+
+    def _check_fence(self, node_id: str, incarnation: int):
+        """Reject a mutation from a dead-declared node incarnation.
+
+        A caller is stale when its incarnation predates the node's
+        current one (the node already rejoined) or is at/below the fence
+        (the GCS declared that incarnation dead).  Unknown nodes are
+        fenced too: their records were swept, so nothing they assert
+        about cluster state can be trusted."""
+        from ray_tpu.exceptions import StaleNodeError
+
+        node = self.nodes.get(node_id)
+        current = int(node.get("incarnation", 0)) if node else 0
+        fence = int(node.get("fence", 0)) if node else 0
+        if node is None or incarnation < current or incarnation <= fence:
+            if node is not None:
+                # volatile zombie diagnostics (surfaced by list_nodes /
+                # `raytpu status` / the dashboard cluster panel)
+                node["stale_contacts"] = int(node.get("stale_contacts", 0)) + 1
+                node["last_stale_contact"] = time.time()
+            logger.warning(
+                "fenced mutation from node %s incarnation %d "
+                "(current %d, fence %d)", node_id[:8], incarnation,
+                current, fence)
+            raise StaleNodeError(node_id, incarnation, current, fence)
+
+    def _fence_wrapper(self, handler):
+        """Pop the optional ``_fence={"node_id", "incarnation"}`` stamp
+        callers attach to node-originated verbs and reject fenced ones
+        BEFORE the handler runs (a zombie's write must never half-apply)."""
+        async def wrapped(_fence=None, **kwargs):
+            if _fence is not None:
+                self._check_fence(str(_fence.get("node_id", "")),
+                                  int(_fence.get("incarnation", 0)))
+            return await handler(**kwargs)
+
+        return wrapped
+
+    def _strip_mid_wrapper(self, handler):
+        # idempotent / read-only verbs accept and ignore a ``_mid`` so
+        # call sites can stamp uniformly without consulting the table
+        async def wrapped(_mid=None, **kwargs):
+            return await handler(**kwargs)
+
+        return wrapped
+
+    def _dedup_wrapper(self, name: str, handler):
+        """At-most-once for non-idempotent verbs: a retry carrying the
+        same client-minted ``_mid`` replays the first reply from the
+        bounded cache instead of re-applying the mutation (reference:
+        the reply-caching role of gRPC idempotency annotations the
+        reference leaves to manual retry discipline)."""
+        async def wrapped(_mid=None, **kwargs):
+            if _mid is None:
+                return await handler(**kwargs)
+            key = (name, _mid)
+            cache = self._reply_cache
+            if key in cache:
+                cache.move_to_end(key)
+                logger.info("at-most-once: replaying cached reply for "
+                            "%s _mid=%s", name, _mid[:8])
+                return cache[key]
+            from ray_tpu.util.fault_injection import fault_point
+
+            fault_point("gcs.mutation_dedup")
+            result = await handler(**kwargs)
+            # successes only: a raised mutation did not apply, so the
+            # retry must re-execute, not replay the failure
+            cache[key] = result
+            limit = int(config.gcs_reply_cache_size)
+            while len(cache) > limit > 0:
+                cache.popitem(last=False)
+            return result
 
         return wrapped
 
@@ -337,7 +475,8 @@ class GcsServer:
     # one-element tuple, matched by exact shape so a legitimate kv value
     # equal to a bare marker string can never replay as a deletion
     _WAL_DEL = ("__wal_del__",)
-    _NODE_VOLATILE = ("last_heartbeat", "pending_demand", "stats")
+    _NODE_VOLATILE = ("last_heartbeat", "pending_demand", "stats",
+                      "stale_contacts", "last_stale_contact")
 
     @staticmethod
     def _is_wal_del(value) -> bool:
@@ -679,7 +818,7 @@ class GcsServer:
         addr = node["addr"]
         client = self._raylet_clients.get(addr)
         if client is None:
-            client = RpcClient(addr, "gcs-raylet")
+            client = RpcClient(addr, "gcs-raylet", src_id="gcs")
             self._raylet_clients[addr] = client
         return client
 
@@ -706,6 +845,13 @@ class GcsServer:
                                    resources: Dict[str, float],
                                    labels: Dict[str, str],
                                    node_name: str = "") -> Dict:
+        prev = self.nodes.get(node_id)
+        # cluster-epoch fencing: every registration mints a strictly
+        # monotonic per-node incarnation — past any incarnation this GCS
+        # has seen AND past the fence, so a rejoining zombie's fresh
+        # writes pass while its pre-fence identity stays rejected
+        incarnation = 1 if prev is None else (
+            max(int(prev.get("incarnation", 0)), int(prev.get("fence", 0))) + 1)
         self.nodes[node_id] = {
             "node_id": node_id,
             "addr": addr,
@@ -713,6 +859,8 @@ class GcsServer:
             "available": dict(resources),
             "labels": labels,
             "node_name": node_name,
+            "incarnation": incarnation,
+            "fence": int(prev.get("fence", 0)) if prev else 0,
             "alive": True,
             # ALIVE -> DRAINING -> DEAD (reference: DrainNode RPC + the
             # autoscaler's drain-before-terminate path).  `alive` stays
@@ -751,7 +899,7 @@ class GcsServer:
 
             asyncio.ensure_future(_push())
         self._kick_pending()
-        return {"ok": True}
+        return {"ok": True, "incarnation": incarnation}
 
     async def handle_drain_node(self, node_id: str, reason: str = "",
                                 deadline_s: Optional[float] = None) -> Dict:
@@ -893,13 +1041,57 @@ class GcsServer:
             return {"armed": 0, "rejection_reason": str(e)}
         return {"armed": int(ack.get("armed", 0)), "node_id": node_id}
 
+    async def handle_arm_netem(self, rules: List[Dict[str, Any]],
+                               seed: Any = 0, lead_s: float = 0.0) -> Dict:
+        """Network-chaos fan-out: install a netem rule set on every
+        endpoint a rule names — the involved raylets FIRST (the arming
+        RPCs themselves must not ride the partition they create), then
+        the GCS's own server.  ``lead_s`` pushes the shared window epoch
+        into the future so both ends of a link cut over at the same
+        instant regardless of relay latency; an empty ``rules`` list
+        clears the emulator everywhere it reaches."""
+        from ray_tpu._private.rpc import normalize_netem_rule
+
+        rules = [normalize_netem_rule(r) for r in rules]
+        epoch = time.time() + max(0.0, float(lead_s))
+        targets: List[str] = []
+        for rule in rules:
+            for endpoint in (rule["src"], rule["dst"]):
+                if endpoint in ("*", "gcs") or endpoint in targets:
+                    continue
+                targets.append(endpoint)
+        armed: Dict[str, bool] = {}
+        for prefix in sorted(targets):
+            # rules may abbreviate node ids; resolve against live nodes
+            matches = [nid for nid, n in self.nodes.items()
+                       if n.get("alive") and nid.startswith(prefix)]
+            for nid in matches:
+                raylet = self._raylet(nid)
+                if raylet is None:
+                    armed[nid] = False
+                    continue
+                try:
+                    await asyncio.wait_for(
+                        raylet.call("netem_arm", rules=rules, seed=seed,
+                                    epoch=epoch), 5.0)
+                    armed[nid] = True
+                except Exception as e:  # noqa: BLE001 — chaos best-effort
+                    logger.warning("netem arm relay to %s failed: %r",
+                                   nid[:8], e)
+                    armed[nid] = False
+        self.server._netem.install(rules, seed=seed, epoch=epoch)
+        armed["gcs"] = True
+        return {"armed": armed, "epoch": epoch,
+                "schedule": self.server._netem.schedule()}
+
     async def handle_unregister_node(self, node_id: str) -> bool:
         await self._mark_node_dead(node_id, reason="unregistered")
         return True
 
     async def handle_heartbeat(self, node_id: str, available: Dict[str, float],
                                pending: Optional[List[Dict[str, float]]] = None,
-                               stats: Optional[Dict[str, Any]] = None
+                               stats: Optional[Dict[str, Any]] = None,
+                               incarnation: Optional[int] = None
                                ) -> Dict:
         node = self.nodes.get(node_id)
         if node is None:
@@ -907,6 +1099,15 @@ class GcsServer:
             # raylet: tell it to re-register (reference: raylets surviving
             # GCS restart re-sync from GcsInitData)
             return {"nodes": self._cluster_view(), "unknown": True}
+        if (incarnation is not None
+                and incarnation < int(node.get("incarnation", 0))):
+            # a heartbeat from a SUPERSEDED incarnation: the node id
+            # already re-registered (split-brain — two raylet processes
+            # claim one identity).  Fence the old claimant; do NOT let it
+            # overwrite the live incarnation's resource view.
+            node["stale_contacts"] = int(node.get("stale_contacts", 0)) + 1
+            node["last_stale_contact"] = time.time()
+            return {"nodes": self._cluster_view(), "stale": True}
         freed = node["available"] != available
         node["available"] = available
         node["pending_demand"] = pending or []
@@ -935,11 +1136,28 @@ class GcsServer:
                 node["death_reason"] = ("drain deadline expired"
                                         f" ({node.get('drain_reason', '')})")
                 return {"nodes": self._cluster_view(), "shutdown": True}
-            # heartbeat from a node marked dead during a GCS outage window:
-            # it's alive after all — resurrect it.  A drain in progress
-            # survives the blip (resurrect to DRAINING, not ALIVE): the
-            # node_draining broadcast is a commitment consumers already
-            # acted on, and the provider will still reclaim the capacity.
+            if (incarnation is not None
+                    and incarnation <= int(node.get("fence", 0))):
+                # the split-brain hole, closed: this incarnation was
+                # DECLARED dead (fence bumped) — actors restarted
+                # elsewhere, gangs fate-shared, leases reassigned.
+                # Silently resurrecting it would double-execute every
+                # task it still runs.  Fence it: the raylet kills its
+                # workers, releases leases, and re-registers as a fresh
+                # incarnation.
+                node["stale_contacts"] = int(node.get("stale_contacts", 0)) + 1
+                node["last_stale_contact"] = time.time()
+                logger.warning(
+                    "node %s incarnation %d heartbeat after death "
+                    "declaration (fence %d): fencing, not resurrecting",
+                    node_id[:8], incarnation, int(node.get("fence", 0)))
+                return {"nodes": self._cluster_view(), "stale": True}
+            # heartbeat from a node marked dead during a GCS outage window
+            # by a LEGACY raylet that carries no incarnation: it's alive
+            # after all — resurrect it.  A drain in progress survives the
+            # blip (resurrect to DRAINING, not ALIVE): the node_draining
+            # broadcast is a commitment consumers already acted on, and
+            # the provider will still reclaim the capacity.
             node["alive"] = True
             node["state"] = "DRAINING" if drain_deadline else "ALIVE"
             self._publish("nodes", {"event": "node_added",
@@ -966,6 +1184,8 @@ class GcsServer:
              "alive": n["alive"],
              "state": n.get("state", "ALIVE" if n["alive"] else "DEAD"),
              "health": n.get("health", "HEALTHY"),
+             "incarnation": n.get("incarnation", 0),
+             "fence": n.get("fence", 0),
              "drain_deadline": n.get("drain_deadline"),
              "pending_demand": n.get("pending_demand", [])}
             for n in self.nodes.values()
@@ -1013,7 +1233,7 @@ class GcsServer:
             await asyncio.sleep(period)
 
     async def _shutdown_drained(self, addr: str):
-        client = RpcClient(addr, "gcs-drain-kill")
+        client = RpcClient(addr, "gcs-drain-kill", src_id="gcs")
         try:
             await asyncio.wait_for(client.call("shutdown_node"), 2.0)
         except Exception:  # noqa: BLE001
@@ -1046,6 +1266,13 @@ class GcsServer:
         node["alive"] = False
         node["state"] = "DEAD"
         node["death_reason"] = reason
+        # bump the fence: this is the single death path for all three
+        # triggers (heartbeat timeout, drain-deadline expiry, health
+        # quarantine-final) — from here on, any write stamped with the
+        # dead incarnation is rejected with StaleNodeError until the
+        # raylet rejoins as a fresh incarnation
+        node["fence"] = max(int(node.get("fence", 0)),
+                            int(node.get("incarnation", 0)))
         if final:
             # an OBSERVED hardware death (chip failure, slice preemption
             # verdict): the raylet process may still heartbeat, but its

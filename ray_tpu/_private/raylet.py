@@ -28,7 +28,8 @@ from typing import Any, Dict, List, Optional
 from ray_tpu._private import scheduling
 from ray_tpu._private.config import config
 from ray_tpu._private.ids import NodeID
-from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.rpc import RpcClient, RpcServer, mint_mid
+from ray_tpu.exceptions import StaleNodeError
 from ray_tpu._private.scheduling import NodeView, ResourceSet
 
 logger = logging.getLogger(__name__)
@@ -102,9 +103,15 @@ class Raylet:
 
         self.labels = {**detect_labels(), **(labels or {})}
 
-        self.server = RpcServer(f"raylet-{self.node_id[:8]}")
+        self.server = RpcServer(f"raylet-{self.node_id[:8]}",
+                                node_id=self.node_id)
         self.addr = ""
-        self.gcs = RpcClient(gcs_addr, "raylet-gcs")
+        self.gcs = RpcClient(gcs_addr, "raylet-gcs", src_id=self.node_id)
+        # cluster-epoch fencing: the incarnation the GCS minted for this
+        # registration; stamped (as ``_fence``) on state-mutating GCS
+        # verbs so a dead-declared zombie's late writes are rejected
+        self.incarnation = 0
+        self._fencing = False  # re-entrancy guard for _on_fenced
 
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.idle: deque = deque()
@@ -184,14 +191,16 @@ class Raylet:
         os.makedirs(os.path.dirname(sock), exist_ok=True)
         await self.server.listen_unix(sock)
         self.addr = f"unix:{sock}"
-        await self.gcs.call(
+        ack = await self.gcs.call(
             "register_node",
             node_id=self.node_id,
             addr=self.addr,
             resources=self.total.to_dict(),
             labels=self.labels,
             node_name=self.node_name,
+            _mid=mint_mid(),
         )
+        self.incarnation = int((ack or {}).get("incarnation", 0))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reaper_loop()))
         self._tasks.append(asyncio.ensure_future(self._log_monitor_loop()))
@@ -261,8 +270,23 @@ class Raylet:
                     pending=[w[0].to_dict() for w in
                              list(self._lease_waiters)[:100]],
                     stats=self._node_stats(),
+                    incarnation=self.incarnation,
+                    # bounded: a silently-lost frame (network partition)
+                    # must fail THIS beat, not wedge the loop forever on
+                    # a reply that will never come
+                    timeout=max(config.health_check_period_s, 2.0),
                 )
                 hb_failures = 0
+                if reply.get("stale"):
+                    # the GCS declared this incarnation dead while we were
+                    # partitioned, and the cluster moved on (actors
+                    # restarted elsewhere, gangs fate-shared): fence
+                    # ourselves — kill workers, release leases, rejoin
+                    # fresh — instead of running doomed zombie leases
+                    await self._on_fenced("stale heartbeat: death was "
+                                          "declared during a partition")
+                    await asyncio.sleep(period)
+                    continue
                 if reply.get("shutdown"):
                     # the GCS declared this node dead for good (drain
                     # deadline expired): stop instead of heartbeating a
@@ -295,10 +319,13 @@ class Raylet:
                 if reply.get("unknown"):
                     # GCS restarted without our registration: re-attach
                     logger.info("gcs forgot this node: re-registering")
-                    await self.gcs.call(
+                    ack = await self.gcs.call(
                         "register_node", node_id=self.node_id,
                         addr=self.addr, resources=self.total.to_dict(),
-                        labels=self.labels, node_name=self.node_name)
+                        labels=self.labels, node_name=self.node_name,
+                        _mid=mint_mid())
+                    self.incarnation = int((ack or {}).get("incarnation",
+                                                           self.incarnation))
             except Exception as e:  # noqa: BLE001
                 hb_failures += 1
                 logger.debug("heartbeat failed (%d in a row): %s",
@@ -414,6 +441,108 @@ class Raylet:
             *(_ask(h.addr) for h in list(self.workers.values())))
         armed += sum(1 for ok in gathered if ok)
         return {"armed": armed, "node_id": self.node_id}
+
+    async def handle_netem_arm(self, rules: List[Dict[str, Any]],
+                               seed: Any = 0,
+                               epoch: Optional[float] = None) -> Dict:
+        """Network-chaos fan-out leg: install a netem rule set on THIS
+        raylet's server (inbound frames to this node).  The GCS relays
+        here from ``arm_netem`` BEFORE arming itself, and ``epoch`` is
+        the shared absolute window anchor, so both ends of a partition
+        cut over at the same instant."""
+        self.server._netem.install(rules, seed=seed, epoch=epoch)
+        return {"node_id": self.node_id,
+                "schedule": self.server._netem.schedule()}
+
+    # --------------------------------------------------------- fencing
+
+    def _kill_all_workers(self, include_zygote: bool = False) -> int:
+        """SIGKILL every worker (and mid-spawn child) in bulk.
+
+        Shared by node teardown (``stop``) and the fence response — a
+        graceful exit RPC per worker would outlive both budgets.  Pids of
+        zygote-forked workers are identity-checked first (recyclable once
+        the zygote reaps them); Popen pids are pinned zombies until we
+        reap them, so they are safe as-is.  Workers are session leaders,
+        so the tree kill reaps their children too."""
+        from ray_tpu._private.process_utils import sigkill_tree
+
+        live: set = set()
+        for h in list(self.workers.values()):
+            if not h.pid:
+                continue
+            if isinstance(h.proc, _ZygoteChild) and h.proc.poll() is not None:
+                continue
+            live.add(h.pid)
+        for pid, proc in self._spawned_procs.items():
+            if isinstance(proc, _ZygoteChild) and proc.poll() is not None:
+                continue
+            live.add(pid)
+        self.workers.clear()
+        self._spawned_procs.clear()
+        self.idle.clear()
+        for pid in live:
+            sigkill_tree(pid)
+        if include_zygote and self._zygote_proc is not None:
+            sigkill_tree(self._zygote_proc.pid)
+            self._zygote_proc = None
+            try:
+                os.unlink(self._zygote_sock)
+            except OSError:
+                pass
+        return len(live)
+
+    async def _on_fenced(self, why: str):
+        """The GCS fenced this incarnation (declared dead during a
+        partition, then the heal exposed us as a zombie): every lease and
+        actor this node hosts was already reassigned or fate-shared
+        elsewhere, so keeping our workers alive risks double-executing
+        their tasks.  Kill the workers, release all lease/bundle
+        bookkeeping, drop any drain adopted under the old identity, and
+        re-register as a fresh incarnation — the node rejoins as clean
+        capacity (the zygote survives: it holds no leases and makes the
+        repopulated pool cheap)."""
+        from ray_tpu.exceptions import StaleNodeError
+        from ray_tpu.util.fault_injection import fault_point
+
+        if self._stopping or self._fencing:
+            return
+        self._fencing = True
+        try:
+            killed = self._kill_all_workers()
+            logger.warning(
+                "node %s incarnation %d fenced (%s): killed %d worker(s), "
+                "released leases, rejoining as a fresh incarnation",
+                self.node_id[:8], self.incarnation, why, killed)
+            self._lease_tokens.clear()
+            self._released_tokens.clear()
+            stale = StaleNodeError(self.node_id, self.incarnation)
+            for waiter in list(self._lease_waiters):
+                for item in waiter:
+                    if isinstance(item, asyncio.Future) and not item.done():
+                        item.set_exception(stale)
+            self._lease_waiters.clear()
+            self._register_waiters.clear()
+            self.bundles.clear()
+            self._bundle_totals.clear()
+            self.available = self.total.copy()
+            self.draining = False
+            self.drain_reason = ""
+            self.drain_deadline = 0.0
+            fault_point("raylet.fence_rejoin")
+            ack = await self.gcs.call(
+                "register_node", node_id=self.node_id, addr=self.addr,
+                resources=self.total.to_dict(), labels=self.labels,
+                node_name=self.node_name, _mid=mint_mid())
+            self.incarnation = int((ack or {}).get("incarnation",
+                                                   self.incarnation))
+            logger.warning("node %s rejoined as incarnation %d",
+                           self.node_id[:8], self.incarnation)
+        except Exception as e:  # noqa: BLE001 — heartbeat loop retries
+            logger.warning("fence rejoin failed (the next heartbeat "
+                           "retries): %r", e)
+        finally:
+            self._fencing = False
 
     # ------------------------------------------------- per-node agent API
     # The dashboard proxies these per node (reference: dashboard/agent.py
@@ -617,6 +746,7 @@ class Raylet:
                                 "pid": victim.pid,
                                 "policy": self.memory_monitor.policy,
                             },
+                            _mid=mint_mid(),
                         )
                     except Exception:  # noqa: BLE001
                         pass
@@ -666,7 +796,16 @@ class Raylet:
             await self.gcs.call(
                 "report_worker_death", node_id=self.node_id,
                 worker_id=h.worker_id, had_lease=lease is not None,
+                # deduped verb (a double-apply burns an actor's restart
+                # budget) + fenced: a zombie node's death reports must
+                # not restart actors the live cluster already recovered
+                _mid=mint_mid(),
+                _fence={"node_id": self.node_id,
+                        "incarnation": self.incarnation},
             )
+        except StaleNodeError:
+            asyncio.ensure_future(
+                self._on_fenced("report_worker_death rejected"))
         except Exception:
             pass
         self._pump_leases()
@@ -943,7 +1082,10 @@ class Raylet:
         self.idle.append(h)
         await self._forward_armed_faults(h)
         self._pump_leases()
-        return {"node_id": self.node_id, "session_dir": self.session_dir}
+        return {"node_id": self.node_id, "session_dir": self.session_dir,
+                # workers stamp node-originated GCS mutations with this
+                # (node_id, incarnation) fence identity
+                "incarnation": self.incarnation}
 
     async def _forward_armed_faults(self, h) -> None:
         """Hand any still-active chaos fault windows to a freshly
@@ -1759,33 +1901,7 @@ class Raylet:
         # 3 s shutdown budget at ~4 workers and orphan the rest of a
         # 100-actor fleet when the head is then hard-killed.  Includes
         # workers still mid-spawn (not yet registered).
-        from ray_tpu._private.process_utils import sigkill_tree
-
-        # identity-check zygote-forked pids (recyclable once the zygote
-        # reaps them) before bulk-killing; Popen pids are pinned zombies
-        # until we reap them, so they are safe as-is
-        live: set = set()
-        for h in list(self.workers.values()):
-            if not h.pid:
-                continue
-            if isinstance(h.proc, _ZygoteChild) and h.proc.poll() is not None:
-                continue
-            live.add(h.pid)
-        for pid, proc in self._spawned_procs.items():
-            if isinstance(proc, _ZygoteChild) and proc.poll() is not None:
-                continue
-            live.add(pid)
-        self.workers.clear()
-        self._spawned_procs.clear()
-        for pid in live:
-            sigkill_tree(pid)
-        if self._zygote_proc is not None:
-            sigkill_tree(self._zygote_proc.pid)
-            self._zygote_proc = None
-            try:
-                os.unlink(self._zygote_sock)
-            except OSError:
-                pass
+        self._kill_all_workers(include_zygote=True)
         try:
             await self.gcs.call("unregister_node", node_id=self.node_id)
         except Exception:

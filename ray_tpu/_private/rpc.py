@@ -9,19 +9,30 @@ cross-host) with length-prefixed pickled frames.  The control plane is not the
 TPU hot path — device data rides XLA collectives over ICI — so a lean Python
 transport keeps the same architecture (typed async clients with retry +
 chaos) without the protobuf toolchain.  Chaos injection is wired in from day
-one, mirroring ``RAY_testing_rpc_failure="method=N:req%:resp%"``.
+one: a deterministic **netem** layer keyed on (src node, dst node, verb)
+supporting drop / delay / duplicate, windowed arming, and one-way or
+symmetric partitions.  The legacy ``RAY_TPU_TESTING_RPC_FAILURE=
+"method=N:req%:resp%"`` spec folds into the same engine (there is exactly
+one transport-chaos mechanism), and every probabilistic decision is a pure
+function of (spec, seed, decision index) — same spec + same seed replays
+the same chaos schedule, extending the ``util/chaos.py`` determinism
+contract down to the transport.
 """
 
 from __future__ import annotations
 
 import asyncio
+import fnmatch
+import hashlib
 import itertools
+import json
 import logging
 import os
 import pickle
-import random
 import struct
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+import time
+import uuid
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import config
 
@@ -69,42 +80,283 @@ class RemoteError(RpcError):
 
 
 # ---------------------------------------------------------------------------
-# chaos injection (reference: src/ray/rpc/rpc_chaos.h:23-40, rpc_chaos.cc:33)
+# deterministic netem (reference: src/ray/rpc/rpc_chaos.h:23-40 — extended
+# from per-method probabilistic drops to a (src, dst, verb)-keyed network
+# emulator with windowed arming and a deterministic decision stream)
 # ---------------------------------------------------------------------------
 
-
-class _ChaosRule:
-    def __init__(self, method: str, max_failures: int, req_prob: float, resp_prob: float):
-        self.method = method
-        self.remaining = max_failures
-        self.req_prob = req_prob
-        self.resp_prob = resp_prob
+NETEM_ACTIONS = ("drop", "delay", "dup")
 
 
-def _parse_chaos(spec: str) -> Dict[str, _ChaosRule]:
-    rules: Dict[str, _ChaosRule] = {}
-    for part in filter(None, (p.strip() for p in spec.split(","))):
-        method, rest = part.split("=", 1)
-        n, req, resp = rest.split(":")
-        rules[method] = _ChaosRule(method, int(n), float(req), float(resp))
+def mint_mid() -> str:
+    """Mint a client-side request id for at-most-once GCS mutations."""
+    return uuid.uuid4().hex
+
+
+def _match_endpoint(pattern: str, node: str) -> bool:
+    # "*" matches anything; otherwise exact node id or an id prefix (node
+    # ids are long hex strings; specs may abbreviate)
+    return pattern == "*" or node == pattern or node.startswith(pattern)
+
+
+def normalize_netem_rule(rule: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill defaults and validate one netem rule.
+
+    Canonical form (a pure function of the input — the determinism
+    contract hashes the normalized rules, so normalization must not
+    consult clocks or randomness)::
+
+        {src, dst, verb, phase, action, delay_s, prob, start_s,
+         duration_s, n}
+    """
+    action = rule.get("action", "drop")
+    if action not in NETEM_ACTIONS:
+        raise ValueError(f"bad netem action: {action!r}")
+    phase = rule.get("phase", "*")
+    if phase not in ("req", "resp", "*"):
+        raise ValueError(f"bad netem phase: {phase!r}")
+    dur = rule.get("duration_s")
+    return {
+        "src": str(rule.get("src", "*")),
+        "dst": str(rule.get("dst", "*")),
+        "verb": str(rule.get("verb", "*")),
+        "phase": phase,
+        "action": action,
+        "delay_s": float(rule.get("delay_s", 0.0)),
+        "prob": float(rule.get("prob", 1.0)),
+        "start_s": float(rule.get("start_s", 0.0)),
+        "duration_s": None if dur is None else float(dur),
+        "n": None if rule.get("n") is None else int(rule["n"]),
+    }
+
+
+def parse_netem(spec: str) -> List[Dict[str, Any]]:
+    """Parse the compact netem grammar into a rule list.
+
+    ``spec`` is ``;``-separated rules of the form::
+
+        src>dst:verb:action[:param...]
+
+    where ``src``/``dst`` are node ids (or prefixes), ``gcs``, or ``*``;
+    ``src<>dst`` expands into the two directed rules of a symmetric link;
+    ``verb`` is an fnmatch glob over RPC method names; ``action`` is
+    ``drop``, ``dup`` or ``delay=<seconds>``; and params are ``p=<prob>``,
+    ``at=<start_s>``, ``for=<duration_s>``, ``n=<count>``,
+    ``phase=req|resp|*``.
+
+    Example — drop every frame between node ``ab12`` and the GCS for 10s
+    starting 2s after arming, and delay 30%% of lease replies by 250ms::
+
+        ab12<>gcs:*:drop:at=2:for=10;*>*:request_lease:delay=0.25:p=0.3:phase=resp
+    """
+    rules: List[Dict[str, Any]] = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        fields = part.split(":")
+        if len(fields) < 3:
+            raise ValueError(f"bad netem rule (need src>dst:verb:action): {part!r}")
+        link, verb, action = fields[0], fields[1], fields[2]
+        symmetric = "<>" in link
+        src, _, dst = link.partition("<>" if symmetric else ">")
+        if not src or not dst:
+            raise ValueError(f"bad netem link (need src>dst or src<>dst): {link!r}")
+        rule: Dict[str, Any] = {"src": src, "dst": dst, "verb": verb}
+        if action.startswith("delay="):
+            rule["action"] = "delay"
+            rule["delay_s"] = float(action[len("delay="):])
+        else:
+            rule["action"] = action
+        for param in fields[3:]:
+            key, _, val = param.partition("=")
+            if key == "p":
+                rule["prob"] = float(val)
+            elif key == "at":
+                rule["start_s"] = float(val)
+            elif key == "for":
+                rule["duration_s"] = float(val)
+            elif key == "n":
+                rule["n"] = int(val)
+            elif key == "phase":
+                rule["phase"] = val
+            else:
+                raise ValueError(f"bad netem param: {param!r}")
+        rules.append(normalize_netem_rule(rule))
+        if symmetric:
+            mirror = dict(rules[-1], src=rules[-1]["dst"], dst=rules[-1]["src"])
+            rules.append(mirror)
     return rules
 
 
-class ChaosInjector:
-    def __init__(self):
-        spec = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE", config.testing_rpc_failure)
-        self._rules = _parse_chaos(spec) if spec else {}
+def partition_rules(a: str, b: str, mode: str = "symmetric",
+                    start_s: float = 0.0,
+                    duration_s: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Build the rule set for a network partition between endpoints.
 
-    def should_drop(self, method: str, phase: str) -> bool:
-        rule = self._rules.get(method)
-        if rule is None or rule.remaining <= 0:
-            return False
-        prob = rule.req_prob if phase == "req" else rule.resp_prob
-        if random.random() < prob:
-            rule.remaining -= 1
-            logger.warning("chaos: dropping %s %s", phase, method)
-            return True
-        return False
+    Netem decisions run at the *receiving* server, so "frames traveling
+    x→y are lost" decomposes into two rules: x's requests never reach y
+    (req phase, keyed src=x dst=y) and y's replies to x's in-flight
+    requests never travel back... no — replies *produced by x for y*
+    travel x→y, and their decision key is the originating request's
+    (src=y, dst=x) at x's server, resp phase.
+
+    Modes: ``symmetric`` cuts both directions; ``oneway`` cuts only
+    frames flowing a→b (b still reaches a — the asymmetric "b cannot
+    hear a" split).
+    """
+    def drop_dir(x: str, y: str) -> List[Dict[str, Any]]:
+        # frames x→y lost = x's requests (req phase at y) + x's replies
+        # to y's requests (resp phase at x, keyed by the request's src=y)
+        return [
+            normalize_netem_rule({"src": x, "dst": y, "verb": "*",
+                                  "phase": "req", "action": "drop",
+                                  "start_s": start_s, "duration_s": duration_s}),
+            normalize_netem_rule({"src": y, "dst": x, "verb": "*",
+                                  "phase": "resp", "action": "drop",
+                                  "start_s": start_s, "duration_s": duration_s}),
+        ]
+
+    if mode == "symmetric":
+        return drop_dir(a, b) + drop_dir(b, a)
+    if mode == "oneway":
+        return drop_dir(a, b)
+    raise ValueError(f"bad partition mode: {mode!r}")
+
+
+def _legacy_rules(spec: str) -> List[Dict[str, Any]]:
+    """Fold ``method=N:req_prob:resp_prob,...`` specs into netem rules.
+
+    The req and resp rules of one method share a single N-failure budget,
+    preserving the reference ``rpc_chaos.h`` semantics.
+    """
+    rules: List[Dict[str, Any]] = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        method, rest = part.split("=", 1)
+        n, req, resp = rest.split(":")
+        budget = {"remaining": int(n)}
+        for phase, prob in (("req", float(req)), ("resp", float(resp))):
+            if prob <= 0:
+                continue
+            rule = normalize_netem_rule({"verb": method, "phase": phase,
+                                         "action": "drop", "prob": prob,
+                                         "n": int(n)})
+            rule["_budget"] = budget
+            rules.append(rule)
+    return rules
+
+
+def _decision(digest: str, idx: int) -> float:
+    """The idx-th uniform [0,1) draw of the chaos stream — a pure function
+    of (spec digest, decision index), so same spec + seed replays exactly."""
+    raw = hashlib.sha256(f"{digest}|{idx}".encode()).digest()
+    return int.from_bytes(raw[:8], "big") / 2.0**64
+
+
+class Netem:
+    """Per-server deterministic network emulator.
+
+    Owned by each :class:`RpcServer` (NOT process-global: the head raylet
+    is embedded in the GCS process, so endpoint identity must live on the
+    server).  Rules match on (src node, dst node, verb, phase); actions
+    are drop / delay / dup; windows (``start_s``/``duration_s``) are
+    relative to the install epoch, so both ends of a link can be armed
+    *before* the window opens and still cut over at the same instant.
+    """
+
+    def __init__(self, node_id: str = "?"):
+        self.node_id = node_id
+        self._rules: List[Dict[str, Any]] = []
+        self._digest = ""
+        self._epoch = 0.0
+        self._idx = 0
+        legacy = os.environ.get("RAY_TPU_TESTING_RPC_FAILURE",
+                                config.testing_rpc_failure)
+        keyed = os.environ.get("RAY_TPU_NETEM", config.netem)
+        rules: List[Dict[str, Any]] = []
+        if legacy:
+            rules.extend(_legacy_rules(legacy))
+        if keyed:
+            rules.extend(parse_netem(keyed))
+        if rules:
+            seed = f"{config.testing_rpc_seed}|{config.netem_seed}"
+            self.install(rules, seed=seed)
+
+    @property
+    def active(self) -> bool:
+        return bool(self._rules)
+
+    def install(self, rules: List[Dict[str, Any]], seed: Any = 0,
+                epoch: Optional[float] = None):
+        """Replace the rule set; resets the decision stream.
+
+        ``epoch`` anchors rule windows (absolute ``time.time()``); pass a
+        future instant to arm both ends of a link race-free.  An empty
+        ``rules`` list clears the emulator.
+        """
+        normalized = []
+        for r in rules:
+            budget = r.get("_budget")
+            rule = normalize_netem_rule(r)
+            if budget is not None:
+                rule["_budget"] = budget
+            elif rule["n"] is not None:
+                rule["_budget"] = {"remaining": rule["n"]}
+            rule.setdefault("_budget", None)
+            rule["_hits"] = 0
+            normalized.append(rule)
+        self._rules = normalized
+        self._digest = hashlib.sha256(
+            (json.dumps(self.schedule(), sort_keys=True)
+             + f"|seed={seed}").encode()).hexdigest()
+        self._epoch = time.time() if epoch is None else epoch
+        self._idx = 0
+
+    def clear(self):
+        self.install([])
+
+    def schedule(self) -> List[Dict[str, Any]]:
+        """The armed schedule in canonical form — a pure function of
+        (spec, seed); the determinism contract test compares its bytes."""
+        return [{k: v for k, v in r.items() if not k.startswith("_")}
+                for r in self._rules]
+
+    def apply(self, src: str, dst: str, verb: str,
+              phase: str) -> Optional[Dict[str, Any]]:
+        """Return the matching rule to apply to this frame, or None.
+
+        First active matching rule wins; each probabilistic check consumes
+        one index of the deterministic decision stream."""
+        if not self._rules:
+            return None
+        now = time.time() - self._epoch
+        for rule in self._rules:
+            if rule["phase"] not in ("*", phase):
+                continue
+            if not _match_endpoint(rule["src"], src):
+                continue
+            if not _match_endpoint(rule["dst"], dst):
+                continue
+            if not fnmatch.fnmatchcase(verb, rule["verb"]):
+                continue
+            if now < rule["start_s"]:
+                continue
+            dur = rule["duration_s"]
+            if dur is not None and now >= rule["start_s"] + dur:
+                continue
+            budget = rule["_budget"]
+            if budget is not None and budget["remaining"] <= 0:
+                continue
+            if rule["prob"] < 1.0:
+                idx = self._idx
+                self._idx += 1
+                if _decision(self._digest, idx) >= rule["prob"]:
+                    continue
+            if budget is not None:
+                budget["remaining"] -= 1
+            rule["_hits"] += 1
+            log = logger.warning if rule["_hits"] == 1 else logger.debug
+            log("netem[%s]: %s %s-phase %s→%s %s", self.node_id,
+                rule["action"], phase, src, dst, verb)
+            return rule
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -142,11 +394,14 @@ class RpcServer:
     used by pubsub like the reference's ``src/ray/pubsub/``).
     """
 
-    def __init__(self, name: str = "server"):
+    def __init__(self, name: str = "server", node_id: Optional[str] = None):
         self.name = name
+        # the netem endpoint identity of this server ("gcs" for the GCS,
+        # the node id for raylets); falls back to the server name
+        self.node_id = node_id or name
         self._handlers: Dict[str, Handler] = {}
         self._servers = []
-        self._chaos = ChaosInjector()
+        self._netem = Netem(self.node_id)
         self._conn_tasks: set = set()
 
     def register(self, method: str, handler: Handler):
@@ -191,8 +446,21 @@ class RpcServer:
     async def _dispatch(self, msg: Dict, writer: asyncio.StreamWriter):
         method = msg.get("method", "")
         req_id = msg.get("req_id")
-        if self._chaos.should_drop(method, "req"):
-            return
+        src = msg.get("src", "?")
+        netem = self._netem
+        if netem.active and not msg.get("_netem_dup"):
+            act = netem.apply(src, self.node_id, method, "req")
+            if act is not None:
+                if act["action"] == "drop":
+                    return  # silent loss: the caller's timeout is its problem
+                if act["action"] == "delay":
+                    await asyncio.sleep(act["delay_s"])
+                elif act["action"] == "dup":
+                    # re-deliver the same frame once (the guard flag keeps
+                    # the duplicate from re-rolling netem and cascading)
+                    dup = dict(msg)
+                    dup["_netem_dup"] = True
+                    asyncio.ensure_future(self._dispatch(dup, writer))
         handler = self._handlers.get(method)
         reply: Dict[str, Any]
         if handler is None:
@@ -206,10 +474,20 @@ class RpcServer:
                 reply = {"req_id": req_id, "ok": False, "error": e}
         if req_id is None:  # one-way message
             return
-        if self._chaos.should_drop(method, "resp"):
-            return
+        dup_reply = False
+        if netem.active:
+            act = netem.apply(src, self.node_id, method, "resp")
+            if act is not None:
+                if act["action"] == "drop":
+                    return
+                if act["action"] == "delay":
+                    await asyncio.sleep(act["delay_s"])
+                elif act["action"] == "dup":
+                    dup_reply = True
         try:
             write_frame(writer, reply)
+            if dup_reply:
+                write_frame(writer, reply)
             await writer.drain()
         except (ConnectionResetError, RuntimeError, BrokenPipeError):
             pass
@@ -244,10 +522,16 @@ class RpcClient:
 
     _ids = itertools.count(1)
 
-    def __init__(self, addr: str, name: str = "client"):
+    def __init__(self, addr: str, name: str = "client",
+                 src_id: Optional[str] = None):
         # addr: "unix:/path" or "tcp:host:port"
         self.addr = addr
         self.name = name
+        # netem source identity stamped into every frame ("gcs" for the
+        # GCS's own clients, the node id for raylet/worker clients);
+        # settable after construction for callers that learn their node
+        # id late (workers discover it from the raylet handshake)
+        self.src_id = src_id
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -328,9 +612,17 @@ class RpcClient:
             try:
                 return await self._call_once(method, timeout, kwargs)
             except RpcDisconnectedError:
-                # mid-call loss: the request may have executed — surface to the
-                # caller, which knows whether the call is idempotent
-                raise
+                # mid-call loss: the request may have executed — surface to
+                # the caller, which knows whether the call is idempotent.
+                # EXCEPT when the caller minted a dedup id (``_mid``): the
+                # server's at-most-once reply cache makes a resend safe (a
+                # duplicate replays the first reply instead of re-applying
+                # the mutation), so retry here.
+                if kwargs.get("_mid") is None or self._closed or retries <= 0:
+                    raise
+                retries -= 1
+                self._writer = None
+                await asyncio.sleep(config.rpc_retry_delay_ms / 1000.0)
             except RpcConnectionError:
                 if self._closed or retries <= 0:
                     raise
@@ -349,11 +641,14 @@ class RpcClient:
         # (single loop thread; write_frame is synchronous buffering and
         # drain only suspends under backpressure), skipping two task
         # switches per call
+        frame = {"method": method, "req_id": None, "kwargs": kwargs,
+                 "src": self.src_id or self.name}
         if self._connected():
             req_id = next(self._ids)
             fut: asyncio.Future = asyncio.get_event_loop().create_future()
             self._pending[req_id] = fut
-            write_frame(self._writer, {"method": method, "req_id": req_id, "kwargs": kwargs})
+            frame["req_id"] = req_id
+            write_frame(self._writer, frame)
             await self._writer.drain()
         else:
             async with self._lock:
@@ -361,7 +656,8 @@ class RpcClient:
                 req_id = next(self._ids)
                 fut = asyncio.get_event_loop().create_future()
                 self._pending[req_id] = fut
-                write_frame(self._writer, {"method": method, "req_id": req_id, "kwargs": kwargs})
+                frame["req_id"] = req_id
+                write_frame(self._writer, frame)
                 await self._writer.drain()
         reply = (await asyncio.wait_for(fut, timeout)
                  if timeout is not None else await fut)
@@ -374,7 +670,9 @@ class RpcClient:
         """One-way message (no reply expected)."""
         async with self._lock:
             await self._connect()
-            write_frame(self._writer, {"method": method, "req_id": None, "kwargs": kwargs})
+            write_frame(self._writer, {"method": method, "req_id": None,
+                                       "kwargs": kwargs,
+                                       "src": self.src_id or self.name})
             await self._writer.drain()
 
     async def close(self):

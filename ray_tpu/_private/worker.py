@@ -152,6 +152,11 @@ class CoreWorker:
         self.mode = mode
         self.session_dir = session_dir
         self.node_id = node_id
+        # the hosting node's cluster-epoch incarnation, learned from the
+        # raylet's register_worker reply (0 = not yet known / driver):
+        # stamped as ``_fence`` on node-originated GCS mutations so a
+        # fenced zombie node's workers cannot write state either
+        self.node_incarnation = 0
         self.job_id = job_id
         self.worker_id = worker_id or WorkerID.from_random()
 
@@ -178,8 +183,8 @@ class CoreWorker:
         # every 50ms poll restarted cross-node pulls from scratch)
         self._wait_fetch_tasks: Dict[ObjectID, "asyncio.Task"] = {}
 
-        self.gcs = RpcClient(gcs_addr, "gcs-client")
-        self.raylet = RpcClient(raylet_addr, "raylet-client")
+        self.gcs = RpcClient(gcs_addr, "gcs-client", src_id=node_id)
+        self.raylet = RpcClient(raylet_addr, "raylet-client", src_id=node_id)
         self._peer_clients: Dict[str, RpcClient] = {}
 
         self._leases: Dict[Tuple, _LeasePool] = {}
@@ -257,6 +262,16 @@ class CoreWorker:
         self._shutdown = False
 
         self.server.register_all(self)
+
+    def _fence_stamp(self) -> Optional[Dict[str, Any]]:
+        """The (node_id, incarnation) identity stamped on node-originated
+        GCS mutations; None while the incarnation is unknown (drivers,
+        pre-registration) — the GCS skips the fence check for unstamped
+        calls rather than rejecting every legacy caller."""
+        if not self.node_incarnation:
+            return None
+        return {"node_id": self.node_id,
+                "incarnation": self.node_incarnation}
 
     # ------------------------------------------------------------------ setup
 
@@ -2077,6 +2092,7 @@ class CoreWorker:
             await self.gcs.call(
                 "report_actor_failed", actor_id=spec.actor_id.binary(),
                 error=serialization.dumps(result),
+                _fence=self._fence_stamp(),
             )
             return self._package_returns(spec, False, result)
         self.actor_instance = result
@@ -2086,6 +2102,7 @@ class CoreWorker:
             addr=self.serve_addr,
             node_id=self.node_id,
             worker_id=self.worker_id.binary(),
+            _fence=self._fence_stamp(),
         )
         return self._package_returns(spec, True, None)
 

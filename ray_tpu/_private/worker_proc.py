@@ -68,7 +68,10 @@ def main():
             pid=os.getpid(),
         )
 
-    core.run_coro(_register(), timeout=30)
+    ack = core.run_coro(_register(), timeout=30)
+    # the node's cluster-epoch incarnation: stamped on this worker's GCS
+    # mutations so a fenced zombie node's workers are rejected too
+    core.node_incarnation = int((ack or {}).get("incarnation", 0))
     # park the main thread; all work happens on the IO loop + executors
     try:
         while True:

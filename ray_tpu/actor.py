@@ -13,7 +13,7 @@ from __future__ import annotations
 import inspect
 from typing import Any, Dict, Optional
 
-from ray_tpu._private import api_utils, serialization
+from ray_tpu._private import api_utils, rpc, serialization
 from ray_tpu._private.ids import ActorID
 from ray_tpu._private.task_spec import FunctionDescriptor, TaskSpec, TaskType
 from ray_tpu.exceptions import ActorDiedError
@@ -268,7 +268,11 @@ class ActorClass:
                 f"{self._cls.__qualname__}.__init__"),
         )
         worker.run_coro(
-            worker.gcs.call("create_actor", spec_bytes=serialization.dumps(spec))
+            # deduped verb: the _mid makes a transport retry of a lost
+            # reply replay the registration instead of double-scheduling
+            worker.gcs.call("create_actor",
+                            spec_bytes=serialization.dumps(spec),
+                            _mid=rpc.mint_mid())
         )
         creation_refs = ([a.payload for a in task_args if a.is_ref]
                          + list(nested_refs))

@@ -22,8 +22,20 @@ def routes(gcs, helpers):
         return gcs._raylet(node_id)
 
     async def api_cluster(_req):
+        from ray_tpu.util.state import ZOMBIE_STALE_SWEEP_S
+
         nodes = []
+        now = time.time()
+        fenced_count = zombie_count = 0
         for nid, n in gcs.nodes.items():
+            fence = int(n.get("fence", 0) or 0)
+            fenced = fence > 0 and int(n.get("incarnation", 0) or 0) <= fence
+            last_stale = n.get("last_stale_contact")
+            zombie = bool(n.get("stale_contacts")
+                          and last_stale is not None
+                          and now - last_stale < ZOMBIE_STALE_SWEEP_S)
+            fenced_count += fenced
+            zombie_count += zombie
             nodes.append({"node_id": nid,
                           "state": n.get("state",
                                          "ALIVE" if n.get("alive")
@@ -32,6 +44,13 @@ def routes(gcs, helpers):
                           "health_reason": n.get("health_reason", ""),
                           "drain_reason": n.get("drain_reason"),
                           "drain_deadline": n.get("drain_deadline"),
+                          "incarnation": n.get("incarnation", 0),
+                          "fence": fence,
+                          "fenced": fenced,
+                          # a zombie is a dead-declared incarnation that
+                          # recently contacted the GCS and got fenced off
+                          "zombie": zombie,
+                          "stale_contacts": n.get("stale_contacts", 0),
                           "addr": n.get("addr", ""),
                           "resources": n.get("total", {}),
                           "available": n.get("available", {}),
@@ -41,7 +60,10 @@ def routes(gcs, helpers):
         total = await gcs.handle_cluster_resources()
         avail = await gcs.handle_available_resources()
         return jresp({"nodes": nodes, "resources_total": total,
-                      "resources_available": avail, "ts": time.time()})
+                      "resources_available": avail,
+                      "fencing": {"fenced": fenced_count,
+                                  "zombies": zombie_count},
+                      "ts": time.time()})
 
     async def api_node_stats(req):
         """Per-node agent stats (reference dashboard/agent.py): cpu%,

@@ -18,6 +18,35 @@ class RpcChaosError(RayTpuError):
     pass
 
 
+class StaleNodeError(RayTpuError):
+    """A GCS mutation arrived from a fenced (dead-declared) node incarnation.
+
+    The GCS mints a monotonic per-node ``incarnation`` at registration and
+    bumps a ``fence`` when it declares the node dead (heartbeat timeout,
+    drain-deadline expiry, health quarantine-final).  Any state-mutating
+    verb carrying an incarnation at or below the fence is rejected with
+    this error instead of being applied — a partition-then-heal zombie can
+    therefore never write into gang/drain/actor state machines it no
+    longer owns.  The zombie raylet reacts by killing its workers,
+    releasing leases, and re-registering as a fresh incarnation.
+    """
+
+    def __init__(self, node_id: str = "", incarnation: int = 0,
+                 current: int = 0, fence: int = 0):
+        self.node_id = node_id
+        self.incarnation = incarnation
+        self.current = current
+        self.fence = fence
+        super().__init__(
+            f"node {node_id!r} incarnation {incarnation} is fenced "
+            f"(current incarnation {current}, fence {fence}); the caller "
+            f"was declared dead and must rejoin as a new incarnation")
+
+    def __reduce__(self):
+        return (type(self), (self.node_id, self.incarnation,
+                             self.current, self.fence))
+
+
 class TaskError(RayTpuError):
     """A task raised an exception; re-raised at ``get`` with the remote trace.
 

@@ -12,7 +12,7 @@ import enum
 import time
 from typing import Any, Dict, List, Optional
 
-from ray_tpu._private.rpc import RpcClient, run_sync
+from ray_tpu._private.rpc import RpcClient, mint_mid, run_sync
 
 
 class JobStatus(str, enum.Enum):
@@ -45,9 +45,11 @@ class JobSubmissionClient:
                    runtime_env: Optional[Dict[str, Any]] = None,
                    metadata: Optional[Dict[str, str]] = None,
                    submission_id: Optional[str] = None) -> str:
+        # deduped verb: a transport retry of a lost reply returns the
+        # first submission id instead of launching the driver twice
         return self._call("submit_job", entrypoint=entrypoint,
                           runtime_env=runtime_env, metadata=metadata,
-                          submission_id=submission_id)
+                          submission_id=submission_id, _mid=mint_mid())
 
     def get_job_status(self, submission_id: str) -> JobStatus:
         info = self._call("job_status", submission_id=submission_id)

@@ -74,8 +74,12 @@ def cmd_stop(args):
 
 def cmd_status(args):
     ray_tpu = _connect(args.address or _default_address())
+    from ray_tpu.util.state import list_nodes
+
+    nodes = list_nodes()
     print("Nodes:")
-    for n in ray_tpu.nodes():
+    fenced = zombies = 0
+    for n in nodes:
         mark = n.get("state", "ALIVE" if n["alive"] else "DEAD")
         extra = ""
         if mark == "DRAINING":
@@ -87,8 +91,19 @@ def cmd_status(args):
         health = n.get("health", "HEALTHY")
         if health != "HEALTHY":
             extra += f" health={health}"
+        if n.get("fenced"):
+            fenced += 1
+            extra += " fenced"
+        if n.get("zombie"):
+            zombies += 1
+            extra += " ZOMBIE"
         print(f"  {n['node_id'][:12]} [{mark}] {n['addr']} "
+              f"inc={n.get('incarnation', 0)} "
               f"total={n['total']}{extra}")
+    if fenced or zombies:
+        # a zombie is a dead-declared incarnation still contacting the
+        # GCS — fenced off, but worth a human look (split-brain debris)
+        print(f"Fencing: {fenced} fenced, {zombies} zombie")
     print("Cluster resources:", ray_tpu.cluster_resources())
     print("Available:", ray_tpu.available_resources())
     try:

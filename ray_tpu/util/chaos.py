@@ -143,10 +143,51 @@ def _degrade_node_action(ev: Dict[str, Any], rng: random.Random) -> Any:
             "armed": armed}
 
 
+def _partition_nodes_action(ev: Dict[str, Any], rng: random.Random) -> Any:
+    """Built-in ``partition_nodes`` action: cut one node off the network
+    for a window — the split-brain rehearsal the cluster-epoch fence
+    exists to survive.  Builds drop rules for the victim↔GCS link
+    (``ev["mode"]``: ``symmetric`` default, or ``oneway`` — the GCS
+    cannot hear the victim but the victim still hears the GCS) and arms
+    them everywhere through the GCS ``arm_netem`` fan-out with a shared
+    future epoch, so both ends cut over at the same instant.  The victim
+    is ``ev["node"]`` when named, else drawn deterministically from
+    ``rng`` over the sorted alive nodes minus ``ev["exclude"]``; the
+    netem seed is likewise drawn from ``rng``, so the same
+    ``(spec, seed)`` produces a byte-identical chaos schedule."""
+    from ray_tpu._private.rpc import partition_rules
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    nodes = worker.run_coro(worker.gcs.call("get_all_nodes"))
+    exclude = set(ev.get("exclude") or ())
+    candidates = sorted(n["node_id"] for n in nodes
+                        if n.get("alive") and n["node_id"] not in exclude)
+    netem_seed = rng.randrange(1 << 30)  # drawn before the early return:
+    # the rng stream consumed per event stays fixed even on a no-op fire
+    if not candidates:
+        return {"node": None, "armed": {}}
+    target = ev.get("node")
+    if target is None:
+        target = candidates[rng.randrange(len(candidates))]
+    mode = ev.get("mode", "symmetric")
+    duration = float(ev.get("duration", 5.0))
+    lead_s = float(ev.get("lead_s", 0.5))
+    rules = partition_rules(target, ev.get("peer", "gcs"), mode=mode,
+                            duration_s=duration)
+    ack = worker.run_coro(worker.gcs.call(
+        "arm_netem", rules=rules, seed=netem_seed, lead_s=lead_s,
+        timeout=10.0))
+    return {"node": target, "mode": mode, "duration_s": duration,
+            "seed": netem_seed, "armed": (ack or {}).get("armed", {}),
+            "epoch": (ack or {}).get("epoch")}
+
+
 #: actions available without caller registration (overridable)
 BUILTIN_ACTIONS: Dict[str, ActionFn] = {
     "preempt_slice": _preempt_slice_action,
     "degrade_node": _degrade_node_action,
+    "partition_nodes": _partition_nodes_action,
 }
 
 

@@ -82,7 +82,9 @@ class ClientServer:
 
     async def handle_client_connect(self, session: str) -> Dict[str, Any]:
         self._sessions[session] = _Session(session)
-        job_no = await self._worker.gcs.call("next_job_id")
+        from ray_tpu._private.rpc import mint_mid
+
+        job_no = await self._worker.gcs.call("next_job_id", _mid=mint_mid())
         await self._worker.gcs.call(
             "add_job", job_id=job_no,
             info={"driver": f"ray_tpu_client:{session[:8]}"})

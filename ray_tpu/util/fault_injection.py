@@ -63,6 +63,8 @@ site                        guards
 ``slice.provision``         the slice provider's create_node edge
 ``health.probe``            the health plane's active-probe dispatch edge
 ``health.quarantine``       the health plane's quarantine actuation edge
+``gcs.mutation_dedup``      a deduped GCS mutation, after the cache miss
+``raylet.fence_rejoin``     the fenced raylet's re-register, post-cleanup
 ==========================  =================================================
 
 Three kinds are special:

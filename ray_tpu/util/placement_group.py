@@ -95,11 +95,16 @@ def placement_group(
     from ray_tpu._private.worker import get_global_worker
 
     worker = get_global_worker()
+    from ray_tpu._private.rpc import mint_mid
+
     pg_id_bytes = worker.run_coro(
+        # deduped verb (the GCS mints the pg id): a transport retry of a
+        # lost reply replays the first grant instead of minting a twin PG
         worker.gcs.call("create_placement_group", bundles=bundles, strategy=strategy,
                         name=name, lifetime=lifetime, priority=int(priority),
                         restartable=bool(restartable),
-                        job_id=worker.job_id.int_value())
+                        job_id=worker.job_id.int_value(),
+                        _mid=mint_mid())
     )
     return PlacementGroup(PlacementGroupID(pg_id_bytes), bundles)
 

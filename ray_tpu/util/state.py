@@ -13,14 +13,32 @@ def _worker():
     return get_global_worker()
 
 
+#: a dead incarnation that stops knocking for this long is presumed
+#: really gone — its zombie flag ages out of the state/CLI/dashboard
+#: views instead of alarming forever (the fence itself never expires)
+ZOMBIE_STALE_SWEEP_S = 600.0
+
+
 def list_nodes() -> List[Dict[str, Any]]:
-    """Node table incl. the drain state machine: each node carries
-    ``state`` (ALIVE | DRAINING | DEAD) and, while DRAINING, the
-    ``drain_reason`` / ``drain_deadline`` of the advance notice."""
+    """Node table incl. the drain state machine and the cluster-epoch
+    fence: each node carries ``state`` (ALIVE | DRAINING | DEAD), the
+    ``drain_reason`` / ``drain_deadline`` while DRAINING, its
+    ``incarnation`` / ``fence`` epochs, plus two derived flags —
+    ``fenced`` (a death fence is standing against this node's last
+    known incarnation) and ``zombie`` (a fenced-out incarnation
+    contacted the GCS within the last ``ZOMBIE_STALE_SWEEP_S``
+    seconds, i.e. a dead-declared node is still out there talking)."""
     w = _worker()
     out = w.run_coro(w.gcs.call("get_all_nodes"))
+    now = time.time()
     for n in out:
         n.setdefault("state", "ALIVE" if n.get("alive") else "DEAD")
+        fence = int(n.get("fence", 0) or 0)
+        n["fenced"] = fence > 0 and int(n.get("incarnation", 0) or 0) <= fence
+        last = n.get("last_stale_contact")
+        n["zombie"] = bool(
+            n.get("stale_contacts")
+            and last is not None and now - last < ZOMBIE_STALE_SWEEP_S)
     return out
 
 

@@ -1,0 +1,525 @@
+"""Partition-tolerance tier: the netem matrix, fencing, and at-most-once.
+
+Four layers, mirroring the PR's three planes plus their composition:
+
+1. **Netem unit** — the compact grammar, rule normalization, the
+   partition rule builder, legacy-spec folding, and the determinism
+   contract (same spec + seed ⇒ byte-identical schedule AND an
+   identically-replayed decision stream).
+2. **Live RPC legs** — an in-process ``RpcServer``/``RpcClient`` pair
+   under drop / delay / dup rules: the req-phase loss surfaces as the
+   caller's timeout, a resp-phase loss loses the reply AFTER the
+   mutation applied (the hazard ``_mid`` exists for), and a duplicated
+   frame re-runs the handler exactly once (the ``_netem_dup`` guard).
+3. **At-most-once GCS mutations** — a retry carrying the same ``_mid``
+   replays the cached reply; a fresh ``_mid`` re-executes; a FAILED
+   apply is never cached (the retry runs for real).
+4. **Cluster-epoch fencing** — fence lifecycle on the GCS tables, stale
+   heartbeats, fenced mutations raising ``StaleNodeError`` end-to-end
+   over RPC, the superseded-incarnation split-brain guard, and the full
+   partition → death → heal → fence → rejoin loop on live raylets.
+
+The cluster legs manage their own in-process servers (the test drives
+partitions and node death), so this file must NOT use the shared
+session cluster.
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+import pytest
+
+from ray_tpu._private.rpc import (
+    Netem,
+    RpcClient,
+    RpcServer,
+    _decision,
+    _legacy_rules,
+    mint_mid,
+    normalize_netem_rule,
+    parse_netem,
+    partition_rules,
+)
+from ray_tpu.exceptions import StaleNodeError
+from ray_tpu.util import fault_injection as fi
+
+
+# ---------------------------------------------------------------------------
+# netem unit: grammar, builders, determinism
+# ---------------------------------------------------------------------------
+
+
+def test_parse_netem_grammar():
+    rules = parse_netem(
+        "ab12<>gcs:*:drop:at=2:for=10;"
+        "*>*:request_lease:delay=0.25:p=0.3:phase=resp;"
+        "n1>n2:heartbeat:dup:n=3")
+    assert len(rules) == 4  # <> expands into the two directed rules
+    cut_ab, cut_ba = rules[0], rules[1]
+    assert (cut_ab["src"], cut_ab["dst"]) == ("ab12", "gcs")
+    assert (cut_ba["src"], cut_ba["dst"]) == ("gcs", "ab12")
+    for r in (cut_ab, cut_ba):
+        assert r["action"] == "drop"
+        assert r["start_s"] == 2.0 and r["duration_s"] == 10.0
+    delay = rules[2]
+    assert delay["action"] == "delay" and delay["delay_s"] == 0.25
+    assert delay["prob"] == 0.3 and delay["phase"] == "resp"
+    assert delay["verb"] == "request_lease"
+    dup = rules[3]
+    assert dup["action"] == "dup" and dup["n"] == 3
+    # empty segments are skipped, not errors
+    assert parse_netem("; ;") == []
+
+
+def test_netem_grammar_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_netem("a>b:drop")  # need src>dst:verb:action
+    with pytest.raises(ValueError):
+        parse_netem(">b:*:drop")  # empty endpoint
+    with pytest.raises(ValueError):
+        parse_netem("a>b:*:teleport")  # unknown action
+    with pytest.raises(ValueError):
+        normalize_netem_rule({"action": "drop", "phase": "both"})
+
+
+def test_partition_rules_modes():
+    # frames x→y are decided at the RECEIVER: a oneway a→b cut is a's
+    # requests (req phase at b) plus a's replies to b (resp phase at a)
+    oneway = partition_rules("a", "b", mode="oneway", duration_s=5.0)
+    assert len(oneway) == 2
+    req, resp = oneway
+    assert (req["src"], req["dst"], req["phase"]) == ("a", "b", "req")
+    assert (resp["src"], resp["dst"], resp["phase"]) == ("b", "a", "resp")
+    assert all(r["action"] == "drop" and r["duration_s"] == 5.0
+               for r in oneway)
+    sym = partition_rules("a", "b", mode="symmetric")
+    assert len(sym) == 4
+    # symmetric = closed under swapping the link direction
+    links = {(r["src"], r["dst"], r["phase"]) for r in sym}
+    assert links == {("a", "b", "req"), ("b", "a", "resp"),
+                     ("b", "a", "req"), ("a", "b", "resp")}
+    with pytest.raises(ValueError):
+        partition_rules("a", "b", mode="diagonal")
+
+
+def test_netem_schedule_and_decision_stream_deterministic():
+    """The acceptance contract: same spec + seed ⇒ byte-identical armed
+    schedule and an identically-replayed probabilistic decision stream."""
+    spec = "cli>srv:echo:drop:p=0.5;*>*:lease:delay=0.1:p=0.25:phase=resp"
+    n1, n2 = Netem("srv"), Netem("srv")
+    n1.install(parse_netem(spec), seed=1234, epoch=time.time() - 1.0)
+    n2.install(parse_netem(spec), seed=1234, epoch=time.time() - 1.0)
+    assert (json.dumps(n1.schedule(), sort_keys=True)
+            == json.dumps(n2.schedule(), sort_keys=True))
+    assert n1._digest == n2._digest
+    stream1 = [n1.apply("cli", "srv", "echo", "req") is not None
+               for _ in range(64)]
+    stream2 = [n2.apply("cli", "srv", "echo", "req") is not None
+               for _ in range(64)]
+    assert stream1 == stream2
+    assert any(stream1) and not all(stream1)  # p=0.5 actually rolls
+    # a different seed produces a different digest and a divergent stream
+    n3 = Netem("srv")
+    n3.install(parse_netem(spec), seed=99, epoch=time.time() - 1.0)
+    assert n3._digest != n1._digest
+    stream3 = [n3.apply("cli", "srv", "echo", "req") is not None
+               for _ in range(64)]
+    assert stream3 != stream1
+    # and the raw draw itself is a pure function of (digest, index)
+    assert _decision(n1._digest, 7) == _decision(n2._digest, 7)
+
+
+def test_netem_windows_and_budget():
+    n = Netem("srv")
+    # window not yet open: epoch pushed into the future (the lead_s trick
+    # that keeps arming RPCs off the partition they create)
+    n.install(parse_netem("a>srv:*:drop:for=5"), seed=0,
+              epoch=time.time() + 30.0)
+    assert n.apply("a", "srv", "x", "req") is None
+    # window expired
+    n.install(parse_netem("a>srv:*:drop:for=5"), seed=0,
+              epoch=time.time() - 30.0)
+    assert n.apply("a", "srv", "x", "req") is None
+    # open window, n=2 budget: exactly the first two matching frames hit
+    n.install(parse_netem("a>srv:*:drop:n=2"), seed=0)
+    hits = [n.apply("a", "srv", "x", "req") is not None for _ in range(4)]
+    assert hits == [True, True, False, False]
+    # endpoint prefix match + verb glob still gate the rule
+    n.install(parse_netem("abcd>srv:lease_*:drop"), seed=0)
+    assert n.apply("abcdef0123", "srv", "lease_worker", "req") is not None
+    assert n.apply("zz", "srv", "lease_worker", "req") is None
+    assert n.apply("abcdef0123", "srv", "heartbeat", "req") is None
+    n.clear()
+    assert not n.active
+
+
+def test_legacy_spec_shares_one_budget_across_phases():
+    """``method=N:req:resp`` folds into two netem rules sharing a single
+    N-failure budget (the reference rpc_chaos semantics)."""
+    rules = _legacy_rules("lease_worker=2:1.0:1.0")
+    assert len(rules) == 2 and rules[0]["_budget"] is rules[1]["_budget"]
+    n = Netem("srv")
+    n.install(rules, seed=0)
+    assert n.apply("a", "srv", "lease_worker", "req") is not None
+    assert n.apply("a", "srv", "lease_worker", "resp") is not None
+    # the shared budget is exhausted: BOTH phases go quiet
+    assert n.apply("a", "srv", "lease_worker", "req") is None
+    assert n.apply("a", "srv", "lease_worker", "resp") is None
+
+
+# ---------------------------------------------------------------------------
+# live RPC legs: an in-process server/client pair under netem
+# ---------------------------------------------------------------------------
+
+
+def _rpc_pair(test_body):
+    """Run ``test_body(server, client, calls)`` against an in-process
+    unix-socket pair; ``calls`` counts handler executions."""
+    async def main():
+        server = RpcServer("test-server", node_id="srv")
+        calls = {"n": 0}
+
+        async def echo(x=0):
+            calls["n"] += 1
+            return {"x": x, "n": calls["n"]}
+
+        server.register("echo", echo)
+        path = os.path.join(tempfile.mkdtemp(), "rpc.sock")
+        await server.listen_unix(path)
+        client = RpcClient("unix:" + path, "test-client", src_id="cli")
+        try:
+            await test_body(server, client, calls)
+        finally:
+            await client.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_rpc_req_drop_is_callers_timeout():
+    async def body(server, client, calls):
+        server._netem.install(parse_netem("cli>srv:echo:drop:n=1"), seed=0)
+        with pytest.raises(asyncio.TimeoutError):
+            await client.call("echo", x=1, timeout=0.4)
+        assert calls["n"] == 0  # the frame never reached the handler
+        # the budget is spent: the retry sails through untouched
+        out = await client.call("echo", x=2, timeout=5.0)
+        assert out["x"] == 2 and calls["n"] == 1
+
+    _rpc_pair(body)
+
+
+def test_rpc_resp_drop_loses_reply_after_apply():
+    """The hazard the ``_mid`` layer exists for: a resp-phase loss times
+    the caller out AFTER the handler already ran."""
+    async def body(server, client, calls):
+        server._netem.install(
+            parse_netem("cli>srv:echo:drop:n=1:phase=resp"), seed=0)
+        with pytest.raises(asyncio.TimeoutError):
+            await client.call("echo", x=1, timeout=0.4)
+        assert calls["n"] == 1  # applied, reply lost
+        out = await client.call("echo", x=2, timeout=5.0)
+        assert out["n"] == 2
+
+    _rpc_pair(body)
+
+
+def test_rpc_delay_and_dup():
+    async def body(server, client, calls):
+        server._netem.install(
+            parse_netem("cli>srv:echo:delay=0.3:n=1"), seed=0)
+        t0 = time.monotonic()
+        await client.call("echo", x=1, timeout=5.0)
+        assert time.monotonic() - t0 >= 0.3
+        # req-phase dup re-runs the handler exactly once more; the
+        # duplicate carries the guard flag, so it cannot cascade
+        server._netem.install(parse_netem("cli>srv:echo:dup:n=1"), seed=0)
+        await client.call("echo", x=2, timeout=5.0)
+        for _ in range(50):
+            if calls["n"] >= 3:
+                break
+            await asyncio.sleep(0.05)
+        assert calls["n"] == 3  # 1 (delayed) + 2 (original + one dup)
+        # budget spent: a further call runs once
+        await client.call("echo", x=3, timeout=5.0)
+        await asyncio.sleep(0.2)
+        assert calls["n"] == 4
+
+    _rpc_pair(body)
+
+
+# ---------------------------------------------------------------------------
+# GCS harnesses (in-process, real sockets — the test_drain topology)
+# ---------------------------------------------------------------------------
+
+
+def _gcs_env(test_body, flags=None):
+    """Run ``test_body(gcs, client)`` against an in-process GCS with a
+    raw RPC client (no raylets: nothing else issues deduped verbs, so
+    the at-most-once and fencing tables are fully test-controlled)."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+
+    config.reload(dict({"health_check_period_s": 1.0}, **(flags or {})))
+
+    async def main():
+        sd = tempfile.mkdtemp()
+        os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+        g = GcsServer(sd)
+        await g.start()
+        client = RpcClient(g.addr, "test-client", src_id="testcli")
+        try:
+            await test_body(g, client)
+        finally:
+            await client.close()
+            await g.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        config.reload()
+
+
+def _cluster_env(test_body, flags=None):
+    """Run ``test_body(gcs, raylet1, raylet2)`` on one event loop with
+    live heartbeating raylets (the drain-test topology)."""
+    from ray_tpu._private.config import config
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet
+
+    config.reload(dict({"health_check_period_s": 1.0}, **(flags or {})))
+
+    async def main():
+        sd = tempfile.mkdtemp()
+        os.makedirs(os.path.join(sd, "logs"), exist_ok=True)
+        g = GcsServer(sd)
+        await g.start()
+        r1 = Raylet(sd, g.addr, {"CPU": 2})
+        await r1.start()
+        r2 = Raylet(sd, g.addr, {"CPU": 2})
+        await r2.start()
+        try:
+            await test_body(g, r1, r2)
+        finally:
+            for r in (r1, r2):
+                try:
+                    await r.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            await g.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        config.reload()
+
+
+_NID = "feedc0de" * 8  # synthetic node id (no live raylet behind it)
+
+
+async def _register(client, node_id=_NID, cpus=1.0):
+    return await client.call(
+        "register_node", node_id=node_id, addr="unix:/nonexistent",
+        resources={"CPU": cpus}, labels={}, _mid=mint_mid())
+
+
+# ---------------------------------------------------------------------------
+# at-most-once GCS mutations
+# ---------------------------------------------------------------------------
+
+
+def test_gcs_at_most_once_dedup():
+    async def body(g, client):
+        mid = mint_mid()
+        first = await client.call("next_job_id", _mid=mid)
+        # a retry with the SAME _mid replays the cached reply: the
+        # counter does not advance
+        replay = await client.call("next_job_id", _mid=mid)
+        assert replay == first
+        assert g._job_counter == first
+        # a fresh _mid is a fresh mutation
+        second = await client.call("next_job_id", _mid=mint_mid())
+        assert second == first + 1
+        # idempotent verbs accept and ignore a _mid (uniform stamping)
+        assert await client.call("kv_put", ns="t", key="k", value=b"v",
+                                 _mid=mint_mid())
+        assert await client.call("kv_put", ns="t", key="k", value=b"v",
+                                 _mid=mint_mid())
+
+    _gcs_env(body)
+
+
+def test_gcs_dedup_never_caches_failures():
+    """A raised mutation did not apply — the retry must re-execute for
+    real instead of replaying the error (docs claim for the
+    ``gcs.mutation_dedup`` fault site)."""
+    async def body(g, client):
+        baseline = await client.call("next_job_id", _mid=mint_mid())
+        mid = mint_mid()
+        fi.arm("gcs.mutation_dedup")
+        try:
+            with pytest.raises(Exception):
+                await client.call("next_job_id", _mid=mid)
+        finally:
+            fi.disarm()
+        assert g._job_counter == baseline  # the faulted apply never ran
+        retry = await client.call("next_job_id", _mid=mid)
+        assert retry == baseline + 1
+        # and the successful retry IS now cached under that _mid
+        assert await client.call("next_job_id", _mid=mid) == retry
+
+    _gcs_env(body)
+
+
+# ---------------------------------------------------------------------------
+# cluster-epoch fencing
+# ---------------------------------------------------------------------------
+
+
+def test_fence_lifecycle_on_gcs_tables():
+    async def body(g, client):
+        ack = await _register(client)
+        assert ack["incarnation"] == 1
+        node = g.nodes[_NID]
+        assert node["fence"] == 0
+        # the view workers/raylets schedule against carries the identity
+        view = {n["node_id"]: n for n in g._cluster_view()}
+        assert view[_NID]["incarnation"] == 1 and view[_NID]["fence"] == 0
+
+        # every death path funnels through _mark_node_dead: fence bumps
+        await g._mark_node_dead(_NID, reason="test death")
+        assert not node["alive"] and node["fence"] == 1
+
+        # the dead incarnation is fenced; an unknown node is fenced too
+        with pytest.raises(StaleNodeError):
+            g._check_fence(_NID, 1)
+        with pytest.raises(StaleNodeError):
+            g._check_fence("na" * 32, 1)
+        # zombie diagnostics accrue for list_nodes / status / dashboard
+        assert node["stale_contacts"] >= 1
+        assert node["last_stale_contact"] <= time.time()
+
+        # a stale heartbeat is told so (the raylet's cue to self-fence)
+        reply = await g.handle_heartbeat(node_id=_NID, available={},
+                                         incarnation=1)
+        assert reply.get("stale")
+
+        # a fenced mutation is rejected END-TO-END: StaleNodeError
+        # round-trips the RPC boundary as itself
+        with pytest.raises(StaleNodeError):
+            await client.call("kv_put", ns="t", key="k", value=b"v",
+                              _fence={"node_id": _NID, "incarnation": 1})
+
+        # rejoining mints an incarnation past the fence; the new identity
+        # writes freely while the old one stays dead forever
+        ack2 = await _register(client)
+        assert ack2["incarnation"] == 2
+        g._check_fence(_NID, 2)  # no raise
+        reply = await g.handle_heartbeat(node_id=_NID, available={},
+                                         incarnation=2)
+        assert not reply.get("stale")
+        with pytest.raises(StaleNodeError):
+            g._check_fence(_NID, 1)
+
+    _gcs_env(body)
+
+
+def test_superseded_incarnation_cannot_overwrite_view():
+    """Split-brain: two processes claim one node id.  The older
+    incarnation's heartbeats must not clobber the live one's resources."""
+    async def body(g, client):
+        await _register(client, cpus=4.0)
+        await _register(client, cpus=4.0)  # the "new" claimant: inc 2
+        node = g.nodes[_NID]
+        assert node["incarnation"] == 2
+        await g.handle_heartbeat(node_id=_NID, available={"CPU": 3.0},
+                                 incarnation=2)
+        # the zombie claimant reports wildly different availability
+        reply = await g.handle_heartbeat(node_id=_NID,
+                                         available={"CPU": 0.0},
+                                         incarnation=1)
+        assert reply.get("stale")
+        assert node["available"] == {"CPU": 3.0}
+        assert node["stale_contacts"] >= 1
+
+    _gcs_env(body)
+
+
+# ---------------------------------------------------------------------------
+# partitions end-to-end on live raylets
+# ---------------------------------------------------------------------------
+
+
+async def _wait_for(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_arm_netem_fans_out_to_involved_raylets():
+    async def body(g, r1, r2):
+        rules = partition_rules(r1.node_id, "gcs", mode="symmetric",
+                                duration_s=4.0)
+        # lead_s pushes the window epoch out so the arming RPCs (and
+        # their replies) never ride the partition they install
+        ack = await g.handle_arm_netem(rules=rules, seed=7, lead_s=30.0)
+        assert ack["armed"]["gcs"] and ack["armed"][r1.node_id]
+        assert r2.node_id not in ack["armed"]  # uninvolved: not armed
+        assert g.server._netem.active and r1.server._netem.active
+        assert not r2.server._netem.active
+        # the shared epoch anchors both ends to the same instant
+        assert ack["epoch"] > time.time() + 25.0
+        assert ack["schedule"] == g.server._netem.schedule()
+        # an empty rule set clears the GCS emulator
+        await g.handle_arm_netem(rules=[])
+        assert not g.server._netem.active
+        r1.server._netem.clear()
+
+    _cluster_env(body)
+
+
+@pytest.mark.chaos
+def test_partition_death_fence_rejoin_loop():
+    """The tentpole end-to-end: a oneway partition silences a raylet,
+    the GCS declares it dead and bumps its fence; the heal exposes the
+    zombie, whose next heartbeat is told ``stale`` — it self-fences and
+    rejoins as a fresh incarnation with clean capacity."""
+    async def body(g, r1, r2):
+        victim = r1.node_id
+        assert g.nodes[victim]["incarnation"] == 1
+        # death timeout = (1.0/5) * 2 * 5 = 2.0s; the 5s window outlives
+        # it, so the death is declared MID-partition
+        rules = partition_rules(victim, "gcs", mode="oneway",
+                                duration_s=5.0)
+        ack = await g.handle_arm_netem(rules=rules, seed=42, lead_s=1.0)
+        assert ack["armed"]["gcs"] and ack["armed"][victim]
+
+        await _wait_for(lambda: not g.nodes[victim]["alive"], 15.0,
+                        "heartbeat-timeout death of the victim")
+        node = g.nodes[victim]
+        assert node["fence"] == 1
+        assert "heartbeat" in node["death_reason"]
+        # the survivor never wavered
+        assert g.nodes[r2.node_id]["alive"]
+
+        # heal: the zombie's first heartbeat through is fenced, and the
+        # raylet rejoins as incarnation 2
+        await _wait_for(
+            lambda: (g.nodes[victim]["alive"]
+                     and g.nodes[victim]["incarnation"] == 2), 20.0,
+            "fenced zombie rejoining as a fresh incarnation")
+        assert r1.incarnation == 2
+        assert g.nodes[victim]["fence"] == 1  # old identity dead forever
+        with pytest.raises(StaleNodeError):
+            g._check_fence(victim, 1)
+        # rejoined clean: full capacity, no inherited drain
+        assert not r1.draining
+        assert r1.available.to_dict() == r1.total.to_dict()
+
+    _cluster_env(body, flags={"num_heartbeats_timeout": 2})

@@ -159,6 +159,54 @@ def test_production_day_degrade_variant():
 
 @pytest.mark.chaos
 @pytest.mark.slow
+@pytest.mark.usefixtures("no_cluster")
+def test_production_day_partition_variant():
+    """Satellite: ``--partition`` swaps the clean-kill timeline for a
+    transient netem partition — one worker node cut off the control
+    plane at the RPC transport (``partition_nodes`` builtin).  Nothing
+    is declared dead (the window is far shorter than the death
+    timeout): the gate is that all three planes ride the partition out
+    on the retry layer with exactly-once accounting intact, and that
+    the drop rules really armed on both ends of the link."""
+    from production_day import PROFILES, run_production_day
+
+    profile = dataclasses.replace(
+        PROFILES["tier1"],
+        serve_rate_hz=6.0, baseline_s=5.0, chaos_tail_s=8.0,
+        rlhf_iterations=7, rlhf_interval_s=1.0,
+        ingest_blocks=6, ingest_block_rows=48, ingest_batch_rows=48,
+    )
+    # same adjustment the --partition entrypoint makes: the partition
+    # window is dead air, so it extends the ingest recovery budget
+    profile = dataclasses.replace(
+        profile, ingest_recovery_s=(profile.ingest_recovery_s
+                                    + profile.partition_duration_s))
+    record = run_production_day(profile, profile.scenario_partition())
+    json.dumps(record)  # emission payload stays JSON-clean
+    assert record["ok"], record["problems"]
+    executed = record["timeline"]["executed"]
+    fired = [e for e in executed
+             if e["ok"] and e["kind"] == "partition_nodes"]
+    assert fired, executed
+    res = fired[0]["result"]
+    # a victim was picked and the rules armed on at least one endpoint
+    assert res["node"], res
+    assert any((res.get("armed") or {}).values()), res
+    # the netem seed is recorded: the schedule is replayable
+    assert "seed" in res and res.get("epoch"), res
+    # exactly-once accounting survived the partition
+    chaos_rlhf = next(v for v in record["verdicts"]["chaos"]
+                      if v["plane"] == "rlhf")
+    assert chaos_rlhf["metrics"]["duplicates_rejected"] == 0
+    assert chaos_rlhf["metrics"]["trajectories_unaccounted"] == 0
+    # SLO verdicts still evaluated for every plane in both phases
+    for phase in ("baseline", "chaos"):
+        assert {v["plane"] for v in record["verdicts"][phase]} >= {
+            "serve", "rlhf", "ingest"}
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
 def test_production_day_full_profile():
     """Full-size profile driven through the real entrypoint (subprocess,
     merged streams): the harness-shaped contract — rc 0 and the LAST

@@ -800,6 +800,91 @@ def test_collective_supervision_fixtures(tmp_path):
     assert any("barrier" in f.message for f in r.findings)
 
 
+_GCS_BAD = '''\
+_READONLY_HANDLERS = frozenset({"get_all_nodes", "ghost_verb"})
+
+GCS_VERB_IDEMPOTENCY = {
+    "register_node": "deduped",
+    "kv_put": "sideways",
+    "gone_verb": "idempotent",
+    "get_all_nodes": "idempotent",
+}
+
+
+class GcsServer:
+    async def handle_register_node(self, node_id):
+        return {}
+
+    async def handle_kv_put(self, key, value):
+        return True
+
+    async def handle_get_all_nodes(self):
+        return []
+
+    async def handle_unannotated(self):
+        return True
+'''
+
+_GCS_GOOD = '''\
+_READONLY_HANDLERS = frozenset({"get_all_nodes"})
+
+GCS_VERB_IDEMPOTENCY = {
+    "register_node": "deduped",
+    "kv_put": "idempotent",
+}
+
+
+class GcsServer:
+    async def handle_register_node(self, node_id):
+        return {}
+
+    async def handle_kv_put(self, key, value):
+        return True
+
+    async def handle_get_all_nodes(self):
+        return []
+'''
+
+
+def test_gcs_verb_idempotency_fixtures(tmp_path):
+    # the checker only audits the real GCS module path
+    r = lint_tree(tmp_path, {"ray_tpu/_private/gcs.py": _GCS_BAD},
+                  rules=["gcs-verb-idempotency"])
+    msgs = sorted(f.message for f in r.findings)
+    assert [f.rule for f in r.findings] == ["gcs-verb-idempotency"] * 5, msgs
+    joined = "\n".join(msgs)
+    assert "'unannotated' is not annotated" in joined          # missing
+    assert "'sideways'" in joined                              # bad kind
+    assert "'gone_verb' names no handle_gone_verb" in joined   # stale table
+    assert "'ghost_verb' names no handle_ghost_verb" in joined  # stale ro
+    assert "both read-only and mutating" in joined             # overlap
+
+    r = lint_tree(tmp_path, {"ray_tpu/_private/gcs.py": _GCS_GOOD},
+                  rules=["gcs-verb-idempotency"])
+    assert not r.findings, r.findings
+
+    # a computed registry defeats the static audit: reported loudly
+    computed = _GCS_GOOD.replace('frozenset({"get_all_nodes"})',
+                                 "frozenset(_build_readonly())")
+    r = lint_tree(tmp_path, {"ray_tpu/_private/gcs.py": computed},
+                  rules=["gcs-verb-idempotency"])
+    assert [f.rule for f in r.findings] == ["gcs-verb-idempotency"]
+    assert "_READONLY_HANDLERS" in r.findings[0].message
+
+    # no handle_register_node class at all: the audit is broken, say so
+    headless = "GCS_VERB_IDEMPOTENCY = {}\n_READONLY_HANDLERS = frozenset()\n"
+    r = lint_tree(tmp_path, {"ray_tpu/_private/gcs.py": headless},
+                  rules=["gcs-verb-idempotency"])
+    assert any("cannot find the GCS server class" in f.message
+               for f in r.findings)
+
+    # some OTHER file defining handle_* verbs is not this checker's business
+    r = lint_tree(tmp_path, {"ray_tpu/_private/gcs.py": _GCS_GOOD,
+                             "ray_tpu/other.py": _GCS_BAD},
+                  rules=["gcs-verb-idempotency"])
+    assert not r.findings, r.findings
+
+
 # ---------------------------------------------------------------------------
 # engine semantics: suppressions + syntax errors
 # ---------------------------------------------------------------------------
@@ -889,7 +974,7 @@ def test_expected_rule_set(live_result):
         "lock-discipline", "context-capture", "fault-site-coverage",
         "proxy-request-context", "collective-supervision",
         "serial-blocking-get", "test-hygiene", "bench-emission",
-        "sharding-discipline"}
+        "sharding-discipline", "gcs-verb-idempotency"}
 
 
 @pytest.mark.parametrize("rule", sorted(
